@@ -1,0 +1,40 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capability
+surface of PaddlePaddle Fluid (reference at /root/reference), built on
+JAX/XLA/Pallas/pjit.
+
+Architecture (see SURVEY.md for the full blueprint):
+  * Python builds a Program (Block ⊃ OpDescs) — reference framework.proto IR.
+  * Ops are JAX lowerings in a registry; autodiff appends grad ops
+    (program-level, like backward.py) with a generic jax.vjp grad op.
+  * The compiling Executor lowers a whole block to ONE jitted XLA
+    computation (the ParallelExecutor/BuildStrategy role); an interpreting
+    executor is the correctness oracle.
+  * Parallelism = jax.sharding over a Mesh (DP/TP/PP/SP), not per-device
+    graph replication; collective ops lower to psum/all_gather/ppermute.
+"""
+
+from . import initializer, layers, optimizer, regularizer  # noqa: F401
+from . import ops as _ops  # registers all op lowerings  # noqa: F401
+from .core import (CPUPlace, CUDAPlace, Executor, Parameter, Program,  # noqa: F401
+                   Scope, TPUPlace, Variable, XLAPlace, append_backward,
+                   default_main_program, default_startup_program, device_guard,
+                   global_scope, gradients, in_dygraph_mode, program_guard)
+from .core.compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: F401
+from .core.executor import run_startup  # noqa: F401
+from .param_attr import ParamAttr  # noqa: F401
+
+__version__ = "0.1.0"
+
+# fluid-compat namespace: `import paddle_tpu.fluid as fluid` style usage is
+# served by this module itself (fluid == paddle_tpu).
+fluid = __import__(__name__)
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """paddle.static.data — full shape, no implicit batch dim."""
+    return layers.static_data(name, shape, dtype, lod_level)
+
+
+def set_global_seed(seed: int):
+    default_main_program().random_seed = seed
+    default_startup_program().random_seed = seed

@@ -1,0 +1,12 @@
+"""Core: IR, registry, executors, autodiff, scope, compiler."""
+
+from . import ir, registry, types, unique_name  # noqa: F401
+from .backward import append_backward, gradients  # noqa: F401
+from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: F401
+from .executor import ExecutionError, Executor, run_startup  # noqa: F401
+from .ir import (Block, OpDesc, OpRole, Parameter, Program, VarDesc,  # noqa: F401
+                 Variable, default_main_program, default_startup_program,
+                 device_guard, in_dygraph_mode, program_guard)
+from .scope import Scope, global_scope, reset_global_scope  # noqa: F401
+from .types import (CPUPlace, CUDAPlace, Place, TPUPlace, VarType,  # noqa: F401
+                    XLAPlace, convert_dtype, default_place)

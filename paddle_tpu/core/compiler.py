@@ -1,0 +1,88 @@
+"""CompiledProgram: attach execution/parallelism metadata to a Program.
+
+Capability mirror of python/paddle/fluid/compiler.py:87 (CompiledProgram →
+core.ParallelExecutor). On TPU there is no per-device graph replication
+(multi_devices_graph_pass.cc:175) — `with_data_parallel` records a
+`jax.sharding.Mesh` plus feed shardings; the compiling executor jits the SAME
+single program with those shardings and XLA/GSPMD inserts ICI collectives
+(the AllReduceOpHandle equivalent is `psum` emitted by the compiler).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from .ir import Program
+
+
+class BuildStrategy:
+    """Knob container kept for API parity (reference: details/build_strategy.h:50).
+
+    Most knobs are XLA's job now; the meaningful ones map to sharding or jit
+    options."""
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.fuse_all_reduce_ops = True      # XLA always fuses; kept for parity
+        self.fuse_elewise_add_act_ops = True
+        self.memory_optimize = True
+        self.enable_inplace = True
+        self.num_trainers = 1
+        self.trainer_id = 0
+
+
+class ExecutionStrategy:
+    """Reference: details/execution_strategy.h:22 — thread counts are moot
+    under one compiled XLA program; kept for API parity."""
+
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 1
+        self.use_experimental_executor = False
+
+
+class CompiledProgram:
+    def __init__(self, program: Program, build_strategy: Optional[BuildStrategy] = None):
+        self._program = program
+        self._build_strategy = build_strategy or BuildStrategy()
+        self._exec_strategy = ExecutionStrategy()
+        self._mesh = None
+        self._feed_shardings = None
+        self._loss_name = None
+
+    def with_data_parallel(self, loss_name: Optional[str] = None,
+                           build_strategy: Optional[BuildStrategy] = None,
+                           exec_strategy: Optional[ExecutionStrategy] = None,
+                           places=None, mesh=None, data_axis: str = "dp"):
+        """Data parallelism: shard the feed batch axis over the mesh's data
+        axis; parameters stay replicated; XLA inserts the grad allreduce.
+        """
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        self._loss_name = loss_name
+        if build_strategy is not None:
+            self._build_strategy = build_strategy
+        if exec_strategy is not None:
+            self._exec_strategy = exec_strategy
+        if mesh is None:
+            devs = np.array(jax.devices())
+            mesh = Mesh(devs.reshape(len(devs)), (data_axis,))
+        self._mesh = mesh
+        self._data_axis = data_axis
+        return self
+
+    def _sharding_for_feed(self, feed: Dict[str, Any]):
+        """Batch axis of every feed is sharded over the data axis; called by
+        the Executor at run time (feed names are only known then)."""
+        if self._mesh is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return {name: NamedSharding(self._mesh, P(self._data_axis))
+                for name in feed}

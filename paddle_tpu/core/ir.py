@@ -1,0 +1,681 @@
+"""Program IR: Program ⊃ Block ⊃ {VarDesc, OpDesc}.
+
+Capability mirror of the reference's protobuf IR
+(paddle/fluid/framework/framework.proto: OpDesc:42, VarDesc:165, BlockDesc:174,
+ProgramDesc:198) and its Python builder (python/paddle/fluid/framework.py:
+Variable:924, Operator:1916, Block:2507, Program:3969) — re-designed for XLA:
+
+* Descs are plain Python dataclasses (JSON-serialisable) instead of protobuf.
+* Build-time shape/dtype inference runs the op's *JAX lowering* under
+  `jax.eval_shape` — one source of truth instead of separate InferShape
+  functions (reference keeps per-op InferShape in C++, operator.cc:1076).
+* Dynamic (batch) dims are stored as -1 and substituted with a sentinel for
+  tracing; execution never depends on desc shapes.
+
+A whole Block is later compiled into ONE jitted XLA computation by the
+compiling executor (see executor.py) instead of being interpreted op-by-op
+(reference hot loop: framework/executor.cc:474-481).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import copy
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Union
+
+import numpy as np
+
+from . import unique_name
+from .types import VarType, convert_dtype
+
+# Op role taxonomy (reference: framework/op_proto_maker.h OpRole)
+class OpRole:
+    Forward = 0
+    Backward = 1
+    Optimize = 2
+    RPC = 3
+    Dist = 4
+    LRSched = 16
+    Loss = 0x100
+    Collective = 0x200
+
+
+# Sentinel used to trace dynamic dims through jax.eval_shape.
+_DYN_SENTINEL = 509  # prime, unlikely to appear as a real model dim
+
+
+def _json_attr(v):
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, np.dtype):
+        return str(v)
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    return v
+
+
+@dataclass
+class VarDesc:
+    """Variable metadata (reference: framework.proto VarDesc:165)."""
+
+    name: str
+    shape: Optional[tuple] = None  # None = unknown; -1 = dynamic dim
+    dtype: Any = np.float32
+    type: VarType = VarType.DENSE_TENSOR
+    persistable: bool = False
+    stop_gradient: bool = False
+    lod_level: int = 0
+    is_parameter: bool = False
+    trainable: bool = True
+    attrs: Dict[str, Any] = field(default_factory=dict)  # e.g. sharding spec
+
+    def __post_init__(self):
+        if self.shape is not None:
+            self.shape = tuple(int(d) for d in self.shape)
+        self.dtype = convert_dtype(self.dtype)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "shape": list(self.shape) if self.shape is not None else None,
+            "dtype": str(np.dtype(self.dtype)),
+            "type": self.type.value,
+            "persistable": self.persistable,
+            "stop_gradient": self.stop_gradient,
+            "lod_level": self.lod_level,
+            "is_parameter": self.is_parameter,
+            "trainable": self.trainable,
+            "attrs": {k: _json_attr(v) for k, v in self.attrs.items()},
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "VarDesc":
+        return VarDesc(
+            name=d["name"],
+            shape=tuple(d["shape"]) if d.get("shape") is not None else None,
+            dtype=d.get("dtype", "float32"),
+            type=VarType(d.get("type", "dense_tensor")),
+            persistable=d.get("persistable", False),
+            stop_gradient=d.get("stop_gradient", False),
+            lod_level=d.get("lod_level", 0),
+            is_parameter=d.get("is_parameter", False),
+            trainable=d.get("trainable", True),
+            attrs=dict(d.get("attrs", {})),
+        )
+
+
+class OpDesc:
+    """One operator invocation (reference: framework.proto OpDesc:42).
+
+    inputs/outputs map proto slot names to lists of variable names
+    (multi-var slots exist: e.g. `sum` takes X=[a, b, c]).
+    """
+
+    __slots__ = ("type", "inputs", "outputs", "attrs", "callstack")
+
+    def __init__(self, type: str, inputs: Dict[str, List[str]],
+                 outputs: Dict[str, List[str]], attrs: Optional[Dict[str, Any]] = None):
+        self.type = type
+        self.inputs = {k: list(v) for k, v in inputs.items()}
+        self.outputs = {k: list(v) for k, v in outputs.items()}
+        self.attrs = dict(attrs or {})
+        # attach Python build-site stack for error reporting
+        # (reference: framework/op_call_stack.cc)
+        self.callstack = traceback.format_stack(limit=6)[:-2]
+
+    def input_names(self) -> List[str]:
+        return [n for names in self.inputs.values() for n in names]
+
+    def output_names(self) -> List[str]:
+        return [n for names in self.outputs.values() for n in names]
+
+    def input(self, slot: str) -> List[str]:
+        return self.inputs.get(slot, [])
+
+    def output(self, slot: str) -> List[str]:
+        return self.outputs.get(slot, [])
+
+    def attr(self, name: str, default=None):
+        return self.attrs.get(name, default)
+
+    def has_attr(self, name: str) -> bool:
+        return name in self.attrs
+
+    def _rename_input(self, old: str, new: str):
+        for slot in self.inputs:
+            self.inputs[slot] = [new if n == old else n for n in self.inputs[slot]]
+
+    def _rename_output(self, old: str, new: str):
+        for slot in self.outputs:
+            self.outputs[slot] = [new if n == old else n for n in self.outputs[slot]]
+
+    @property
+    def op_role(self) -> int:
+        return self.attrs.get("op_role", OpRole.Forward)
+
+    def is_backward_op(self) -> bool:
+        return (self.op_role & 0xF) == OpRole.Backward
+
+    def is_optimize_op(self) -> bool:
+        return (self.op_role & 0xF) == OpRole.Optimize
+
+    def to_dict(self) -> dict:
+        return {
+            "type": self.type,
+            "inputs": self.inputs,
+            "outputs": self.outputs,
+            "attrs": {k: _json_attr(v) for k, v in self.attrs.items()},
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "OpDesc":
+        return OpDesc(d["type"], d["inputs"], d["outputs"], d.get("attrs", {}))
+
+    def __repr__(self):
+        ins = ", ".join(f"{k}={v}" for k, v in self.inputs.items())
+        outs = ", ".join(f"{k}={v}" for k, v in self.outputs.items())
+        return f"Op({self.type}: {ins} -> {outs})"
+
+
+class Variable:
+    """Python handle to a VarDesc in a Block (reference: framework.py:924).
+
+    Supports arithmetic operator overloads that append elementwise ops to the
+    variable's block — this is what makes `a + b` inside a program build IR.
+    """
+
+    def __init__(self, block: "Block", desc: VarDesc):
+        self.block = block
+        self.desc = desc
+
+    # -- metadata ------------------------------------------------------------
+    @property
+    def name(self) -> str:
+        return self.desc.name
+
+    @property
+    def shape(self) -> Optional[tuple]:
+        return self.desc.shape
+
+    @property
+    def dtype(self):
+        return self.desc.dtype
+
+    @property
+    def type(self) -> VarType:
+        return self.desc.type
+
+    @property
+    def persistable(self) -> bool:
+        return self.desc.persistable
+
+    @persistable.setter
+    def persistable(self, v: bool):
+        self.desc.persistable = v
+
+    @property
+    def stop_gradient(self) -> bool:
+        return self.desc.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v: bool):
+        self.desc.stop_gradient = v
+
+    @property
+    def lod_level(self) -> int:
+        return self.desc.lod_level
+
+    def astype(self, dtype) -> "Variable":
+        from .. import layers
+
+        return layers.cast(self, dtype)
+
+    # -- operator overloads --------------------------------------------------
+    def _binary(self, other, op, reverse=False):
+        from .. import layers
+
+        return layers._elementwise_binary(self, other, op, reverse)
+
+    def __add__(self, other):
+        return self._binary(other, "elementwise_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._binary(other, "elementwise_sub")
+
+    def __rsub__(self, other):
+        return self._binary(other, "elementwise_sub", reverse=True)
+
+    def __mul__(self, other):
+        return self._binary(other, "elementwise_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._binary(other, "elementwise_div")
+
+    def __rtruediv__(self, other):
+        return self._binary(other, "elementwise_div", reverse=True)
+
+    def __pow__(self, other):
+        return self._binary(other, "elementwise_pow")
+
+    def __neg__(self):
+        from .. import layers
+
+        return layers.scale(self, scale=-1.0)
+
+    def __matmul__(self, other):
+        from .. import layers
+
+        return layers.matmul(self, other)
+
+    def _cmp(self, other, op):
+        from .. import layers
+
+        return layers._compare(self, other, op)
+
+    def __lt__(self, other):
+        return self._cmp(other, "less_than")
+
+    def __le__(self, other):
+        return self._cmp(other, "less_equal")
+
+    def __gt__(self, other):
+        return self._cmp(other, "greater_than")
+
+    def __ge__(self, other):
+        return self._cmp(other, "greater_equal")
+
+    def __getitem__(self, idx):
+        from .. import layers
+
+        return layers._getitem(self, idx)
+
+    def __repr__(self):
+        return (f"Variable(name={self.name}, shape={self.shape}, "
+                f"dtype={np.dtype(self.dtype).name}, persistable={self.persistable})")
+
+    __str__ = __repr__
+
+
+class Parameter(Variable):
+    """Trainable persistable variable (reference: framework.py Parameter:5116)."""
+
+    def __init__(self, block: "Block", desc: VarDesc, trainable: bool = True,
+                 regularizer=None, optimize_attr=None):
+        desc.persistable = True
+        desc.is_parameter = True
+        desc.trainable = trainable
+        super().__init__(block, desc)
+        self.regularizer = regularizer
+        self.optimize_attr = optimize_attr or {"learning_rate": 1.0}
+
+    @property
+    def trainable(self) -> bool:
+        return self.desc.trainable
+
+    @trainable.setter
+    def trainable(self, v: bool):
+        self.desc.trainable = v
+
+
+class Block:
+    """Ordered list of ops + var table (reference: framework.py Block:2507)."""
+
+    def __init__(self, program: "Program", idx: int, parent_idx: int = -1):
+        self.program = program
+        self.idx = idx
+        self.parent_idx = parent_idx
+        self.vars: Dict[str, Variable] = {}
+        self.ops: List[OpDesc] = []
+
+    @property
+    def parent_block(self) -> Optional["Block"]:
+        if self.parent_idx < 0:
+            return None
+        return self.program.block(self.parent_idx)
+
+    # -- var management ------------------------------------------------------
+    def create_var(self, name: Optional[str] = None, shape=None, dtype="float32",
+                   type: VarType = VarType.DENSE_TENSOR, persistable: bool = False,
+                   stop_gradient: bool = False, lod_level: int = 0, **kw) -> Variable:
+        name = name or unique_name.generate("_generated_var")
+        if name in self.vars:
+            return self.vars[name]
+        desc = VarDesc(name=name, shape=tuple(shape) if shape is not None else None,
+                       dtype=dtype, type=type, persistable=persistable,
+                       stop_gradient=stop_gradient, lod_level=lod_level)
+        var = Variable(self, desc)
+        self.vars[name] = var
+        self.program._bump_version()
+        return var
+
+    def create_parameter(self, name: str, shape, dtype="float32", trainable=True,
+                         regularizer=None, optimize_attr=None) -> Parameter:
+        desc = VarDesc(name=name, shape=tuple(shape), dtype=dtype, persistable=True)
+        param = Parameter(self, desc, trainable=trainable, regularizer=regularizer,
+                          optimize_attr=optimize_attr)
+        self.vars[name] = param
+        self.program._bump_version()
+        return param
+
+    def var(self, name: str) -> Variable:
+        v = self._find_var_recursive(name)
+        if v is None:
+            raise ValueError(f"Variable '{name}' not found in block {self.idx}")
+        return v
+
+    def has_var(self, name: str) -> bool:
+        return self._find_var_recursive(name) is not None
+
+    def _find_var_recursive(self, name: str) -> Optional[Variable]:
+        blk: Optional[Block] = self
+        while blk is not None:
+            if name in blk.vars:
+                return blk.vars[name]
+            blk = blk.parent_block
+        return None
+
+    def all_parameters(self) -> List[Parameter]:
+        return [v for v in self.vars.values() if isinstance(v, Parameter)]
+
+    # -- op management -------------------------------------------------------
+    @staticmethod
+    def _normalize_io(io: Optional[Dict[str, Any]]) -> Dict[str, List[str]]:
+        out: Dict[str, List[str]] = {}
+        for slot, vals in (io or {}).items():
+            if vals is None:
+                continue
+            if not isinstance(vals, (list, tuple)):
+                vals = [vals]
+            names = []
+            for v in vals:
+                if isinstance(v, (Variable,)):
+                    names.append(v.name)
+                elif isinstance(v, str):
+                    names.append(v)
+                else:
+                    raise TypeError(f"bad io entry for slot {slot}: {type(v)}")
+            out[slot] = names
+        return out
+
+    def append_op(self, type: str, inputs: Optional[Dict] = None,
+                  outputs: Optional[Dict] = None, attrs: Optional[Dict] = None,
+                  infer_shape: bool = True) -> OpDesc:
+        op = OpDesc(type, self._normalize_io(inputs), self._normalize_io(outputs),
+                    attrs)
+        if "op_role" not in op.attrs:
+            op.attrs["op_role"] = self.program._current_role
+        self.ops.append(op)
+        if infer_shape:
+            self._infer_op_shapes(op)
+        self.program._bump_version()
+        return op
+
+    def _prepend_op(self, type: str, inputs=None, outputs=None, attrs=None) -> OpDesc:
+        op = OpDesc(type, self._normalize_io(inputs), self._normalize_io(outputs), attrs)
+        if "op_role" not in op.attrs:
+            op.attrs["op_role"] = self.program._current_role
+        self.ops.insert(0, op)
+        self.program._bump_version()
+        return op
+
+    def _infer_op_shapes(self, op: OpDesc):
+        """Build-time shape/dtype inference by tracing the op's JAX lowering
+        with jax.eval_shape over sentinel-substituted dynamic dims.
+
+        Replaces the reference's per-op C++ InferShape (operator.cc:1076) with
+        the lowering itself as the single source of truth.
+        """
+        from . import registry
+
+        opdef = registry.lookup(op.type)
+        if opdef is None or opdef.forward is None or opdef.skip_infer_shape:
+            return
+        import jax
+
+        structs: Dict[str, List[Any]] = {}
+        try:
+            for slot, names in op.inputs.items():
+                lst = []
+                for n in names:
+                    v = self._find_var_recursive(n)
+                    if v is None or v.shape is None:
+                        return  # unknown input shape: give up silently
+                    shape = tuple(_DYN_SENTINEL if d == -1 else d for d in v.shape)
+                    lst.append(jax.ShapeDtypeStruct(shape, np.dtype(v.dtype)))
+                structs[slot] = lst
+
+            out_structs = jax.eval_shape(
+                lambda ins: opdef.forward(ins, dict(op.attrs)), structs)
+        except Exception:
+            return  # inference is best-effort; runtime uses real arrays
+
+        if not isinstance(out_structs, dict):
+            return
+        for slot, names in op.outputs.items():
+            vals = out_structs.get(slot)
+            if vals is None:
+                continue
+            if not isinstance(vals, (list, tuple)):
+                vals = [vals]
+            for n, s in zip(names, vals):
+                v = self._find_var_recursive(n)
+                if v is None or s is None:
+                    continue
+                shape = tuple(-1 if (d == _DYN_SENTINEL or (d > _DYN_SENTINEL and d % _DYN_SENTINEL == 0))
+                              else d for d in s.shape)
+                v.desc.shape = shape
+                v.desc.dtype = np.dtype(s.dtype)
+
+    def to_dict(self) -> dict:
+        return {
+            "idx": self.idx,
+            "parent_idx": self.parent_idx,
+            "vars": [v.desc.to_dict() for v in self.vars.values()],
+            "ops": [op.to_dict() for op in self.ops],
+        }
+
+    def _load_dict(self, d: dict):
+        for vd in d.get("vars", []):
+            desc = VarDesc.from_dict(vd)
+            if desc.is_parameter:
+                self.vars[desc.name] = Parameter(self, desc, trainable=desc.trainable)
+            else:
+                self.vars[desc.name] = Variable(self, desc)
+        for od in d.get("ops", []):
+            self.ops.append(OpDesc.from_dict(od))
+
+    def __repr__(self):
+        return f"Block(idx={self.idx}, vars={len(self.vars)}, ops={len(self.ops)})"
+
+
+class Program:
+    """A whole computation (reference: framework.py Program:3969).
+
+    Holds a list of Blocks; block 0 is the global block. The compiling
+    executor lowers one (program, feed-names, fetch-names) triple to a single
+    jitted XLA computation, keyed on `version` for cache invalidation.
+    """
+
+    def __init__(self):
+        self.blocks: List[Block] = [Block(self, 0, -1)]
+        self.current_block_idx = 0
+        self.random_seed: int = 0
+        self._current_role = OpRole.Forward
+        self._version = 0
+        # populated by append_backward: maps var name -> grad var name
+        self.grad_var_map: Dict[str, str] = {}
+        self._seed_counter = 0
+
+    def _bump_version(self):
+        self._version += 1
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    def next_op_seed(self) -> int:
+        """Per-op RNG seed assigned at build time; runtime folds in the global
+        step so random ops (dropout, …) vary per run but stay reproducible."""
+        self._seed_counter += 1
+        return self.random_seed * 1000003 + self._seed_counter
+
+    def global_block(self) -> Block:
+        return self.blocks[0]
+
+    def current_block(self) -> Block:
+        return self.blocks[self.current_block_idx]
+
+    def block(self, idx: int) -> Block:
+        return self.blocks[idx]
+
+    def create_block(self, parent_idx: Optional[int] = None) -> Block:
+        parent = self.current_block_idx if parent_idx is None else parent_idx
+        blk = Block(self, len(self.blocks), parent)
+        self.blocks.append(blk)
+        self.current_block_idx = blk.idx
+        return blk
+
+    def rollback(self):
+        self.current_block_idx = self.current_block().parent_idx
+
+    @contextlib.contextmanager
+    def _role_guard(self, role: int):
+        old = self._current_role
+        self._current_role = role
+        try:
+            yield
+        finally:
+            self._current_role = old
+
+    def list_vars(self) -> Iterator[Variable]:
+        for blk in self.blocks:
+            yield from blk.vars.values()
+
+    def all_parameters(self) -> List[Parameter]:
+        out = []
+        for blk in self.blocks:
+            out.extend(blk.all_parameters())
+        return out
+
+    def clone(self, for_test: bool = False) -> "Program":
+        """Deep-copy the program. for_test=True keeps only forward ops and
+        flips is_test attrs (reference: framework.py Program.clone)."""
+        p = Program()
+        p.random_seed = self.random_seed
+        p._seed_counter = self._seed_counter
+        p.blocks = []
+        for blk in self.blocks:
+            nb = Block(p, blk.idx, blk.parent_idx)
+            for name, var in blk.vars.items():
+                desc = copy.deepcopy(var.desc)
+                if isinstance(var, Parameter):
+                    nb.vars[name] = Parameter(nb, desc, trainable=var.trainable)
+                else:
+                    nb.vars[name] = Variable(nb, desc)
+            for op in blk.ops:
+                if for_test and (op.is_backward_op() or op.is_optimize_op()):
+                    continue
+                nop = OpDesc(op.type, op.inputs, op.outputs, copy.deepcopy(op.attrs))
+                if for_test and "is_test" in nop.attrs:
+                    nop.attrs["is_test"] = True
+                nb.ops.append(nop)
+            p.blocks.append(nb)
+        if not p.blocks:
+            p.blocks = [Block(p, 0, -1)]
+        p.grad_var_map = dict(self.grad_var_map)
+        p._bump_version()
+        return p
+
+    def to_dict(self) -> dict:
+        return {
+            "random_seed": self.random_seed,
+            "blocks": [b.to_dict() for b in self.blocks],
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "Program":
+        p = Program()
+        p.random_seed = d.get("random_seed", 0)
+        p.blocks = []
+        for bd in d["blocks"]:
+            blk = Block(p, bd["idx"], bd.get("parent_idx", -1))
+            blk._load_dict(bd)
+            p.blocks.append(blk)
+        if not p.blocks:
+            p.blocks = [Block(p, 0, -1)]
+        p._bump_version()
+        return p
+
+    def __repr__(self):
+        nops = sum(len(b.ops) for b in self.blocks)
+        return f"Program(blocks={len(self.blocks)}, ops={nops}, version={self._version})"
+
+
+# -- default program stack ---------------------------------------------------
+
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program() -> Program:
+    return _main_program
+
+
+def default_startup_program() -> Program:
+    return _startup_program
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program, startup_program: Optional[Program] = None):
+    """Scope the default programs (reference: framework.py:5455)."""
+    global _main_program, _startup_program
+    old_main, old_startup = _main_program, _startup_program
+    _main_program = main_program
+    if startup_program is not None:
+        _startup_program = startup_program
+    try:
+        yield
+    finally:
+        _main_program, _startup_program = old_main, old_startup
+
+
+def switch_main_program(program: Program) -> Program:
+    global _main_program
+    old = _main_program
+    _main_program = program
+    return old
+
+
+# device_guard: pins subsequent ops to a pipeline stage
+# (reference: framework.py:5591 device_guard — the pipeline-stage mechanism)
+_current_device: Optional[str] = None
+
+
+@contextlib.contextmanager
+def device_guard(device: Optional[str] = None):
+    global _current_device
+    old = _current_device
+    _current_device = device
+    try:
+        yield
+    finally:
+        _current_device = old
+
+
+def current_device() -> Optional[str]:
+    return _current_device
+
+
+_dygraph_tracer_holder = [None]
+
+
+def in_dygraph_mode() -> bool:
+    return _dygraph_tracer_holder[0] is not None

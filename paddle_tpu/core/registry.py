@@ -1,0 +1,213 @@
+"""Operator registry: op type → {JAX lowering, grad maker}.
+
+Capability mirror of the reference's OpRegistry / OpInfoMap
+(paddle/fluid/framework/op_registry.h:75, op_info.h) re-designed for XLA:
+
+* A kernel is a pure JAX-traceable function
+  ``forward(inputs: {slot: [Array, ...]}, attrs) -> {slot: [Array, ...]}``
+  — no per-Place kernel maps (framework/operator.cc:1141 ChooseKernel);
+  XLA owns device placement and fusion.
+* Gradients keep the reference's program-level semantics (grad ops are IR
+  nodes built by a GradOpMaker, framework/grad_op_desc_maker.h) but the
+  DEFAULT grad maker emits a single generic ``__vjp_grad__`` op whose
+  lowering calls ``jax.vjp`` on the forward lowering. Hand-written grad ops
+  are only needed where vjp recomputation hurts or semantics differ.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .ir import OpDesc
+
+# Sentinel variable name meaning "no tensor" in an op's input list.
+EMPTY_VAR = "@EMPTY@"
+
+LoweringFn = Callable[[Dict[str, List[Any]], Dict[str, Any]], Dict[str, Any]]
+# grad_maker(fwd_op, out_grads, in_grads) -> list of grad OpDescs.
+#   out_grads: fwd output slot -> [grad var name or None, ...]
+#   in_grads:  fwd input slot  -> [grad var name to produce or None, ...]
+GradMakerFn = Callable[[OpDesc, Dict[str, List[Optional[str]]],
+                        Dict[str, List[Optional[str]]]], List[OpDesc]]
+
+
+@dataclass
+class OpDef:
+    type: str
+    forward: Optional[LoweringFn] = None
+    grad_maker: Optional[GradMakerFn] = None
+    skip_infer_shape: bool = False
+    # slots whose inputs are never differentiable (indices, masks, seeds)
+    non_diff_inputs: tuple = ()
+    # True for ops with side-band semantics the compiler must know about
+    is_collective: bool = False
+    doc: str = ""
+
+
+_REGISTRY: Dict[str, OpDef] = {}
+
+
+def register_op(type: str, *, grad_maker: Optional[GradMakerFn] = None,
+                skip_infer_shape: bool = False, non_diff_inputs: tuple = (),
+                is_collective: bool = False, doc: str = ""):
+    """Decorator registering a forward lowering for `type`."""
+
+    def deco(fn: LoweringFn) -> LoweringFn:
+        od = _REGISTRY.get(type)
+        if od is None:
+            od = OpDef(type=type)
+            _REGISTRY[type] = od
+        od.forward = fn
+        od.skip_infer_shape = skip_infer_shape
+        od.non_diff_inputs = tuple(non_diff_inputs)
+        od.is_collective = is_collective
+        od.doc = doc or fn.__doc__ or ""
+        if grad_maker is not None:
+            od.grad_maker = grad_maker
+        return fn
+
+    return deco
+
+
+def register_grad_maker(type: str):
+    """Decorator attaching a custom GradOpMaker to an already/soon registered op."""
+
+    def deco(fn: GradMakerFn) -> GradMakerFn:
+        od = _REGISTRY.get(type)
+        if od is None:
+            od = OpDef(type=type)
+            _REGISTRY[type] = od
+        od.grad_maker = fn
+        return fn
+
+    return deco
+
+
+def lookup(type: str) -> Optional[OpDef]:
+    return _REGISTRY.get(type)
+
+
+def get(type: str) -> OpDef:
+    od = _REGISTRY.get(type)
+    if od is None:
+        raise KeyError(
+            f"Operator '{type}' is not registered. Known ops: "
+            f"{sorted(_REGISTRY)[:20]}... ({len(_REGISTRY)} total)")
+    return od
+
+
+def registered_ops() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+def normalize_outputs(outs: Dict[str, Any]) -> Dict[str, List[Any]]:
+    """Lowerings may return bare arrays per slot; normalise to lists."""
+    norm = {}
+    for k, v in outs.items():
+        if isinstance(v, (list, tuple)):
+            norm[k] = list(v)
+        else:
+            norm[k] = [v]
+    return norm
+
+
+# ---------------------------------------------------------------------------
+# Generic vjp-based gradient
+# ---------------------------------------------------------------------------
+
+_IN_PREFIX = "In__"
+_OG_PREFIX = "OG__"
+_IG_PREFIX = "IG__"
+
+
+def default_grad_maker(op: OpDesc, out_grads: Dict[str, List[Optional[str]]],
+                       in_grads: Dict[str, List[Optional[str]]]) -> List[OpDesc]:
+    """Build one ``__vjp_grad__`` op whose lowering is jax.vjp of the forward.
+
+    Mirrors the role of DefaultGradOpMaker (framework/grad_op_desc_maker.h)
+    without per-op hand-written grad kernels.
+    """
+    inputs: Dict[str, List[str]] = {}
+    for slot, names in op.inputs.items():
+        inputs[_IN_PREFIX + slot] = list(names)
+    grad_out_slots = []
+    for slot, names in op.outputs.items():
+        gnames = out_grads.get(slot)
+        if gnames is None:
+            gnames = [None] * len(names)
+        inputs[_OG_PREFIX + slot] = [g if g is not None else EMPTY_VAR for g in gnames]
+        grad_out_slots.append(slot)
+    outputs: Dict[str, List[str]] = {}
+    want_slots = []
+    for slot, gnames in in_grads.items():
+        if gnames is None or all(g is None for g in gnames):
+            continue
+        outputs[_IG_PREFIX + slot] = [g if g is not None else EMPTY_VAR for g in gnames]
+        want_slots.append(slot)
+    if not outputs:
+        return []
+    grad_op = OpDesc("__vjp_grad__", inputs, outputs, {
+        "fwd_type": op.type,
+        "fwd_attrs": dict(op.attrs),
+        "fwd_out_slots": list(op.outputs.keys()),
+        "fwd_out_arity": {s: len(n) for s, n in op.outputs.items()},
+    })
+    return [grad_op]
+
+
+def _is_inexact(x) -> bool:
+    import jax.numpy as jnp
+
+    return jnp.issubdtype(jnp.result_type(x), jnp.inexact)
+
+
+@register_op("__vjp_grad__", skip_infer_shape=True)
+def _vjp_grad_lowering(ins: Dict[str, List[Any]], attrs: Dict[str, Any]):
+    import jax
+    import jax.numpy as jnp
+
+    fwd_def = get(attrs["fwd_type"])
+    fwd_attrs = attrs["fwd_attrs"]
+    fwd_ins = {s[len(_IN_PREFIX):]: v for s, v in ins.items()
+               if s.startswith(_IN_PREFIX)}
+
+    def f(d):
+        return normalize_outputs(fwd_def.forward(d, fwd_attrs))
+
+    out_structs = jax.eval_shape(f, fwd_ins)
+    # Assemble cotangents: provided grads where present, zeros elsewhere.
+    cts: Dict[str, List[Any]] = {}
+    for slot, structs in out_structs.items():
+        ogs = ins.get(_OG_PREFIX + slot, [None] * len(structs))
+        lst = []
+        for i, s in enumerate(structs):
+            og = ogs[i] if i < len(ogs) else None
+            if og is not None:
+                lst.append(jnp.asarray(og, dtype=s.dtype).reshape(s.shape))
+            elif jnp.issubdtype(s.dtype, jnp.inexact):
+                lst.append(jnp.zeros(s.shape, s.dtype))
+            else:
+                lst.append(np.zeros(s.shape, jax.dtypes.float0))
+        cts[slot] = lst
+
+    _, vjp_fn = jax.vjp(f, fwd_ins)
+    (in_cts,) = vjp_fn(cts)
+
+    outs: Dict[str, List[Any]] = {}
+    for slot in fwd_ins:
+        key = _IG_PREFIX + slot
+        grads = in_cts.get(slot)
+        if grads is None:
+            continue
+        fixed = []
+        for g, x in zip(grads, fwd_ins[slot]):
+            if g is None or (hasattr(g, "dtype") and g.dtype == jax.dtypes.float0):
+                fixed.append(jnp.zeros(jnp.shape(x), jnp.result_type(x))
+                             if _is_inexact(x) else jnp.zeros(jnp.shape(x), jnp.float32))
+            else:
+                fixed.append(g)
+        outs[key] = fixed
+    return outs

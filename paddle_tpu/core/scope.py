@@ -1,0 +1,69 @@
+"""Scope: hierarchical name → value store.
+
+Capability mirror of the reference Scope/Variable
+(paddle/fluid/framework/scope.h:52, variable.h:26). Values here are
+jax.Arrays (device-resident), numpy arrays, or opaque Python objects
+(readers, comm handles — the reference's RAW var kind).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, Optional
+
+
+class Scope:
+    def __init__(self, parent: Optional["Scope"] = None):
+        self._vars: Dict[str, Any] = {}
+        self.parent = parent
+        self.kids: list[Scope] = []
+
+    def new_scope(self) -> "Scope":
+        kid = Scope(self)
+        self.kids.append(kid)
+        return kid
+
+    def drop_kids(self):
+        self.kids.clear()
+
+    def set(self, name: str, value: Any):
+        self._vars[name] = value
+
+    def find_var(self, name: str) -> Any:
+        """Recursive lookup (reference: Scope::FindVar). Returns None if absent."""
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s.parent
+        return None
+
+    def has_var(self, name: str) -> bool:
+        return self.find_var(name) is not None
+
+    def erase(self, name: str):
+        self._vars.pop(name, None)
+
+    def local_var_names(self) -> list[str]:
+        return list(self._vars)
+
+    def items(self) -> Iterator[tuple[str, Any]]:
+        return iter(self._vars.items())
+
+    def __contains__(self, name: str) -> bool:
+        return self.has_var(name)
+
+    def __len__(self):
+        return len(self._vars)
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+def reset_global_scope():
+    global _global_scope
+    _global_scope = Scope()
+    return _global_scope

@@ -1,0 +1,45 @@
+"""Unique name generator (reference: python/paddle/fluid/unique_name.py)."""
+
+from __future__ import annotations
+
+import contextlib
+from collections import defaultdict
+
+
+class UniqueNameGenerator:
+    def __init__(self, prefix: str = ""):
+        self.prefix = prefix
+        self.ids: dict[str, int] = defaultdict(int)
+
+    def __call__(self, key: str) -> str:
+        tmp = self.ids[key]
+        self.ids[key] += 1
+        return f"{self.prefix}{key}_{tmp}"
+
+
+_generator = UniqueNameGenerator()
+
+
+def generate(key: str) -> str:
+    return _generator(key)
+
+
+@contextlib.contextmanager
+def guard(new_generator: str | UniqueNameGenerator | None = None):
+    """Swap in a fresh generator (used by tests for reproducible names)."""
+    global _generator
+    old = _generator
+    if isinstance(new_generator, str):
+        new_generator = UniqueNameGenerator(new_generator)
+    _generator = new_generator or UniqueNameGenerator()
+    try:
+        yield
+    finally:
+        _generator = old
+
+
+def switch(new_generator: UniqueNameGenerator | None = None):
+    global _generator
+    old = _generator
+    _generator = new_generator or UniqueNameGenerator()
+    return old

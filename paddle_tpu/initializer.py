@@ -1,0 +1,144 @@
+"""Parameter initializers — emit init ops into the startup program.
+
+Capability mirror of python/paddle/fluid/initializer.py (ConstantInitializer,
+UniformInitializer, NormalInitializer, TruncatedNormalInitializer,
+XavierInitializer, MSRAInitializer, BilinearInitializer, NumpyArrayInitializer).
+Each __call__ appends a creation op (fill_constant / uniform_random /
+gaussian_random) to the var's (startup) block — matching the reference's
+"initialisation is ops in the startup program" design.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+
+class Initializer:
+    def __call__(self, var, block):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def __call__(self, var, block):
+        block.append_op("fill_constant", {}, {"Out": [var.name]},
+                        {"shape": list(var.shape), "value": float(self.value),
+                         "dtype": str(np.dtype(var.dtype))})
+
+
+class Uniform(Initializer):
+    def __init__(self, low: float = -1.0, high: float = 1.0, seed: int = 0):
+        self.low, self.high, self.seed = low, high, seed
+
+    def __call__(self, var, block):
+        block.append_op("uniform_random", {}, {"Out": [var.name]},
+                        {"shape": list(var.shape), "min": self.low, "max": self.high,
+                         "seed": self.seed or block.program.next_op_seed(),
+                         "dtype": str(np.dtype(var.dtype))})
+
+
+class Normal(Initializer):
+    def __init__(self, loc: float = 0.0, scale: float = 1.0, seed: int = 0):
+        self.loc, self.scale, self.seed = loc, scale, seed
+
+    def __call__(self, var, block):
+        block.append_op("gaussian_random", {}, {"Out": [var.name]},
+                        {"shape": list(var.shape), "mean": self.loc,
+                         "std": self.scale,
+                         "seed": self.seed or block.program.next_op_seed(),
+                         "dtype": str(np.dtype(var.dtype))})
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, loc: float = 0.0, scale: float = 1.0, seed: int = 0):
+        self.loc, self.scale, self.seed = loc, scale, seed
+
+    def __call__(self, var, block):
+        block.append_op("truncated_gaussian_random", {}, {"Out": [var.name]},
+                        {"shape": list(var.shape), "mean": self.loc,
+                         "std": self.scale,
+                         "seed": self.seed or block.program.next_op_seed(),
+                         "dtype": str(np.dtype(var.dtype))})
+
+
+def _fan_in_out(shape):
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    receptive = int(np.prod(shape[2:])) if len(shape) > 2 else 1
+    fan_in = shape[0] * receptive if len(shape) > 2 else shape[0]
+    fan_out = shape[1] * receptive if len(shape) > 2 else shape[1]
+    # conv filters are OIHW: fan_in = I*k, fan_out = O*k
+    if len(shape) > 2:
+        fan_in = shape[1] * receptive
+        fan_out = shape[0] * receptive
+    return fan_in, fan_out
+
+
+class Xavier(Initializer):
+    """reference: initializer.py XavierInitializer (Glorot)."""
+
+    def __init__(self, uniform: bool = True, fan_in=None, fan_out=None, seed: int = 0):
+        self.uniform, self.fan_in, self.fan_out, self.seed = uniform, fan_in, fan_out, seed
+
+    def __call__(self, var, block):
+        fi, fo = _fan_in_out(var.shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        if self.uniform:
+            limit = math.sqrt(6.0 / (fi + fo))
+            Uniform(-limit, limit, self.seed)(var, block)
+        else:
+            std = math.sqrt(2.0 / (fi + fo))
+            Normal(0.0, std, self.seed)(var, block)
+
+
+class MSRA(Initializer):
+    """reference: initializer.py MSRAInitializer (Kaiming/He)."""
+
+    def __init__(self, uniform: bool = True, fan_in=None, seed: int = 0):
+        self.uniform, self.fan_in, self.seed = uniform, fan_in, seed
+
+    def __call__(self, var, block):
+        fi, _ = _fan_in_out(var.shape)
+        fi = self.fan_in or fi
+        if self.uniform:
+            limit = math.sqrt(6.0 / fi)
+            Uniform(-limit, limit, self.seed)(var, block)
+        else:
+            std = math.sqrt(2.0 / fi)
+            Normal(0.0, std, self.seed)(var, block)
+
+
+class NumpyArrayInitializer(Initializer):
+    def __init__(self, value: np.ndarray):
+        self.value = np.asarray(value)
+
+    def __call__(self, var, block):
+        block.append_op("assign_value", {}, {"Out": [var.name]},
+                        {"shape": list(self.value.shape),
+                         "values": self.value.flatten().tolist(),
+                         "dtype": str(self.value.dtype)})
+
+
+# fluid-compat aliases
+ConstantInitializer = Constant
+UniformInitializer = Uniform
+NormalInitializer = Normal
+TruncatedNormalInitializer = TruncatedNormal
+XavierInitializer = Xavier
+MSRAInitializer = MSRA
+KaimingUniform = MSRA
+
+
+def _default_weight_initializer():
+    return Xavier()
+
+
+def _default_bias_initializer():
+    return Constant(0.0)

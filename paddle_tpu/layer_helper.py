@@ -1,0 +1,76 @@
+"""LayerHelper: shared machinery for op-emitting layer functions.
+
+Capability mirror of python/paddle/fluid/layer_helper.py — creates parameters
+(main-program Parameter + startup-program init op), temp output vars, and
+appends ops with activation fusion.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .core import unique_name
+from .core.ir import (Parameter, Variable, default_main_program,
+                      default_startup_program)
+from .initializer import Constant, Xavier, _default_bias_initializer
+from .param_attr import ParamAttr
+
+
+class LayerHelper:
+    def __init__(self, layer_type: str, **kwargs):
+        self.layer_type = layer_type
+        self.kwargs = kwargs
+        self.name = kwargs.get("name") or unique_name.generate(layer_type)
+
+    @property
+    def main_program(self):
+        return default_main_program()
+
+    @property
+    def startup_program(self):
+        return default_startup_program()
+
+    def create_parameter(self, attr, shape, dtype="float32", is_bias: bool = False,
+                         default_initializer=None) -> Optional[Parameter]:
+        attr = ParamAttr._to_attr(attr)
+        if attr is None:
+            return None
+        name = attr.name or unique_name.generate(f"{self.name}.b" if is_bias
+                                                 else f"{self.name}.w")
+        init = attr.initializer or default_initializer or (
+            _default_bias_initializer() if is_bias else Xavier())
+        block = self.main_program.global_block()
+        if name in block.vars:
+            return block.vars[name]
+        param = block.create_parameter(
+            name=name, shape=shape, dtype=dtype, trainable=attr.trainable,
+            regularizer=attr.regularizer,
+            optimize_attr={"learning_rate": attr.learning_rate})
+        # mirror into startup program + its init op
+        sblock = self.startup_program.global_block()
+        svar = sblock.create_parameter(name=name, shape=shape, dtype=dtype,
+                                       trainable=attr.trainable)
+        init(svar, sblock)
+        return param
+
+    def create_variable_for_type_inference(self, dtype="float32",
+                                           stop_gradient: bool = False) -> Variable:
+        return self.main_program.current_block().create_var(
+            name=unique_name.generate(f"{self.name}.tmp"), dtype=dtype,
+            stop_gradient=stop_gradient)
+
+    def append_op(self, type, inputs=None, outputs=None, attrs=None):
+        return self.main_program.current_block().append_op(
+            type, inputs, outputs, attrs)
+
+    def append_activation(self, out: Variable, act: Optional[str]) -> Variable:
+        if act is None:
+            return out
+        act_out = self.create_variable_for_type_inference(out.dtype)
+        self.append_op(act, {"X": [out]}, {"Out": [act_out]}, {})
+        return act_out
+
+    def input_dtype(self, var: Variable):
+        return var.dtype

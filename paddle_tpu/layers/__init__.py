@@ -1,0 +1,5 @@
+"""fluid-style layers API (reference: python/paddle/fluid/layers/)."""
+
+from .nn import *  # noqa: F401,F403
+from .nn import (_elementwise_binary, _compare, _getitem, _to_var,  # noqa: F401
+                 _unary, _binary, _reduce_layer)

@@ -1,0 +1,29 @@
+"""Op lowerings — importing this package registers all ops.
+
+Capability mirror of paddle/fluid/operators/ (480 registered ops): the subset
+needed by the BASELINE workload ladder plus the common API surface, each as a
+JAX lowering in the registry (see core/registry.py).
+"""
+
+from . import math_ops, nn_ops, optimizer_ops, tensor_ops  # noqa: F401
+
+try:  # modules added as the build widens
+    from . import amp_ops  # noqa: F401
+except ImportError:
+    pass
+try:
+    from . import collective_ops  # noqa: F401
+except ImportError:
+    pass
+try:
+    from . import control_flow_ops  # noqa: F401
+except ImportError:
+    pass
+try:
+    from . import sequence_ops  # noqa: F401
+except ImportError:
+    pass
+try:
+    from . import attention_ops  # noqa: F401
+except ImportError:
+    pass

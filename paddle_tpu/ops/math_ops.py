@@ -1,0 +1,504 @@
+"""Math op lowerings: elementwise, activations, reductions, matmul, losses.
+
+Capability mirror of paddle/fluid/operators/ dense math:
+elementwise/elementwise_op_function.h (broadcast semantics incl. the `axis`
+attr), activation_op.cc, reduce_ops/, matmul_op.cc, mul_op.cc, softmax_op.cc,
+cross_entropy_op.cc, softmax_with_cross_entropy_op.cc, metrics/accuracy_op.cc,
+top_k_op.cc, clip_op.cc. All lower to jax.numpy/lax; XLA fuses elementwise
+chains into surrounding matmuls (the role of fuse_elewise_add_act_pass etc.).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.registry import register_op
+
+
+def _bcast_y(x, y, axis: int):
+    """Paddle elementwise broadcast: align y's dims starting at `axis` of x
+    (reference: elementwise_op_function.h). axis=-1 → numpy trailing align."""
+    if x.ndim == y.ndim or y.ndim == 0:
+        return y
+    if axis == -1 or axis is None:
+        axis = x.ndim - y.ndim
+    new_shape = [1] * axis + list(y.shape) + [1] * (x.ndim - axis - y.ndim)
+    return y.reshape(new_shape)
+
+
+def _ew(op):
+    def lowering(ins, attrs):
+        x, y = ins["X"][0], ins["Y"][0]
+        y = _bcast_y(x, y, int(attrs.get("axis", -1)))
+        return {"Out": op(x, y)}
+
+    return lowering
+
+
+def _register_elementwise():
+    import jax.numpy as jnp
+    import operator
+
+    ops = {
+        "elementwise_add": operator.add,
+        "elementwise_sub": operator.sub,
+        "elementwise_mul": operator.mul,
+        "elementwise_div": operator.truediv,
+        "elementwise_min": jnp.minimum,
+        "elementwise_max": jnp.maximum,
+        "elementwise_pow": jnp.power,
+        "elementwise_mod": jnp.mod,
+        "elementwise_floordiv": jnp.floor_divide,
+    }
+    for name, fn in ops.items():
+        register_op(name)(_ew(fn))
+
+
+_register_elementwise()
+
+
+def _register_compares():
+    import jax.numpy as jnp
+
+    cmps = {
+        "equal": jnp.equal, "not_equal": jnp.not_equal,
+        "less_than": jnp.less, "less_equal": jnp.less_equal,
+        "greater_than": jnp.greater, "greater_equal": jnp.greater_equal,
+        "logical_and": jnp.logical_and, "logical_or": jnp.logical_or,
+        "logical_xor": jnp.logical_xor,
+    }
+    for name, fn in cmps.items():
+        def lowering(ins, attrs, _fn=fn):
+            x, y = ins["X"][0], ins["Y"][0]
+            return {"Out": _fn(x, y)}
+
+        register_op(name, non_diff_inputs=("X", "Y"))(lowering)
+
+    @register_op("logical_not", non_diff_inputs=("X",))
+    def logical_not(ins, attrs):
+        return {"Out": jnp.logical_not(ins["X"][0])}
+
+
+_register_compares()
+
+
+def _register_activations():
+    import jax
+    import jax.numpy as jnp
+
+    acts = {
+        "relu": jax.nn.relu,
+        "relu6": lambda x: jnp.clip(x, 0, 6),
+        "sigmoid": jax.nn.sigmoid,
+        "tanh": jnp.tanh,
+        "exp": jnp.exp,
+        "log": jnp.log,
+        "log2": jnp.log2,
+        "sqrt": jnp.sqrt,
+        "rsqrt": jax.lax.rsqrt,
+        "square": jnp.square,
+        "abs": jnp.abs,
+        "floor": jnp.floor,
+        "ceil": jnp.ceil,
+        "round": jnp.round,
+        "reciprocal": jnp.reciprocal,
+        "softplus": jax.nn.softplus,
+        "softsign": jax.nn.soft_sign,
+        "silu": jax.nn.silu,
+        "swish": jax.nn.silu,
+        "sin": jnp.sin,
+        "cos": jnp.cos,
+        "erf": jax.scipy.special.erf,
+        "sign": jnp.sign,
+        "logsigmoid": jax.nn.log_sigmoid,
+    }
+    for name, fn in acts.items():
+        def lowering(ins, attrs, _fn=fn):
+            return {"Out": _fn(ins["X"][0])}
+
+        register_op(name)(lowering)
+
+
+_register_activations()
+
+
+@register_op("gelu")
+def gelu(ins, attrs):
+    import jax
+
+    return {"Out": jax.nn.gelu(ins["X"][0],
+                               approximate=bool(attrs.get("approximate", False)))}
+
+
+@register_op("leaky_relu")
+def leaky_relu(ins, attrs):
+    import jax
+
+    return {"Out": jax.nn.leaky_relu(ins["X"][0],
+                                     negative_slope=attrs.get("alpha", 0.02))}
+
+
+@register_op("elu")
+def elu(ins, attrs):
+    import jax
+
+    return {"Out": jax.nn.elu(ins["X"][0], alpha=attrs.get("alpha", 1.0))}
+
+
+@register_op("hard_sigmoid")
+def hard_sigmoid(ins, attrs):
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    slope = attrs.get("slope", 0.2)
+    offset = attrs.get("offset", 0.5)
+    return {"Out": jnp.clip(x * slope + offset, 0.0, 1.0)}
+
+
+@register_op("hard_swish")
+def hard_swish(ins, attrs):
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    t = attrs.get("threshold", 6.0)
+    s = attrs.get("scale", 6.0)
+    o = attrs.get("offset", 3.0)
+    return {"Out": x * jnp.clip(x + o, 0.0, t) / s}
+
+
+@register_op("pow")
+def pow_op(ins, attrs):
+    return {"Out": ins["X"][0] ** attrs.get("factor", 1.0)}
+
+
+@register_op("clip")
+def clip(ins, attrs):
+    import jax.numpy as jnp
+
+    return {"Out": jnp.clip(ins["X"][0], attrs.get("min"), attrs.get("max"))}
+
+
+@register_op("maximum")
+def maximum(ins, attrs):
+    import jax.numpy as jnp
+
+    return {"Out": jnp.maximum(ins["X"][0], ins["Y"][0])}
+
+
+@register_op("minimum")
+def minimum(ins, attrs):
+    import jax.numpy as jnp
+
+    return {"Out": jnp.minimum(ins["X"][0], ins["Y"][0])}
+
+
+# -- reductions ---------------------------------------------------------------
+
+def _reduce(fn_name):
+    import jax.numpy as jnp
+
+    fn = getattr(jnp, fn_name)
+
+    def lowering(ins, attrs):
+        x = ins["X"][0]
+        if attrs.get("reduce_all", False):
+            dims = None
+        else:
+            dims = attrs.get("dim")
+            dims = tuple(dims) if dims is not None else None
+        keep = bool(attrs.get("keep_dim", False))
+        return {"Out": fn(x, axis=dims, keepdims=keep)}
+
+    return lowering
+
+
+for _name, _jnp_name in [("reduce_sum", "sum"), ("reduce_mean", "mean"),
+                         ("reduce_max", "max"), ("reduce_min", "min"),
+                         ("reduce_prod", "prod"), ("reduce_any", "any"),
+                         ("reduce_all", "all")]:
+    register_op(_name)(_reduce(_jnp_name))
+
+
+@register_op("mean")
+def mean(ins, attrs):
+    import jax.numpy as jnp
+
+    return {"Out": jnp.mean(ins["X"][0])}
+
+
+@register_op("squared_l2_norm")
+def squared_l2_norm(ins, attrs):
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    return {"Out": jnp.sum(jnp.square(x)).reshape((1,))}
+
+
+@register_op("p_norm")
+def p_norm(ins, attrs):
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    porder = attrs.get("porder", 2.0)
+    axis = attrs.get("axis", None)
+    keepdim = attrs.get("keepdim", False)
+    out = jnp.linalg.norm(x, ord=porder, axis=axis, keepdims=keepdim)
+    return {"Out": out}
+
+
+# -- matmul family ------------------------------------------------------------
+
+@register_op("matmul")
+def matmul(ins, attrs):
+    """reference: operators/matmul_op.cc — transpose_X/Y + alpha; batched
+    matmul broadcasts leading dims. Lowers straight onto the MXU."""
+    import jax.numpy as jnp
+
+    x, y = ins["X"][0], ins["Y"][0]
+    if attrs.get("transpose_X", False):
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if attrs.get("transpose_Y", False):
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    out = jnp.matmul(x, y)
+    alpha = attrs.get("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * np.asarray(alpha, out.dtype)
+    return {"Out": out}
+
+
+@register_op("matmul_v2")
+def matmul_v2(ins, attrs):
+    import jax.numpy as jnp
+
+    x, y = ins["X"][0], ins["Y"][0]
+    if attrs.get("trans_x", False):
+        x = jnp.swapaxes(x, -1, -2)
+    if attrs.get("trans_y", False):
+        y = jnp.swapaxes(y, -1, -2)
+    return {"Out": jnp.matmul(x, y)}
+
+
+@register_op("mul")
+def mul(ins, attrs):
+    """reference: operators/mul_op.cc — flattens x to 2-D at num_col_dims."""
+    import jax.numpy as jnp
+
+    x, y = ins["X"][0], ins["Y"][0]
+    xd = int(attrs.get("x_num_col_dims", 1))
+    yd = int(attrs.get("y_num_col_dims", 1))
+    x2 = x.reshape((int(np.prod(x.shape[:xd])), -1))
+    y2 = y.reshape((int(np.prod(y.shape[:yd])), -1))
+    out = x2 @ y2
+    out_shape = x.shape[:xd] + y.shape[yd:]
+    return {"Out": out.reshape(out_shape)}
+
+
+@register_op("bmm")
+def bmm(ins, attrs):
+    import jax.numpy as jnp
+
+    return {"Out": jnp.matmul(ins["X"][0], ins["Y"][0])}
+
+
+@register_op("dot")
+def dot(ins, attrs):
+    import jax.numpy as jnp
+
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": jnp.sum(x * y, axis=-1, keepdims=True)}
+
+
+# -- softmax / losses ---------------------------------------------------------
+
+@register_op("softmax")
+def softmax(ins, attrs):
+    import jax
+
+    return {"Out": jax.nn.softmax(ins["X"][0], axis=int(attrs.get("axis", -1)))}
+
+
+@register_op("log_softmax")
+def log_softmax(ins, attrs):
+    import jax
+
+    return {"Out": jax.nn.log_softmax(ins["X"][0], axis=int(attrs.get("axis", -1)))}
+
+
+@register_op("cross_entropy", non_diff_inputs=("Label",))
+def cross_entropy(ins, attrs):
+    """reference: operators/cross_entropy_op.cc — takes probabilities.
+    Hard labels (int) index; soft labels dot."""
+    import jax.numpy as jnp
+
+    x, label = ins["X"][0], ins["Label"][0]
+    eps = 1e-8
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * jnp.log(x + eps), axis=-1, keepdims=True)
+    else:
+        if label.ndim == x.ndim and label.shape[-1] == 1:
+            label = jnp.squeeze(label, axis=-1)
+        p = jnp.take_along_axis(x, label[..., None].astype(np.int32), axis=-1)
+        loss = -jnp.log(p + eps)
+    return {"Y": loss}
+
+
+@register_op("softmax_with_cross_entropy", non_diff_inputs=("Label",))
+def softmax_with_cross_entropy(ins, attrs):
+    """reference: operators/softmax_with_cross_entropy_op.cc — fused,
+    numerically stable. Outputs both Softmax and Loss."""
+    import jax
+    import jax.numpy as jnp
+
+    logits, label = ins["Logits"][0], ins["Label"][0]
+    axis = int(attrs.get("axis", -1))
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        lbl = label
+        if lbl.ndim == logits.ndim and lbl.shape[axis] == 1:
+            lbl = jnp.squeeze(lbl, axis=axis)
+        ax = axis % logits.ndim
+        lbl_exp = jnp.expand_dims(lbl, ax).astype(np.int32)
+        picked = jnp.take_along_axis(logp, lbl_exp, axis=ax)
+        loss = -picked
+        ignore = int(attrs.get("ignore_index", -100))
+        if ignore >= 0:
+            mask = jnp.expand_dims(lbl != ignore, ax)
+            loss = jnp.where(mask, loss, 0.0)
+    return {"Softmax": jnp.exp(logp), "Loss": loss}
+
+
+@register_op("sigmoid_cross_entropy_with_logits", non_diff_inputs=("Label",))
+def sigmoid_cross_entropy_with_logits(ins, attrs):
+    import jax
+
+    x, label = ins["X"][0], ins["Label"][0]
+    loss = jax.nn.softplus(x) - x * label
+    return {"Out": loss}
+
+
+@register_op("huber_loss", non_diff_inputs=("Y",))
+def huber_loss(ins, attrs):
+    import jax.numpy as jnp
+
+    x, y = ins["X"][0], ins["Y"][0]
+    d = attrs.get("delta", 1.0)
+    r = y - x
+    a = jnp.abs(r)
+    loss = jnp.where(a <= d, 0.5 * r * r, d * (a - 0.5 * d))
+    return {"Out": loss, "Residual": r}
+
+
+@register_op("square_error_cost", non_diff_inputs=("Label",))
+def square_error_cost(ins, attrs):
+    x, label = ins["Input"][0], ins["Label"][0]
+    d = x - label
+    return {"Out": d * d}
+
+
+@register_op("smooth_l1_loss", non_diff_inputs=("Y",))
+def smooth_l1_loss(ins, attrs):
+    import jax.numpy as jnp
+
+    x, y = ins["X"][0], ins["Y"][0]
+    sigma2 = attrs.get("sigma", 1.0) ** 2
+    d = x - y
+    a = jnp.abs(d)
+    diff = jnp.where(a < 1.0 / sigma2, 0.5 * d * d * sigma2, a - 0.5 / sigma2)
+    return {"Out": jnp.sum(diff, axis=-1, keepdims=True), "Diff": diff}
+
+
+@register_op("kldiv_loss", non_diff_inputs=("Target",))
+def kldiv_loss(ins, attrs):
+    import jax.numpy as jnp
+
+    x, t = ins["X"][0], ins["Target"][0]
+    loss = jnp.where(t > 0, t * (jnp.log(t) - x), 0.0)
+    red = attrs.get("reduction", "mean")
+    if red == "mean":
+        loss = jnp.mean(loss)
+    elif red == "sum":
+        loss = jnp.sum(loss)
+    elif red == "batchmean":
+        loss = jnp.sum(loss) / x.shape[0]
+    return {"Loss": loss}
+
+
+# -- metrics / topk -----------------------------------------------------------
+
+@register_op("accuracy", non_diff_inputs=("Out", "Indices", "Label"))
+def accuracy(ins, attrs):
+    """reference: operators/metrics/accuracy_op.cc."""
+    import jax.numpy as jnp
+
+    indices, label = ins["Indices"][0], ins["Label"][0]
+    if label.ndim == indices.ndim and label.shape[-1] == 1:
+        correct = jnp.any(indices == label, axis=-1)
+    else:
+        correct = jnp.any(indices == label[..., None], axis=-1)
+    total = correct.size
+    num_correct = jnp.sum(correct.astype(np.int32))
+    acc = num_correct.astype(np.float32) / float(total)
+    return {"Accuracy": acc.reshape((1,)),
+            "Correct": num_correct.reshape((1,)),
+            "Total": jnp.full((1,), total, np.int32)}
+
+
+@register_op("top_k", non_diff_inputs=("X",))
+def top_k(ins, attrs):
+    import jax
+
+    x = ins["X"][0]
+    k = int(attrs.get("k", 1))
+    vals, idx = jax.lax.top_k(x, k)
+    return {"Out": vals, "Indices": idx.astype(np.int64)}
+
+
+@register_op("top_k_v2", non_diff_inputs=("X",))
+def top_k_v2(ins, attrs):
+    return top_k(ins, attrs)
+
+
+@register_op("arg_max", non_diff_inputs=("X",))
+def arg_max(ins, attrs):
+    import jax.numpy as jnp
+
+    axis = int(attrs.get("axis", -1))
+    out = jnp.argmax(ins["X"][0], axis=axis)
+    if attrs.get("keepdims", False):
+        out = jnp.expand_dims(out, axis)
+    return {"Out": out.astype(np.int64)}
+
+
+@register_op("arg_min", non_diff_inputs=("X",))
+def arg_min(ins, attrs):
+    import jax.numpy as jnp
+
+    axis = int(attrs.get("axis", -1))
+    return {"Out": jnp.argmin(ins["X"][0], axis=axis).astype(np.int64)}
+
+
+@register_op("argsort", non_diff_inputs=("X",))
+def argsort(ins, attrs):
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    axis = int(attrs.get("axis", -1))
+    idx = jnp.argsort(x, axis=axis, descending=bool(attrs.get("descending", False)))
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return {"Out": out, "Indices": idx.astype(np.int64)}
+
+
+@register_op("isfinite", non_diff_inputs=("X",))
+def isfinite(ins, attrs):
+    import jax.numpy as jnp
+
+    return {"Out": jnp.all(jnp.isfinite(ins["X"][0])).reshape((1,))}
+
+
+@register_op("isfinite_v2", non_diff_inputs=("X",))
+def isfinite_v2(ins, attrs):
+    import jax.numpy as jnp
+
+    return {"Out": jnp.isfinite(ins["X"][0])}

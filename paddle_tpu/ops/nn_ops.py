@@ -1,0 +1,291 @@
+"""NN op lowerings: conv, pooling, normalisation, dropout.
+
+Capability mirror of paddle/fluid/operators/ conv_op.cc (+conv_cudnn),
+pool_op.cc, batch_norm_op.cc, layer_norm_op.{cc,cu}, dropout_op.cc,
+conv_transpose_op.cc, group_norm_op.cc. Convs lower to
+lax.conv_general_dilated (NCHW, fluid's default layout — XLA relayouts for
+the MXU internally); norms are jnp compositions XLA fuses into one kernel.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.registry import register_op
+from ..core.types import convert_dtype
+
+
+def _conv_padding(attrs, spatial_rank=2):
+    p = attrs.get("paddings", [0] * spatial_rank)
+    algo = attrs.get("padding_algorithm", "EXPLICIT")
+    if algo == "SAME":
+        return "SAME"
+    if algo == "VALID":
+        return "VALID"
+    if len(p) == spatial_rank:
+        return [(int(pi), int(pi)) for pi in p]
+    if len(p) == 2 * spatial_rank:
+        return [(int(p[2 * i]), int(p[2 * i + 1])) for i in range(spatial_rank)]
+    return [(0, 0)] * spatial_rank
+
+
+@register_op("conv2d")
+def conv2d(ins, attrs):
+    """reference: operators/conv_op.cc (NCHW). Filter is OIHW."""
+    import jax.lax as lax
+
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = tuple(attrs.get("strides", [1, 1]))
+    dilations = tuple(attrs.get("dilations", [1, 1]))
+    groups = int(attrs.get("groups", 1))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=_conv_padding(attrs),
+        rhs_dilation=dilations, feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=None)
+    return {"Output": out}
+
+
+@register_op("depthwise_conv2d")
+def depthwise_conv2d(ins, attrs):
+    x = ins["Input"][0]
+    attrs = dict(attrs)
+    attrs["groups"] = x.shape[1]
+    return {"Output": conv2d({"Input": ins["Input"], "Filter": ins["Filter"]},
+                             attrs)["Output"]}
+
+
+@register_op("conv2d_transpose")
+def conv2d_transpose(ins, attrs):
+    """reference: operators/conv_transpose_op.cc. Filter is IOHW (paddle keeps
+    [in_c, out_c/groups, kh, kw])."""
+    import jax.lax as lax
+
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = tuple(attrs.get("strides", [1, 1]))
+    dilations = tuple(attrs.get("dilations", [1, 1]))
+    groups = int(attrs.get("groups", 1))
+    pad = _conv_padding(attrs)
+    if isinstance(pad, str):
+        padding = pad
+    else:
+        # conv_transpose output padding math: lax.conv_transpose with
+        # transpose_kernel handles the fluid semantics for symmetric pads
+        padding = [(p0, p1) for (p0, p1) in pad]
+        kh, kw = w.shape[2], w.shape[3]
+        padding = [(kh - 1 - padding[0][0], kh - 1 - padding[0][1]),
+                   (kw - 1 - padding[1][0], kw - 1 - padding[1][1])]
+    w_t = w.transpose(1, 0, 2, 3)[:, :, ::-1, ::-1]
+    out = lax.conv_general_dilated(
+        x, w_t, window_strides=(1, 1), padding=padding,
+        lhs_dilation=strides, rhs_dilation=dilations,
+        feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return {"Output": out}
+
+
+@register_op("pool2d")
+def pool2d(ins, attrs):
+    """reference: operators/pool_op.cc — max/avg, NCHW."""
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    ptype = attrs.get("pooling_type", "max")
+    if attrs.get("global_pooling", False) or attrs.get("adaptive", False) and \
+            tuple(attrs.get("ksize", ())) == (1, 1):
+        axis = (2, 3)
+        out = (jnp.max(x, axis=axis, keepdims=True) if ptype == "max"
+               else jnp.mean(x, axis=axis, keepdims=True))
+        return {"Out": out}
+    ksize = tuple(attrs.get("ksize", [2, 2]))
+    strides = tuple(attrs.get("strides", ksize))
+    pad = _conv_padding(attrs)
+    if isinstance(pad, str):
+        padding = pad
+    else:
+        padding = [(0, 0), (0, 0)] + list(pad)
+    window = (1, 1) + ksize
+    strides4 = (1, 1) + strides
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        out = lax.reduce_window(x, np.asarray(init, x.dtype), lax.max, window,
+                                strides4, padding)
+    else:
+        summed = lax.reduce_window(x, np.asarray(0.0, x.dtype), lax.add, window,
+                                   strides4, padding)
+        if attrs.get("exclusive", True) and padding != "VALID" and not isinstance(padding, str):
+            ones = jnp.ones_like(x)
+            counts = lax.reduce_window(ones, np.asarray(0.0, x.dtype), lax.add,
+                                       window, strides4, padding)
+            out = summed / counts
+        else:
+            out = summed / float(np.prod(ksize))
+    return {"Out": out}
+
+
+@register_op("batch_norm")
+def batch_norm(ins, attrs):
+    """reference: operators/batch_norm_op.cc. Outputs Y plus updated running
+    stats (MeanOut/VarianceOut alias the input stat vars — in-place through
+    scope threading) and SavedMean/SavedVariance for the backward."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    scale, bias = ins["Scale"][0], ins["Bias"][0]
+    mean, var = ins["Mean"][0], ins["Variance"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    is_test = bool(attrs.get("is_test", False)) or bool(attrs.get("use_global_stats", False))
+    layout = attrs.get("data_layout", "NCHW")
+    c_axis = 1 if layout == "NCHW" else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    bshape = [1] * x.ndim
+    bshape[c_axis] = x.shape[c_axis]
+
+    if is_test:
+        use_mean, use_var = mean, var
+        mean_out, var_out = mean, var
+        saved_mean = jnp.zeros_like(mean)
+        saved_var = jnp.zeros_like(var)
+    else:
+        xf = x.astype(jnp.float32)
+        use_mean = jnp.mean(xf, axis=axes)
+        use_var = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(use_mean)
+        mean_out = mean * momentum + use_mean * (1.0 - momentum)
+        var_out = var * momentum + use_var * (1.0 - momentum)
+        saved_mean = use_mean
+        saved_var = 1.0 / jnp.sqrt(use_var + eps)
+    inv = 1.0 / jnp.sqrt(use_var.astype(jnp.float32) + eps)
+    y = (x - use_mean.reshape(bshape).astype(x.dtype)) * \
+        (inv * scale.astype(jnp.float32)).reshape(bshape).astype(x.dtype) + \
+        bias.reshape(bshape).astype(x.dtype)
+    return {"Y": y, "MeanOut": mean_out, "VarianceOut": var_out,
+            "SavedMean": saved_mean, "SavedVariance": saved_var}
+
+
+@register_op("layer_norm")
+def layer_norm(ins, attrs):
+    """reference: operators/layer_norm_op.cc — normalise trailing dims from
+    begin_norm_axis; compute in fp32 for bf16 inputs (TPU practice)."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    scale = ins["Scale"][0] if ins.get("Scale") and ins["Scale"][0] is not None else None
+    bias = ins["Bias"][0] if ins.get("Bias") and ins["Bias"][0] is not None else None
+    eps = attrs.get("epsilon", 1e-5)
+    axis = int(attrs.get("begin_norm_axis", 1))
+    axes = tuple(range(axis, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    inv = 1.0 / jnp.sqrt(var + eps)
+    y = (xf - mean) * inv
+    norm_shape = x.shape[axis:]
+    if scale is not None:
+        y = y * scale.reshape(norm_shape).astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.reshape(norm_shape).astype(jnp.float32)
+    red = int(np.prod([x.shape[a] for a in axes]))
+    lead = x.shape[:axis]
+    return {"Y": y.astype(x.dtype),
+            "Mean": mean.reshape(lead),
+            "Variance": var.reshape(lead)}
+
+
+@register_op("group_norm")
+def group_norm(ins, attrs):
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    scale = ins["Scale"][0] if ins.get("Scale") else None
+    bias = ins["Bias"][0] if ins.get("Bias") else None
+    g = int(attrs.get("groups", 1))
+    eps = attrs.get("epsilon", 1e-5)
+    n, c = x.shape[0], x.shape[1]
+    xg = x.reshape((n, g, c // g) + x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    y = ((xg - mean) / jnp.sqrt(var + eps)).reshape(x.shape)
+    bshape = (1, c) + (1,) * (x.ndim - 2)
+    if scale is not None:
+        y = y * scale.reshape(bshape)
+    if bias is not None:
+        y = y + bias.reshape(bshape)
+    return {"Y": y, "Mean": mean.reshape((n, g)), "Variance": var.reshape((n, g))}
+
+
+@register_op("dropout", skip_infer_shape=True)
+def dropout(ins, attrs):
+    """reference: operators/dropout_op.cc. Seed assigned at build; runtime
+    folds the global step so masks differ per run but stay reproducible."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    p = float(attrs.get("dropout_prob", 0.5))
+    is_test = bool(attrs.get("is_test", False))
+    impl = attrs.get("dropout_implementation", "upscale_in_train")
+    if is_test or p == 0.0:
+        out = x if impl == "upscale_in_train" else x * (1.0 - p)
+        return {"Out": out, "Mask": jnp.ones(x.shape, np.uint8)}
+    from .tensor_ops import _rng_key
+
+    keep = jax.random.bernoulli(_rng_key(attrs), 1.0 - p, x.shape)
+    if impl == "upscale_in_train":
+        out = jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+    else:
+        out = jnp.where(keep, x, 0.0).astype(x.dtype)
+    return {"Out": out, "Mask": keep.astype(np.uint8)}
+
+
+@register_op("interpolate")
+@register_op("nearest_interp")
+@register_op("bilinear_interp")
+def interpolate(ins, attrs):
+    import jax
+
+    x = ins["X"][0]
+    out_h = int(attrs.get("out_h", 0))
+    out_w = int(attrs.get("out_w", 0))
+    scale = attrs.get("scale", 0)
+    if (not out_h or not out_w) and scale:
+        out_h = int(x.shape[2] * scale)
+        out_w = int(x.shape[3] * scale)
+    method = "nearest" if "nearest" in attrs.get("interp_method", "nearest") else "linear"
+    out = jax.image.resize(x, (x.shape[0], x.shape[1], out_h, out_w), method)
+    return {"Out": out.astype(x.dtype)}
+
+
+@register_op("pad2d")
+def pad2d(ins, attrs):
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    p = attrs["paddings"]  # [top, bottom, left, right]
+    mode = attrs.get("mode", "constant")
+    pairs = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    if mode == "constant":
+        return {"Out": jnp.pad(x, pairs, constant_values=attrs.get("pad_value", 0.0))}
+    jmode = {"reflect": "reflect", "edge": "edge"}[mode]
+    return {"Out": jnp.pad(x, pairs, mode=jmode)}
+
+
+@register_op("prelu")
+def prelu(ins, attrs):
+    import jax.numpy as jnp
+
+    x, alpha = ins["X"][0], ins["Alpha"][0]
+    mode = attrs.get("mode", "all")
+    if mode == "channel":
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return {"Out": jnp.where(x > 0, x, x * alpha)}
+
+
+@register_op("label_smooth", non_diff_inputs=("PriorDist",))
+def label_smooth(ins, attrs):
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 0.1)
+    k = x.shape[-1]
+    return {"Out": x * (1.0 - eps) + eps / k}

@@ -1,0 +1,235 @@
+"""Optimizer op lowerings — state updates ARE ops in the program.
+
+Capability mirror of paddle/fluid/operators/optimizers/ (sgd_op.cc,
+momentum_op.cc, adam_op.{cc,cu,h}, adamax, adagrad, rmsprop, lamb_op,
+lars_momentum_op.cc, ftrl, adadelta, dgc_momentum). Each op consumes
+Param/Grad/state and emits ParamOut/state-out; the output var NAMES equal the
+input var names, so the functional executor threads the update "in place"
+(the reference mutates scope vars directly).
+
+XLA fuses an entire optimizer sweep (all params' update ops) into the same
+compiled program as the backward — the role of fuse_optimizer_ops_pass
+(ir/fuse_optimizer_ops_pass/) comes for free.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.registry import register_op
+
+_OPT = dict(non_diff_inputs=("Param", "Grad", "LearningRate", "Moment", "Moment1",
+                             "Moment2", "Beta1Pow", "Beta2Pow", "Velocity",
+                             "MeanSquare", "MeanGrad"))
+
+
+@register_op("sgd", **_OPT)
+def sgd(ins, attrs):
+    p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    return {"ParamOut": p - lr.astype(p.dtype) * g.astype(p.dtype)}
+
+
+@register_op("momentum", **_OPT)
+def momentum(ins, attrs):
+    p, g, v, lr = (ins["Param"][0], ins["Grad"][0], ins["Velocity"][0],
+                   ins["LearningRate"][0])
+    mu = np.asarray(attrs.get("mu", 0.9), p.dtype)
+    g = g.astype(p.dtype)
+    lr = lr.astype(p.dtype)
+    rd = attrs.get("regularization_coeff", 0.0)
+    if attrs.get("regularization_method", "") == "l2_decay" and rd:
+        g = g + np.asarray(rd, p.dtype) * p
+    v_out = mu * v + g
+    if attrs.get("use_nesterov", False):
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    return {"ParamOut": p_out, "VelocityOut": v_out}
+
+
+@register_op("adam", **_OPT)
+def adam(ins, attrs):
+    """reference: operators/optimizers/adam_op.h AdamFunctor."""
+    import jax.numpy as jnp
+
+    p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    b1 = np.asarray(attrs.get("beta1", 0.9), np.float32)
+    b2 = np.asarray(attrs.get("beta2", 0.999), np.float32)
+    eps = np.asarray(attrs.get("epsilon", 1e-8), np.float32)
+    gf = g.astype(m1.dtype)
+    m1o = b1 * m1 + (1 - b1) * gf
+    m2o = b2 * m2 + (1 - b2) * gf * gf
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    step = lr_t * m1o / (jnp.sqrt(m2o) + eps)
+    return {"ParamOut": (p.astype(np.float32) - step).astype(p.dtype),
+            "Moment1Out": m1o, "Moment2Out": m2o,
+            "Beta1PowOut": b1p * b1, "Beta2PowOut": b2p * b2}
+
+
+@register_op("adamw", **_OPT)
+def adamw(ins, attrs):
+    import jax.numpy as jnp
+
+    p, lr = ins["Param"][0], ins["LearningRate"][0]
+    coeff = np.asarray(attrs.get("coeff", 0.01), np.float32)
+    outs = adam(ins, attrs)
+    if attrs.get("with_decay", True):
+        outs["ParamOut"] = (outs["ParamOut"].astype(np.float32)
+                            - lr * coeff * p.astype(np.float32)).astype(p.dtype)
+    return outs
+
+
+@register_op("adagrad", **_OPT)
+def adagrad(ins, attrs):
+    import jax.numpy as jnp
+
+    p, g, mom, lr = (ins["Param"][0], ins["Grad"][0], ins["Moment"][0],
+                     ins["LearningRate"][0])
+    eps = attrs.get("epsilon", 1e-6)
+    mo = mom + g * g
+    return {"ParamOut": p - lr * g / (jnp.sqrt(mo) + eps), "MomentOut": mo}
+
+
+@register_op("adamax", **_OPT)
+def adamax(ins, attrs):
+    import jax.numpy as jnp
+
+    p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    m, inf = ins["Moment"][0], ins["InfNorm"][0]
+    b1p = ins["Beta1Pow"][0]
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    mo = b1 * m + (1 - b1) * g
+    info = jnp.maximum(b2 * inf, jnp.abs(g))
+    lr_t = lr / (1 - b1p)
+    return {"ParamOut": p - lr_t * mo / (info + eps),
+            "MomentOut": mo, "InfNormOut": info}
+
+
+@register_op("adadelta", **_OPT)
+def adadelta(ins, attrs):
+    import jax.numpy as jnp
+
+    p, g = ins["Param"][0], ins["Grad"][0]
+    avg_sq, avg_upd = ins["AvgSquaredGrad"][0], ins["AvgSquaredUpdate"][0]
+    rho = attrs.get("rho", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    sq = rho * avg_sq + (1 - rho) * g * g
+    upd = jnp.sqrt(avg_upd + eps) / jnp.sqrt(sq + eps) * g
+    upd_acc = rho * avg_upd + (1 - rho) * upd * upd
+    return {"ParamOut": p - upd, "AvgSquaredGradOut": sq,
+            "AvgSquaredUpdateOut": upd_acc}
+
+
+@register_op("rmsprop", **_OPT)
+def rmsprop(ins, attrs):
+    import jax.numpy as jnp
+
+    p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    ms, mom = ins["MeanSquare"][0], ins["Moment"][0]
+    rho = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    mu = attrs.get("momentum", 0.0)
+    centered = attrs.get("centered", False)
+    ms_out = rho * ms + (1 - rho) * g * g
+    if centered:
+        mg = ins["MeanGrad"][0]
+        mg_out = rho * mg + (1 - rho) * g
+        denom = ms_out - mg_out * mg_out + eps
+    else:
+        mg_out = None
+        denom = ms_out + eps
+    mom_out = mu * mom + lr * g / jnp.sqrt(denom)
+    outs = {"ParamOut": p - mom_out, "MeanSquareOut": ms_out, "MomentOut": mom_out}
+    if mg_out is not None:
+        outs["MeanGradOut"] = mg_out
+    return outs
+
+
+@register_op("lars_momentum", **_OPT)
+def lars_momentum(ins, attrs):
+    """reference: operators/optimizers/lars_momentum_op.cc — layer-wise
+    adaptive rate scaling for large-batch training."""
+    import jax.numpy as jnp
+
+    p, g, v, lr = (ins["Param"][0], ins["Grad"][0], ins["Velocity"][0],
+                   ins["LearningRate"][0])
+    mu = attrs.get("mu", 0.9)
+    coeff = attrs.get("lars_coeff", 0.001)
+    decay = attrs.get("lars_weight_decay", 0.0005)
+    eps = attrs.get("epsilon", 1e-9)
+    pn = jnp.sqrt(jnp.sum(jnp.square(p.astype(np.float32))))
+    gn = jnp.sqrt(jnp.sum(jnp.square(g.astype(np.float32))))
+    local_lr = lr * coeff * pn / (gn + decay * pn + eps)
+    v_out = mu * v + local_lr * (g + decay * p)
+    return {"ParamOut": p - v_out, "VelocityOut": v_out}
+
+
+@register_op("lamb", **_OPT)
+def lamb(ins, attrs):
+    """reference: operators/optimizers/lamb_op.h — LAMB for large-batch BERT."""
+    import jax.numpy as jnp
+
+    p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-6)
+    wd = attrs.get("weight_decay", 0.01)
+    pf = p.astype(np.float32)
+    gf = g.astype(np.float32)
+    m1o = b1 * m1 + (1 - b1) * gf
+    m2o = b2 * m2 + (1 - b2) * gf * gf
+    mhat = m1o / (1 - b1p)
+    vhat = m2o / (1 - b2p)
+    r = mhat / (jnp.sqrt(vhat) + eps) + wd * pf
+    r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(pf)))
+    ratio = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+    return {"ParamOut": (pf - lr * ratio * r).astype(p.dtype),
+            "Moment1Out": m1o, "Moment2Out": m2o,
+            "Beta1PowOut": b1p * b1, "Beta2PowOut": b2p * b2}
+
+
+@register_op("ftrl", **_OPT)
+def ftrl(ins, attrs):
+    import jax.numpy as jnp
+
+    p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    sq, lin = ins["SquaredAccumulator"][0], ins["LinearAccumulator"][0]
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    power = attrs.get("lr_power", -0.5)
+    new_sq = sq + g * g
+    sigma = (new_sq ** -power - sq ** -power) / lr
+    lin_out = lin + g - sigma * p
+    pre = jnp.clip(lin_out, -l1, l1) - lin_out
+    denom = new_sq ** -power / lr + 2 * l2
+    return {"ParamOut": pre / denom, "SquaredAccumOut": new_sq,
+            "LinearAccumOut": lin_out}
+
+
+@register_op("decayed_adagrad", **_OPT)
+def decayed_adagrad(ins, attrs):
+    import jax.numpy as jnp
+
+    p, g, mom, lr = (ins["Param"][0], ins["Grad"][0], ins["Moment"][0],
+                     ins["LearningRate"][0])
+    decay = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    mo = decay * mom + (1 - decay) * g * g
+    return {"ParamOut": p - lr * g / (jnp.sqrt(mo) + eps), "MomentOut": mo}
+
+
+@register_op("clip_by_norm")
+def clip_by_norm(ins, attrs):
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    max_norm = attrs.get("max_norm", 1.0)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    return {"Out": jnp.where(norm > max_norm, x * (max_norm / norm), x)}

@@ -1,0 +1,482 @@
+"""Tensor creation / manipulation op lowerings.
+
+Capability mirror of the reference's dense manipulation ops
+(paddle/fluid/operators/: fill_constant_op.cc, uniform_random_op.cc,
+gaussian_random_op.cc, reshape_op.cc, transpose_op.cc, concat_op.cc,
+split_op.cc, cast_op.cc, slice_op.cc, gather_op.cc, one_hot_op.cc,
+lookup_table_op.cc, sum_op.cc, scale_op.cc, assign_op.cc, ...) as JAX
+lowerings. Each lowering is a pure function over {slot: [Array]} dicts.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ..core.registry import register_op
+from ..core.types import convert_dtype
+
+
+def _rng_key(attrs):
+    import jax
+
+    seed = int(attrs.get("seed", 0) or 0)
+    key = jax.random.PRNGKey(seed)
+    step = attrs.get("__step__")
+    if step is not None:
+        key = jax.random.fold_in(key, step)
+    return key
+
+
+def _shape_of(attrs, ins):
+    shape = attrs.get("shape")
+    if shape is None and ins.get("ShapeTensor"):
+        raise NotImplementedError("dynamic ShapeTensor is not XLA-compatible")
+    return tuple(int(d) for d in shape)
+
+
+@register_op("fill_constant", skip_infer_shape=True)
+def fill_constant(ins, attrs):
+    import jax.numpy as jnp
+
+    dtype = convert_dtype(attrs.get("dtype", "float32"))
+    shape = _shape_of(attrs, ins)
+    return {"Out": jnp.full(shape, attrs.get("value", 0.0), dtype=dtype)}
+
+
+@register_op("assign_value", skip_infer_shape=True)
+def assign_value(ins, attrs):
+    import jax.numpy as jnp
+
+    dtype = convert_dtype(attrs.get("dtype", "float32"))
+    vals = np.array(attrs["values"], dtype=dtype).reshape(attrs["shape"])
+    return {"Out": jnp.asarray(vals)}
+
+
+@register_op("uniform_random", skip_infer_shape=True)
+def uniform_random(ins, attrs):
+    import jax
+
+    dtype = convert_dtype(attrs.get("dtype", "float32"))
+    lo = float(attrs.get("min", -1.0))
+    hi = float(attrs.get("max", 1.0))
+    shape = _shape_of(attrs, ins)
+    return {"Out": jax.random.uniform(_rng_key(attrs), shape, dtype=np.dtype(dtype),
+                                      minval=lo, maxval=hi)}
+
+
+@register_op("gaussian_random", skip_infer_shape=True)
+def gaussian_random(ins, attrs):
+    import jax
+
+    dtype = convert_dtype(attrs.get("dtype", "float32"))
+    shape = _shape_of(attrs, ins)
+    mean = float(attrs.get("mean", 0.0))
+    std = float(attrs.get("std", 1.0))
+    x = jax.random.normal(_rng_key(attrs), shape, dtype=np.dtype(dtype))
+    return {"Out": x * std + mean}
+
+
+@register_op("truncated_gaussian_random", skip_infer_shape=True)
+def truncated_gaussian_random(ins, attrs):
+    import jax
+
+    dtype = convert_dtype(attrs.get("dtype", "float32"))
+    shape = _shape_of(attrs, ins)
+    mean = float(attrs.get("mean", 0.0))
+    std = float(attrs.get("std", 1.0))
+    x = jax.random.truncated_normal(_rng_key(attrs), -2.0, 2.0, shape,
+                                    dtype=np.dtype(dtype))
+    return {"Out": x * std + mean}
+
+
+@register_op("randint", skip_infer_shape=True)
+def randint(ins, attrs):
+    import jax
+
+    shape = _shape_of(attrs, ins)
+    return {"Out": jax.random.randint(_rng_key(attrs), shape,
+                                      int(attrs.get("low", 0)),
+                                      int(attrs.get("high", 100)),
+                                      dtype=np.dtype(convert_dtype(attrs.get("dtype", "int64"))))}
+
+
+@register_op("assign")
+def assign(ins, attrs):
+    return {"Out": ins["X"][0]}
+
+
+@register_op("share_data")
+def share_data(ins, attrs):
+    return {"Out": ins["X"][0]}
+
+
+@register_op("cast")
+def cast(ins, attrs):
+    import jax.numpy as jnp
+
+    dtype = convert_dtype(attrs.get("out_dtype", attrs.get("dtype", "float32")))
+    return {"Out": ins["X"][0].astype(np.dtype(dtype))}
+
+
+@register_op("scale")
+def scale(ins, attrs):
+    x = ins["X"][0]
+    s = attrs.get("scale", 1.0)
+    b = attrs.get("bias", 0.0)
+    if attrs.get("bias_after_scale", True):
+        return {"Out": x * s + np.asarray(b, x.dtype)}
+    return {"Out": (x + np.asarray(b, x.dtype)) * s}
+
+
+@register_op("reshape2")
+def reshape2(ins, attrs):
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    shape = list(attrs["shape"])
+    # paddle semantics: 0 means copy input dim; -1 infers
+    shape = [x.shape[i] if d == 0 else d for i, d in enumerate(shape)]
+    out = jnp.reshape(x, shape)
+    return {"Out": out, "XShape": jnp.zeros((0,) + x.shape, x.dtype)}
+
+
+@register_op("reshape")
+def reshape(ins, attrs):
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    shape = [x.shape[i] if d == 0 else d for i, d in enumerate(attrs["shape"])]
+    return {"Out": jnp.reshape(x, shape)}
+
+
+@register_op("transpose2")
+def transpose2(ins, attrs):
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    out = jnp.transpose(x, attrs["axis"])
+    return {"Out": out, "XShape": jnp.zeros((0,) + x.shape, x.dtype)}
+
+
+@register_op("transpose")
+def transpose(ins, attrs):
+    import jax.numpy as jnp
+
+    return {"Out": jnp.transpose(ins["X"][0], attrs["axis"])}
+
+
+@register_op("concat")
+def concat(ins, attrs):
+    import jax.numpy as jnp
+
+    return {"Out": jnp.concatenate(ins["X"], axis=int(attrs.get("axis", 0)))}
+
+
+@register_op("split")
+def split(ins, attrs):
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    axis = int(attrs.get("axis", 0))
+    sections = attrs.get("sections")
+    num = attrs.get("num", 0)
+    if sections:
+        idx = np.cumsum(sections)[:-1].tolist()
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, int(num), axis=axis)
+    return {"Out": list(outs)}
+
+
+@register_op("stack")
+def stack(ins, attrs):
+    import jax.numpy as jnp
+
+    return {"Y": jnp.stack(ins["X"], axis=int(attrs.get("axis", 0)))}
+
+
+@register_op("unstack")
+def unstack(ins, attrs):
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    axis = int(attrs.get("axis", 0))
+    n = x.shape[axis]
+    return {"Y": [jnp.squeeze(s, axis=axis) for s in jnp.split(x, n, axis=axis)]}
+
+
+@register_op("squeeze2")
+def squeeze2(ins, attrs):
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    axes = attrs.get("axes") or [i for i, d in enumerate(x.shape) if d == 1]
+    axes = [a for a in axes if x.shape[a] == 1]
+    out = jnp.squeeze(x, axis=tuple(axes)) if axes else x
+    return {"Out": out, "XShape": jnp.zeros((0,) + x.shape, x.dtype)}
+
+
+@register_op("unsqueeze2")
+def unsqueeze2(ins, attrs):
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    out = x
+    for a in sorted(attrs["axes"]):
+        out = jnp.expand_dims(out, axis=a)
+    return {"Out": out, "XShape": jnp.zeros((0,) + x.shape, x.dtype)}
+
+
+@register_op("flatten2")
+def flatten2(ins, attrs):
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    axis = int(attrs.get("axis", 1))
+    lead = int(np.prod(x.shape[:axis])) if axis > 0 else 1
+    out = jnp.reshape(x, (lead, -1))
+    return {"Out": out, "XShape": jnp.zeros((0,) + x.shape, x.dtype)}
+
+
+@register_op("flatten_contiguous_range")
+def flatten_contiguous_range(ins, attrs):
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    start = int(attrs.get("start_axis", 1))
+    stop = int(attrs.get("stop_axis", -1))
+    nd = x.ndim
+    if start < 0:
+        start += nd
+    if stop < 0:
+        stop += nd
+    shape = x.shape[:start] + (-1,) + x.shape[stop + 1:]
+    return {"Out": jnp.reshape(x, shape),
+            "XShape": jnp.zeros((0,) + x.shape, x.dtype)}
+
+
+@register_op("slice")
+def slice_op(ins, attrs):
+    x = ins["Input"][0]
+    axes = attrs["axes"]
+    starts = attrs["starts"]
+    ends = attrs["ends"]
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[a] = slice(s, e)
+    out = x[tuple(idx)]
+    decrease = attrs.get("decrease_axis") or []
+    if decrease:
+        import jax.numpy as jnp
+
+        out = jnp.squeeze(out, axis=tuple(decrease))
+    return {"Out": out}
+
+
+@register_op("strided_slice")
+def strided_slice(ins, attrs):
+    x = ins["Input"][0]
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(attrs["axes"], attrs["starts"], attrs["ends"],
+                           attrs["strides"]):
+        idx[a] = slice(s, e, st)
+    return {"Out": x[tuple(idx)]}
+
+
+@register_op("gather", non_diff_inputs=("Index",))
+def gather(ins, attrs):
+    import jax.numpy as jnp
+
+    x, index = ins["X"][0], ins["Index"][0]
+    axis = int(attrs.get("axis", 0))
+    return {"Out": jnp.take(x, index, axis=axis)}
+
+
+@register_op("gather_nd", non_diff_inputs=("Index",))
+def gather_nd(ins, attrs):
+    x, index = ins["X"][0], ins["Index"][0]
+    return {"Out": x[tuple(index[..., i] for i in range(index.shape[-1]))]}
+
+
+@register_op("scatter", non_diff_inputs=("Ids",))
+def scatter(ins, attrs):
+    x, ids, updates = ins["X"][0], ins["Ids"][0], ins["Updates"][0]
+    if attrs.get("overwrite", True):
+        return {"Out": x.at[ids].set(updates)}
+    return {"Out": x.at[ids].add(updates)}
+
+
+@register_op("lookup_table_v2", non_diff_inputs=("Ids",))
+def lookup_table_v2(ins, attrs):
+    """Embedding lookup (reference: operators/lookup_table_op.cc). padding_idx
+    rows emit zeros. Grad is the vjp (scatter-add) of the gather."""
+    import jax.numpy as jnp
+
+    w, ids = ins["W"][0], ins["Ids"][0]
+    out = jnp.take(w, ids, axis=0)
+    pad = int(attrs.get("padding_idx", -1))
+    if pad >= 0:
+        mask = (ids != pad)[..., None].astype(out.dtype)
+        out = out * mask
+    return {"Out": out}
+
+
+@register_op("lookup_table", non_diff_inputs=("Ids",))
+def lookup_table(ins, attrs):
+    import jax.numpy as jnp
+
+    w, ids = ins["W"][0], ins["Ids"][0]
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = jnp.squeeze(ids, axis=-1)
+    return lookup_table_v2({"W": [w], "Ids": [ids]}, attrs)
+
+
+@register_op("one_hot", non_diff_inputs=("X",))
+def one_hot(ins, attrs):
+    import jax
+
+    x = ins["X"][0]
+    depth = int(attrs["depth"])
+    if x.ndim >= 2 and x.shape[-1] == 1:
+        import jax.numpy as jnp
+
+        x = jnp.squeeze(x, axis=-1)
+    return {"Out": jax.nn.one_hot(x, depth, dtype=np.float32)}
+
+
+@register_op("one_hot_v2", non_diff_inputs=("X",))
+def one_hot_v2(ins, attrs):
+    import jax
+
+    return {"Out": jax.nn.one_hot(ins["X"][0], int(attrs["depth"]),
+                                  dtype=np.float32)}
+
+
+@register_op("sum")
+def sum_op(ins, attrs):
+    """Multi-input add — the gradient-accumulation op
+    (reference: operators/sum_op.cc)."""
+    xs = [x for x in ins["X"] if x is not None]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": out}
+
+
+@register_op("shape", non_diff_inputs=("Input",))
+def shape_op(ins, attrs):
+    import jax.numpy as jnp
+
+    return {"Out": jnp.array(ins["Input"][0].shape, dtype=np.int32)}
+
+
+@register_op("fill_zeros_like")
+def fill_zeros_like(ins, attrs):
+    import jax.numpy as jnp
+
+    return {"Out": jnp.zeros_like(ins["X"][0])}
+
+
+@register_op("fill_any_like", skip_infer_shape=True)
+def fill_any_like(ins, attrs):
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    dtype = attrs.get("dtype")
+    dt = x.dtype if dtype in (None, -1) else np.dtype(convert_dtype(dtype))
+    return {"Out": jnp.full(x.shape, attrs.get("value", 0.0), dtype=dt)}
+
+
+@register_op("expand")
+def expand(ins, attrs):
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    times = attrs["expand_times"]
+    return {"Out": jnp.tile(x, times)}
+
+
+@register_op("expand_v2")
+def expand_v2(ins, attrs):
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    shape = list(attrs["shape"])
+    shape = [x.shape[i - (len(shape) - x.ndim)] if d == -1 else d
+             for i, d in enumerate(shape)]
+    return {"Out": jnp.broadcast_to(x, shape)}
+
+
+@register_op("tile")
+def tile(ins, attrs):
+    import jax.numpy as jnp
+
+    return {"Out": jnp.tile(ins["X"][0], attrs["repeat_times"])}
+
+
+@register_op("range", skip_infer_shape=True, non_diff_inputs=("Start", "End", "Step"))
+def range_op(ins, attrs):
+    import jax.numpy as jnp
+
+    start = attrs.get("start", ins.get("Start", [0])[0])
+    end = attrs.get("end", ins.get("End", [0])[0])
+    step = attrs.get("step", ins.get("Step", [1])[0])
+    dtype = convert_dtype(attrs.get("dtype", "int64"))
+    return {"Out": jnp.arange(np.asarray(start).item() if not hasattr(start, "aval") else start,
+                              np.asarray(end).item() if not hasattr(end, "aval") else end,
+                              np.asarray(step).item() if not hasattr(step, "aval") else step,
+                              dtype=np.dtype(dtype))}
+
+
+@register_op("where", non_diff_inputs=("Condition",))
+def where(ins, attrs):
+    import jax.numpy as jnp
+
+    return {"Out": jnp.where(ins["Condition"][0], ins["X"][0], ins["Y"][0])}
+
+
+@register_op("cumsum")
+def cumsum(ins, attrs):
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    if attrs.get("flatten", False):
+        x = x.reshape(-1)
+        axis = 0
+    out = jnp.cumsum(x, axis=axis)
+    if attrs.get("reverse", False):
+        out = jnp.flip(jnp.cumsum(jnp.flip(x, axis), axis=axis), axis)
+    return {"Out": out}
+
+
+@register_op("pad")
+def pad(ins, attrs):
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    p = attrs["paddings"]
+    pairs = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": jnp.pad(x, pairs, constant_values=attrs.get("pad_value", 0.0))}
+
+
+@register_op("tril_triu")
+def tril_triu(ins, attrs):
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    k = int(attrs.get("diagonal", 0))
+    if attrs.get("lower", True):
+        return {"Out": jnp.tril(x, k)}
+    return {"Out": jnp.triu(x, k)}
+
+
+@register_op("increment")
+def increment(ins, attrs):
+    x = ins["X"][0]
+    return {"Out": x + np.asarray(attrs.get("step", 1.0), x.dtype)}

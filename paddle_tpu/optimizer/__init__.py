@@ -1,0 +1,413 @@
+"""Optimizer classes — emit optimizer ops into the program.
+
+Capability mirror of python/paddle/fluid/optimizer.py (Optimizer:57,
+SGDOptimizer:956, MomentumOptimizer:1050, AdagradOptimizer:1737,
+AdamOptimizer:1853, AdamaxOptimizer:2119, DecayedAdagrad:2386, Adadelta:2496,
+RMSProp:2615, Ftrl:2803, Lamb:2962, LarsMomentumOptimizer:1605).
+`minimize(loss)` = append_backward + per-param optimizer ops; the compiled
+executor fuses the whole sweep into the training step's XLA program.
+
+Wrapper/meta optimizers (Recompute, GradientMerge, Pipeline, DGC, …) live in
+paddle_tpu.distributed.fleet.meta_optimizers.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core import unique_name
+from ..core.backward import append_backward
+from ..core.ir import (OpRole, Parameter, Program, Variable,
+                       default_main_program, default_startup_program)
+from ..regularizer import append_regularization_ops
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameter_list=None,
+                 regularization=None, grad_clip=None, name: Optional[str] = None):
+        self._learning_rate = learning_rate
+        self._parameter_list = parameter_list
+        self.regularization = regularization
+        self._grad_clip = grad_clip
+        self._name = name or unique_name.generate(type(self).__name__)
+        self._accumulators: Dict[str, Dict[str, Variable]] = {}
+        self._lr_var: Optional[Variable] = None
+
+    # -- learning rate --------------------------------------------------------
+    def _create_global_learning_rate(self):
+        if self._lr_var is not None:
+            return
+        from ..layers import nn as layers_nn
+
+        lr = self._learning_rate
+        if isinstance(lr, Variable):
+            self._lr_var = lr
+            return
+        if callable(lr):  # LR scheduler object from .lr
+            self._lr_var = lr._create_var()
+            return
+        self._lr_var = layers_nn.create_global_var(
+            [1], float(lr), "float32", persistable=True,
+            name=unique_name.generate("learning_rate"))
+
+    @property
+    def learning_rate_var(self) -> Variable:
+        return self._lr_var
+
+    def current_step_lr(self) -> float:
+        from ..core.scope import global_scope
+
+        v = global_scope().find_var(self._lr_var.name)
+        return float(np.asarray(v)[0]) if v is not None else float(self._learning_rate)
+
+    def set_lr(self, value: float):
+        from ..core.scope import global_scope
+
+        global_scope().set(self._lr_var.name, np.full((1,), value, np.float32))
+
+    # -- accumulators ----------------------------------------------------------
+    def _add_accumulator(self, name: str, param: Variable, fill_value: float = 0.0,
+                         shape=None, dtype="float32") -> Variable:
+        from ..layers import nn as layers_nn
+
+        acc = self._accumulators.setdefault(name, {})
+        if param.name in acc:
+            return acc[param.name]
+        var = layers_nn.create_global_var(
+            shape or list(param.shape), fill_value, dtype, persistable=True,
+            name=unique_name.generate(f"{param.name}_{name}"))
+        acc[param.name] = var
+        return var
+
+    def _get_accumulator(self, name: str, param: Variable) -> Variable:
+        return self._accumulators[name][param.name]
+
+    # -- hooks subclasses implement -------------------------------------------
+    def _create_accumulators(self, block, parameters):
+        pass
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    # -- public API ------------------------------------------------------------
+    def backward(self, loss: Variable, startup_program=None, parameter_list=None,
+                 no_grad_set=None) -> List[Tuple[Parameter, Variable]]:
+        return append_backward(loss, parameter_list or self._parameter_list,
+                               no_grad_set)
+
+    def apply_gradients(self, params_grads) -> List:
+        block = default_main_program().global_block()
+        program = block.program
+        with program._role_guard(OpRole.Optimize):
+            self._create_global_learning_rate()
+            if self._grad_clip is not None:
+                params_grads = self._grad_clip(params_grads)
+            params_grads = append_regularization_ops(params_grads,
+                                                     self.regularization)
+            self._create_accumulators(block, [p for p, _ in params_grads])
+            ops = []
+            for pg in params_grads:
+                ops.append(self._append_optimize_op(block, pg))
+        return ops
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self.apply_gradients(params_grads)
+
+    def minimize(self, loss: Variable, startup_program=None,
+                 parameter_list=None, no_grad_set=None):
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        ops = self.apply_gradients(params_grads)
+        return ops, params_grads
+
+
+class SGDOptimizer(Optimizer):
+    type = "sgd"
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            "sgd", {"Param": [p], "Grad": [g], "LearningRate": [self._lr_var]},
+            {"ParamOut": [p]}, {})
+
+
+class MomentumOptimizer(Optimizer):
+    type = "momentum"
+
+    def __init__(self, learning_rate, momentum=0.9, use_nesterov=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        v = self._get_accumulator("velocity", p)
+        return block.append_op(
+            "momentum",
+            {"Param": [p], "Grad": [g], "Velocity": [v],
+             "LearningRate": [self._lr_var]},
+            {"ParamOut": [p], "VelocityOut": [v]},
+            {"mu": self._momentum, "use_nesterov": self._use_nesterov})
+
+
+class LarsMomentumOptimizer(Optimizer):
+    type = "lars_momentum"
+
+    def __init__(self, learning_rate, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        v = self._get_accumulator("velocity", p)
+        return block.append_op(
+            "lars_momentum",
+            {"Param": [p], "Grad": [g], "Velocity": [v],
+             "LearningRate": [self._lr_var]},
+            {"ParamOut": [p], "VelocityOut": [v]},
+            {"mu": self._momentum, "lars_coeff": self._lars_coeff,
+             "lars_weight_decay": self._lars_weight_decay})
+
+
+class AdagradOptimizer(Optimizer):
+    type = "adagrad"
+
+    def __init__(self, learning_rate, epsilon=1e-6, initial_accumulator_value=0.0,
+                 **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon = epsilon
+        self._initial = initial_accumulator_value
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p, self._initial)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._get_accumulator("moment", p)
+        return block.append_op(
+            "adagrad",
+            {"Param": [p], "Grad": [g], "Moment": [m],
+             "LearningRate": [self._lr_var]},
+            {"ParamOut": [p], "MomentOut": [m]}, {"epsilon": self._epsilon})
+
+
+class AdamOptimizer(Optimizer):
+    type = "adam"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_mode=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment1", p)
+            self._add_accumulator("moment2", p)
+            self._add_accumulator("beta1_pow", p, self._beta1, shape=[1])
+            self._add_accumulator("beta2_pow", p, self._beta2, shape=[1])
+
+    def _op_type(self):
+        return "adam", {}
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m1 = self._get_accumulator("moment1", p)
+        m2 = self._get_accumulator("moment2", p)
+        b1p = self._get_accumulator("beta1_pow", p)
+        b2p = self._get_accumulator("beta2_pow", p)
+        op_type, extra = self._op_type()
+        return block.append_op(
+            op_type,
+            {"Param": [p], "Grad": [g], "LearningRate": [self._lr_var],
+             "Moment1": [m1], "Moment2": [m2], "Beta1Pow": [b1p],
+             "Beta2Pow": [b2p]},
+            {"ParamOut": [p], "Moment1Out": [m1], "Moment2Out": [m2],
+             "Beta1PowOut": [b1p], "Beta2PowOut": [b2p]},
+            {"beta1": self._beta1, "beta2": self._beta2,
+             "epsilon": self._epsilon, **extra})
+
+
+class AdamWOptimizer(AdamOptimizer):
+    type = "adamw"
+
+    def __init__(self, learning_rate=0.001, weight_decay=0.01, **kw):
+        super().__init__(learning_rate, **kw)
+        self._coeff = weight_decay
+
+    def _op_type(self):
+        return "adamw", {"coeff": self._coeff, "with_decay": True}
+
+
+class LambOptimizer(AdamOptimizer):
+    type = "lamb"
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, beta1=beta1, beta2=beta2,
+                         epsilon=epsilon, **kw)
+        self._weight_decay = lamb_weight_decay
+
+    def _op_type(self):
+        return "lamb", {"weight_decay": self._weight_decay}
+
+
+class AdamaxOptimizer(Optimizer):
+    type = "adamax"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+            self._add_accumulator("inf_norm", p)
+            self._add_accumulator("beta1_pow", p, self._beta1, shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            "adamax",
+            {"Param": [p], "Grad": [g], "LearningRate": [self._lr_var],
+             "Moment": [self._get_accumulator("moment", p)],
+             "InfNorm": [self._get_accumulator("inf_norm", p)],
+             "Beta1Pow": [self._get_accumulator("beta1_pow", p)]},
+            {"ParamOut": [p],
+             "MomentOut": [self._get_accumulator("moment", p)],
+             "InfNormOut": [self._get_accumulator("inf_norm", p)]},
+            {"beta1": self._beta1, "beta2": self._beta2,
+             "epsilon": self._epsilon})
+
+
+class AdadeltaOptimizer(Optimizer):
+    type = "adadelta"
+
+    def __init__(self, learning_rate=1.0, epsilon=1e-6, rho=0.95, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("avg_squared_grad", p)
+            self._add_accumulator("avg_squared_update", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            "adadelta",
+            {"Param": [p], "Grad": [g],
+             "AvgSquaredGrad": [self._get_accumulator("avg_squared_grad", p)],
+             "AvgSquaredUpdate": [self._get_accumulator("avg_squared_update", p)]},
+            {"ParamOut": [p],
+             "AvgSquaredGradOut": [self._get_accumulator("avg_squared_grad", p)],
+             "AvgSquaredUpdateOut": [self._get_accumulator("avg_squared_update", p)]},
+            {"epsilon": self._epsilon, "rho": self._rho})
+
+
+class RMSPropOptimizer(Optimizer):
+    type = "rmsprop"
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("mean_square", p)
+            self._add_accumulator("moment", p)
+            if self._centered:
+                self._add_accumulator("mean_grad", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        ins = {"Param": [p], "Grad": [g], "LearningRate": [self._lr_var],
+               "MeanSquare": [self._get_accumulator("mean_square", p)],
+               "Moment": [self._get_accumulator("moment", p)]}
+        outs = {"ParamOut": [p],
+                "MeanSquareOut": [self._get_accumulator("mean_square", p)],
+                "MomentOut": [self._get_accumulator("moment", p)]}
+        if self._centered:
+            ins["MeanGrad"] = [self._get_accumulator("mean_grad", p)]
+            outs["MeanGradOut"] = [self._get_accumulator("mean_grad", p)]
+        return block.append_op(
+            "rmsprop", ins, outs,
+            {"decay": self._rho, "epsilon": self._epsilon,
+             "momentum": self._momentum, "centered": self._centered})
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    type = "decayed_adagrad"
+
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._decay, self._epsilon = decay, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._get_accumulator("moment", p)
+        return block.append_op(
+            "decayed_adagrad",
+            {"Param": [p], "Grad": [g], "Moment": [m],
+             "LearningRate": [self._lr_var]},
+            {"ParamOut": [p], "MomentOut": [m]},
+            {"decay": self._decay, "epsilon": self._epsilon})
+
+
+class FtrlOptimizer(Optimizer):
+    type = "ftrl"
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, **kw):
+        super().__init__(learning_rate, **kw)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("squared", p)
+            self._add_accumulator("linear", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            "ftrl",
+            {"Param": [p], "Grad": [g], "LearningRate": [self._lr_var],
+             "SquaredAccumulator": [self._get_accumulator("squared", p)],
+             "LinearAccumulator": [self._get_accumulator("linear", p)]},
+            {"ParamOut": [p],
+             "SquaredAccumOut": [self._get_accumulator("squared", p)],
+             "LinearAccumOut": [self._get_accumulator("linear", p)]},
+            {"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power})
+
+
+# 2.0-style aliases (paddle.optimizer)
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adagrad = AdagradOptimizer
+Adam = AdamOptimizer
+AdamW = AdamWOptimizer
+Adamax = AdamaxOptimizer
+Adadelta = AdadeltaOptimizer
+RMSProp = RMSPropOptimizer
+Lamb = LambOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+Ftrl = FtrlOptimizer

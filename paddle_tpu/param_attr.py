@@ -1,0 +1,38 @@
+"""ParamAttr (reference: python/paddle/fluid/param_attr.py)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class ParamAttr:
+    def __init__(self, name: Optional[str] = None, initializer=None,
+                 learning_rate: float = 1.0, regularizer=None,
+                 trainable: bool = True, do_model_average: bool = False):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+
+    @staticmethod
+    def _to_attr(arg) -> Optional["ParamAttr"]:
+        """Normalise user input: None → default, False → no parameter,
+        str → named, Initializer → initializer, ParamAttr → itself."""
+        if arg is None:
+            return ParamAttr()
+        if arg is False:
+            return None
+        if isinstance(arg, ParamAttr):
+            return arg
+        if isinstance(arg, str):
+            return ParamAttr(name=arg)
+        from .initializer import Initializer
+
+        if isinstance(arg, Initializer):
+            return ParamAttr(initializer=arg)
+        raise TypeError(f"cannot convert {type(arg)} to ParamAttr")
+
+
+WeightNormParamAttr = ParamAttr

@@ -1,0 +1,52 @@
+"""Weight-decay regularizers (reference: python/paddle/fluid/regularizer.py).
+
+append_regularization_ops adds `grad += coeff * param` (L2) or
+`grad += coeff * sign(param)` (L1) ops before the optimizer ops — the same
+program-rewrite mechanism as the reference.
+"""
+
+from __future__ import annotations
+
+
+class WeightDecayRegularizer:
+    def __init__(self, regularization_coeff: float = 0.0):
+        self._coeff = regularization_coeff
+
+
+class L2Decay(WeightDecayRegularizer):
+    def append(self, block, param, grad):
+        scaled = block.create_var(stop_gradient=True, dtype=grad.dtype)
+        block.append_op("scale", {"X": [param]}, {"Out": [scaled]},
+                        {"scale": self._coeff})
+        out = block.create_var(stop_gradient=True, dtype=grad.dtype)
+        block.append_op("sum", {"X": [grad, scaled]}, {"Out": [out]}, {})
+        return out
+
+
+class L1Decay(WeightDecayRegularizer):
+    def append(self, block, param, grad):
+        sign = block.create_var(stop_gradient=True, dtype=grad.dtype)
+        block.append_op("sign", {"X": [param]}, {"Out": [sign]}, {})
+        scaled = block.create_var(stop_gradient=True, dtype=grad.dtype)
+        block.append_op("scale", {"X": [sign]}, {"Out": [scaled]},
+                        {"scale": self._coeff})
+        out = block.create_var(stop_gradient=True, dtype=grad.dtype)
+        block.append_op("sum", {"X": [grad, scaled]}, {"Out": [out]}, {})
+        return out
+
+
+L2DecayRegularizer = L2Decay
+L1DecayRegularizer = L1Decay
+
+
+def append_regularization_ops(params_grads, global_regularizer=None):
+    out = []
+    for p, g in params_grads:
+        reg = getattr(p, "regularizer", None) or global_regularizer
+        if reg is None or g is None:
+            out.append((p, g))
+            continue
+        block = p.block
+        new_g = reg.append(block, p, g)
+        out.append((p, new_g))
+    return out

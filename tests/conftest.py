@@ -1,0 +1,43 @@
+"""Test config: run on a virtual 8-device CPU mesh (no TPU contention).
+
+Mirrors the reference's test strategy (SURVEY.md §4): multi-device tests run
+single-process against mesh slices of the 8 virtual devices.
+MUST set env before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def scope():
+    import paddle_tpu as pt
+
+    return pt.Scope()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_programs():
+    """Give every test fresh default programs + a fresh name generator."""
+    import paddle_tpu as pt
+    from paddle_tpu.core import ir, unique_name
+
+    old_main, old_startup = ir._main_program, ir._startup_program
+    ir._main_program, ir._startup_program = ir.Program(), ir.Program()
+    old_gen = unique_name.switch()
+    yield
+    unique_name.switch(old_gen)
+    ir._main_program, ir._startup_program = old_main, old_startup
+
+
+def rand(*shape, dtype=np.float32, seed=None):
+    rng = np.random.RandomState(seed if seed is not None else 42)
+    return rng.randn(*shape).astype(dtype)

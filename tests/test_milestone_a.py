@@ -1,0 +1,126 @@
+"""Milestone A: MNIST-style static-graph end-to-end (SURVEY.md §7 L5').
+
+Mirrors the reference's book test test_recognize_digits.py:67 at smoke scale:
+build program → append_backward → optimizer ops → compiled executor; loss
+must decrease; interpreter and compiler must agree.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _mnist_program(conv=False, optimizer="sgd"):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        if conv:
+            img = layers.data("img", [1, 28, 28])
+            h = layers.conv2d(img, 6, 5, padding=2, act="relu")
+            h = layers.pool2d(h, 2, "max", 2)
+            h = layers.conv2d(h, 16, 5, act="relu")
+            h = layers.pool2d(h, 2, "max", 2)
+        else:
+            img = layers.data("img", [784])
+            h = layers.fc(img, 64, act="relu")
+        label = layers.data("label", [1], dtype="int64")
+        logits = layers.fc(h, 10)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        acc = layers.accuracy(layers.softmax(logits), label)
+        if optimizer == "sgd":
+            opt = pt.optimizer.SGDOptimizer(0.1)
+        elif optimizer == "momentum":
+            opt = pt.optimizer.MomentumOptimizer(0.05, 0.9)
+        else:
+            opt = pt.optimizer.AdamOptimizer(1e-3)
+        opt.minimize(loss)
+    return main, startup, loss, acc
+
+
+def _feed(conv=False, n=16, seed=0):
+    rng = np.random.RandomState(seed)
+    shape = (n, 1, 28, 28) if conv else (n, 784)
+    return {"img": rng.randn(*shape).astype(np.float32),
+            "label": rng.randint(0, 10, (n, 1)).astype(np.int64)}
+
+
+@pytest.mark.parametrize("optimizer", ["sgd", "momentum", "adam"])
+def test_mlp_loss_decreases(scope, optimizer):
+    main, startup, loss, acc = _mnist_program(conv=False, optimizer=optimizer)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope, use_compiled=False)
+    feed = _feed()
+    losses = []
+    for _ in range(12):
+        lv, = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+        losses.append(lv.item())
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_lenet_conv_overfits_batch(scope):
+    main, startup, loss, acc = _mnist_program(conv=True, optimizer="momentum")
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope, use_compiled=False)
+    feed = _feed(conv=True)
+    for _ in range(15):
+        lv, av = exe.run(main, feed=feed, fetch_list=[loss, acc], scope=scope)
+    assert av.item() > 0.9
+    assert lv.item() < 0.5
+
+
+def test_interpreter_compiler_parity():
+    main, startup, loss, _ = _mnist_program(conv=False, optimizer="momentum")
+    exe = pt.Executor(pt.CPUPlace())
+    s1 = pt.Scope()
+    exe.run(startup, scope=s1, use_compiled=False)
+    s2 = pt.Scope()
+    for k, v in list(s1.items()):
+        s2.set(k, np.array(v))
+    feed = _feed()
+    for _ in range(3):
+        a, = exe.run(main, feed=feed, fetch_list=[loss], scope=s1,
+                     use_compiled=False)
+    for _ in range(3):
+        b, = exe.run(main, feed=feed, fetch_list=[loss], scope=s2,
+                     use_compiled=True)
+    np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-6)
+
+
+def test_clone_for_test_strips_backward(scope):
+    main, startup, loss, acc = _mnist_program()
+    n_train = len(main.global_block().ops)
+    test_prog = main.clone(for_test=True)
+    n_test = len(test_prog.global_block().ops)
+    assert n_test < n_train
+    assert not any(op.is_backward_op() or op.is_optimize_op()
+                   for op in test_prog.global_block().ops)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope, use_compiled=False)
+    lv, = exe.run(test_prog, feed=_feed(), fetch_list=[loss], scope=scope)
+    assert np.isfinite(lv).all()
+
+
+def test_gradients_fan_out(scope):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4], append_batch_size=False, stop_gradient=False)
+        y = layers.reduce_sum(x * 3.0 + x * 2.0)
+        (gx,) = pt.gradients([y], [x])
+    exe = pt.Executor(pt.CPUPlace())
+    g, = exe.run(main, feed={"x": np.ones(4, np.float32)}, fetch_list=[gx],
+                 scope=scope)
+    np.testing.assert_allclose(g, 5.0)
+
+
+def test_save_scope_roundtrip(scope):
+    """Params live device-side in the scope and survive across run calls."""
+    main, startup, loss, _ = _mnist_program()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope, use_compiled=False)
+    names = [p.name for p in main.all_parameters()]
+    before = {n: np.array(scope.find_var(n)) for n in names}
+    exe.run(main, feed=_feed(), fetch_list=[loss], scope=scope)
+    changed = [n for n in names
+               if not np.allclose(before[n], np.array(scope.find_var(n)))]
+    assert changed, "no parameter changed after a training step"
