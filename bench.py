@@ -1,0 +1,84 @@
+"""Benchmark: BERT-base pretraining step throughput on the local chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline = achieved MFU / 0.35 (the BASELINE north-star MFU target;
+the reference publishes no absolute numbers — BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def peak_flops_per_chip() -> float:
+    """bf16 peak of the local chip (v5e/lite: 197 TFLOPS; v5p: 459)."""
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    if "v5p" in kind or "v5 p" in kind:
+        return 459e12
+    if "v4" in kind:
+        return 275e12
+    if "v6" in kind or "trillium" in kind:
+        return 918e12
+    return 197e12  # v5e / v5 lite
+
+
+def transformer_step_flops(cfg, batch, seq) -> float:
+    """6 * non-embedding-params * tokens + attention term (fwd+bwd)."""
+    h, l, ff, v = (cfg.hidden_size, cfg.num_hidden_layers,
+                   cfg.intermediate_size, cfg.vocab_size)
+    per_layer = 4 * h * h + 2 * h * ff          # qkv/out + ffn
+    n_params = l * per_layer + h * v            # + lm head matmul (tied emb)
+    tokens = batch * seq
+    matmul = 6.0 * n_params * tokens
+    attn = 6.0 * 2 * l * batch * seq * seq * h  # scores + context, fwd+bwd
+    return matmul + attn
+
+
+def main():
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu.models import bert
+
+    cfg = bert.bert_base()
+    cfg.dtype = "bfloat16"
+    seq, batch = 128, 64
+    steps = 20
+
+    main_prog, startup, feeds, fetches = bert.build_pretraining_program(
+        cfg, seq_len=seq, optimizer_name="adamw")
+    exe = pt.Executor()
+    scope = pt.Scope()
+    exe.run(startup, scope=scope, use_compiled=False)
+    data = bert.synthetic_pretraining_batch(cfg, batch, seq)
+
+    loss_v = fetches["loss"]
+    # warmup/compile
+    exe.run(main_prog, feed=data, fetch_list=[loss_v], scope=scope)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = exe.run(main_prog, feed=data, fetch_list=[loss_v], scope=scope)
+    dt = (time.perf_counter() - t0) / steps
+
+    tokens_per_sec = batch * seq / dt
+    flops = transformer_step_flops(cfg, batch, seq)
+    mfu = flops / dt / peak_flops_per_chip()
+    print(json.dumps({
+        "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.35, 4),
+        "extra": {"ms_per_step": round(dt * 1e3, 2), "mfu": round(mfu, 4),
+                  "batch": batch, "seq_len": seq,
+                  "loss": float(np.asarray(out[0]))},
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -156,15 +156,20 @@ class Executor:
             feed: Optional[Dict[str, Any]] = None,
             fetch_list: Optional[Sequence[Union[str, Variable]]] = None,
             scope: Optional[Scope] = None, return_numpy: bool = True,
-            use_compiled: bool = True):
+            use_compiled: bool = True, mesh: Optional[Any] = None):
         from .compiler import CompiledProgram  # local: avoid cycle
 
-        mesh = None
         in_shardings = None
+        # precedence: explicit mesh= arg > CompiledProgram's mesh > global mesh
         if isinstance(program, CompiledProgram):
-            mesh = program._mesh
+            if mesh is None:
+                mesh = program._mesh
             in_shardings = program._sharding_for_feed(feed or {})
             program = program._program
+        if mesh is None:
+            from ..parallel.mesh import get_mesh
+
+            mesh = get_mesh()
         if program is None:
             program = default_main_program()
         if scope is None:
@@ -218,12 +223,21 @@ class Executor:
         import jax
 
         feed_names = tuple(sorted(feed))
+        # default dp-sharding of a feed is only safe when its batch dim
+        # divides the dp axis; partial batches compile a replicated entry
+        dp = mesh.shape.get("dp") if mesh is not None else None
+        dp_ok = {}
+        if dp:
+            for n in feed_names:
+                v = feed[n]
+                dp_ok[n] = bool(getattr(v, "ndim", 0) >= 1
+                                and v.shape[0] % dp == 0)
         key = (id(program), program.version, id(scope), feed_names,
-               tuple(fetch_names), id(mesh))
+               tuple(fetch_names), id(mesh), tuple(sorted(dp_ok.items())))
         entry = self._cache.get(key)
         if entry is None:
             entry = self._compile(program, block, feed_names, fetch_names, scope,
-                                  mesh, in_shardings)
+                                  mesh, in_shardings, dp_ok)
             self._cache[key] = entry
 
         state = {}
@@ -246,7 +260,7 @@ class Executor:
         return list(fetches)
 
     def _compile(self, program, block, feed_names, fetch_names, scope, mesh,
-                 in_shardings) -> _CompiledEntry:
+                 in_shardings, dp_ok=None) -> _CompiledEntry:
         import jax
         import jax.numpy as jnp
 
@@ -285,8 +299,30 @@ class Executor:
             return tuple(fetches), new_state, step + 1
 
         jit_kwargs: Dict[str, Any] = {"donate_argnums": (0,)}
-        if mesh is not None and in_shardings is not None:
-            jit_kwargs["in_shardings"] = (None, None, in_shardings, None)
+        if mesh is not None:
+            # Shardings from VarDesc annotations (parallel/api.py): params use
+            # their spec (default replicated), feeds default to batch-over-dp.
+            from ..parallel.api import named_sharding_for
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            def var_sharding(name, default_spec=None):
+                if block.has_var(name):
+                    return named_sharding_for(block.var(name), mesh, default_spec)
+                return NamedSharding(mesh, P())
+
+            state_sh = {n: var_sharding(n) for n in state_names}
+            ro_sh = {n: var_sharding(n) for n in ro_names}
+            feed_sh = {}
+            for n in feed_names:
+                if in_shardings is not None and n in in_shardings:
+                    feed_sh[n] = in_shardings[n]
+                else:
+                    feed_default = (("dp",) if "dp" in mesh.shape
+                                    and (dp_ok or {}).get(n) else None)
+                    feed_sh[n] = var_sharding(n, default_spec=feed_default)
+            step_sh = NamedSharding(mesh, P())
+            jit_kwargs["in_shardings"] = (state_sh, ro_sh, feed_sh, step_sh)
+            jit_kwargs["out_shardings"] = (None, state_sh, step_sh)
         jitted = jax.jit(fn, **jit_kwargs)
         return _CompiledEntry(jitted, state_names, ro_names, fetch_tuple,
                               bool(state_names))

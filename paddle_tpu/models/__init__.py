@@ -1,0 +1,14 @@
+"""Model zoo covering the BASELINE workload ladder:
+MNIST LeNet, ResNet-50, BERT-base, ERNIE-large, Transformer-big.
+"""
+
+from . import bert, lenet  # noqa: F401
+
+try:
+    from . import resnet  # noqa: F401
+except ImportError:
+    pass
+try:
+    from . import transformer  # noqa: F401
+except ImportError:
+    pass

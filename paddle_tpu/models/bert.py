@@ -1,0 +1,246 @@
+"""BERT / ERNIE transformer encoder + pretraining program.
+
+BASELINE configs 3 (BERT-base) and 4 (ERNIE-large — the north-star
+data-parallel workload). The reference era trains these via PaddleNLP model
+zoos on the fluid layers API; here the encoder is built the same way
+(program IR), with TPU-native extras:
+
+* bf16-friendly compute (layer_norm/softmax accumulate in fp32),
+* Megatron-style tensor-parallel sharding annotations on the QKV/FFN weights
+  (parallel/api.shard_tensor) — GSPMD emits the allreduces the reference
+  lacked first-class TP for (SURVEY.md §2.7),
+* batch axis sharded over 'dp', sequence shardable over 'sp'.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .. import layers
+from ..core.ir import Program, program_guard
+from ..initializer import Normal, TruncatedNormal
+from ..param_attr import ParamAttr
+from ..parallel.api import shard_tensor
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    initializer_range: float = 0.02
+    hidden_act: str = "gelu"
+    dtype: str = "float32"
+
+
+def bert_base() -> BertConfig:
+    return BertConfig()
+
+
+def bert_large() -> BertConfig:
+    return BertConfig(hidden_size=1024, num_hidden_layers=24,
+                      num_attention_heads=16, intermediate_size=4096)
+
+
+def ernie_large() -> BertConfig:
+    """ERNIE 2.0 large (Baidu flagship): BERT-large geometry, 18k vocab
+    (reference-era ERNIE uses its own WordPiece vocab)."""
+    return BertConfig(vocab_size=18000, hidden_size=1024, num_hidden_layers=24,
+                      num_attention_heads=16, intermediate_size=4096)
+
+
+def _param(name, cfg):
+    return ParamAttr(name=name, initializer=TruncatedNormal(
+        0.0, cfg.initializer_range))
+
+
+def _dense(x, d_out, name, cfg, act=None, tp_spec=None):
+    """3-D dense: [B,S,H] @ [H,d_out] + b, with optional TP sharding spec on
+    the weight (e.g. (None,'mp') column-parallel, ('mp',None) row-parallel)."""
+    w = layers.create_parameter([int(x.shape[-1]), d_out], cfg.dtype,
+                                attr=_param(name + "_w", cfg))
+    if tp_spec is not None:
+        shard_tensor(w, tp_spec)
+    b = layers.create_parameter([d_out], cfg.dtype,
+                                attr=ParamAttr(name=name + "_b"), is_bias=True)
+    if tp_spec is not None and tp_spec[-1] is not None:
+        shard_tensor(b, (tp_spec[-1],))
+    out = layers.linear(x, w, b)
+    if act == "gelu":
+        out = layers.gelu(out, approximate=True)
+    elif act:
+        out = getattr(layers, act)(out)
+    return out
+
+
+def _attention(x, attn_bias, cfg: BertConfig, name: str, is_test=False):
+    """Multi-head self-attention via program ops (matmul/reshape/transpose/
+    softmax). Swappable with the fused flash-attention op (ops/attention_ops)
+    by the fuse pass; QKV is column-parallel, the output projection
+    row-parallel (Megatron pattern)."""
+    h = cfg.hidden_size
+    n = cfg.num_attention_heads
+    hd = h // n
+    qkv = _dense(x, 3 * h, f"{name}_qkv", cfg, tp_spec=(None, "mp"))
+    qkv = layers.reshape(qkv, [0, 0, 3, n, hd])
+    qkv = layers.transpose(qkv, [2, 0, 3, 1, 4])      # [3,B,n,S,hd]
+    # slice the stacked qkv (static slice keeps XLA happy)
+    q = layers.slice(qkv, [0], [0], [1])
+    k = layers.slice(qkv, [0], [1], [2])
+    v = layers.slice(qkv, [0], [2], [3])
+    q = layers.squeeze(q, [0])
+    k = layers.squeeze(k, [0])
+    v = layers.squeeze(v, [0])
+    scores = layers.matmul(q, k, transpose_y=True, alpha=1.0 / np.sqrt(hd))
+    if attn_bias is not None:
+        scores = scores + attn_bias
+    probs = layers.softmax(scores)
+    probs = layers.dropout(probs, cfg.attention_probs_dropout_prob,
+                           is_test=is_test,
+                           dropout_implementation="upscale_in_train")
+    ctx = layers.matmul(probs, v)                     # [B,n,S,hd]
+    ctx = layers.transpose(ctx, [0, 2, 1, 3])
+    ctx = layers.reshape(ctx, [0, 0, h])
+    return _dense(ctx, h, f"{name}_out", cfg, tp_spec=("mp", None))
+
+
+def _encoder_layer(x, attn_bias, cfg: BertConfig, name: str, is_test=False):
+    attn = _attention(x, attn_bias, cfg, f"{name}_attn", is_test)
+    attn = layers.dropout(attn, cfg.hidden_dropout_prob, is_test=is_test,
+                          dropout_implementation="upscale_in_train")
+    x = layers.layer_norm(x + attn, begin_norm_axis=2,
+                          param_attr=ParamAttr(name=f"{name}_ln1_scale"),
+                          bias_attr=ParamAttr(name=f"{name}_ln1_bias"))
+    ffn = _dense(x, cfg.intermediate_size, f"{name}_ffn1", cfg,
+                 act=cfg.hidden_act, tp_spec=(None, "mp"))
+    ffn = _dense(ffn, cfg.hidden_size, f"{name}_ffn2", cfg,
+                 tp_spec=("mp", None))
+    ffn = layers.dropout(ffn, cfg.hidden_dropout_prob, is_test=is_test,
+                         dropout_implementation="upscale_in_train")
+    return layers.layer_norm(x + ffn, begin_norm_axis=2,
+                             param_attr=ParamAttr(name=f"{name}_ln2_scale"),
+                             bias_attr=ParamAttr(name=f"{name}_ln2_bias"))
+
+
+def bert_encoder(src_ids, sent_ids, pos_ids, input_mask, cfg: BertConfig,
+                 is_test=False):
+    """Token+segment+position embeddings → N transformer layers.
+    Returns sequence output [B,S,H]."""
+    emb = layers.embedding(src_ids, [cfg.vocab_size, cfg.hidden_size],
+                           param_attr=_param("word_embedding", cfg),
+                           dtype=cfg.dtype)
+    semb = layers.embedding(sent_ids, [cfg.type_vocab_size, cfg.hidden_size],
+                            param_attr=_param("sent_embedding", cfg),
+                            dtype=cfg.dtype)
+    pemb = layers.embedding(pos_ids, [cfg.max_position_embeddings,
+                                      cfg.hidden_size],
+                            param_attr=_param("pos_embedding", cfg),
+                            dtype=cfg.dtype)
+    x = emb + semb + pemb
+    x = layers.layer_norm(x, begin_norm_axis=2,
+                          param_attr=ParamAttr(name="emb_ln_scale"),
+                          bias_attr=ParamAttr(name="emb_ln_bias"))
+    x = layers.dropout(x, cfg.hidden_dropout_prob, is_test=is_test,
+                       dropout_implementation="upscale_in_train")
+    # additive attention bias from the [B,S] 0/1 mask → [B,1,1,S]
+    mask = layers.unsqueeze(input_mask, [1, 2])
+    attn_bias = layers.scale(mask, scale=-10000.0, bias=1.0,
+                             bias_after_scale=False)
+    attn_bias.stop_gradient = True
+    for i in range(cfg.num_hidden_layers):
+        x = _encoder_layer(x, attn_bias, cfg, f"layer_{i}", is_test)
+    return x
+
+
+def build_pretraining_program(cfg: BertConfig, seq_len: int = 128,
+                              batch_size: int = -1, optimizer_name="adamw",
+                              lr: float = 1e-4, is_test=False,
+                              with_optimizer=True):
+    """MLM + NSP pretraining step (the reference-era BERT/ERNIE recipe).
+
+    Feeds: src_ids, sent_ids, pos_ids, input_mask [B,S];
+           mask_labels [B,S] int64 (-0 where unmasked), mask_pos_weight [B,S]
+           float 1.0 at masked positions; nsp_labels [B,1].
+    Fetches: loss (total), lm_loss, nsp_loss.
+    """
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        B, S = batch_size, seq_len
+        src_ids = layers.static_data("src_ids", [B, S], "int64")
+        sent_ids = layers.static_data("sent_ids", [B, S], "int64")
+        pos_ids = layers.static_data("pos_ids", [B, S], "int64")
+        input_mask = layers.static_data("input_mask", [B, S], "float32")
+        mask_labels = layers.static_data("mask_labels", [B, S], "int64")
+        mask_weight = layers.static_data("mask_weight", [B, S], "float32")
+        nsp_labels = layers.static_data("nsp_labels", [B, 1], "int64")
+
+        seq_out = bert_encoder(src_ids, sent_ids, pos_ids, input_mask, cfg,
+                               is_test=is_test)
+
+        # MLM head: transform + tied decoder over the word embedding
+        trans = _dense(seq_out, cfg.hidden_size, "mlm_trans", cfg,
+                       act=cfg.hidden_act)
+        trans = layers.layer_norm(trans, begin_norm_axis=2,
+                                  param_attr=ParamAttr(name="mlm_ln_scale"),
+                                  bias_attr=ParamAttr(name="mlm_ln_bias"))
+        word_emb = main.global_block().var("word_embedding")
+        lm_logits = layers.matmul(trans, word_emb, transpose_y=True)
+        lm_bias = layers.create_parameter([cfg.vocab_size], cfg.dtype,
+                                          attr=ParamAttr(name="mlm_out_bias"),
+                                          is_bias=True)
+        lm_logits = layers.elementwise_add(lm_logits, lm_bias, axis=-1)
+        lm_loss_all = layers.softmax_with_cross_entropy(
+            lm_logits, layers.unsqueeze(mask_labels, [2]))
+        lm_loss_all = layers.squeeze(lm_loss_all, [2])
+        denom = layers.reduce_sum(mask_weight) + 1e-5
+        lm_loss = layers.reduce_sum(lm_loss_all * mask_weight) / denom
+
+        # NSP head on pooled [CLS]
+        first_tok = layers.slice(seq_out, [1], [0], [1])
+        pooled = _dense(first_tok, cfg.hidden_size, "pooler", cfg, act="tanh")
+        pooled = layers.reshape(pooled, [0, cfg.hidden_size])
+        nsp_logits = layers.fc(pooled, 2, param_attr=_param("nsp_w", cfg),
+                               bias_attr=ParamAttr(name="nsp_b"))
+        nsp_loss = layers.mean(
+            layers.softmax_with_cross_entropy(nsp_logits, nsp_labels))
+
+        loss = lm_loss + nsp_loss
+        if with_optimizer:
+            from .. import optimizer as opt_mod
+
+            if optimizer_name == "adamw":
+                opt = opt_mod.AdamWOptimizer(lr, weight_decay=0.01)
+            elif optimizer_name == "lamb":
+                opt = opt_mod.LambOptimizer(lr)
+            else:
+                opt = opt_mod.AdamOptimizer(lr)
+            opt.minimize(loss)
+
+    feeds = dict(src_ids=src_ids, sent_ids=sent_ids, pos_ids=pos_ids,
+                 input_mask=input_mask, mask_labels=mask_labels,
+                 mask_weight=mask_weight, nsp_labels=nsp_labels)
+    fetches = dict(loss=loss, lm_loss=lm_loss, nsp_loss=nsp_loss)
+    return main, startup, feeds, fetches
+
+
+def synthetic_pretraining_batch(cfg: BertConfig, batch_size: int, seq_len: int,
+                                seed: int = 0):
+    rng = np.random.RandomState(seed)
+    src = rng.randint(0, cfg.vocab_size, (batch_size, seq_len)).astype(np.int64)
+    sent = rng.randint(0, cfg.type_vocab_size,
+                       (batch_size, seq_len)).astype(np.int64)
+    pos = np.tile(np.arange(seq_len, dtype=np.int64), (batch_size, 1))
+    mask = np.ones((batch_size, seq_len), np.float32)
+    labels = rng.randint(0, cfg.vocab_size, (batch_size, seq_len)).astype(np.int64)
+    weight = (rng.rand(batch_size, seq_len) < 0.15).astype(np.float32)
+    nsp = rng.randint(0, 2, (batch_size, 1)).astype(np.int64)
+    return dict(src_ids=src, sent_ids=sent, pos_ids=pos, input_mask=mask,
+                mask_labels=labels, mask_weight=weight, nsp_labels=nsp)

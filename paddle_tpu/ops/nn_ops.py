@@ -216,7 +216,7 @@ def group_norm(ins, attrs):
     return {"Y": y, "Mean": mean.reshape((n, g)), "Variance": var.reshape((n, g))}
 
 
-@register_op("dropout", skip_infer_shape=True)
+@register_op("dropout")
 def dropout(ins, attrs):
     """reference: operators/dropout_op.cc. Seed assigned at build; runtime
     folds the global step so masks differ per run but stay reproducible."""

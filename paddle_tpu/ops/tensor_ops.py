@@ -36,7 +36,7 @@ def _shape_of(attrs, ins):
     return tuple(int(d) for d in shape)
 
 
-@register_op("fill_constant", skip_infer_shape=True)
+@register_op("fill_constant")
 def fill_constant(ins, attrs):
     import jax.numpy as jnp
 
@@ -45,7 +45,7 @@ def fill_constant(ins, attrs):
     return {"Out": jnp.full(shape, attrs.get("value", 0.0), dtype=dtype)}
 
 
-@register_op("assign_value", skip_infer_shape=True)
+@register_op("assign_value")
 def assign_value(ins, attrs):
     import jax.numpy as jnp
 
@@ -54,7 +54,7 @@ def assign_value(ins, attrs):
     return {"Out": jnp.asarray(vals)}
 
 
-@register_op("uniform_random", skip_infer_shape=True)
+@register_op("uniform_random")
 def uniform_random(ins, attrs):
     import jax
 
@@ -66,7 +66,7 @@ def uniform_random(ins, attrs):
                                       minval=lo, maxval=hi)}
 
 
-@register_op("gaussian_random", skip_infer_shape=True)
+@register_op("gaussian_random")
 def gaussian_random(ins, attrs):
     import jax
 
@@ -78,7 +78,7 @@ def gaussian_random(ins, attrs):
     return {"Out": x * std + mean}
 
 
-@register_op("truncated_gaussian_random", skip_infer_shape=True)
+@register_op("truncated_gaussian_random")
 def truncated_gaussian_random(ins, attrs):
     import jax
 
@@ -91,7 +91,7 @@ def truncated_gaussian_random(ins, attrs):
     return {"Out": x * std + mean}
 
 
-@register_op("randint", skip_infer_shape=True)
+@register_op("randint")
 def randint(ins, attrs):
     import jax
 
@@ -382,7 +382,7 @@ def fill_zeros_like(ins, attrs):
     return {"Out": jnp.zeros_like(ins["X"][0])}
 
 
-@register_op("fill_any_like", skip_infer_shape=True)
+@register_op("fill_any_like")
 def fill_any_like(ins, attrs):
     import jax.numpy as jnp
 

@@ -1,0 +1,12 @@
+"""Parallelism: mesh, sharding annotations, collectives, fleet.
+
+Replaces the reference's multi-stack distributed runtime (NCCL comm registry
+platform/collective_helper.h, SSA-graph replication
+ir/multi_devices_graph_pass/, gRPC/BRPC PS operators/distributed/) with the
+TPU-native model: ONE program + jax.sharding over a Mesh; XLA emits ICI/DCN
+collectives (scaling-book recipe: pick a mesh, annotate shardings, let XLA
+insert collectives).
+"""
+
+from .mesh import create_mesh, get_mesh, set_mesh, mesh_axis_size  # noqa: F401
+from .api import shard_tensor, shard_parameter, PartitionSpec  # noqa: F401

@@ -1,0 +1,65 @@
+"""Device mesh management.
+
+TPU-native replacement for the reference's communicator registries
+(platform/collective_helper.h:62 NCCLCommContext keyed by ring_id;
+nccl_helper.h:92 flat / :265 hierarchical context maps): one global
+`jax.sharding.Mesh` whose named axes (dp/mp/pp/sp/…) subsume ring ids.
+Hierarchical allreduce (intra/inter node) falls out of multi-axis meshes:
+ICI axes inside a slice, DCN axes across slices.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Union
+
+import numpy as np
+
+_current_mesh = None
+
+
+def create_mesh(axes: Union[Dict[str, int], Sequence[int]],
+                axis_names: Optional[Sequence[str]] = None,
+                devices=None):
+    """Build a Mesh from {axis: size} (row-major over devices).
+
+    create_mesh({"dp": 2, "mp": 4}) on 8 chips → 2×4 mesh. Sizes of -1 are
+    inferred. The result is also installed as the process-global mesh.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    devices = np.array(devices)
+    if isinstance(axes, dict):
+        axis_names = tuple(axes.keys())
+        sizes = list(axes.values())
+    else:
+        sizes = list(axes)
+        axis_names = tuple(axis_names or [f"axis{i}" for i in range(len(sizes))])
+    n = len(devices)
+    if any(s == -1 for s in sizes):
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes = [n // known if s == -1 else s for s in sizes]
+    total = int(np.prod(sizes))
+    if total != n:
+        devices = devices[:total]
+    mesh = Mesh(devices.reshape(sizes), axis_names)
+    set_mesh(mesh)
+    return mesh
+
+
+def set_mesh(mesh):
+    global _current_mesh
+    _current_mesh = mesh
+    return mesh
+
+
+def get_mesh():
+    return _current_mesh
+
+
+def mesh_axis_size(axis: str) -> int:
+    if _current_mesh is None or axis not in _current_mesh.shape:
+        return 1
+    return _current_mesh.shape[axis]
