@@ -125,6 +125,22 @@ def _analyze_block(block: Block) -> Tuple[List[str], List[str]]:
     return ext_reads, writes
 
 
+def _collect_collective_ops(ops, _seen=None) -> List[OpDesc]:
+    """Collective ops in an op list, recursing into sub-blocks
+    (block_call / conditional_block / while hold blocks in attrs)."""
+    out: List[OpDesc] = []
+    _seen = _seen if _seen is not None else set()
+    for op in ops:
+        opdef = registry.lookup(op.type)
+        if opdef is not None and opdef.is_collective:
+            out.append(op)
+        sub = op.attrs.get("sub_block") if op.attrs else None
+        if sub is not None and id(sub) not in _seen:
+            _seen.add(id(sub))
+            out.extend(_collect_collective_ops(sub.ops, _seen))
+    return out
+
+
 class _CompiledEntry:
     __slots__ = ("jitted", "state_names", "ro_names", "fetch_names", "has_state_out")
 
@@ -197,6 +213,12 @@ class Executor:
 
     # -- interpreting path ---------------------------------------------------
     def _run_interpreted(self, program, block, feed, fetch_names, scope):
+        needed = max([int(op.attr("nranks", 1) or 1)
+                      for op in _collect_collective_ops(block.ops)], default=1)
+        if needed > 1:
+            raise ExecutionError(
+                f"program expects {needed}-rank collectives; the interpreting "
+                f"executor is single-rank — use the compiled path with a mesh")
         env: Dict[str, Any] = {}
         for name, val in scope.items():
             env[name] = val
@@ -284,6 +306,20 @@ class Executor:
 
         fetch_tuple = tuple(fetch_names)
 
+        # collective-executor mode: programs containing explicit collective
+        # ops (c_allreduce_*, …) run inside shard_map so lax.psum-family
+        # lowerings have bound axis names (the NCCL-ring equivalent).
+        coll_ops = _collect_collective_ops(block.ops)
+        needed_ranks = max([int(op.attr("nranks", 1) or 1)
+                            for op in coll_ops], default=1)
+        if mesh is None and needed_ranks > 1:
+            raise ExecutionError(
+                f"program contains collective ops expecting {needed_ranks} "
+                f"ranks but no device mesh is active — call "
+                f"paddle_tpu.parallel.create_mesh({{'dp': {needed_ranks}}}) "
+                f"(or pass mesh=) before running")
+        use_spmd = mesh is not None and bool(coll_ops)
+
         def fn(state, ro, feed, step):
             env: Dict[str, Any] = {}
             env.update(ro)
@@ -294,12 +330,27 @@ class Executor:
             for n in fetch_tuple:
                 if n not in env:
                     raise ExecutionError(f"fetch target '{n}' was not produced")
-                fetches.append(env[n])
+                val = env[n]
+                if use_spmd and "dp" in mesh.shape:
+                    # scalars (losses/metrics) → global mean; non-scalars
+                    # (batch-sharded logits/preds) → dp-concatenated batch
+                    import jax
+                    import jax.numpy as jnp
+
+                    if jnp.ndim(val) == 0 or jnp.shape(val) in ((), (1,)):
+                        if jnp.issubdtype(jnp.result_type(val), jnp.inexact):
+                            val = jax.lax.pmean(val, "dp")
+                    else:
+                        val = jax.lax.all_gather(val, "dp", tiled=True)
+                fetches.append(val)
             new_state = {n: env[n] for n in state_names}
             return tuple(fetches), new_state, step + 1
 
         jit_kwargs: Dict[str, Any] = {"donate_argnums": (0,)}
-        if mesh is not None:
+        if use_spmd:
+            fn = self._wrap_shard_map(fn, block, mesh, state_names, ro_names,
+                                      feed_names, dp_ok, in_shardings)
+        elif mesh is not None:
             # Shardings from VarDesc annotations (parallel/api.py): params use
             # their spec (default replicated), feeds default to batch-over-dp.
             from ..parallel.api import named_sharding_for
@@ -326,6 +377,45 @@ class Executor:
         jitted = jax.jit(fn, **jit_kwargs)
         return _CompiledEntry(jitted, state_names, ro_names, fetch_tuple,
                               bool(state_names))
+
+    @staticmethod
+    def _wrap_shard_map(fn, block, mesh, state_names, ro_names, feed_names,
+                        dp_ok, in_shardings=None):
+        """Wrap the step in shard_map: params use their annotated specs
+        (default replicated), feeds shard batch over dp when divisible.
+        CompiledProgram feed shardings (in_shardings) take precedence."""
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.api import clean_spec, get_shard_map, get_sharding_spec
+
+        def var_spec(name, default=None):
+            spec = None
+            if block.has_var(name):
+                spec = get_sharding_spec(block.var(name))
+            if spec is None:
+                spec = default
+            if spec is None:
+                return P()
+            return P(*clean_spec(spec, mesh))
+
+        state_spec = {n: var_spec(n) for n in state_names}
+        ro_spec = {n: var_spec(n) for n in ro_names}
+        feed_spec = {}
+        for n in feed_names:
+            if in_shardings is not None and n in in_shardings:
+                feed_spec[n] = in_shardings[n].spec
+                continue
+            default = ("dp",) if (dp_ok or {}).get(n) and "dp" in mesh.shape \
+                else None
+            feed_spec[n] = var_spec(n, default)
+        in_specs = (state_spec, ro_spec, feed_spec, P())
+        # fetches are pmean'd/all_gathered inside fn → replicated;
+        # state stays on its spec
+        out_specs = (P(), state_spec, P())
+
+        shard_map, kwargs = get_shard_map()
+        return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, **kwargs)
 
 
 # convenience singletons ------------------------------------------------------

@@ -1,0 +1,120 @@
+"""Eager collective user API on jax Arrays.
+
+Capability mirror of python/paddle/distributed/collective.py (broadcast:59,
+all_reduce:116, reduce:191, all_gather:274, scatter:347, barrier:419 — NCCL
+ops under dygraph). Here the collectives run over the current mesh's 'dp'
+axis via a tiny shard_map'd function per call; on a single device they are
+identities (ring of size 1).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+
+
+def _mesh_axis(group=None):
+    from ..parallel.mesh import get_mesh
+
+    mesh = get_mesh()
+    if mesh is None or "dp" not in mesh.shape or mesh.shape["dp"] <= 1:
+        return None, None
+    return mesh, "dp"
+
+
+def _spmd(fn, mesh, axis, x, in_spec=None, out_spec=None):
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.api import get_shard_map
+
+    shard_map, kwargs = get_shard_map()
+    return shard_map(fn, mesh=mesh, in_specs=in_spec or P(),
+                     out_specs=out_spec or P(), **kwargs)(x)
+
+
+def all_reduce(tensor, op: str = ReduceOp.SUM, group=None):
+    import jax
+    import jax.numpy as jnp
+
+    mesh, axis = _mesh_axis(group)
+    if mesh is None:
+        return jnp.asarray(tensor)
+    red = {"sum": jax.lax.psum, "max": jax.lax.pmax, "min": jax.lax.pmin,
+           "prod": lambda x, ax: jnp.prod(jax.lax.all_gather(x, ax), axis=0),
+           }[op]
+    return _spmd(lambda x: red(x, axis), mesh, axis, jnp.asarray(tensor))
+
+
+def broadcast(tensor, src: int = 0, group=None):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    mesh, axis = _mesh_axis(group)
+    if mesh is None:
+        return jnp.asarray(tensor)
+    return _spmd(lambda x: jax.lax.all_gather(x, axis)[src], mesh, axis,
+                 jnp.asarray(tensor))
+
+
+def reduce(tensor, dst: int = 0, op: str = ReduceOp.SUM, group=None):
+    return all_reduce(tensor, op, group)
+
+
+def all_gather(tensor_list: Optional[List], tensor, group=None):
+    """Returns the gathered [world, ...] array; also extends tensor_list for
+    fluid API parity."""
+    import jax
+    import jax.numpy as jnp
+
+    mesh, axis = _mesh_axis(group)
+    if mesh is None:
+        out = jnp.asarray(tensor)[None]
+    else:
+        out = _spmd(lambda x: jax.lax.all_gather(x, axis), mesh, axis,
+                    jnp.asarray(tensor))
+    if tensor_list is not None:
+        tensor_list.extend(list(out))
+    return out
+
+
+def scatter(tensor, tensor_list=None, src: int = 0, group=None):
+    import jax.numpy as jnp
+
+    mesh, axis = _mesh_axis(group)
+    if tensor_list is not None:
+        stacked = jnp.stack([jnp.asarray(t) for t in tensor_list])
+        if mesh is None:
+            return stacked[0]
+        import jax
+
+        def body(x):
+            return x[jax.lax.axis_index(axis)]
+
+        return _spmd(body, mesh, axis, stacked)
+    return jnp.asarray(tensor)
+
+
+def barrier(group=None):
+    """XLA programs are globally ordered; nothing to do single-controller."""
+    return None
+
+
+def get_rank() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def get_world_size() -> int:
+    import jax
+
+    return jax.process_count()

@@ -1,0 +1,14 @@
+"""Fleet v2 orchestration (reference: python/paddle/distributed/fleet/).
+
+fleet.init → role maker (env parse / jax.distributed init);
+fleet.distributed_optimizer(opt, strategy) → meta-optimizer chain;
+minimize() rewrites the Program per strategy then applies the inner
+optimizer (reference: fleet_base.py:125,544,920 + strategy_compiler.py:112).
+"""
+
+from .distributed_strategy import DistributedStrategy  # noqa: F401
+from .fleet_base import (Fleet, fleet, init, distributed_optimizer,  # noqa: F401
+                         worker_num, worker_index, is_first_worker,
+                         barrier_worker)
+from .role_maker import PaddleCloudRoleMaker, UserDefinedRoleMaker  # noqa: F401
+from . import meta_optimizers  # noqa: F401

@@ -1,0 +1,139 @@
+"""Fleet singleton (reference: fleet/base/fleet_base.py:125 init,
+:544 distributed_optimizer, :920 minimize + strategy_compiler.py chain)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .distributed_strategy import DistributedStrategy
+from .meta_optimizers import (AMPOptimizer, GradientMergeOptimizer,
+                              RecomputeOptimizer, insert_grad_allreduce,
+                              maybe_swap_large_batch_optimizer)
+from .role_maker import PaddleCloudRoleMaker, RoleMakerBase
+
+
+class Fleet:
+    def __init__(self):
+        self._role_maker: Optional[RoleMakerBase] = None
+        self._is_collective = True
+        self._strategy: Optional[DistributedStrategy] = None
+
+    # -- lifecycle -----------------------------------------------------------
+    def init(self, role_maker: Optional[RoleMakerBase] = None,
+             is_collective: bool = True,
+             strategy: Optional[DistributedStrategy] = None):
+        from ..parallel import init_parallel_env
+
+        self._is_collective = is_collective
+        self._role_maker = role_maker or PaddleCloudRoleMaker(is_collective)
+        self._strategy = strategy
+        init_parallel_env()
+        return self
+
+    def _assert_init(self):
+        if self._role_maker is None:
+            self.init()
+
+    # -- topology ------------------------------------------------------------
+    def worker_num(self) -> int:
+        self._assert_init()
+        n = self._role_maker.worker_num()
+        if n > 1:
+            return n
+        # single-process SPMD: dp axis of the active mesh is the worker count
+        from ...parallel.mesh import get_mesh
+
+        mesh = get_mesh()
+        if mesh is not None and "dp" in mesh.shape:
+            return mesh.shape["dp"]
+        return n
+
+    def worker_index(self) -> int:
+        self._assert_init()
+        return self._role_maker.worker_index()
+
+    def is_first_worker(self) -> bool:
+        self._assert_init()
+        return self._role_maker.is_first_worker()
+
+    def is_worker(self) -> bool:
+        self._assert_init()
+        return self._role_maker.is_worker()
+
+    def is_server(self) -> bool:
+        self._assert_init()
+        return self._role_maker.is_server()
+
+    def barrier_worker(self):
+        from ..collective import barrier
+
+        barrier()
+
+    # -- optimizer -----------------------------------------------------------
+    def distributed_optimizer(self, optimizer,
+                              strategy: Optional[DistributedStrategy] = None):
+        self._assert_init()
+        self._strategy = strategy or self._strategy or DistributedStrategy()
+        return DistributedOptimizer(self, optimizer, self._strategy)
+
+
+class DistributedOptimizer:
+    """Applies the meta-optimizer chain then the DP transpile
+    (reference order, strategy_compiler.py: recompute → amp → … →
+    graph_execution last)."""
+
+    def __init__(self, fleet_obj: Fleet, inner, strategy: DistributedStrategy):
+        self.fleet = fleet_obj
+        self.strategy = strategy
+        inner = maybe_swap_large_batch_optimizer(inner, strategy)
+        if strategy.recompute:
+            inner = RecomputeOptimizer(
+                inner, strategy.recompute_configs.get("checkpoints", []))
+        if strategy.amp:
+            inner = AMPOptimizer(inner, strategy.amp_configs)
+        if strategy.gradient_merge:
+            inner = GradientMergeOptimizer(
+                inner, strategy.gradient_merge_configs.get("k_steps", 1),
+                strategy.gradient_merge_configs.get("avg", True))
+        self.inner = inner
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.inner.backward(
+            loss, startup_program=startup_program,
+            parameter_list=parameter_list, no_grad_set=no_grad_set)
+        # DP allreduce before the update ops (graph_execution equivalent)
+        insert_grad_allreduce(loss.block.program, params_grads,
+                              self.fleet.worker_num())
+        ops = self.inner.apply_gradients(params_grads)
+        return ops, params_grads
+
+    def __getattr__(self, item):
+        return getattr(self.inner, item)
+
+
+fleet = Fleet()
+
+
+def init(role_maker=None, is_collective: bool = True, strategy=None):
+    return fleet.init(role_maker, is_collective, strategy)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return fleet.distributed_optimizer(optimizer, strategy)
+
+
+def worker_num() -> int:
+    return fleet.worker_num()
+
+
+def worker_index() -> int:
+    return fleet.worker_index()
+
+
+def is_first_worker() -> bool:
+    return fleet.is_first_worker()
+
+
+def barrier_worker():
+    return fleet.barrier_worker()

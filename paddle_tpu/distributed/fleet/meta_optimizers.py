@@ -1,0 +1,388 @@
+"""Meta-optimizers — strategy-driven Program rewrites.
+
+Capability mirror of python/paddle/distributed/fleet/meta_optimizers/
+(amp_optimizer.py, recompute_optimizer.py, gradient_merge_optimizer.py,
+graph_execution_optimizer.py, lars_optimizer.py, lamb_optimizer.py,
+localsgd_optimizer.py, dgc_optimizer.py) + transpiler/collective.py:178
+GradAllReduce. Each wraps an inner Optimizer and rewrites the Program:
+
+* AMP        → bf16 cast insertion on MXU ops (+ optional loss-scaling ops
+               for API parity; bf16 on TPU needs no scaling)
+* Recompute  → forward segments become remat'd block_call ops
+               (jax.checkpoint at lowering — real memory savings, unlike the
+               reference's grad-time subgraph re-emission, backward.py:689)
+* GradientMerge → grad accumulators + conditional_block'd update every k steps
+* DP         → scale(1/n) + c_allreduce_sum on every grad (runs under
+               shard_map; XLA emits the ICI allreduce)
+* LARS/LAMB  → swap the inner optimizer for the large-batch variant
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...core import unique_name
+from ...core.backward import GRAD_SUFFIX
+from ...core.ir import Block, OpDesc, OpRole, Program, default_main_program
+from ...layers import nn as L
+
+
+# ---------------------------------------------------------------------------
+# DP: gradient allreduce transpile
+# ---------------------------------------------------------------------------
+
+def insert_grad_allreduce(program: Program, params_grads, nranks: int,
+                          axis_name: str = "dp"):
+    """Append scale(1/n) + c_allreduce_sum for each grad
+    (reference: transpiler/collective.py GradAllReduce.transpile:178 —
+    there via inserted ops after each grad op; op order inside one XLA
+    program is dataflow, so appending is equivalent)."""
+    if nranks <= 1:
+        return
+    block = program.global_block()
+    with program._role_guard(OpRole.Backward):
+        for p, g in params_grads:
+            block.append_op("scale", {"X": [g]}, {"Out": [g]},
+                            {"scale": 1.0 / nranks,
+                             "op_role_var": [p.name, g.name]})
+            block.append_op("c_allreduce_sum", {"X": [g]}, {"Out": [g]},
+                            {"axis_name": axis_name, "ring_id": 0,
+                             "nranks": nranks,
+                             "op_role_var": [p.name, g.name]})
+
+
+# ---------------------------------------------------------------------------
+# AMP: bf16 rewrite + loss scaling
+# ---------------------------------------------------------------------------
+
+AMP_WHITE_LIST = {"matmul", "matmul_v2", "mul", "conv2d", "depthwise_conv2d",
+                  "bmm"}
+AMP_BLACK_LIST = {"softmax_with_cross_entropy", "cross_entropy", "layer_norm",
+                  "batch_norm", "mean", "reduce_mean", "softmax", "exp", "log"}
+
+
+def rewrite_program_bf16(program: Program, white_list=None, black_list=None):
+    """Insert bf16 casts on white-list op inputs (reference:
+    contrib/mixed_precision/fp16_utils.py cast insertion). Outputs stay bf16
+    and re-promote naturally; params remain fp32 masters so grads/optimizer
+    math stay fp32."""
+    import jax.numpy as jnp
+
+    white = set(white_list or AMP_WHITE_LIST)
+    block = program.global_block()
+    new_ops: List[OpDesc] = []
+    cast_cache: Dict[str, str] = {}
+    for op in block.ops:
+        if op.type in white:
+            for slot, names in list(op.inputs.items()):
+                new_names = []
+                for n in names:
+                    var = block._find_var_recursive(n)
+                    if var is None or np.dtype(var.dtype) != np.float32:
+                        new_names.append(n)
+                        continue
+                    cname = cast_cache.get(n)
+                    if cname is None:
+                        cname = f"{n}.cast_bf16"
+                        block.create_var(name=cname, shape=var.shape,
+                                         dtype="bfloat16", stop_gradient=False)
+                        cop = OpDesc("cast", {"X": [n]}, {"Out": [cname]},
+                                     {"out_dtype": "bfloat16",
+                                      "op_role": op.attrs.get("op_role", 0)})
+                        new_ops.append(cop)
+                        cast_cache[n] = cname
+                    new_names.append(cname)
+                op.inputs[slot] = new_names
+        new_ops.append(op)
+    block.ops = new_ops
+    program._bump_version()
+
+
+class AMPOptimizer:
+    """reference: fleet/meta_optimizers/amp_optimizer.py +
+    contrib/mixed_precision/decorator.py OptimizerWithMixedPrecision."""
+
+    def __init__(self, inner, configs: Optional[dict] = None):
+        self.inner = inner
+        self.configs = configs or {}
+        self._loss_scaling_var = None
+
+    def backward(self, loss, **kw):
+        rewrite_program_bf16(loss.block.program,
+                             white_list=(set(AMP_WHITE_LIST)
+                                         | set(self.configs.get(
+                                             "custom_white_list", []))))
+        if self.configs.get("use_dynamic_loss_scaling"):
+            self._loss_scaling_var = L.create_global_var(
+                [1], self.configs.get("init_loss_scaling", 32768.0),
+                "float32", persistable=True,
+                name=unique_name.generate("loss_scaling"))
+            loss = loss * self._loss_scaling_var
+        return self.inner.backward(loss, **kw)
+
+    def apply_gradients(self, params_grads):
+        if self.configs.get("use_dynamic_loss_scaling"):
+            params_grads = append_loss_scaling_ops(
+                params_grads, self._loss_scaling_var)
+        return self.inner.apply_gradients(params_grads)
+
+    def minimize(self, loss, **kw):
+        pg = self.backward(loss, **kw)
+        ops = self.apply_gradients(pg)
+        return ops, pg
+
+    def __getattr__(self, item):
+        return getattr(self.inner, item)
+
+
+def append_loss_scaling_ops(params_grads, scale_var):
+    """check_finite_and_unscale + update_loss_scaling (reference:
+    operators/amp/*). Kept for API parity — bf16 needs no scaling, but fp16
+    flows and the strategy knob still exercise this path."""
+    block = default_main_program().current_block()
+    good = L.create_global_var([1], 0, "int32", persistable=True,
+                               name=unique_name.generate("good_steps"))
+    bad = L.create_global_var([1], 0, "int32", persistable=True,
+                              name=unique_name.generate("bad_steps"))
+    grads = [g for _, g in params_grads]
+    found_inf = block.create_var(
+        name=unique_name.generate("found_inf"), dtype="bool", shape=(1,),
+        stop_gradient=True)
+    block.append_op("check_finite_and_unscale",
+                    {"X": grads, "Scale": [scale_var]},
+                    {"Out": grads, "FoundInfinite": [found_inf]}, {})
+    block.append_op("update_loss_scaling",
+                    {"X": grads, "FoundInfinite": [found_inf],
+                     "PrevLossScaling": [scale_var], "InGoodSteps": [good],
+                     "InBadSteps": [bad]},
+                    {"Out": grads, "LossScaling": [scale_var],
+                     "OutGoodSteps": [good], "OutBadSteps": [bad]},
+                    {"incr_every_n_steps": 1000, "decr_every_n_nan_or_inf": 2,
+                     "incr_ratio": 2.0, "decr_ratio": 0.5})
+    return params_grads
+
+
+# ---------------------------------------------------------------------------
+# Recompute: segment remat
+# ---------------------------------------------------------------------------
+
+def _segment_external_io(ops: List[OpDesc], block: Block,
+                         later_reads: set) -> Tuple[List[str], List[str]]:
+    produced = set()
+    reads: List[str] = []
+    for op in ops:
+        for n in op.input_names():
+            if n not in produced and n not in reads:
+                reads.append(n)
+        produced.update(op.output_names())
+    outs = [n for n in dict.fromkeys(
+        n for op in ops for n in op.output_names())
+        if n in later_reads]
+    return reads, outs
+
+
+class RecomputeOptimizer:
+    """reference: optimizer.py:4547 RecomputeOptimizer /
+    fleet recompute_optimizer.py. Forward ops between user checkpoints are
+    folded into remat'd block_call ops before backward, so the whole segment
+    is recomputed in the backward pass (jax.checkpoint under the hood)."""
+
+    def __init__(self, inner, checkpoints: Optional[List] = None):
+        self.inner = inner
+        self._checkpoints = [c if isinstance(c, str) else c.name
+                             for c in (checkpoints or [])]
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = [c if isinstance(c, str) else c.name
+                             for c in checkpoints]
+
+    def _rewrite(self, program: Program, loss_name: str):
+        block = program.global_block()
+        ckpts = set(self._checkpoints)
+        if not ckpts:
+            return
+        # split forward ops into segments at checkpoint producers
+        segments: List[List[OpDesc]] = [[]]
+        for op in block.ops:
+            segments[-1].append(op)
+            if any(n in ckpts for n in op.output_names()):
+                segments.append([])
+        if not segments[-1]:
+            segments.pop()
+        # later_reads per segment = union of inputs of later segments + loss
+        suffix_reads: List[set] = [set() for _ in segments]
+        acc: set = {loss_name}
+        for i in range(len(segments) - 1, -1, -1):
+            suffix_reads[i] = set(acc)
+            for op in segments[i]:
+                acc.update(op.input_names())
+        new_ops: List[OpDesc] = []
+        for i, seg in enumerate(segments):
+            last = i == len(segments) - 1
+            persist_out = any(
+                block.has_var(n) and block.var(n).persistable
+                for op in seg for n in op.output_names())
+            if last or len(seg) < 2 or persist_out:
+                new_ops.extend(seg)  # tail / trivial / stateful: keep inline
+                continue
+            reads, outs = _segment_external_io(
+                seg, block, suffix_reads[i] | ckpts)
+            sub = Block(program, len(program.blocks), 0)
+            sub.ops = list(seg)
+            program.blocks.append(sub)
+            new_ops.append(OpDesc(
+                "block_call", {"X": reads}, {"Out": outs},
+                {"sub_block": sub, "input_names": reads,
+                 "output_names": outs, "remat": True,
+                 "op_role": OpRole.Forward}))
+        block.ops = new_ops
+        program._bump_version()
+
+    def backward(self, loss, **kw):
+        self._rewrite(loss.block.program, loss.name)
+        return self.inner.backward(loss, **kw)
+
+    def apply_gradients(self, params_grads):
+        return self.inner.apply_gradients(params_grads)
+
+    def minimize(self, loss, **kw):
+        pg = self.backward(loss, **kw)
+        ops = self.apply_gradients(pg)
+        return ops, pg
+
+    def __getattr__(self, item):
+        return getattr(self.inner, item)
+
+
+# ---------------------------------------------------------------------------
+# Gradient merge (accumulation)
+# ---------------------------------------------------------------------------
+
+class GradientMergeOptimizer:
+    """reference: optimizer.py:5025 GradientMergeOptimizer — accumulate k
+    microbatch grads, then run the real update inside a conditional_block."""
+
+    def __init__(self, inner, k_steps: int = 1, avg: bool = True):
+        self.inner = inner
+        self.k_steps = int(k_steps)
+        self.avg = avg
+
+    def backward(self, loss, **kw):
+        return self.inner.backward(loss, **kw)
+
+    def minimize(self, loss, **kw):
+        pg = self.backward(loss, **kw)
+        ops = self.apply_gradients(pg)
+        return ops, pg
+
+    def apply_gradients(self, params_grads):
+        k = self.k_steps
+        if k <= 1:
+            return self.inner.apply_gradients(params_grads)
+        program = default_main_program()
+        block = program.global_block()
+        with program._role_guard(OpRole.Optimize):
+            # accumulate
+            acc_pg = []
+            for p, g in params_grads:
+                acc = L.create_global_var(list(p.shape), 0.0, "float32",
+                                          persistable=True,
+                                          name=f"{p.name}@GradAcc")
+                block.append_op("sum", {"X": [acc, g]}, {"Out": [acc]}, {})
+                acc_pg.append((p, block.var(acc.name)))
+            # step counter + fire condition
+            counter = L.create_global_var([1], 0.0, "float32",
+                                          persistable=True,
+                                          name=unique_name.generate("gm_step"))
+            block.append_op("increment", {"X": [counter]}, {"Out": [counter]},
+                            {"step": 1.0})
+            kvar = L.fill_constant([1], "float32", float(k))
+            rem = block.create_var(name=unique_name.generate("gm_rem"),
+                                   stop_gradient=True)
+            block.append_op("elementwise_mod", {"X": [counter], "Y": [kvar]},
+                            {"Out": [rem]}, {"axis": -1})
+            zero = L.fill_constant([1], "float32", 0.0)
+            fire = block.create_var(name=unique_name.generate("gm_fire"),
+                                    dtype="bool", stop_gradient=True)
+            block.append_op("equal", {"X": [rem], "Y": [zero]},
+                            {"Out": [fire]}, {})
+
+            # build the update sub-block: scale acc, inner update, reset acc
+            sub = program.create_block(parent_idx=0)
+            try:
+                scaled_pg = []
+                for p, acc in acc_pg:
+                    if self.avg:
+                        sub.append_op("scale", {"X": [acc]}, {"Out": [acc]},
+                                      {"scale": 1.0 / k})
+                    scaled_pg.append((p, acc))
+                self.inner.apply_gradients(scaled_pg)
+                for p, acc in acc_pg:
+                    sub.append_op("scale", {"X": [acc]}, {"Out": [acc]},
+                                  {"scale": 0.0})
+            finally:
+                program.rollback()
+
+            reads, _ = _segment_external_io(sub.ops, sub, set())
+            reads = [n for n in dict.fromkeys(reads)]
+            written = list(dict.fromkeys(
+                n for op in sub.ops for n in op.output_names()))
+            # outputs must be carried through the false branch too
+            io_names = list(dict.fromkeys(reads + written))
+            block.append_op(
+                "conditional_block",
+                {"Cond": [fire], "X": io_names},
+                {"Out": written},
+                {"sub_block": sub, "input_names": io_names,
+                 "output_names": written})
+        return []
+
+    def __getattr__(self, item):
+        return getattr(self.inner, item)
+
+
+# ---------------------------------------------------------------------------
+# LARS / LAMB swaps + stubs
+# ---------------------------------------------------------------------------
+
+def maybe_swap_large_batch_optimizer(inner, strategy):
+    """reference: lars_optimizer.py / lamb_optimizer.py meta-optimizers —
+    replace Momentum→LarsMomentum, Adam→Lamb when enabled."""
+    from ... import optimizer as opt
+
+    if strategy.lars and isinstance(inner, opt.MomentumOptimizer) and \
+            not isinstance(inner, opt.LarsMomentumOptimizer):
+        return opt.LarsMomentumOptimizer(
+            inner._learning_rate, momentum=inner._momentum,
+            **strategy.lars_configs)
+    if strategy.lamb and isinstance(inner, opt.AdamOptimizer) and \
+            not isinstance(inner, opt.LambOptimizer):
+        return opt.LambOptimizer(
+            inner._learning_rate,
+            lamb_weight_decay=strategy.lamb_configs.get("lamb_weight_decay",
+                                                        0.01))
+    return inner
+
+
+class LocalSGDOptimizer:
+    """Stub with documented mapping (reference: localsgd_optimizer.py): on
+    TPU, k local steps + periodic psum of params. Not on the north-star
+    path; raises with guidance if enabled."""
+
+    def __init__(self, inner, configs):
+        raise NotImplementedError(
+            "localsgd: run k steps with mesh-local params then "
+            "paddle_tpu.distributed.all_reduce the params; planned")
+
+
+class DGCOptimizer:
+    """Stub (reference: dgc_optimizer.py, operators/dgc_op.cc): top-k grad
+    sparsification makes dense ICI allreduce slower on TPU, not faster —
+    intentionally unsupported; dense allreduce is the recommended path."""
+
+    def __init__(self, inner, configs):
+        raise NotImplementedError(
+            "DGC is a bandwidth workaround for commodity NICs; ICI allreduce "
+            "does not need it. Use plain data parallelism.")

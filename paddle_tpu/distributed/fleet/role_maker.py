@@ -1,0 +1,79 @@
+"""Role makers — who am I in the cluster?
+
+Capability mirror of python/paddle/distributed/fleet/base/role_maker.py
+(PaddleCloudRoleMaker:33 parses PADDLE_* env; Gloo rendezvous :534). The
+TPU-native rendezvous is jax.distributed's coordination service
+(distributed/parallel.py); env var names are kept for launcher parity.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+
+
+class RoleMakerBase:
+    def worker_num(self) -> int:
+        raise NotImplementedError
+
+    def worker_index(self) -> int:
+        raise NotImplementedError
+
+    def is_worker(self) -> bool:
+        return True
+
+    def is_server(self) -> bool:
+        return False
+
+    def is_first_worker(self) -> bool:
+        return self.worker_index() == 0
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    def __init__(self, is_collective: bool = True, **kwargs):
+        self._is_collective = is_collective
+        self._worker_num = int(os.environ.get("PADDLE_TRAINERS_NUM", "0"))
+        self._worker_index = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self._endpoints = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+
+    def _jax_world(self):
+        try:
+            import jax
+
+            return jax.process_count(), jax.process_index()
+        except Exception:
+            return 1, 0
+
+    def worker_num(self) -> int:
+        if self._worker_num:
+            return self._worker_num
+        return self._jax_world()[0]
+
+    def worker_index(self) -> int:
+        if self._worker_num:
+            return self._worker_index
+        return self._jax_world()[1]
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    def __init__(self, current_id: int = 0, worker_num: int = 1, role=Role.WORKER,
+                 **kwargs):
+        self._id = current_id
+        self._n = worker_num
+        self._role = role
+
+    def worker_num(self) -> int:
+        return self._n
+
+    def worker_index(self) -> int:
+        return self._id
+
+    def is_worker(self) -> bool:
+        return self._role == Role.WORKER
+
+    def is_server(self) -> bool:
+        return self._role == Role.SERVER

@@ -1,0 +1,56 @@
+"""Distributed launcher CLI (reference: python/paddle/distributed/launch.py:221
+— spawns one process per GPU with PADDLE_TRAINER_ID/... env).
+
+TPU-native: one process per HOST (each owns all local chips); multi-host
+rendezvous via jax.distributed's coordination service. Usage:
+
+  python -m paddle_tpu.distributed.launch train.py args...            # local
+  python -m paddle_tpu.distributed.launch --nproc 2 train.py ...      # multi-proc (CPU testing)
+  PADDLE_TRAINER_ID=k PADDLE_TRAINERS_NUM=N PADDLE_COORDINATOR_ADDR=host:port \\
+      python -m paddle_tpu.distributed.launch train.py               # pod slice
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import subprocess
+import sys
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    parser.add_argument("--nproc", type=int, default=1,
+                        help="processes to spawn locally (CPU/testing; on "
+                             "TPU hardware keep 1 per host)")
+    parser.add_argument("--coordinator", default="127.0.0.1:12355")
+    parser.add_argument("script")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    if args.nproc <= 1:
+        sys.argv = [args.script] + args.script_args
+        runpy.run_path(args.script, run_name="__main__")
+        return 0
+
+    procs = []
+    for rank in range(args.nproc):
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(args.nproc),
+            "PADDLE_COORDINATOR_ADDR": args.coordinator,
+            "JAX_COORDINATOR_ADDRESS": args.coordinator,
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, args.script] + args.script_args, env=env))
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

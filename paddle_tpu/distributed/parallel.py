@@ -1,0 +1,46 @@
+"""Process-level distributed init.
+
+Capability mirror of python/paddle/distributed/parallel.py:46
+init_parallel_env (reference rendezvous: TCP store + NCCL comm bootstrap,
+imperative/nccl_context.cc). TPU-native: jax.distributed.initialize against
+the coordination service; env vars keep the reference's names
+(PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS).
+"""
+
+from __future__ import annotations
+
+import os
+
+_initialized = False
+
+
+def init_parallel_env() -> bool:
+    """Initialise multi-host JAX if cluster env vars are present; no-op (and
+    returns False) for single-host runs."""
+    global _initialized
+    if _initialized:
+        return True
+    import jax
+
+    coord = os.environ.get("PADDLE_COORDINATOR_ADDR") or \
+        os.environ.get("JAX_COORDINATOR_ADDRESS")
+    nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    pid = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if coord and nprocs > 1:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=nprocs, process_id=pid)
+        _initialized = True
+        return True
+    return False
+
+
+def get_rank() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def get_world_size() -> int:
+    import jax
+
+    return jax.process_count()

@@ -1,0 +1,61 @@
+"""AMP loss-scaling ops (reference: paddle/fluid/operators/amp/
+check_finite_and_unscale_op.{cc,cu}, update_loss_scaling_op.{cc,cu}).
+
+On TPU the bf16 path needs no loss scaling; these ops exist for fp16 flows
+and API/strategy parity, and are pure-functional here (the reference mutates
+grads in place on the compute stream)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.registry import register_op
+
+_NON_DIFF = dict(non_diff_inputs=("X", "Scale", "FoundInfinite",
+                                  "PrevLossScaling", "InGoodSteps",
+                                  "InBadSteps"))
+
+
+@register_op("check_finite_and_unscale", **_NON_DIFF)
+def check_finite_and_unscale(ins, attrs):
+    import jax.numpy as jnp
+
+    scale = ins["Scale"][0]
+    xs = ins["X"]
+    inv = 1.0 / scale
+    found = jnp.zeros((1,), bool)
+    outs = []
+    for x in xs:
+        finite = jnp.all(jnp.isfinite(x))
+        found = found | (~finite)
+        outs.append(x * inv.astype(x.dtype))
+    return {"Out": outs, "FoundInfinite": found}
+
+
+@register_op("update_loss_scaling", **_NON_DIFF)
+def update_loss_scaling(ins, attrs):
+    import jax.numpy as jnp
+
+    xs = ins["X"]
+    found = ins["FoundInfinite"][0].reshape(())
+    scale = ins["PrevLossScaling"][0]
+    good = ins["InGoodSteps"][0]
+    bad = ins["InBadSteps"][0]
+    incr_every = int(attrs.get("incr_every_n_steps", 1000))
+    decr_every = int(attrs.get("decr_every_n_nan_or_inf", 2))
+    incr_ratio = float(attrs.get("incr_ratio", 2.0))
+    decr_ratio = float(attrs.get("decr_ratio", 0.5))
+
+    new_bad = jnp.where(found, bad + 1, jnp.zeros_like(bad))
+    new_good = jnp.where(found, jnp.zeros_like(good), good + 1)
+    do_decr = new_bad >= decr_every
+    do_incr = new_good >= incr_every
+    new_scale = jnp.where(do_decr, scale * decr_ratio,
+                          jnp.where(do_incr, scale * incr_ratio, scale))
+    new_scale = jnp.maximum(new_scale, 1.0)
+    new_bad = jnp.where(do_decr, jnp.zeros_like(bad), new_bad)
+    new_good = jnp.where(do_incr, jnp.zeros_like(good), new_good)
+    # zero grads on overflow so the update is a no-op (reference semantics)
+    outs = [jnp.where(found, jnp.zeros_like(x), x) for x in xs]
+    return {"Out": outs, "LossScaling": new_scale,
+            "OutGoodSteps": new_good, "OutBadSteps": new_bad}

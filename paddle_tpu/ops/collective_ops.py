@@ -1,0 +1,206 @@
+"""Collective op lowerings — XLA cross-replica collectives over ICI.
+
+Capability mirror of paddle/fluid/operators/collective/ (c_allreduce_op.h:124
+ncclAllReduce, c_broadcast_op, c_allgather_op, c_reducescatter_op,
+c_reduce_op, barrier_op, c_comm_init_op.cc, c_gen_nccl_id_op.cc,
+c_sync_calc_stream_op.cc, c_sync_comm_stream_op.cc).
+
+Design: each collective carries a mesh axis name (the reference's ring_id →
+axis name mapping lives in the op attrs). When the op executes inside a
+`shard_map` SPMD region (collective executor mode, executor.py) the lowering
+emits `lax.psum`-family primitives that compile to ICI collectives. Outside
+an SPMD region (single-rank semantics) they are identities — matching the
+reference where a ring of size 1 is a no-op.
+
+Stream-ordering ops (c_sync_*) are identities: XLA's dataflow order subsumes
+the reference's manual compute/comm stream synchronisation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.registry import register_op
+
+
+def _axis_name(attrs) -> str:
+    # ring_id kept for API parity; axis_name wins if present
+    ax = attrs.get("axis_name")
+    if ax:
+        return ax
+    ring = int(attrs.get("ring_id", 0))
+    return {0: "dp", 1: "mp", 2: "pp", 3: "sp"}.get(ring, "dp")
+
+
+def _in_spmd(axis: str) -> bool:
+    """True if `axis` is bound as an SPMD axis name in the current trace."""
+    import jax
+
+    try:
+        jax.lax.axis_index(axis)
+        return True
+    except NameError:
+        return False
+    except Exception:
+        return False
+
+
+def _allreduce(reduce_fn):
+    def lowering(ins, attrs):
+        import jax
+
+        x = ins["X"][0]
+        ax = _axis_name(attrs)
+        if _in_spmd(ax):
+            x = reduce_fn(x, ax)
+        return {"Out": x}
+
+    return lowering
+
+
+def _register_allreduce():
+    import jax.lax as lax
+
+    for name, fn in [("c_allreduce_sum", lax.psum),
+                     ("c_allreduce_max", lax.pmax),
+                     ("c_allreduce_min", lax.pmin),
+                     ("c_allreduce_prod",
+                      lambda x, ax: lax.all_gather(x, ax).prod(axis=0)),
+                     ("allreduce", lax.psum)]:
+        register_op(name, is_collective=True)(_allreduce(fn))
+
+
+_register_allreduce()
+
+
+@register_op("c_broadcast", is_collective=True)
+def c_broadcast(ins, attrs):
+    """Root's value to all ranks (reference: c_broadcast_op)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    ax = _axis_name(attrs)
+    root = int(attrs.get("root", 0))
+    if _in_spmd(ax):
+        full = jax.lax.all_gather(x, ax)
+        x = full[root]
+    return {"Out": x}
+
+
+@register_op("c_allgather", is_collective=True)
+def c_allgather(ins, attrs):
+    """Concatenate shards along dim 0 (reference: c_allgather_op)."""
+    import jax
+
+    x = ins["X"][0]
+    ax = _axis_name(attrs)
+    if _in_spmd(ax):
+        x = jax.lax.all_gather(x, ax, tiled=True)
+    return {"Out": x}
+
+
+@register_op("c_reducescatter", is_collective=True)
+def c_reducescatter(ins, attrs):
+    """Reduce-sum then scatter along dim 0 (reference: c_reducescatter_op)."""
+    import jax
+
+    x = ins["X"][0]
+    ax = _axis_name(attrs)
+    if _in_spmd(ax):
+        x = jax.lax.psum_scatter(x, ax, tiled=True)
+    return {"Out": x}
+
+
+@register_op("c_reduce_sum", is_collective=True)
+def c_reduce_sum(ins, attrs):
+    """Reduce to root; non-roots keep the reduced value too (XLA has no
+    cheaper rooted reduce on ICI; semantics superset of the reference)."""
+    import jax
+
+    x = ins["X"][0]
+    ax = _axis_name(attrs)
+    if _in_spmd(ax):
+        x = jax.lax.psum(x, ax)
+    return {"Out": x}
+
+
+@register_op("c_concat", is_collective=True)
+def c_concat(ins, attrs):
+    import jax
+
+    x = ins["X"][0]
+    ax = _axis_name(attrs)
+    if _in_spmd(ax):
+        x = jax.lax.all_gather(x, ax, axis=x.ndim - 1, tiled=True)
+    return {"Out": x}
+
+
+@register_op("c_split", is_collective=True)
+def c_split(ins, attrs):
+    import jax
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    ax = _axis_name(attrs)
+    if _in_spmd(ax):
+        idx = jax.lax.axis_index(ax)
+        n = jax.lax.axis_size(ax)
+        per = x.shape[-1] // n
+        x = jax.lax.dynamic_slice_in_dim(x, idx * per, per, axis=x.ndim - 1)
+    return {"Out": x}
+
+
+@register_op("c_ppermute", is_collective=True)
+def c_ppermute(ins, attrs):
+    """Ring permute — the sequence-parallel / pipeline building block
+    (no reference equivalent; the reference's peer-to-peer is PS RPC)."""
+    import jax
+
+    x = ins["X"][0]
+    ax = _axis_name(attrs)
+    shift = int(attrs.get("shift", 1))
+    if _in_spmd(ax):
+        n = jax.lax.axis_size(ax)
+        perm = [(i, (i + shift) % n) for i in range(n)]
+        x = jax.lax.ppermute(x, ax, perm)
+    return {"Out": x}
+
+
+@register_op("c_identity", is_collective=True)
+def c_identity(ins, attrs):
+    return {"Out": ins["X"][0]}
+
+
+@register_op("barrier", is_collective=True)
+def barrier(ins, attrs):
+    """XLA programs are globally scheduled; barrier is an identity on the
+    optional token input (reference: collective/barrier_op.cc)."""
+    x = ins.get("X", [None])[0]
+    return {"Out": x if x is not None else np.zeros((1,), np.float32)}
+
+
+# -- comm bootstrap (API parity; mesh construction replaces ncclUniqueId) -----
+
+@register_op("c_comm_init", is_collective=True)
+def c_comm_init(ins, attrs):
+    """Reference boots NCCL comms (c_comm_init_op.cc); here the Mesh already
+    defines the comm domain — no-op kept for program compatibility."""
+    return {}
+
+
+@register_op("c_gen_unique_id", is_collective=True)
+def c_gen_unique_id(ins, attrs):
+    """Reference exchanges ncclUniqueId over TCP (c_gen_nccl_id_op.cc);
+    jax.distributed's coordination service replaces it."""
+    return {}
+
+
+@register_op("c_sync_calc_stream", is_collective=True)
+def c_sync_calc_stream(ins, attrs):
+    return {"Out": ins["X"][0]}
+
+
+@register_op("c_sync_comm_stream", is_collective=True)
+def c_sync_comm_stream(ins, attrs):
+    return {"Out": ins["X"][0]}

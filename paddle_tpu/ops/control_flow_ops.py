@@ -1,0 +1,119 @@
+"""Control-flow ops holding sub-blocks — lowered to lax.cond/while/checkpoint.
+
+Capability mirror of paddle/fluid/operators/controlflow/
+(conditional_block_op.cc, while_op.cc) and the recompute machinery
+(backward.py:689 _append_backward_ops_with_checkpoints_). The reference
+interprets sub-blocks with nested executors; here a sub-block is traced into
+the surrounding XLA computation via lax.cond / lax.while_loop /
+jax.checkpoint — compiler-friendly control flow with static shapes.
+
+`block_call` is the workhorse: it inlines a sub-block as one IR node. With
+attrs["remat"]=True the segment is wrapped in jax.checkpoint, giving
+segment-level activation recomputation (RecomputeOptimizer). Gradients flow
+through via the generic __vjp_grad__ (jax.vjp traces through run_block).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from ..core.registry import register_op
+
+
+def _run_sub_block(blk, env: Dict[str, Any], step=None):
+    from ..core.executor import run_block
+
+    run_block(blk, env, step=step)
+    return env
+
+
+@register_op("block_call", skip_infer_shape=True)
+def block_call(ins, attrs):
+    """Run a sub-block as a function of its inputs; optionally rematerialised.
+
+    inputs:  X: values of attrs["input_names"] (ordered)
+    outputs: Out: values of attrs["output_names"] (ordered)
+    """
+    import jax
+
+    blk = attrs["sub_block"]
+    in_names = list(attrs["input_names"])
+    out_names = list(attrs["output_names"])
+    step = attrs.get("__step__")
+
+    def body(*vals):
+        env = dict(zip(in_names, vals))
+        _run_sub_block(blk, env, step=step)
+        return tuple(env[n] for n in out_names)
+
+    if attrs.get("remat", False):
+        body = jax.checkpoint(body)
+    outs = body(*ins["X"])
+    return {"Out": list(outs)}
+
+
+@register_op("conditional_block", skip_infer_shape=True,
+             non_diff_inputs=("Cond",))
+def conditional_block(ins, attrs):
+    """lax.cond over a sub-block (reference: conditional_block_op.cc).
+    The false branch passes through the current values of the output vars,
+    so every output name must also appear in input_names."""
+    import jax
+
+    blk = attrs["sub_block"]
+    in_names = list(attrs["input_names"])
+    out_names = list(attrs["output_names"])
+    step = attrs.get("__step__")
+    cond = ins["Cond"][0]
+    if cond.ndim > 0:
+        cond = cond.reshape(())
+
+    def true_fn(vals):
+        env = dict(zip(in_names, vals))
+        _run_sub_block(blk, env, step=step)
+        return tuple(env[n] for n in out_names)
+
+    def false_fn(vals):
+        env = dict(zip(in_names, vals))
+        return tuple(env[n] for n in out_names)
+
+    outs = jax.lax.cond(cond, true_fn, false_fn, tuple(ins["X"]))
+    return {"Out": list(outs)}
+
+
+@register_op("while", skip_infer_shape=True, non_diff_inputs=("Condition",))
+def while_op(ins, attrs):
+    """lax.while_loop over a sub-block (reference: while_op.cc). The
+    sub-block must rewrite the condition var each iteration; carried shapes
+    are fixed (XLA requirement — the reference's growing TensorArrays need
+    pre-sized buffers here)."""
+    import jax
+
+    blk = attrs["sub_block"]
+    carry_names = list(attrs["carry_names"])  # includes the condition var
+    cond_name = attrs["cond_name"]
+    step = attrs.get("__step__")
+
+    def cond_fn(vals):
+        env = dict(zip(carry_names, vals))
+        c = env[cond_name]
+        return c.reshape(()) if getattr(c, "ndim", 0) else c
+
+    def body_fn(vals):
+        env = dict(zip(carry_names, vals))
+        _run_sub_block(blk, env, step=step)
+        return tuple(env[n] for n in carry_names)
+
+    outs = jax.lax.while_loop(cond_fn, body_fn, tuple(ins["X"]))
+    return {"Out": list(outs)}
+
+
+@register_op("print", skip_infer_shape=True)
+def print_op(ins, attrs):
+    """Debug print (reference: controlflow/print_op). Uses jax.debug.print
+    so it also fires inside jitted programs."""
+    import jax
+
+    x = ins["X"][0]
+    jax.debug.print(attrs.get("message", "print_op") + ": {x}", x=x)
+    return {"Out": x}

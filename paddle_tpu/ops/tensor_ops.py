@@ -26,6 +26,11 @@ def _rng_key(attrs):
     step = attrs.get("__step__")
     if step is not None:
         key = jax.random.fold_in(key, step)
+    # inside a shard_map SPMD region, decorrelate dropout across dp shards
+    try:
+        key = jax.random.fold_in(key, jax.lax.axis_index("dp"))
+    except Exception:
+        pass
     return key
 
 

@@ -98,7 +98,9 @@ class Optimizer:
                                no_grad_set)
 
     def apply_gradients(self, params_grads) -> List:
-        block = default_main_program().global_block()
+        # current_block so wrapper optimizers (gradient merge) can redirect
+        # the update into a conditional sub-block
+        block = default_main_program().current_block()
         program = block.program
         with program._role_guard(OpRole.Optimize):
             self._create_global_learning_rate()

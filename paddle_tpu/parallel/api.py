@@ -49,6 +49,44 @@ def get_sharding_spec(var):
     return _var_desc(var).attrs.get(SHARDING_ATTR)
 
 
+def clean_spec(spec, mesh):
+    """Drop axes absent from `mesh` from a raw spec tuple (so one program
+    runs on any mesh shape)."""
+    if spec is None:
+        return None
+    clean = []
+    for s in spec:
+        if s is None:
+            clean.append(None)
+        elif isinstance(s, (list, tuple)):
+            kept = tuple(a for a in s if a in mesh.shape)
+            clean.append(kept if kept else None)
+        else:
+            clean.append(s if s in mesh.shape else None)
+    return tuple(clean)
+
+
+def get_shard_map():
+    """shard_map entry point + its replication-check kwarg, across jax
+    versions. Returns (shard_map_fn, {kwarg: False})."""
+    import inspect
+
+    import jax
+
+    try:
+        shard_map = jax.shard_map
+    except AttributeError:
+        from jax.experimental.shard_map import shard_map
+
+    kwargs = {}
+    sig = inspect.signature(shard_map)
+    if "check_vma" in sig.parameters:
+        kwargs["check_vma"] = False
+    elif "check_rep" in sig.parameters:
+        kwargs["check_rep"] = False
+    return shard_map, kwargs
+
+
 def named_sharding_for(var, mesh, default_spec=None):
     """NamedSharding for a var under `mesh` (None → replicated/default).
     Silently drops axes absent from the mesh so one program runs on any
@@ -61,13 +99,4 @@ def named_sharding_for(var, mesh, default_spec=None):
         spec = default_spec
     if spec is None:
         return NamedSharding(mesh, P())
-    clean = []
-    for s in spec:
-        if s is None:
-            clean.append(None)
-        elif isinstance(s, (list, tuple)):
-            kept = tuple(a for a in s if a in mesh.shape)
-            clean.append(kept if kept else None)
-        else:
-            clean.append(s if s in mesh.shape else None)
-    return NamedSharding(mesh, P(*clean))
+    return NamedSharding(mesh, P(*clean_spec(spec, mesh)))

@@ -46,7 +46,9 @@ def append_regularization_ops(params_grads, global_regularizer=None):
         if reg is None or g is None:
             out.append((p, g))
             continue
-        block = p.block
+        # current block, not p.block: under GradientMergeOptimizer the update
+        # lives in a conditional sub-block and regularization must join it
+        block = p.block.program.current_block()
         new_g = reg.append(block, p, g)
         out.append((p, new_g))
     return out
