@@ -1,0 +1,128 @@
+"""Imperative (dygraph) mode.
+
+Capability mirror of python/paddle/fluid/dygraph/ + paddle/fluid/imperative/:
+eager tensors (VarBase), tape autograd (tracer.run_backward ≈ BasicEngine),
+Layer system, guard()/enable_dygraph switches, no_grad, paddle.grad.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+from ..core.ir import _dygraph_tracer_holder, in_dygraph_mode
+from .layers import Layer
+from .tracer import Tracer, get_tracer, grad, trace_fn, trace_op
+from .varbase import ParamBase, VarBase, to_variable
+
+__all__ = [
+    "Layer", "Tracer", "VarBase", "ParamBase", "to_variable", "guard",
+    "enable_dygraph", "disable_dygraph", "enabled", "no_grad", "grad",
+    "trace_op", "trace_fn", "save_dygraph", "load_dygraph",
+]
+
+
+def enabled() -> bool:
+    return in_dygraph_mode()
+
+
+def enable_dygraph(place=None):
+    if _dygraph_tracer_holder[0] is None:
+        _dygraph_tracer_holder[0] = Tracer()
+
+
+def disable_dygraph():
+    _dygraph_tracer_holder[0] = None
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """Enter dygraph mode (reference: dygraph/base.py guard())."""
+    old = _dygraph_tracer_holder[0]
+    _dygraph_tracer_holder[0] = Tracer()
+    try:
+        yield
+    finally:
+        _dygraph_tracer_holder[0] = old
+
+
+class no_grad:
+    """Context manager AND decorator disabling gradient recording
+    (reference: dygraph/base.py no_grad)."""
+
+    def __enter__(self):
+        self._tracer = get_tracer()
+        if self._tracer is not None:
+            self._old = self._tracer.has_grad
+            self._tracer.has_grad = False
+        return self
+
+    def __exit__(self, *exc):
+        if self._tracer is not None:
+            self._tracer.has_grad = self._old
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+def save_dygraph(state_dict, model_path: str):
+    """Persist a state dict (reference: dygraph/checkpoint.py save_dygraph).
+
+    Optimizer state dicts get '.pdopt', parameter dicts '.pdparams' —
+    payload is a single npz next to a tiny JSON manifest."""
+    import json
+    import os
+
+    arrays = {}
+    meta = {}
+    # marker from Optimizer.state_dict(); the '#' key shape survives dict
+    # copies that would drop the subclass marker
+    is_opt = bool(getattr(state_dict, "_is_optimizer_state", False)) or (
+        bool(state_dict) and all("#" in k or k.startswith("LR_")
+                                 for k in state_dict))
+    for k, v in state_dict.items():
+        if isinstance(v, VarBase):
+            arrays[k] = v.numpy()
+        elif hasattr(v, "shape"):
+            arrays[k] = np.asarray(v)
+        else:
+            meta[k] = v
+            is_opt = True  # non-tensor entries only appear in optimizer state
+    suffix = ".pdopt" if is_opt else ".pdparams"
+    path = model_path if model_path.endswith((".pdparams", ".pdopt")) \
+        else model_path + suffix
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    np.savez(path + ".npz", **arrays)
+    with open(path, "w") as f:
+        json.dump({"keys": sorted(arrays), "meta": meta}, f)
+
+
+def load_dygraph(model_path: str):
+    """Load (param_state_dict, opt_state_dict or None)."""
+    import json
+    import os
+
+    params, opt = None, None
+    for suffix in (".pdparams", ".pdopt"):
+        path = model_path if model_path.endswith(suffix) else model_path + suffix
+        if not os.path.exists(path):
+            continue
+        with open(path) as f:
+            manifest = json.load(f)
+        data = np.load(path + ".npz")
+        state = {k: data[k] for k in data.files}
+        state.update(manifest.get("meta", {}))
+        if suffix == ".pdparams":
+            params = state
+        else:
+            opt = state
+    return params, opt

@@ -1,0 +1,292 @@
+"""Layer: the dygraph module base class.
+
+Capability mirror of python/paddle/fluid/dygraph/layers.py (Layer base:
+parameters/sublayers registration via __setattr__, state_dict round-trip,
+train/eval flags, forward hooks). Parameters are eager ParamBase tensors;
+creation runs the same initializer ops as the static startup program, so
+both modes share one init story.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..core import unique_name
+from .varbase import ParamBase, VarBase
+
+
+def _eager_initialize(initializer, shape, dtype) -> np.ndarray:
+    """Run an initializer's op through a throwaway block (shares the op
+    lowerings with the static startup-program path)."""
+    from ..core.executor import run_block
+    from ..core.ir import Program
+
+    prog = Program()
+    blk = prog.global_block()
+    var = blk.create_var(name="__init__", shape=tuple(shape), dtype=dtype)
+    initializer(var, blk)
+    env: Dict[str, Any] = {}
+    run_block(blk, env)
+    return env["__init__"]
+
+
+class Layer:
+    """Dygraph module (reference: dygraph/layers.py Layer)."""
+
+    def __init__(self, name_scope: Optional[str] = None, dtype="float32"):
+        self._full_name = unique_name.generate(
+            name_scope or type(self).__name__.lower())
+        self._dtype = dtype
+        self.training = True
+        self._parameters: "collections.OrderedDict[str, ParamBase]" = \
+            collections.OrderedDict()
+        self._sub_layers: "collections.OrderedDict[str, Layer]" = \
+            collections.OrderedDict()
+        self._buffers: "collections.OrderedDict[str, VarBase]" = \
+            collections.OrderedDict()
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+
+    # -- naming ---------------------------------------------------------------
+    def full_name(self) -> str:
+        return self._full_name
+
+    # -- parameter creation ---------------------------------------------------
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None) -> ParamBase:
+        from .. import initializer as I
+        from ..param_attr import ParamAttr
+
+        dtype = dtype or self._dtype
+        attr = ParamAttr._to_attr(attr)
+        if attr is None:  # attr=False → no parameter (e.g. bias_attr=False)
+            return None
+        init = default_initializer
+        if attr is not None and attr.initializer is not None:
+            init = attr.initializer
+        if init is None:
+            init = (I.Constant(0.0) if is_bias
+                    else I._default_weight_initializer())
+        name = attr.name if (attr is not None and attr.name) else None
+        value = _eager_initialize(init, shape, dtype)
+        p = ParamBase(value, name=name, is_bias=is_bias)
+        if attr is not None:
+            p.regularizer = attr.regularizer
+            if attr.learning_rate is not None:
+                p.optimize_attr["learning_rate"] = attr.learning_rate
+            if attr.trainable is False:
+                p.trainable = False
+                p.stop_gradient = True
+        return p
+
+    # -- registration ---------------------------------------------------------
+    def add_parameter(self, name: str, parameter: Optional[ParamBase]):
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def register_buffer(self, name: str, tensor: Optional[VarBase],
+                        persistable: bool = True):
+        if tensor is not None and not isinstance(tensor, VarBase):
+            tensor = VarBase(tensor)
+        if tensor is not None:
+            tensor.persistable = persistable
+        self._buffers[name] = tensor
+        return tensor
+
+    def __setattr__(self, name: str, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, ParamBase) and params is not None:
+            if layers is not None:
+                layers.pop(name, None)
+            params[name] = value
+        elif isinstance(value, Layer) and layers is not None:
+            if params is not None:
+                params.pop(name, None)
+            layers[name] = value
+        elif buffers is not None and name in buffers:
+            buffers[name] = value if (value is None or isinstance(value, VarBase)) \
+                else VarBase(value)
+        else:
+            # overwriting a registered param/sublayer with a plain value
+            # deregisters it so parameters()/state_dict() stay consistent
+            for store in (params, layers):
+                if store is not None:
+                    store.pop(name, None)
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name: str):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    # -- traversal ------------------------------------------------------------
+    def parameters(self, include_sublayers: bool = True) -> List[ParamBase]:
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix: str = "", include_sublayers: bool = True
+                         ) -> Iterator[Tuple[str, ParamBase]]:
+        seen = set()
+        for name, p in self._parameters.items():
+            if p is not None and id(p) not in seen:
+                seen.add(id(p))
+                yield (prefix + name if not prefix else f"{prefix}.{name}"), p
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                for n, p in layer.named_parameters(sub_prefix, True):
+                    if id(p) not in seen:
+                        seen.add(id(p))
+                        yield n, p
+
+    def sublayers(self, include_self: bool = False) -> List["Layer"]:
+        out = [self] if include_self else []
+        for layer in self._sub_layers.values():
+            if layer is not None:
+                out.extend(layer.sublayers(include_self=True))
+        return out
+
+    def named_sublayers(self, prefix: str = "", include_self: bool = False
+                        ) -> Iterator[Tuple[str, "Layer"]]:
+        if include_self:
+            yield prefix, self
+        for name, layer in self._sub_layers.items():
+            if layer is None:
+                continue
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield from layer.named_sublayers(sub_prefix, include_self=True)
+
+    def buffers(self, include_sublayers: bool = True) -> List[VarBase]:
+        out = [b for b in self._buffers.values() if b is not None]
+        if include_sublayers:
+            for layer in self._sub_layers.values():
+                if layer is not None:
+                    out.extend(layer.buffers(True))
+        return out
+
+    def apply(self, fn):
+        for layer in self.sublayers(include_self=True):
+            fn(layer)
+        return self
+
+    # -- mode -----------------------------------------------------------------
+    def train(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = True
+        return self
+
+    def eval(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = False
+        return self
+
+    # -- state dict -----------------------------------------------------------
+    def state_dict(self, include_sublayers: bool = True,
+                   structured_name_prefix: str = "") -> Dict[str, VarBase]:
+        out: "collections.OrderedDict[str, VarBase]" = collections.OrderedDict()
+        for name, p in self._parameters.items():
+            if p is not None:
+                out[structured_name_prefix + name] = p
+        for name, b in self._buffers.items():
+            if b is not None and b.persistable:
+                out[structured_name_prefix + name] = b
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is not None:
+                    out.update(layer.state_dict(
+                        True, structured_name_prefix + lname + "."))
+        return out
+
+    def set_state_dict(self, state_dict: Dict[str, Any], use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for k, v in state_dict.items():
+            if k not in own:
+                unexpected.append(k)
+                continue
+            target = own[k]
+            arr = v.numpy() if isinstance(v, VarBase) else np.asarray(v)
+            if tuple(arr.shape) != tuple(target.shape):
+                raise ValueError(
+                    f"shape mismatch for '{k}': checkpoint {arr.shape} vs "
+                    f"model {tuple(target.shape)}")
+            import jax.numpy as jnp
+
+            target._array = jnp.asarray(arr, dtype=target._array.dtype)
+        for k in own:
+            if k not in state_dict:
+                missing.append(k)
+        return missing, unexpected
+
+    set_dict = set_state_dict
+    load_dict = set_state_dict
+
+    # -- grads ----------------------------------------------------------------
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_gradient()
+
+    # -- hooks ----------------------------------------------------------------
+    def register_forward_post_hook(self, hook):
+        handle = _HookHandle(self._forward_post_hooks, hook)
+        return handle
+
+    def register_forward_pre_hook(self, hook):
+        handle = _HookHandle(self._forward_pre_hooks, hook)
+        return handle
+
+    # -- call -----------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            res = hook(self, inputs)
+            if res is not None:
+                inputs = res if isinstance(res, tuple) else (res,)
+        out = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            res = hook(self, inputs, out)
+            if res is not None:
+                out = res
+        return out
+
+    def __repr__(self):
+        extra = ", ".join(f"{n}: {type(l).__name__}"
+                          for n, l in self._sub_layers.items())
+        return f"{type(self).__name__}({extra})"
+
+
+class _HookHandle:
+    _counter = [0]
+
+    def __init__(self, store, hook):
+        self._store = store
+        self._id = self._counter[0]
+        self._counter[0] += 1
+        store[self._id] = hook
+
+    def remove(self):
+        self._store.pop(self._id, None)

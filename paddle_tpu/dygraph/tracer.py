@@ -1,0 +1,336 @@
+"""Imperative tracer: eager op execution + tape autograd.
+
+Capability mirror of the reference imperative engine:
+* ``Tracer::TraceOp`` (paddle/fluid/imperative/tracer.cc:50) — run the op now,
+  record a grad node;
+* ``BasicEngine`` (imperative/basic_engine.cc:38,161) — reverse topological
+  walk that executes grad ops and accumulates fan-in.
+
+TPU-native redesign: instead of per-op hand-written grad kernels, TraceOp
+captures a ``jax.vjp`` closure of the op's JAX lowering in the SAME forward
+pass (no recompute), and backward() replays those closures in reverse tape
+order. Gradient accumulation is a dict keyed by tensor identity (the
+reference's GradientAccumulator role, imperative/gradient_accumulator.cc).
+"""
+
+from __future__ import annotations
+
+import itertools
+import weakref
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core import registry
+from ..core.ir import _dygraph_tracer_holder
+from .varbase import VarBase
+
+_node_counter = itertools.count()
+
+
+class TapeNode:
+    """One recorded op on the autograd tape."""
+
+    __slots__ = ("op_type", "vjp_fn", "input_vars", "outputs", "out_structs",
+                 "seq")
+
+    def __init__(self, op_type: str, vjp_fn, input_vars: List[VarBase],
+                 out_structs: Dict[str, list]):
+        self.op_type = op_type
+        self.vjp_fn = vjp_fn
+        self.input_vars = input_vars        # diff inputs, strong refs (graph)
+        self.outputs: List[Tuple[str, int, Any]] = []  # (slot, idx, weakref)
+        self.out_structs = out_structs      # slot -> [(shape, dtype), ...]
+        self.seq = next(_node_counter)
+
+
+class Tracer:
+    """Per-guard tracer state (reference: imperative/tracer.h:45)."""
+
+    def __init__(self):
+        self.has_grad = True
+        self.train_mode = True
+
+    def trace(self, enabled: bool):
+        self.has_grad = enabled
+
+
+def get_tracer() -> Optional[Tracer]:
+    return _dygraph_tracer_holder[0]
+
+
+def _require_tracer() -> Tracer:
+    tr = _dygraph_tracer_holder[0]
+    if tr is None:
+        raise RuntimeError(
+            "not in dygraph mode — wrap the code in "
+            "`with paddle_tpu.dygraph.guard():` or call enable_dygraph()")
+    return tr
+
+
+def _is_inexact(x) -> bool:
+    import jax.numpy as jnp
+
+    return jnp.issubdtype(jnp.result_type(x), jnp.inexact)
+
+
+def _record(node: TapeNode, outs: Dict[str, List[Any]],
+            names: Optional[Dict[str, List[str]]] = None) -> Dict[str, List[VarBase]]:
+    """Wrap lowering outputs in VarBases, linking inexact ones to the node."""
+    out_vars: Dict[str, List[VarBase]] = {}
+    for slot, vals in outs.items():
+        lst = []
+        for i, a in enumerate(vals):
+            name = None
+            if names and slot in names and i < len(names[slot]):
+                name = names[slot][i]
+            vb = VarBase(a, name=name, stop_gradient=node is None
+                         or not _is_inexact(a))
+            if node is not None and _is_inexact(a):
+                vb._grad_node = node
+                node.outputs.append((slot, i, weakref.ref(vb)))
+            lst.append(vb)
+        out_vars[slot] = lst
+    return out_vars
+
+
+def trace_op(op_type: str, inputs: Dict[str, Any],
+             attrs: Optional[Dict[str, Any]] = None,
+             stop_gradient: bool = False) -> Dict[str, List[VarBase]]:
+    """Eagerly execute a registered op; record its vjp on the tape.
+
+    ``inputs`` values may be VarBase, array-likes, None, or lists thereof.
+    Returns {slot: [VarBase, ...]} matching the lowering's output dict.
+    """
+    import jax
+
+    tracer = _require_tracer()
+    opdef = registry.get(op_type)
+    if opdef.forward is None:
+        raise RuntimeError(f"op '{op_type}' has no registered lowering")
+    attrs = dict(attrs or {})
+
+    norm: Dict[str, List[Optional[VarBase]]] = {}
+    for slot, vals in (inputs or {}).items():
+        if vals is None:
+            continue
+        if not isinstance(vals, (list, tuple)):
+            vals = [vals]
+        lst = []
+        for v in vals:
+            if v is None or isinstance(v, VarBase):
+                lst.append(v)
+            else:
+                lst.append(VarBase(v))
+        norm[slot] = lst
+
+    arr_ins = {slot: [None if v is None else v._array for v in vals]
+               for slot, vals in norm.items()}
+
+    diff_idx: List[Tuple[str, int]] = []
+    if tracer.has_grad and not stop_gradient:
+        for slot, vals in norm.items():
+            if slot in opdef.non_diff_inputs:
+                continue
+            for i, v in enumerate(vals):
+                if v is not None and not v.stop_gradient and _is_inexact(v._array):
+                    diff_idx.append((slot, i))
+
+    if not diff_idx:
+        outs = registry.normalize_outputs(opdef.forward(arr_ins, attrs))
+        return _record(None, outs)
+
+    def f(diff_vals):
+        ins = {s: list(l) for s, l in arr_ins.items()}
+        for (slot, i), a in zip(diff_idx, diff_vals):
+            ins[slot][i] = a
+        return registry.normalize_outputs(opdef.forward(ins, attrs))
+
+    primals = [arr_ins[s][i] for s, i in diff_idx]
+    outs, vjp_fn = jax.vjp(f, primals)
+    out_structs = {slot: [(np.shape(a), np.result_type(a)) for a in vals]
+                   for slot, vals in outs.items()}
+    node = TapeNode(op_type, vjp_fn, [norm[s][i] for s, i in diff_idx],
+                    out_structs)
+    return _record(node, outs)
+
+
+def trace_fn(fn, *inputs: VarBase) -> VarBase:
+    """Trace an ad-hoc single-output jax function over VarBases.
+
+    Powers VarBase methods/operators; the recorded node is identical in
+    shape to a trace_op node (slot "Out", one output)."""
+    import jax
+
+    tracer = get_tracer()
+    vbs = [v if isinstance(v, VarBase) else VarBase(v) for v in inputs]
+    arrs = [v._array for v in vbs]
+
+    diff_idx = []
+    if tracer is not None and tracer.has_grad:
+        diff_idx = [i for i, v in enumerate(vbs)
+                    if not v.stop_gradient and _is_inexact(v._array)]
+    if not diff_idx:
+        out = fn(*arrs)
+        vb = VarBase(out)
+        return vb
+
+    def g(diff_vals):
+        full = list(arrs)
+        for i, a in zip(diff_idx, diff_vals):
+            full[i] = a
+        return {"Out": [fn(*full)]}
+
+    out, vjp_fn = jax.vjp(g, [arrs[i] for i in diff_idx])
+    a = out["Out"][0]
+    node = TapeNode("<fn>", vjp_fn, [vbs[i] for i in diff_idx],
+                    {"Out": [(np.shape(a), np.result_type(a))]})
+    return _record(node, out)["Out"][0]
+
+
+# ---------------------------------------------------------------------------
+# Backward engine
+# ---------------------------------------------------------------------------
+
+def run_backward(var: VarBase, grad=None, retain_graph: bool = False,
+                 only_grad_ids=None):
+    """Reverse-tape walk (reference: BasicEngine::Execute,
+    imperative/basic_engine.cc:161).
+
+    ``only_grad_ids``: when set, write ``.grad`` ONLY for tensors whose id is
+    in the set (leaf or not) — the paddle.grad partial-grad mode. When None,
+    write ``.grad`` for all reachable leaves (loss.backward() mode)."""
+    import jax
+    import jax.numpy as jnp
+
+    root = var._grad_node
+    if root is None:
+        return
+    if grad is None:
+        if np.prod(var.shape) != 1:
+            raise RuntimeError(
+                f"backward() on non-scalar (shape {var.shape}) requires an "
+                f"explicit grad argument")
+        seed = jnp.ones(var._array.shape, var._array.dtype)
+    else:
+        seed = jnp.asarray(grad._array if isinstance(grad, VarBase) else grad,
+                           dtype=var._array.dtype).reshape(var._array.shape)
+
+    # collect reachable tape nodes
+    nodes: Dict[int, TapeNode] = {}
+    stack = [root]
+    while stack:
+        n = stack.pop()
+        if n.seq in nodes:
+            continue
+        nodes[n.seq] = n
+        for iv in n.input_vars:
+            if iv._grad_node is not None:
+                stack.append(iv._grad_node)
+
+    # grads keyed by tensor identity; keepalive prevents id reuse
+    grads: Dict[int, Any] = {id(var): seed}
+    keepalive: Dict[int, VarBase] = {id(var): var}
+
+    for seq in sorted(nodes, reverse=True):
+        node = nodes[seq]
+        # assemble cotangents for every output of the recorded function
+        cts: Dict[str, List[Any]] = {}
+        any_ct = False
+        for slot, structs in node.out_structs.items():
+            cts[slot] = []
+            for shape, dtype in structs:
+                cts[slot].append(
+                    jnp.zeros(shape, dtype) if jnp.issubdtype(dtype, jnp.inexact)
+                    else np.zeros(shape, jax.dtypes.float0))
+        for slot, i, ref in node.outputs:
+            vb = ref()
+            if vb is None:
+                continue
+            g = grads.get(id(vb))
+            if g is not None:
+                cts[slot][i] = jnp.asarray(g, dtype=node.out_structs[slot][i][1])
+                any_ct = True
+        if not any_ct:
+            continue
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                "trying to backward through a graph that has already been "
+                "freed — pass retain_graph=True to the first backward() if "
+                "you need to backward twice")
+        (in_cts,) = node.vjp_fn(cts)
+        for iv, ct in zip(node.input_vars, in_cts):
+            if ct is None or (hasattr(ct, "dtype")
+                              and ct.dtype == jax.dtypes.float0):
+                continue
+            key = id(iv)
+            if key in grads:
+                grads[key] = grads[key] + ct
+            else:
+                grads[key] = ct
+                keepalive[key] = iv
+
+    # write leaf grads into .grad (accumulating across backward calls)
+    for key, vb in keepalive.items():
+        if only_grad_ids is not None:
+            if key not in only_grad_ids:
+                continue
+        elif vb.stop_gradient or vb._grad_node is not None:
+            continue
+        g = grads.get(key)
+        if g is None:
+            continue
+        if vb.grad is None:
+            vb.grad = VarBase(g, name=vb.name + "@GRAD")
+        else:
+            vb.grad = VarBase(vb.grad._array + g, name=vb.name + "@GRAD")
+
+    if not retain_graph:
+        for n in nodes.values():
+            n.vjp_fn = None
+            n.input_vars = []
+        var._grad_node = None
+
+
+def grad(outputs: Sequence[VarBase], inputs: Sequence[VarBase],
+         grad_outputs=None, retain_graph: bool = False,
+         create_graph: bool = False, allow_unused: bool = False):
+    """paddle.grad — grads of outputs wrt inputs without touching .grad
+    (reference: imperative/partial_grad_engine.cc)."""
+    import jax
+    import jax.numpy as jnp
+
+    outputs = list(outputs) if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+
+    # save/restore .grad, run the tape, read off grads
+    saved = [(v, v.grad) for v in inputs]
+    for v in inputs:
+        v.grad = None
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (higher-order grad through paddle.grad) is "
+            "not supported by the tape engine yet")
+    want = {id(v) for v in inputs}
+    try:
+        for out, og in zip(outputs, grad_outputs):
+            run_backward(out, og, retain_graph=True, only_grad_ids=want)
+        results = []
+        for v in inputs:
+            if v.grad is None:
+                if not allow_unused:
+                    raise RuntimeError(
+                        f"input {v.name} unused in the graph "
+                        f"(pass allow_unused=True to permit)")
+                results.append(None)
+            else:
+                results.append(v.grad)
+        return results
+    finally:
+        for v, g in saved:
+            v.grad = g
+        if not retain_graph:
+            for out in outputs:
+                out._grad_node = None

@@ -1,0 +1,338 @@
+"""VarBase: eager tensor for imperative (dygraph) mode.
+
+Capability mirror of the reference's imperative VarBase
+(paddle/fluid/imperative/layer.h:65) and its Python surface
+(python/paddle/fluid/framework.py ParamBase:5222, dygraph/base.py
+to_variable) — re-designed for TPU: the payload is a device-resident
+jax.Array; every traced op runs through the op registry's JAX lowering, so
+eager and static modes share one kernel set (the reference shares kernels
+between Tracer and Executor the same way, imperative/prepared_operator.cc).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from ..core import unique_name
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+class VarBase:
+    """Eager tensor with autograd metadata.
+
+    ``_grad_node`` points at the tape node that produced this tensor (None
+    for leaves); ``grad`` accumulates gradients across backward() calls
+    (reference: GradientAccumulator, imperative/gradient_accumulator.cc).
+    """
+
+    __slots__ = ("_array", "name", "stop_gradient", "grad", "_grad_node",
+                 "persistable", "__weakref__")
+
+    def __init__(self, value, name: Optional[str] = None,
+                 stop_gradient: bool = True, persistable: bool = False):
+        jnp = _jnp()
+        if isinstance(value, VarBase):
+            value = value._array
+        if not hasattr(value, "dtype") or isinstance(value, np.ndarray):
+            value = np.asarray(value)
+            if value.dtype == np.float64:
+                value = value.astype(np.float32)
+            elif value.dtype == np.int64:
+                value = value.astype(np.int32)
+        self._array = jnp.asarray(value)
+        self.name = name or unique_name.generate("eager_tmp")
+        self.stop_gradient = stop_gradient
+        self.grad: Optional[VarBase] = None
+        self._grad_node = None
+        self.persistable = persistable
+
+    # -- metadata -------------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._array.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._array.dtype)
+
+    @property
+    def ndim(self):
+        return self._array.ndim
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._array)
+
+    def item(self):
+        arr = np.asarray(self._array)
+        if arr.size != 1:
+            raise ValueError(
+                f"only one-element tensors can be converted to Python "
+                f"scalars; got shape {self.shape}")
+        return arr.reshape(-1)[0].item()
+
+    def __len__(self):
+        return int(self._array.shape[0])
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        arr = np.asarray(self._array)
+        if arr.size != 1:
+            raise ValueError(
+                f"the truth value of a tensor with {arr.size} elements is "
+                f"ambiguous — use .any()/.all() or compare reductions")
+        return bool(arr.reshape(-1)[0])
+
+    # -- autograd -------------------------------------------------------------
+    def backward(self, grad=None, retain_graph: bool = False):
+        from .tracer import run_backward
+
+        run_backward(self, grad, retain_graph=retain_graph)
+
+    def gradient(self) -> Optional[np.ndarray]:
+        return None if self.grad is None else self.grad.numpy()
+
+    def clear_gradient(self):
+        self.grad = None
+
+    clear_grad = clear_gradient
+
+    def detach(self) -> "VarBase":
+        out = VarBase(self._array, name=self.name + ".detach",
+                      stop_gradient=True)
+        return out
+
+    def clone(self) -> "VarBase":
+        from .tracer import trace_fn
+
+        return trace_fn(lambda x: x + 0, self)
+
+    # -- conversion / reshaping ----------------------------------------------
+    def astype(self, dtype) -> "VarBase":
+        from .tracer import trace_fn
+
+        dt = np.dtype(dtype)
+        return trace_fn(lambda x: x.astype(dt), self)
+
+    def cast(self, dtype) -> "VarBase":
+        return self.astype(dtype)
+
+    def reshape(self, shape) -> "VarBase":
+        from .tracer import trace_fn
+
+        shape = tuple(shape)
+        return trace_fn(lambda x: x.reshape(shape), self)
+
+    def transpose(self, perm) -> "VarBase":
+        from .tracer import trace_fn
+
+        perm = tuple(perm)
+        return trace_fn(lambda x: x.transpose(perm), self)
+
+    def flatten(self) -> "VarBase":
+        from .tracer import trace_fn
+
+        return trace_fn(lambda x: x.reshape(-1), self)
+
+    def squeeze(self, axis=None) -> "VarBase":
+        from .tracer import trace_fn
+
+        jnp = _jnp()
+        return trace_fn(lambda x: jnp.squeeze(x, axis), self)
+
+    def unsqueeze(self, axis) -> "VarBase":
+        from .tracer import trace_fn
+
+        jnp = _jnp()
+        return trace_fn(lambda x: jnp.expand_dims(x, axis), self)
+
+    # -- reductions -----------------------------------------------------------
+    def _reduce(self, fname, axis=None, keepdim=False):
+        from .tracer import trace_fn
+
+        jnp = _jnp()
+        fn = getattr(jnp, fname)
+        return trace_fn(lambda x: fn(x, axis=axis, keepdims=keepdim), self)
+
+    def sum(self, axis=None, keepdim=False):
+        return self._reduce("sum", axis, keepdim)
+
+    def mean(self, axis=None, keepdim=False):
+        return self._reduce("mean", axis, keepdim)
+
+    def max(self, axis=None, keepdim=False):
+        return self._reduce("max", axis, keepdim)
+
+    def min(self, axis=None, keepdim=False):
+        return self._reduce("min", axis, keepdim)
+
+    def any(self):
+        return VarBase(_jnp().any(self._array))
+
+    def all(self):
+        return VarBase(_jnp().all(self._array))
+
+    def norm(self):
+        from .tracer import trace_fn
+
+        jnp = _jnp()
+        return trace_fn(lambda x: jnp.sqrt(jnp.sum(x * x)), self)
+
+    def argmax(self, axis=-1):
+        from .tracer import trace_fn
+
+        jnp = _jnp()
+        return trace_fn(lambda x: jnp.argmax(x, axis=axis), self)
+
+    def exp(self):
+        from .tracer import trace_fn
+
+        return trace_fn(_jnp().exp, self)
+
+    def log(self):
+        from .tracer import trace_fn
+
+        return trace_fn(_jnp().log, self)
+
+    def sqrt(self):
+        from .tracer import trace_fn
+
+        return trace_fn(_jnp().sqrt, self)
+
+    def abs(self):
+        from .tracer import trace_fn
+
+        return trace_fn(_jnp().abs, self)
+
+    def tanh(self):
+        from .tracer import trace_fn
+
+        return trace_fn(_jnp().tanh, self)
+
+    # -- arithmetic -----------------------------------------------------------
+    def _binary(self, other, fn, reverse=False):
+        from .tracer import trace_fn
+
+        if not isinstance(other, VarBase):
+            other = VarBase(np.asarray(other, dtype=self.dtype
+                                       if np.isscalar(other) else None))
+        a, b = (other, self) if reverse else (self, other)
+        return trace_fn(fn, a, b)
+
+    def __add__(self, other):
+        return self._binary(other, lambda a, b: a + b)
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._binary(other, lambda a, b: a - b)
+
+    def __rsub__(self, other):
+        return self._binary(other, lambda a, b: a - b, reverse=True)
+
+    def __mul__(self, other):
+        return self._binary(other, lambda a, b: a * b)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._binary(other, lambda a, b: a / b)
+
+    def __rtruediv__(self, other):
+        return self._binary(other, lambda a, b: a / b, reverse=True)
+
+    def __pow__(self, other):
+        return self._binary(other, lambda a, b: a ** b)
+
+    def __matmul__(self, other):
+        return self._binary(other, lambda a, b: a @ b)
+
+    def __neg__(self):
+        from .tracer import trace_fn
+
+        return trace_fn(lambda x: -x, self)
+
+    def _cmp(self, other, fn):
+        jnp = _jnp()
+        o = other._array if isinstance(other, VarBase) else other
+        return VarBase(fn(self._array, o))
+
+    def __lt__(self, other):
+        return self._cmp(other, lambda a, b: a < b)
+
+    def __le__(self, other):
+        return self._cmp(other, lambda a, b: a <= b)
+
+    def __gt__(self, other):
+        return self._cmp(other, lambda a, b: a > b)
+
+    def __ge__(self, other):
+        return self._cmp(other, lambda a, b: a >= b)
+
+    def __eq__(self, other):  # elementwise, reference VarBase semantics
+        return self._cmp(other, lambda a, b: a == b)
+
+    def __ne__(self, other):
+        return self._cmp(other, lambda a, b: a != b)
+
+    __hash__ = object.__hash__
+
+    def __getitem__(self, idx) -> "VarBase":
+        from .tracer import trace_fn
+
+        if isinstance(idx, VarBase):
+            idx = np.asarray(idx._array)
+        return trace_fn(lambda x: x[idx], self)
+
+    def __repr__(self):
+        return (f"VarBase(name={self.name}, shape={self.shape}, "
+                f"dtype={self.dtype.name}, stop_gradient={self.stop_gradient})\n"
+                f"{np.asarray(self._array)}")
+
+    __str__ = __repr__
+
+
+class ParamBase(VarBase):
+    """Trainable eager parameter (reference: framework.py ParamBase:5222)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "is_bias")
+
+    def __init__(self, value, name: Optional[str] = None, trainable: bool = True,
+                 is_bias: bool = False):
+        super().__init__(value, name=name or unique_name.generate("param"),
+                         stop_gradient=not trainable, persistable=True)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.is_bias = is_bias
+
+    def set_value(self, value):
+        jnp = _jnp()
+        if isinstance(value, VarBase):
+            value = value._array
+        self._array = jnp.asarray(value, dtype=self._array.dtype).reshape(
+            self._array.shape)
+
+    def __repr__(self):
+        return (f"ParamBase(name={self.name}, shape={self.shape}, "
+                f"dtype={self.dtype.name}, trainable={self.trainable})")
+
+    __str__ = __repr__
+
+
+def to_variable(value, name: Optional[str] = None, zero_copy=None) -> VarBase:
+    """numpy → eager tensor (reference: dygraph/base.py to_variable)."""
+    if isinstance(value, VarBase):
+        return value
+    return VarBase(value, name=name)

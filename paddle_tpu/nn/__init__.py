@@ -1,0 +1,468 @@
+"""paddle.nn — 2.0-style class Layer API (dygraph-first).
+
+Capability mirror of python/paddle/nn/layer/ (Linear, Conv2D, norm layers,
+Embedding, Dropout, activations, pooling, containers, losses) built on the
+dygraph Layer base; functional bodies live in nn.functional and share the
+op registry with the static-graph layers API.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..dygraph.layers import Layer
+from ..initializer import Constant, Normal, Uniform, Xavier
+from . import functional
+from . import functional as F
+
+__all__ = [
+    "Layer", "Linear", "Conv2D", "Conv2DTranspose", "Embedding", "Dropout",
+    "BatchNorm", "BatchNorm1D", "BatchNorm2D", "LayerNorm", "GroupNorm",
+    "ReLU", "GELU", "Sigmoid", "Tanh", "Softmax", "LeakyReLU", "Hardswish",
+    "Silu", "MaxPool2D", "AvgPool2D", "AdaptiveAvgPool2D", "AdaptiveMaxPool2D",
+    "Flatten", "Pad2D", "Sequential", "LayerList", "ParameterList",
+    "CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCEWithLogitsLoss",
+    "SmoothL1Loss", "KLDivLoss", "Upsample", "functional",
+]
+
+
+def _ntuple(v, n=2):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+class Linear(Layer):
+    """y = xW + b (reference: python/paddle/nn/layer/common.py Linear;
+    fluid dygraph/nn.py Linear)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=Xavier())
+        self.bias = self.create_parameter(
+            [out_features], attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+
+class Conv2D(Layer):
+    """NCHW conv (reference: nn/layer/conv.py Conv2D; filter OIHW)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__()
+        k = _ntuple(kernel_size)
+        self._stride, self._padding, self._dilation = stride, padding, dilation
+        self._groups = groups
+        self._data_format = data_format
+        fan_in = in_channels * k[0] * k[1]
+        bound = 1.0 / np.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, k[0], k[1]], attr=weight_attr,
+            default_initializer=Normal(0.0, np.sqrt(2.0 / fan_in)))
+        self.bias = self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True,
+            default_initializer=Uniform(-bound, bound)
+            if bias_attr is None else None)
+
+    def forward(self, x):
+        return F.conv2d(x, self.weight, self.bias, stride=self._stride,
+                        padding=self._padding, dilation=self._dilation,
+                        groups=self._groups, data_format=self._data_format)
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__()
+        k = _ntuple(kernel_size)
+        self._stride, self._padding, self._dilation = stride, padding, dilation
+        self._groups = groups
+        self.weight = self.create_parameter(
+            [in_channels, out_channels // groups, k[0], k[1]], attr=weight_attr,
+            default_initializer=Xavier())
+        self.bias = self.create_parameter([out_channels], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x):
+        return F.conv2d_transpose(x, self.weight, self.bias,
+                                  stride=self._stride, padding=self._padding,
+                                  dilation=self._dilation, groups=self._groups)
+
+
+class Embedding(Layer):
+    def __init__(self, num_embeddings, embedding_dim, padding_idx=None,
+                 sparse=False, weight_attr=None, name=None):
+        super().__init__()
+        self._padding_idx = padding_idx
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=Normal(0.0, 1.0 / np.sqrt(embedding_dim)))
+
+    def forward(self, x):
+        return F.embedding(x, self.weight, padding_idx=self._padding_idx)
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x):
+        return F.dropout(x, self.p, training=self.training, mode=self.mode)
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self._momentum, self._epsilon = momentum, epsilon
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            [num_features], attr=weight_attr, default_initializer=Constant(1.0))
+        self.bias = self.create_parameter([num_features], attr=bias_attr,
+                                          is_bias=True)
+        self.register_buffer("_mean", np.zeros([num_features], np.float32))
+        self.register_buffer("_variance", np.ones([num_features], np.float32))
+
+    def forward(self, x):
+        return F.batch_norm(x, self._mean, self._variance, self.weight,
+                            self.bias, training=self.training,
+                            momentum=self._momentum, epsilon=self._epsilon,
+                            data_format=self._data_format)
+
+
+class BatchNorm(_BatchNormBase):
+    """fluid dygraph/nn.py BatchNorm signature (num_channels first)."""
+
+    def __init__(self, num_channels, act=None, momentum=0.9, epsilon=1e-5,
+                 is_test=False, **kw):
+        kw.pop("dtype", None)
+        super().__init__(num_channels, momentum=momentum, epsilon=epsilon, **kw)
+        self._act = act
+
+    def forward(self, x):
+        y = super().forward(x)
+        if self._act:
+            y = getattr(F, self._act)(y)
+        return y
+
+
+class BatchNorm1D(_BatchNormBase):
+    pass
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """On TPU, batch stats sync falls out of SPMD compilation: under a dp
+    mesh the reduction is global (reference: sync_batch_norm_op.cu needs an
+    explicit NCCL allreduce)."""
+    pass
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._normalized_shape = list(normalized_shape)
+        self._epsilon = epsilon
+        n = int(np.prod(self._normalized_shape))
+        self.weight = self.create_parameter([n], attr=weight_attr,
+                                            default_initializer=Constant(1.0))
+        self.bias = self.create_parameter([n], attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.layer_norm(x, self._normalized_shape, self.weight, self.bias,
+                            self._epsilon)
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        self.weight = self.create_parameter([num_channels], attr=weight_attr,
+                                            default_initializer=Constant(1.0))
+        self.bias = self.create_parameter([num_channels], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x):
+        return F.group_norm(x, self._num_groups, self.weight, self.bias,
+                            self._epsilon)
+
+
+# -- activation layers --------------------------------------------------------
+
+def _act_layer(name, fn_name, **defaults):
+    class _Act(Layer):
+        def __init__(self, **kw):
+            super().__init__()
+            self._kw = {**defaults, **kw}
+
+        def forward(self, x):
+            return getattr(F, fn_name)(x, **self._kw)
+
+    _Act.__name__ = name
+    return _Act
+
+
+ReLU = _act_layer("ReLU", "relu")
+GELU = _act_layer("GELU", "gelu")
+Sigmoid = _act_layer("Sigmoid", "sigmoid")
+Tanh = _act_layer("Tanh", "tanh")
+Hardswish = _act_layer("Hardswish", "hardswish")
+Silu = _act_layer("Silu", "silu")
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, axis=self._axis)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        return F.leaky_relu(x, self._slope)
+
+
+# -- pooling / shape ----------------------------------------------------------
+
+class MaxPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 name=None):
+        super().__init__()
+        self._k, self._s, self._p = kernel_size, stride, padding
+        self._ceil = ceil_mode
+
+    def forward(self, x):
+        return F.max_pool2d(x, self._k, self._s, self._p, self._ceil)
+
+
+class AvgPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, name=None):
+        super().__init__()
+        self._k, self._s, self._p = kernel_size, stride, padding
+        self._ceil, self._excl = ceil_mode, exclusive
+
+    def forward(self, x):
+        return F.avg_pool2d(x, self._k, self._s, self._p, self._ceil,
+                            self._excl)
+
+
+class AdaptiveAvgPool2D(Layer):
+    def __init__(self, output_size, name=None):
+        super().__init__()
+        self._size = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool2d(x, self._size)
+
+
+class AdaptiveMaxPool2D(Layer):
+    def __init__(self, output_size, name=None):
+        super().__init__()
+        self._size = output_size
+
+    def forward(self, x):
+        return F.adaptive_max_pool2d(x, self._size)
+
+
+class Flatten(Layer):
+    def __init__(self, start_axis=1, stop_axis=-1):
+        super().__init__()
+        self._start, self._stop = start_axis, stop_axis
+
+    def forward(self, x):
+        shape = list(x.shape)
+        stop = self._stop if self._stop >= 0 else len(shape) + self._stop
+        n = int(np.prod(shape[self._start:stop + 1]))
+        new_shape = shape[:self._start] + [n] + shape[stop + 1:]
+        return x.reshape(new_shape)
+
+
+class Pad2D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCHW"):
+        super().__init__()
+        self._padding = padding if isinstance(padding, (list, tuple)) \
+            else [padding] * 4
+        self._mode, self._value, self._fmt = mode, value, data_format
+
+    def forward(self, x):
+        return F.pad(x, self._padding, self._mode, self._value, self._fmt)
+
+
+class Upsample(Layer):
+    def __init__(self, size=None, scale_factor=None, mode="nearest",
+                 align_corners=False, name=None):
+        super().__init__()
+        self._size, self._scale = size, scale_factor
+        self._mode, self._align = mode, align_corners
+
+    def forward(self, x):
+        return F.interpolate(x, self._size, self._scale, self._mode,
+                             self._align)
+
+
+# -- containers ---------------------------------------------------------------
+
+class Sequential(Layer):
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], (list, tuple)) and \
+                layers[0] and isinstance(layers[0][0], (list, tuple)):
+            layers = [(name, l) for name, l in layers[0]]
+        for i, item in enumerate(layers):
+            if isinstance(item, tuple):
+                name, layer = item
+            else:
+                name, layer = str(i), item
+            self.add_sublayer(name, layer)
+
+    def forward(self, x):
+        for layer in self._sub_layers.values():
+            x = layer(x)
+        return x
+
+    def __getitem__(self, idx):
+        return list(self._sub_layers.values())[idx]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+
+class LayerList(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        for i, l in enumerate(sublayers or []):
+            self.add_sublayer(str(i), l)
+
+    def append(self, layer):
+        self.add_sublayer(str(len(self._sub_layers)), layer)
+        return self
+
+    def __getitem__(self, idx):
+        return list(self._sub_layers.values())[idx]
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+
+class ParameterList(Layer):
+    def __init__(self, parameters=None):
+        super().__init__()
+        for i, p in enumerate(parameters or []):
+            self.add_parameter(str(i), p)
+
+    def append(self, p):
+        self.add_parameter(str(len(self._parameters)), p)
+        return self
+
+    def __getitem__(self, idx):
+        return list(self._parameters.values())[idx]
+
+    def __iter__(self):
+        return iter(self._parameters.values())
+
+    def __len__(self):
+        return len(self._parameters)
+
+
+# -- losses -------------------------------------------------------------------
+
+class CrossEntropyLoss(Layer):
+    def __init__(self, weight=None, ignore_index=-100, reduction="mean",
+                 soft_label=False, axis=-1, name=None):
+        super().__init__()
+        self._ignore = ignore_index
+        self._reduction = reduction
+        self._soft = soft_label
+        self._axis = axis
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, ignore_index=self._ignore,
+                               reduction=self._reduction,
+                               soft_label=self._soft, axis=self._axis)
+
+
+class MSELoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        return F.mse_loss(input, label, self._reduction)
+
+
+class L1Loss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        return F.l1_loss(input, label, self._reduction)
+
+
+class NLLLoss(Layer):
+    def __init__(self, weight=None, ignore_index=-100, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        return F.nll_loss(input, label, reduction=self._reduction)
+
+
+class BCEWithLogitsLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", pos_weight=None,
+                 name=None):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, logit, label):
+        return F.binary_cross_entropy_with_logits(logit, label,
+                                                  reduction=self._reduction)
+
+
+class SmoothL1Loss(Layer):
+    def __init__(self, reduction="mean", delta=1.0, name=None):
+        super().__init__()
+        self._reduction, self._delta = reduction, delta
+
+    def forward(self, input, label):
+        return F.smooth_l1_loss(input, label, self._reduction, self._delta)
+
+
+class KLDivLoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        return F.kl_div(input, label, self._reduction)

@@ -359,13 +359,15 @@ def softmax_with_cross_entropy(ins, attrs):
         if lbl.ndim == logits.ndim and lbl.shape[axis] == 1:
             lbl = jnp.squeeze(lbl, axis=axis)
         ax = axis % logits.ndim
-        lbl_exp = jnp.expand_dims(lbl, ax).astype(np.int32)
+        ignore = int(attrs.get("ignore_index", -100))
+        # clip before gather so an ignored (possibly negative) label can't
+        # wrap around via take_along_axis; mask its loss to 0 afterwards
+        safe = jnp.clip(lbl, 0, logits.shape[ax] - 1).astype(np.int32)
+        lbl_exp = jnp.expand_dims(safe, ax)
         picked = jnp.take_along_axis(logp, lbl_exp, axis=ax)
         loss = -picked
-        ignore = int(attrs.get("ignore_index", -100))
-        if ignore >= 0:
-            mask = jnp.expand_dims(lbl != ignore, ax)
-            loss = jnp.where(mask, loss, 0.0)
+        mask = jnp.expand_dims(lbl.astype(np.int32) != ignore, ax)
+        loss = jnp.where(mask, loss, jnp.zeros_like(loss))
     return {"Softmax": jnp.exp(logp), "Loss": loss}
 
 

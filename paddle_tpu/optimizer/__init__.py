@@ -13,7 +13,7 @@ paddle_tpu.distributed.fleet.meta_optimizers.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -24,16 +24,31 @@ from ..core.ir import (OpRole, Parameter, Program, Variable,
 from ..regularizer import append_regularization_ops
 
 
+class _OptimizerStateDict(dict):
+    """Marks a dict as optimizer state so save_dygraph picks '.pdopt'."""
+
+    _is_optimizer_state = True
+
+
 class Optimizer:
     def __init__(self, learning_rate=0.001, parameter_list=None,
-                 regularization=None, grad_clip=None, name: Optional[str] = None):
+                 regularization=None, grad_clip=None, name: Optional[str] = None,
+                 parameters=None, weight_decay=None):
         self._learning_rate = learning_rate
-        self._parameter_list = parameter_list
+        self._parameter_list = parameter_list if parameter_list is not None \
+            else parameters
         self.regularization = regularization
+        if weight_decay is not None and regularization is None \
+                and not isinstance(self, AdamWOptimizer):
+            from ..regularizer import L2Decay
+
+            self.regularization = L2Decay(weight_decay)
         self._grad_clip = grad_clip
         self._name = name or unique_name.generate(type(self).__name__)
         self._accumulators: Dict[str, Dict[str, Variable]] = {}
         self._lr_var: Optional[Variable] = None
+        # dygraph eager path: cached (program, scope, executor, grad-names)
+        self._dy_cache: Dict[tuple, tuple] = {}
 
     # -- learning rate --------------------------------------------------------
     def _create_global_learning_rate(self):
@@ -56,16 +71,27 @@ class Optimizer:
     def learning_rate_var(self) -> Variable:
         return self._lr_var
 
-    def current_step_lr(self) -> float:
+    def _lr_scope(self):
+        scope = getattr(self, "_dy_scope", None)
+        if scope is not None:
+            return scope
         from ..core.scope import global_scope
 
-        v = global_scope().find_var(self._lr_var.name)
+        return global_scope()
+
+    def current_step_lr(self) -> float:
+        if self._lr_var is None:
+            lr = self._learning_rate
+            return float(lr() if callable(lr) else lr)
+        v = self._lr_scope().find_var(self._lr_var.name)
         return float(np.asarray(v)[0]) if v is not None else float(self._learning_rate)
 
     def set_lr(self, value: float):
-        from ..core.scope import global_scope
-
-        global_scope().set(self._lr_var.name, np.full((1,), value, np.float32))
+        if self._lr_var is None:
+            self._learning_rate = float(value)
+            return
+        self._lr_scope().set(self._lr_var.name,
+                             np.full((1,), value, np.float32))
 
     # -- accumulators ----------------------------------------------------------
     def _add_accumulator(self, name: str, param: Variable, fill_value: float = 0.0,
@@ -119,10 +145,149 @@ class Optimizer:
 
     def minimize(self, loss: Variable, startup_program=None,
                  parameter_list=None, no_grad_set=None):
+        from ..core.ir import in_dygraph_mode
+
+        if in_dygraph_mode():
+            params_grads = self._dygraph_params_grads(parameter_list)
+            self._dygraph_apply(params_grads)
+            return None, params_grads
         params_grads = self.backward(loss, startup_program, parameter_list,
                                      no_grad_set)
         ops = self.apply_gradients(params_grads)
         return ops, params_grads
+
+    # -- dygraph eager path ----------------------------------------------------
+    # The per-param update ops are built ONCE into a micro-Program whose scope
+    # owns params + accumulators + lr; each step feeds grads and runs the
+    # jitted update (all params' updates fuse into one XLA computation — the
+    # role of ir/fuse_optimizer_ops_pass comes for free).
+
+    def _dygraph_params_grads(self, parameter_list=None):
+        params = parameter_list or self._parameter_list
+        if params is None:
+            raise ValueError(
+                "dygraph optimizers need the parameter list — construct with "
+                "parameter_list=model.parameters()")
+        return [(p, p.grad) for p in params
+                if getattr(p, "trainable", True) and p.grad is not None]
+
+    def step(self):
+        """2.0-style: apply grads accumulated by loss.backward()."""
+        self._dygraph_apply(self._dygraph_params_grads())
+
+    def clear_grad(self):
+        for p in (self._parameter_list or []):
+            p.clear_gradient()
+
+    clear_gradients = clear_grad
+
+    def _dygraph_apply(self, params_grads):
+        if not params_grads:
+            return
+        from ..core.executor import Executor
+        from ..core.ir import Program, program_guard
+        from ..core.scope import Scope
+
+        # ONE scope shared by every micro-program: accumulators/lr are keyed
+        # by var NAME, so a later program (e.g. when the set of params with
+        # grads changes) reuses the existing state and its startup only
+        # initialises the accumulators that are new.
+        scope = getattr(self, "_dy_scope", None)
+        if scope is None:
+            scope = self._dy_scope = Scope()
+        key = tuple(p.name for p, _ in params_grads)
+        cached = self._dy_cache.get(key)
+        if cached is None:
+            prog, startup = Program(), Program()
+            with program_guard(prog, startup):
+                pg_vars = []
+                for p, g in params_grads:
+                    blk = prog.global_block()
+                    pv = blk.create_parameter(p.name, list(p.shape),
+                                              str(np.dtype(p.dtype)))
+                    pv.regularizer = getattr(p, "regularizer", None)
+                    gv = blk.create_var(p.name + "@GRAD", list(g.shape),
+                                        str(np.dtype(g.dtype)))
+                    pg_vars.append((pv, gv))
+                self.apply_gradients(pg_vars)
+            exe = Executor()
+            exe.run(startup, scope=scope, use_compiled=False)
+            pending = getattr(self, "_pending_state", None)
+            if pending:
+                self._write_state(pending)
+                self._pending_state = None
+            cached = (prog, exe)
+            self._dy_cache[key] = cached
+        prog, exe = cached
+        for p, _ in params_grads:
+            scope.set(p.name, p._array)
+        feed = {p.name + "@GRAD": g._array for p, g in params_grads}
+        exe.run(prog, feed=feed, fetch_list=[], scope=scope, return_numpy=False)
+        for p, _ in params_grads:
+            p._array = scope.find_var(p.name)
+
+    def _param_index(self) -> Dict[str, int]:
+        """Stable param-name → position map (positions survive process
+        restarts where unique_name counters don't)."""
+        params = self._parameter_list or []
+        return {p.name: i for i, p in enumerate(params)}
+
+    def state_dict(self) -> Dict[str, Any]:
+        """Dygraph optimizer state keyed by '<accum>#<param position>'
+        (positional, so a freshly built model/optimizer in a new process can
+        restore it; raw var names embed unique_name counters)."""
+        out = _OptimizerStateDict()
+        scope = getattr(self, "_dy_scope", None)
+        if scope is None:
+            return out
+        idx = self._param_index()
+        for name, per_param in self._accumulators.items():
+            for pname, var in per_param.items():
+                v = scope.find_var(var.name)
+                if v is not None and pname in idx:
+                    out[f"{name}#{idx[pname]}"] = np.asarray(v)
+        if self._lr_var is not None:
+            v = scope.find_var(self._lr_var.name)
+            if v is not None:
+                out["LR#"] = np.asarray(v)
+        return out
+
+    def set_state_dict(self, state: Dict[str, Any]):
+        if getattr(self, "_dy_scope", None) is None or not self._accumulators:
+            # before the first step there is no scope to restore into yet:
+            # stash and apply right after the first micro-program is built
+            self._pending_state = dict(state)
+            return
+        self._write_state(state)
+
+    def _write_state(self, state: Dict[str, Any]):
+        by_pos = {i: p for p, i in self._param_index().items()}
+        restored = 0
+        for k, v in state.items():
+            if k == "LR#" or k.startswith("LR_"):
+                if self._lr_var is not None:
+                    self._dy_scope.set(self._lr_var.name, np.asarray(v))
+                    restored += 1
+                continue
+            if "#" in k:
+                acc_name, pos = k.rsplit("#", 1)
+                pname = by_pos.get(int(pos))
+                var = self._accumulators.get(acc_name, {}).get(pname) \
+                    if pname else None
+                if var is None:
+                    continue
+                self._dy_scope.set(var.name, np.asarray(v))
+                restored += 1
+            else:  # legacy raw-name key
+                self._dy_scope.set(k, np.asarray(v))
+                restored += 1
+        if state and restored == 0:
+            raise ValueError(
+                "optimizer set_state_dict restored 0 entries — checkpoint "
+                f"keys {sorted(state)[:5]} match no accumulator of this "
+                "optimizer (was it saved by a different optimizer type?)")
+
+    set_dict = set_state_dict
 
 
 class SGDOptimizer(Optimizer):

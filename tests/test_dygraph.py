@@ -1,0 +1,339 @@
+"""Dygraph (imperative) mode tests — mirrors the reference's
+test_imperative_* suite (python/paddle/fluid/tests/unittests/
+test_imperative_basic.py, test_imperative_mnist.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import dygraph, nn
+from paddle_tpu.dygraph import VarBase, to_variable
+from paddle_tpu.nn import functional as F
+from paddle_tpu.optimizer import AdamOptimizer, SGDOptimizer
+
+
+def test_varbase_basic():
+    with dygraph.guard():
+        x = to_variable(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+        y = x * 2 + 1
+        np.testing.assert_allclose(y.numpy(), [[3, 5], [7, 9]], rtol=1e-6)
+        assert y.shape == [2, 2]
+        z = x.sum()
+        assert z.item() == pytest.approx(10.0)
+
+
+def test_simple_backward():
+    with dygraph.guard():
+        x = VarBase(np.array([2.0, 3.0], np.float32), stop_gradient=False)
+        y = (x * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.gradient(), [4.0, 6.0], rtol=1e-6)
+
+
+def test_grad_accumulation_across_backwards():
+    with dygraph.guard():
+        x = VarBase(np.array([1.0], np.float32), stop_gradient=False)
+        (x * 2).sum().backward()
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.gradient(), [5.0], rtol=1e-6)
+
+
+def test_chain_rule_through_shared_input():
+    with dygraph.guard():
+        x = VarBase(np.array([2.0], np.float32), stop_gradient=False)
+        y = x * x + x  # dy/dx = 2x + 1 = 5
+        y.backward()
+        np.testing.assert_allclose(x.gradient(), [5.0], rtol=1e-6)
+
+
+def test_no_grad():
+    with dygraph.guard():
+        x = VarBase(np.array([2.0], np.float32), stop_gradient=False)
+        with dygraph.no_grad():
+            y = x * x
+        assert y._grad_node is None
+        assert y.stop_gradient
+
+
+def test_paddle_grad_api():
+    with dygraph.guard():
+        x = VarBase(np.array([3.0], np.float32), stop_gradient=False)
+        y = x * x
+        (g,) = dygraph.grad([y], [x])
+        np.testing.assert_allclose(g.numpy(), [6.0], rtol=1e-6)
+        assert x.grad is None  # .grad untouched
+
+
+def test_trace_op_matches_numpy():
+    with dygraph.guard():
+        a = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+        x = to_variable(a)
+        out = dygraph.trace_op("softmax", {"X": x}, {"axis": -1})["Out"][0]
+        e = np.exp(a - a.max(-1, keepdims=True))
+        np.testing.assert_allclose(out.numpy(), e / e.sum(-1, keepdims=True),
+                                   rtol=1e-5)
+
+
+class MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(4, 16)
+        self.fc2 = nn.Linear(16, 2)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+def test_layer_params_and_state_dict():
+    with dygraph.guard():
+        m = MLP()
+        params = m.parameters()
+        assert len(params) == 4
+        sd = m.state_dict()
+        assert set(sd) == {"fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"}
+        m2 = MLP()
+        m2.set_state_dict({k: v.numpy() for k, v in sd.items()})
+        np.testing.assert_allclose(m2.fc1.weight.numpy(),
+                                   m.fc1.weight.numpy())
+
+
+def test_dygraph_mlp_training_loss_decreases():
+    rng = np.random.RandomState(0)
+    xs = rng.rand(64, 4).astype(np.float32)
+    ys = (xs.sum(1) > 2).astype(np.int32).reshape(64, 1)
+    with dygraph.guard():
+        m = MLP()
+        opt = AdamOptimizer(0.01, parameter_list=m.parameters())
+        losses = []
+        for _ in range(30):
+            x, y = to_variable(xs), to_variable(ys)
+            loss = F.cross_entropy(m(x), y)
+            loss.backward()
+            opt.minimize(loss)
+            m.clear_gradients()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.7, losses[:3] + losses[-3:]
+
+
+def test_dygraph_numeric_gradcheck():
+    """Analytic tape gradient vs central finite differences through a
+    two-layer net (the reference's check_grad discipline, op_test.py:1299)."""
+    rng = np.random.RandomState(1)
+    w = rng.rand(3, 3).astype(np.float32)
+    xv = rng.rand(2, 3).astype(np.float32)
+
+    def loss_np(wv):
+        h = np.tanh(xv @ wv)
+        return (h * h).sum()
+
+    with dygraph.guard():
+        wvar = VarBase(w, stop_gradient=False)
+        h = to_variable(xv).__matmul__(wvar).tanh()
+        (h * h).sum().backward()
+        analytic = wvar.gradient()
+
+    eps = 1e-3
+    numeric = np.zeros_like(w)
+    for i in range(3):
+        for j in range(3):
+            wp, wm = w.copy(), w.copy()
+            wp[i, j] += eps
+            wm[i, j] -= eps
+            numeric[i, j] = (loss_np(wp) - loss_np(wm)) / (2 * eps)
+    np.testing.assert_allclose(analytic, numeric, rtol=1e-2, atol=1e-3)
+
+
+def test_batchnorm_running_stats_update():
+    with dygraph.guard():
+        bn = nn.BatchNorm2D(3)
+        x = to_variable(np.random.RandomState(0)
+                        .rand(4, 3, 5, 5).astype(np.float32) * 2 + 1)
+        bn.train()
+        _ = bn(x)
+        assert not np.allclose(bn._mean.numpy(), 0.0)
+        bn.eval()
+        mean_before = bn._mean.numpy().copy()
+        _ = bn(x)
+        np.testing.assert_allclose(bn._mean.numpy(), mean_before)
+
+
+def test_dropout_train_eval():
+    with dygraph.guard():
+        d = nn.Dropout(0.5)
+        x = to_variable(np.ones((100, 100), np.float32))
+        y = d(x)
+        assert (y.numpy() == 0).mean() > 0.3
+        d.eval()
+        np.testing.assert_allclose(d(x).numpy(), 1.0)
+
+
+def test_save_load_dygraph(tmp_path):
+    with dygraph.guard():
+        m = MLP()
+        path = str(tmp_path / "mlp")
+        dygraph.save_dygraph({k: v for k, v in m.state_dict().items()}, path)
+        params, _ = dygraph.load_dygraph(path)
+        m2 = MLP()
+        m2.set_state_dict(params)
+        np.testing.assert_allclose(m2.fc2.weight.numpy(),
+                                   m.fc2.weight.numpy())
+
+
+def test_sequential_and_containers():
+    with dygraph.guard():
+        seq = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        x = to_variable(np.random.rand(3, 4).astype(np.float32))
+        assert seq(x).shape == [3, 2]
+        assert len(seq) == 3
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(ll.parameters()) == 6
+
+
+def test_sgd_step_and_clear_grad():
+    with dygraph.guard():
+        m = nn.Linear(2, 2)
+        opt = SGDOptimizer(0.1, parameter_list=m.parameters())
+        x = to_variable(np.ones((4, 2), np.float32))
+        loss = (m(x) * m(x)).mean()
+        loss.backward()
+        w_before = m.weight.numpy().copy()
+        opt.step()
+        assert not np.allclose(m.weight.numpy(), w_before)
+        opt.clear_grad()
+        assert m.weight.grad is None
+
+
+# -- regression tests from code review ---------------------------------------
+
+def test_optimizer_param_subset_changes_between_steps():
+    """Accumulators created for one param subset must survive a later step
+    touching a different subset (shared micro-program scope)."""
+    with dygraph.guard():
+        a, b = nn.Linear(2, 2), nn.Linear(2, 2)
+        opt = AdamOptimizer(0.01, parameter_list=a.parameters() + b.parameters())
+        x = to_variable(np.ones((2, 2), np.float32))
+        a(x).mean().backward()          # only a has grads
+        opt.step()
+        opt.clear_grad()
+        (a(x) + b(x)).mean().backward()  # now both
+        opt.step()                       # must not raise
+
+
+def test_save_dygraph_model_and_opt_same_prefix(tmp_path):
+    with dygraph.guard():
+        m = nn.Linear(2, 2)
+        opt = AdamOptimizer(0.01, parameter_list=m.parameters())
+        m(to_variable(np.ones((2, 2), np.float32))).mean().backward()
+        opt.step()
+        path = str(tmp_path / "ckpt")
+        dygraph.save_dygraph(m.state_dict(), path)
+        dygraph.save_dygraph(opt.state_dict(), path)
+        params, opt_state = dygraph.load_dygraph(path)
+        assert "weight" in params and "bias" in params
+        assert opt_state is not None and len(opt_state) > 0
+
+
+def test_optimizer_set_state_dict_before_first_step():
+    with dygraph.guard():
+        m = nn.Linear(2, 2)
+        opt = AdamOptimizer(0.01, parameter_list=m.parameters())
+        m(to_variable(np.ones((2, 2), np.float32))).mean().backward()
+        opt.step()
+        state = {k: v.copy() for k, v in opt.state_dict().items()}
+        assert state
+
+        m2 = nn.Linear(2, 2)
+        opt2 = AdamOptimizer(0.01, parameter_list=m2.parameters())
+        # key names depend on param names; remap onto opt2's params
+        opt2.set_state_dict(state)  # before any step: must stash, not drop
+        assert getattr(opt2, "_pending_state", None)
+
+
+def test_grad_wrt_intermediate():
+    with dygraph.guard():
+        x = VarBase(np.array([2.0], np.float32), stop_gradient=False)
+        y = x * x
+        z = (y * 3.0).sum()
+        (g,) = dygraph.grad([z], [y])
+        np.testing.assert_allclose(g.numpy(), [3.0], rtol=1e-6)
+
+
+def test_double_backward_without_retain_raises():
+    with dygraph.guard():
+        a = VarBase(np.array([1.0], np.float32), stop_gradient=False)
+        b = a * 2
+        (b * 3).sum().backward()
+        with pytest.raises(RuntimeError, match="retain_graph"):
+            b.sum().backward()
+
+
+def test_cross_entropy_ignore_index_mean():
+    with dygraph.guard():
+        logits = to_variable(np.zeros((4, 3), np.float32))
+        label = to_variable(np.array([[0], [1], [0], [0]], np.int32))
+        # uniform logits -> per-token loss = log(3); half the batch ignored
+        loss = F.cross_entropy(logits, label, ignore_index=0)
+        np.testing.assert_allclose(float(loss), np.log(3), rtol=1e-5)
+
+
+def test_nll_loss_ignore_index_and_weight():
+    with dygraph.guard():
+        logp = to_variable(np.log(np.full((3, 2), 0.5, np.float32)))
+        label = to_variable(np.array([0, 1, 0], np.int32))
+        loss = F.nll_loss(logp, label, ignore_index=0)
+        np.testing.assert_allclose(float(loss), np.log(2), rtol=1e-5)
+        w = to_variable(np.array([1.0, 3.0], np.float32))
+        loss_w = F.nll_loss(logp, label, weight=w)
+        # weights: [1,3,1]; all losses log2 -> weighted mean still log2
+        np.testing.assert_allclose(float(loss_w), np.log(2), rtol=1e-5)
+
+
+def test_layer_setattr_deregisters():
+    with dygraph.guard():
+        m = MLP()
+        n_before = len(m.parameters())
+        m.fc1 = None
+        assert m.fc1 is None
+        assert len(m.parameters()) == n_before - 2
+        assert "fc1.weight" not in m.state_dict()
+
+
+def test_grad_does_not_pollute_other_leaves():
+    with dygraph.guard():
+        x = VarBase(np.array([1.0], np.float32), stop_gradient=False)
+        w = VarBase(np.array([2.0], np.float32), stop_gradient=False)
+        y = (x * w).sum()
+        (g,) = dygraph.grad([y], [x])
+        np.testing.assert_allclose(g.numpy(), [2.0])
+        assert w.grad is None  # untouched leaf
+
+
+def test_optimizer_state_restore_fresh_process_names():
+    """Positional state keys restore into an optimizer whose accumulator
+    var names differ (simulates a new process / fresh unique_name counters)."""
+    with dygraph.guard():
+        m1 = nn.Linear(2, 2)
+        o1 = AdamOptimizer(0.01, parameter_list=m1.parameters())
+        m1(to_variable(np.ones((2, 2), np.float32))).mean().backward()
+        o1.step()
+        state = {k: v.copy() for k, v in o1.state_dict().items()}
+
+        m2 = nn.Linear(2, 2)  # different unique names
+        o2 = AdamOptimizer(0.01, parameter_list=m2.parameters())
+        o2.set_state_dict(state)
+        m2(to_variable(np.ones((2, 2), np.float32))).mean().backward()
+        o2.step()  # applies pending state during build
+        restored = o2.state_dict()
+        # moment1 of param 0 must match what o1 saved (restored, then one
+        # more adam step was applied on top — so just check it's non-zero
+        # and that restore didn't silently no-op into zeros+fresh step)
+        k = [k for k in restored if k.startswith("moment2#0")][0]
+        assert np.abs(restored[k]).sum() > np.abs(state[k]).sum() * 0.5
+
+
+def test_bool_ambiguity_raises():
+    with dygraph.guard():
+        a = to_variable(np.array([1.0, 2.0], np.float32))
+        with pytest.raises(ValueError, match="ambiguous"):
+            bool(a == a)
+        assert bool((a == a).all())
