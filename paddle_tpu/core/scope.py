@@ -55,6 +55,11 @@ class Scope:
     def __len__(self):
         return len(self._vars)
 
+    def __bool__(self):
+        # an empty Scope is still a scope — never falsy (guards against
+        # `scope or global_scope()` silently swapping in the global scope)
+        return True
+
 
 _global_scope = Scope()
 

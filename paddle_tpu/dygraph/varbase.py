@@ -225,8 +225,11 @@ class VarBase:
         from .tracer import trace_fn
 
         if not isinstance(other, VarBase):
-            other = VarBase(np.asarray(other, dtype=self.dtype
-                                       if np.isscalar(other) else None))
+            # numpy promotion rules: int tensor * 0.5 must NOT truncate the
+            # scalar to int (result_type(int32, 0.5) -> floating)
+            dt = (np.result_type(np.dtype(self.dtype), other)
+                  if np.isscalar(other) else None)
+            other = VarBase(np.asarray(other, dtype=dt))
         a, b = (other, self) if reverse else (self, other)
         return trace_fn(fn, a, b)
 

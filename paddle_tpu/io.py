@@ -1,0 +1,306 @@
+"""Checkpointing & model export.
+
+Capability mirror of the reference's io layer
+(python/paddle/fluid/io.py: save_vars:224, save_persistables:598,
+load_persistables:966, save_inference_model:1164, load_inference_model:1374)
+re-designed for the TPU build:
+
+* The reference emits `save`/`load` ops into a side program and runs them
+  through the C++ executor (framework/save_load_util.cc). Here persistables
+  are host-fetched from the Scope (one `jax.device_get` per var — XLA owns
+  transfers) and written as `.npy` files, or one combined `.npz`
+  (reference `save_combine`).
+* Program serialization is the IR's JSON form (core/ir.py to_dict) instead
+  of the framework.proto wire format.
+* `save_inference_model` prunes the program to the feed→fetch slice like
+  the reference's Prune (framework/prune.cc) before export.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.parse
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .core.ir import Block, OpDesc, Program, Variable, default_main_program
+from .core.registry import EMPTY_VAR
+from .core.scope import Scope, global_scope
+
+__all__ = [
+    "save_vars", "save_params", "save_persistables", "load_vars", "load_params",
+    "load_persistables", "save_inference_model", "load_inference_model",
+    "get_program_state", "set_program_state", "save", "load", "prune_program",
+]
+
+_MODEL_FILE = "__model__.json"
+
+
+def _encode_name(name: str) -> str:
+    """Var names may contain '/', '@', … — make them filesystem-safe."""
+    return urllib.parse.quote(name, safe="")
+
+
+def _decode_name(fname: str) -> str:
+    return urllib.parse.unquote(fname)
+
+
+def _to_numpy(v) -> np.ndarray:
+    import jax
+
+    if hasattr(v, "addressable_shards"):
+        v = jax.device_get(v)
+    return np.asarray(v)
+
+
+# ---------------------------------------------------------------------------
+# Program pruning (reference: framework/prune.cc, executor.py _prune_program)
+# ---------------------------------------------------------------------------
+
+def prune_program(program: Program, feed_names: Sequence[str],
+                  fetch_names: Sequence[str]) -> Program:
+    """Backward-slice block 0 to the ops needed to compute `fetch_names`
+    from `feed_names` (+ scope residents). Sub-blocks referenced by kept
+    control-flow ops are preserved untouched."""
+    pruned = program.clone(for_test=True)
+    block = pruned.global_block()
+    feed_set = set(feed_names)
+    needed = set(fetch_names)
+    kept_rev: List[OpDesc] = []
+    for op in reversed(block.ops):
+        outs = [n for n in op.output_names() if n != EMPTY_VAR]
+        if any(n in needed for n in outs):
+            kept_rev.append(op)
+            for n in op.input_names():
+                if n != EMPTY_VAR and n not in feed_set:
+                    needed.add(n)
+    block.ops = list(reversed(kept_rev))
+    # drop vars no op touches and that aren't feeds/fetches
+    used = set(feed_names) | set(fetch_names)
+    for op in block.ops:
+        used.update(op.input_names())
+        used.update(op.output_names())
+    block.vars = {n: v for n, v in block.vars.items() if n in used}
+    pruned._bump_version()
+    return pruned
+
+
+# ---------------------------------------------------------------------------
+# Variable save/load (reference: io.py save_vars:224 / load_vars)
+# ---------------------------------------------------------------------------
+
+def _select_vars(program: Program, vars=None, predicate=None) -> List[Variable]:
+    if vars is not None:
+        out = []
+        for v in vars:
+            out.append(program.global_block().var(v) if isinstance(v, str) else v)
+        return out
+    pred = predicate or (lambda v: True)
+    seen = {}
+    for v in program.list_vars():
+        if v.name not in seen and pred(v):
+            seen[v.name] = v
+    return list(seen.values())
+
+
+def is_persistable(var: Variable) -> bool:
+    return bool(var.persistable)
+
+
+def is_parameter(var: Variable) -> bool:
+    return bool(getattr(var.desc, "is_parameter", False))
+
+
+def save_vars(executor=None, dirname: str = "", main_program: Optional[Program] = None,
+              vars=None, predicate=None, filename: Optional[str] = None,
+              scope: Optional[Scope] = None):
+    """Write selected vars to `dirname` — one `.npy` per var, or a single
+    `.npz` when `filename` is given (reference `save_combine` op)."""
+    program = main_program or default_main_program()
+    scope = global_scope() if scope is None else scope
+    targets = _select_vars(program, vars, predicate)
+    os.makedirs(dirname, exist_ok=True)
+    arrays: Dict[str, np.ndarray] = {}
+    for v in targets:
+        val = scope.find_var(v.name)
+        if val is None:
+            raise RuntimeError(
+                f"save_vars: variable '{v.name}' has no value in scope — "
+                f"run the startup program first")
+        arrays[v.name] = _to_numpy(val)
+    if filename is not None:
+        np.savez(os.path.join(dirname, filename),
+                 **{_encode_name(k): a for k, a in arrays.items()})
+    else:
+        for name, a in arrays.items():
+            np.save(os.path.join(dirname, _encode_name(name) + ".npy"), a)
+    return sorted(arrays)
+
+
+def load_vars(executor=None, dirname: str = "", main_program: Optional[Program] = None,
+              vars=None, predicate=None, filename: Optional[str] = None,
+              scope: Optional[Scope] = None):
+    program = main_program or default_main_program()
+    scope = global_scope() if scope is None else scope
+    targets = _select_vars(program, vars, predicate)
+    if filename is not None:
+        path = os.path.join(dirname, filename)
+        if not path.endswith(".npz"):
+            path += ".npz"
+        with np.load(path) as z:
+            stored = {_decode_name(k): z[k] for k in z.files}
+    else:
+        stored = None
+    loaded = []
+    for v in targets:
+        if stored is not None:
+            if v.name not in stored:
+                raise RuntimeError(f"load_vars: '{v.name}' missing from {filename}")
+            a = stored[v.name]
+        else:
+            path = os.path.join(dirname, _encode_name(v.name) + ".npy")
+            if not os.path.exists(path):
+                raise RuntimeError(f"load_vars: file not found for '{v.name}': {path}")
+            a = np.load(path)
+        if v.shape is not None and (len(v.shape) != len(a.shape) or not all(
+                e in (-1, s) for e, s in zip(v.shape, a.shape))):
+            raise RuntimeError(
+                f"load_vars: shape mismatch for '{v.name}': "
+                f"checkpoint {a.shape} vs program {tuple(v.shape)}")
+        scope.set(v.name, np.asarray(a, dtype=np.dtype(v.dtype)))
+        loaded.append(v.name)
+    return sorted(loaded)
+
+
+def save_params(executor=None, dirname: str = "", main_program=None, filename=None,
+                scope=None):
+    return save_vars(executor, dirname, main_program, predicate=is_parameter,
+                     filename=filename, scope=scope)
+
+
+def load_params(executor=None, dirname: str = "", main_program=None, filename=None,
+                scope=None):
+    return load_vars(executor, dirname, main_program, predicate=is_parameter,
+                     filename=filename, scope=scope)
+
+
+def save_persistables(executor=None, dirname: str = "", main_program=None,
+                      filename=None, scope=None):
+    """Save every persistable var — params AND optimizer state
+    (reference: io.py:598)."""
+    return save_vars(executor, dirname, main_program, predicate=is_persistable,
+                     filename=filename, scope=scope)
+
+
+def load_persistables(executor=None, dirname: str = "", main_program=None,
+                      filename=None, scope=None):
+    return load_vars(executor, dirname, main_program, predicate=is_persistable,
+                     filename=filename, scope=scope)
+
+
+# ---------------------------------------------------------------------------
+# Whole-scope program state (reference: io.py get_program_state / 2.0 static.save)
+# ---------------------------------------------------------------------------
+
+def get_program_state(program: Optional[Program] = None,
+                      scope: Optional[Scope] = None) -> Dict[str, np.ndarray]:
+    program = default_main_program() if program is None else program
+    scope = global_scope() if scope is None else scope
+    out = {}
+    for v in _select_vars(program, predicate=is_persistable):
+        val = scope.find_var(v.name)
+        if val is not None:
+            out[v.name] = _to_numpy(val)
+    return out
+
+
+def set_program_state(program: Optional[Program] = None,
+                      state: Optional[Dict[str, np.ndarray]] = None,
+                      scope: Optional[Scope] = None):
+    program = default_main_program() if program is None else program
+    scope = global_scope() if scope is None else scope
+    state = state or {}
+    for v in _select_vars(program, predicate=is_persistable):
+        if v.name in state:
+            scope.set(v.name, np.asarray(state[v.name]))
+
+
+def save(program: Program, model_path: str, scope: Optional[Scope] = None):
+    """2.0-style `paddle.static.save`: params → `.pdparams`, other
+    persistables (opt state) → `.pdopt`, program → `.pdmodel` (JSON)."""
+    scope = global_scope() if scope is None else scope
+    base = model_path
+    params = {v.name: _to_numpy(scope.find_var(v.name))
+              for v in _select_vars(program, predicate=is_parameter)
+              if scope.find_var(v.name) is not None}
+    others = {v.name: _to_numpy(scope.find_var(v.name))
+              for v in _select_vars(program, predicate=is_persistable)
+              if not is_parameter(v) and scope.find_var(v.name) is not None}
+    os.makedirs(os.path.dirname(os.path.abspath(base)), exist_ok=True)
+    np.savez(base + ".pdparams.npz", **{_encode_name(k): v for k, v in params.items()})
+    np.savez(base + ".pdopt.npz", **{_encode_name(k): v for k, v in others.items()})
+    with open(base + ".pdmodel", "w") as f:
+        json.dump(program.to_dict(), f)
+
+
+def load(program: Program, model_path: str, executor=None,
+         scope: Optional[Scope] = None):
+    scope = global_scope() if scope is None else scope
+    for suffix in (".pdparams.npz", ".pdopt.npz"):
+        path = model_path + suffix
+        if os.path.exists(path):
+            with np.load(path) as z:
+                for k in z.files:
+                    scope.set(_decode_name(k), np.asarray(z[k]))
+
+
+# ---------------------------------------------------------------------------
+# Inference model export (reference: io.py save_inference_model:1164)
+# ---------------------------------------------------------------------------
+
+def save_inference_model(dirname: str, feeded_var_names: Sequence[str],
+                         target_vars: Sequence[Variable], executor=None,
+                         main_program: Optional[Program] = None,
+                         model_filename: Optional[str] = None,
+                         params_filename: Optional[str] = None,
+                         scope: Optional[Scope] = None) -> List[str]:
+    """Export a pruned inference program + its parameters.
+
+    Layout: `dirname/__model__.json` (program + feed/fetch metadata) and
+    per-var `.npy` files (or combined `params_filename.npz`)."""
+    program = main_program or default_main_program()
+    scope = global_scope() if scope is None else scope
+    fetch_names = [t.name if isinstance(t, Variable) else str(t)
+                   for t in target_vars]
+    inference_program = prune_program(program, feeded_var_names, fetch_names)
+
+    os.makedirs(dirname, exist_ok=True)
+    doc = {
+        "program": inference_program.to_dict(),
+        "feed_names": list(feeded_var_names),
+        "fetch_names": fetch_names,
+        "format_version": 1,
+    }
+    with open(os.path.join(dirname, model_filename or _MODEL_FILE), "w") as f:
+        json.dump(doc, f)
+
+    save_vars(executor, dirname, inference_program, predicate=is_persistable,
+              filename=params_filename, scope=scope)
+    return fetch_names
+
+
+def load_inference_model(dirname: str, executor=None,
+                         model_filename: Optional[str] = None,
+                         params_filename: Optional[str] = None,
+                         scope: Optional[Scope] = None):
+    """Returns (program, feed_names, fetch_names); params go into `scope`
+    (reference: io.py load_inference_model:1374)."""
+    scope = global_scope() if scope is None else scope
+    with open(os.path.join(dirname, model_filename or _MODEL_FILE)) as f:
+        doc = json.load(f)
+    program = Program.from_dict(doc["program"])
+    load_vars(executor, dirname, program, predicate=is_persistable,
+              filename=params_filename, scope=scope)
+    return program, doc["feed_names"], doc["fetch_names"]

@@ -60,6 +60,7 @@ class Conv2D(Layer):
         k = _ntuple(kernel_size)
         self._stride, self._padding, self._dilation = stride, padding, dilation
         self._groups = groups
+        self._padding_mode = padding_mode
         self._data_format = data_format
         fan_in = in_channels * k[0] * k[1]
         bound = 1.0 / np.sqrt(fan_in)
@@ -72,8 +73,15 @@ class Conv2D(Layer):
             if bias_attr is None else None)
 
     def forward(self, x):
+        padding = self._padding
+        if self._padding_mode != "zeros":
+            # non-zero padding modes: explicit pad2d first, then a VALID conv
+            p = _ntuple(self._padding)
+            x = F.pad(x, [p[0], p[0], p[1], p[1]], mode=self._padding_mode,
+                      data_format=self._data_format)
+            padding = 0
         return F.conv2d(x, self.weight, self.bias, stride=self._stride,
-                        padding=self._padding, dilation=self._dilation,
+                        padding=padding, dilation=self._dilation,
                         groups=self._groups, data_format=self._data_format)
 
 
@@ -400,13 +408,15 @@ class CrossEntropyLoss(Layer):
     def __init__(self, weight=None, ignore_index=-100, reduction="mean",
                  soft_label=False, axis=-1, name=None):
         super().__init__()
+        self._weight = weight
         self._ignore = ignore_index
         self._reduction = reduction
         self._soft = soft_label
         self._axis = axis
 
     def forward(self, input, label):
-        return F.cross_entropy(input, label, ignore_index=self._ignore,
+        return F.cross_entropy(input, label, weight=self._weight,
+                               ignore_index=self._ignore,
                                reduction=self._reduction,
                                soft_label=self._soft, axis=self._axis)
 
@@ -433,21 +443,28 @@ class NLLLoss(Layer):
     def __init__(self, weight=None, ignore_index=-100, reduction="mean",
                  name=None):
         super().__init__()
+        self._weight = weight
+        self._ignore = ignore_index
         self._reduction = reduction
 
     def forward(self, input, label):
-        return F.nll_loss(input, label, reduction=self._reduction)
+        return F.nll_loss(input, label, weight=self._weight,
+                          ignore_index=self._ignore,
+                          reduction=self._reduction)
 
 
 class BCEWithLogitsLoss(Layer):
     def __init__(self, weight=None, reduction="mean", pos_weight=None,
                  name=None):
         super().__init__()
+        self._weight = weight
+        self._pos_weight = pos_weight
         self._reduction = reduction
 
     def forward(self, logit, label):
-        return F.binary_cross_entropy_with_logits(logit, label,
-                                                  reduction=self._reduction)
+        return F.binary_cross_entropy_with_logits(
+            logit, label, weight=self._weight, reduction=self._reduction,
+            pos_weight=self._pos_weight)
 
 
 class SmoothL1Loss(Layer):

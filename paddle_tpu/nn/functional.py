@@ -57,7 +57,7 @@ def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
     d = [dilation] * 2 if isinstance(dilation, int) else list(dilation)
     out = _op("conv2d", {"Input": x, "Filter": weight},
               {"strides": s, "paddings": p, "dilations": d, "groups": groups,
-               "data_format": data_format})
+               "data_format": data_format}, out_slot="Output")
     if bias is not None:
         axis = 1 if data_format == "NCHW" else -1
         out = _op("elementwise_add", {"X": out, "Y": bias}, {"axis": axis})
@@ -71,7 +71,8 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
     p = [padding] * 2 if isinstance(padding, int) else list(padding)
     d = [dilation] * 2 if isinstance(dilation, int) else list(dilation)
     out = _op("conv2d_transpose", {"Input": x, "Filter": weight},
-              {"strides": s, "paddings": p, "dilations": d, "groups": groups})
+              {"strides": s, "paddings": p, "dilations": d, "groups": groups},
+              out_slot="Output")
     if bias is not None:
         out = _op("elementwise_add", {"X": out, "Y": bias}, {"axis": 1})
     return out
@@ -260,7 +261,11 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
     if size is not None:
         attrs["out_h"], attrs["out_w"] = int(size[0]), int(size[1])
     if scale_factor is not None:
-        attrs["scale"] = float(scale_factor)
+        if isinstance(scale_factor, (list, tuple)):
+            attrs["scale_h"] = float(scale_factor[0])
+            attrs["scale_w"] = float(scale_factor[1])
+        else:
+            attrs["scale"] = float(scale_factor)
     return _op("interpolate", {"X": x}, attrs)
 
 
@@ -297,10 +302,15 @@ def softmax_with_cross_entropy(logits, label, soft_label=False,
 
 def cross_entropy(input, label, weight=None, ignore_index=-100,
                   reduction="mean", soft_label=False, axis=-1, name=None):
+    if weight is not None and not soft_label:
+        # per-class weights: route through nll_loss, which owns the
+        # weighted-mean semantics (divide by summed weights of valid entries)
+        return nll_loss(log_softmax(input, axis=axis), label, weight=weight,
+                        ignore_index=ignore_index, reduction=reduction)
     loss = softmax_with_cross_entropy(input, label, soft_label=soft_label,
                                       ignore_index=ignore_index, axis=axis)
-    if reduction == "mean" and not soft_label and ignore_index != -100:
-        # mean over the NON-ignORED entries only (reference:
+    if reduction == "mean" and not soft_label:
+        # mean over the NON-ignored entries only (reference:
         # python/paddle/nn/functional/loss.py cross_entropy divides by the
         # valid-token count, not the batch size)
         return _masked_mean(loss, label, ignore_index)
@@ -363,8 +373,23 @@ def l1_loss(input, label, reduction="mean", name=None):
 def binary_cross_entropy_with_logits(logit, label, weight=None,
                                      reduction="mean", pos_weight=None,
                                      name=None):
-    loss = _op("sigmoid_cross_entropy_with_logits", {"X": logit, "Label": label},
-               {})
+    if weight is None and pos_weight is None:
+        loss = _op("sigmoid_cross_entropy_with_logits",
+                   {"X": logit, "Label": label}, {})
+    else:
+        # loss = pos_weight·z·softplus(−x) + (1−z)·softplus(x)  [torch/paddle]
+        sp_neg = _op("softplus",
+                     {"X": _op("scale", {"X": logit}, {"scale": -1.0})}, {})
+        sp_pos = _op("softplus", {"X": logit}, {})
+        pos_term = _op("elementwise_mul", {"X": label, "Y": sp_neg}, {})
+        if pos_weight is not None:
+            pos_term = _op("elementwise_mul",
+                           {"X": pos_term, "Y": pos_weight}, {"axis": -1})
+        one_minus = _op("scale", {"X": label}, {"scale": -1.0, "bias": 1.0})
+        neg_term = _op("elementwise_mul", {"X": one_minus, "Y": sp_pos}, {})
+        loss = _op("elementwise_add", {"X": pos_term, "Y": neg_term}, {})
+        if weight is not None:
+            loss = _op("elementwise_mul", {"X": loss, "Y": weight}, {"axis": -1})
     return _reduce_loss(loss, reduction)
 
 

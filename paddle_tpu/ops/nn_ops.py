@@ -250,9 +250,12 @@ def interpolate(ins, attrs):
     out_h = int(attrs.get("out_h", 0))
     out_w = int(attrs.get("out_w", 0))
     scale = attrs.get("scale", 0)
-    if (not out_h or not out_w) and scale:
-        out_h = int(x.shape[2] * scale)
-        out_w = int(x.shape[3] * scale)
+    scale_h = attrs.get("scale_h", scale)
+    scale_w = attrs.get("scale_w", scale)
+    if not out_h and scale_h:
+        out_h = int(x.shape[2] * scale_h)
+    if not out_w and scale_w:
+        out_w = int(x.shape[3] * scale_w)
     method = "nearest" if "nearest" in attrs.get("interp_method", "nearest") else "linear"
     out = jax.image.resize(x, (x.shape[0], x.shape[1], out_h, out_w), method)
     return {"Out": out.astype(x.dtype)}
@@ -268,7 +271,8 @@ def pad2d(ins, attrs):
     pairs = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
     if mode == "constant":
         return {"Out": jnp.pad(x, pairs, constant_values=attrs.get("pad_value", 0.0))}
-    jmode = {"reflect": "reflect", "edge": "edge"}[mode]
+    jmode = {"reflect": "reflect", "edge": "edge", "replicate": "edge",
+             "circular": "wrap"}[mode]
     return {"Out": jnp.pad(x, pairs, mode=jmode)}
 
 

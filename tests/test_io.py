@@ -1,0 +1,105 @@
+"""io.py: persistable save/load, pruning, inference-model export.
+
+Mirrors the reference's test_inference_model_io.py / save-load suites
+(python/paddle/fluid/tests/unittests/test_io_save_load.py style).
+"""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _model(optimizer=True):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [8])
+        label = layers.data("label", [1], dtype="int64")
+        h = layers.fc(x, 16, act="relu")
+        logits = layers.fc(h, 4)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        if optimizer:
+            pt.optimizer.AdamOptimizer(1e-3).minimize(loss)
+    return main, startup, logits, loss
+
+
+def _feed(n=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return {"x": rng.randn(n, 8).astype(np.float32),
+            "label": rng.randint(0, 4, (n, 1)).astype(np.int64)}
+
+
+def test_save_load_persistables_roundtrip(tmp_path, scope):
+    main, startup, logits, loss = _model()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope, use_compiled=False)
+    feed = _feed()
+    for _ in range(3):
+        exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+    ref, = exe.run(main.clone(for_test=True), feed=feed, fetch_list=[logits],
+                   scope=scope)
+    saved = pt.io.save_persistables(exe, str(tmp_path / "ckpt"), main, scope=scope)
+    assert saved  # includes adam moments, not just params
+    assert any("moment" in n.lower() or "beta" in n.lower() for n in saved)
+
+    s2 = pt.Scope()
+    pt.io.load_persistables(exe, str(tmp_path / "ckpt"), main, scope=s2)
+    out, = exe.run(main.clone(for_test=True), feed=feed, fetch_list=[logits],
+                   scope=s2)
+    np.testing.assert_allclose(ref, out, rtol=1e-5, atol=1e-6)
+
+
+def test_save_load_combined_file(tmp_path, scope):
+    main, startup, logits, _ = _model(optimizer=False)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope, use_compiled=False)
+    pt.io.save_params(exe, str(tmp_path), main, filename="params.npz", scope=scope)
+    s2 = pt.Scope()
+    pt.io.load_params(exe, str(tmp_path), main, filename="params.npz", scope=s2)
+    feed = _feed()
+    a, = exe.run(main, feed=feed, fetch_list=[logits], scope=scope)
+    b, = exe.run(main, feed=feed, fetch_list=[logits], scope=s2)
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_prune_program_drops_backward(scope):
+    main, startup, logits, loss = _model()
+    pruned = pt.io.prune_program(main, ["x"], [logits.name])
+    kept_types = {op.type for op in pruned.global_block().ops}
+    assert "sgd" not in kept_types and "adam" not in kept_types
+    assert not any(op.is_backward_op() for op in pruned.global_block().ops)
+    # label path must be gone: logits don't depend on it
+    for op in pruned.global_block().ops:
+        assert "label" not in op.input_names()
+
+
+def test_save_load_inference_model(tmp_path, scope):
+    main, startup, logits, loss = _model()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope, use_compiled=False)
+    feed = _feed()
+    exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+    ref, = exe.run(main.clone(for_test=True), feed=feed, fetch_list=[logits],
+                   scope=scope)
+    pt.io.save_inference_model(str(tmp_path / "model"), ["x"], [logits], exe,
+                               main, scope=scope)
+
+    s2 = pt.Scope()
+    prog, feeds, fetches = pt.io.load_inference_model(str(tmp_path / "model"),
+                                                      exe, scope=s2)
+    assert feeds == ["x"]
+    out, = exe.run(prog, feed={"x": feed["x"]}, fetch_list=fetches, scope=s2)
+    np.testing.assert_allclose(ref, out, rtol=1e-5, atol=1e-6)
+
+
+def test_static_save_load_state(tmp_path, scope):
+    main, startup, logits, loss = _model()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope, use_compiled=False)
+    exe.run(main, feed=_feed(), fetch_list=[loss], scope=scope)
+    state = pt.io.get_program_state(main, scope=scope)
+    pt.io.save(main, str(tmp_path / "m" / "model"), scope=scope)
+    s2 = pt.Scope()
+    pt.io.load(main, str(tmp_path / "m" / "model"), scope=s2)
+    for k, v in state.items():
+        np.testing.assert_array_equal(v, np.asarray(s2.find_var(k)))
