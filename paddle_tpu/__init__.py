@@ -15,6 +15,10 @@ Architecture (see SURVEY.md for the full blueprint):
 
 from . import initializer, layers, optimizer, regularizer  # noqa: F401
 from . import io  # noqa: F401
+from . import reader  # noqa: F401
+from .reader import DataLoader  # noqa: F401
+
+io.DataLoader = DataLoader  # fluid.io.DataLoader compat
 from . import ops as _ops  # registers all op lowerings  # noqa: F401
 from .core import (CPUPlace, CUDAPlace, Executor, Parameter, Program,  # noqa: F401
                    Scope, TPUPlace, Variable, XLAPlace, append_backward,

@@ -3,3 +3,6 @@
 from .nn import *  # noqa: F401,F403
 from .nn import (_elementwise_binary, _compare, _getitem, _to_var,  # noqa: F401
                  _unary, _binary, _reduce_layer)
+from .learning_rate_scheduler import (  # noqa: F401
+    cosine_decay, exponential_decay, inverse_time_decay, linear_lr_warmup,
+    natural_exp_decay, noam_decay, piecewise_decay, polynomial_decay)

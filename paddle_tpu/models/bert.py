@@ -150,9 +150,10 @@ def bert_encoder(src_ids, sent_ids, pos_ids, input_mask, cfg: BertConfig,
                           bias_attr=ParamAttr(name="emb_ln_bias"))
     x = layers.dropout(x, cfg.hidden_dropout_prob, is_test=is_test,
                        dropout_implementation="upscale_in_train")
-    # additive attention bias from the [B,S] 0/1 mask → [B,1,1,S]
+    # additive attention bias from the [B,S] 0/1 mask → [B,1,1,S]:
+    # (mask-1)*1e4 → 0 on real tokens, -1e4 on padding
     mask = layers.unsqueeze(input_mask, [1, 2])
-    attn_bias = layers.scale(mask, scale=-10000.0, bias=1.0,
+    attn_bias = layers.scale(mask, scale=10000.0, bias=-1.0,
                              bias_after_scale=False)
     attn_bias.stop_gradient = True
     for i in range(cfg.num_hidden_layers):

@@ -1,0 +1,49 @@
+"""word2vec (skip-gram-style N-gram LM) — the reference book model
+tests/book/test_word2vec.py:  four context words → embeddings → concat →
+hidden fc → softmax over vocab. Exercises embedding/lookup_table, concat,
+and the sparse-gradient path the reference used SelectedRows for (here the
+scatter-add falls out of the lookup vjp)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import layers
+from ..core.ir import Program, program_guard
+from ..param_attr import ParamAttr
+
+EMBED_SIZE = 32
+HIDDEN_SIZE = 256
+N = 5  # context window: 4 inputs predict the 5th
+
+
+def build_word2vec_program(dict_size: int, batch_size: int = -1,
+                           lr: float = 1e-3, with_optimizer: bool = True):
+    """Feeds: firstw..fourthw, nextw — [B,1] int64. Fetches: loss."""
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        words = [layers.static_data(n, [batch_size, 1], "int64")
+                 for n in ("firstw", "secondw", "thirdw", "fourthw")]
+        nextw = layers.static_data("nextw", [batch_size, 1], "int64")
+        embs = []
+        for w in words:
+            e = layers.embedding(w, [dict_size, EMBED_SIZE],
+                                 param_attr=ParamAttr(name="shared_w"),
+                                 is_sparse=True)
+            embs.append(layers.reshape(e, [0, EMBED_SIZE]))
+        concat = layers.concat(embs, axis=1)
+        hidden = layers.fc(concat, HIDDEN_SIZE, act="sigmoid")
+        logits = layers.fc(hidden, dict_size)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, nextw))
+        if with_optimizer:
+            from .. import optimizer as opt_mod
+
+            opt_mod.SGDOptimizer(lr).minimize(loss)
+    feeds = {v.name: v for v in words + [nextw]}
+    return main, startup, feeds, dict(loss=loss)
+
+
+def synthetic_batch(dict_size: int, batch_size: int, seed: int = 0):
+    rng = np.random.RandomState(seed)
+    return {n: rng.randint(0, dict_size, (batch_size, 1)).astype(np.int64)
+            for n in ("firstw", "secondw", "thirdw", "fourthw", "nextw")}

@@ -5,7 +5,7 @@ needed by the BASELINE workload ladder plus the common API surface, each as a
 JAX lowering in the registry (see core/registry.py).
 """
 
-from . import math_ops, nn_ops, optimizer_ops, tensor_ops  # noqa: F401
+from . import lr_ops, math_ops, nn_ops, optimizer_ops, tensor_ops  # noqa: F401
 
 try:  # modules added as the build widens
     from . import amp_ops  # noqa: F401

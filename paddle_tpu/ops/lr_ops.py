@@ -1,0 +1,81 @@
+"""Learning-rate schedule op.
+
+The reference implements LR schedules as small op subgraphs reading a
+`@LR_DECAY_COUNTER@` global step (python/paddle/fluid/layers/
+learning_rate_scheduler.py, ops in operators/ — increment, scale, cond).
+Here one `lr_schedule` op computes the current LR from the executor's
+global step (`@STEP_COUNTER@`, threaded into lowerings as attrs["__step__"])
+— a single fused XLA expression instead of an op chain.
+"""
+
+from __future__ import annotations
+
+import math
+
+from ..core.registry import register_op
+
+
+@register_op("lr_schedule", non_diff_inputs=("BaseLR", "Step"))
+def lr_schedule(ins, attrs):
+    import jax.numpy as jnp
+
+    step_in = ins.get("Step", [None])[0]
+    if step_in is not None:
+        step = jnp.reshape(jnp.asarray(step_in, jnp.float32), ())
+    else:  # fallback: executor global step (dygraph micro-programs)
+        step = jnp.asarray(attrs.get("__step__", 0), jnp.float32)
+    sched = attrs["schedule"]
+    lr0 = float(attrs.get("learning_rate", 1.0))
+
+    if sched == "noam":
+        d_model = float(attrs["d_model"])
+        warmup = float(attrs["warmup_steps"])
+        s = step + 1.0
+        lr = lr0 * d_model ** -0.5 * jnp.minimum(s ** -0.5, s * warmup ** -1.5)
+    elif sched in ("exponential", "natural_exp", "inverse_time"):
+        ds = float(attrs["decay_steps"])
+        dr = float(attrs["decay_rate"])
+        p = step / ds
+        if attrs.get("staircase", False):
+            p = jnp.floor(p)
+        if sched == "exponential":
+            lr = lr0 * dr ** p
+        elif sched == "natural_exp":
+            lr = lr0 * jnp.exp(-dr * p)
+        else:
+            lr = lr0 / (1.0 + dr * p)
+    elif sched == "polynomial":
+        ds = float(attrs["decay_steps"])
+        end_lr = float(attrs.get("end_learning_rate", 1e-4))
+        power = float(attrs.get("power", 1.0))
+        if attrs.get("cycle", False):
+            div = jnp.maximum(jnp.ceil(step / ds), 1.0)
+            horizon = ds * div
+            s = step
+        else:
+            horizon = ds
+            s = jnp.minimum(step, ds)
+        lr = (lr0 - end_lr) * (1.0 - s / horizon) ** power + end_lr
+    elif sched == "piecewise":
+        bounds = jnp.asarray(attrs["boundaries"], jnp.float32)
+        values = jnp.asarray(attrs["values"], jnp.float32)
+        idx = jnp.sum((step >= bounds).astype(jnp.int32))
+        lr = values[idx]
+    elif sched == "cosine":
+        spe = float(attrs["step_each_epoch"])
+        epochs = float(attrs["epochs"])
+        epoch = jnp.floor(step / spe)
+        lr = 0.5 * lr0 * (jnp.cos(epoch * math.pi / epochs) + 1.0)
+    elif sched == "linear_warmup":
+        warmup = float(attrs["warmup_steps"])
+        start_lr = float(attrs["start_lr"])
+        end_lr = float(attrs["end_lr"])
+        base = ins.get("BaseLR", [None])[0]
+        if base is None:
+            base = jnp.asarray(attrs["base_lr"], jnp.float32)
+        base = jnp.reshape(jnp.asarray(base, jnp.float32), ())
+        warm = start_lr + (end_lr - start_lr) * jnp.minimum(step, warmup) / warmup
+        lr = jnp.where(step < warmup, warm, base)
+    else:
+        raise ValueError(f"unknown lr schedule '{sched}'")
+    return {"Out": jnp.reshape(lr, (1,)).astype(jnp.float32)}

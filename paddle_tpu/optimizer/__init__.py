@@ -62,6 +62,10 @@ class Optimizer:
             return
         if callable(lr):  # LR scheduler object from .lr
             self._lr_var = lr._create_var()
+            if hasattr(lr, "_bind"):
+                # host-driven 2.0 scheduler: step() pushes into this scope
+                # (bound as a provider so scope resets/replacements track)
+                lr._bind(self._lr_scope, self._lr_var.name)
             return
         self._lr_var = layers_nn.create_global_var(
             [1], float(lr), "float32", persistable=True,
@@ -578,3 +582,5 @@ RMSProp = RMSPropOptimizer
 Lamb = LambOptimizer
 DecayedAdagrad = DecayedAdagradOptimizer
 Ftrl = FtrlOptimizer
+
+from . import lr  # noqa: E402,F401  (2.0-style host-driven LR schedulers)

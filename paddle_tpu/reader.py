@@ -1,0 +1,553 @@
+"""Data pipeline: reader decorators + Dataset/Sampler/DataLoader.
+
+Capability mirror of the reference's three data stacks re-designed for TPU:
+
+* reader decorators (python/paddle/reader/decorator.py — batch, shuffle,
+  buffered, cache, chain, compose, map_readers, xmap_readers): pure-Python
+  generator combinators, kept 1:1.
+* `DataLoader.from_generator` (python/paddle/fluid/reader.py:147): the
+  reference pushes LoDTensors through a C++ BlockingQueue into
+  `create_py_reader` ops; here a background thread prefetches ready
+  batches into a bounded queue and (optionally) `jax.device_put`s them so
+  host→device copy overlaps the previous step (the buffered_reader.cc
+  double-buffering role).
+* `DataLoader(dataset, ...)` map-style path (fluid/reader.py DataLoader +
+  dataloader/dataloader_iter.py): Dataset/BatchSampler/collate with a
+  thread pool standing in for the mmap-shared-memory worker processes
+  (batches are numpy; XLA owns the device transfer — no per-worker device
+  context to isolate, so threads suffice on the host side).
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import random as _random
+import threading
+from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    # decorators
+    "batch", "shuffle", "buffered", "cache", "chain", "compose", "firstn",
+    "map_readers", "xmap_readers", "ComposeNotAligned",
+    # datasets / samplers / loader
+    "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
+    "Sampler", "SequenceSampler", "RandomSampler", "BatchSampler",
+    "DataLoader", "default_collate_fn",
+]
+
+
+# ---------------------------------------------------------------------------
+# reader decorators (reference: python/paddle/reader/decorator.py)
+# ---------------------------------------------------------------------------
+
+def batch(reader: Callable, batch_size: int, drop_last: bool = False):
+    """Group samples into lists of `batch_size`."""
+
+    def batched():
+        b = []
+        for sample in reader():
+            b.append(sample)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batched
+
+
+def shuffle(reader: Callable, buf_size: int, seed: Optional[int] = None):
+    """Pool-based shuffle with a `buf_size` reservoir."""
+
+    def shuffled():
+        rng = _random.Random(seed)
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) >= buf_size:
+                rng.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            rng.shuffle(buf)
+            yield from buf
+
+    return shuffled
+
+
+def buffered(reader: Callable, size: int):
+    """Background-thread prefetch of up to `size` samples."""
+
+    _end = object()
+
+    def buffered_reader():
+        q: queue.Queue = queue.Queue(maxsize=size)
+        err: List[BaseException] = []
+
+        def produce():
+            try:
+                for sample in reader():
+                    q.put(sample)
+            except BaseException as e:  # surface worker errors to consumer
+                err.append(e)
+            finally:
+                q.put(_end)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is _end:
+                break
+            yield item
+        if err:
+            raise err[0]
+
+    return buffered_reader
+
+
+def cache(reader: Callable):
+    all_data: List[Any] = []
+    filled = [False]
+
+    def cached():
+        if not filled[0]:
+            all_data.extend(reader())
+            filled[0] = True
+        yield from all_data
+
+    return cached
+
+
+def chain(*readers):
+    def chained():
+        for r in readers:
+            yield from r()
+
+    return chained
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def compose(*readers, check_alignment: bool = True):
+    """Zip readers into flat tuples of their samples. With
+    check_alignment=True (default), raises ComposeNotAligned if readers have
+    different lengths (reference: reader/decorator.py compose); with False,
+    stops at the longest reader, padding missing slots with None."""
+
+    _missing = object()
+
+    def composed():
+        iters = [r() for r in readers]
+        for items in itertools.zip_longest(*iters, fillvalue=_missing):
+            if check_alignment and any(i is _missing for i in items):
+                raise ComposeNotAligned(
+                    "compose: input readers yielded different lengths")
+            items = tuple(None if i is _missing else i for i in items)
+            yield tuple(x for i in items
+                        for x in (i if isinstance(i, tuple) else (i,)))
+
+    return composed
+
+
+def firstn(reader: Callable, n: int):
+    def firstn_reader():
+        yield from itertools.islice(reader(), n)
+
+    return firstn_reader
+
+
+def map_readers(func: Callable, *readers):
+    def mapped():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+
+    return mapped
+
+
+def xmap_readers(mapper: Callable, reader: Callable, process_num: int,
+                 buffer_size: int, order: bool = False):
+    """Parallel map over a reader with `process_num` worker threads."""
+
+    _end = object()
+
+    def xmapped():
+        in_q: queue.Queue = queue.Queue(buffer_size)
+        out_q: queue.Queue = queue.Queue(buffer_size)
+        errors: List[BaseException] = []
+
+        def feed():
+            try:
+                for i, sample in enumerate(reader()):
+                    in_q.put((i, sample))
+            except BaseException as e:
+                errors.append(e)
+            finally:
+                for _ in range(process_num):
+                    in_q.put(_end)
+
+        def work():
+            try:
+                while True:
+                    item = in_q.get()
+                    if item is _end:
+                        return
+                    i, sample = item
+                    out_q.put((i, mapper(sample)))
+            except BaseException as e:
+                errors.append(e)
+            finally:
+                # always post the sentinel so the consumer can't deadlock on
+                # a failed worker
+                out_q.put(_end)
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+        done = 0
+        pending = {}
+        next_idx = 0
+        while done < process_num:
+            item = out_q.get()
+            if item is _end:
+                done += 1
+                continue
+            i, mapped = item
+            if not order:
+                yield mapped
+            else:
+                pending[i] = mapped
+                while next_idx in pending:
+                    yield pending.pop(next_idx)
+                    next_idx += 1
+        if errors:
+            raise errors[0]
+        if order:
+            for i in sorted(pending):
+                yield pending[i]
+
+    return xmapped
+
+
+# ---------------------------------------------------------------------------
+# Dataset / Sampler (reference: python/paddle/fluid/dataloader/)
+# ---------------------------------------------------------------------------
+
+class Dataset:
+    """Map-style dataset: __getitem__ + __len__."""
+
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class IterableDataset(Dataset):
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        raise TypeError("IterableDataset is not subscriptable")
+
+    def __len__(self):
+        raise TypeError("IterableDataset has no len()")
+
+
+class TensorDataset(Dataset):
+    def __init__(self, tensors: Sequence[np.ndarray]):
+        self.tensors = [np.asarray(t) for t in tensors]
+        n = len(self.tensors[0])
+        if any(len(t) != n for t in self.tensors):
+            raise ValueError("all tensors must share dim 0")
+
+    def __getitem__(self, idx):
+        return tuple(t[idx] for t in self.tensors)
+
+    def __len__(self):
+        return len(self.tensors[0])
+
+
+class ComposeDataset(Dataset):
+    def __init__(self, datasets: Sequence[Dataset]):
+        self.datasets = list(datasets)
+
+    def __getitem__(self, idx):
+        out = []
+        for ds in self.datasets:
+            s = ds[idx]
+            out.extend(s if isinstance(s, tuple) else (s,))
+        return tuple(out)
+
+    def __len__(self):
+        return min(len(d) for d in self.datasets)
+
+
+class Sampler:
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self) -> Iterator[int]:
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement: bool = False,
+                 num_samples: Optional[int] = None, generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self._num_samples = num_samples
+        self.generator = generator
+
+    @property
+    def num_samples(self):
+        return self._num_samples or len(self.data_source)
+
+    def __iter__(self):
+        n = len(self.data_source)
+        rng = self.generator or np.random
+        if self.replacement:
+            return iter(rng.randint(0, n, self.num_samples).tolist())
+        idx = np.arange(n)
+        rng.shuffle(idx)
+        return iter(idx[:self.num_samples].tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class BatchSampler(Sampler):
+    """Yields lists of indices (reference: dataloader/batch_sampler.py)."""
+
+    def __init__(self, dataset=None, sampler: Optional[Sampler] = None,
+                 shuffle: bool = False, batch_size: int = 1,
+                 drop_last: bool = False):
+        super().__init__(dataset)
+        if sampler is None:
+            sampler = (RandomSampler(dataset) if shuffle
+                       else SequenceSampler(dataset))
+        self.sampler = sampler
+        self.batch_size = int(batch_size)
+        self.drop_last = drop_last
+
+    def __iter__(self):
+        b = []
+        for idx in self.sampler:
+            b.append(idx)
+            if len(b) == self.batch_size:
+                yield b
+                b = []
+        if b and not self.drop_last:
+            yield b
+
+    def __len__(self):
+        n = len(self.sampler)
+        return n // self.batch_size if self.drop_last else \
+            (n + self.batch_size - 1) // self.batch_size
+
+
+def default_collate_fn(batch_list):
+    """List of samples → stacked numpy arrays (field-wise)."""
+    first = batch_list[0]
+    if isinstance(first, (tuple, list)):
+        return tuple(default_collate_fn([s[i] for s in batch_list])
+                     for i in range(len(first)))
+    if isinstance(first, dict):
+        return {k: default_collate_fn([s[k] for s in batch_list])
+                for k in first}
+    return np.stack([np.asarray(s) for s in batch_list])
+
+
+# ---------------------------------------------------------------------------
+# DataLoader
+# ---------------------------------------------------------------------------
+
+_END = object()
+
+
+class _GeneratorLoader:
+    """from_generator loader: queue-fed, iterable (reference:
+    fluid/reader.py GeneratorLoader)."""
+
+    def __init__(self, feed_list=None, capacity: int = 16,
+                 return_list: bool = False, use_device_put: bool = True):
+        self.feed_list = feed_list or []
+        self.capacity = capacity
+        self.return_list = return_list
+        self.use_device_put = use_device_put
+        self._gen: Optional[Callable] = None
+        self._places = None
+
+    # -- configuration ----------------------------------------------------
+    def set_sample_generator(self, generator, batch_size: int,
+                             drop_last: bool = True, places=None):
+        self.set_sample_list_generator(
+            batch(lambda: generator(), batch_size, drop_last), places)
+        return self
+
+    def set_sample_list_generator(self, generator, places=None):
+        def to_batches():
+            for sample_list in generator():
+                yield default_collate_fn(sample_list)
+
+        self.set_batch_generator(to_batches, places)
+        return self
+
+    def set_batch_generator(self, generator, places=None):
+        self._gen = generator
+        self._places = places
+        return self
+
+    # -- iteration ---------------------------------------------------------
+    def __iter__(self):
+        if self._gen is None:
+            raise RuntimeError(
+                "DataLoader not configured — call set_sample_generator / "
+                "set_sample_list_generator / set_batch_generator first")
+        names = [getattr(v, "name", str(v)) for v in self.feed_list]
+        q: queue.Queue = queue.Queue(maxsize=self.capacity)
+        err: List[BaseException] = []
+
+        def produce():
+            try:
+                for b in self._gen():
+                    if self.use_device_put:
+                        import jax
+
+                        b = jax.tree.map(jax.device_put, b)
+                    q.put(b)
+            except BaseException as e:
+                err.append(e)
+            finally:
+                q.put(_END)
+
+        threading.Thread(target=produce, daemon=True).start()
+        while True:
+            item = q.get()
+            if item is _END:
+                break
+            if self.return_list or not names:
+                yield list(item) if isinstance(item, tuple) else [item]
+            else:
+                arrays = item if isinstance(item, (tuple, list)) else (item,)
+                yield dict(zip(names, arrays))
+        if err:
+            raise err[0]
+
+
+class DataLoader:
+    """Two construction modes, mirroring the reference:
+
+    * ``DataLoader.from_generator(feed_list, capacity)`` then
+      ``set_*_generator`` — iterable loader yielding feed dicts.
+    * ``DataLoader(dataset, batch_size=.., shuffle=..)`` — map-style with
+      sampler + collate + threaded workers.
+    """
+
+    def __init__(self, dataset: Optional[Dataset] = None, feed_list=None,
+                 places=None, return_list: bool = True,
+                 batch_sampler: Optional[BatchSampler] = None,
+                 batch_size: int = 1, shuffle: bool = False,
+                 drop_last: bool = False, collate_fn: Optional[Callable] = None,
+                 num_workers: int = 0, use_buffer_reader: bool = True,
+                 prefetch_factor: int = 2, timeout: float = 0,
+                 worker_init_fn=None):
+        self.dataset = dataset
+        self.feed_list = feed_list or []
+        self.return_list = return_list
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = int(num_workers)
+        self.prefetch_factor = prefetch_factor
+        self._iterable_dataset = isinstance(dataset, IterableDataset)
+        if self._iterable_dataset:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        else:
+            if batch_sampler is not None:
+                self.batch_sampler = batch_sampler
+            else:
+                self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
+                                                  batch_size=batch_size,
+                                                  drop_last=drop_last)
+
+    @staticmethod
+    def from_generator(feed_list=None, capacity: int = 16, iterable: bool = True,
+                       return_list: bool = False, use_double_buffer: bool = True,
+                       use_multiprocess: bool = False,
+                       drop_last: bool = True) -> _GeneratorLoader:
+        # use_double_buffer → device_put in the prefetch thread so the H2D
+        # copy overlaps the previous step (buffered_reader.cc role)
+        return _GeneratorLoader(feed_list, capacity, return_list,
+                                use_device_put=use_double_buffer)
+
+    def __len__(self):
+        if self._iterable_dataset:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)
+
+    def _fetch(self, indices):
+        samples = [self.dataset[i] for i in indices]
+        return self.collate_fn(samples)
+
+    def _emit(self, collated):
+        if self.return_list or not self.feed_list:
+            return list(collated) if isinstance(collated, tuple) else [collated]
+        names = [getattr(v, "name", str(v)) for v in self.feed_list]
+        arrays = collated if isinstance(collated, (tuple, list)) else (collated,)
+        return dict(zip(names, arrays))
+
+    def __iter__(self):
+        if self._iterable_dataset:
+            def gen():
+                b = []
+                for sample in self.dataset:
+                    b.append(sample)
+                    if len(b) == self.batch_size:
+                        yield self.collate_fn(b)
+                        b = []
+                if b and not self.drop_last:
+                    yield self.collate_fn(b)
+
+            for collated in gen():
+                yield self._emit(collated)
+            return
+
+        if self.num_workers <= 0:
+            for indices in self.batch_sampler:
+                yield self._emit(self._fetch(indices))
+            return
+
+        # threaded workers with in-order delivery
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(self.num_workers) as pool:
+            batches = list(self.batch_sampler)
+            depth = self.num_workers * self.prefetch_factor
+            futures: "queue.Queue" = queue.Queue()
+            it = iter(batches)
+            submitted = 0
+            for indices in itertools.islice(it, depth):
+                futures.put(pool.submit(self._fetch, indices))
+                submitted += 1
+            while submitted > 0:
+                f = futures.get()
+                submitted -= 1
+                nxt = next(it, None)
+                if nxt is not None:
+                    futures.put(pool.submit(self._fetch, nxt))
+                    submitted += 1
+                yield self._emit(f.result())

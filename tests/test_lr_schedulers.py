@@ -1,0 +1,131 @@
+"""LR schedules: fluid op-driven (layers.*_decay over the step counter) and
+2.0 host-driven (optimizer.lr.LRScheduler.step()).
+
+Mirrors reference test_learning_rate_scheduler.py: compares the in-program
+schedule against a python reference at several steps.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _run_schedule(make_lr, steps=6):
+    """Build loss + schedule + SGD, run `steps`, return lr value per step."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4])
+        loss = layers.mean(layers.fc(x, 1))
+        lr = make_lr()
+        pt.optimizer.SGDOptimizer(lr).minimize(loss)
+    scope = pt.Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope, use_compiled=False)
+    feed = {"x": np.zeros((2, 4), np.float32)}
+    out = []
+    for _ in range(steps):
+        lv, = exe.run(main, feed=feed, fetch_list=[lr.name], scope=scope)
+        out.append(float(np.asarray(lv).reshape(-1)[0]))
+    return out
+
+
+def test_exponential_decay_matches_formula():
+    got = _run_schedule(lambda: layers.exponential_decay(0.1, 2, 0.5))
+    expect = [0.1 * 0.5 ** (s / 2.0) for s in range(6)]
+    np.testing.assert_allclose(got, expect, rtol=1e-6)
+
+
+def test_exponential_decay_staircase():
+    got = _run_schedule(
+        lambda: layers.exponential_decay(0.1, 2, 0.5, staircase=True))
+    expect = [0.1 * 0.5 ** (s // 2) for s in range(6)]
+    np.testing.assert_allclose(got, expect, rtol=1e-6)
+
+
+def test_noam_decay_matches_formula():
+    got = _run_schedule(lambda: layers.noam_decay(64, 4, learning_rate=2.0))
+    expect = [2.0 * 64 ** -0.5 * min((s + 1) ** -0.5, (s + 1) * 4 ** -1.5)
+              for s in range(6)]
+    np.testing.assert_allclose(got, expect, rtol=1e-6)
+
+
+def test_piecewise_decay_boundaries():
+    got = _run_schedule(
+        lambda: layers.piecewise_decay([2, 4], [0.1, 0.01, 0.001]))
+    np.testing.assert_allclose(got, [0.1, 0.1, 0.01, 0.01, 0.001, 0.001],
+                               rtol=1e-6)
+
+
+def test_polynomial_decay():
+    got = _run_schedule(
+        lambda: layers.polynomial_decay(0.1, 4, end_learning_rate=0.01,
+                                        power=2.0))
+    expect = [(0.1 - 0.01) * (1 - min(s, 4) / 4.0) ** 2 + 0.01
+              for s in range(6)]
+    np.testing.assert_allclose(got, expect, rtol=1e-6)
+
+
+def test_cosine_decay():
+    got = _run_schedule(lambda: layers.cosine_decay(0.1, 2, 3))
+    expect = [0.5 * 0.1 * (math.cos((s // 2) * math.pi / 3) + 1)
+              for s in range(6)]
+    np.testing.assert_allclose(got, expect, rtol=1e-6)
+
+
+def test_linear_warmup_wraps_schedule():
+    got = _run_schedule(
+        lambda: layers.linear_lr_warmup(
+            layers.exponential_decay(0.1, 2, 0.5), 3, 0.0, 0.1))
+    for s, v in enumerate(got):
+        if s < 3:
+            assert abs(v - 0.1 * s / 3.0) < 1e-7
+        else:
+            assert abs(v - 0.1 * 0.5 ** (s / 2.0)) < 1e-7
+
+
+def test_scheduler_classes_host_driven():
+    lr = pt.optimizer.lr.StepDecay(0.5, step_size=2, gamma=0.1)
+    vals = []
+    for _ in range(5):
+        vals.append(lr())
+        lr.step()
+    np.testing.assert_allclose(vals, [0.5, 0.5, 0.05, 0.05, 0.005], rtol=1e-6)
+
+    cos = pt.optimizer.lr.CosineAnnealingDecay(1.0, T_max=4)
+    cos.step(2)
+    assert abs(cos() - 0.5) < 1e-7
+
+    warm = pt.optimizer.lr.LinearWarmup(
+        pt.optimizer.lr.ExponentialDecay(0.1, 0.5), 2, 0.0, 0.1)
+    warm.step(1)
+    assert abs(warm() - 0.05) < 1e-9
+    warm.step(4)  # 2 past warmup → wrapped at epoch 2
+    assert abs(warm() - 0.1 * 0.25) < 1e-9
+
+
+def test_reduce_on_plateau():
+    lr = pt.optimizer.lr.ReduceOnPlateau(0.1, patience=1, factor=0.5)
+    for m in [1.0, 1.0, 1.0]:   # no improvement
+        lr.step(m)
+    assert abs(lr() - 0.05) < 1e-9
+
+
+def test_scheduler_drives_static_optimizer():
+    sched = pt.optimizer.lr.PiecewiseDecay([2], [0.1, 0.001])
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4])
+        loss = layers.mean(layers.fc(x, 1))
+        opt = pt.optimizer.SGDOptimizer(sched)
+        opt.minimize(loss)
+    pt.core.scope.reset_global_scope()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, use_compiled=False)
+    assert abs(opt.current_step_lr() - 0.1) < 1e-8
+    sched.step()
+    sched.step()
+    assert abs(opt.current_step_lr() - 0.001) < 1e-8

@@ -1,0 +1,126 @@
+"""Book-style end-to-end model tests (SURVEY.md §4.3): each model builds,
+runs a step, and overfits a tiny batch. ResNet runs at toy image size to
+keep CPU CI fast; geometry checks run at full 224 config."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.models import resnet, word2vec
+
+
+def test_resnet50_builds_full_geometry():
+    cfg = resnet.resnet50()
+    main, startup, feeds, fetches = resnet.build_classifier_program(
+        cfg, with_optimizer=False, is_test=True)
+    # 53 convs in resnet-50 (1 stem + 3*16 bottleneck + 4 shortcut convs)
+    n_convs = sum(1 for op in main.global_block().ops if op.type == "conv2d")
+    assert n_convs == 53
+    logits_like = [v for v in main.global_block().vars.values()
+                   if v.shape == (-1, 1000)]
+    assert logits_like
+
+
+@pytest.mark.parametrize("depth", [18, 50])
+def test_resnet_small_trains(depth, scope):
+    cfg = resnet.ResNetConfig(depth=depth, num_classes=10,
+                              image_shape=(3, 32, 32))
+    main, startup, feeds, fetches = resnet.build_classifier_program(
+        cfg, optimizer_name="momentum", lr=0.01)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope, use_compiled=False)
+    batch = resnet.synthetic_batch(cfg, 8)
+    losses = []
+    for _ in range(8):
+        lv, a1, a5 = exe.run(main, feed=batch,
+                             fetch_list=[fetches["loss"], fetches["acc1"],
+                                         fetches["acc5"]], scope=scope)
+        losses.append(float(lv))
+    assert np.isfinite(losses).all()
+    # deep nets can transiently spike on random data; require recovery below
+    # the early-loss level by the end
+    assert losses[-1] < max(losses[:2]), losses
+    assert 0.0 <= float(a1) <= float(a5) <= 1.0
+
+
+def test_resnet_train_vs_eval_bn(scope):
+    """BN must use batch stats in train and running stats in eval."""
+    cfg = resnet.ResNetConfig(depth=18, num_classes=4, image_shape=(3, 16, 16))
+    main, startup, feeds, fetches = resnet.build_classifier_program(cfg)
+    test_prog = main.clone(for_test=True)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope, use_compiled=False)
+    batch = resnet.synthetic_batch(cfg, 4)
+    mean0 = np.array(scope.find_var("conv1_bn_mean"))
+    for _ in range(3):
+        exe.run(main, feed=batch, fetch_list=[fetches["loss"]], scope=scope)
+    mean1 = np.array(scope.find_var("conv1_bn_mean"))
+    assert not np.allclose(mean0, mean1), "running mean did not update"
+    lv, = exe.run(test_prog, feed=batch, fetch_list=[fetches["loss"]],
+                  scope=scope)
+    assert np.isfinite(lv)
+    # eval twice → identical (no dropout/bn randomness, stats frozen)
+    lv2, = exe.run(test_prog, feed=batch, fetch_list=[fetches["loss"]],
+                   scope=scope)
+    mean2 = np.array(scope.find_var("conv1_bn_mean"))
+    np.testing.assert_array_equal(mean1, mean2)
+
+
+def test_word2vec_overfits(scope):
+    dict_size = 50
+    main, startup, feeds, fetches = word2vec.build_word2vec_program(
+        dict_size, lr=0.5)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope, use_compiled=False)
+    batch = word2vec.synthetic_batch(dict_size, 16)
+    losses = []
+    for _ in range(80):
+        lv, = exe.run(main, feed=batch, fetch_list=[fetches["loss"]],
+                      scope=scope)
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.3, (losses[0], losses[-1])
+
+
+def test_transformer_tiny_trains(scope):
+    from paddle_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(src_vocab_size=64, tgt_vocab_size=64,
+                                d_model=32, n_head=4, d_inner=64,
+                                n_encoder_layers=2, n_decoder_layers=2)
+    main, startup, feeds, fetches = tfm.build_wmt_program(
+        cfg, seq_len=8, warmup_steps=100, lr_scale=2.0)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope, use_compiled=False)
+    batch = tfm.synthetic_batch(cfg, 4, 8)
+    losses = []
+    for _ in range(25):
+        lv, tn = exe.run(main, feed=batch,
+                         fetch_list=[fetches["loss"], fetches["token_num"]],
+                         scope=scope)
+        losses.append(float(lv))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+    assert float(tn) == batch["lbl_weight"].sum()
+
+
+def test_transformer_tp_dryrun():
+    """Megatron TP: the same program runs under a dp×mp mesh; GSPMD inserts
+    the collectives the reference lacked first-class TP for."""
+    import jax
+
+    from paddle_tpu.models import transformer as tfm
+    from paddle_tpu.parallel import create_mesh
+
+    cfg = tfm.TransformerConfig(src_vocab_size=64, tgt_vocab_size=64,
+                                d_model=32, n_head=4, d_inner=64,
+                                n_encoder_layers=1, n_decoder_layers=1)
+    main, startup, feeds, fetches = tfm.build_wmt_program(
+        cfg, seq_len=8, warmup_steps=2)
+    mesh = create_mesh({"dp": 2, "mp": 2}, devices=jax.devices()[:4])
+    scope = pt.Scope()
+    exe = pt.Executor()
+    exe.run(startup, scope=scope, use_compiled=False)
+    batch = tfm.synthetic_batch(cfg, 4, 8)
+    lv, = exe.run(main, feed=batch, fetch_list=[fetches["loss"]], scope=scope,
+                  mesh=mesh)
+    assert np.isfinite(float(lv))

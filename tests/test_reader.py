@@ -1,0 +1,122 @@
+"""Data pipeline: decorators, Dataset/BatchSampler/DataLoader, and the
+from_generator queue loader feeding a real training program.
+
+Mirrors reference test_multiprocess_dataloader_*.py / reader decorator tests.
+"""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers, reader
+from paddle_tpu.reader import (BatchSampler, DataLoader, Dataset,
+                               IterableDataset, TensorDataset)
+
+
+def test_decorators_batch_shuffle_chain():
+    r = lambda: iter(range(10))  # noqa: E731
+    b = reader.batch(r, 3)
+    batches = list(b())
+    assert [len(x) for x in batches] == [3, 3, 3, 1]
+    b2 = reader.batch(r, 3, drop_last=True)
+    assert [len(x) for x in b2()] == [3, 3, 3]
+
+    s = reader.shuffle(r, buf_size=10, seed=0)
+    out = list(s())
+    assert sorted(out) == list(range(10)) and out != list(range(10))
+
+    c = reader.chain(r, r)
+    assert len(list(c())) == 20
+
+    f = reader.firstn(r, 4)
+    assert list(f()) == [0, 1, 2, 3]
+
+    m = reader.map_readers(lambda a, b: a + b, r, r)
+    assert list(m()) == [2 * i for i in range(10)]
+
+
+def test_buffered_and_xmap_preserve_data():
+    r = lambda: iter(range(50))  # noqa: E731
+    assert list(reader.buffered(r, 8)()) == list(range(50))
+    x = reader.xmap_readers(lambda v: v * v, r, process_num=4, buffer_size=8,
+                            order=True)
+    assert list(x()) == [i * i for i in range(50)]
+    x2 = reader.xmap_readers(lambda v: v * v, r, process_num=4, buffer_size=8)
+    assert sorted(x2()) == sorted(i * i for i in range(50))
+
+
+def test_tensor_dataset_loader_batches():
+    xs = np.arange(20, dtype=np.float32).reshape(10, 2)
+    ys = np.arange(10, dtype=np.int64)
+    ds = TensorDataset([xs, ys])
+    dl = DataLoader(ds, batch_size=4, drop_last=False)
+    batches = list(dl)
+    assert len(batches) == 3
+    bx, by = batches[0]
+    assert bx.shape == (4, 2) and by.shape == (4,)
+    np.testing.assert_array_equal(bx, xs[:4])
+
+
+def test_loader_shuffle_covers_all():
+    ds = TensorDataset([np.arange(16, dtype=np.float32)])
+    dl = DataLoader(ds, batch_size=4, shuffle=True)
+    seen = np.concatenate([b[0] for b in dl])
+    assert sorted(seen.tolist()) == list(range(16))
+
+
+def test_loader_num_workers_in_order():
+    class SlowDS(Dataset):
+        def __getitem__(self, i):
+            return np.float32(i)
+
+        def __len__(self):
+            return 32
+
+    dl = DataLoader(SlowDS(), batch_size=4, num_workers=4)
+    got = np.concatenate([b[0] for b in dl])
+    np.testing.assert_array_equal(got, np.arange(32, dtype=np.float32))
+
+
+def test_iterable_dataset():
+    class Stream(IterableDataset):
+        def __iter__(self):
+            yield from (np.float32(i) for i in range(10))
+
+    dl = DataLoader(Stream(), batch_size=3)
+    sizes = [len(b[0]) for b in dl]
+    assert sizes == [3, 3, 3, 1]
+
+
+def test_batch_sampler_len():
+    ds = TensorDataset([np.zeros(10)])
+    assert len(BatchSampler(ds, batch_size=3)) == 4
+    assert len(BatchSampler(ds, batch_size=3, drop_last=True)) == 3
+
+
+def test_from_generator_feeds_training(scope):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4])
+        label = layers.data("label", [1], dtype="int64")
+        loss = layers.mean(layers.softmax_with_cross_entropy(
+            layers.fc(x, 4), label))
+        pt.optimizer.SGDOptimizer(0.1).minimize(loss)
+
+    rng = np.random.RandomState(0)
+
+    def sample_gen():
+        for _ in range(32):
+            yield rng.randn(4).astype(np.float32), \
+                rng.randint(0, 4, (1,)).astype(np.int64)
+
+    loader = DataLoader.from_generator(feed_list=[x, label], capacity=4)
+    loader.set_sample_generator(sample_gen, batch_size=8)
+
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope, use_compiled=False)
+    losses = []
+    for epoch in range(6):
+        for feed in loader:
+            assert set(feed) == {"x", "label"}
+            lv, = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+            losses.append(float(lv))
+    assert losses[-1] < losses[0]
