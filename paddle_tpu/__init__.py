@@ -15,6 +15,9 @@ Architecture (see SURVEY.md for the full blueprint):
 
 from . import initializer, layers, optimizer, regularizer  # noqa: F401
 from . import io  # noqa: F401
+from . import amp  # noqa: F401
+from . import contrib  # noqa: F401
+from . import metric  # noqa: F401
 from . import reader  # noqa: F401
 from .reader import DataLoader  # noqa: F401
 

@@ -1,0 +1,164 @@
+"""AMP user API (reference: python/paddle/amp/auto_cast.py:20,
+fluid/dygraph/amp/auto_cast.py:90 amp_guard + loss_scaler.py
+AmpScaler/GradScaler; C++ autocast: imperative/amp_auto_cast.cc).
+
+TPU design notes: the natural mixed-precision dtype is **bfloat16** — same
+exponent range as fp32, so loss scaling is mathematically unnecessary; the
+GradScaler still implements full dynamic-scaling semantics for API parity
+and for fp16 experiments. White-list ops (matmul/conv — MXU work) cast
+inputs down; black-list ops (softmax/norms/losses) stay fp32.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["auto_cast", "amp_guard", "GradScaler", "AmpScaler",
+           "WHITE_LIST", "BLACK_LIST"]
+
+WHITE_LIST = frozenset({"matmul", "matmul_v2", "mul", "bmm", "conv2d",
+                        "depthwise_conv2d", "conv2d_transpose"})
+BLACK_LIST = frozenset({"softmax", "log_softmax", "softmax_with_cross_entropy",
+                        "cross_entropy", "layer_norm", "batch_norm",
+                        "group_norm", "mean", "reduce_mean", "reduce_sum",
+                        "exp", "log", "sum"})
+
+# module-level autocast state consulted by dygraph.tracer.trace_op
+_state = {"enable": False, "dtype": "bfloat16",
+          "white": set(WHITE_LIST), "black": set(BLACK_LIST)}
+
+
+def amp_state() -> Optional[dict]:
+    return _state if _state["enable"] else None
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, custom_white_list: Sequence[str] = None,
+              custom_black_list: Sequence[str] = None,
+              level: str = "O1", dtype: str = "bfloat16"):
+    """Dygraph autocast context (reference: amp/auto_cast.py auto_cast)."""
+    old = dict(_state)
+    white = set(WHITE_LIST) | set(custom_white_list or [])
+    black = (set(BLACK_LIST) | set(custom_black_list or [])) - white
+    _state.update(enable=enable, dtype=dtype, white=white, black=black)
+    try:
+        yield
+    finally:
+        _state.update(old)
+
+
+amp_guard = auto_cast  # fluid name (dygraph/amp/auto_cast.py:90)
+
+
+class GradScaler:
+    """Dynamic loss scaling for dygraph training (reference:
+    fluid/dygraph/amp/loss_scaler.py AmpScaler / paddle.amp.GradScaler).
+
+    Usage:
+        scaler = GradScaler(init_loss_scaling=1024)
+        with auto_cast():
+            loss = model(x)
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        scaler.minimize(optimizer, scaled)     # fluid style
+        # or: scaler.step(optimizer); scaler.update()   # 2.0 style
+    """
+
+    def __init__(self, enable: bool = True, init_loss_scaling: float = 2.0 ** 15,
+                 incr_ratio: float = 2.0, decr_ratio: float = 0.5,
+                 incr_every_n_steps: int = 1000,
+                 decr_every_n_nan_or_inf: int = 2,
+                 use_dynamic_loss_scaling: bool = True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good = 0
+        self._bad = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def is_enable(self) -> bool:
+        return self._enable
+
+    def get_loss_scaling(self) -> float:
+        return self._scale
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def _params_with_grads(self, optimizer) -> List:
+        params = optimizer._parameter_list or []
+        return [p for p in params if getattr(p, "grad", None) is not None]
+
+    def unscale_(self, optimizer):
+        """Divide grads by the scale; record overflow (reference:
+        AmpScaler._unscale)."""
+        if not self._enable or self._unscaled:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in self._params_with_grads(optimizer):
+            g = np.asarray(p.grad._array)
+            if not np.all(np.isfinite(g)):
+                found = True
+            p.grad._array = p.grad._array * np.asarray(inv, g.dtype)
+        self._found_inf = found
+        self._unscaled = True
+
+    def minimize(self, optimizer, scaled_loss=None, *args, **kwargs):
+        if not self._enable:
+            return optimizer.minimize(scaled_loss, *args, **kwargs)
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.minimize(scaled_loss, *args, **kwargs)
+        self.update()
+
+    def step(self, optimizer):
+        """2.0 style: unscale + conditional optimizer.step()."""
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+
+    def update(self):
+        if not self._enable:
+            return
+        if self._dynamic:
+            if self._found_inf:
+                self._bad += 1
+                self._good = 0
+                if self._bad >= self._decr_every:
+                    self._scale = max(self._scale * self._decr_ratio, 1.0)
+                    self._bad = 0
+            else:
+                self._good += 1
+                self._bad = 0
+                if self._good >= self._incr_every:
+                    self._scale *= self._incr_ratio
+                    self._good = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio, "good": self._good,
+                "bad": self._bad}
+
+    def set_state_dict(self, state):
+        self._scale = float(state.get("scale", self._scale))
+        self._good = int(state.get("good", self._good))
+        self._bad = int(state.get("bad", self._bad))
+
+
+AmpScaler = GradScaler  # fluid name

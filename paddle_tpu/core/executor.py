@@ -263,12 +263,27 @@ class Executor:
             self._cache[key] = entry
 
         state = {}
+        seen_bufs: Dict[int, str] = {}
         for n in entry.state_names:
             v = scope.find_var(n)
             if v is None:
                 raise ExecutionError(
                     f"persistable var '{n}' not initialised in scope — "
                     f"did you run the startup program?")
+            # state buffers are donated: two names aliasing one device
+            # buffer would fail Execute(); copy the duplicate
+            ptr = getattr(v, "unsafe_buffer_pointer", None)
+            if ptr is not None:
+                try:
+                    key = v.unsafe_buffer_pointer()
+                    if key in seen_bufs:
+                        import jax.numpy as jnp
+
+                        v = jnp.copy(v)
+                    else:
+                        seen_bufs[key] = n
+                except Exception:
+                    pass
             state[n] = v
         ro = {n: scope.find_var(n) for n in entry.ro_names}
         step = scope.find_var("@STEP_COUNTER@")

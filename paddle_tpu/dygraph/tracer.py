@@ -94,6 +94,39 @@ def _record(node: TapeNode, outs: Dict[str, List[Any]],
     return out_vars
 
 
+def _maybe_autocast(op_type: str, forward):
+    """AMP autocast wrapper (reference: imperative/amp_auto_cast.cc):
+    white-list ops run with fp32 inputs cast to the AMP dtype INSIDE the
+    vjp'd function, so cast grads flow back to fp32 automatically;
+    black-list ops promote low-precision inputs to fp32."""
+    from ..amp import amp_state
+
+    st = amp_state()
+    if st is None:
+        return forward
+    import jax.numpy as jnp
+
+    if op_type in st["white"]:
+        to = jnp.dtype(st["dtype"])
+        src = jnp.float32
+    elif op_type in st["black"]:
+        to = jnp.float32
+        src = jnp.dtype(st["dtype"])
+    else:
+        return forward
+
+    def cast(v):
+        if v is not None and hasattr(v, "dtype") and v.dtype == src:
+            return v.astype(to)
+        return v
+
+    def wrapped(ins, attrs, _f=forward):
+        ins = {s: [cast(v) for v in vals] for s, vals in ins.items()}
+        return _f(ins, attrs)
+
+    return wrapped
+
+
 def trace_op(op_type: str, inputs: Dict[str, Any],
              attrs: Optional[Dict[str, Any]] = None,
              stop_gradient: bool = False) -> Dict[str, List[VarBase]]:
@@ -109,6 +142,7 @@ def trace_op(op_type: str, inputs: Dict[str, Any],
     if opdef.forward is None:
         raise RuntimeError(f"op '{op_type}' has no registered lowering")
     attrs = dict(attrs or {})
+    forward = _maybe_autocast(op_type, opdef.forward)
 
     norm: Dict[str, List[Optional[VarBase]]] = {}
     for slot, vals in (inputs or {}).items():
@@ -137,14 +171,14 @@ def trace_op(op_type: str, inputs: Dict[str, Any],
                     diff_idx.append((slot, i))
 
     if not diff_idx:
-        outs = registry.normalize_outputs(opdef.forward(arr_ins, attrs))
+        outs = registry.normalize_outputs(forward(arr_ins, attrs))
         return _record(None, outs)
 
     def f(diff_vals):
         ins = {s: list(l) for s, l in arr_ins.items()}
         for (slot, i), a in zip(diff_idx, diff_vals):
             ins[slot][i] = a
-        return registry.normalize_outputs(opdef.forward(ins, attrs))
+        return registry.normalize_outputs(forward(ins, attrs))
 
     primals = [arr_ins[s][i] for s, i in diff_idx]
     outs, vjp_fn = jax.vjp(f, primals)

@@ -1,0 +1,5 @@
+"""hapi — Keras-like high-level API (reference: python/paddle/hapi/)."""
+
+from .callbacks import (Callback, EarlyStopping, ModelCheckpoint,  # noqa: F401
+                        ProgBarLogger)
+from .model import Model  # noqa: F401

@@ -109,7 +109,12 @@ def randint(ins, attrs):
 
 @register_op("assign")
 def assign(ins, attrs):
-    return {"Out": ins["X"][0]}
+    import jax.numpy as jnp
+
+    # copy, don't alias: two scope vars sharing one buffer would both be
+    # donated to the jitted step ("donate the same buffer twice"); inside
+    # jit XLA elides the copy
+    return {"Out": jnp.copy(ins["X"][0])}
 
 
 @register_op("share_data")

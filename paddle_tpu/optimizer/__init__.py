@@ -584,3 +584,24 @@ DecayedAdagrad = DecayedAdagradOptimizer
 Ftrl = FtrlOptimizer
 
 from . import lr  # noqa: E402,F401  (2.0-style host-driven LR schedulers)
+
+from .extras import (ExponentialMovingAverage, LookaheadOptimizer,  # noqa: E402,F401
+                     ModelAverage)
+
+
+def _fleet_wrappers():
+    from ..distributed.fleet.meta_optimizers import (GradientMergeOptimizer,
+                                                     RecomputeOptimizer)
+
+    return RecomputeOptimizer, GradientMergeOptimizer
+
+
+# fluid.optimizer.RecomputeOptimizer / GradientMergeOptimizer surface
+# (reference: optimizer.py:4547, :5025) — same rewrites as the fleet
+# meta-optimizers, importable from here lazily to avoid a package cycle.
+def __getattr__(name):
+    if name == "RecomputeOptimizer":
+        return _fleet_wrappers()[0]
+    if name == "GradientMergeOptimizer":
+        return _fleet_wrappers()[1]
+    raise AttributeError(name)

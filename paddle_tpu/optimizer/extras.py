@@ -1,0 +1,256 @@
+"""Parameter-averaging / slow-weight optimizers (reference:
+python/paddle/fluid/optimizer.py — ModelAverage:3134,
+ExponentialMovingAverage:3443, LookaheadOptimizer:4853).
+
+All three keep per-param auxiliary persistables updated by ops inside the
+main program (so the whole update stays in the one compiled XLA step) and
+swap values host-side through the Scope for apply()/restore() — the
+reference runs separate apply/restore programs; a scope swap is the same
+state transition without building them.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core import unique_name
+from ..core.ir import OpRole, default_main_program
+from ..core.scope import global_scope
+from ..layers import nn as L
+
+__all__ = ["ExponentialMovingAverage", "ModelAverage", "LookaheadOptimizer"]
+
+
+def _trainable_params(program):
+    return [p for p in program.all_parameters() if p.trainable]
+
+
+class ExponentialMovingAverage:
+    """EMA of trainable params with bias correction (reference:
+    optimizer.py:3443). Call ``update()`` under the training program guard
+    AFTER minimize(); evaluate under ``with ema.apply(exe):``."""
+
+    def __init__(self, decay: float = 0.999, thres_steps=None,
+                 name: Optional[str] = None):
+        self._decay = float(decay)
+        self._name = name or unique_name.generate("ema")
+        self._shadow: Dict[str, str] = {}  # param name -> ema var name
+        self._step_name = f"{self._name}_step"
+        self._backup: Dict[str, np.ndarray] = {}
+
+    def update(self):
+        """Append EMA update ops to the current main program."""
+        program = default_main_program()
+        block = program.global_block()
+        with program._role_guard(OpRole.Optimize):
+            step = L.create_global_var([1], 0.0, "float32", persistable=True,
+                                       name=self._step_name)
+            block.append_op("increment", {"X": [step]}, {"Out": [step]},
+                            {"step": 1.0})
+            for p in _trainable_params(program):
+                ema = L.create_global_var(list(p.shape), 0.0, "float32",
+                                          persistable=True,
+                                          name=f"{self._name}_{p.name}")
+                self._shadow[p.name] = ema.name
+                # ema = decay*ema + (1-decay)*param
+                block.append_op("scale", {"X": [ema]}, {"Out": [ema]},
+                                {"scale": self._decay})
+                tmp = block.create_var(
+                    name=unique_name.generate(f"{self._name}_tmp"),
+                    stop_gradient=True)
+                block.append_op("scale", {"X": [p]}, {"Out": [tmp]},
+                                {"scale": 1.0 - self._decay})
+                block.append_op("sum", {"X": [ema, tmp]}, {"Out": [ema]}, {})
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore: bool = True, scope=None):
+        """Swap bias-corrected EMA values into the params."""
+        scope = global_scope() if scope is None else scope
+        t = float(np.asarray(scope.find_var(self._step_name) or 0.0)
+                  .reshape(-1)[0])
+        corr = 1.0 - self._decay ** max(t, 1.0)
+        self._backup = {}
+        for pname, ename in self._shadow.items():
+            pv = scope.find_var(pname)
+            ev = scope.find_var(ename)
+            if pv is None or ev is None:
+                continue
+            self._backup[pname] = np.asarray(pv)
+            scope.set(pname, np.asarray(ev) / corr)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore(scope=scope)
+
+    def restore(self, executor=None, scope=None):
+        scope = global_scope() if scope is None else scope
+        for pname, val in self._backup.items():
+            scope.set(pname, val)
+        self._backup = {}
+
+
+class ModelAverage:
+    """Sliding-window average of params (reference: optimizer.py:3134).
+
+    The reference keeps three staggered sums (sum_1/2/3) to bound the
+    window; here one (sum, count) pair is halved whenever count exceeds
+    max_average_window — same bounded-window effect, one less buffer."""
+
+    def __init__(self, average_window_rate: float = 0.15,
+                 min_average_window: int = 10000,
+                 max_average_window: int = 10000,
+                 name: Optional[str] = None):
+        self.rate = average_window_rate
+        self.min_window = min_average_window
+        self.max_window = max_average_window
+        self._name = name or unique_name.generate("model_avg")
+        self._sums: Dict[str, str] = {}
+        self._count_name = f"{self._name}_count"
+        self._backup: Dict[str, np.ndarray] = {}
+        self._append_ops()
+
+    def _append_ops(self):
+        program = default_main_program()
+        block = program.global_block()
+        with program._role_guard(OpRole.Optimize):
+            cnt = L.create_global_var([1], 0.0, "float32", persistable=True,
+                                      name=self._count_name)
+            block.append_op("increment", {"X": [cnt]}, {"Out": [cnt]},
+                            {"step": 1.0})
+            sum_names = []
+            for p in _trainable_params(program):
+                s = L.create_global_var(list(p.shape), 0.0, "float32",
+                                        persistable=True,
+                                        name=f"{self._name}_sum_{p.name}")
+                self._sums[p.name] = s.name
+                sum_names.append(s.name)
+                block.append_op("sum", {"X": [s, p]}, {"Out": [s]}, {})
+            # bounded window: when count exceeds max_average_window, halve
+            # (sum, count) — the reference rotates sum_1/2/3 buffers to the
+            # same effect (optimizer.py:3134)
+            maxw = L.fill_constant([1], "float32", float(self.max_window))
+            over = block.create_var(name=unique_name.generate("ma_over"),
+                                    dtype="bool", stop_gradient=True)
+            block.append_op("greater_than", {"X": [cnt], "Y": [maxw]},
+                            {"Out": [over]}, {})
+            sub = program.create_block(parent_idx=0)
+            try:
+                for sname in sum_names + [cnt.name]:
+                    sub.append_op("scale", {"X": [sname]}, {"Out": [sname]},
+                                  {"scale": 0.5})
+            finally:
+                program.rollback()
+            io_names = sum_names + [cnt.name]
+            block.append_op("conditional_block",
+                            {"Cond": [over], "X": io_names},
+                            {"Out": io_names},
+                            {"sub_block": sub, "input_names": io_names,
+                             "output_names": io_names})
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore: bool = True, scope=None):
+        scope = global_scope() if scope is None else scope
+        n = float(np.asarray(scope.find_var(self._count_name) or 1.0)
+                  .reshape(-1)[0]) or 1.0
+        self._backup = {}
+        for pname, sname in self._sums.items():
+            pv, sv = scope.find_var(pname), scope.find_var(sname)
+            if pv is None or sv is None:
+                continue
+            self._backup[pname] = np.asarray(pv)
+            scope.set(pname, np.asarray(sv) / n)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore(scope=scope)
+
+    def restore(self, executor=None, scope=None):
+        scope = global_scope() if scope is None else scope
+        for pname, val in self._backup.items():
+            scope.set(pname, val)
+        self._backup = {}
+
+
+class LookaheadOptimizer:
+    """Lookahead (k fast steps, then slow ← slow + α(fast−slow); fast ← slow)
+    (reference: optimizer.py:4853). The slow update runs inside a
+    conditional_block fired every k steps — one compiled program, no
+    host-side branching."""
+
+    def __init__(self, inner_optimizer, alpha: float = 0.5, k: int = 5):
+        self.inner = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        ops, pg = self.inner.minimize(loss, startup_program, parameter_list,
+                                      no_grad_set)
+        program = loss.block.program
+        block = program.global_block()
+        with program._role_guard(OpRole.Optimize):
+            cnt = L.create_global_var([1], 0.0, "float32", persistable=True,
+                                      name=unique_name.generate("la_step"))
+            block.append_op("increment", {"X": [cnt]}, {"Out": [cnt]},
+                            {"step": 1.0})
+            kvar = L.fill_constant([1], "float32", float(self.k))
+            rem = block.create_var(name=unique_name.generate("la_rem"),
+                                   stop_gradient=True)
+            block.append_op("elementwise_mod", {"X": [cnt], "Y": [kvar]},
+                            {"Out": [rem]}, {"axis": -1})
+            zero = L.fill_constant([1], "float32", 0.0)
+            fire = block.create_var(name=unique_name.generate("la_fire"),
+                                    dtype="bool", stop_gradient=True)
+            block.append_op("equal", {"X": [rem], "Y": [zero]},
+                            {"Out": [fire]}, {})
+
+            slow_names: List[str] = []
+            fast_names: List[str] = []
+            for p, _ in pg:
+                slow = L.create_global_var(list(p.shape), 0.0, "float32",
+                                           persistable=True,
+                                           name=f"{p.name}@SLOW")
+                # initialise slow weights from the startup params
+                startup = __import__(
+                    "paddle_tpu.core.ir", fromlist=["default_startup_program"]
+                ).default_startup_program()
+                sb = startup.global_block()
+                if p.name in sb.vars:
+                    sb.append_op("assign", {"X": [p.name]},
+                                 {"Out": [slow.name]}, {})
+                slow_names.append(slow.name)
+                fast_names.append(p.name)
+
+            sub = program.create_block(parent_idx=0)
+            try:
+                for pname, sname in zip(fast_names, slow_names):
+                    # slow += alpha * (fast - slow);  fast = slow
+                    diff = sub.create_var(
+                        name=unique_name.generate("la_diff"),
+                        stop_gradient=True)
+                    sub.append_op("elementwise_sub",
+                                  {"X": [pname], "Y": [sname]},
+                                  {"Out": [diff]}, {"axis": -1})
+                    sub.append_op("scale", {"X": [diff]}, {"Out": [diff]},
+                                  {"scale": self.alpha})
+                    sub.append_op("sum", {"X": [sname, diff]},
+                                  {"Out": [sname]}, {})
+                    sub.append_op("assign", {"X": [sname]}, {"Out": [pname]},
+                                  {})
+            finally:
+                program.rollback()
+            io_names = list(dict.fromkeys(fast_names + slow_names))
+            block.append_op("conditional_block",
+                            {"Cond": [fire], "X": io_names},
+                            {"Out": io_names},
+                            {"sub_block": sub, "input_names": io_names,
+                             "output_names": io_names})
+        return ops, pg
+
+    def __getattr__(self, item):
+        return getattr(self.inner, item)

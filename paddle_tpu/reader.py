@@ -144,13 +144,17 @@ def compose(*readers, check_alignment: bool = True):
 
     def composed():
         iters = [r() for r in readers]
-        for items in itertools.zip_longest(*iters, fillvalue=_missing):
-            if check_alignment and any(i is _missing for i in items):
-                raise ComposeNotAligned(
-                    "compose: input readers yielded different lengths")
-            items = tuple(None if i is _missing else i for i in items)
-            yield tuple(x for i in items
-                        for x in (i if isinstance(i, tuple) else (i,)))
+        if check_alignment:
+            for items in itertools.zip_longest(*iters, fillvalue=_missing):
+                if any(i is _missing for i in items):
+                    raise ComposeNotAligned(
+                        "compose: input readers yielded different lengths")
+                yield tuple(x for i in items
+                            for x in (i if isinstance(i, tuple) else (i,)))
+        else:
+            for items in zip(*iters):  # stop at the shortest (reference)
+                yield tuple(x for i in items
+                            for x in (i if isinstance(i, tuple) else (i,)))
 
     return composed
 

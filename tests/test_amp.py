@@ -1,0 +1,120 @@
+"""AMP: dygraph auto_cast + GradScaler; static bf16 rewrite + loss scaling.
+
+Mirrors reference test_imperative_auto_mixed_precision.py /
+test_mixed_precision (contrib) coverage points at smoke scale.
+"""
+
+import numpy as np
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu import layers
+from paddle_tpu.amp import GradScaler, auto_cast
+from paddle_tpu.dygraph import guard, to_variable
+
+
+def test_auto_cast_runs_matmul_in_bf16():
+    import jax.numpy as jnp
+
+    with guard():
+        x = to_variable(np.random.randn(4, 8).astype(np.float32))
+        w = to_variable(np.random.randn(8, 8).astype(np.float32))
+        with auto_cast():
+            from paddle_tpu.dygraph.tracer import trace_op
+
+            out = trace_op("matmul_v2", {"X": x, "Y": w}, {})["Out"][0]
+            assert out._array.dtype == jnp.bfloat16
+        # outside the context: fp32 again
+        out2 = trace_op("matmul_v2", {"X": x, "Y": w}, {})["Out"][0]
+        assert out2._array.dtype == jnp.float32
+
+
+def test_auto_cast_training_converges_and_grads_fp32():
+    rng = np.random.RandomState(0)
+    xs = rng.randn(32, 8).astype(np.float32)
+    ys = np.argmax(xs[:, :4], axis=1).astype(np.int64)
+    with guard():
+        net = nn.Linear(8, 4)
+        opt = pt.optimizer.SGDOptimizer(0.5, parameter_list=net.parameters())
+        loss_fn = nn.CrossEntropyLoss()
+        losses = []
+        for _ in range(20):
+            with auto_cast():
+                logits = net(to_variable(xs))
+                loss = loss_fn(logits, to_variable(ys))
+            loss.backward()
+            # params + their grads must stay fp32 (master weights)
+            for p in net.parameters():
+                assert np.dtype(p.dtype) == np.float32
+                assert np.dtype(p.grad.dtype) == np.float32
+            opt.minimize(loss)
+            net.clear_gradients()
+            losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_grad_scaler_scales_and_recovers():
+    with guard():
+        net = nn.Linear(4, 2)
+        opt = pt.optimizer.SGDOptimizer(0.1, parameter_list=net.parameters())
+        scaler = GradScaler(init_loss_scaling=64.0,
+                            decr_every_n_nan_or_inf=1)
+        x = to_variable(np.ones((2, 4), np.float32))
+        w_before = net.weight.numpy().copy()
+        loss = net(x).mean()
+        scaled = scaler.scale(loss)
+        assert abs(float(scaled.numpy()) - 64.0 * float(loss.numpy())) < 1e-3
+        scaled.backward()
+        scaler.minimize(opt, scaled)
+        net.clear_gradients()
+        # grads were unscaled before the update: the step must equal a
+        # plain lr*grad step, not 64x it
+        delta = np.abs(net.weight.numpy() - w_before).max()
+        assert delta < 0.1, delta
+
+
+def test_grad_scaler_skips_on_overflow():
+    with guard():
+        net = nn.Linear(4, 2)
+        opt = pt.optimizer.SGDOptimizer(0.1, parameter_list=net.parameters())
+        scaler = GradScaler(init_loss_scaling=64.0, decr_every_n_nan_or_inf=1)
+        w_before = net.weight.numpy().copy()
+        x = to_variable(np.ones((2, 4), np.float32))
+        loss = net(x).mean()
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        # poison a grad with inf
+        net.weight.grad._array = net.weight.grad._array * np.inf
+        scaler.minimize(opt, scaled)
+        np.testing.assert_array_equal(net.weight.numpy(), w_before)
+        assert scaler.get_loss_scaling() == 32.0  # halved after 1 bad step
+
+
+def test_static_bf16_rewrite_inserts_casts(scope):
+    from paddle_tpu.contrib.mixed_precision import decorate
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [8])
+        label = layers.data("label", [1], dtype="int64")
+        h = layers.fc(x, 16, act="relu")
+        loss = layers.mean(layers.softmax_with_cross_entropy(
+            layers.fc(h, 4), label))
+        opt = decorate(pt.optimizer.SGDOptimizer(0.1),
+                       use_dynamic_loss_scaling=True)
+        opt.minimize(loss)
+    types = [op.type for op in main.global_block().ops]
+    assert "cast" in types
+    assert "check_finite_and_unscale" in types
+    assert "update_loss_scaling" in types
+    # trains without NaN
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope, use_compiled=False)
+    feed = {"x": np.random.randn(8, 8).astype(np.float32),
+            "label": np.random.randint(0, 4, (8, 1)).astype(np.int64)}
+    losses = []
+    for _ in range(10):
+        lv, = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+        losses.append(float(lv))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]

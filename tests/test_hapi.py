@@ -1,0 +1,121 @@
+"""hapi Model.fit/evaluate/predict/save/load + metrics.
+
+Mirrors reference test_model.py (python/paddle/tests/test_model.py): MNIST-
+style Model trained via fit() on a Dataset, metrics accumulate, checkpoint
+round-trips.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu.hapi import EarlyStopping, Model
+from paddle_tpu.metric import Accuracy, Auc, Precision, Recall
+from paddle_tpu.reader import TensorDataset
+
+
+class MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 32)
+        self.fc2 = nn.Linear(32, 4)
+
+    def forward(self, x):
+        return self.fc2(nn.functional.relu(self.fc1(x)))
+
+
+def _dataset(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 8).astype(np.float32)
+    w = rng.randn(8, 4)
+    y = np.argmax(x @ w, axis=1).astype(np.int64)
+    return TensorDataset([x, y])
+
+
+def test_metric_accuracy_topk():
+    m = Accuracy(topk=(1, 2))
+    pred = np.array([[0.1, 0.7, 0.2], [0.8, 0.1, 0.1]])
+    label = np.array([[1], [2]])
+    m.update(pred, label)
+    acc1, acc2 = m.accumulate()
+    assert acc1 == 0.5 and acc2 == 0.5
+    m.update(pred, np.array([[1], [0]]))
+    acc1, acc2 = m.accumulate()
+    assert abs(acc1 - 0.75) < 1e-9
+
+
+def test_metric_precision_recall_auc():
+    p, r, a = Precision(), Recall(), Auc()
+    pred = np.array([0.9, 0.8, 0.2, 0.6])
+    label = np.array([1, 0, 1, 1])
+    p.update(pred, label)
+    r.update(pred, label)
+    a.update(pred.reshape(-1, 1), label)
+    assert abs(p.accumulate() - 2 / 3) < 1e-9   # TP=2 FP=1
+    assert abs(r.accumulate() - 2 / 3) < 1e-9   # TP=2 FN=1
+    assert 0.0 <= a.accumulate() <= 1.0
+
+
+def test_model_fit_overfits_and_metrics():
+    with pt.dygraph.guard():
+        net = MLP()
+        model = Model(net)
+        model.prepare(
+            optimizer=pt.optimizer.AdamOptimizer(
+                5e-3, parameter_list=net.parameters()),
+            loss=nn.CrossEntropyLoss(),
+            metrics=Accuracy())
+    ds = _dataset()
+    model.fit(ds, batch_size=16, epochs=25, verbose=0, shuffle=True)
+    logs = model.evaluate(ds, batch_size=16, verbose=0)
+    assert logs["eval_acc"] > 0.85, logs
+    assert logs["eval_loss"] < 0.7
+
+
+def test_model_predict_shapes():
+    with pt.dygraph.guard():
+        net = MLP()
+        model = Model(net)
+        model.prepare(loss=nn.CrossEntropyLoss())
+    ds = _dataset(20)
+    outs = model.predict(ds, batch_size=8, stack_outputs=True)
+    assert len(outs) == 1 and outs[0].shape == (20, 4)
+
+
+def test_model_save_load_roundtrip(tmp_path):
+    with pt.dygraph.guard():
+        net = MLP()
+        model = Model(net)
+        model.prepare(
+            optimizer=pt.optimizer.AdamOptimizer(
+                5e-3, parameter_list=net.parameters()),
+            loss=nn.CrossEntropyLoss())
+    ds = _dataset()
+    model.fit(ds, batch_size=16, epochs=2, verbose=0)
+    ref = model.predict(ds, batch_size=64, stack_outputs=True)[0]
+    model.save(str(tmp_path / "ckpt" / "m"))
+
+    with pt.dygraph.guard():
+        net2 = MLP()
+        model2 = Model(net2)
+        model2.prepare(loss=nn.CrossEntropyLoss())
+        model2.load(str(tmp_path / "ckpt" / "m"))
+    out = model2.predict(ds, batch_size=64, stack_outputs=True)[0]
+    np.testing.assert_allclose(ref, out, rtol=1e-5, atol=1e-6)
+
+
+def test_early_stopping_stops():
+    with pt.dygraph.guard():
+        net = MLP()
+        model = Model(net)
+        model.prepare(
+            optimizer=pt.optimizer.SGDOptimizer(
+                0.0, parameter_list=net.parameters()),  # lr 0 → no progress
+            loss=nn.CrossEntropyLoss(),
+            metrics=Accuracy())
+    ds = _dataset(32)
+    es = EarlyStopping(monitor="eval_loss", mode="min", patience=1)
+    model.fit(ds, eval_data=ds, batch_size=16, epochs=50, verbose=0,
+              callbacks=[es])
+    assert model.stop_training

@@ -1,0 +1,97 @@
+"""EMA / ModelAverage / Lookahead wrapper optimizers
+(reference: fluid test_ema.py, test_lookahead.py, ModelAverage tests)."""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _build(wrap=None):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4])
+        loss = layers.mean(layers.fc(x, 1))
+        inner = pt.optimizer.SGDOptimizer(0.1)
+        if wrap is None:
+            inner.minimize(loss)
+            extra = None
+        elif wrap == "ema":
+            inner.minimize(loss)
+            extra = pt.optimizer.ExponentialMovingAverage(0.5)
+            extra.update()
+        elif wrap == "ma":
+            inner.minimize(loss)
+            extra = pt.optimizer.ModelAverage(0.15)
+        elif wrap == "lookahead":
+            extra = pt.optimizer.LookaheadOptimizer(inner, alpha=0.5, k=2)
+            extra.minimize(loss)
+    w = main.all_parameters()[0].name
+    return main, startup, loss, extra, w
+
+
+def test_ema_apply_restore(scope):
+    main, startup, loss, ema, w = _build("ema")
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope, use_compiled=False)
+    feed = {"x": np.ones((2, 4), np.float32)}
+    for _ in range(5):
+        exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+    w_now = np.array(scope.find_var(w))
+    with ema.apply(exe, scope=scope):
+        w_ema = np.array(scope.find_var(w))
+        assert not np.allclose(w_ema, w_now)
+    np.testing.assert_array_equal(np.array(scope.find_var(w)), w_now)
+
+
+def test_lookahead_sync_every_k(scope):
+    main, startup, loss, la, w = _build("lookahead")
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope, use_compiled=False)
+    slow_name = w + "@SLOW"
+    w0 = np.array(scope.find_var(w))
+    np.testing.assert_array_equal(w0, np.array(scope.find_var(slow_name)))
+    feed = {"x": np.ones((2, 4), np.float32)}
+    for _ in range(4):
+        exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+    slow = np.array(scope.find_var(slow_name))
+    fast = np.array(scope.find_var(w))
+    assert not np.allclose(slow, w0), "slow weights never updated"
+    # step 4 is a sync step (k=2): fast == slow
+    np.testing.assert_allclose(slow, fast, rtol=1e-6)
+
+
+def test_model_average_apply(scope):
+    main, startup, loss, ma, w = _build("ma")
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope, use_compiled=False)
+    feed = {"x": np.ones((2, 4), np.float32)}
+    for _ in range(3):
+        exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+    w_now = np.array(scope.find_var(w))
+    with ma.apply(exe, scope=scope):
+        w_avg = np.array(scope.find_var(w))
+        assert not np.allclose(w_avg, w_now)
+    np.testing.assert_array_equal(np.array(scope.find_var(w)), w_now)
+
+
+def test_model_average_window_bounded(scope):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4])
+        loss = layers.mean(layers.fc(x, 1))
+        pt.optimizer.SGDOptimizer(0.0).minimize(loss)  # lr 0: params frozen
+        ma = pt.optimizer.ModelAverage(0.15, max_average_window=4)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope, use_compiled=False)
+    feed = {"x": np.ones((2, 4), np.float32)}
+    for _ in range(10):
+        exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+    cnt = float(np.asarray(scope.find_var(ma._count_name)).reshape(-1)[0])
+    assert cnt <= 5.5, cnt  # halved whenever it crosses 4
+    # average of a constant param is that param
+    w = main.all_parameters()[0].name
+    w_now = np.array(scope.find_var(w))
+    with ma.apply(exe, scope=scope):
+        np.testing.assert_allclose(np.array(scope.find_var(w)), w_now,
+                                   rtol=1e-5)
