@@ -781,3 +781,19 @@ def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
 def dropout_with_impl(x, p, is_test=False):
     return dropout(x, p, is_test=is_test,
                    dropout_implementation="upscale_in_train")
+
+
+def flash_attention(q, k, v, bias=None, causal=False, scale=None, name=None):
+    """Fused attention: softmax(q k^T * scale + bias) v via the Pallas
+    flash-attention kernel (ops/attention_ops.py). q [B,H,Sq,D];
+    k,v [B,H,Sk,D]; bias optional, broadcastable to [B,1,1,Sk]."""
+    helper = LayerHelper("flash_attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    inputs = {"Q": [q], "K": [k], "V": [v]}
+    if bias is not None:
+        inputs["Bias"] = [bias]
+    attrs = {"causal": causal}
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    helper.append_op("flash_attention", inputs, {"Out": [out]}, attrs)
+    return out

@@ -39,6 +39,11 @@ class BertConfig:
     initializer_range: float = 0.02
     hidden_act: str = "gelu"
     dtype: str = "float32"
+    # emit the fused Pallas flash-attention op instead of the
+    # matmul/softmax/matmul chain (ops/attention_ops.py). Probability
+    # dropout is folded away on this path (flash kernels don't
+    # materialise probs); hidden dropout is unaffected.
+    use_flash_attention: bool = False
 
 
 def bert_base() -> BertConfig:
@@ -99,14 +104,18 @@ def _attention(x, attn_bias, cfg: BertConfig, name: str, is_test=False):
     q = layers.squeeze(q, [0])
     k = layers.squeeze(k, [0])
     v = layers.squeeze(v, [0])
-    scores = layers.matmul(q, k, transpose_y=True, alpha=1.0 / np.sqrt(hd))
-    if attn_bias is not None:
-        scores = scores + attn_bias
-    probs = layers.softmax(scores)
-    probs = layers.dropout(probs, cfg.attention_probs_dropout_prob,
-                           is_test=is_test,
-                           dropout_implementation="upscale_in_train")
-    ctx = layers.matmul(probs, v)                     # [B,n,S,hd]
+    if cfg.use_flash_attention:
+        ctx = layers.flash_attention(q, k, v, bias=attn_bias,
+                                     scale=1.0 / np.sqrt(hd))
+    else:
+        scores = layers.matmul(q, k, transpose_y=True, alpha=1.0 / np.sqrt(hd))
+        if attn_bias is not None:
+            scores = scores + attn_bias
+        probs = layers.softmax(scores)
+        probs = layers.dropout(probs, cfg.attention_probs_dropout_prob,
+                               is_test=is_test,
+                               dropout_implementation="upscale_in_train")
+        ctx = layers.matmul(probs, v)                 # [B,n,S,hd]
     ctx = layers.transpose(ctx, [0, 2, 1, 3])
     ctx = layers.reshape(ctx, [0, 0, h])
     return _dense(ctx, h, f"{name}_out", cfg, tp_spec=("mp", None))

@@ -1,0 +1,48 @@
+"""Fused attention ops backed by the Pallas flash-attention kernel.
+
+Capability mirror of the reference's fused inference attention
+(operators/fused/multihead_matmul_op.cu) generalised to training: one IR op
+`flash_attention` replaces the matmul/softmax/dropout/matmul chain, with a
+custom-VJP Pallas backward. The inference fuse pass
+(inference/passes) rewrites the unfused pattern into this op; models can
+also emit it directly (models/bert.py with use_flash_attention=True).
+"""
+
+from __future__ import annotations
+
+from ..core.registry import register_op
+
+
+@register_op("flash_attention", non_diff_inputs=("Bias",))
+def flash_attention_op(ins, attrs):
+    """Out = softmax(Q K^T * scale + Bias) V.
+
+    Q [B,H,Sq,D]; K,V [B,H,Sk,D]; Bias optional, broadcastable to
+    [B,1,1,Sk] (key padding mask). Attrs: causal (bool), scale (float,
+    default 1/sqrt(D)).
+    """
+    from .pallas import flash_attention
+
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    bias = None
+    if ins.get("Bias") and ins["Bias"][0] is not None:
+        bias = ins["Bias"][0]
+    out = flash_attention(q, k, v, bias=bias,
+                          causal=bool(attrs.get("causal", False)),
+                          scale=attrs.get("scale", None))
+    return {"Out": out}
+
+
+@register_op("fused_layer_norm")
+def fused_layer_norm_op(ins, attrs):
+    """layer_norm over the last axis via the Pallas kernel (nn_ops.layer_norm
+    stays the general begin_norm_axis implementation)."""
+    from .pallas import fused_layer_norm
+
+    x = ins["X"][0]
+    scale = ins["Scale"][0] if ins.get("Scale") and ins["Scale"][0] is not None else None
+    bias = ins["Bias"][0] if ins.get("Bias") and ins["Bias"][0] is not None else None
+    eps = attrs.get("epsilon", 1e-5)
+    y, mean, rstd = fused_layer_norm(x, scale, bias, eps=eps)
+    # match nn_ops.layer_norm's contract: Variance is the variance, not rstd
+    return {"Y": y, "Mean": mean, "Variance": 1.0 / (rstd * rstd) - eps}

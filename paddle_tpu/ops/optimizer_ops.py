@@ -74,6 +74,22 @@ def adamw(ins, attrs):
 
     p, lr = ins["Param"][0], ins["LearningRate"][0]
     coeff = np.asarray(attrs.get("coeff", 0.01), np.float32)
+
+    from .pallas import fused_adamw, kernel_mode
+
+    if kernel_mode() != "off" and attrs.get("with_decay", True):
+        g = ins["Grad"][0]
+        m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+        b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+        b1 = float(attrs.get("beta1", 0.9))
+        b2 = float(attrs.get("beta2", 0.999))
+        po, mo, vo = fused_adamw(
+            p, g.astype(m1.dtype), m1, m2, lr, b1, b2,
+            float(attrs.get("epsilon", 1e-8)), float(coeff),
+            b1p.reshape(()), b2p.reshape(()))
+        return {"ParamOut": po, "Moment1Out": mo, "Moment2Out": vo,
+                "Beta1PowOut": b1p * b1, "Beta2PowOut": b2p * b2}
+
     outs = adam(ins, attrs)
     if attrs.get("with_decay", True):
         outs["ParamOut"] = (outs["ParamOut"].astype(np.float32)

@@ -1,0 +1,50 @@
+"""Pallas TPU kernels — the hand-fused hot path (SURVEY.md §7 L8').
+
+Capability mirror of the reference's hand-fused CUDA kernels
+(operators/fused/multihead_matmul_op.cu, fused_embedding_eltwise_layernorm,
+math/bert_encoder_functor.cu) and fused optimizer passes
+(ir/fuse_optimizer_ops_pass/), re-designed as Pallas TPU kernels:
+
+* flash_attention — blockwise online-softmax attention (fwd + bwd kernels),
+* layer_norm      — fused row-normalisation,
+* fused_adamw     — single-kernel parameter/moment update.
+
+Mode selection (``kernel_mode()``):
+  'tpu'       compiled Pallas on a real TPU backend,
+  'interpret' pallas interpreter (CPU tests validate kernels bit-for-bit
+              against the jnp references),
+  'off'       pure-jnp reference (XLA still fuses well; default on CPU).
+Env override: PT_PALLAS=off|interpret|auto.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def kernel_mode() -> str:
+    env = os.environ.get("PT_PALLAS", "auto").lower()
+    if env in ("off", "0", "false"):
+        return "off"
+    if env == "interpret":
+        return "interpret"
+    import jax
+
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        return "off"
+    return "tpu" if backend == "tpu" else "off"
+
+
+def use_pallas() -> bool:
+    return kernel_mode() in ("tpu", "interpret")
+
+
+def interpret_mode() -> bool:
+    return kernel_mode() == "interpret"
+
+
+from .flash_attention import flash_attention  # noqa: E402,F401
+from .layer_norm import fused_layer_norm  # noqa: E402,F401
+from .fused_adam import fused_adamw  # noqa: E402,F401

@@ -1,0 +1,419 @@
+"""Flash attention as Pallas TPU kernels (fwd + bwd), with custom_vjp.
+
+The TPU answer to the reference's fused attention CUDA kernels
+(operators/fused/multihead_matmul_op.cu, math/bert_encoder_functor.cu):
+blockwise online-softmax attention that never materialises the [S, S]
+probability matrix in HBM — O(S) memory, MXU-sized tiles, fp32 accumulation
+over bf16 inputs.
+
+Layout: q [B, H, Sq, D], k/v [B, H, Sk, D]; optional additive bias over
+keys ([B, Sk], or any shape broadcastable to [B, 1, 1, Sk] — the padding
+mask form BERT/ERNIE use); optional causal masking.
+
+Falls back to a pure-jnp reference when shapes don't meet TPU tiling
+constraints or no TPU/interpreter backend is selected (kernel_mode()).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# jnp reference (used for fallback and as the test oracle)
+# ---------------------------------------------------------------------------
+
+def reference_attention(q, k, v, bias_kv=None, causal=False, scale=None):
+    """Plain XLA attention: softmax(q k^T * scale + bias) v, fp32 softmax.
+    bias_kv may be [B, Sk] (key-padding form) or any [B,H,Sq,Sk]-broadcastable
+    4-D bias."""
+    d = q.shape[-1]
+    scale = float(scale) if scale is not None else 1.0 / np.sqrt(d)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if bias_kv is not None:
+        b = bias_kv.astype(jnp.float32)
+        s = s + (b[:, None, None, :] if b.ndim == 2 else b)
+    if causal:
+        sq, sk = q.shape[2], k.shape[2]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k,
+                causal_offset=0):
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0]                                   # (bq, d) native dtype
+    k = k_ref[0]                                   # (bk, d)
+    v = v_ref[0]
+    # native-dtype (bf16) MXU dots, fp32 accumulation
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if bias_ref is not None:
+        s = s + bias_ref[0, 0].astype(jnp.float32)[None, :]
+    if causal:
+        i = pl.program_id(1)
+        rows = causal_offset + i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32,
+                                                      (block_q, block_k), 1)
+        s = jnp.where(rows >= cols, s, NEG_INF)
+
+    m_prev = m_scr[:, :1]                          # (bq, 1)
+    l_prev = l_scr[:, :1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    p = jnp.exp(s - m_new)                         # (bq, bk)
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
+        p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)            # fully-masked rows → 0 out
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_scr[:, :1]
+                         + jnp.log(jnp.maximum(l_scr[:, :1], 1e-30)))[:, 0]
+
+
+def _fwd_pallas(q, k, v, bias_kv, causal, scale, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq, bk = min(DEFAULT_BLOCK_Q, sq), min(DEFAULT_BLOCK_K, sk)
+    bh = b * h
+    q3 = q.reshape(bh, sq, d)
+    k3 = k.reshape(bh, sk, d)
+    v3 = v.reshape(bh, sk, d)
+    grid = (bh, sq // bq, sk // bk)
+
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda bi, i, j: (bi, i, 0)),
+        pl.BlockSpec((1, bk, d), lambda bi, i, j: (bi, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda bi, i, j: (bi, j, 0)),
+    ]
+    args = [q3, k3, v3]
+    if bias_kv is not None:
+        in_specs.append(pl.BlockSpec(
+            (1, 1, bk), lambda bi, i, j, _h=h: (bi // _h, 0, j)))
+        args.append(bias_kv.reshape(bias_kv.shape[0], 1, bias_kv.shape[1]))
+        kernel = _fwd_kernel
+    else:
+        kernel = functools.partial(_bias_none_wrap, _fwd_kernel, n_in=3)
+
+    out_shape = [
+        jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
+    ]
+    out_specs = [
+        pl.BlockSpec((1, bq, d), lambda bi, i, j: (bi, i, 0)),
+        pl.BlockSpec((1, 1, bq), lambda bi, i, j: (bi, 0, i)),
+    ]
+    scratch = [
+        pltpu.VMEM((bq, 128), jnp.float32),
+        pltpu.VMEM((bq, 128), jnp.float32),
+        pltpu.VMEM((bq, d), jnp.float32),
+    ]
+    o3, lse = pl.pallas_call(
+        functools.partial(kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, causal_offset=sk - sq),
+        grid=grid, in_specs=in_specs, out_specs=out_specs,
+        out_shape=out_shape, scratch_shapes=scratch,
+        interpret=interpret)(*args)
+    return o3.reshape(b, h, sq, d), lse.reshape(b, h, sq)
+
+
+def _bias_none_wrap(kernel, *refs, n_in, **kw):
+    """Adapt a kernel expecting a bias ref to the no-bias call signature."""
+    ins, rest = refs[:n_in], refs[n_in:]
+    kernel(*ins, None, *rest, **kw)
+
+
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
+                block_q, block_k, causal_offset=0):
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(2)                      # q block (innermost)
+    nq = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q = q_ref[0]                              # (bq, d) native dtype
+    k = k_ref[0]                              # (bk, d)
+    v = v_ref[0]
+    do = do_ref[0]                            # (bq, d)
+    lse = lse_ref[0, 0][:, None]              # (bq, 1)
+    delta = delta_ref[0, 0][:, None]
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if bias_ref is not None:
+        s = s + bias_ref[0, 0].astype(jnp.float32)[None, :]
+    if causal:
+        j = pl.program_id(1)
+        rows = causal_offset + i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32,
+                                                      (block_q, block_k), 1)
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    p = jnp.exp(s - lse)                      # (bq, bk) fp32
+    dv_scr[:] += jax.lax.dot_general(p.astype(do.dtype), do,
+                                     (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale             # (bq, bk)
+    dk_scr[:] += jax.lax.dot_general(ds.astype(q.dtype), q,
+                                     (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+
+    @pl.when(i == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
+               dq_ref, dq_scr, *, scale, causal, block_q, block_k,
+               causal_offset=0):
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(2)                      # kv block (innermost)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0, 0][:, None]
+    delta = delta_ref[0, 0][:, None]
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if bias_ref is not None:
+        s = s + bias_ref[0, 0].astype(jnp.float32)[None, :]
+    if causal:
+        i = pl.program_id(1)
+        rows = causal_offset + i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32,
+                                                      (block_q, block_k), 1)
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    p = jnp.exp(s - lse)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale
+    dq_scr[:] += jax.lax.dot(ds.astype(k.dtype), k,
+                             preferred_element_type=jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_pallas(q, k, v, bias_kv, causal, scale, interpret, o, lse, do):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq, bk = min(DEFAULT_BLOCK_Q, sq), min(DEFAULT_BLOCK_K, sk)
+    bh = b * h
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1).reshape(bh, 1, sq)
+    q3, k3, v3 = (t.reshape(bh, t.shape[2], d) for t in (q, k, v))
+    do3 = do.reshape(bh, sq, d)
+    lse3 = lse.reshape(bh, 1, sq)
+    bias3 = (None if bias_kv is None
+             else bias_kv.reshape(bias_kv.shape[0], 1, bias_kv.shape[1]))
+
+    def specs(maps):
+        return [pl.BlockSpec(shape, m) for shape, m in maps]
+
+    common_args = [q3, k3, v3, do3, lse3, delta]
+    has_bias = bias_kv is not None
+
+    # --- dk/dv: grid (bh, kv blocks, q blocks) ---
+    in_specs = specs([
+        ((1, bq, d), lambda bi, j, i: (bi, i, 0)),
+        ((1, bk, d), lambda bi, j, i: (bi, j, 0)),
+        ((1, bk, d), lambda bi, j, i: (bi, j, 0)),
+        ((1, bq, d), lambda bi, j, i: (bi, i, 0)),
+        ((1, 1, bq), lambda bi, j, i: (bi, 0, i)),
+        ((1, 1, bq), lambda bi, j, i: (bi, 0, i)),
+    ])
+    args = list(common_args)
+    if has_bias:
+        in_specs.append(pl.BlockSpec((1, 1, bk),
+                                     lambda bi, j, i, _h=h: (bi // _h, 0, j)))
+        args.append(bias3)
+        kernel = _dkv_kernel
+    else:
+        kernel = functools.partial(_bias_none_wrap, _dkv_kernel, n_in=6)
+    dk3, dv3 = pl.pallas_call(
+        functools.partial(kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, causal_offset=sk - sq),
+        grid=(bh, sk // bk, sq // bq),
+        in_specs=in_specs,
+        out_specs=[pl.BlockSpec((1, bk, d), lambda bi, j, i: (bi, j, 0)),
+                   pl.BlockSpec((1, bk, d), lambda bi, j, i: (bi, j, 0))],
+        out_shape=[jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, sk, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=interpret)(*args)
+
+    # --- dq: grid (bh, q blocks, kv blocks) ---
+    in_specs = specs([
+        ((1, bq, d), lambda bi, i, j: (bi, i, 0)),
+        ((1, bk, d), lambda bi, i, j: (bi, j, 0)),
+        ((1, bk, d), lambda bi, i, j: (bi, j, 0)),
+        ((1, bq, d), lambda bi, i, j: (bi, i, 0)),
+        ((1, 1, bq), lambda bi, i, j: (bi, 0, i)),
+        ((1, 1, bq), lambda bi, i, j: (bi, 0, i)),
+    ])
+    args = list(common_args)
+    if has_bias:
+        in_specs.append(pl.BlockSpec((1, 1, bk),
+                                     lambda bi, i, j, _h=h: (bi // _h, 0, j)))
+        args.append(bias3)
+        kernel = _dq_kernel
+    else:
+        kernel = functools.partial(_bias_none_wrap, _dq_kernel, n_in=6)
+    dq3 = pl.pallas_call(
+        functools.partial(kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, causal_offset=sk - sq),
+        grid=(bh, sq // bq, sk // bk),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, bq, d), lambda bi, i, j: (bi, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret)(*args)
+
+    return (dq3.reshape(q.shape), dk3.reshape(k.shape), dv3.reshape(v.shape))
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper + public API
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash(q, k, v, bias_kv, causal, scale, interpret):
+    o, _ = _fwd_pallas(q, k, v, bias_kv, causal, scale, interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, bias_kv, causal, scale, interpret):
+    o, lse = _fwd_pallas(q, k, v, bias_kv, causal, scale, interpret)
+    return o, (q, k, v, bias_kv, o, lse)
+
+
+def _flash_bwd(causal, scale, interpret, res, do):
+    q, k, v, bias_kv, o, lse = res
+    dq, dk, dv = _bwd_pallas(q, k, v, bias_kv, causal, scale, interpret,
+                             o, lse, do)
+    return dq, dk, dv, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _supported(q, k, bias_kv):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    if d > 256:
+        return False
+    if sq % min(DEFAULT_BLOCK_Q, sq) or sk % min(DEFAULT_BLOCK_K, sk):
+        return False
+    if min(sq, sk) < 8:
+        return False
+    if bias_kv is not None and bias_kv.shape != (b, sk):
+        return False
+    return True
+
+
+def _pad_head_dim(x, target):
+    d = x.shape[-1]
+    if d == target:
+        return x
+    pad = [(0, 0)] * (x.ndim - 1) + [(0, target - d)]
+    return jnp.pad(x, pad)
+
+
+def flash_attention(q, k, v, bias=None, causal=False, scale=None):
+    """softmax(q k^T * scale + bias) v with flash blocking.
+
+    q [B,H,Sq,D]; k,v [B,H,Sk,D]; bias None or broadcastable to
+    [B,1,1,Sk] (key padding mask) or exactly [B,Sk].
+    """
+    from . import kernel_mode
+
+    d = q.shape[-1]
+    scale = float(scale) if scale is not None else 1.0 / float(np.sqrt(d))
+
+    bias_kv = None
+    if bias is not None:
+        b, sk = q.shape[0], k.shape[2]
+        bias_kv = jnp.broadcast_to(bias, (b, 1, 1, sk)).reshape(b, sk) \
+            if bias.ndim == 4 and bias.shape[1] == 1 and bias.shape[2] == 1 \
+            else (bias if bias.ndim == 2 else None)
+        if bias_kv is None:
+            # general bias → reference path
+            return reference_attention(q, k, v, bias, causal, scale)
+
+    mode = kernel_mode()
+    if mode == "off" or not _supported(q, k, bias_kv):
+        return reference_attention(q, k, v, bias_kv, causal, scale)
+
+    # pad head dim only when it breaks sublane tiling (block covers the
+    # whole d, so any multiple of 8 is legal; zero pads don't change
+    # scores and padded v columns are sliced off)
+    dpad = d if d % 8 == 0 else int(np.ceil(d / 8) * 8)
+    qp, kp, vp = (_pad_head_dim(t, dpad) for t in (q, k, v))
+    out = _flash(qp, kp, vp, bias_kv, causal, scale, mode == "interpret")
+    return out[..., :d]
+
