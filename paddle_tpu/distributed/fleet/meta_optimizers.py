@@ -34,19 +34,27 @@ from ...layers import nn as L
 # ---------------------------------------------------------------------------
 
 def insert_grad_allreduce(program: Program, params_grads, nranks: int,
-                          axis_name: str = "dp"):
-    """Append scale(1/n) + c_allreduce_sum for each grad
-    (reference: transpiler/collective.py GradAllReduce.transpile:178 —
-    there via inserted ops after each grad op; op order inside one XLA
-    program is dataflow, so appending is equivalent)."""
+                          axis_name="dp", average: bool = True):
+    """Append [scale(1/n) +] c_allreduce_sum for each grad
+    (reference: transpiler/collective.py GradAllReduce.transpile:178).
+    MUST be called between backward() and apply_gradients(): the executor
+    runs ops in block order, so allreduce ops appended after the optimizer
+    ops would rebind the grad names only after the update consumed them.
+
+    average=True is classic DP (per-rank mean losses → grads averaged);
+    average=False is for programs whose loss is already globally normalised
+    via in-program c_allreduce_sum (e.g. sequence-parallel token losses) —
+    per-rank grads are partials of the SAME global loss, so they sum.
+    axis_name may be a tuple (e.g. ("dp", "sp"))."""
     if nranks <= 1:
         return
     block = program.global_block()
     with program._role_guard(OpRole.Backward):
         for p, g in params_grads:
-            block.append_op("scale", {"X": [g]}, {"Out": [g]},
-                            {"scale": 1.0 / nranks,
-                             "op_role_var": [p.name, g.name]})
+            if average:
+                block.append_op("scale", {"X": [g]}, {"Out": [g]},
+                                {"scale": 1.0 / nranks,
+                                 "op_role_var": [p.name, g.name]})
             block.append_op("c_allreduce_sum", {"X": [g]}, {"Out": [g]},
                             {"axis_name": axis_name, "ring_id": 0,
                              "nranks": nranks,

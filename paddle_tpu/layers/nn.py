@@ -797,3 +797,20 @@ def flash_attention(q, k, v, bias=None, causal=False, scale=None, name=None):
         attrs["scale"] = float(scale)
     helper.append_op("flash_attention", inputs, {"Out": [out]}, attrs)
     return out
+
+
+def ring_attention(q, k, v, bias=None, causal=False, scale=None,
+                   axis_name="sp", nranks=1, name=None):
+    """Sequence-parallel ring attention (parallel/ring_attention.py).
+    q/k/v are sequence shards [B,H,S_local,D]; bias a key-bias shard
+    [B,S_local] travelling with kv around the ring."""
+    helper = LayerHelper("ring_attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    inputs = {"Q": [q], "K": [k], "V": [v]}
+    if bias is not None:
+        inputs["Bias"] = [bias]
+    attrs = {"causal": causal, "axis_name": axis_name, "nranks": nranks}
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    helper.append_op("ring_attention", inputs, {"Out": [out]}, attrs)
+    return out

@@ -14,6 +14,7 @@ zoos on the fluid layers API; here the encoder is built the same way
 
 from __future__ import annotations
 
+import dataclasses
 from dataclasses import dataclass
 
 import numpy as np
@@ -44,6 +45,10 @@ class BertConfig:
     # dropout is folded away on this path (flash kernels don't
     # materialise probs); hidden dropout is unaffected.
     use_flash_attention: bool = False
+    # emit ring_attention ops (parallel/ring_attention.py): the sequence
+    # axis is sharded over the 'sp' mesh axis and kv shards rotate over
+    # ICI. Set by build_pretraining_program(sequence_parallel=n).
+    use_ring_attention: bool = False
 
 
 def bert_base() -> BertConfig:
@@ -67,6 +72,18 @@ def _param(name, cfg):
         0.0, cfg.initializer_range))
 
 
+def _allreduce_sum(x, axes, nranks):
+    """Append an in-program c_allreduce_sum over mesh `axes` (multi-axis
+    psum; ops/collective_ops.py)."""
+    from ..layer_helper import LayerHelper
+
+    helper = LayerHelper("c_allreduce_sum")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("c_allreduce_sum", {"X": [x]}, {"Out": [out]},
+                     {"axis_name": list(axes), "nranks": nranks})
+    return out
+
+
 def _dense(x, d_out, name, cfg, act=None, tp_spec=None):
     """3-D dense: [B,S,H] @ [H,d_out] + b, with optional TP sharding spec on
     the weight (e.g. (None,'mp') column-parallel, ('mp',None) row-parallel)."""
@@ -86,7 +103,8 @@ def _dense(x, d_out, name, cfg, act=None, tp_spec=None):
     return out
 
 
-def _attention(x, attn_bias, cfg: BertConfig, name: str, is_test=False):
+def _attention(x, attn_bias, cfg: BertConfig, name: str, is_test=False,
+               attn_bias2d=None):
     """Multi-head self-attention via program ops (matmul/reshape/transpose/
     softmax). Swappable with the fused flash-attention op (ops/attention_ops)
     by the fuse pass; QKV is column-parallel, the output projection
@@ -104,7 +122,19 @@ def _attention(x, attn_bias, cfg: BertConfig, name: str, is_test=False):
     q = layers.squeeze(q, [0])
     k = layers.squeeze(k, [0])
     v = layers.squeeze(v, [0])
-    if cfg.use_flash_attention:
+    if (cfg.use_ring_attention or cfg.use_flash_attention) and \
+            not is_test and cfg.attention_probs_dropout_prob > 0.0:
+        import warnings
+
+        warnings.warn(
+            "flash/ring attention does not materialise attention "
+            "probabilities, so attention_probs_dropout_prob="
+            f"{cfg.attention_probs_dropout_prob} is ignored on this path "
+            "(hidden dropout still applies)", stacklevel=3)
+    if cfg.use_ring_attention:
+        ctx = layers.ring_attention(q, k, v, bias=attn_bias2d,
+                                    scale=1.0 / np.sqrt(hd), axis_name="sp")
+    elif cfg.use_flash_attention:
         ctx = layers.flash_attention(q, k, v, bias=attn_bias,
                                      scale=1.0 / np.sqrt(hd))
     else:
@@ -121,8 +151,10 @@ def _attention(x, attn_bias, cfg: BertConfig, name: str, is_test=False):
     return _dense(ctx, h, f"{name}_out", cfg, tp_spec=("mp", None))
 
 
-def _encoder_layer(x, attn_bias, cfg: BertConfig, name: str, is_test=False):
-    attn = _attention(x, attn_bias, cfg, f"{name}_attn", is_test)
+def _encoder_layer(x, attn_bias, cfg: BertConfig, name: str, is_test=False,
+                   attn_bias2d=None):
+    attn = _attention(x, attn_bias, cfg, f"{name}_attn", is_test,
+                      attn_bias2d=attn_bias2d)
     attn = layers.dropout(attn, cfg.hidden_dropout_prob, is_test=is_test,
                           dropout_implementation="upscale_in_train")
     x = layers.layer_norm(x + attn, begin_norm_axis=2,
@@ -159,28 +191,45 @@ def bert_encoder(src_ids, sent_ids, pos_ids, input_mask, cfg: BertConfig,
                           bias_attr=ParamAttr(name="emb_ln_bias"))
     x = layers.dropout(x, cfg.hidden_dropout_prob, is_test=is_test,
                        dropout_implementation="upscale_in_train")
-    # additive attention bias from the [B,S] 0/1 mask → [B,1,1,S]:
-    # (mask-1)*1e4 → 0 on real tokens, -1e4 on padding
-    mask = layers.unsqueeze(input_mask, [1, 2])
-    attn_bias = layers.scale(mask, scale=10000.0, bias=-1.0,
-                             bias_after_scale=False)
+    # additive attention bias from the [B,S] 0/1 mask:
+    # (mask-1)*1e4 → 0 on real tokens, -1e4 on padding. Kept 2-D for the
+    # ring-attention path (the bias shard travels with its kv shard) and
+    # unsqueezed to [B,1,1,S] for the dense paths.
+    bias2d = layers.scale(input_mask, scale=10000.0, bias=-1.0,
+                          bias_after_scale=False)
+    bias2d.stop_gradient = True
+    attn_bias = layers.unsqueeze(bias2d, [1, 2])
     attn_bias.stop_gradient = True
     for i in range(cfg.num_hidden_layers):
-        x = _encoder_layer(x, attn_bias, cfg, f"layer_{i}", is_test)
+        x = _encoder_layer(x, attn_bias, cfg, f"layer_{i}", is_test,
+                           attn_bias2d=bias2d)
     return x
 
 
 def build_pretraining_program(cfg: BertConfig, seq_len: int = 128,
                               batch_size: int = -1, optimizer_name="adamw",
                               lr: float = 1e-4, is_test=False,
-                              with_optimizer=True):
+                              with_optimizer=True, with_nsp=True,
+                              sequence_parallel: int = 0,
+                              data_parallel: int = 1):
     """MLM + NSP pretraining step (the reference-era BERT/ERNIE recipe).
 
     Feeds: src_ids, sent_ids, pos_ids, input_mask [B,S];
            mask_labels [B,S] int64 (-0 where unmasked), mask_pos_weight [B,S]
            float 1.0 at masked positions; nsp_labels [B,1].
-    Fetches: loss (total), lm_loss, nsp_loss.
+    Fetches: loss (total), lm_loss, nsp_loss (0 when with_nsp=False).
+
+    sequence_parallel=n (>1) builds the long-context SP variant: ring
+    attention over the 'sp' mesh axis, token feeds sharded ('dp','sp'),
+    MLM loss globally normalised via in-program c_allreduce_sum, grads
+    summed (not averaged) over ('dp','sp'). NSP is dropped on this path
+    (its [CLS] pooling is not sequence-shardable).
     """
+    sp = int(sequence_parallel or 0)
+    dp = int(data_parallel or 1)
+    if sp > 1:
+        cfg = dataclasses.replace(cfg, use_ring_attention=True)
+        with_nsp = False
     main, startup = Program(), Program()
     with program_guard(main, startup):
         B, S = batch_size, seq_len
@@ -210,19 +259,31 @@ def build_pretraining_program(cfg: BertConfig, seq_len: int = 128,
         lm_loss_all = layers.softmax_with_cross_entropy(
             lm_logits, layers.unsqueeze(mask_labels, [2]))
         lm_loss_all = layers.squeeze(lm_loss_all, [2])
-        denom = layers.reduce_sum(mask_weight) + 1e-5
-        lm_loss = layers.reduce_sum(lm_loss_all * mask_weight) / denom
+        num = layers.reduce_sum(lm_loss_all * mask_weight)
+        denom = layers.reduce_sum(mask_weight)
+        if sp > 1:
+            # global normalisation: per-shard token sums → psum over the
+            # data+sequence shards, so every rank computes the SAME global
+            # loss (grads then SUM unscaled — see insert_grad_allreduce)
+            num = _allreduce_sum(num, ("dp", "sp"), nranks=sp * dp)
+            denom = _allreduce_sum(denom, ("dp", "sp"), nranks=sp * dp)
+        lm_loss = num / (denom + 1e-5)
 
-        # NSP head on pooled [CLS]
-        first_tok = layers.slice(seq_out, [1], [0], [1])
-        pooled = _dense(first_tok, cfg.hidden_size, "pooler", cfg, act="tanh")
-        pooled = layers.reshape(pooled, [0, cfg.hidden_size])
-        nsp_logits = layers.fc(pooled, 2, param_attr=_param("nsp_w", cfg),
-                               bias_attr=ParamAttr(name="nsp_b"))
-        nsp_loss = layers.mean(
-            layers.softmax_with_cross_entropy(nsp_logits, nsp_labels))
+        if with_nsp:
+            # NSP head on pooled [CLS]
+            first_tok = layers.slice(seq_out, [1], [0], [1])
+            pooled = _dense(first_tok, cfg.hidden_size, "pooler", cfg,
+                            act="tanh")
+            pooled = layers.reshape(pooled, [0, cfg.hidden_size])
+            nsp_logits = layers.fc(pooled, 2, param_attr=_param("nsp_w", cfg),
+                                   bias_attr=ParamAttr(name="nsp_b"))
+            nsp_loss = layers.mean(
+                layers.softmax_with_cross_entropy(nsp_logits, nsp_labels))
+            loss = lm_loss + nsp_loss
+        else:
+            nsp_loss = layers.fill_constant([1], "float32", 0.0)
+            loss = lm_loss
 
-        loss = lm_loss + nsp_loss
         if with_optimizer:
             from .. import optimizer as opt_mod
 
@@ -232,7 +293,26 @@ def build_pretraining_program(cfg: BertConfig, seq_len: int = 128,
                 opt = opt_mod.LambOptimizer(lr)
             else:
                 opt = opt_mod.AdamOptimizer(lr)
-            opt.minimize(loss)
+            if sp > 1:
+                # backward → grad allreduce → update (the executor runs ops
+                # in block order, so the allreduce MUST precede the
+                # optimizer ops — same order fleet_base uses)
+                from ..distributed.fleet.meta_optimizers import \
+                    insert_grad_allreduce
+
+                params_grads = opt.backward(loss)
+                insert_grad_allreduce(main, params_grads, nranks=sp * dp,
+                                      axis_name=("dp", "sp"), average=False)
+                opt.apply_gradients(params_grads)
+            else:
+                opt.minimize(loss)
+
+    if sp > 1:
+        from ..parallel.api import shard_tensor
+
+        for v in (src_ids, sent_ids, pos_ids, input_mask, mask_labels,
+                  mask_weight):
+            shard_tensor(v, ("dp", "sp"))
 
     feeds = dict(src_ids=src_ids, sent_ids=sent_ids, pos_ids=pos_ids,
                  input_mask=input_mask, mask_labels=mask_labels,

@@ -33,6 +33,25 @@ def flash_attention_op(ins, attrs):
     return {"Out": out}
 
 
+@register_op("ring_attention", non_diff_inputs=("Bias",), is_collective=True)
+def ring_attention_op(ins, attrs):
+    """Sequence-parallel attention over the `sp` mesh axis
+    (parallel/ring_attention.py). Q/K/V are the local sequence shards
+    [B,H,S_local,D]; Bias the local key-bias shard [B,S_local]. Degrades to
+    single-device flash attention outside an SPMD region (nranks==1)."""
+    from ..parallel.ring_attention import ring_attention
+
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    bias = None
+    if ins.get("Bias") and ins["Bias"][0] is not None:
+        bias = ins["Bias"][0]
+    out = ring_attention(q, k, v, bias_kv=bias,
+                         causal=bool(attrs.get("causal", False)),
+                         scale=attrs.get("scale", None),
+                         axis_name=attrs.get("axis_name", "sp"))
+    return {"Out": out}
+
+
 @register_op("fused_layer_norm")
 def fused_layer_norm_op(ins, attrs):
     """layer_norm over the last axis via the Pallas kernel (nn_ops.layer_norm

@@ -20,29 +20,39 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..core.registry import register_op
+from ..core.registry import register_grad_maker, register_op
 
 
-def _axis_name(attrs) -> str:
-    # ring_id kept for API parity; axis_name wins if present
+def _axis_name(attrs):
+    # ring_id kept for API parity; axis_name wins if present. May be a
+    # tuple/list of axes (e.g. ("dp", "sp") grad allreduce for
+    # sequence-parallel training) — lax.psum-family accept multi-axis.
     ax = attrs.get("axis_name")
     if ax:
-        return ax
+        return tuple(ax) if isinstance(ax, (list, tuple)) else ax
     ring = int(attrs.get("ring_id", 0))
     return {0: "dp", 1: "mp", 2: "pp", 3: "sp"}.get(ring, "dp")
 
 
-def _in_spmd(axis: str) -> bool:
-    """True if `axis` is bound as an SPMD axis name in the current trace."""
+def _bound_axes(axis) -> tuple:
+    """Subset of `axis` (name or tuple of names) bound as SPMD axes in the
+    current trace — a program asking for ("dp","sp") still reduces over the
+    axes the active mesh actually has."""
     import jax
 
-    try:
-        jax.lax.axis_index(axis)
-        return True
-    except NameError:
-        return False
-    except Exception:
-        return False
+    axes = axis if isinstance(axis, tuple) else (axis,)
+    bound = []
+    for a in axes:
+        try:
+            jax.lax.axis_index(a)
+            bound.append(a)
+        except Exception:
+            pass
+    return tuple(bound)
+
+
+def _in_spmd(axis) -> bool:
+    return bool(_bound_axes(axis))
 
 
 def _allreduce(reduce_fn):
@@ -50,9 +60,9 @@ def _allreduce(reduce_fn):
         import jax
 
         x = ins["X"][0]
-        ax = _axis_name(attrs)
-        if _in_spmd(ax):
-            x = reduce_fn(x, ax)
+        bound = _bound_axes(_axis_name(attrs))
+        if bound:
+            x = reduce_fn(x, bound if len(bound) > 1 else bound[0])
         return {"Out": x}
 
     return lowering
@@ -204,3 +214,24 @@ def c_sync_calc_stream(ins, attrs):
 @register_op("c_sync_comm_stream", is_collective=True)
 def c_sync_comm_stream(ins, attrs):
     return {"Out": ins["X"][0]}
+
+
+# -- gradients ---------------------------------------------------------------
+# y = psum(x) over an axis: each local x contributes once to the global sum,
+# so with a replicated upstream cotangent dL/dy, dL/dx_local = dL/dy —
+# identity. (The default vjp-based grad maker would emit jax.vjp(psum),
+# whose in-region transpose psums the replicated cotangent — an n× grad.)
+
+def _identity_grad(op, out_grads, in_grads):
+    from ..core.ir import OpDesc
+
+    og = (out_grads.get("Out") or [None])[0]
+    ig = (in_grads.get("X") or [None])[0]
+    if og is None or ig is None:
+        return []
+    return [OpDesc("assign", {"X": [og]}, {"Out": [ig]}, {})]
+
+
+for _t in ("c_allreduce_sum", "allreduce", "c_reduce_sum", "c_identity",
+           "c_sync_calc_stream", "c_sync_comm_stream"):
+    register_grad_maker(_t)(_identity_grad)

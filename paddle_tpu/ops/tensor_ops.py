@@ -26,11 +26,14 @@ def _rng_key(attrs):
     step = attrs.get("__step__")
     if step is not None:
         key = jax.random.fold_in(key, step)
-    # inside a shard_map SPMD region, decorrelate dropout across dp shards
-    try:
-        key = jax.random.fold_in(key, jax.lax.axis_index("dp"))
-    except Exception:
-        pass
+    # inside a shard_map SPMD region, decorrelate random masks across the
+    # data/sequence shards (mp/pp shards replicate activations, so they are
+    # deliberately NOT folded — replicas must agree)
+    for ax in ("dp", "sp"):
+        try:
+            key = jax.random.fold_in(key, jax.lax.axis_index(ax))
+        except Exception:
+            pass
     return key
 
 
