@@ -412,6 +412,10 @@ class Block:
                     attrs)
         if "op_role" not in op.attrs:
             op.attrs["op_role"] = self.program._current_role
+        dev = current_device()
+        if dev is not None and "__device__" not in op.attrs:
+            # pipeline-stage tag (reference: device_guard framework.py:5591)
+            op.attrs["__device__"] = dev
         self.ops.append(op)
         if infer_shape:
             self._infer_op_shapes(op)

@@ -171,38 +171,67 @@ def _encoder_layer(x, attn_bias, cfg: BertConfig, name: str, is_test=False,
                              bias_attr=ParamAttr(name=f"{name}_ln2_bias"))
 
 
-def bert_encoder(src_ids, sent_ids, pos_ids, input_mask, cfg: BertConfig,
-                 is_test=False):
-    """Token+segment+position embeddings → N transformer layers.
-    Returns sequence output [B,S,H]."""
-    emb = layers.embedding(src_ids, [cfg.vocab_size, cfg.hidden_size],
-                           param_attr=_param("word_embedding", cfg),
-                           dtype=cfg.dtype)
-    semb = layers.embedding(sent_ids, [cfg.type_vocab_size, cfg.hidden_size],
-                            param_attr=_param("sent_embedding", cfg),
-                            dtype=cfg.dtype)
-    pemb = layers.embedding(pos_ids, [cfg.max_position_embeddings,
-                                      cfg.hidden_size],
-                            param_attr=_param("pos_embedding", cfg),
-                            dtype=cfg.dtype)
-    x = emb + semb + pemb
-    x = layers.layer_norm(x, begin_norm_axis=2,
-                          param_attr=ParamAttr(name="emb_ln_scale"),
-                          bias_attr=ParamAttr(name="emb_ln_bias"))
-    x = layers.dropout(x, cfg.hidden_dropout_prob, is_test=is_test,
-                       dropout_implementation="upscale_in_train")
-    # additive attention bias from the [B,S] 0/1 mask:
-    # (mask-1)*1e4 → 0 on real tokens, -1e4 on padding. Kept 2-D for the
-    # ring-attention path (the bias shard travels with its kv shard) and
-    # unsqueezed to [B,1,1,S] for the dense paths.
+def _attn_bias_from_mask(input_mask):
+    """Additive attention bias from the [B,S] 0/1 mask:
+    (mask-1)*1e4 → 0 on real tokens, -1e4 on padding. Kept 2-D for the
+    ring-attention path (the bias shard travels with its kv shard) and
+    unsqueezed to [B,1,1,S] for the dense paths."""
     bias2d = layers.scale(input_mask, scale=10000.0, bias=-1.0,
                           bias_after_scale=False)
     bias2d.stop_gradient = True
     attn_bias = layers.unsqueeze(bias2d, [1, 2])
     attn_bias.stop_gradient = True
+    return attn_bias, bias2d
+
+
+def bert_encoder(src_ids, sent_ids, pos_ids, input_mask, cfg: BertConfig,
+                 is_test=False, pipeline_stages: int = 0):
+    """Token+segment+position embeddings → N transformer layers.
+    Returns sequence output [B,S,H].
+
+    pipeline_stages=p (>1) tags op groups with device_guard("stage:k") for
+    the PipelineOptimizer: embeddings + the first layer block on stage 0,
+    then ceil(L/p) layers per stage. The attention bias is re-derived from
+    the input_mask feed inside every stage (feeds are visible to all
+    stages; cross-stage dataflow is restricted to k→k+1)."""
+    from ..core.ir import device_guard
+
+    p = int(pipeline_stages or 0)
+    per_stage = -(-cfg.num_hidden_layers // p) if p > 1 else None
+
+    def stage_of_layer(i):
+        return "stage:%d" % (i // per_stage) if p > 1 else None
+
+    with device_guard("stage:0" if p > 1 else None):
+        emb = layers.embedding(src_ids, [cfg.vocab_size, cfg.hidden_size],
+                               param_attr=_param("word_embedding", cfg),
+                               dtype=cfg.dtype)
+        semb = layers.embedding(sent_ids,
+                                [cfg.type_vocab_size, cfg.hidden_size],
+                                param_attr=_param("sent_embedding", cfg),
+                                dtype=cfg.dtype)
+        pemb = layers.embedding(pos_ids, [cfg.max_position_embeddings,
+                                          cfg.hidden_size],
+                                param_attr=_param("pos_embedding", cfg),
+                                dtype=cfg.dtype)
+        x = emb + semb + pemb
+        x = layers.layer_norm(x, begin_norm_axis=2,
+                              param_attr=ParamAttr(name="emb_ln_scale"),
+                              bias_attr=ParamAttr(name="emb_ln_bias"))
+        x = layers.dropout(x, cfg.hidden_dropout_prob, is_test=is_test,
+                           dropout_implementation="upscale_in_train")
+        attn_bias, bias2d = _attn_bias_from_mask(input_mask)
+    cur_stage = "stage:0"
     for i in range(cfg.num_hidden_layers):
-        x = _encoder_layer(x, attn_bias, cfg, f"layer_{i}", is_test,
-                           attn_bias2d=bias2d)
+        stage = stage_of_layer(i)
+        with device_guard(stage):
+            if stage is not None and stage != cur_stage:
+                # new stage: re-derive the bias from the feed so the only
+                # cross-stage tensor is x
+                attn_bias, bias2d = _attn_bias_from_mask(input_mask)
+                cur_stage = stage
+            x = _encoder_layer(x, attn_bias, cfg, f"layer_{i}", is_test,
+                               attn_bias2d=bias2d)
     return x
 
 
@@ -211,7 +240,9 @@ def build_pretraining_program(cfg: BertConfig, seq_len: int = 128,
                               lr: float = 1e-4, is_test=False,
                               with_optimizer=True, with_nsp=True,
                               sequence_parallel: int = 0,
-                              data_parallel: int = 1):
+                              data_parallel: int = 1,
+                              pipeline_stages: int = 0,
+                              num_microbatches: int = 1):
     """MLM + NSP pretraining step (the reference-era BERT/ERNIE recipe).
 
     Feeds: src_ids, sent_ids, pos_ids, input_mask [B,S];
@@ -224,7 +255,23 @@ def build_pretraining_program(cfg: BertConfig, seq_len: int = 128,
     MLM loss globally normalised via in-program c_allreduce_sum, grads
     summed (not averaged) over ('dp','sp'). NSP is dropped on this path
     (its [CLS] pooling is not sequence-shardable).
+
+    pipeline_stages=p (>1) builds the pipeline-parallel variant: encoder
+    layers tagged over p device_guard stages, optimizer wrapped in
+    PipelineOptimizer(num_microbatches) — the forward becomes one GPipe
+    schedule op over the 'pp' mesh axis. Only `loss` is fetchable on this
+    path (stage intermediates live inside the schedule). Mutually
+    exclusive with sequence_parallel for now. Loss semantics on this path
+    are gradient-accumulation style — the MEAN of per-microbatch
+    sum(loss*w)/sum(w) ratios — which differs from the dense program's
+    global masked-token mean when masked counts vary across microbatches
+    (same trade the reference's GradientMergeOptimizer makes,
+    optimizer.py:5025).
     """
+    pp = int(pipeline_stages or 0)
+    if pp > 1 and sequence_parallel and sequence_parallel > 1:
+        raise ValueError("pipeline_stages and sequence_parallel are "
+                         "mutually exclusive for now")
     sp = int(sequence_parallel or 0)
     dp = int(data_parallel or 1)
     if sp > 1:
@@ -242,7 +289,7 @@ def build_pretraining_program(cfg: BertConfig, seq_len: int = 128,
         nsp_labels = layers.static_data("nsp_labels", [B, 1], "int64")
 
         seq_out = bert_encoder(src_ids, sent_ids, pos_ids, input_mask, cfg,
-                               is_test=is_test)
+                               is_test=is_test, pipeline_stages=pp)
 
         # MLM head: transform + tied decoder over the word embedding
         trans = _dense(seq_out, cfg.hidden_size, "mlm_trans", cfg,
@@ -304,6 +351,11 @@ def build_pretraining_program(cfg: BertConfig, seq_len: int = 128,
                 insert_grad_allreduce(main, params_grads, nranks=sp * dp,
                                       axis_name=("dp", "sp"), average=False)
                 opt.apply_gradients(params_grads)
+            elif pp > 1:
+                from ..optimizer.pipeline import PipelineOptimizer
+
+                PipelineOptimizer(opt, num_microbatches=num_microbatches
+                                  ).minimize(loss)
             else:
                 opt.minimize(loss)
 

@@ -27,3 +27,7 @@ try:
     from . import attention_ops  # noqa: F401
 except ImportError:
     pass
+try:
+    from . import pipeline_ops  # noqa: F401
+except ImportError:
+    pass

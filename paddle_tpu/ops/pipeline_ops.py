@@ -1,0 +1,130 @@
+"""pipeline_forward op — the GPipe microbatch schedule as one XLA program.
+
+Capability mirror of the reference's pipeline stack (PipelineOptimizer
+optimizer.py:3695, PipelineTrainer pipeline_trainer.cc:24, SectionWorker
+section_worker.cc:82) re-designed for TPU: instead of one thread + queue per
+stage, the whole schedule lives inside one jitted computation over the 'pp'
+mesh axis — `lax.switch` on the rank id picks the stage body, activations
+rotate stage→stage via `lax.ppermute` each tick, and the backward schedule
+falls out of jax.vjp through the forward (ppermute transposes to the
+reverse ring).
+
+The op consumes every external var of all stages (feeds + params), emits a
+per-rank partial loss sum over microbatches (nonzero only on the last
+stage's rank); the PipelineOptimizer follows it with
+c_allreduce_sum('pp') + scale(1/M) to form the global loss.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from ..core.registry import register_op
+
+
+@register_op("pipeline_forward", is_collective=True, skip_infer_shape=True)
+def pipeline_forward(ins, attrs):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..core.executor import run_op
+    from .collective_ops import _in_spmd
+
+    stages: List[List] = attrs["stages"]                # list of op lists
+    boundaries: List[List[str]] = attrs["boundaries"]   # iface names per cut
+    mb_feed_names: List[str] = list(attrs["mb_feed_names"])
+    loss_name: str = attrs["loss_name"]
+    m = int(attrs["num_microbatches"])
+    axis = attrs.get("axis_name", "pp")
+    n = len(stages)
+
+    # flat env of every op input (params + feeds), keyed by var name
+    env: Dict[str, Any] = {}
+    for slot, vals in ins.items():
+        names = attrs["input_names"][slot]
+        for name, val in zip(names, vals):
+            env[name] = val
+    step = attrs.get("__step__")
+
+    # microbatch the data feeds along dim 0: [B, ...] -> [M, B/M, ...]
+    mb_feeds = {}
+    for name in mb_feed_names:
+        v = env.pop(name)
+        if v.shape[0] % m:
+            raise ValueError(
+                f"pipeline feed '{name}' batch {v.shape[0]} not divisible "
+                f"by num_microbatches={m}")
+        mb_feeds[name] = v.reshape((m, v.shape[0] // m) + v.shape[1:])
+
+    def bind_mb(e, mb):
+        for name, v in mb_feeds.items():
+            e[name] = lax.dynamic_index_in_dim(v, mb, keepdims=False)
+
+    def run_stage(k, e):
+        for op in stages[k]:
+            run_op(op, e, step=step)
+
+    def stage_body(k, buf, mb):
+        """Run stage k for microbatch index mb; buf = incoming interface."""
+        e = dict(env)
+        bind_mb(e, mb)           # stage 0 consumes data; later stages may
+        if k > 0:                # read labels/masks from the feed too
+            for name, val in zip(boundaries[k - 1], buf):
+                e[name] = val
+        run_stage(k, e)
+        return e
+
+    # -- single-rank / no-'pp'-axis mode: sequential microbatch loop ---------
+    if n == 1 or not _in_spmd(axis):
+        total = jnp.float32(0.0)
+        for mb in range(m):
+            buf = ()
+            for k in range(n):
+                e = stage_body(k, buf, mb)
+                if k < n - 1:
+                    buf = tuple(e[nm] for nm in boundaries[k])
+            total = total + e[loss_name].astype(jnp.float32).reshape(())
+        return {"LossPartial": total}
+
+    # -- SPMD GPipe schedule over the 'pp' ring ------------------------------
+    def branch(k):
+        def fn(buf, mb):
+            e = stage_body(k, buf, mb)
+            if k < n - 1:
+                return (tuple(e[nm] for nm in boundaries[k]),
+                        jnp.float32(0.0))
+            zero_out = tuple(jnp.zeros_like(b) for b in buf)
+            return zero_out, e[loss_name].astype(jnp.float32).reshape(())
+
+        return fn
+
+    nranks = lax.axis_size(axis)
+    if nranks != n:
+        raise ValueError(
+            f"pipeline_forward: '{axis}' mesh axis has {nranks} ranks but "
+            f"the program has {n} stages — they must match")
+    branches = [branch(k) for k in range(n)]
+    r = lax.axis_index(axis)
+
+    # uniform interface structure, derived abstractly from stage 0
+    iface_struct, _ = jax.eval_shape(
+        lambda mb: branches[0]((), mb), jnp.int32(0))
+    buf0 = tuple(jnp.zeros(s.shape, s.dtype) for s in iface_struct)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    ticks = m + n - 1
+
+    # scan over ticks: each stage body is traced ONCE (inside switch), not
+    # per tick — keeps HLO size O(n) instead of O(n * (m+n))
+    def tick(carry, t):
+        buf, loss_acc = carry
+        mb_idx = jnp.clip(t - r, 0, m - 1).astype(jnp.int32)
+        valid = jnp.logical_and(t - r >= 0, t - r < m)
+        out, l = lax.switch(r, branches, buf, mb_idx)
+        loss_acc = loss_acc + jnp.where(valid, l, 0.0)
+        buf = tuple(lax.ppermute(o, axis, perm) for o in out)
+        return (buf, loss_acc), None
+
+    (_, loss_acc), _ = lax.scan(tick, (buf0, jnp.float32(0.0)),
+                                jnp.arange(ticks))
+    return {"LossPartial": loss_acc}

@@ -587,6 +587,7 @@ from . import lr  # noqa: E402,F401  (2.0-style host-driven LR schedulers)
 
 from .extras import (ExponentialMovingAverage, LookaheadOptimizer,  # noqa: E402,F401
                      ModelAverage)
+from .pipeline import PipelineOptimizer  # noqa: E402,F401
 
 
 def _fleet_wrappers():

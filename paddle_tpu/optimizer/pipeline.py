@@ -1,0 +1,144 @@
+"""PipelineOptimizer — device_guard-tagged program → GPipe schedule op.
+
+Capability mirror of the reference PipelineOptimizer (optimizer.py:3695):
+ops tagged by `device_guard("gpu:k")` / ("stage:k") are split into per-stage
+sections and the whole forward is replaced by ONE `pipeline_forward` op
+(ops/pipeline_ops.py) that runs the microbatched schedule over the 'pp'
+mesh axis inside the compiled program. The reference's per-stage
+SectionWorker threads + cross-stage queues (section_worker.cc:82) become
+lax.switch + lax.ppermute in one XLA computation; the backward schedule is
+jax.vjp of the forward.
+
+Constraints (v1): cross-stage values may only flow k → k+1 (no skip
+connections), every stage boundary must carry the same (shape, dtype)
+interface tuple, and the 'pp' mesh axis size must equal the stage count.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..core import unique_name
+from ..core.ir import OpDesc
+
+
+def _stage_of(op: OpDesc, sticky: int) -> int:
+    dev = op.attrs.get("__device__")
+    if dev is None:
+        return sticky
+    if isinstance(dev, int):
+        return dev
+    if ":" in str(dev):
+        return int(str(dev).rsplit(":", 1)[1])
+    return int(dev)
+
+
+class PipelineOptimizer:
+    """Wraps an inner optimizer; minimize() rewrites the program into the
+    pipeline schedule then backward/allreduce/apply."""
+
+    def __init__(self, optimizer, num_microbatches: int = 1,
+                 axis_name: str = "pp"):
+        self.inner = optimizer
+        self.num_microbatches = int(num_microbatches)
+        self.axis_name = axis_name
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        program = loss.block.program
+        block = program.global_block()
+        m = self.num_microbatches
+
+        # -- 1. partition forward ops into stages ---------------------------
+        stages: List[List[OpDesc]] = []
+        stage_idx = 0
+        producer: Dict[str, int] = {}
+        for op in block.ops:
+            stage_idx = _stage_of(op, stage_idx)
+            while len(stages) <= stage_idx:
+                stages.append([])
+            stages[stage_idx].append(op)
+            for name in op.output_names():
+                producer[name] = stage_idx
+        n = len(stages)
+        if any(not s for s in stages):
+            raise ValueError("pipeline: some stages have no ops — check "
+                             "device_guard tags")
+        if producer.get(loss.name) != n - 1:
+            raise ValueError(
+                f"pipeline: loss '{loss.name}' must be produced by the last "
+                f"stage (stage {producer.get(loss.name)} of {n})")
+
+        # -- 2. interfaces + external reads ---------------------------------
+        boundaries: List[List[str]] = [[] for _ in range(n - 1)]
+        ext_reads: List[str] = []
+        seen_ext = set()
+        for k, ops in enumerate(stages):
+            for op in ops:
+                for name in op.input_names():
+                    if name == "@EMPTY@":
+                        continue
+                    src = producer.get(name)
+                    if src is None:
+                        if name not in seen_ext:
+                            seen_ext.add(name)
+                            ext_reads.append(name)
+                    elif src < k:
+                        if src != k - 1:
+                            raise ValueError(
+                                f"pipeline: '{name}' produced at stage {src} "
+                                f"is consumed at stage {k}; only k->k+1 "
+                                f"dataflow is supported (no skip "
+                                f"connections)")
+                        if name not in boundaries[src]:
+                            boundaries[src].append(name)
+        if n > 1:
+            sig0 = None
+            for k, names in enumerate(boundaries):
+                sig = tuple((tuple(block.var(nm).shape),
+                             str(block.var(nm).dtype)) for nm in names
+                            if block.has_var(nm))
+                if sig0 is None:
+                    sig0 = sig
+                elif sig != sig0:
+                    raise ValueError(
+                        f"pipeline: boundary {k} interface {sig} differs "
+                        f"from boundary 0 {sig0}; stage interfaces must be "
+                        f"uniform for the ring buffer")
+
+        # data feeds (microbatched) vs persistables (params, lr — replicated)
+        mb_feed_names = [nm for nm in ext_reads
+                         if block.has_var(nm) and not block.var(nm).persistable]
+
+        # -- 3. replace the forward with the pipeline op --------------------
+        fwd_ops = list(block.ops)
+        del block.ops[:]
+        loss_partial = block.create_var(
+            name=unique_name.generate("pipeline_loss_partial"),
+            shape=[], dtype="float32")
+        block.append_op(
+            "pipeline_forward", {"X": ext_reads},
+            {"LossPartial": [loss_partial]},
+            {"stages": stages, "boundaries": boundaries,
+             "mb_feed_names": mb_feed_names, "loss_name": loss.name,
+             "num_microbatches": m, "axis_name": self.axis_name,
+             "input_names": {"X": list(ext_reads)},
+             "nranks": n},
+            infer_shape=False)
+        block.append_op("c_allreduce_sum", {"X": [loss_partial]},
+                        {"Out": [loss_partial]},
+                        {"axis_name": self.axis_name, "nranks": n})
+        block.append_op("scale", {"X": [loss_partial]}, {"Out": [loss.name]},
+                        {"scale": 1.0 / m})
+
+        # -- 4. backward -> grad allreduce over 'pp' -> update --------------
+        params_grads = self.inner.backward(loss, startup_program,
+                                           parameter_list, no_grad_set)
+        from ..distributed.fleet.meta_optimizers import insert_grad_allreduce
+
+        # per-rank grads are partials of the same global loss (each rank
+        # executed only its stage) -> SUM over the ring, no averaging
+        insert_grad_allreduce(program, params_grads, nranks=n,
+                              axis_name=self.axis_name, average=False)
+        ops = self.inner.apply_gradients(params_grads)
+        return ops, params_grads

@@ -86,5 +86,7 @@ def ring_attention(q, k, v, bias_kv=None, causal=False, scale=None,
     bias0 = bias_kv if has_bias else jnp.zeros((b, skl), q.dtype)
     carry = (k, v, bias0, m0, l0, acc0)
     (k_c, v_c, b_c, m, l, acc), _ = lax.scan(step_fn, carry, jnp.arange(n))
-    l = jnp.where(l == 0.0, 1.0, l)           # fully-masked rows → zero out
+    # l >= 1 always (the running-max entry contributes exp(0)=1, even for
+    # fully NEG_INF-masked rows, which degrade to uniform attention exactly
+    # like the dense reference)
     return (acc / l[..., None]).astype(q.dtype)
