@@ -1,0 +1,222 @@
+"""IR pass framework — program-rewriting optimization passes.
+
+Capability mirror of the reference's ir::Pass stack (framework/ir/pass.h:40,
+pass registry, GraphPatternDetector graph_pattern_detector.cc, and the
+fusion passes fc_fuse_pass / multihead_matmul_fuse_pass /
+fuse_elewise_add_act_pass). Re-designed for the XLA substrate: generic
+elementwise/matmul fusion is XLA's job, so the passes that remain are the
+SEMANTIC rewrites XLA cannot do — swapping an op chain for a Pallas kernel
+(attention), collapsing API-level op pairs (mul+add → fc), and stripping
+test-time no-ops (dropout).
+
+A Pass maps Program → Program (mutating in place and returning it).
+Passes here operate on the op list of block 0 — the same data the
+executor compiles — so anything a pass rewrites is exactly what jit sees.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from .ir import OpDesc, Program
+
+PassFn = Callable[[Program], Program]
+
+_PASS_REGISTRY: Dict[str, PassFn] = {}
+
+
+def register_pass(name: str):
+    def deco(fn: PassFn) -> PassFn:
+        _PASS_REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def get_pass(name: str) -> PassFn:
+    if name not in _PASS_REGISTRY:
+        raise KeyError(f"unknown pass '{name}'; have {sorted(_PASS_REGISTRY)}")
+    return _PASS_REGISTRY[name]
+
+
+def registered_passes() -> List[str]:
+    return sorted(_PASS_REGISTRY)
+
+
+def apply_passes(program: Program, names: List[str]) -> Program:
+    for n in names:
+        program = get_pass(n)(program)
+    return program
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _single_consumer_map(ops: List[OpDesc]) -> Dict[str, List[OpDesc]]:
+    consumers: Dict[str, List[OpDesc]] = {}
+    for op in ops:
+        for name in op.input_names():
+            consumers.setdefault(name, []).append(op)
+    return consumers
+
+
+def _producer_map(ops: List[OpDesc]) -> Dict[str, OpDesc]:
+    prod: Dict[str, OpDesc] = {}
+    for op in ops:
+        for name in op.output_names():
+            prod[name] = op
+    return prod
+
+
+def _out(op: OpDesc, slot: str) -> Optional[str]:
+    v = op.outputs.get(slot)
+    return v[0] if v else None
+
+
+def _in(op: OpDesc, slot: str) -> Optional[str]:
+    v = op.inputs.get(slot)
+    return v[0] if v else None
+
+
+# ---------------------------------------------------------------------------
+# passes
+# ---------------------------------------------------------------------------
+
+@register_pass("delete_dropout_pass")
+def delete_dropout_pass(program: Program) -> Program:
+    """Strip is_test dropout ops (identity at inference) by rewiring their
+    consumers — reference: simplify_with_basic_ops_pass (dropout removal)."""
+    block = program.global_block()
+    rename: Dict[str, str] = {}
+    kept: List[OpDesc] = []
+    for op in block.ops:
+        # apply pending renames to inputs first
+        for slot, names in op.inputs.items():
+            op.inputs[slot] = [rename.get(n, n) for n in names]
+        if op.type == "dropout" and bool(op.attrs.get("is_test", False)) and \
+                op.attrs.get("dropout_implementation",
+                             "upscale_in_train") == "upscale_in_train":
+            rename[_out(op, "Out")] = _in(op, "X")
+            continue
+        kept.append(op)
+    block.ops = kept
+    program._bump_version()
+    return program
+
+
+@register_pass("fc_fuse_pass")
+def fc_fuse_pass(program: Program) -> Program:
+    """mul/matmul_v2 + elementwise_add(bias) → one fc op
+    (reference: ir/fc_fuse_pass.cc)."""
+    block = program.global_block()
+    consumers = _single_consumer_map(block.ops)
+    fused_away = set()
+    new_ops: List[OpDesc] = []
+    for op in block.ops:
+        if id(op) in fused_away:
+            continue
+        if op.type in ("mul", "matmul_v2") and not op.attrs.get("trans_x") \
+                and not op.attrs.get("trans_y"):
+            out = _out(op, "Out")
+            cons = consumers.get(out, [])
+            if len(cons) == 1 and cons[0].type == "elementwise_add":
+                add = cons[0]
+                bias_name = _in(add, "Y")
+                bias_var = block.var(bias_name) \
+                    if bias_name and block.has_var(bias_name) else None
+                # only fuse a real bias: 1-D persistable parameter (the
+                # reference fc_fuse_pass.cc requirement) — never a
+                # residual-add of another activation tensor
+                bias_ok = (bias_var is not None and bias_var.persistable
+                           and len(bias_var.shape or ()) == 1)
+                if bias_ok and _in(add, "X") == out and \
+                        int(add.attrs.get("axis", -1)) in (-1, 1):
+                    xname = _in(op, "X")
+                    if op.type == "matmul_v2":
+                        # batched matmul contracts only the last dim:
+                        # flatten everything before it
+                        xv = block.var(xname) if block.has_var(xname) else None
+                        ncol = (len(xv.shape) - 1) if xv is not None and \
+                            xv.shape and len(xv.shape) > 1 else 1
+                    else:
+                        ncol = op.attrs.get("x_num_col_dims", 1)
+                    new_ops.append(OpDesc(
+                        "fc",
+                        {"Input": [xname], "W": [_in(op, "Y")],
+                         "Bias": [_in(add, "Y")]},
+                        {"Out": [_out(add, "Out")]},
+                        {"in_num_col_dims": ncol}))
+                    fused_away.add(id(add))
+                    continue
+        new_ops.append(op)
+    block.ops = new_ops
+    program._bump_version()
+    return program
+
+
+@register_pass("multihead_attention_fuse_pass")
+def multihead_attention_fuse_pass(program: Program) -> Program:
+    """matmul(QK^T, alpha) [+ bias] → softmax [→ dropout] → matmul(·V)
+    becomes one flash_attention op backed by the Pallas kernel
+    (reference: ir/multihead_matmul_fuse_pass.cc — there a CUDA fused
+    kernel; here the Pallas flash kernel, ops/attention_ops.py)."""
+    block = program.global_block()
+    consumers = _single_consumer_map(block.ops)
+    dead = set()
+    new_ops: List[OpDesc] = []
+
+    def only_consumer(name, op_type):
+        cons = [c for c in consumers.get(name, [])]
+        if len(cons) == 1 and cons[0].type == op_type:
+            return cons[0]
+        return None
+
+    for op in block.ops:
+        if id(op) in dead:
+            continue
+        # anchor: the scores matmul q @ k^T
+        if op.type == "matmul" and op.attrs.get("transpose_Y") and \
+                not op.attrs.get("transpose_X"):
+            q, k = _in(op, "X"), _in(op, "Y")
+            scale = float(op.attrs.get("alpha", 1.0))
+            scores = _out(op, "Out")
+            bias = None
+            cur = op
+            nxt = only_consumer(scores, "elementwise_add")
+            if nxt is not None:
+                bias = _in(nxt, "Y") if _in(nxt, "X") == scores else _in(nxt, "X")
+                scores = _out(nxt, "Out")
+                cur = nxt
+            sm = only_consumer(scores, "softmax")
+            if sm is None or int(sm.attrs.get("axis", -1)) != -1:
+                new_ops.append(op)
+                continue
+            probs = _out(sm, "Out")
+            chain = [op] if cur is op else [op, cur]
+            chain.append(sm)
+            drop = only_consumer(probs, "dropout")
+            if drop is not None and bool(drop.attrs.get("is_test", False)):
+                probs = _out(drop, "Out")
+                chain.append(drop)
+            ctx_mm = only_consumer(probs, "matmul")
+            if ctx_mm is None or _in(ctx_mm, "X") != probs or \
+                    ctx_mm.attrs.get("transpose_X") or \
+                    ctx_mm.attrs.get("transpose_Y") or \
+                    float(ctx_mm.attrs.get("alpha", 1.0)) != 1.0:
+                new_ops.append(op)
+                continue
+            v = _in(ctx_mm, "Y")
+            chain.append(ctx_mm)
+            inputs = {"Q": [q], "K": [k], "V": [v]}
+            if bias is not None:
+                inputs["Bias"] = [bias]
+            new_ops.append(OpDesc("flash_attention", inputs,
+                                  {"Out": [_out(ctx_mm, "Out")]},
+                                  {"scale": scale, "causal": False}))
+            dead.update(id(o) for o in chain if o is not op)
+            continue
+        new_ops.append(op)
+    block.ops = new_ops
+    program._bump_version()
+    return program

@@ -1,0 +1,17 @@
+"""Inference engine — load, optimize, jit, predict.
+
+Capability mirror of paddle/fluid/inference/ (AnalysisPredictor
+api/analysis_predictor.cc:82, AnalysisConfig api/analysis_config.cc, pass
+chain api/paddle_pass_builder.cc, ZeroCopyTensor). TPU re-design: the
+analysis passes are program rewrites (core/passes.py — attention →
+Pallas flash kernel, mul+add → fc, dropout stripping), and the "engine"
+is one jitted XLA computation per input-shape signature — XLA plays the
+role the reference splits between NaiveExecutor, TensorRT subgraphs and
+memory-optimize passes (fusion, buffer reuse, scheduling).
+"""
+
+from .predictor import (AnalysisConfig, AnalysisPredictor, Config,
+                        PredictorTensor, create_predictor)
+
+__all__ = ["AnalysisConfig", "AnalysisPredictor", "Config",
+           "PredictorTensor", "create_predictor"]

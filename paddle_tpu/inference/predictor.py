@@ -1,0 +1,199 @@
+"""AnalysisPredictor — the user-facing inference engine.
+
+reference call path (SURVEY.md §3.6): CreatePaddlePredictor(AnalysisConfig)
+→ load ProgramDesc + params → OptimizeInferenceProgram (IRPassManager) →
+NaiveExecutor; Run/ZeroCopyRun (analysis_predictor.cc:230,297,522,753).
+
+Here: load_inference_model → apply_passes → one jax.jit'd
+(params, feeds) → fetches function, cached per feed-shape signature.
+Params live on device once (the ZeroCopy promise); each run only
+transfers the feeds.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import io
+from ..core.executor import run_block
+from ..core.ir import Program
+from ..core.passes import apply_passes
+from ..core.scope import Scope
+
+DEFAULT_PASSES = [
+    "delete_dropout_pass",
+    "multihead_attention_fuse_pass",
+    "fc_fuse_pass",
+]
+
+
+class AnalysisConfig:
+    """reference: api/analysis_config.cc. model_dir points at a directory
+    written by io.save_inference_model."""
+
+    def __init__(self, model_dir: Optional[str] = None,
+                 prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        self.model_dir = model_dir
+        self.prog_file = prog_file
+        self.params_file = params_file
+        self.ir_optim = True
+        self.passes: List[str] = list(DEFAULT_PASSES)
+        self._deleted: set = set()
+
+    # -- reference API surface ------------------------------------------------
+    def set_model(self, model_dir: str, params_file: Optional[str] = None):
+        self.model_dir = model_dir
+        self.params_file = params_file
+
+    def switch_ir_optim(self, on: bool = True):
+        self.ir_optim = bool(on)
+
+    def delete_pass(self, name: str):
+        self._deleted.add(name)
+
+    def enabled_passes(self) -> List[str]:
+        return [p for p in self.passes if p not in self._deleted]
+
+    # TPU has no TensorRT; keep the switch as a no-op for API parity
+    def enable_tensorrt_engine(self, *a, **kw):
+        pass
+
+
+Config = AnalysisConfig
+
+
+class PredictorTensor:
+    """ZeroCopyTensor-style handle (reference: paddle_api.h ZeroCopyTensor):
+    copy_from_cpu stages the input; copy_to_cpu reads the output."""
+
+    def __init__(self, name: str, owner: "AnalysisPredictor", is_input: bool):
+        self.name = name
+        self._owner = owner
+        self._is_input = is_input
+
+    def copy_from_cpu(self, arr):
+        if not self._is_input:
+            raise ValueError(f"'{self.name}' is an output tensor")
+        self._owner._staged[self.name] = np.asarray(arr)
+
+    def copy_to_cpu(self):
+        if self._is_input:
+            return self._owner._staged.get(self.name)
+        out = self._owner._last_outputs
+        if out is None:
+            raise RuntimeError("run() has not been called yet")
+        return np.asarray(out[self.name])
+
+    @property
+    def shape(self):
+        v = self._owner._staged.get(self.name)
+        return None if v is None else v.shape
+
+
+class AnalysisPredictor:
+    def __init__(self, config: AnalysisConfig,
+                 program: Optional[Program] = None,
+                 feed_names: Optional[Sequence[str]] = None,
+                 fetch_names: Optional[Sequence[str]] = None,
+                 scope: Optional[Scope] = None):
+        self.config = config
+        if program is None:
+            if not config.model_dir:
+                raise ValueError("AnalysisConfig.model_dir not set")
+            scope = scope or Scope()
+            program, feed_names, fetch_names = io.load_inference_model(
+                config.model_dir, model_filename=config.prog_file,
+                params_filename=config.params_file, scope=scope)
+        self.program = program
+        self.scope = scope if scope is not None else Scope()
+        self.feed_names = list(feed_names or [])
+        self.fetch_names = list(fetch_names or [])
+        if config.ir_optim:
+            self.program = apply_passes(self.program,
+                                        config.enabled_passes())
+        self._staged: Dict[str, np.ndarray] = {}
+        self._last_outputs: Optional[Dict[str, Any]] = None
+        self._cache: Dict[tuple, Any] = {}
+        self._params = self._load_params_to_device()
+
+    # -- internals ------------------------------------------------------------
+    def _load_params_to_device(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        params = {}
+        for name, val in self.scope.items():
+            params[name] = jnp.asarray(val)
+        return params
+
+    def _compiled(self, sig):
+        import jax
+
+        entry = self._cache.get(sig)
+        if entry is None:
+            block = self.program.global_block()
+            fetch = tuple(self.fetch_names)
+
+            def fn(params, feed):
+                env = dict(params)
+                env.update(feed)
+                run_block(block, env)
+                return tuple(env[n] for n in fetch)
+
+            entry = jax.jit(fn)
+            self._cache[sig] = entry
+        return entry
+
+    # -- reference API surface ------------------------------------------------
+    def get_input_names(self) -> List[str]:
+        return list(self.feed_names)
+
+    def get_output_names(self) -> List[str]:
+        return list(self.fetch_names)
+
+    def get_input_handle(self, name: str) -> PredictorTensor:
+        if name not in self.feed_names:
+            raise KeyError(f"'{name}' is not an input; have {self.feed_names}")
+        return PredictorTensor(name, self, is_input=True)
+
+    get_input_tensor = get_input_handle
+
+    def get_output_handle(self, name: str) -> PredictorTensor:
+        if name not in self.fetch_names:
+            raise KeyError(f"'{name}' is not an output; have {self.fetch_names}")
+        return PredictorTensor(name, self, is_input=False)
+
+    get_output_tensor = get_output_handle
+
+    def run(self, feeds: Optional[Dict[str, Any]] = None) -> List[np.ndarray]:
+        """ZeroCopyRun (staged handles) or direct dict feed."""
+        import jax.numpy as jnp
+
+        feed = dict(self._staged)
+        if feeds:
+            feed.update({k: np.asarray(v) for k, v in feeds.items()})
+        missing = [n for n in self.feed_names if n not in feed]
+        if missing:
+            raise ValueError(f"missing inputs: {missing}")
+        dev_feed = {}
+        block = self.program.global_block()
+        for n in self.feed_names:
+            v = feed[n]
+            dtype = None
+            if block.has_var(n):
+                dtype = block.var(n).dtype
+                if dtype == "int64":
+                    dtype = "int32"   # x64 disabled
+            dev_feed[n] = jnp.asarray(v, dtype=dtype)
+        sig = tuple((n, dev_feed[n].shape, str(dev_feed[n].dtype))
+                    for n in self.feed_names)
+        outs = self._compiled(sig)(self._params, dev_feed)
+        self._last_outputs = dict(zip(self.fetch_names, outs))
+        return [np.asarray(o) for o in outs]
+
+
+def create_predictor(config: AnalysisConfig) -> AnalysisPredictor:
+    """reference: CreatePaddlePredictor / create_predictor."""
+    return AnalysisPredictor(config)

@@ -197,10 +197,26 @@ def bert_encoder(src_ids, sent_ids, pos_ids, input_mask, cfg: BertConfig,
     from ..core.ir import device_guard
 
     p = int(pipeline_stages or 0)
-    per_stage = -(-cfg.num_hidden_layers // p) if p > 1 else None
+    if p > 1 and p > cfg.num_hidden_layers:
+        raise ValueError(
+            f"pipeline_stages={p} exceeds num_hidden_layers="
+            f"{cfg.num_hidden_layers} — some stages would be empty")
+    if p > 1:
+        # balanced partition: L//p per stage, first L%p stages get one extra
+        base, rem = divmod(cfg.num_hidden_layers, p)
+        bounds = []
+        acc = 0
+        for k in range(p):
+            acc += base + (1 if k < rem else 0)
+            bounds.append(acc)
 
     def stage_of_layer(i):
-        return "stage:%d" % (i // per_stage) if p > 1 else None
+        if p <= 1:
+            return None
+        for k, b in enumerate(bounds):
+            if i < b:
+                return "stage:%d" % k
+        return "stage:%d" % (p - 1)
 
     with device_guard("stage:0" if p > 1 else None):
         emb = layers.embedding(src_ids, [cfg.vocab_size, cfg.hidden_size],

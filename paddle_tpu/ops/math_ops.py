@@ -504,3 +504,21 @@ def isfinite_v2(ins, attrs):
     import jax.numpy as jnp
 
     return {"Out": jnp.isfinite(ins["X"][0])}
+
+
+@register_op("fc")
+def fc(ins, attrs):
+    """Fused Input @ W + Bias (reference: operators/fc_op.cc; emitted by
+    fc_fuse_pass). in_num_col_dims flattens leading dims like mul."""
+    import jax.numpy as jnp
+
+    x, w = ins["Input"][0], ins["W"][0]
+    bias = ins["Bias"][0] if ins.get("Bias") and ins["Bias"][0] is not None \
+        else None
+    ncol = int(attrs.get("in_num_col_dims", 1))
+    lead = x.shape[:ncol]
+    x2 = x.reshape((int(np.prod(lead)),) + (-1,))
+    out = jnp.matmul(x2, w)
+    if bias is not None:
+        out = out + bias
+    return {"Out": out.reshape(tuple(lead) + (w.shape[-1],))}

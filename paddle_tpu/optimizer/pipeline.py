@@ -111,7 +111,6 @@ class PipelineOptimizer:
                          if block.has_var(nm) and not block.var(nm).persistable]
 
         # -- 3. replace the forward with the pipeline op --------------------
-        fwd_ops = list(block.ops)
         del block.ops[:]
         loss_partial = block.create_var(
             name=unique_name.generate("pipeline_loss_partial"),

@@ -1,0 +1,127 @@
+"""Inference engine tests: save → load → analyze (passes) → predict.
+
+Mirrors the reference's inference tests (inference/tests/api/,
+test_inference_model_io.py): optimized predictor output must match the
+unoptimized executor run of the same program."""
+
+import numpy as np
+import pytest
+
+
+def _build_lenet():
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        img = layers.data("img", [1, 28, 28])
+        conv = layers.conv2d(img, 6, 5, act="relu")
+        pool = layers.pool2d(conv, 2, pool_stride=2)
+        flat = layers.reshape(pool, [0, 6 * 12 * 12])
+        h = layers.fc(flat, 64, act="relu")
+        logits = layers.fc(h, 10)
+    return main, startup, img, logits
+
+
+class TestSaveLoadPredict:
+    def test_lenet_roundtrip(self, tmp_path, scope):
+        import paddle_tpu as pt
+
+        main, startup, img, logits = _build_lenet()
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        x = np.random.RandomState(0).randn(4, 1, 28, 28).astype(np.float32)
+        want, = exe.run(main, feed={"img": x}, fetch_list=[logits],
+                        scope=scope)
+
+        from paddle_tpu import io
+        from paddle_tpu.inference import AnalysisConfig, create_predictor
+
+        io.save_inference_model(str(tmp_path / "model"), ["img"], [logits],
+                                main_program=main, scope=scope)
+        pred = create_predictor(AnalysisConfig(str(tmp_path / "model")))
+        got, = pred.run({"img": x})
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_zero_copy_handles(self, tmp_path, scope):
+        import paddle_tpu as pt
+
+        main, startup, img, logits = _build_lenet()
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+
+        from paddle_tpu import io
+        from paddle_tpu.inference import AnalysisConfig, create_predictor
+
+        io.save_inference_model(str(tmp_path / "m"), ["img"], [logits],
+                                main_program=main, scope=scope)
+        pred = create_predictor(AnalysisConfig(str(tmp_path / "m")))
+        assert pred.get_input_names() == ["img"]
+        x = np.random.randn(2, 1, 28, 28).astype(np.float32)
+        pred.get_input_handle("img").copy_from_cpu(x)
+        pred.run()
+        out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+        assert out.shape == (2, 10)
+
+
+class TestPasses:
+    def _bert_inference_program(self):
+        from paddle_tpu.models import bert
+
+        cfg = bert.BertConfig(vocab_size=64, hidden_size=32,
+                              num_hidden_layers=2, num_attention_heads=2,
+                              intermediate_size=64,
+                              max_position_embeddings=32)
+        return bert.build_pretraining_program(
+            cfg, seq_len=32, with_optimizer=False, is_test=True), cfg
+
+    def test_attention_fuse_and_dropout_delete(self, scope):
+        import paddle_tpu as pt
+        from paddle_tpu.core.passes import apply_passes
+        from paddle_tpu.models import bert
+
+        (main, startup, feeds, fetches), cfg = self._bert_inference_program()
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        batch = bert.synthetic_pretraining_batch(cfg, 2, 32)
+        want, = exe.run(main, feed=batch, fetch_list=[fetches["loss"]],
+                        scope=scope)
+
+        from paddle_tpu import io
+
+        pruned = io.prune_program(main, list(batch), [fetches["loss"].name])
+        n_before = len(pruned.global_block().ops)
+        types_before = [o.type for o in pruned.global_block().ops]
+        opt = apply_passes(pruned, ["delete_dropout_pass",
+                                    "multihead_attention_fuse_pass",
+                                    "fc_fuse_pass"])
+        types_after = [o.type for o in opt.global_block().ops]
+        assert types_after.count("flash_attention") == cfg.num_hidden_layers
+        assert "dropout" not in types_after
+        assert "softmax" not in [t for t in types_after
+                                 if t != "softmax_with_cross_entropy"]
+        assert types_after.count("fc") >= 4
+        assert len(types_after) < n_before
+
+        got, = exe.run(opt, feed=batch, fetch_list=[fetches["loss"]],
+                       scope=scope)
+        np.testing.assert_allclose(got, want, atol=2e-5)
+
+    def test_fc_fuse_simple(self, scope):
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+        from paddle_tpu.core.passes import apply_passes
+
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [16])
+            y = layers.fc(x, 8)
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        xv = np.random.randn(3, 16).astype(np.float32)
+        want, = exe.run(main, feed={"x": xv}, fetch_list=[y], scope=scope)
+        apply_passes(main, ["fc_fuse_pass"])
+        types = [o.type for o in main.global_block().ops]
+        assert "fc" in types and "elementwise_add" not in types
+        got, = exe.run(main, feed={"x": xv}, fetch_list=[y], scope=scope)
+        np.testing.assert_allclose(got, want, atol=1e-6)
