@@ -1,0 +1,407 @@
+// Native data-ingestion runtime for paddle_tpu.
+//
+// C++ capability mirror of the reference's data path
+// (paddle/fluid/framework/data_feed.cc MultiSlotInMemoryDataFeed,
+// data_set.cc MultiSlotDataset, channel.h, blocking_queue.h): multi-threaded
+// parsing of MultiSlot-format text files into an in-memory record store,
+// global shuffle, and LoD-aware batch assembly into contiguous buffers the
+// Python side wraps zero-copy as numpy arrays (then jax.device_put's).
+//
+// MultiSlot line format (reference: data_feed.cc CheckFile): for each slot,
+// whitespace-separated: <n> <v_1> ... <v_n>. Slot types: 'f' = float32,
+// 'u' = uint64 (stored int64 for numpy friendliness).
+//
+// Exposed as a C ABI (ptds_* = paddle-tpu-dataset) consumed via ctypes —
+// the image has no pybind11 (build notes: paddle_tpu/native/__init__.py).
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <fstream>
+#include <memory>
+#include <mutex>
+#include <random>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Channel: bounded MPMC blocking queue (reference: framework/channel.h,
+// blocking_queue.h)
+// ---------------------------------------------------------------------------
+template <typename T>
+class Channel {
+ public:
+  explicit Channel(size_t cap) : cap_(cap), closed_(false) {}
+
+  bool Put(T&& v) {
+    std::unique_lock<std::mutex> lk(mu_);
+    put_cv_.wait(lk, [&] { return q_.size() < cap_ || closed_; });
+    if (closed_) return false;
+    q_.emplace_back(std::move(v));
+    get_cv_.notify_one();
+    return true;
+  }
+
+  bool Get(T* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    get_cv_.wait(lk, [&] { return !q_.empty() || closed_; });
+    if (q_.empty()) return false;
+    *out = std::move(q_.front());
+    q_.pop_front();
+    put_cv_.notify_one();
+    return true;
+  }
+
+  void Close() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = true;
+    put_cv_.notify_all();
+    get_cv_.notify_all();
+  }
+
+ private:
+  size_t cap_;
+  bool closed_;
+  std::deque<T> q_;
+  std::mutex mu_;
+  std::condition_variable put_cv_, get_cv_;
+};
+
+// ---------------------------------------------------------------------------
+// Records
+// ---------------------------------------------------------------------------
+struct SlotValues {
+  std::vector<float> f;
+  std::vector<int64_t> i;
+};
+
+struct Record {
+  std::vector<SlotValues> slots;  // one per schema slot
+};
+
+struct SlotSchema {
+  std::string name;
+  char type;  // 'f' or 'u'
+};
+
+// global stat registry (reference: platform/monitor.h StatRegistry)
+std::atomic<uint64_t> g_mem_bytes{0};
+std::atomic<uint64_t> g_records_parsed{0};
+
+struct Dataset {
+  std::vector<SlotSchema> schema;
+  std::vector<std::string> files;
+  std::vector<Record> records;
+  std::string error;
+
+  // batching state
+  size_t cursor = 0;
+  int batch_size = 1;
+  // per-slot assembled buffers for the current batch
+  std::vector<std::vector<float>> batch_f;
+  std::vector<std::vector<int64_t>> batch_i;
+  std::vector<std::vector<int64_t>> batch_lod;  // rows+1 offsets per slot
+
+  // streaming state (QueueDataset mode)
+  std::unique_ptr<Channel<Record>> chan;
+  std::vector<std::thread> stream_workers;
+  std::atomic<size_t> stream_next_file{0};
+  std::atomic<int> stream_live_workers{0};
+  std::mutex stream_err_mu;
+};
+
+bool ParseLine(const std::string& line, const std::vector<SlotSchema>& schema,
+               Record* rec, std::string* err) {
+  const char* p = line.c_str();
+  char* end = nullptr;
+  rec->slots.clear();
+  rec->slots.resize(schema.size());
+  for (size_t s = 0; s < schema.size(); ++s) {
+    long n = std::strtol(p, &end, 10);
+    if (end == p) {
+      *err = "expected slot count for slot '" + schema[s].name + "'";
+      return false;
+    }
+    if (n < 0 || n > (1L << 26)) {  // bad count would crash reserve()
+      *err = "invalid slot count " + std::to_string(n) + " for slot '" +
+             schema[s].name + "'";
+      return false;
+    }
+    p = end;
+    auto& sv = rec->slots[s];
+    if (schema[s].type == 'f') {
+      sv.f.reserve(n);
+      for (long j = 0; j < n; ++j) {
+        float v = std::strtof(p, &end);
+        if (end == p) {
+          *err = "bad float in slot '" + schema[s].name + "'";
+          return false;
+        }
+        sv.f.push_back(v);
+        p = end;
+      }
+    } else {
+      sv.i.reserve(n);
+      for (long j = 0; j < n; ++j) {
+        long long v = std::strtoll(p, &end, 10);
+        if (end == p) {
+          *err = "bad int in slot '" + schema[s].name + "'";
+          return false;
+        }
+        sv.i.push_back(static_cast<int64_t>(v));
+        p = end;
+      }
+    }
+  }
+  return true;
+}
+
+size_t RecordBytes(const Record& r) {
+  size_t b = 0;
+  for (const auto& s : r.slots)
+    b += s.f.size() * sizeof(float) + s.i.size() * sizeof(int64_t);
+  return b;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ptds_create(const char** slot_names, const char* slot_types,
+                  int nslots) {
+  auto* ds = new Dataset();
+  for (int i = 0; i < nslots; ++i)
+    ds->schema.push_back({slot_names[i], slot_types[i]});
+  return ds;
+}
+
+void ptds_stream_end(void* h);  // forward decl (defined below)
+
+void ptds_destroy(void* h) {
+  auto* ds = static_cast<Dataset*>(h);
+  ptds_stream_end(h);  // join any live parser threads first
+  for (auto& r : ds->records) g_mem_bytes -= RecordBytes(r);
+  delete ds;
+}
+
+void ptds_set_filelist(void* h, const char** files, int n) {
+  auto* ds = static_cast<Dataset*>(h);
+  ds->files.assign(files, files + n);
+}
+
+const char* ptds_last_error(void* h) {
+  return static_cast<Dataset*>(h)->error.c_str();
+}
+
+// Parse all files with `nthreads` worker threads, one per-file buffer each
+// (the reference's LoadIntoMemory / thread-per-file pattern, data_set.cc).
+// Results concatenate in FILE ORDER so a load is deterministic regardless
+// of thread interleaving (shuffle is the explicit, seeded step).
+long ptds_load_into_memory(void* h, int nthreads) {
+  auto* ds = static_cast<Dataset*>(h);
+  ds->error.clear();
+  if (nthreads < 1) nthreads = 1;
+  std::vector<std::vector<Record>> per_file(ds->files.size());
+  std::atomic<size_t> next_file{0};
+  std::mutex err_mu;
+
+  auto worker = [&]() {
+    for (;;) {
+      size_t fi = next_file.fetch_add(1);
+      if (fi >= ds->files.size()) return;
+      std::ifstream in(ds->files[fi]);
+      if (!in) {
+        std::lock_guard<std::mutex> lk(err_mu);
+        ds->error = "cannot open file: " + ds->files[fi];
+        return;
+      }
+      std::string line;
+      while (std::getline(in, line)) {
+        if (line.empty()) continue;
+        Record rec;
+        std::string err;
+        if (!ParseLine(line, ds->schema, &rec, &err)) {
+          std::lock_guard<std::mutex> lk(err_mu);
+          ds->error = ds->files[fi] + ": " + err;
+          return;
+        }
+        g_records_parsed.fetch_add(1);
+        per_file[fi].emplace_back(std::move(rec));
+      }
+    }
+  };
+
+  std::vector<std::thread> workers;
+  for (int i = 0; i < nthreads; ++i) workers.emplace_back(worker);
+  for (auto& t : workers) t.join();
+  if (!ds->error.empty()) return -1;
+  for (auto& vec : per_file) {
+    for (auto& r : vec) {
+      g_mem_bytes += RecordBytes(r);
+      ds->records.emplace_back(std::move(r));
+    }
+  }
+  return static_cast<long>(ds->records.size());
+}
+
+// Fisher-Yates with a seeded engine (reference: data_set.cc GlobalShuffle —
+// there a distributed shuffle via fleet; single-host here, deterministic).
+void ptds_global_shuffle(void* h, uint64_t seed) {
+  auto* ds = static_cast<Dataset*>(h);
+  std::mt19937_64 rng(seed);
+  std::shuffle(ds->records.begin(), ds->records.end(), rng);
+}
+
+long ptds_num_records(void* h) {
+  return static_cast<long>(static_cast<Dataset*>(h)->records.size());
+}
+
+void ptds_begin_epoch(void* h, int batch_size) {
+  auto* ds = static_cast<Dataset*>(h);
+  ds->cursor = 0;
+  ds->batch_size = batch_size < 1 ? 1 : batch_size;
+}
+
+// Assemble the next batch: per slot, concatenated values + LoD offsets
+// (rows+1). Returns rows in the batch, 0 at epoch end.
+long ptds_next_batch(void* h) {
+  auto* ds = static_cast<Dataset*>(h);
+  size_t n = ds->schema.size();
+  size_t rows = std::min<size_t>(ds->batch_size,
+                                 ds->records.size() - ds->cursor);
+  if (rows == 0) return 0;
+  ds->batch_f.assign(n, {});
+  ds->batch_i.assign(n, {});
+  ds->batch_lod.assign(n, {});
+  for (size_t s = 0; s < n; ++s) ds->batch_lod[s].push_back(0);
+  for (size_t r = 0; r < rows; ++r) {
+    const Record& rec = ds->records[ds->cursor + r];
+    for (size_t s = 0; s < n; ++s) {
+      const auto& sv = rec.slots[s];
+      if (ds->schema[s].type == 'f') {
+        ds->batch_f[s].insert(ds->batch_f[s].end(), sv.f.begin(), sv.f.end());
+        ds->batch_lod[s].push_back(
+            static_cast<int64_t>(ds->batch_f[s].size()));
+      } else {
+        ds->batch_i[s].insert(ds->batch_i[s].end(), sv.i.begin(), sv.i.end());
+        ds->batch_lod[s].push_back(
+            static_cast<int64_t>(ds->batch_i[s].size()));
+      }
+    }
+  }
+  ds->cursor += rows;
+  return static_cast<long>(rows);
+}
+
+long ptds_slot_values(void* h, int slot, void** data) {
+  auto* ds = static_cast<Dataset*>(h);
+  if (ds->schema[slot].type == 'f') {
+    *data = ds->batch_f[slot].data();
+    return static_cast<long>(ds->batch_f[slot].size());
+  }
+  *data = ds->batch_i[slot].data();
+  return static_cast<long>(ds->batch_i[slot].size());
+}
+
+long ptds_slot_lod(void* h, int slot, int64_t** lod) {
+  auto* ds = static_cast<Dataset*>(h);
+  *lod = ds->batch_lod[slot].data();
+  return static_cast<long>(ds->batch_lod[slot].size());
+}
+
+uint64_t ptds_stat_mem_bytes() { return g_mem_bytes.load(); }
+uint64_t ptds_stat_records_parsed() { return g_records_parsed.load(); }
+
+// ---------------------------------------------------------------------------
+// Streaming (QueueDataset) mode: parser threads feed the bounded Channel
+// while the consumer drains batches — records never fully materialise
+// (reference: QueueDataset dataset.py:923 over MultiSlotDataFeed channels).
+// Record order depends on thread interleaving, as in the reference.
+// ---------------------------------------------------------------------------
+
+void ptds_stream_begin(void* h, int batch_size, int nthreads) {
+  auto* ds = static_cast<Dataset*>(h);
+  ds->error.clear();
+  ds->batch_size = batch_size < 1 ? 1 : batch_size;
+  if (nthreads < 1) nthreads = 1;
+  ds->chan.reset(new Channel<Record>(4096));
+  ds->stream_next_file = 0;
+  ds->stream_live_workers = nthreads;
+  for (int i = 0; i < nthreads; ++i) {
+    ds->stream_workers.emplace_back([ds]() {
+      for (;;) {
+        size_t fi = ds->stream_next_file.fetch_add(1);
+        if (fi >= ds->files.size()) break;
+        std::ifstream in(ds->files[fi]);
+        if (!in) {
+          std::lock_guard<std::mutex> lk(ds->stream_err_mu);
+          ds->error = "cannot open file: " + ds->files[fi];
+          break;
+        }
+        std::string line;
+        bool bad = false;
+        while (std::getline(in, line)) {
+          if (line.empty()) continue;
+          Record rec;
+          std::string err;
+          if (!ParseLine(line, ds->schema, &rec, &err)) {
+            std::lock_guard<std::mutex> lk(ds->stream_err_mu);
+            ds->error = ds->files[fi] + ": " + err;
+            bad = true;
+            break;
+          }
+          g_records_parsed.fetch_add(1);
+          if (!ds->chan->Put(std::move(rec))) return;
+        }
+        if (bad) break;
+      }
+      if (ds->stream_live_workers.fetch_sub(1) == 1)
+        ds->chan->Close();  // last worker out closes the channel
+    });
+  }
+}
+
+long ptds_stream_next_batch(void* h) {
+  auto* ds = static_cast<Dataset*>(h);
+  size_t n = ds->schema.size();
+  ds->batch_f.assign(n, {});
+  ds->batch_i.assign(n, {});
+  ds->batch_lod.assign(n, {});
+  for (size_t s = 0; s < n; ++s) ds->batch_lod[s].push_back(0);
+  long rows = 0;
+  Record rec;
+  while (rows < ds->batch_size && ds->chan && ds->chan->Get(&rec)) {
+    for (size_t s = 0; s < n; ++s) {
+      const auto& sv = rec.slots[s];
+      if (ds->schema[s].type == 'f') {
+        ds->batch_f[s].insert(ds->batch_f[s].end(), sv.f.begin(), sv.f.end());
+        ds->batch_lod[s].push_back(
+            static_cast<int64_t>(ds->batch_f[s].size()));
+      } else {
+        ds->batch_i[s].insert(ds->batch_i[s].end(), sv.i.begin(), sv.i.end());
+        ds->batch_lod[s].push_back(
+            static_cast<int64_t>(ds->batch_i[s].size()));
+      }
+    }
+    ++rows;
+  }
+  return rows;
+}
+
+void ptds_stream_end(void* h) {
+  auto* ds = static_cast<Dataset*>(h);
+  if (ds->chan) ds->chan->Close();
+  for (auto& t : ds->stream_workers)
+    if (t.joinable()) t.join();
+  ds->stream_workers.clear();
+  ds->chan.reset();
+}
+
+}  // extern "C"

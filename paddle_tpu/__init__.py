@@ -30,6 +30,8 @@ from .core import (CPUPlace, CUDAPlace, Executor, Parameter, Program,  # noqa: F
 from .core.compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: F401
 from .core.executor import run_startup  # noqa: F401
 from .param_attr import ParamAttr  # noqa: F401
+from . import dataset  # noqa: F401  (native-backed Dataset API)
+from .dataset import DatasetFactory, InMemoryDataset, QueueDataset  # noqa: F401
 
 __version__ = "0.1.0"
 

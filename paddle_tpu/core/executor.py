@@ -433,6 +433,72 @@ class Executor:
                          out_specs=out_specs, **kwargs)
 
 
+    # -- dataset training path (reference: executor.py:1605
+    # train_from_dataset → MultiTrainer + HogwildWorker hot loop,
+    # hogwild_worker.cc:194) -------------------------------------------------
+    def train_from_dataset(self, program=None, dataset=None,
+                           scope: Optional[Scope] = None, thread: int = 0,
+                           debug: bool = False, fetch_list=None,
+                           fetch_info=None, print_period: int = 100,
+                           fetch_handler=None, _skip_update: bool = False):
+        """Stream the dataset's batches through the compiled training step.
+
+        The reference spawns one DeviceWorker thread per core, each running
+        the op interpreter over its shard of the data (hogwild). Here the
+        jitted XLA step IS the worker: the native parse threads
+        (native/data_feed.cc) keep the host side ahead while XLA's async
+        dispatch pipelines device steps — same roles, two components.
+        """
+        if dataset is None:
+            raise ValueError("dataset is required")
+        if program is None:
+            program = default_main_program()
+        scope = scope or global_scope()
+        if thread:
+            dataset.set_thread(thread)
+        if _skip_update:
+            program = program.clone(for_test=True)
+            block = program.global_block()
+            # masked role check (OpRole.Loss/LRSched combine with the base
+            # role, e.g. Backward|Loss = 257 — ir.py is_backward_op)
+            block.ops = [op for op in block.ops
+                         if not op.is_backward_op() and not op.is_optimize_op()]
+            program._bump_version()
+        fetch_names = [f.name if isinstance(f, Variable) else str(f)
+                       for f in (fetch_list or [])]
+        fetch_info = fetch_info or fetch_names
+        step = 0
+        last = None
+        for feed in dataset.iter_batches():
+            bad = [k for k, v in feed.items() if isinstance(v, tuple)]
+            if bad:
+                raise ExecutionError(
+                    f"lod-tensor slots {bad} need a lod-aware program; dense "
+                    f"training path expects fixed-shape slots")
+            last = self.run(program, feed=feed, fetch_list=fetch_names,
+                            scope=scope)
+            if debug and fetch_names and step % max(print_period, 1) == 0:
+                msgs = ", ".join(f"{i}={np.asarray(v).reshape(-1)[0]:.6f}"
+                                 for i, v in zip(fetch_info, last))
+                print(f"[train_from_dataset] step {step}: {msgs}")
+            step += 1
+        if step == 0:
+            raise ExecutionError(
+                "dataset produced no batches — for InMemoryDataset call "
+                "load_into_memory() before training")
+        if fetch_handler is not None and last is not None:
+            fetch_handler(dict(zip(fetch_names, last)))
+        return last
+
+    def infer_from_dataset(self, program=None, dataset=None, **kwargs):
+        """Like train_from_dataset but NEVER updates parameters
+        (reference: executor.py infer_from_dataset — trainer with
+        is_infer=True): backward/optimizer-role ops are stripped from a
+        clone before running."""
+        kwargs["_skip_update"] = True
+        return self.train_from_dataset(program, dataset, **kwargs)
+
+
 # convenience singletons ------------------------------------------------------
 
 def run_startup(startup_program: Optional[Program] = None,
@@ -444,3 +510,4 @@ def run_startup(startup_program: Optional[Program] = None,
     exe.run(startup_program or default_startup_program(), feed={}, fetch_list=[],
             scope=scope, use_compiled=False)
     return exe
+

@@ -196,7 +196,10 @@ def multihead_attention_fuse_pass(program: Program) -> Program:
             chain = [op] if cur is op else [op, cur]
             chain.append(sm)
             drop = only_consumer(probs, "dropout")
-            if drop is not None and bool(drop.attrs.get("is_test", False)):
+            if drop is not None and bool(drop.attrs.get("is_test", False)) \
+                    and drop.attrs.get("dropout_implementation",
+                                       "upscale_in_train") == \
+                    "upscale_in_train":  # downgrade_in_infer scales at test
                 probs = _out(drop, "Out")
                 chain.append(drop)
             ctx_mm = only_consumer(probs, "matmul")

@@ -1,0 +1,190 @@
+"""Dataset API over the native data runtime.
+
+Capability mirror of python/paddle/fluid/dataset.py (DatasetFactory:23,
+InMemoryDataset:329 load_into_memory:661 global_shuffle:746,
+QueueDataset:923) backed by the C++ MultiSlot engine (native/data_feed.cc —
+the reference's data_feed.cc/data_set.cc). Falls back to a pure-Python
+parser when no toolchain is available, same API.
+
+Slots are declared via set_use_var(program_vars): dtype int64 → 'u'
+(uint64 ids), float32 → 'f'. Dense vars (lod_level 0) are reshaped to
+[rows] + var.shape[1:]; lod vars yield (values, lod_offsets).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class _PyParserDataset:
+    """Pure-Python fallback with the NativeDataset interface."""
+
+    def __init__(self, slots):
+        self.slots = list(slots)
+        self._records: List[List[np.ndarray]] = []
+        self._files: List[str] = []
+
+    def set_filelist(self, files):
+        self._files = list(files)
+
+    def load_into_memory(self, num_threads: int = 1) -> int:
+        for path in self._files:
+            with open(path) as f:
+                for line in f:
+                    toks = line.split()
+                    if not toks:
+                        continue
+                    rec = []
+                    pos = 0
+                    for name, typ in self.slots:
+                        n = int(toks[pos])
+                        pos += 1
+                        vals = toks[pos:pos + n]
+                        pos += n
+                        rec.append(np.asarray(
+                            vals, dtype=np.float32 if typ == "f" else np.int64))
+                    self._records.append(rec)
+        return len(self._records)
+
+    def global_shuffle(self, seed: int = 0):
+        rng = np.random.RandomState(seed & 0x7FFFFFFF)
+        order = rng.permutation(len(self._records))
+        self._records = [self._records[i] for i in order]
+
+    def num_records(self) -> int:
+        return len(self._records)
+
+    def batches(self, batch_size: int):
+        for start in range(0, len(self._records), batch_size):
+            chunk = self._records[start:start + batch_size]
+            out = {}
+            for idx, (name, typ) in enumerate(self.slots):
+                vals = np.concatenate([r[idx] for r in chunk]) if chunk else \
+                    np.zeros((0,), np.float32 if typ == "f" else np.int64)
+                lod = np.cumsum([0] + [len(r[idx]) for r in chunk]).astype(
+                    np.int64)
+                out[name] = (vals, lod)
+            yield out
+
+
+class DatasetBase:
+    def __init__(self):
+        self.batch_size = 1
+        self.thread_num = 4
+        self.filelist: List[str] = []
+        self.use_vars: List[Any] = []
+        self._engine = None
+        self._force_python = False
+
+    # -- reference API ---------------------------------------------------------
+    def set_batch_size(self, batch_size: int):
+        self.batch_size = int(batch_size)
+
+    def set_thread(self, thread_num: int):
+        self.thread_num = int(thread_num)
+
+    def set_filelist(self, filelist: Sequence[str]):
+        self.filelist = list(filelist)
+        if self._engine is not None:
+            self._engine.set_filelist(self.filelist)
+
+    def set_use_var(self, var_list):
+        self.use_vars = list(var_list)
+
+    def set_pipe_command(self, cmd: str):
+        # reference pipes raw data through a user command (data_feed.proto);
+        # preprocessing belongs upstream here — kept for API parity
+        self._pipe_command = cmd
+
+    # -- engine ---------------------------------------------------------------
+    def _slots(self):
+        if not self.use_vars:
+            raise ValueError("call set_use_var(vars) before loading data")
+        slots = []
+        for v in self.use_vars:
+            typ = "u" if "int" in str(v.dtype) else "f"
+            slots.append((v.name, typ))
+        return slots
+
+    def _ensure_engine(self):
+        if self._engine is None:
+            from . import native
+
+            if not self._force_python and native.available():
+                self._engine = native.NativeDataset(self._slots())
+            else:
+                self._engine = _PyParserDataset(self._slots())
+            if self.filelist:
+                self._engine.set_filelist(self.filelist)
+        return self._engine
+
+    def _dense_shape(self, var):
+        return [int(d) for d in (var.shape[1:] if var.shape else [])]
+
+    def _feed_from_raw(self, raw) -> Dict[str, Any]:
+        feed: Dict[str, Any] = {}
+        for v in self.use_vars:
+            vals, lod = raw[v.name]
+            if getattr(v, "lod_level", 0):
+                feed[v.name] = (vals, lod)
+            else:
+                tail = self._dense_shape(v)
+                rows = len(lod) - 1
+                feed[v.name] = vals.reshape([rows] + tail)
+        return feed
+
+    def iter_batches(self):
+        """Yield feed dicts {var_name: ndarray} (dense vars reshaped; lod
+        vars yield (values, lod) tuples)."""
+        engine = self._ensure_engine()
+        for raw in engine.batches(self.batch_size):
+            yield self._feed_from_raw(raw)
+
+
+class InMemoryDataset(DatasetBase):
+    """reference: dataset.py:329."""
+
+    def load_into_memory(self):
+        return self._ensure_engine().load_into_memory(self.thread_num)
+
+    def global_shuffle(self, fleet=None, thread_num: Optional[int] = None,
+                       seed: int = 0):
+        self._ensure_engine().global_shuffle(seed)
+
+    def get_memory_data_size(self, fleet=None) -> int:
+        return self._ensure_engine().num_records()
+
+    def release_memory(self):
+        self._engine = None
+
+
+class QueueDataset(DatasetBase):
+    """reference: dataset.py:923 — streaming reader: native parser threads
+    feed a bounded channel, batches stream out without materialising the
+    dataset in memory (falls back to load-then-iterate on the pure-Python
+    engine)."""
+
+    def iter_batches(self):
+        engine = self._ensure_engine()
+        if hasattr(engine, "stream_batches"):
+            raw_iter = engine.stream_batches(self.batch_size,
+                                             self.thread_num)
+        else:
+            if engine.num_records() == 0:
+                engine.load_into_memory(self.thread_num)
+            raw_iter = engine.batches(self.batch_size)
+        for raw in raw_iter:
+            yield self._feed_from_raw(raw)
+
+
+class DatasetFactory:
+    """reference: dataset.py:23."""
+
+    def create_dataset(self, datafeed_class: str = "QueueDataset"):
+        if datafeed_class == "InMemoryDataset":
+            return InMemoryDataset()
+        if datafeed_class == "QueueDataset":
+            return QueueDataset()
+        raise ValueError(f"unknown dataset class {datafeed_class}")

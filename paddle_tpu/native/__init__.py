@@ -1,0 +1,197 @@
+"""Native (C++) runtime bindings — build-on-first-use + ctypes.
+
+The C++ sources live in <repo>/native/ (data_feed.cc: the reference's
+data_feed.cc / data_set.cc / channel.h capability as one library). The
+shared object is compiled with g++ on first import (no pybind11 in the
+image — C ABI + ctypes) and cached next to the sources keyed on a source
+hash. `available()` is False when no toolchain exists; callers fall back
+to the pure-Python parser (dataset.py) so the framework never hard-depends
+on the native path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_LOCK = threading.Lock()
+_LIB = None
+_ERR: Optional[str] = None
+
+_SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_SOURCES = ["data_feed.cc"]
+
+
+def _build_and_load():
+    global _LIB, _ERR
+    srcs = [os.path.join(_SRC_DIR, s) for s in _SOURCES]
+    if not all(os.path.exists(s) for s in srcs):
+        _ERR = f"native sources not found under {_SRC_DIR}"
+        return
+    h = hashlib.sha256()
+    for s in srcs:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    so_path = os.path.join(_SRC_DIR, f"libpaddle_tpu_native.{h.hexdigest()[:12]}.so")
+    if not os.path.exists(so_path):
+        cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
+               "-o", so_path] + srcs
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True,
+                           timeout=300)
+        except FileNotFoundError:
+            _ERR = "g++ not found"
+            return
+        except subprocess.CalledProcessError as e:
+            _ERR = f"native build failed:\n{e.stderr[-2000:]}"
+            return
+    lib = ctypes.CDLL(so_path)
+    lib.ptds_create.restype = ctypes.c_void_p
+    lib.ptds_create.argtypes = [ctypes.POINTER(ctypes.c_char_p),
+                                ctypes.c_char_p, ctypes.c_int]
+    lib.ptds_destroy.argtypes = [ctypes.c_void_p]
+    lib.ptds_set_filelist.argtypes = [ctypes.c_void_p,
+                                      ctypes.POINTER(ctypes.c_char_p),
+                                      ctypes.c_int]
+    lib.ptds_last_error.restype = ctypes.c_char_p
+    lib.ptds_last_error.argtypes = [ctypes.c_void_p]
+    lib.ptds_load_into_memory.restype = ctypes.c_long
+    lib.ptds_load_into_memory.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ptds_global_shuffle.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.ptds_num_records.restype = ctypes.c_long
+    lib.ptds_num_records.argtypes = [ctypes.c_void_p]
+    lib.ptds_begin_epoch.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ptds_next_batch.restype = ctypes.c_long
+    lib.ptds_next_batch.argtypes = [ctypes.c_void_p]
+    lib.ptds_slot_values.restype = ctypes.c_long
+    lib.ptds_slot_values.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                     ctypes.POINTER(ctypes.c_void_p)]
+    lib.ptds_slot_lod.restype = ctypes.c_long
+    lib.ptds_slot_lod.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                  ctypes.POINTER(ctypes.POINTER(ctypes.c_int64))]
+    lib.ptds_stat_mem_bytes.restype = ctypes.c_uint64
+    lib.ptds_stat_records_parsed.restype = ctypes.c_uint64
+    lib.ptds_stream_begin.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                      ctypes.c_int]
+    lib.ptds_stream_next_batch.restype = ctypes.c_long
+    lib.ptds_stream_next_batch.argtypes = [ctypes.c_void_p]
+    lib.ptds_stream_end.argtypes = [ctypes.c_void_p]
+    _LIB = lib
+
+
+def get_lib():
+    global _LIB
+    with _LOCK:
+        if _LIB is None and _ERR is None:
+            _build_and_load()
+    return _LIB
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def build_error() -> Optional[str]:
+    get_lib()
+    return _ERR
+
+
+def mem_bytes() -> int:
+    lib = get_lib()
+    return int(lib.ptds_stat_mem_bytes()) if lib else 0
+
+
+def records_parsed() -> int:
+    lib = get_lib()
+    return int(lib.ptds_stat_records_parsed()) if lib else 0
+
+
+class NativeDataset:
+    """Handle over the C++ MultiSlot in-memory dataset.
+
+    slots: [(name, 'f'|'u'), ...] in file column order."""
+
+    def __init__(self, slots: Sequence[Tuple[str, str]]):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError(f"native runtime unavailable: {_ERR}")
+        self._lib = lib
+        self.slots = list(slots)
+        names = (ctypes.c_char_p * len(slots))(
+            *[s[0].encode() for s in slots])
+        types = "".join(s[1] for s in slots).encode()
+        self._h = lib.ptds_create(names, types, len(slots))
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.ptds_destroy(self._h)
+            self._h = None
+
+    def set_filelist(self, files: Sequence[str]):
+        arr = (ctypes.c_char_p * len(files))(*[f.encode() for f in files])
+        self._lib.ptds_set_filelist(self._h, arr, len(files))
+
+    def load_into_memory(self, num_threads: int = 4) -> int:
+        n = self._lib.ptds_load_into_memory(self._h, num_threads)
+        if n < 0:
+            raise RuntimeError(
+                self._lib.ptds_last_error(self._h).decode() or "load failed")
+        return int(n)
+
+    def global_shuffle(self, seed: int = 0):
+        self._lib.ptds_global_shuffle(self._h, seed)
+
+    def num_records(self) -> int:
+        return int(self._lib.ptds_num_records(self._h))
+
+    def _read_batch(self):
+        out = {}
+        for idx, (name, typ) in enumerate(self.slots):
+            ptr = ctypes.c_void_p()
+            n = self._lib.ptds_slot_values(self._h, idx, ctypes.byref(ptr))
+            ctype = ctypes.c_float if typ == "f" else ctypes.c_int64
+            buf = ctypes.cast(ptr, ctypes.POINTER(ctype * n)).contents \
+                if n else (ctype * 0)()
+            vals = np.frombuffer(buf, dtype=np.float32 if typ == "f"
+                                 else np.int64).copy() if n else \
+                np.zeros((0,), np.float32 if typ == "f" else np.int64)
+            lod_ptr = ctypes.POINTER(ctypes.c_int64)()
+            ln = self._lib.ptds_slot_lod(self._h, idx, ctypes.byref(lod_ptr))
+            lod = np.ctypeslib.as_array(lod_ptr, shape=(ln,)).copy()
+            out[name] = (vals, lod)
+        return out
+
+    def batches(self, batch_size: int):
+        """Yield {slot: (values ndarray, lod ndarray)} per batch from the
+        in-memory store. Values are copied out of the native buffers
+        (they are reused next batch)."""
+        self._lib.ptds_begin_epoch(self._h, batch_size)
+        while True:
+            rows = self._lib.ptds_next_batch(self._h)
+            if rows <= 0:
+                return
+            yield self._read_batch()
+
+    def stream_batches(self, batch_size: int, num_threads: int = 4):
+        """QueueDataset mode: background parser threads feed a bounded
+        channel; batches stream out without materialising the dataset.
+        Record order depends on thread interleaving."""
+        self._lib.ptds_stream_begin(self._h, batch_size, num_threads)
+        try:
+            while True:
+                rows = self._lib.ptds_stream_next_batch(self._h)
+                if rows <= 0:
+                    break
+                yield self._read_batch()
+        finally:
+            self._lib.ptds_stream_end(self._h)
+        err = self._lib.ptds_last_error(self._h).decode()
+        if err:
+            raise RuntimeError(f"stream parse failed: {err}")

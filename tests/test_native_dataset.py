@@ -1,0 +1,214 @@
+"""Native data runtime + Dataset API + train_from_dataset tests.
+
+Mirrors the reference's dataset/data_feed tests (test_dataset.py,
+data_feed.cc CheckFile): MultiSlot parsing, shuffle determinism, batch
+LoD assembly, and the executor's trainer path."""
+
+import os
+
+import numpy as np
+import pytest
+
+
+def _write_multislot(tmp_path, n_files=2, rows=6, feat=8):
+    """Files with slots: feat (float, `feat` values) + label (uint, 1)."""
+    files = []
+    rng = np.random.RandomState(7)
+    truth = []
+    for fi in range(n_files):
+        path = str(tmp_path / f"part-{fi}")
+        with open(path, "w") as f:
+            for r in range(rows):
+                vals = rng.randn(feat).astype(np.float32)
+                label = int(rng.randint(0, 4))
+                truth.append((vals, label))
+                f.write(f"{feat} " + " ".join(f"{v:.6f}" for v in vals)
+                        + f" 1 {label}\n")
+        files.append(path)
+    return files, truth
+
+
+class TestNativeEngine:
+    def test_available(self):
+        from paddle_tpu import native
+
+        assert native.available(), native.build_error()
+
+    def test_parse_matches_python_fallback(self, tmp_path):
+        from paddle_tpu import native
+        from paddle_tpu.dataset import _PyParserDataset
+
+        files, truth = _write_multislot(tmp_path)
+        slots = [("feat", "f"), ("label", "u")]
+
+        nat = native.NativeDataset(slots)
+        nat.set_filelist(files)
+        assert nat.load_into_memory(3) == len(truth)
+
+        py = _PyParserDataset(slots)
+        py.set_filelist(files)
+        py.load_into_memory()
+
+        nb = list(nat.batches(5))
+        pb = list(py.batches(5))
+        assert len(nb) == len(pb)
+        for b1, b2 in zip(nb, pb):
+            np.testing.assert_allclose(b1["feat"][0], b2["feat"][0],
+                                       atol=1e-6)
+            np.testing.assert_array_equal(b1["label"][0], b2["label"][0])
+            np.testing.assert_array_equal(b1["feat"][1], b2["feat"][1])
+
+    def test_shuffle_deterministic(self, tmp_path):
+        from paddle_tpu import native
+
+        files, truth = _write_multislot(tmp_path)
+        orders = []
+        for _ in range(2):
+            ds = native.NativeDataset([("feat", "f"), ("label", "u")])
+            ds.set_filelist(files)
+            ds.load_into_memory(2)
+            ds.global_shuffle(seed=123)
+            labels = []
+            for b in ds.batches(4):
+                labels.extend(b["label"][0].tolist())
+            orders.append(labels)
+        assert orders[0] == orders[1]
+        assert sorted(orders[0]) == sorted(t[1] for t in truth)
+
+    def test_parse_error_reported(self, tmp_path):
+        from paddle_tpu import native
+
+        bad = str(tmp_path / "bad")
+        with open(bad, "w") as f:
+            f.write("2 1.0 notafloat 1 0\n")
+        ds = native.NativeDataset([("feat", "f"), ("label", "u")])
+        ds.set_filelist([bad])
+        with pytest.raises(RuntimeError, match="bad float"):
+            ds.load_into_memory(1)
+
+
+class TestTrainFromDataset:
+    def test_mlp_trains(self, tmp_path, scope):
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+
+        files, _ = _write_multislot(tmp_path, n_files=2, rows=16, feat=8)
+
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            feat = layers.data("feat", [8], stop_gradient=True)
+            label = layers.data("label", [1], dtype="int64",
+                                stop_gradient=True)
+            h = layers.fc(feat, 16, act="relu")
+            logits = layers.fc(h, 4)
+            loss = layers.mean(
+                layers.softmax_with_cross_entropy(logits, label))
+            pt.optimizer.SGDOptimizer(0.2).minimize(loss)
+
+        dataset = pt.DatasetFactory().create_dataset("InMemoryDataset")
+        dataset.set_batch_size(8)
+        dataset.set_thread(2)
+        dataset.set_use_var([feat, label])
+        dataset.set_filelist(files)
+        dataset.load_into_memory()
+        dataset.global_shuffle(seed=1)
+        assert dataset.get_memory_data_size() == 32
+
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        first = exe.train_from_dataset(main, dataset, scope=scope,
+                                       fetch_list=[loss])
+        for _ in range(12):
+            last = exe.train_from_dataset(main, dataset, scope=scope,
+                                          fetch_list=[loss])
+        assert float(np.asarray(last[0]).reshape(-1)[0]) < \
+            float(np.asarray(first[0]).reshape(-1)[0])
+
+
+class TestQueueDataset:
+    def test_streaming_covers_all_records(self, tmp_path):
+        import paddle_tpu as pt
+
+        files, truth = _write_multislot(tmp_path, n_files=3, rows=10)
+        import paddle_tpu.layers as layers
+        from paddle_tpu.core import ir
+
+        ir._main_program = ir.Program()
+        feat = layers.data("feat", [8], stop_gradient=True)
+        label = layers.data("label", [1], dtype="int64", stop_gradient=True)
+
+        ds = pt.DatasetFactory().create_dataset("QueueDataset")
+        ds.set_batch_size(7)
+        ds.set_thread(3)
+        ds.set_use_var([feat, label])
+        ds.set_filelist(files)
+        seen = []
+        for feed in ds.iter_batches():
+            assert feed["feat"].shape[1] == 8
+            seen.extend(feed["label"].reshape(-1).tolist())
+        assert sorted(seen) == sorted(t[1] for t in truth)
+
+
+class TestInferFromDataset:
+    def test_does_not_update_params(self, tmp_path, scope):
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+
+        files, _ = _write_multislot(tmp_path, n_files=1, rows=8)
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            feat = layers.data("feat", [8], stop_gradient=True)
+            label = layers.data("label", [1], dtype="int64",
+                                stop_gradient=True)
+            logits = layers.fc(feat, 4, param_attr=pt.ParamAttr(name="w"))
+            loss = layers.mean(
+                layers.softmax_with_cross_entropy(logits, label))
+            pt.optimizer.SGDOptimizer(0.5).minimize(loss)
+
+        dataset = pt.DatasetFactory().create_dataset("InMemoryDataset")
+        dataset.set_batch_size(4)
+        dataset.set_use_var([feat, label])
+        dataset.set_filelist(files)
+        dataset.load_into_memory()
+
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        w0 = np.asarray(scope.find_var("w")).copy()
+        exe.infer_from_dataset(main, dataset, scope=scope,
+                               fetch_list=[loss])
+        np.testing.assert_array_equal(np.asarray(scope.find_var("w")), w0)
+        exe.train_from_dataset(main, dataset, scope=scope,
+                               fetch_list=[loss])
+        assert not np.array_equal(np.asarray(scope.find_var("w")), w0)
+
+    def test_unloaded_dataset_raises(self, tmp_path, scope):
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+        from paddle_tpu.core.executor import ExecutionError
+
+        files, _ = _write_multislot(tmp_path, n_files=1, rows=4)
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            feat = layers.data("feat", [8], stop_gradient=True)
+            loss = layers.mean(layers.fc(feat, 2))
+            pt.optimizer.SGDOptimizer(0.1).minimize(loss)
+        ds = pt.DatasetFactory().create_dataset("InMemoryDataset")
+        ds.set_batch_size(4)
+        ds.set_use_var([feat])
+        ds.set_filelist(files)  # load_into_memory() NOT called
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        with pytest.raises(ExecutionError, match="load_into_memory"):
+            exe.train_from_dataset(main, ds, scope=scope)
+
+    def test_stream_parse_error_raises(self, tmp_path):
+        from paddle_tpu import native
+
+        bad = str(tmp_path / "bad")
+        with open(bad, "w") as f:
+            f.write("8 1 2 3 4 5 6 7 8 1 0\n")
+            f.write("8 1 2 oops 4 5 6 7 8 1 0\n")
+        ds = native.NativeDataset([("feat", "f"), ("label", "u")])
+        ds.set_filelist([bad])
+        with pytest.raises(RuntimeError, match="bad float"):
+            list(ds.stream_batches(2, 1))
