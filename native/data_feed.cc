@@ -207,6 +207,10 @@ const char* ptds_last_error(void* h) {
 long ptds_load_into_memory(void* h, int nthreads) {
   auto* ds = static_cast<Dataset*>(h);
   ds->error.clear();
+  // reload replaces the store (a second call must not duplicate records)
+  for (auto& r : ds->records) g_mem_bytes -= RecordBytes(r);
+  ds->records.clear();
+  ds->cursor = 0;
   if (nthreads < 1) nthreads = 1;
   std::vector<std::vector<Record>> per_file(ds->files.size());
   std::atomic<size_t> next_file{0};

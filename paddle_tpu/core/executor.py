@@ -457,13 +457,9 @@ class Executor:
         if thread:
             dataset.set_thread(thread)
         if _skip_update:
+            # clone(for_test=True) strips backward/optimize-role ops
+            # (masked role checks — ir.py is_backward_op/is_optimize_op)
             program = program.clone(for_test=True)
-            block = program.global_block()
-            # masked role check (OpRole.Loss/LRSched combine with the base
-            # role, e.g. Backward|Loss = 257 — ir.py is_backward_op)
-            block.ops = [op for op in block.ops
-                         if not op.is_backward_op() and not op.is_optimize_op()]
-            program._bump_version()
         fetch_names = [f.name if isinstance(f, Variable) else str(f)
                        for f in (fetch_list or [])]
         fetch_info = fetch_info or fetch_names

@@ -30,6 +30,7 @@ class _PyParserDataset:
         self._files = list(files)
 
     def load_into_memory(self, num_threads: int = 1) -> int:
+        self._records = []      # reload replaces, never duplicates
         for path in self._files:
             with open(path) as f:
                 for line in f:
@@ -39,9 +40,17 @@ class _PyParserDataset:
                     rec = []
                     pos = 0
                     for name, typ in self.slots:
+                        if pos >= len(toks):
+                            raise ValueError(
+                                f"{path}: truncated line, missing slot "
+                                f"'{name}'")
                         n = int(toks[pos])
                         pos += 1
                         vals = toks[pos:pos + n]
+                        if len(vals) != n:
+                            raise ValueError(
+                                f"{path}: slot '{name}' declares {n} values "
+                                f"but line has {len(vals)}")
                         pos += n
                         rec.append(np.asarray(
                             vals, dtype=np.float32 if typ == "f" else np.int64))

@@ -15,11 +15,15 @@ from ..core.ir import _dygraph_tracer_holder, in_dygraph_mode
 from .layers import Layer
 from .tracer import Tracer, get_tracer, grad, trace_fn, trace_op
 from .varbase import ParamBase, VarBase, to_variable
+from . import jit  # noqa: F401
+from .jit import (ProgramTranslator, TracedLayer, declarative,  # noqa: F401
+                  to_static)
 
 __all__ = [
     "Layer", "Tracer", "VarBase", "ParamBase", "to_variable", "guard",
     "enable_dygraph", "disable_dygraph", "enabled", "no_grad", "grad",
-    "trace_op", "trace_fn", "save_dygraph", "load_dygraph",
+    "trace_op", "trace_fn", "save_dygraph", "load_dygraph", "jit",
+    "to_static", "declarative", "TracedLayer", "ProgramTranslator",
 ]
 
 

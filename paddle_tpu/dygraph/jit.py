@@ -1,0 +1,375 @@
+"""dygraph → static: @to_static, TracedLayer, jit.save/load.
+
+Capability mirror of the reference's dygraph_to_static stack
+(dygraph/dygraph_to_static/program_translator.py:691 ProgramTranslator,
+dygraph/jit.py TracedLayer/save/load, partial_program.py PartialProgramLayer).
+
+TPU re-design — capture-by-execution instead of AST rewriting: the dygraph
+function runs EAGERLY once per input signature while every trace_op records
+its op into a fresh Program (so Python control flow executes with concrete
+values and is frozen into the trace, like the reference's TracedLayer).
+Subsequent calls run the whole captured block as ONE jitted XLA
+computation, re-entering the autograd tape as a single node whose vjp is
+jax.vjp of the block — the to_static speedup (no per-op dispatch) plus
+full training support, without a source-to-source compiler.
+
+VarBase convenience methods route through ad-hoc jax closures
+(tracer.trace_fn); those capture as non-serialisable `__jax_fn__` ops —
+callable in memory, rejected at export time with a clear message.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core import unique_name
+from ..core.ir import Program
+from ..core.registry import EMPTY_VAR, register_op
+from .varbase import VarBase
+
+_capture_stack: List["_CaptureState"] = []
+
+
+@register_op("__jax_fn__", skip_infer_shape=True)
+def _jax_fn_op(ins, attrs):
+    """Ad-hoc traced closure as an op (in-memory only — not exportable)."""
+    res = attrs["fn"](*[v for v in ins.get("X", [])])
+    if not isinstance(res, (list, tuple)):
+        res = [res]
+    return {"Out": list(res)}
+
+
+class _CaptureState:
+    def __init__(self):
+        self.program = Program()
+        self.block = self.program.global_block()
+        self.names: Dict[int, str] = {}
+        self.keep: List[VarBase] = []       # id-stability for self.names
+        self.param_values: Dict[str, VarBase] = {}  # live persistable links
+        self.feed_names: List[str] = []
+        self.closure_ops = 0
+
+    def mark_feed(self, vb: VarBase) -> str:
+        name = unique_name.generate("feed")
+        self.block.create_var(name=name, shape=list(vb.shape),
+                              dtype=str(vb.dtype), stop_gradient=True)
+        self.names[id(vb)] = name
+        self.keep.append(vb)
+        self.feed_names.append(name)
+        return name
+
+    def name_of(self, vb: VarBase) -> str:
+        key = id(vb)
+        if key in self.names:
+            return self.names[key]
+        self.keep.append(vb)
+        # params keep their names; any other externally-created tensor is
+        # captured by (live) reference as a persistable too
+        name = vb.name if vb.persistable else unique_name.generate("captured")
+        self.block.create_var(name=name, shape=list(vb.shape),
+                              dtype=str(vb.dtype), persistable=True)
+        self.param_values[name] = vb
+        self.names[key] = name
+        return name
+
+    def bind_outputs(self, out_vars: Dict[str, List[VarBase]],
+                     op_type: str) -> Dict[str, List[str]]:
+        outputs: Dict[str, List[str]] = {}
+        for slot, vals in out_vars.items():
+            names = []
+            for vb in vals:
+                name = unique_name.generate(f"{op_type}.cap")
+                self.block.create_var(name=name, shape=list(vb.shape),
+                                      dtype=str(vb.dtype))
+                self.names[id(vb)] = name
+                self.keep.append(vb)
+                names.append(name)
+            outputs[slot] = names
+        return outputs
+
+
+def capture_op(op_type: str, norm_inputs, attrs, out_vars):
+    """Called by tracer.trace_op after eager execution to record the op."""
+    if not _capture_stack:
+        return
+    cap = _capture_stack[-1]
+    inputs: Dict[str, List[str]] = {}
+    for slot, vals in norm_inputs.items():
+        inputs[slot] = [EMPTY_VAR if v is None else cap.name_of(v)
+                        for v in vals]
+    outputs = cap.bind_outputs(out_vars, op_type)
+    if op_type == "__jax_fn__":
+        cap.closure_ops += 1
+    cap.block.append_op(op_type, inputs, outputs, dict(attrs),
+                        infer_shape=False)
+
+
+class ConcreteProgram:
+    """One traced (program, feeds, fetches, params) per input signature
+    (reference: partial_program.py PartialProgramLayer)."""
+
+    def __init__(self, cap: _CaptureState, fetch_names: List[str], treedef):
+        import jax
+
+        self.program = cap.program
+        self.feed_names = list(cap.feed_names)
+        self.fetch_names = list(fetch_names)
+        self.param_values = dict(cap.param_values)
+        self.closure_ops = cap.closure_ops
+        self.treedef = treedef
+        block = self.program.global_block()
+        param_names = list(self.param_values)
+        feed_names = self.feed_names
+        fetch = list(fetch_names)
+
+        def static_call(*arrs):
+            from ..core.executor import run_block
+
+            env = dict(zip(param_names + feed_names, arrs))
+            run_block(block, env)
+            return tuple(env[n] for n in fetch)
+
+        self._jitted = jax.jit(static_call)
+        self.param_names = param_names
+
+    def __call__(self, arg_vbs: List[VarBase]):
+        from .tracer import trace_op
+
+        all_vbs = [self.param_values[n] for n in self.param_names] + arg_vbs
+        outs = trace_op("__jax_fn__", {"X": all_vbs},
+                        {"fn": self._jitted})["Out"]
+        return self.treedef(outs)
+
+
+class ProgramTranslator:
+    """reference: program_translator.py:691 — global enable/disable switch."""
+
+    _instance: Optional["ProgramTranslator"] = None
+
+    def __init__(self):
+        self.enable_to_static = True
+
+    @classmethod
+    def get_instance(cls) -> "ProgramTranslator":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, flag: bool):
+        self.enable_to_static = bool(flag)
+
+
+def _sig_of(args) -> tuple:
+    sig = []
+    for a in args:
+        if isinstance(a, VarBase):
+            sig.append(("vb", tuple(a.shape), str(a.dtype)))
+        elif isinstance(a, np.ndarray):
+            sig.append(("nd", a.shape, str(a.dtype)))
+        elif isinstance(a, (int, float, bool, str, bytes, type(None))):
+            sig.append(("py", a))
+        else:
+            # arbitrary objects (e.g. the Layer self): identity, not repr —
+            # reprs of distinct instances can collide
+            sig.append(("obj", id(a)))
+    return tuple(sig)
+
+
+class StaticFunction:
+    """@to_static wrapper: trace-on-first-call per signature, then run the
+    captured block as one jitted computation on the tape."""
+
+    def __init__(self, fn, input_spec=None):
+        self._fn = fn
+        self._input_spec = input_spec
+        self._cache: Dict[tuple, ConcreteProgram] = {}
+        self._last: Optional[ConcreteProgram] = None
+        functools.update_wrapper(self, fn)
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        # one StaticFunction (and trace cache) PER INSTANCE — a shared
+        # class-level cache would key m1 and m2 to the same ConcreteProgram
+        # and silently run m2 with m1's captured parameters
+        key = "_sf_" + self._fn.__name__
+        inst_sf = obj.__dict__.get(key)
+        if inst_sf is None:
+            inst_sf = StaticFunction(self._fn, self._input_spec)
+            obj.__dict__[key] = inst_sf
+        bound = functools.partial(inst_sf.__call__, obj)
+        bound.__self__ = obj
+        bound._static_function = inst_sf
+        return bound
+
+    def __call__(self, *args):
+        if not ProgramTranslator.get_instance().enable_to_static:
+            return self._fn(*args)
+        tensor_idx = [i for i, a in enumerate(args)
+                      if isinstance(a, (VarBase, np.ndarray))]
+        vb_args = [a if isinstance(a, VarBase) else VarBase(a)
+                   for a in (args[i] for i in tensor_idx)]
+        sig = _sig_of(args)
+        conc = self._cache.get(sig)
+        if conc is None:
+            conc = self._trace(args, tensor_idx, vb_args)
+            self._cache[sig] = conc
+        self._last = conc
+        return conc(vb_args)
+
+    def _trace(self, args, tensor_idx, vb_args) -> ConcreteProgram:
+        cap = _CaptureState()
+        for vb in vb_args:
+            cap.mark_feed(vb)
+        full_args = list(args)
+        for i, vb in zip(tensor_idx, vb_args):
+            full_args[i] = vb
+        _capture_stack.append(cap)
+        try:
+            result = self._fn(*full_args)
+        finally:
+            _capture_stack.pop()
+        flat, treedef = _flatten_result(result)
+        fetch_names = []
+        for vb in flat:
+            name = cap.names.get(id(vb))
+            if name is None:
+                # output independent of the trace (constant) — capture it
+                name = cap.name_of(vb)
+            fetch_names.append(name)
+        return ConcreteProgram(cap, fetch_names, treedef)
+
+    # export surface -------------------------------------------------------
+    @property
+    def concrete_program(self) -> Optional[ConcreteProgram]:
+        return self._last
+
+    @property
+    def main_program(self) -> Optional[Program]:
+        return self._last.program if self._last else None
+
+
+def _flatten_result(result):
+    if isinstance(result, VarBase):
+        return [result], (lambda outs: outs[0])
+    if isinstance(result, (list, tuple)):
+        ctor = type(result)
+        if not all(isinstance(r, VarBase) for r in result):
+            raise TypeError("to_static functions must return VarBase or "
+                            "(nested) lists/tuples of VarBase")
+        return list(result), (lambda outs: ctor(outs))
+    raise TypeError(f"unsupported to_static return type {type(result)}")
+
+
+def to_static(function=None, input_spec=None, **kwargs):
+    """@paddle.jit.to_static (reference: jit.py declarative)."""
+
+    def deco(fn):
+        return StaticFunction(fn, input_spec)
+
+    if function is not None:
+        return deco(function)
+    return deco
+
+
+declarative = to_static
+
+
+def _concrete_of(layer_or_fn) -> ConcreteProgram:
+    target = layer_or_fn
+    if hasattr(target, "forward"):
+        fwd = type(target).__dict__.get("forward")
+        if isinstance(fwd, StaticFunction):
+            inst_sf = target.__dict__.get("_sf_" + fwd._fn.__name__)
+            conc = inst_sf.concrete_program if inst_sf else None
+            if conc is None:
+                raise RuntimeError(
+                    "layer has not been called yet — run one forward pass "
+                    "(or TracedLayer.trace) before jit.save")
+            return conc
+        raise TypeError("layer.forward is not decorated with @to_static — "
+                        "use TracedLayer.trace instead")
+    if isinstance(target, StaticFunction):
+        conc = target.concrete_program
+        if conc is None:
+            raise RuntimeError("function has not been called yet — call it "
+                               "once with example inputs before jit.save")
+        return conc
+    bound = getattr(target, "_static_function", None)
+    if bound is not None:
+        conc = bound.concrete_program
+        if conc is None:
+            raise RuntimeError("call the function once before jit.save")
+        return conc
+    raise TypeError(f"cannot jit.save a {type(target)}")
+
+
+def save(layer_or_fn, path: str):
+    """Export the traced program + current parameter values as an inference
+    model directory (reference: jit.py save → save_inference_model)."""
+    from .. import io
+    from ..core.scope import Scope
+
+    conc = _concrete_of(layer_or_fn)
+    if conc.closure_ops:
+        raise RuntimeError(
+            f"traced program contains {conc.closure_ops} ad-hoc closure op(s) "
+            f"(VarBase method calls like x.reshape()/x.sum()); these cannot "
+            f"be serialised — build the model from paddle_tpu.nn / "
+            f"dygraph layers for an exportable trace")
+    scope = Scope()
+    for name, vb in conc.param_values.items():
+        scope.set(name, vb._array)
+    io.save_inference_model(path, conc.feed_names,
+                            [conc.program.global_block().var(n)
+                             for n in conc.fetch_names],
+                            main_program=conc.program, scope=scope)
+    return path
+
+
+def load(path: str):
+    """Load an exported model as a callable (reference: jit.py load →
+    TranslatedLayer; here backed by the AnalysisPredictor)."""
+    from ..inference import AnalysisConfig, create_predictor
+
+    pred = create_predictor(AnalysisConfig(path))
+
+    def run(*arrays):
+        feeds = {n: np.asarray(a._array if isinstance(a, VarBase) else a)
+                 for n, a in zip(pred.get_input_names(), arrays)}
+        outs = pred.run(feeds)
+        return outs[0] if len(outs) == 1 else outs
+
+    run.predictor = pred
+    return run
+
+
+class TracedLayer:
+    """reference: dygraph/jit.py TracedLayer — trace a Layer once, get a
+    static callable + export handle."""
+
+    def __init__(self, conc: ConcreteProgram):
+        self._conc = conc
+
+    @staticmethod
+    def trace(layer, inputs: Sequence[Any]):
+        sf = StaticFunction(layer.forward if hasattr(layer, "forward")
+                            else layer)
+        out = sf(*inputs)
+        return out, TracedLayer(sf.concrete_program)
+
+    def __call__(self, *inputs):
+        vbs = [v if isinstance(v, VarBase) else VarBase(v) for v in inputs]
+        return self._conc(vbs)
+
+    def save_inference_model(self, path: str):
+        sf = StaticFunction(lambda: None)
+        sf._last = self._conc
+        return save(sf, path)
+
+    @property
+    def program(self) -> Program:
+        return self._conc.program

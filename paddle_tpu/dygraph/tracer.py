@@ -172,7 +172,9 @@ def trace_op(op_type: str, inputs: Dict[str, Any],
 
     if not diff_idx:
         outs = registry.normalize_outputs(forward(arr_ins, attrs))
-        return _record(None, outs)
+        out_vars = _record(None, outs)
+        _maybe_capture(op_type, norm, attrs, out_vars)
+        return out_vars
 
     def f(diff_vals):
         ins = {s: list(l) for s, l in arr_ins.items()}
@@ -186,7 +188,17 @@ def trace_op(op_type: str, inputs: Dict[str, Any],
                    for slot, vals in outs.items()}
     node = TapeNode(op_type, vjp_fn, [norm[s][i] for s, i in diff_idx],
                     out_structs)
-    return _record(node, outs)
+    out_vars = _record(node, outs)
+    _maybe_capture(op_type, norm, attrs, out_vars)
+    return out_vars
+
+
+def _maybe_capture(op_type, norm_inputs, attrs, out_vars):
+    """Record the executed op into an active @to_static capture (jit.py)."""
+    from . import jit
+
+    if jit._capture_stack:
+        jit.capture_op(op_type, norm_inputs, attrs, out_vars)
 
 
 def trace_fn(fn, *inputs: VarBase) -> VarBase:
@@ -207,6 +219,8 @@ def trace_fn(fn, *inputs: VarBase) -> VarBase:
     if not diff_idx:
         out = fn(*arrs)
         vb = VarBase(out)
+        _maybe_capture("__jax_fn__", {"X": vbs},
+                       {"fn": lambda *a, _f=fn: (_f(*a),)}, {"Out": [vb]})
         return vb
 
     def g(diff_vals):
@@ -219,7 +233,10 @@ def trace_fn(fn, *inputs: VarBase) -> VarBase:
     a = out["Out"][0]
     node = TapeNode("<fn>", vjp_fn, [vbs[i] for i in diff_idx],
                     {"Out": [(np.shape(a), np.result_type(a))]})
-    return _record(node, out)["Out"][0]
+    out_vars = _record(node, out)
+    _maybe_capture("__jax_fn__", {"X": vbs},
+                   {"fn": lambda *a, _f=fn: (_f(*a),)}, out_vars)
+    return out_vars["Out"][0]
 
 
 # ---------------------------------------------------------------------------

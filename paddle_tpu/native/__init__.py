@@ -41,17 +41,27 @@ def _build_and_load():
             h.update(f.read())
     so_path = os.path.join(_SRC_DIR, f"libpaddle_tpu_native.{h.hexdigest()[:12]}.so")
     if not os.path.exists(so_path):
+        # compile to a process-unique temp path then atomically rename so a
+        # concurrent process never CDLLs a half-written file
+        tmp_path = f"{so_path}.tmp.{os.getpid()}"
         cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
-               "-o", so_path] + srcs
+               "-o", tmp_path] + srcs
         try:
             subprocess.run(cmd, check=True, capture_output=True, text=True,
                            timeout=300)
+            os.replace(tmp_path, so_path)
         except FileNotFoundError:
             _ERR = "g++ not found"
             return
         except subprocess.CalledProcessError as e:
             _ERR = f"native build failed:\n{e.stderr[-2000:]}"
             return
+        finally:
+            if os.path.exists(tmp_path):
+                try:
+                    os.remove(tmp_path)
+                except OSError:
+                    pass
     lib = ctypes.CDLL(so_path)
     lib.ptds_create.restype = ctypes.c_void_p
     lib.ptds_create.argtypes = [ctypes.POINTER(ctypes.c_char_p),
