@@ -32,6 +32,9 @@ from .core.executor import run_startup  # noqa: F401
 from .param_attr import ParamAttr  # noqa: F401
 from . import dataset  # noqa: F401  (native-backed Dataset API)
 from .dataset import DatasetFactory, InMemoryDataset, QueueDataset  # noqa: F401
+from . import profiler  # noqa: F401
+from .core.flags import get_flags, set_flags  # noqa: F401
+from .core import monitor  # noqa: F401
 
 __version__ = "0.1.0"
 

@@ -80,7 +80,13 @@ def run_op(op: OpDesc, env: Dict[str, Any], step=None):
     if step is not None:
         attrs["__step__"] = step
     try:
-        outs = registry.normalize_outputs(opdef.forward(ins, attrs))
+        from .. import profiler as _prof
+
+        # per-op host span (reference: RecordEvent around op->Run,
+        # framework/operator.cc:195); only the interpreting path reaches
+        # here per step — under jit this runs once at trace time
+        with _prof.RecordEvent(op.type):
+            outs = registry.normalize_outputs(opdef.forward(ins, attrs))
     except ExecutionError:
         raise
     except Exception as e:  # attach op callstack (reference: op_call_stack.cc)
@@ -254,12 +260,15 @@ class Executor:
                 v = feed[n]
                 dp_ok[n] = bool(getattr(v, "ndim", 0) >= 1
                                 and v.shape[0] % dp == 0)
+        from .. import profiler as _prof
+
         key = (id(program), program.version, id(scope), feed_names,
                tuple(fetch_names), id(mesh), tuple(sorted(dp_ok.items())))
         entry = self._cache.get(key)
         if entry is None:
-            entry = self._compile(program, block, feed_names, fetch_names, scope,
-                                  mesh, in_shardings, dp_ok)
+            with _prof.RecordEvent("executor::compile"):
+                entry = self._compile(program, block, feed_names, fetch_names,
+                                      scope, mesh, in_shardings, dp_ok)
             self._cache[key] = entry
 
         state = {}
@@ -290,7 +299,21 @@ class Executor:
         if step is None:
             step = _as_device_array(0, np.int32)
 
-        fetches, new_state, new_step = entry.jitted(state, ro, feed, step)
+        with _prof.RecordEvent("executor::run"):
+            fetches, new_state, new_step = entry.jitted(state, ro, feed, step)
+        from .flags import flag as _flag
+
+        if _flag("check_nan_inf"):
+            # host-side scan, forces device sync — debug flag semantics
+            # (reference: FLAGS_check_nan_inf, nan_inf_utils_detail.cc)
+            for name, v in list(new_state.items()) + \
+                    list(zip(entry.fetch_names, fetches)):
+                arr = np.asarray(v)
+                if np.issubdtype(arr.dtype, np.floating) and \
+                        not np.all(np.isfinite(arr)):
+                    raise ExecutionError(
+                        f"NaN/Inf detected in '{name}' after executor run "
+                        f"(FLAGS_check_nan_inf)")
         for n, v in new_state.items():
             scope.set(n, v)
         scope.set("@STEP_COUNTER@", new_step)

@@ -1,0 +1,96 @@
+"""Global FLAGS registry — env-settable runtime configuration.
+
+Capability mirror of the reference's gflags tier (platform/flags.cc:33-560,
+exported to Python via global_value_getter_setter.cc + init_gflags,
+pybind.cc:1696): each flag has a default, is overridable via the
+environment (FLAGS_<name>=...) at import, and via set_flags() at runtime
+(the paddle.set_flags/get_flags API surface).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Union
+
+
+class _Flag:
+    __slots__ = ("name", "value", "default", "doc", "type")
+
+    def __init__(self, name, default, doc):
+        self.name = name
+        self.default = default
+        self.value = default
+        self.doc = doc
+        self.type = type(default)
+
+
+_REGISTRY: Dict[str, _Flag] = {}
+
+
+def _coerce(flag: _Flag, val):
+    if flag.type is bool:
+        if isinstance(val, str):
+            return val.lower() in ("1", "true", "yes", "on")
+        return bool(val)
+    return flag.type(val)
+
+
+def define_flag(name: str, default, doc: str = ""):
+    """DEFINE_bool/int/double/string equivalent (flags.cc)."""
+    flag = _Flag(name, default, doc)
+    env = os.environ.get(f"FLAGS_{name}")
+    if env is not None:
+        flag.value = _coerce(flag, env)
+    _REGISTRY[name] = flag
+    return flag
+
+
+def get_flags(flags: Union[str, List[str]]) -> Dict[str, Any]:
+    """paddle.get_flags."""
+    names = [flags] if isinstance(flags, str) else list(flags)
+    out = {}
+    for n in names:
+        key = n[6:] if n.startswith("FLAGS_") else n
+        if key not in _REGISTRY:
+            raise ValueError(f"unknown flag '{n}'")
+        out[n] = _REGISTRY[key].value
+    return out
+
+
+def set_flags(flags: Dict[str, Any]):
+    """paddle.set_flags."""
+    for n, v in flags.items():
+        key = n[6:] if n.startswith("FLAGS_") else n
+        if key not in _REGISTRY:
+            raise ValueError(f"unknown flag '{n}'")
+        f = _REGISTRY[key]
+        f.value = _coerce(f, v)
+
+
+def flag(name: str):
+    """Fast internal accessor."""
+    return _REGISTRY[name].value
+
+
+def all_flags() -> Dict[str, Any]:
+    return {n: f.value for n, f in _REGISTRY.items()}
+
+
+# -- the flag set (reference: platform/flags.cc; TPU-meaningful subset,
+#    others kept for API compat) --------------------------------------------
+
+define_flag("check_nan_inf", False,
+            "scan every fetched value and updated persistable for NaN/Inf "
+            "after each executor run (reference: flags.cc:44, "
+            "details/nan_inf_utils_detail.cc)")
+define_flag("benchmark", False, "sync + time every executor run")
+define_flag("eager_delete_tensor_gb", 0.0,
+            "GC threshold (XLA owns buffer lifetime; API compat)")
+define_flag("fraction_of_gpu_memory_to_use", 0.92,
+            "accelerator memory fraction (XLA preallocation; API compat)")
+define_flag("paddle_num_threads", 1, "intra-op host threads (API compat)")
+define_flag("use_pinned_memory", True, "host staging buffers (API compat)")
+define_flag("cudnn_deterministic", False,
+            "deterministic kernels (XLA is deterministic by default)")
+define_flag("max_inplace_grad_add", 0,
+            "grad accumulation chunking (API compat)")

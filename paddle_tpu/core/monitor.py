@@ -1,0 +1,60 @@
+"""Global stat registry — counters/gauges for observability.
+
+Capability mirror of platform/monitor.h (StatRegistry:77, STAT_ADD:130 —
+the reference tracks e.g. STAT_GPU_MEM per device). Stats here also
+surface the native runtime's counters (native/data_feed.cc mem/records).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+
+class StatRegistry:
+    _instance = None
+
+    def __init__(self):
+        self._stats: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def instance(cls) -> "StatRegistry":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def add(self, name: str, delta: int) -> int:
+        with self._lock:
+            self._stats[name] = self._stats.get(name, 0) + int(delta)
+            return self._stats[name]
+
+    def set(self, name: str, value: int):
+        with self._lock:
+            self._stats[name] = int(value)
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._stats.get(name, 0)
+
+    def stats(self) -> Dict[str, int]:
+        out = dict(self._stats)
+        # live native-runtime stats (reference: STAT_GPU_MEM analog)
+        try:
+            from .. import native
+
+            if native.loaded():
+                out["STAT_native_dataset_mem_bytes"] = native.mem_bytes()
+                out["STAT_native_records_parsed"] = native.records_parsed()
+        except Exception:
+            pass
+        return out
+
+
+def stat_add(name: str, delta: int) -> int:
+    """STAT_ADD (monitor.h:130)."""
+    return StatRegistry.instance().add(name, delta)
+
+
+def stat_get(name: str) -> int:
+    return StatRegistry.instance().get(name)

@@ -186,6 +186,9 @@ class StaticFunction:
         self._fn = fn
         self._input_spec = input_spec
         self._cache: Dict[tuple, ConcreteProgram] = {}
+        # signature tuples embed id(obj) for non-tensor args; pin those
+        # objects so CPython id reuse can never alias a stale cache entry
+        self._sig_refs: Dict[tuple, list] = {}
         self._last: Optional[ConcreteProgram] = None
         functools.update_wrapper(self, fn)
 
@@ -217,6 +220,10 @@ class StaticFunction:
         if conc is None:
             conc = self._trace(args, tensor_idx, vb_args)
             self._cache[sig] = conc
+            self._sig_refs[sig] = [
+                a for a in args
+                if not isinstance(a, (VarBase, np.ndarray, int, float, bool,
+                                      str, bytes, type(None)))]
         self._last = conc
         return conc(vb_args)
 
@@ -356,6 +363,13 @@ class TracedLayer:
 
     @staticmethod
     def trace(layer, inputs: Sequence[Any]):
+        fwd = type(layer).__dict__.get("forward") \
+            if hasattr(layer, "forward") else None
+        if isinstance(fwd, StaticFunction):
+            # forward is already @to_static: reuse its ConcreteProgram —
+            # re-wrapping would capture it as one opaque closure op
+            out = layer(*inputs)
+            return out, TracedLayer(_concrete_of(layer))
         sf = StaticFunction(layer.forward if hasattr(layer, "forward")
                             else layer)
         out = sf(*inputs)

@@ -108,6 +108,12 @@ def available() -> bool:
     return get_lib() is not None
 
 
+def loaded() -> bool:
+    """True only if the library is ALREADY loaded — never triggers a build
+    (observability readers must not block on a g++ subprocess)."""
+    return _LIB is not None
+
+
 def build_error() -> Optional[str]:
     get_lib()
     return _ERR

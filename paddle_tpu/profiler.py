@@ -1,0 +1,182 @@
+"""Profiler — host spans, summary tables, chrome-trace export, jax bridge.
+
+Capability mirror of the reference profiler stack:
+* ``RecordEvent`` RAII spans (platform/profiler.h:127; pushed per op run,
+  framework/operator.cc:195) — here a context manager feeding a global
+  event store;
+* ``start_profiler``/``stop_profiler``/``reset_profiler`` + the
+  ``profiler()`` context and sorted summary table
+  (python/paddle/fluid/profiler.py, platform/profiler.cc PrintProfiler);
+* chrome://tracing JSON export (tools/timeline.py) via
+  ``export_chrome_tracing``;
+* device-side tracing (platform/device_tracer.cc CUPTI) maps to the jax
+  profiler (XPlane/TensorBoard): ``start_trace``/``stop_trace``.
+
+The executor pushes spans automatically: per-op in the interpreting path,
+per-step (compile + run) in the compiled path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+_lock = threading.Lock()
+_enabled = False
+_events: List[dict] = []          # {name, ts, dur, tid}
+
+
+def _now_us() -> float:
+    return time.perf_counter() * 1e6
+
+
+class RecordEvent:
+    """reference: platform/profiler.h:127 — RAII span; usable as a context
+    manager or via push/pop."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._t0 = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+
+    def begin(self):
+        if _enabled:
+            self._t0 = _now_us()
+
+    def end(self):
+        if self._t0 is None:
+            return
+        dur = _now_us() - self._t0
+        with _lock:
+            _events.append({"name": self.name, "ts": self._t0, "dur": dur,
+                            "tid": threading.get_ident()})
+        self._t0 = None
+
+
+@contextlib.contextmanager
+def record_event(name: str):
+    with RecordEvent(name):
+        yield
+
+
+def is_profiler_enabled() -> bool:
+    return _enabled
+
+
+def start_profiler(state: str = "All", tracer_option: str = "Default"):
+    """reference: profiler.py start_profiler / EnableProfiler
+    (profiler.h:209). `state`/`tracer_option` kept for API parity."""
+    global _enabled
+    reset_profiler()
+    _enabled = True
+
+
+def reset_profiler():
+    with _lock:
+        _events.clear()
+
+
+def stop_profiler(sorted_key: Optional[str] = "total",
+                  profile_path: Optional[str] = None):
+    """Disable profiling, print the summary table, optionally dump the
+    chrome trace (reference: DisableProfiler + PrintProfiler)."""
+    global _enabled
+    _enabled = False
+    summary = summarize()
+    _print_summary(summary, sorted_key)
+    if profile_path:
+        export_chrome_tracing(profile_path)
+    return summary
+
+
+@contextlib.contextmanager
+def profiler(state: str = "All", sorted_key: str = "total",
+             profile_path: Optional[str] = None):
+    """with profiler.profiler(): ... (reference: fluid/profiler.py)."""
+    start_profiler(state)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+profile = profiler  # alias
+
+
+def events() -> List[dict]:
+    with _lock:
+        return list(_events)
+
+
+def summarize() -> Dict[str, dict]:
+    """Aggregate events by name → {calls, total_us, avg_us, max_us, min_us}."""
+    agg: Dict[str, dict] = {}
+    for e in events():
+        s = agg.setdefault(e["name"], {"calls": 0, "total_us": 0.0,
+                                       "max_us": 0.0, "min_us": float("inf")})
+        s["calls"] += 1
+        s["total_us"] += e["dur"]
+        s["max_us"] = max(s["max_us"], e["dur"])
+        s["min_us"] = min(s["min_us"], e["dur"])
+    for s in agg.values():
+        s["avg_us"] = s["total_us"] / s["calls"]
+    return agg
+
+
+def _print_summary(summary: Dict[str, dict], sorted_key: Optional[str]):
+    if not summary:
+        return
+    key = {"total": "total_us", "calls": "calls", "max": "max_us",
+           "min": "min_us", "ave": "avg_us", "avg": "avg_us"}.get(
+               sorted_key or "total", "total_us")
+    rows = sorted(summary.items(), key=lambda kv: kv[1][key], reverse=True)
+    print(f"{'Event':<40}{'Calls':>8}{'Total(us)':>14}{'Avg(us)':>12}"
+          f"{'Max(us)':>12}{'Min(us)':>12}")
+    for name, s in rows:
+        print(f"{name[:39]:<40}{s['calls']:>8}{s['total_us']:>14.1f}"
+              f"{s['avg_us']:>12.1f}{s['max_us']:>12.1f}{s['min_us']:>12.1f}")
+
+
+def export_chrome_tracing(path: str):
+    """chrome://tracing JSON (reference: tools/timeline.py output format)."""
+    trace = {"traceEvents": [
+        {"name": e["name"], "ph": "X", "ts": e["ts"], "dur": e["dur"],
+         "pid": 0, "tid": e["tid"], "cat": "op"}
+        for e in events()
+    ]}
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return path
+
+
+# -- device-side tracing: the jax profiler (XPlane → TensorBoard) replaces
+#    the reference's CUPTI DeviceTracer ------------------------------------
+
+def start_trace(log_dir: str):
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_trace():
+    import jax
+
+    jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    start_trace(log_dir)
+    try:
+        yield
+    finally:
+        stop_trace()

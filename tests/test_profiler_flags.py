@@ -1,0 +1,92 @@
+"""Profiler / flags / monitor tests (reference: test_profiler.py,
+test_global_var_getter_setter.py, monitor.h stats)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, profiler
+
+
+def _small_program():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4], stop_gradient=True)
+        y = layers.fc(x, 8, act="relu")
+        loss = layers.mean(y)
+        pt.optimizer.SGDOptimizer(0.1).minimize(loss)
+    return main, startup, loss
+
+
+class TestProfiler:
+    def test_records_ops_and_steps(self, scope, tmp_path):
+        main, startup, loss = _small_program()
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        x = np.ones((2, 4), np.float32)
+        trace_path = str(tmp_path / "trace.json")
+        with profiler.profiler(profile_path=trace_path):
+            exe.run(main, feed={"x": x}, fetch_list=[loss], scope=scope,
+                    use_compiled=False)         # per-op spans
+            exe.run(main, feed={"x": x}, fetch_list=[loss], scope=scope)
+        summary = profiler.summarize()
+        assert any(n in summary for n in ("mul", "matmul_v2", "fc"))
+        assert "executor::run" in summary
+        with open(trace_path) as f:
+            trace = json.load(f)
+        assert len(trace["traceEvents"]) == len(profiler.events())
+        assert all("dur" in e for e in trace["traceEvents"])
+
+    def test_disabled_records_nothing(self, scope):
+        profiler.reset_profiler()
+        main, startup, loss = _small_program()
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                fetch_list=[loss], scope=scope)
+        assert profiler.events() == []
+
+
+class TestFlags:
+    def test_get_set_roundtrip(self):
+        assert pt.get_flags("FLAGS_check_nan_inf") == \
+            {"FLAGS_check_nan_inf": False}
+        pt.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            assert pt.get_flags("check_nan_inf")["check_nan_inf"] is True
+        finally:
+            pt.set_flags({"FLAGS_check_nan_inf": False})
+
+    def test_unknown_flag_raises(self):
+        with pytest.raises(ValueError, match="unknown flag"):
+            pt.get_flags("FLAGS_no_such_flag")
+
+    def test_check_nan_inf_catches(self, scope):
+        from paddle_tpu.core.executor import ExecutionError
+
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [2], stop_gradient=True)
+            y = layers.log(x)       # log(-1) -> NaN
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        pt.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            with pytest.raises(ExecutionError, match="NaN/Inf"):
+                exe.run(main, feed={"x": -np.ones((1, 2), np.float32)},
+                        fetch_list=[y], scope=scope)
+        finally:
+            pt.set_flags({"FLAGS_check_nan_inf": False})
+
+
+class TestMonitor:
+    def test_stat_add(self):
+        from paddle_tpu.core.monitor import StatRegistry, stat_add, stat_get
+
+        stat_add("test_stat", 5)
+        stat_add("test_stat", 7)
+        assert stat_get("test_stat") == 12
+        assert StatRegistry.instance().stats()["test_stat"] == 12

@@ -150,3 +150,17 @@ class TestToStatic:
                 jit.ProgramTranslator.get_instance().enable(True)
             np.testing.assert_allclose(o2, e2, atol=1e-5)
             assert not np.allclose(o1, o2)
+
+    def test_traced_layer_on_to_static_forward(self, tmp_path):
+        """TracedLayer.trace of an @to_static model must reuse the inner
+        trace (exportable), not wrap it as one opaque closure op."""
+        with dygraph.guard():
+            m = MLP()
+            x = to_variable(np.ones((2, 8), np.float32))
+            out, traced = dygraph.TracedLayer.trace(m, [x])
+            types = [op.type for op in traced.program.global_block().ops]
+            assert "__jax_fn__" not in types
+            traced.save_inference_model(str(tmp_path / "m2"))
+        loaded = jit.load(str(tmp_path / "m2"))
+        np.testing.assert_allclose(loaded(np.ones((2, 8), np.float32)),
+                                   out.numpy(), atol=1e-5)
