@@ -814,3 +814,132 @@ def ring_attention(q, k, v, bias=None, causal=False, scale=None,
         attrs["scale"] = float(scale)
     helper.append_op("ring_attention", inputs, {"Out": [out]}, attrs)
     return out
+
+
+# -- RNN + sequence + metric layer surface (reference: layers/nn.py
+# dynamic_lstm/dynamic_gru, sequence_* wrappers, layers/metric_op.py auc) ----
+
+def lstm_unit_layer(input, hidden_size, param_attr=None, bias_attr=None,
+                    h0=None, c0=None, is_reverse=False, seq_length=None,
+                    name=None):
+    """Dense padded LSTM over [B,S,D] (the reference's dynamic_lstm with
+    LoD replaced by an optional seq_length mask — ops/rnn_ops.py)."""
+    helper = LayerHelper("lstm", name=name)
+    d = int(input.shape[-1])
+    wx = helper.create_parameter(param_attr or ParamAttr(), [d, 4 * hidden_size],
+                                 input.dtype)
+    wh = helper.create_parameter(
+        ParamAttr(name=unique_name.generate((name or "lstm") + "_wh")),
+        [hidden_size, 4 * hidden_size], input.dtype)
+    b = helper.create_parameter(bias_attr or ParamAttr(), [4 * hidden_size],
+                                input.dtype, is_bias=True)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    last_h = helper.create_variable_for_type_inference(input.dtype)
+    last_c = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"Input": [input], "WeightX": [wx], "WeightH": [wh], "Bias": [b]}
+    if h0 is not None:
+        inputs["H0"] = [h0]
+    if c0 is not None:
+        inputs["C0"] = [c0]
+    if seq_length is not None:
+        inputs["SequenceLength"] = [seq_length]
+    helper.append_op("lstm", inputs,
+                     {"Out": [out], "LastH": [last_h], "LastC": [last_c]},
+                     {"is_reverse": is_reverse})
+    return out, last_h, last_c
+
+
+def gru_layer(input, hidden_size, param_attr=None, bias_attr=None, h0=None,
+              is_reverse=False, seq_length=None, name=None):
+    """Dense padded GRU over [B,S,D] (reference: dynamic_gru)."""
+    helper = LayerHelper("gru", name=name)
+    d = int(input.shape[-1])
+    wx = helper.create_parameter(param_attr or ParamAttr(), [d, 3 * hidden_size],
+                                 input.dtype)
+    wh = helper.create_parameter(
+        ParamAttr(name=unique_name.generate((name or "gru") + "_wh")),
+        [hidden_size, 3 * hidden_size], input.dtype)
+    b = helper.create_parameter(bias_attr or ParamAttr(), [3 * hidden_size],
+                                input.dtype, is_bias=True)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    last_h = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"Input": [input], "WeightX": [wx], "WeightH": [wh], "Bias": [b]}
+    if h0 is not None:
+        inputs["H0"] = [h0]
+    if seq_length is not None:
+        inputs["SequenceLength"] = [seq_length]
+    helper.append_op("gru", inputs, {"Out": [out], "LastH": [last_h]},
+                     {"is_reverse": is_reverse})
+    return out, last_h
+
+
+def sequence_mask(x, maxlen, dtype="int64", name=None):
+    helper = LayerHelper("sequence_mask", name=name)
+    out = helper.create_variable_for_type_inference(dtype, True)
+    helper.append_op("sequence_mask", {"X": [x]}, {"Y": [out]},
+                     {"maxlen": int(maxlen), "out_dtype": dtype})
+    return out
+
+
+def sequence_pool(input, pool_type="sum", lod=None, name=None):
+    """Pool a (flat values, lod) pair per sequence; `lod` is the explicit
+    offsets tensor the dataset layer yields for lod slots."""
+    if lod is None:
+        raise ValueError(
+            "sequence_pool requires lod= (the explicit offsets tensor; LoD "
+            "travels beside values on TPU — see ops/sequence_ops.py)")
+    helper = LayerHelper("sequence_pool", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    idx = helper.create_variable_for_type_inference("int32", True)
+    helper.append_op("sequence_pool", {"X": [input], "Lod": [lod]},
+                     {"Out": [out], "MaxIndex": [idx]},
+                     {"pooltype": pool_type.upper()})
+    return out
+
+
+def cos_sim(X, Y, name=None):
+    helper = LayerHelper("cos_sim", name=name)
+    out = helper.create_variable_for_type_inference(X.dtype)
+    xn = helper.create_variable_for_type_inference(X.dtype)
+    yn = helper.create_variable_for_type_inference(X.dtype)
+    helper.append_op("cos_sim", {"X": [X], "Y": [Y]},
+                     {"Out": [out], "XNorm": [xn], "YNorm": [yn]}, {})
+    return out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    helper = LayerHelper("instance_norm", name=name)
+    c = int(input.shape[1])
+    scale = helper.create_parameter(param_attr or ParamAttr(), [c],
+                                    input.dtype,
+                                    default_initializer=Constant(1.0))
+    bias = helper.create_parameter(bias_attr or ParamAttr(), [c], input.dtype,
+                                   is_bias=True)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    sm = helper.create_variable_for_type_inference(input.dtype, True)
+    sv = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op("instance_norm",
+                     {"X": [input], "Scale": [scale], "Bias": [bias]},
+                     {"Y": [out], "SavedMean": [sm], "SavedVariance": [sv]},
+                     {"epsilon": epsilon})
+    return out
+
+
+def auc(input, label, num_thresholds=4095, name=None):
+    """Streaming AUC metric (reference: layers auc / metrics/auc_op.cc).
+    Returns (auc_value, [stat_pos, stat_neg]) — state vars accumulate."""
+    helper = LayerHelper("auc", name=name)
+    pos = create_global_var([num_thresholds + 1], 0.0, "float32",
+                            persistable=True,
+                            name=unique_name.generate("auc_stat_pos"))
+    neg = create_global_var([num_thresholds + 1], 0.0, "float32",
+                            persistable=True,
+                            name=unique_name.generate("auc_stat_neg"))
+    out = helper.create_variable_for_type_inference("float32", True)
+    helper.append_op("auc",
+                     {"Predict": [input], "Label": [label],
+                      "StatPos": [pos], "StatNeg": [neg]},
+                     {"AUC": [out], "StatPosOut": [pos], "StatNegOut": [neg]},
+                     {"num_thresholds": num_thresholds})
+    return out, [pos, neg]

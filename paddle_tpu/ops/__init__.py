@@ -31,3 +31,11 @@ try:
     from . import pipeline_ops  # noqa: F401
 except ImportError:
     pass
+try:
+    from . import extra_ops  # noqa: F401
+except ImportError:
+    pass
+try:
+    from . import rnn_ops  # noqa: F401
+except ImportError:
+    pass

@@ -1,0 +1,458 @@
+"""Extended op coverage: trig/hyperbolic math, activation zoo, tensor
+manipulation, similarity/ranking losses, instance_norm, auc metric.
+
+Capability mirror of the long tail of paddle/fluid/operators/ (activation
+ops activation_op.cc, eye/linspace/meshgrid/diag tensor factories,
+index_select/index_sample, flip/roll, cos_sim_op.cc, rank_loss_op.cc,
+margin_rank_loss_op.cc, log_loss_op.cc, bce_loss_op.cc, hinge_loss_op.cc,
+instance_norm_op.cc, l2_normalize (norm_op.cc), metrics/auc_op.cc).
+Everything lowers to jnp/lax; XLA fuses the elementwise chains.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.registry import register_op
+
+
+def _unary(name, fn_name=None, fn=None):
+    def lowering(ins, attrs, _fn=fn, _fname=fn_name):
+        import jax.numpy as jnp
+
+        x = ins["X"][0]
+        f = _fn if _fn is not None else getattr(jnp, _fname)
+        return {"Out": f(x)}
+
+    register_op(name)(lowering)
+
+
+for _n, _f in [("sin", None), ("asin", None), ("acos", None), ("atan", None),
+               ("sinh", None), ("cosh", None), ("tan", None),
+               ("expm1", None), ("log1p", None), ("log10", None),
+               ("trunc", "trunc"), ("atanh", None), ("asinh", None),
+               ("acosh", None)]:
+    _unary(_n, fn_name=_f or _n)
+
+
+@register_op("atan2")
+def atan2(ins, attrs):
+    import jax.numpy as jnp
+
+    return {"Out": jnp.arctan2(ins["X1"][0], ins["X2"][0])}
+
+
+# -- activation zoo (reference: operators/activation_op.cc) ------------------
+
+@register_op("mish")
+def mish(ins, attrs):
+    import jax
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    return {"Out": x * jnp.tanh(jax.nn.softplus(x))}
+
+
+@register_op("selu")
+def selu(ins, attrs):
+    import jax
+
+    scale = attrs.get("scale", 1.0507009873554805)
+    alpha = attrs.get("alpha", 1.6732632423543772)
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    return {"Out": scale * jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1.0))}
+
+
+@register_op("celu")
+def celu(ins, attrs):
+    import jax.numpy as jnp
+
+    a = attrs.get("alpha", 1.0)
+    x = ins["X"][0]
+    return {"Out": jnp.where(x > 0, x, a * (jnp.exp(x / a) - 1.0))}
+
+
+@register_op("brelu")
+def brelu(ins, attrs):
+    import jax.numpy as jnp
+
+    t_min = attrs.get("t_min", 0.0)
+    t_max = attrs.get("t_max", 24.0)
+    return {"Out": jnp.clip(ins["X"][0], t_min, t_max)}
+
+
+@register_op("thresholded_relu")
+def thresholded_relu(ins, attrs):
+    import jax.numpy as jnp
+
+    th = attrs.get("threshold", 1.0)
+    x = ins["X"][0]
+    return {"Out": jnp.where(x > th, x, 0.0).astype(x.dtype)}
+
+
+@register_op("tanh_shrink")
+def tanh_shrink(ins, attrs):
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    return {"Out": x - jnp.tanh(x)}
+
+
+@register_op("softshrink")
+def softshrink(ins, attrs):
+    import jax.numpy as jnp
+
+    lam = attrs.get("lambda", 0.5)
+    x = ins["X"][0]
+    return {"Out": jnp.where(x > lam, x - lam,
+                             jnp.where(x < -lam, x + lam, 0.0)).astype(x.dtype)}
+
+
+@register_op("hard_shrink")
+def hard_shrink(ins, attrs):
+    import jax.numpy as jnp
+
+    th = attrs.get("threshold", 0.5)
+    x = ins["X"][0]
+    return {"Out": jnp.where(jnp.abs(x) > th, x, 0.0).astype(x.dtype)}
+
+
+@register_op("stanh")
+def stanh(ins, attrs):
+    import jax.numpy as jnp
+
+    a = attrs.get("scale_a", 0.67)
+    b = attrs.get("scale_b", 1.7159)
+    return {"Out": b * jnp.tanh(a * ins["X"][0])}
+
+
+# -- tensor factories / manipulation ----------------------------------------
+
+@register_op("eye")
+def eye(ins, attrs):
+    import jax.numpy as jnp
+
+    from ..core.types import convert_dtype
+
+    n = int(attrs["num_rows"])
+    m = int(attrs.get("num_columns", -1))
+    m = n if m < 0 else m
+    return {"Out": jnp.eye(n, m, dtype=convert_dtype(attrs.get("dtype", 5)))}
+
+
+@register_op("linspace", non_diff_inputs=("Start", "Stop", "Num"))
+def linspace(ins, attrs):
+    import jax.numpy as jnp
+
+    start = ins["Start"][0].reshape(())
+    stop = ins["Stop"][0].reshape(())
+    num = attrs.get("num")
+    if num is None:
+        raise ValueError(
+            "linspace on TPU needs a static `num` attr (a traced Num "
+            "tensor would be a dynamic output shape)")
+    return {"Out": jnp.linspace(start, stop, int(num))}
+
+
+@register_op("meshgrid")
+def meshgrid(ins, attrs):
+    import jax.numpy as jnp
+
+    outs = jnp.meshgrid(*ins["X"], indexing="ij")
+    return {"Out": list(outs)}
+
+
+@register_op("diag_v2")
+def diag_v2(ins, attrs):
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    off = int(attrs.get("offset", 0))
+    if x.ndim == 1:
+        out = jnp.diag(x, k=off)
+        pad = attrs.get("padding_value", 0.0)
+        if pad:
+            mask = jnp.diag(jnp.ones_like(x), k=off) > 0
+            out = jnp.where(mask, out, pad).astype(x.dtype)
+        return {"Out": out}
+    return {"Out": jnp.diagonal(x, offset=off)}
+
+
+@register_op("index_select", non_diff_inputs=("Index",))
+def index_select(ins, attrs):
+    import jax.numpy as jnp
+
+    x, idx = ins["X"][0], ins["Index"][0]
+    return {"Out": jnp.take(x, idx.astype(jnp.int32),
+                            axis=int(attrs.get("dim", 0)))}
+
+
+@register_op("index_sample", non_diff_inputs=("Index",))
+def index_sample(ins, attrs):
+    import jax.numpy as jnp
+
+    x, idx = ins["X"][0], ins["Index"][0]
+    return {"Out": jnp.take_along_axis(x, idx.astype(jnp.int32), axis=1)}
+
+
+@register_op("flip")
+def flip(ins, attrs):
+    import jax.numpy as jnp
+
+    axes = attrs.get("axis", [0])
+    return {"Out": jnp.flip(ins["X"][0], axis=tuple(axes))}
+
+
+@register_op("roll")
+def roll(ins, attrs):
+    import jax.numpy as jnp
+
+    shifts = attrs.get("shifts", [0])
+    axes = attrs.get("axis", None)
+    x = ins["X"][0]
+    if axes in (None, []):
+        return {"Out": jnp.roll(x.reshape(-1),
+                                shifts[0]).reshape(x.shape)}
+    return {"Out": jnp.roll(x, tuple(shifts), axis=tuple(axes))}
+
+
+@register_op("broadcast_to")
+@register_op("expand_as_v2")
+def broadcast_to(ins, attrs):
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    shape = attrs.get("shape") or attrs.get("target_shape")
+    if shape is None and ins.get("Y"):
+        shape = np.shape(ins["Y"][0])
+    return {"Out": jnp.broadcast_to(x, tuple(int(s) for s in shape))}
+
+
+@register_op("unbind")
+def unbind(ins, attrs):
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    axis = int(attrs.get("axis", 0))
+    n = x.shape[axis]
+    return {"Out": [jnp.squeeze(a, axis)
+                    for a in jnp.split(x, n, axis=axis)]}
+
+
+@register_op("kron")
+def kron(ins, attrs):
+    import jax.numpy as jnp
+
+    return {"Out": jnp.kron(ins["X"][0], ins["Y"][0])}
+
+
+@register_op("take_along_axis", non_diff_inputs=("Index",))
+def take_along_axis(ins, attrs):
+    import jax.numpy as jnp
+
+    x, idx = ins["Input"][0], ins["Index"][0]
+    return {"Result": jnp.take_along_axis(x, idx.astype(jnp.int32),
+                                          axis=int(attrs.get("Axis", 0)))}
+
+
+@register_op("put_along_axis", non_diff_inputs=("Index",))
+def put_along_axis(ins, attrs):
+    import jax.numpy as jnp
+
+    x, idx, v = ins["Input"][0], ins["Index"][0], ins["Value"][0]
+    axis = int(attrs.get("Axis", 0))
+    reduce = attrs.get("Reduce", "assign")
+    idx = idx.astype(jnp.int32)
+    if reduce == "add":
+        # scatter-add along axis
+        dnums_x = jnp.indices(idx.shape)
+        index_list = list(dnums_x)
+        index_list[axis] = idx
+        return {"Result": x.at[tuple(index_list)].add(v)}
+    dnums_x = jnp.indices(idx.shape)
+    index_list = list(dnums_x)
+    index_list[axis] = idx
+    return {"Result": x.at[tuple(index_list)].set(
+        jnp.broadcast_to(v, idx.shape))}
+
+
+# -- similarity / ranking / regression losses --------------------------------
+
+@register_op("cos_sim")
+def cos_sim(ins, attrs):
+    """reference: operators/cos_sim_op.cc — row-wise cosine similarity."""
+    import jax.numpy as jnp
+
+    x, y = ins["X"][0], ins["Y"][0]
+    xf = x.astype(jnp.float32)
+    yf = jnp.broadcast_to(y, x.shape).astype(jnp.float32)
+    xn = jnp.sqrt(jnp.sum(xf * xf, axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(yf * yf, axis=-1, keepdims=True))
+    out = jnp.sum(xf * yf, axis=-1, keepdims=True) / \
+        jnp.maximum(xn * yn, 1e-12)
+    return {"Out": out.astype(x.dtype), "XNorm": xn, "YNorm": yn}
+
+
+@register_op("dist")
+def dist(ins, attrs):
+    import jax.numpy as jnp
+
+    x, y = ins["X"][0], ins["Y"][0]
+    p = float(attrs.get("p", 2.0))
+    d = (x - y).reshape(-1).astype(jnp.float32)
+    if p == float("inf"):
+        out = jnp.max(jnp.abs(d))
+    elif p == 0:
+        out = jnp.sum(d != 0).astype(jnp.float32)
+    else:
+        out = jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+    return {"Out": out.reshape(())}
+
+
+@register_op("log_loss", non_diff_inputs=("Labels",))
+def log_loss(ins, attrs):
+    import jax.numpy as jnp
+
+    pred, label = ins["Predicted"][0], ins["Labels"][0]
+    eps = attrs.get("epsilon", 1e-4)
+    out = -label * jnp.log(pred + eps) - \
+        (1.0 - label) * jnp.log(1.0 - pred + eps)
+    return {"Loss": out}
+
+
+@register_op("bce_loss", non_diff_inputs=("Label",))
+def bce_loss(ins, attrs):
+    import jax.numpy as jnp
+
+    x, label = ins["X"][0], ins["Label"][0]
+    xf = jnp.clip(x.astype(jnp.float32), 1e-12, 1.0 - 1e-7)
+    out = -(label * jnp.log(xf) + (1.0 - label) * jnp.log(1.0 - xf))
+    return {"Out": out.astype(x.dtype)}
+
+
+@register_op("hinge_loss", non_diff_inputs=("Labels",))
+def hinge_loss(ins, attrs):
+    import jax.numpy as jnp
+
+    logits, label = ins["Logits"][0], ins["Labels"][0]
+    signed = 2.0 * label - 1.0
+    return {"Loss": jnp.maximum(0.0, 1.0 - signed * logits)}
+
+
+@register_op("rank_loss", non_diff_inputs=("Label",))
+def rank_loss(ins, attrs):
+    import jax
+    import jax.numpy as jnp
+
+    label = ins["Label"][0]
+    left, right = ins["Left"][0], ins["Right"][0]
+    d = left - right
+    return {"Out": jax.nn.softplus(d) - label * d}
+
+
+@register_op("margin_rank_loss", non_diff_inputs=("Label",))
+def margin_rank_loss(ins, attrs):
+    import jax.numpy as jnp
+
+    label = ins["Label"][0]
+    x1, x2 = ins["X1"][0], ins["X2"][0]
+    margin = attrs.get("margin", 0.0)
+    out = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    return {"Out": out, "Activated": (out > 0).astype(x1.dtype)}
+
+
+@register_op("nll_loss", non_diff_inputs=("Label",))
+def nll_loss(ins, attrs):
+    import jax.numpy as jnp
+
+    x, label = ins["X"][0], ins["Label"][0]
+    reduction = attrs.get("reduction", "mean")
+    picked = -jnp.take_along_axis(
+        x, label.astype(jnp.int32).reshape(-1, 1), axis=1)[:, 0]
+    total_w = jnp.asarray(picked.size, jnp.float32)
+    if reduction == "mean":
+        out = jnp.mean(picked)
+    elif reduction == "sum":
+        out = jnp.sum(picked)
+    else:
+        out = picked
+    return {"Out": out, "Total_weight": total_w}
+
+
+# -- norms -------------------------------------------------------------------
+
+@register_op("instance_norm")
+def instance_norm(ins, attrs):
+    """reference: operators/instance_norm_op.cc — per-(N,C) spatial norm."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    scale = ins["Scale"][0] if ins.get("Scale") and ins["Scale"][0] is not None else None
+    bias = ins["Bias"][0] if ins.get("Bias") and ins["Bias"][0] is not None else None
+    eps = attrs.get("epsilon", 1e-5)
+    axes = tuple(range(2, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    rstd = 1.0 / jnp.sqrt(var + eps)
+    y = (xf - mean) * rstd
+    bshape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    if scale is not None:
+        y = y * scale.reshape(bshape)
+    if bias is not None:
+        y = y + bias.reshape(bshape)
+    n, c = x.shape[0], x.shape[1]
+    return {"Y": y.astype(x.dtype),
+            "SavedMean": mean.reshape(n, c),
+            "SavedVariance": rstd.reshape(n, c)}
+
+
+@register_op("norm")
+def norm(ins, attrs):
+    """l2_normalize (reference: operators/norm_op.cc)."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    axis = int(attrs.get("axis", -1))
+    eps = attrs.get("epsilon", 1e-10)
+    nrm = jnp.sqrt(jnp.sum(jnp.square(x.astype(jnp.float32)), axis=axis,
+                           keepdims=True) + eps)
+    return {"Out": (x / nrm).astype(x.dtype), "Norm": nrm}
+
+
+# -- metrics -----------------------------------------------------------------
+
+@register_op("auc", non_diff_inputs=("Predict", "Label", "StatPos", "StatNeg"))
+def auc(ins, attrs):
+    """Streaming ROC AUC (reference: operators/metrics/auc_op.cc): histogram
+    positives/negatives over `num_thresholds` buckets; state accumulates
+    across steps through the StatPos/StatNeg vars (in-place threading)."""
+    import jax.numpy as jnp
+
+    pred = ins["Predict"][0]          # [N, 2] (prob of class 1 in col 1)
+    label = ins["Label"][0].reshape(-1)
+    stat_pos = ins["StatPos"][0]
+    stat_neg = ins["StatNeg"][0]
+    num_t = int(attrs.get("num_thresholds", 4095))
+
+    p1 = pred[:, -1]
+    bucket = jnp.clip((p1 * num_t).astype(jnp.int32), 0, num_t)
+    is_pos = (label > 0).astype(stat_pos.dtype)
+    pos_hist = jnp.zeros_like(stat_pos).at[bucket].add(is_pos)
+    neg_hist = jnp.zeros_like(stat_neg).at[bucket].add(1.0 - is_pos)
+    stat_pos = stat_pos + pos_hist
+    stat_neg = stat_neg + neg_hist
+
+    # AUC from histograms: sum over buckets (descending threshold) of
+    # trapezoid areas
+    tot_pos = jnp.cumsum(stat_pos[::-1])
+    tot_neg = jnp.cumsum(stat_neg[::-1])
+    area = jnp.sum((tot_neg - jnp.concatenate([jnp.zeros(1), tot_neg[:-1]]))
+                   * (jnp.concatenate([jnp.zeros(1), tot_pos[:-1]])
+                      + tot_pos) / 2.0)
+    denom = tot_pos[-1] * tot_neg[-1]
+    auc_val = jnp.where(denom > 0, area / jnp.maximum(denom, 1.0), 0.0)
+    return {"AUC": auc_val.astype(jnp.float32).reshape(()),
+            "StatPosOut": stat_pos, "StatNegOut": stat_neg}

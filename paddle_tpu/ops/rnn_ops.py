@@ -1,0 +1,121 @@
+"""RNN ops — LSTM/GRU as lax.scan over the sequence axis.
+
+Capability mirror of the reference's recurrent stack (operators/lstm_op.cc,
+gru_op.cc, math/lstm_compute, gru_compute; the LoD-batched `dynamic_lstm`
+surface). TPU re-design: dense padded batches [B, S, D] + a length mask
+(XLA needs static shapes — LoD packing becomes mask semantics), the time
+loop is `lax.scan` (compiled once, no per-step dispatch), gates evaluate
+as one fused [B, 4H] matmul per step on the MXU.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.registry import register_op
+
+
+@register_op("lstm", non_diff_inputs=("SequenceLength",))
+def lstm(ins, attrs):
+    """Inputs: Input [B,S,D], WeightX [D,4H], WeightH [H,4H], Bias [4H],
+    optional H0/C0 [B,H], optional SequenceLength [B] int.
+    Outputs: Out [B,S,H], LastH [B,H], LastC [B,H].
+    Gate order: i, f, c(cand), o (paddle math/lstm_compute order ifco)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    x = ins["Input"][0]
+    wx, wh = ins["WeightX"][0], ins["WeightH"][0]
+    bias = ins["Bias"][0] if ins.get("Bias") and ins["Bias"][0] is not None \
+        else None
+    b, s, d = x.shape
+    h_size = wh.shape[0]
+    h0 = ins["H0"][0] if ins.get("H0") and ins["H0"][0] is not None else \
+        jnp.zeros((b, h_size), x.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") and ins["C0"][0] is not None else \
+        jnp.zeros((b, h_size), x.dtype)
+    seq_len = None
+    if ins.get("SequenceLength") and ins["SequenceLength"][0] is not None:
+        seq_len = ins["SequenceLength"][0].reshape(-1)
+    reverse = bool(attrs.get("is_reverse", False))
+
+    xs = jnp.swapaxes(x, 0, 1)                      # [S, B, D]
+    if reverse:
+        xs = xs[::-1]
+    x_proj = jnp.einsum("sbd,dh->sbh", xs, wx)      # [S, B, 4H]
+    if bias is not None:
+        x_proj = x_proj + bias
+
+    def step(carry, inp):
+        h, c = carry
+        xp, t = inp
+        gates = xp + h @ wh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        if seq_len is not None:
+            # frozen past each row's length (padded steps keep state)
+            tt = (s - 1 - t) if reverse else t
+            alive = (tt < seq_len)[:, None]
+            h_new = jnp.where(alive, h_new, h)
+            c_new = jnp.where(alive, c_new, c)
+        return (h_new, c_new), h_new
+
+    (h_last, c_last), hs = lax.scan(step, (h0, c0),
+                                    (x_proj, jnp.arange(s)))
+    if reverse:
+        hs = hs[::-1]
+    return {"Out": jnp.swapaxes(hs, 0, 1), "LastH": h_last, "LastC": c_last}
+
+
+@register_op("gru", non_diff_inputs=("SequenceLength",))
+def gru(ins, attrs):
+    """Inputs: Input [B,S,D], WeightX [D,3H], WeightH [H,3H], Bias [3H].
+    Gate order: u(update), r(reset), c(candidate) — paddle gru_compute."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    x = ins["Input"][0]
+    wx, wh = ins["WeightX"][0], ins["WeightH"][0]
+    bias = ins["Bias"][0] if ins.get("Bias") and ins["Bias"][0] is not None \
+        else None
+    b, s, d = x.shape
+    h_size = wh.shape[0]
+    h0 = ins["H0"][0] if ins.get("H0") and ins["H0"][0] is not None else \
+        jnp.zeros((b, h_size), x.dtype)
+    seq_len = None
+    if ins.get("SequenceLength") and ins["SequenceLength"][0] is not None:
+        seq_len = ins["SequenceLength"][0].reshape(-1)
+    reverse = bool(attrs.get("is_reverse", False))
+
+    xs = jnp.swapaxes(x, 0, 1)
+    if reverse:
+        xs = xs[::-1]
+    x_proj = jnp.einsum("sbd,dh->sbh", xs, wx)
+    if bias is not None:
+        x_proj = x_proj + bias
+
+    wh_ur = wh[:, :2 * h_size]
+    wh_c = wh[:, 2 * h_size:]
+
+    def step(carry, inp):
+        h = carry
+        xp, t = inp
+        ur = jax.nn.sigmoid(xp[:, :2 * h_size] + h @ wh_ur)
+        u, r = jnp.split(ur, 2, axis=-1)
+        cand = jnp.tanh(xp[:, 2 * h_size:] + (r * h) @ wh_c)
+        h_new = u * h + (1.0 - u) * cand
+        if seq_len is not None:
+            tt = (s - 1 - t) if reverse else t
+            alive = (tt < seq_len)[:, None]
+            h_new = jnp.where(alive, h_new, h)
+        return h_new, h_new
+
+    h_last, hs = lax.scan(step, h0, (x_proj, jnp.arange(s)))
+    if reverse:
+        hs = hs[::-1]
+    return {"Out": jnp.swapaxes(hs, 0, 1), "LastH": h_last}

@@ -1,0 +1,171 @@
+"""Sequence ops — LoD semantics on static shapes.
+
+Capability mirror of paddle/fluid/operators/sequence_ops/ (sequence_mask,
+sequence_pad/unpad, sequence_pool, sequence_expand, sequence_softmax,
+sequence_reverse). The reference threads LoD offsets inside LoDTensor
+(lod_tensor.h:114); XLA needs static shapes, so here sequences travel as
+(padded values, explicit Length/LoD tensors) — the dataset layer
+(dataset.py / native/data_feed.cc) produces exactly that pair.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.registry import register_op
+
+
+
+def _segment_ids(lod, t):
+    """Segment id per flat row from LoD offsets: O(T log B) searchsorted
+    (shared by pool/softmax/reverse — not an O(T*B) comparison matrix)."""
+    import jax.numpy as jnp
+
+    return jnp.searchsorted(lod[1:], jnp.arange(t), side="right")
+
+
+@register_op("sequence_mask", non_diff_inputs=("X",))
+def sequence_mask(ins, attrs):
+    """lengths [B] → mask [B, maxlen] (reference:
+    sequence_ops/sequence_mask_op.cc). maxlen must be static (attr)."""
+    import jax.numpy as jnp
+
+    from ..core.types import convert_dtype
+
+    lengths = ins["X"][0].reshape(-1)
+    maxlen = int(attrs.get("maxlen", -1))
+    if maxlen <= 0:
+        raise ValueError("sequence_mask on TPU needs a static maxlen attr")
+    dtype = convert_dtype(attrs.get("out_dtype", "int64"))
+    steps = jnp.arange(maxlen)
+    return {"Y": (steps[None, :] < lengths[:, None]).astype(dtype)}
+
+
+@register_op("sequence_pad", non_diff_inputs=("Lod", "PadValue"))
+def sequence_pad(ins, attrs):
+    """(flat values [T, ...], lod offsets [B+1]) → padded [B, maxlen, ...]
+    (reference: sequence_ops/sequence_pad_op.cc). padded_length static."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    lod = ins["Lod"][0].reshape(-1).astype(jnp.int32)
+    maxlen = int(attrs.get("padded_length", -1))
+    if maxlen <= 0:
+        raise ValueError("sequence_pad on TPU needs a static padded_length")
+    pad_val = 0.0
+    if ins.get("PadValue") and ins["PadValue"][0] is not None:
+        pad_val = ins["PadValue"][0].reshape(())
+    b = lod.shape[0] - 1
+    starts = lod[:-1]
+    lengths = lod[1:] - starts
+    # gather row t of sequence i from x[starts[i] + t] (clamped), then mask
+    t_idx = jnp.arange(maxlen)
+    gather_idx = starts[:, None] + jnp.minimum(
+        t_idx[None, :], jnp.maximum(lengths[:, None] - 1, 0))
+    padded = x[gather_idx.reshape(-1)].reshape((b, maxlen) + x.shape[1:])
+    mask = (t_idx[None, :] < lengths[:, None])
+    mask = mask.reshape(mask.shape + (1,) * (x.ndim - 1))
+    padded = jnp.where(mask, padded, jnp.asarray(pad_val, x.dtype))
+    return {"Out": padded, "Length": lengths.astype(jnp.int32)}
+
+
+@register_op("sequence_unpad", non_diff_inputs=("Length",))
+def sequence_unpad(ins, attrs):
+    """Padded [B, S, ...] + lengths → flat values with padded tail rows
+    zeroed and moved to the end (static-shape stand-in for ragged unpad:
+    the flat size stays B*S; consumers use Length)."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    b, s = x.shape[0], x.shape[1]
+    return {"Out": x.reshape((b * s,) + x.shape[2:])}
+
+
+@register_op("sequence_pool", non_diff_inputs=("Lod",))
+def sequence_pool(ins, attrs):
+    """Pool within each sequence of a (flat values, lod) pair (reference:
+    sequence_ops/sequence_pool_op.cc; pooltype SUM/MEAN/MAX/SQRT/LAST/
+    FIRST). Uses segment reductions — static output [B, ...]."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    lod = ins["Lod"][0].reshape(-1).astype(jnp.int32)
+    ptype = attrs.get("pooltype", "SUM").upper()
+    b = lod.shape[0] - 1
+    t = x.shape[0]
+    if ptype == "LAST":
+        out = x[jnp.maximum(lod[1:] - 1, 0)]
+    elif ptype == "FIRST":
+        out = x[lod[:-1]]
+    elif ptype == "MAX":
+        seg = _segment_ids(lod, t)
+        out = jax.ops.segment_max(x, seg, num_segments=b)
+        out = jnp.where(jnp.isfinite(out), out, 0.0).astype(x.dtype)
+    else:
+        seg = _segment_ids(lod, t)
+        summed = jax.ops.segment_sum(x, seg, num_segments=b)
+        lengths = (lod[1:] - lod[:-1]).astype(jnp.float32)
+        lengths = jnp.maximum(lengths, 1.0)
+        lshape = (b,) + (1,) * (x.ndim - 1)
+        if ptype in ("MEAN", "AVERAGE"):
+            out = (summed / lengths.reshape(lshape)).astype(x.dtype)
+        elif ptype == "SQRT":
+            out = (summed / jnp.sqrt(lengths).reshape(lshape)).astype(x.dtype)
+        else:
+            out = summed.astype(x.dtype)
+    return {"Out": out, "MaxIndex": jnp.zeros((b,), jnp.int32)}
+
+
+@register_op("sequence_softmax", non_diff_inputs=("Lod",))
+def sequence_softmax(ins, attrs):
+    """Softmax within each sequence of a flat (values [T], lod) pair."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ins["X"][0].reshape(-1)
+    lod = ins["Lod"][0].reshape(-1).astype(jnp.int32)
+    b = lod.shape[0] - 1
+    t = x.shape[0]
+    seg = _segment_ids(lod, t)
+    seg_max = jax.ops.segment_max(x, seg, num_segments=b)
+    z = jnp.exp(x - seg_max[seg])
+    denom = jax.ops.segment_sum(z, seg, num_segments=b)
+    return {"Out": (z / denom[seg]).reshape(ins["X"][0].shape)}
+
+
+@register_op("sequence_reverse", non_diff_inputs=("Lod",))
+def sequence_reverse(ins, attrs):
+    """Reverse rows within each sequence (reference:
+    sequence_ops/sequence_reverse_op.cc)."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    lod = ins["Lod"][0].reshape(-1).astype(jnp.int32)
+    t = x.shape[0]
+    seg = _segment_ids(lod, t)
+    starts = lod[:-1][seg]
+    ends = lod[1:][seg]
+    pos = jnp.arange(t)
+    rev_idx = starts + (ends - 1 - pos)
+    rev_idx = jnp.where((pos >= starts) & (pos < ends), rev_idx, pos)
+    return {"Y": x[rev_idx]}
+
+
+@register_op("sequence_expand", non_diff_inputs=("Y", "Lod", "RefLod"))
+def sequence_expand(ins, attrs):
+    """Repeat each sequence of X per the reference LoD's repeat counts —
+    static-shape variant: ref lod must yield a fixed total (reference:
+    sequence_ops/sequence_expand_op.cc)."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    ref_lod = ins["RefLod"][0].reshape(-1).astype(jnp.int32)
+    # row i of x repeats (ref_lod[i+1]-ref_lod[i]) times; total is the
+    # ref lod's last offset, which must be static → use x rows via gather
+    total = int(attrs.get("out_rows", -1))
+    if total <= 0:
+        raise ValueError("sequence_expand on TPU needs static out_rows attr")
+    pos = jnp.arange(total)
+    seg = jnp.searchsorted(ref_lod[1:], pos, side="right")
+    return {"Out": x[seg]}

@@ -32,16 +32,22 @@ def scope():
 
 @pytest.fixture(autouse=True)
 def _fresh_programs():
-    """Give every test fresh default programs + a fresh name generator."""
+    """Give every test fresh default programs + a fresh name generator,
+    and clear any process-global mesh a test installed (a leaked mesh
+    makes later single-device tests shard their feeds)."""
     import paddle_tpu as pt
     from paddle_tpu.core import ir, unique_name
+    from paddle_tpu.parallel import mesh as mesh_mod
 
     old_main, old_startup = ir._main_program, ir._startup_program
     ir._main_program, ir._startup_program = ir.Program(), ir.Program()
     old_gen = unique_name.switch()
+    old_mesh = mesh_mod._current_mesh
+    mesh_mod._current_mesh = None
     yield
     unique_name.switch(old_gen)
     ir._main_program, ir._startup_program = old_main, old_startup
+    mesh_mod._current_mesh = old_mesh
 
 
 def rand(*shape, dtype=np.float32, seed=None):

@@ -1,0 +1,156 @@
+"""OpTest harness — numpy-reference forward + numeric-gradient checks.
+
+Capability mirror of the reference's op-test workhorse
+(python/paddle/fluid/tests/unittests/op_test.py:184 OpTest,
+check_output_with_place:979, check_grad_with_place:1299): a subclass
+declares op_type/inputs/attrs and numpy-computed expected outputs;
+check_output runs the single op through BOTH executors (interpreting
+oracle and compiled) and compares; check_grad compares the analytic
+gradient (program-level append_backward over the op's grad maker) against
+central-difference numeric gradients.
+"""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.core import ir, unique_name
+from paddle_tpu.core.ir import Program
+
+
+class OpTest:
+    op_type: str = ""
+
+    # subclasses set in setup(): inputs / attrs / outputs
+    def setup(self):
+        raise NotImplementedError
+
+    # -- helpers -------------------------------------------------------------
+    def _norm_io(self, io):
+        """{slot: arr | [(name, arr), ...]} → {slot: [(name, arr), ...]}"""
+        norm = {}
+        for slot, v in io.items():
+            if isinstance(v, list) and v and isinstance(v[0], tuple):
+                norm[slot] = [(n, np.asarray(a)) for n, a in v]
+            else:
+                norm[slot] = [(f"{slot}", np.asarray(v))]
+        return norm
+
+    def _build(self):
+        self.setup()
+        ins = self._norm_io(self.inputs)
+        outs = self._norm_io(getattr(self, "outputs", {}))
+        attrs = dict(getattr(self, "attrs", {}))
+
+        ir._main_program, ir._startup_program = Program(), Program()
+        unique_name.switch()
+        main = ir._main_program
+        block = main.global_block()
+        feed = {}
+        input_names = {}
+        for slot, pairs in ins.items():
+            names = []
+            for name, arr in pairs:
+                vname = f"{self.op_type}_{name}"
+                block.create_var(name=vname, shape=list(arr.shape),
+                                 dtype=str(arr.dtype))
+                feed[vname] = arr
+                names.append(vname)
+            input_names[slot] = names
+        output_names = {}
+        expected = {}
+        for slot, pairs in outs.items():
+            names = []
+            for name, arr in pairs:
+                vname = f"{self.op_type}_out_{name}"
+                block.create_var(name=vname, shape=list(arr.shape),
+                                 dtype=str(arr.dtype))
+                expected[vname] = arr
+                names.append(vname)
+            output_names[slot] = names
+        block.append_op(self.op_type, input_names, output_names, attrs)
+        return main, feed, expected, input_names, output_names
+
+    # -- checks --------------------------------------------------------------
+    def check_output(self, atol=1e-5, rtol=1e-5, no_check_set=()):
+        main, feed, expected, _, _ = self._build()
+        fetch = [n for n in expected if not any(s in n for s in no_check_set)]
+        for use_compiled in (False, True):
+            exe = pt.Executor()
+            got = exe.run(main, feed=dict(feed), fetch_list=fetch,
+                          scope=pt.Scope(), use_compiled=use_compiled)
+            for name, val in zip(fetch, got):
+                want = expected[name]
+                np.testing.assert_allclose(
+                    np.asarray(val, dtype=want.dtype), want, atol=atol,
+                    rtol=rtol,
+                    err_msg=f"{self.op_type}.{name} "
+                            f"(compiled={use_compiled})")
+
+    def check_grad(self, inputs_to_check, output_name,
+                   max_relative_error=0.005, delta=5e-3, atol=2e-4):
+        """Analytic (grad-op) vs central-difference numeric gradient of
+        sum(output) wrt each input in inputs_to_check."""
+        main, feed, expected, input_names, output_names = self._build()
+        out_var = None
+        for slot, names in output_names.items():
+            for n in names:
+                if n.endswith(output_name) or slot == output_name:
+                    out_var = n
+        assert out_var is not None, f"no output '{output_name}'"
+
+        block = main.global_block()
+        loss = block.create_var(name="optest_loss", shape=[], dtype="float32")
+        block.append_op("reduce_sum", {"X": [out_var]},
+                        {"Out": ["optest_loss"]}, {"reduce_all": True})
+        from paddle_tpu.core.backward import gradients
+
+        target_names = []
+        for want in inputs_to_check:
+            found = None
+            for slot, names in input_names.items():
+                for n in names:
+                    if n.endswith(want) or slot == want:
+                        found = n
+            assert found is not None, f"no input '{want}'"
+            target_names.append(found)
+        grad_vars = gradients([block.var("optest_loss")],
+                              [block.var(n) for n in target_names])
+        exe = pt.Executor()
+        analytic = exe.run(main, feed=dict(feed),
+                           fetch_list=[g.name for g in grad_vars],
+                           scope=pt.Scope())
+
+        # numeric: rerun the forward with perturbed inputs
+        base_main, base_feed, _, _, _ = self._build()
+
+        def f(feed_over):
+            exe2 = pt.Executor()
+            out, = exe2.run(base_main, feed=feed_over, fetch_list=[out_var],
+                            scope=pt.Scope(), use_compiled=False)
+            return float(np.sum(np.asarray(out, np.float64)))
+
+        for tname, g_an in zip(target_names, analytic):
+            arr = np.asarray(base_feed[tname], np.float64)
+            g_num = np.zeros_like(arr)
+            flat = arr.reshape(-1)
+            gflat = g_num.reshape(-1)
+            for i in range(flat.size):
+                orig = flat[i]
+                fo = dict(base_feed)
+                pert = arr.copy().reshape(-1)
+                pert[i] = orig + delta
+                fo[tname] = pert.reshape(arr.shape).astype(
+                    base_feed[tname].dtype)
+                up = f(fo)
+                pert[i] = orig - delta
+                fo[tname] = pert.reshape(arr.shape).astype(
+                    base_feed[tname].dtype)
+                down = f(fo)
+                gflat[i] = (up - down) / (2 * delta)
+            g_an = np.asarray(g_an, np.float64).reshape(g_num.shape)
+            denom = np.maximum(np.abs(g_num), 1.0)
+            rel = np.abs(g_an - g_num) / denom
+            assert rel.max() <= max_relative_error or \
+                np.abs(g_an - g_num).max() <= atol, (
+                    f"{self.op_type} grad wrt {tname}: max rel err "
+                    f"{rel.max():.5f} (abs {np.abs(g_an - g_num).max():.6f})")

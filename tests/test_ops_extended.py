@@ -1,0 +1,295 @@
+"""Extended op tests via the OpTest harness (numpy reference + numeric
+gradients) — the reference's test_*_op.py methodology (op_test.py:184)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _r(*shape, seed=0, scale=1.0):
+    return (np.random.RandomState(seed).randn(*shape) * scale).astype(
+        np.float32)
+
+
+class TestMatmulOp(OpTest):
+    op_type = "matmul"
+
+    def setup(self):
+        x, y = _r(2, 3, seed=1), _r(3, 4, seed=2)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"alpha": 2.0}
+        self.outputs = {"Out": 2.0 * (x @ y)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestLayerNormOp(OpTest):
+    op_type = "layer_norm"
+
+    def setup(self):
+        x = _r(3, 8, seed=3)
+        s, b = _r(8, seed=4), _r(8, seed=5)
+        mean = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        y = (x - mean) / np.sqrt(var + 1e-5) * s + b
+        self.inputs = {"X": x, "Scale": s, "Bias": b}
+        self.attrs = {"begin_norm_axis": 1, "epsilon": 1e-5}
+        self.outputs = {"Y": y, "Mean": mean.reshape(3),
+                        "Variance": var.reshape(3)}
+
+    def test(self):
+        self.check_output(atol=1e-4)
+        self.check_grad(["X", "Scale", "Bias"], "Y",
+                        max_relative_error=0.02)
+
+
+class TestMishOp(OpTest):
+    op_type = "mish"
+
+    def setup(self):
+        x = _r(2, 5, seed=6)
+        sp = np.log1p(np.exp(x))
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x * np.tanh(sp)}
+
+    def test(self):
+        self.check_output(atol=1e-5)
+        self.check_grad(["X"], "Out")
+
+
+class TestSeluOp(OpTest):
+    op_type = "selu"
+
+    def setup(self):
+        x = _r(3, 4, seed=7)
+        # keep inputs away from the kink at 0 — central differences
+        # average the two one-sided slopes there (reference op tests do
+        # the same for relu-family ops)
+        x = x + np.sign(x) * 0.1
+        scale, alpha = 1.0507009873554805, 1.6732632423543772
+        self.inputs = {"X": x}
+        self.outputs = {"Out": scale * np.where(
+            x > 0, x, alpha * (np.exp(x) - 1.0)).astype(np.float32)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestCosSimOp(OpTest):
+    op_type = "cos_sim"
+
+    def setup(self):
+        x, y = _r(4, 6, seed=8), _r(4, 6, seed=9)
+        xn = np.sqrt((x * x).sum(-1, keepdims=True))
+        yn = np.sqrt((y * y).sum(-1, keepdims=True))
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": (x * y).sum(-1, keepdims=True) / (xn * yn),
+                        "XNorm": xn, "YNorm": yn}
+
+    def test(self):
+        self.check_output(atol=1e-5)
+        self.check_grad(["X", "Y"], "Out", max_relative_error=0.02)
+
+
+class TestIndexSelectOp(OpTest):
+    op_type = "index_select"
+
+    def setup(self):
+        x = _r(5, 3, seed=10)
+        idx = np.array([0, 2, 4, 2], np.int64)
+        self.inputs = {"X": x, "Index": idx}
+        self.attrs = {"dim": 0}
+        self.outputs = {"Out": x[idx]}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestInstanceNormOp(OpTest):
+    op_type = "instance_norm"
+
+    def setup(self):
+        x = _r(2, 3, 4, 4, seed=11)
+        s, b = _r(3, seed=12), _r(3, seed=13)
+        mean = x.mean((2, 3), keepdims=True)
+        var = x.var((2, 3), keepdims=True)
+        y = (x - mean) / np.sqrt(var + 1e-5) * s.reshape(1, 3, 1, 1) + \
+            b.reshape(1, 3, 1, 1)
+        self.inputs = {"X": x, "Scale": s, "Bias": b}
+        self.outputs = {"Y": y.astype(np.float32)}
+
+    def test(self):
+        self.check_output(atol=1e-4)
+
+
+class TestEyeLinspaceMeshgrid:
+    def test_eye(self):
+        import paddle_tpu as pt
+        from paddle_tpu.core.executor import run_op
+        from paddle_tpu.core.ir import OpDesc
+
+        env = {}
+        run_op(OpDesc("eye", {}, {"Out": ["e"]},
+                      {"num_rows": 3, "num_columns": 4, "dtype": "float32"}),
+               env)
+        np.testing.assert_allclose(env["e"], np.eye(3, 4))
+
+    def test_meshgrid(self):
+        from paddle_tpu.core.executor import run_op
+        from paddle_tpu.core.ir import OpDesc
+
+        env = {"a": np.arange(3.0), "b": np.arange(2.0)}
+        run_op(OpDesc("meshgrid", {"X": ["a", "b"]},
+                      {"Out": ["ga", "gb"]}, {}), env)
+        wa, wb = np.meshgrid(np.arange(3.0), np.arange(2.0), indexing="ij")
+        np.testing.assert_allclose(env["ga"], wa)
+        np.testing.assert_allclose(env["gb"], wb)
+
+
+class TestSequenceOps:
+    def _run(self, op_type, inputs, outputs, attrs=None):
+        from paddle_tpu.core.executor import run_op
+        from paddle_tpu.core.ir import OpDesc
+
+        env = dict(inputs)
+        run_op(OpDesc(op_type, {k: [k] for k in inputs},
+                      {k: [k] for k in outputs}, attrs or {}), env)
+        return env
+
+    def test_sequence_mask(self):
+        env = self._run("sequence_mask", {"X": np.array([2, 0, 3])},
+                        ["Y"], {"maxlen": 4, "out_dtype": "int32"})
+        np.testing.assert_array_equal(
+            env["Y"], [[1, 1, 0, 0], [0, 0, 0, 0], [1, 1, 1, 0]])
+
+    def test_sequence_pad_pool_softmax_reverse(self):
+        vals = np.arange(10, dtype=np.float32).reshape(5, 2)
+        lod = np.array([0, 2, 5], np.int64)     # seqs of len 2 and 3
+        env = self._run("sequence_pad", {"X": vals, "Lod": lod}, ["Out"],
+                        {"padded_length": 3})
+        want = np.zeros((2, 3, 2), np.float32)
+        want[0, :2] = vals[:2]
+        want[1, :3] = vals[2:]
+        np.testing.assert_allclose(env["Out"], want)
+
+        env = self._run("sequence_pool", {"X": vals, "Lod": lod}, ["Out"],
+                        {"pooltype": "MEAN"})
+        np.testing.assert_allclose(
+            env["Out"], [vals[:2].mean(0), vals[2:].mean(0)], atol=1e-6)
+
+        x1 = np.array([1.0, 2.0, 0.5, 0.2, 0.3], np.float32)
+        env = self._run("sequence_softmax", {"X": x1, "Lod": lod}, ["Out"])
+        w = np.concatenate([np.exp(x1[:2]) / np.exp(x1[:2]).sum(),
+                            np.exp(x1[2:]) / np.exp(x1[2:]).sum()])
+        np.testing.assert_allclose(env["Out"], w, atol=1e-6)
+
+        env = self._run("sequence_reverse", {"X": vals, "Lod": lod}, ["Y"])
+        want = np.concatenate([vals[:2][::-1], vals[2:][::-1]])
+        np.testing.assert_allclose(env["Y"], want)
+
+
+class TestRnnOps:
+    def test_lstm_matches_numpy(self):
+        from paddle_tpu.core.executor import run_op
+        from paddle_tpu.core.ir import OpDesc
+
+        rng = np.random.RandomState(0)
+        B, S, D, H = 2, 4, 3, 5
+        x = rng.randn(B, S, D).astype(np.float32)
+        wx = rng.randn(D, 4 * H).astype(np.float32) * 0.1
+        wh = rng.randn(H, 4 * H).astype(np.float32) * 0.1
+        bias = rng.randn(4 * H).astype(np.float32) * 0.1
+        env = {"Input": x, "WeightX": wx, "WeightH": wh, "Bias": bias}
+        run_op(OpDesc("lstm",
+                      {"Input": ["Input"], "WeightX": ["WeightX"],
+                       "WeightH": ["WeightH"], "Bias": ["Bias"]},
+                      {"Out": ["Out"], "LastH": ["LastH"],
+                       "LastC": ["LastC"]}, {}), env)
+
+        def sigmoid(v):
+            return 1.0 / (1.0 + np.exp(-v))
+
+        h = np.zeros((B, H), np.float32)
+        c = np.zeros((B, H), np.float32)
+        outs = []
+        for t in range(S):
+            gates = x[:, t] @ wx + bias + h @ wh
+            i, f, g, o = np.split(gates, 4, axis=-1)
+            c = sigmoid(f) * c + sigmoid(i) * np.tanh(g)
+            h = sigmoid(o) * np.tanh(c)
+            outs.append(h.copy())
+        want = np.stack(outs, axis=1)
+        np.testing.assert_allclose(env["Out"], want, atol=1e-5)
+        np.testing.assert_allclose(env["LastH"], h, atol=1e-5)
+        np.testing.assert_allclose(env["LastC"], c, atol=1e-5)
+
+    def test_lstm_respects_lengths(self):
+        from paddle_tpu.core.executor import run_op
+        from paddle_tpu.core.ir import OpDesc
+
+        rng = np.random.RandomState(1)
+        B, S, D, H = 2, 5, 3, 4
+        x = rng.randn(B, S, D).astype(np.float32)
+        wx = rng.randn(D, 4 * H).astype(np.float32) * 0.1
+        wh = rng.randn(H, 4 * H).astype(np.float32) * 0.1
+        lens = np.array([3, 5], np.int32)
+        env = {"Input": x, "WeightX": wx, "WeightH": wh,
+               "SequenceLength": lens}
+        run_op(OpDesc("lstm",
+                      {"Input": ["Input"], "WeightX": ["WeightX"],
+                       "WeightH": ["WeightH"],
+                       "SequenceLength": ["SequenceLength"]},
+                      {"Out": ["Out"], "LastH": ["LastH"],
+                       "LastC": ["LastC"]}, {}), env)
+        # row 0's state freezes after step 3
+        np.testing.assert_allclose(env["Out"][0, 2], env["Out"][0, 4],
+                                   atol=1e-6)
+        np.testing.assert_allclose(env["LastH"][0], env["Out"][0, 2],
+                                   atol=1e-6)
+
+    def test_gru_runs_and_shapes(self):
+        from paddle_tpu.core.executor import run_op
+        from paddle_tpu.core.ir import OpDesc
+
+        rng = np.random.RandomState(2)
+        B, S, D, H = 2, 4, 3, 5
+        env = {"Input": rng.randn(B, S, D).astype(np.float32),
+               "WeightX": rng.randn(D, 3 * H).astype(np.float32) * 0.1,
+               "WeightH": rng.randn(H, 3 * H).astype(np.float32) * 0.1}
+        run_op(OpDesc("gru",
+                      {"Input": ["Input"], "WeightX": ["WeightX"],
+                       "WeightH": ["WeightH"]},
+                      {"Out": ["Out"], "LastH": ["LastH"]}, {}), env)
+        assert env["Out"].shape == (B, S, H)
+        np.testing.assert_allclose(env["Out"][:, -1], env["LastH"],
+                                   atol=1e-6)
+
+
+class TestAucOp:
+    def test_streaming_auc(self):
+        from paddle_tpu.core.executor import run_op
+        from paddle_tpu.core.ir import OpDesc
+
+        rng = np.random.RandomState(0)
+        n_t = 200
+        stat_pos = np.zeros(n_t + 1, np.float32)
+        stat_neg = np.zeros(n_t + 1, np.float32)
+        # perfectly separable → AUC ~ 1
+        preds = np.concatenate([rng.uniform(0.8, 1.0, (50,)),
+                                rng.uniform(0.0, 0.2, (50,))])
+        labels = np.concatenate([np.ones(50), np.zeros(50)]).astype(np.int64)
+        pred2 = np.stack([1 - preds, preds], axis=1).astype(np.float32)
+        env = {"Predict": pred2, "Label": labels,
+               "StatPos": stat_pos, "StatNeg": stat_neg}
+        run_op(OpDesc("auc",
+                      {"Predict": ["Predict"], "Label": ["Label"],
+                       "StatPos": ["StatPos"], "StatNeg": ["StatNeg"]},
+                      {"AUC": ["AUC"], "StatPosOut": ["StatPos"],
+                       "StatNegOut": ["StatNeg"]},
+                      {"num_thresholds": n_t}), env)
+        assert float(env["AUC"]) > 0.99
