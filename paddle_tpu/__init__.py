@@ -35,6 +35,9 @@ from .dataset import DatasetFactory, InMemoryDataset, QueueDataset  # noqa: F401
 from . import profiler  # noqa: F401
 from .core.flags import get_flags, set_flags  # noqa: F401
 from .core import monitor  # noqa: F401
+from . import utils  # noqa: F401
+from . import generator  # noqa: F401
+from .generator import seed  # noqa: F401
 
 __version__ = "0.1.0"
 

@@ -1,0 +1,224 @@
+"""Slim quantization — QAT transform pass + post-training quantization.
+
+Capability mirror of python/paddle/fluid/contrib/slim/quantization/
+(quantization_pass.py QuantizationTransformPass,
+post_training_quantization.py PostTrainingQuantization): insert
+fake-quant/dequant ops (ops/quant_ops.py) on the weights and input
+activations of quantizable ops, with straight-through-estimator gradients
+for QAT; PTQ calibrates activation scales from sample batches then freezes
+them into the program. On TPU the quantized program still computes in fp
+(simulated int8) — the `convert` step additionally returns int8 weight
+arrays + scales for deployment.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core import unique_name
+from ..core.ir import OpDesc, Program
+
+QUANTIZABLE_OPS = {"mul", "matmul", "matmul_v2", "conv2d",
+                   "depthwise_conv2d", "fc"}
+# which input slots hold (activation, weight) per op type
+_SLOTS = {
+    "mul": ("X", "Y"), "matmul": ("X", "Y"), "matmul_v2": ("X", "Y"),
+    "conv2d": ("Input", "Filter"), "depthwise_conv2d": ("Input", "Filter"),
+    "fc": ("Input", "W"),
+}
+
+
+class QuantizationTransformPass:
+    """Insert weight + activation fake-qdq ops before each quantizable op
+    (reference: quantization_pass.py QuantizationTransformPass).
+
+    For QAT, apply() must run BEFORE optimizer.minimize() so the backward
+    pass is built over the fake-quant ops and their straight-through
+    gradients; applying after minimize leaves the backward differentiating
+    the unquantized path."""
+
+    def __init__(self, weight_bits: int = 8, activation_bits: int = 8,
+                 quantizable_op_type: Optional[Sequence[str]] = None,
+                 moving_rate: float = 0.9, for_test: bool = False):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.ops = set(quantizable_op_type or QUANTIZABLE_OPS)
+        self.moving_rate = moving_rate
+        self.for_test = for_test
+
+    def apply(self, program: Program, startup_program: Optional[Program] = None
+              ) -> Program:
+        """When startup_program is given, zero-init ops for the
+        activation-scale state are appended there (otherwise call
+        init_scale_state(scope) before running)."""
+        block = program.global_block()
+        params = {v.name for v in block.vars.values()
+                  if getattr(v, "persistable", False)}
+        new_ops: List[OpDesc] = []
+        # keyed on (name, scheme): a var consumed under two different quant
+        # schemes (other bits / other quant_axis) gets its own qdq op
+        quantized: Dict[tuple, str] = {}
+        scale_vars: List[str] = []
+        for op in block.ops:
+            if op.type in self.ops:
+                act_slot, w_slot = _SLOTS[op.type]
+                axis = 1 if op.type in ("mul", "matmul", "matmul_v2",
+                                        "fc") else 0
+                for slot, bits, channelwise in (
+                        (act_slot, self.activation_bits, False),
+                        (w_slot, self.weight_bits, True)):
+                    names = op.inputs.get(slot)
+                    if not names:
+                        continue
+                    src = names[0]
+                    qkey = (src, bits, channelwise,
+                            axis if channelwise else -1)
+                    if qkey in quantized:
+                        op.inputs[slot] = [quantized[qkey]]
+                        continue
+                    qname = unique_name.generate(src + ".quantized")
+                    var = block.var(src) if block.has_var(src) else None
+                    block.create_var(name=qname,
+                                     shape=list(var.shape) if var else None,
+                                     dtype=str(var.dtype) if var else "float32")
+                    is_weight = src in params
+                    if is_weight and channelwise:
+                        sname = unique_name.generate(src + ".scale")
+                        block.create_var(name=sname, shape=[-1],
+                                         dtype="float32")
+                        new_ops.append(OpDesc(
+                            "fake_channel_wise_quantize_dequantize_abs_max",
+                            {"X": [src]}, {"Out": [qname],
+                                           "OutScale": [sname]},
+                            {"bit_length": bits, "quant_axis": axis}))
+                    else:
+                        sname = unique_name.generate(src + ".scale")
+                        state = unique_name.generate(src + ".state")
+                        accum = unique_name.generate(src + ".accum")
+                        for nm, shape in ((sname, [1]), (state, [1]),
+                                          (accum, [1])):
+                            block.create_var(name=nm, shape=shape,
+                                             dtype="float32",
+                                             persistable=True)
+                        scale_vars.extend([sname, state, accum])
+                        new_ops.append(OpDesc(
+                            "fake_quantize_dequantize_moving_average_abs_max",
+                            {"X": [src], "InScale": [sname],
+                             "InState": [state], "InAccum": [accum]},
+                            {"Out": [qname], "OutScale": [sname],
+                             "OutState": [state], "OutAccum": [accum]},
+                            {"bit_length": bits,
+                             "moving_rate": self.moving_rate,
+                             "is_test": self.for_test}))
+                    quantized[qkey] = qname
+                    op.inputs[slot] = [qname]
+            new_ops.append(op)
+        block.ops = new_ops
+        program._bump_version()
+        # activation-scale state must exist before running: either via the
+        # startup program (here) or init_scale_state(scope)
+        self.scale_var_names = scale_vars
+        if startup_program is not None:
+            sblock = startup_program.global_block()
+            for name in scale_vars:
+                sblock.create_var(name=name, shape=[1], dtype="float32",
+                                  persistable=True)
+                sblock.append_op("fill_constant", {}, {"Out": [name]},
+                                 {"shape": [1], "dtype": "float32",
+                                  "value": 0.0})
+        return program
+
+    def init_scale_state(self, scope):
+        for name in getattr(self, "scale_var_names", []):
+            if scope.find_var(name) is None:
+                scope.set(name, np.zeros((1,), np.float32))
+
+
+class PostTrainingQuantization:
+    """Calibrate activation scales on sample batches then emit a quantized
+    inference program (reference: post_training_quantization.py)."""
+
+    def __init__(self, executor, program: Program, feed_names,
+                 scope, batch_generator, weight_bits=8, activation_bits=8,
+                 quantizable_op_type=None):
+        self.exe = executor
+        self.program = program
+        self.feed_names = list(feed_names)
+        self.scope = scope
+        self.batches = batch_generator
+        self.wbits = weight_bits
+        self.abits = activation_bits
+        self.op_types = set(quantizable_op_type or QUANTIZABLE_OPS)
+
+    def quantize(self) -> Program:
+        block = self.program.global_block()
+        params = {v.name for v in block.vars.values()
+                  if getattr(v, "persistable", False)}
+        # 1. which activations feed quantizable ops
+        act_names: List[str] = []
+        for op in block.ops:
+            if op.type in self.op_types:
+                act_slot, _ = _SLOTS[op.type]
+                names = op.inputs.get(act_slot)
+                if names and names[0] not in params and \
+                        names[0] not in act_names:
+                    act_names.append(names[0])
+        # 2. run calibration batches, record abs-max per activation
+        scales = {n: 0.0 for n in act_names}
+        fetchable = [n for n in act_names]
+        for feed in self.batches:
+            vals = self.exe.run(self.program, feed=feed,
+                                fetch_list=fetchable, scope=self.scope,
+                                use_compiled=False)
+            for n, v in zip(fetchable, vals):
+                scales[n] = max(scales[n], float(np.max(np.abs(v))))
+        # 3. rewrite: static abs-max qdq on activations + channelwise on
+        # weights (scales frozen as attrs/consts)
+        qpass = QuantizationTransformPass(
+            weight_bits=self.wbits, activation_bits=self.abits,
+            quantizable_op_type=self.op_types, for_test=True)
+        qpass.apply(self.program)
+        qpass.init_scale_state(self.scope)
+        # seed the frozen activation scales: moving-average vars in test
+        # mode read InScale directly
+        for op in self.program.global_block().ops:
+            if op.type == "fake_quantize_dequantize_moving_average_abs_max":
+                src = op.inputs["X"][0]
+                if src in scales:
+                    # an activation dead on calibration data gets scale 1.0
+                    # (coarse but non-destructive) instead of 0, which
+                    # would collapse nonzero inference values to ~1e-8
+                    sc = scales[src] if scales[src] > 0 else 1.0
+                    self.scope.set(op.inputs["InScale"][0],
+                                   np.asarray([sc], np.float32))
+        self.calibrated_scales = scales
+        return self.program
+
+
+def quantize_weights_int8(program: Program, scope,
+                          op_types=None) -> Dict[str, dict]:
+    """Deployment convert: per-channel int8 weight arrays + fp scales
+    (reference: quantization_pass.py QuantizationFreezePass/convert)."""
+    op_types = set(op_types or QUANTIZABLE_OPS)
+    out: Dict[str, dict] = {}
+    block = program.global_block()
+    for op in block.ops:
+        if op.type not in op_types:
+            continue
+        _, w_slot = _SLOTS[op.type]
+        names = op.inputs.get(w_slot)
+        if not names:
+            continue
+        base = names[0].split(".quantized")[0]
+        w = scope.find_var(base)
+        if w is None:
+            continue
+        w = np.asarray(w, np.float32)
+        axis = 1 if op.type in ("mul", "matmul", "matmul_v2", "fc") else 0
+        red = tuple(i for i in range(w.ndim) if i != axis)
+        scale = np.maximum(np.max(np.abs(w), axis=red, keepdims=True), 1e-8)
+        q = np.clip(np.round(w / scale * 127.0), -127, 127).astype(np.int8)
+        out[base] = {"int8": q, "scale": (scale / 127.0).squeeze()}
+    return out

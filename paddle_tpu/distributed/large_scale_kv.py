@@ -1,0 +1,137 @@
+"""Host-side sharded KV store for huge sparse embeddings.
+
+Capability mirror of the reference's large-scale sparse stack
+(operators/distributed/large_scale_kv.h SSDSparseTable-style server tables,
+framework/fleet/fleet_wrapper.h:111 PullSparseVarsSync / push grads): a
+sharded hashmap of id → embedding row living in HOST memory, so embedding
+tables far larger than HBM stay off-chip; the hot rows a batch touches are
+pulled to device, trained, and pushed back.
+
+TPU design note (SURVEY.md §2.7): the reference distributes this across
+pserver processes over gRPC/BRPC. Here shards are in-process (one per
+host); multi-host deployment points each host's trainer at its own shard
+set with jax.distributed coordinating — the pull/push surface is the same.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+
+class SparseShard:
+    def __init__(self, dim: int, initializer):
+        self.dim = dim
+        self.table: Dict[int, np.ndarray] = {}
+        self.init = initializer
+        self.lock = threading.Lock()
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        out = np.empty((len(ids), self.dim), np.float32)
+        with self.lock:
+            for i, key in enumerate(ids):
+                row = self.table.get(int(key))
+                if row is None:
+                    row = self.init(self.dim).astype(np.float32)
+                    self.table[int(key)] = row
+                out[i] = row
+        return out
+
+    def push(self, ids: np.ndarray, grads: np.ndarray, lr: float):
+        with self.lock:
+            for key, g in zip(ids, grads):
+                k = int(key)
+                row = self.table.get(k)
+                if row is None:
+                    row = self.init(self.dim).astype(np.float32)
+                self.table[k] = row - lr * g
+
+
+class LargeScaleKV:
+    """Sharded id → row store with SGD push (reference: large_scale_kv.h
+    + DownpourWorker pull/push flow, downpour_worker.cc)."""
+
+    def __init__(self, dim: int, num_shards: int = 8, seed: int = 0,
+                 initializer: Optional[Callable[[int], np.ndarray]] = None):
+        self.dim = dim
+        # one RNG per shard (RandomState is not thread-safe; shards are
+        # pulled concurrently under per-shard locks only)
+        self.shards = []
+        for i in range(num_shards):
+            if initializer is not None:
+                init = initializer
+            else:
+                rng = np.random.RandomState(seed * 1000003 + i)
+                init = (lambda d, _r=rng: _r.randn(d) * 0.01)
+            self.shards.append(SparseShard(dim, init))
+
+    def _shard_of(self, ids: np.ndarray):
+        return np.mod(ids, len(self.shards)).astype(np.int64)
+
+    def pull(self, ids) -> np.ndarray:
+        """Gather rows for (possibly duplicated) ids — one row per id."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        out = np.empty((len(ids), self.dim), np.float32)
+        sh = self._shard_of(ids)
+        for s, shard in enumerate(self.shards):
+            mask = sh == s
+            if mask.any():
+                out[mask] = shard.pull(ids[mask])
+        return out
+
+    def push(self, ids, grads, lr: float = 0.01):
+        """Scatter-add gradients (duplicate ids accumulate) then SGD."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(len(ids), self.dim)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        acc = np.zeros((len(uniq), self.dim), np.float32)
+        np.add.at(acc, inv, grads)
+        sh = self._shard_of(uniq)
+        for s, shard in enumerate(self.shards):
+            mask = sh == s
+            if mask.any():
+                shard.push(uniq[mask], acc[mask], lr)
+
+    def size(self) -> int:
+        return sum(len(s.table) for s in self.shards)
+
+    def save(self, path: str):
+        ids, rows = [], []
+        for s in self.shards:
+            with s.lock:
+                for k, v in s.table.items():
+                    ids.append(k)
+                    rows.append(v)
+        np.savez(path, ids=np.asarray(ids, np.int64),
+                 rows=np.stack(rows) if rows else
+                 np.zeros((0, self.dim), np.float32))
+
+    def load(self, path: str):
+        data = np.load(path if path.endswith(".npz") else path + ".npz")
+        for k, v in zip(data["ids"], data["rows"]):
+            self.shards[int(k) % len(self.shards)].table[int(k)] = v
+
+
+class SparseEmbedding:
+    """Trainer-side helper: pull rows for a batch of ids into a dense
+    [N, dim] device array, and push grads back after the step — the
+    DownpourWorker per-batch flow (downpour_worker.cc) as two calls."""
+
+    def __init__(self, kv: LargeScaleKV):
+        self.kv = kv
+        self._last_ids: Optional[np.ndarray] = None
+
+    def pull(self, ids):
+        import jax.numpy as jnp
+
+        ids = np.asarray(ids, np.int64)
+        self._last_ids = ids.reshape(-1)
+        rows = self.kv.pull(self._last_ids)
+        return jnp.asarray(rows.reshape(ids.shape + (self.kv.dim,)))
+
+    def push(self, grads, lr: float = 0.01):
+        assert self._last_ids is not None, "push before pull"
+        self.kv.push(self._last_ids, np.asarray(grads).reshape(
+            len(self._last_ids), self.kv.dim), lr)

@@ -1,0 +1,77 @@
+"""RNG generator state (reference: framework/generator.{h,cc} Generator —
+global/per-device seed + state get/set; paddle.seed / paddle.get_rng_state).
+
+Program-level randomness here is seed-attr based (ops fold seed + step),
+so the generator tracks the global seed used when op seeds are assigned,
+plus a counter for unique per-op seeds."""
+
+from __future__ import annotations
+
+import threading
+
+
+class Generator:
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self._seed = seed
+        self._offset = 0
+
+    def manual_seed(self, seed: int):
+        with self._lock:
+            self._seed = int(seed)
+            self._offset = 0
+        return self
+
+    def seed(self) -> int:
+        return self._seed
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def get_state(self):
+        with self._lock:
+            return (self._seed, self._offset)
+
+    def set_state(self, state):
+        with self._lock:
+            self._seed, self._offset = int(state[0]), int(state[1])
+
+
+_default = Generator()
+
+
+def default_generator() -> Generator:
+    return _default
+
+
+def seed(value: int):
+    """paddle.seed — also seeds the default programs' random_seed (op
+    seeds derive from it at build time, core/ir.py next_op_seed)."""
+    from .core.ir import default_main_program, default_startup_program
+
+    _default.manual_seed(value)
+    default_main_program().random_seed = value
+    default_startup_program().random_seed = value
+    return _default
+
+
+def get_rng_state():
+    """Snapshot everything that controls build-time randomness: the
+    generator seed plus the default programs' (random_seed, op-seed
+    counter) — restoring it makes subsequently BUILT random ops repeat."""
+    from .core.ir import default_main_program, default_startup_program
+
+    main, startup = default_main_program(), default_startup_program()
+    return (_default.get_state(),
+            (main.random_seed, main._seed_counter),
+            (startup.random_seed, startup._seed_counter))
+
+
+def set_rng_state(state):
+    from .core.ir import default_main_program, default_startup_program
+
+    gen_state, (mseed, mctr), (sseed, sctr) = state
+    _default.set_state(gen_state)
+    main, startup = default_main_program(), default_startup_program()
+    main.random_seed, main._seed_counter = mseed, mctr
+    startup.random_seed, startup._seed_counter = sseed, sctr

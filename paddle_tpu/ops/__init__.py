@@ -39,3 +39,7 @@ try:
     from . import rnn_ops  # noqa: F401
 except ImportError:
     pass
+try:
+    from . import quant_ops  # noqa: F401
+except ImportError:
+    pass

@@ -1,0 +1,97 @@
+"""Fake-quantization ops — QAT / PTQ simulation kernels.
+
+Capability mirror of paddle/fluid/operators/fake_quantize_op.cc
+(fake_quantize_dequantize_abs_max, fake_channel_wise_quantize_dequantize_
+abs_max, fake_quantize_dequantize_moving_average_abs_max): quantize to
+int`bits` then dequantize in fp — the straight-through estimator pattern.
+Gradients flow via a custom grad (identity inside the clip range), the STE,
+rather than the vjp of round() (which is zero everywhere).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.ir import OpDesc
+from ..core.registry import register_grad_maker, register_op
+
+
+def _qdq(x, scale, bits):
+    import jax.numpy as jnp
+
+    bnt = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / s * bnt), -bnt, bnt)
+    return q * s / bnt
+
+
+@register_op("fake_quantize_dequantize_abs_max")
+def fake_qdq_abs_max(ins, attrs):
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    bits = int(attrs.get("bit_length", 8))
+    scale = jnp.max(jnp.abs(x))
+    return {"Out": _qdq(x, scale, bits), "OutScale": scale.reshape(1)}
+
+
+@register_op("fake_channel_wise_quantize_dequantize_abs_max")
+def fake_qdq_channel(ins, attrs):
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    bits = int(attrs.get("bit_length", 8))
+    axis = int(attrs.get("quant_axis", 0))
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    scale = jnp.max(jnp.abs(x), axis=red, keepdims=True)
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    return {"Out": _qdq(x, scale.reshape(shape), bits),
+            "OutScale": scale.reshape(-1)}
+
+
+@register_op("fake_quantize_dequantize_moving_average_abs_max",
+             non_diff_inputs=("InScale", "InAccum", "InState"))
+def fake_qdq_moving_avg(ins, attrs):
+    """Activation quant: scale tracked as a moving average of abs-max
+    across steps (state threads through the scope like optimizer state)."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    bits = int(attrs.get("bit_length", 8))
+    rate = float(attrs.get("moving_rate", 0.9))
+    in_scale = ins["InScale"][0].reshape(())
+    state = ins["InState"][0].reshape(()) if ins.get("InState") and \
+        ins["InState"][0] is not None else jnp.float32(0.0)
+    accum = ins["InAccum"][0].reshape(()) if ins.get("InAccum") and \
+        ins["InAccum"][0] is not None else jnp.float32(0.0)
+    is_test = bool(attrs.get("is_test", False))
+    cur = jnp.max(jnp.abs(x)).astype(jnp.float32)
+    if is_test:
+        scale = in_scale
+        state_out, accum_out = state, accum
+    else:
+        state_out = rate * state + 1.0
+        accum_out = rate * accum + cur
+        scale = accum_out / state_out
+    return {"Out": _qdq(x, scale, bits),
+            "OutScale": scale.reshape(1),
+            "OutState": state_out.reshape(1),
+            "OutAccum": accum_out.reshape(1)}
+
+
+def _ste_grad(op: OpDesc, out_grads, in_grads):
+    """Straight-through estimator: d(qdq(x))/dx ≈ 1 inside the range —
+    pass the output grad straight to X (reference: the fake_quantize grad
+    kernels are identity copies)."""
+    og = (out_grads.get("Out") or [None])[0]
+    ig = (in_grads.get("X") or [None])[0]
+    if og is None or ig is None:
+        return []
+    return [OpDesc("assign", {"X": [og]}, {"Out": [ig]}, {})]
+
+
+for _t in ("fake_quantize_dequantize_abs_max",
+           "fake_channel_wise_quantize_dequantize_abs_max",
+           "fake_quantize_dequantize_moving_average_abs_max"):
+    register_grad_maker(_t)(_ste_grad)

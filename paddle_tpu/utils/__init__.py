@@ -1,0 +1,3 @@
+"""Utility namespace (reference: python/paddle/utils/)."""
+
+from . import dlpack  # noqa: F401

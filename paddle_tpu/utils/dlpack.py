@@ -1,0 +1,24 @@
+"""DLPack zero-copy tensor exchange (reference: framework/dlpack_tensor.cc,
+paddle.utils.dlpack). Bridges jax arrays to/from any DLPack consumer
+(torch, numpy) without host copies where the backends allow.
+
+Modern DLPack protocol: to_dlpack returns an exporter object implementing
+__dlpack__/__dlpack_device__ (jax arrays do natively); from_dlpack accepts
+any such exporter (torch tensors, numpy arrays, other jax arrays)."""
+
+from __future__ import annotations
+
+
+def to_dlpack(x):
+    """jax array (or VarBase) → DLPack exporter object."""
+    arr = getattr(x, "_array", x)
+    if not hasattr(arr, "__dlpack__"):
+        raise TypeError(f"{type(arr)} does not export DLPack")
+    return arr
+
+
+def from_dlpack(obj):
+    """DLPack exporter (object with __dlpack__) → jax array."""
+    import jax
+
+    return jax.dlpack.from_dlpack(obj)

@@ -1,0 +1,142 @@
+"""Quantization (slim) + large-scale KV tests (reference:
+slim/tests/test_quantization_pass.py, test_post_training_quantization,
+large_scale_kv / downpour pull-push flow)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _mlp_program():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [8], stop_gradient=True)
+        label = layers.data("label", [1], dtype="int64", stop_gradient=True)
+        h = layers.fc(x, 16, act="relu")
+        logits = layers.fc(h, 4)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+    return main, startup, x, label, logits, loss
+
+
+class TestQATPass:
+    def test_insert_and_train(self, scope):
+        from paddle_tpu.contrib.slim import QuantizationTransformPass
+
+        main, startup, x, label, logits, loss = _mlp_program()
+        # QAT order matters: transform BEFORE minimize so the backward is
+        # built over the fake-quant ops (STE grad makers engage)
+        qpass = QuantizationTransformPass()
+        qpass.apply(main)
+        with pt.program_guard(main, startup):
+            pt.optimizer.SGDOptimizer(0.1).minimize(loss)
+        types = [op.type for op in main.global_block().ops]
+        assert "fake_channel_wise_quantize_dequantize_abs_max" in types
+        assert "fake_quantize_dequantize_moving_average_abs_max" in types
+
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        qpass.init_scale_state(scope)
+        rng = np.random.RandomState(0)
+        feed = {"x": rng.randn(16, 8).astype(np.float32),
+                "label": rng.randint(0, 4, (16, 1)).astype(np.int64)}
+        losses = []
+        for _ in range(8):
+            lv, = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+        assert losses[-1] < losses[0]     # STE grads train through fake-quant
+
+    def test_quantized_close_to_fp(self, scope):
+        from paddle_tpu.contrib.slim import QuantizationTransformPass
+
+        main, startup, x, label, logits, loss = _mlp_program()
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        feed = {"x": np.random.RandomState(1).randn(4, 8).astype(np.float32),
+                "label": np.zeros((4, 1), np.int64)}
+        fp, = exe.run(main, feed=feed, fetch_list=[logits], scope=scope)
+        qpass = QuantizationTransformPass(for_test=False)
+        qpass.apply(main)
+        qpass.init_scale_state(scope)
+        q, = exe.run(main, feed=feed, fetch_list=[logits], scope=scope)
+        # int8 simulation stays within ~2% of fp
+        assert np.max(np.abs(np.asarray(q) - np.asarray(fp))) < \
+            0.02 * (np.max(np.abs(fp)) + 1.0)
+
+
+class TestPTQ:
+    def test_calibrate_and_freeze(self, scope):
+        from paddle_tpu.contrib.slim import (PostTrainingQuantization,
+                                             quantize_weights_int8)
+
+        main, startup, x, label, logits, loss = _mlp_program()
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        rng = np.random.RandomState(2)
+        batches = [{"x": rng.randn(8, 8).astype(np.float32),
+                    "label": np.zeros((8, 1), np.int64)} for _ in range(3)]
+        feed = batches[0]
+        fp, = exe.run(main, feed=feed, fetch_list=[logits], scope=scope)
+        ptq = PostTrainingQuantization(exe, main, ["x", "label"],
+                                       scope, batches)
+        qprog = ptq.quantize()
+        assert any(s > 0 for s in ptq.calibrated_scales.values())
+        q, = exe.run(qprog, feed=feed, fetch_list=[logits], scope=scope)
+        assert np.max(np.abs(np.asarray(q) - np.asarray(fp))) < \
+            0.05 * (np.max(np.abs(fp)) + 1.0)
+
+        packs = quantize_weights_int8(qprog, scope)
+        assert packs and all(p["int8"].dtype == np.int8
+                             for p in packs.values())
+
+
+class TestLargeScaleKV:
+    def test_pull_push_roundtrip(self):
+        from paddle_tpu.distributed.large_scale_kv import LargeScaleKV
+
+        kv = LargeScaleKV(dim=4, num_shards=3, seed=0)
+        ids = np.array([5, 99, 5, 1000000007])
+        rows = kv.pull(ids)
+        assert rows.shape == (4, 4)
+        np.testing.assert_allclose(rows[0], rows[2])   # same id, same row
+        assert kv.size() == 3
+
+        grads = np.ones((4, 4), np.float32)
+        before = kv.pull(np.array([5]))[0].copy()
+        kv.push(ids, grads, lr=0.5)
+        after = kv.pull(np.array([5]))[0]
+        # id 5 appears twice -> accumulated grad 2.0, sgd 0.5 * 2
+        np.testing.assert_allclose(after, before - 1.0, atol=1e-6)
+
+    def test_save_load(self, tmp_path):
+        from paddle_tpu.distributed.large_scale_kv import LargeScaleKV
+
+        kv = LargeScaleKV(dim=3, seed=1)
+        kv.pull(np.arange(10))
+        kv.save(str(tmp_path / "kv"))
+        kv2 = LargeScaleKV(dim=3, seed=2)
+        kv2.load(str(tmp_path / "kv"))
+        np.testing.assert_allclose(kv2.pull(np.arange(10)),
+                                   kv.pull(np.arange(10)))
+
+    def test_sparse_embedding_trains(self):
+        """Host-KV embedding + device loss: the downpour per-batch flow."""
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.distributed.large_scale_kv import (LargeScaleKV,
+                                                           SparseEmbedding)
+
+        kv = LargeScaleKV(dim=4, seed=3)
+        emb = SparseEmbedding(kv)
+        ids = np.array([1, 2, 3, 4])
+        target = jnp.ones((4, 4))
+        losses = []
+        for _ in range(20):
+            rows = emb.pull(ids)
+            loss, g = jax.value_and_grad(
+                lambda r: jnp.mean((r - target) ** 2))(rows)
+            emb.push(np.asarray(g), lr=1.0)
+            losses.append(float(loss))
+        assert losses[-1] < 0.1 * losses[0]
