@@ -28,14 +28,16 @@ def peak_flops_per_chip() -> float:
     return 197e12  # v5e / v5 lite
 
 
-def transformer_step_flops(cfg, batch, seq) -> float:
-    """6 * non-embedding-params * tokens + attention term (fwd+bwd)."""
+def transformer_step_flops(cfg, batch, seq, lm_positions=None) -> float:
+    """6 * non-embedding-params * tokens + attention term (fwd+bwd).
+    lm_positions: tokens entering the vocab projection (masked-gather
+    head) — defaults to every token."""
     h, l, ff, v = (cfg.hidden_size, cfg.num_hidden_layers,
                    cfg.intermediate_size, cfg.vocab_size)
     per_layer = 4 * h * h + 2 * h * ff          # qkv/out + ffn
-    n_params = l * per_layer + h * v            # + lm head matmul (tied emb)
     tokens = batch * seq
-    matmul = 6.0 * n_params * tokens
+    lm_tokens = batch * (lm_positions if lm_positions else seq)
+    matmul = 6.0 * l * per_layer * tokens + 6.0 * h * v * lm_tokens
     attn = 6.0 * 2 * l * batch * seq * seq * h  # scores + context, fwd+bwd
     return matmul + attn
 
@@ -48,15 +50,23 @@ def main():
 
     cfg = bert.bert_base()
     cfg.dtype = "bfloat16"
-    seq, batch = 128, 64
+    # batch sweep on v5e: 64→40k, 256→84k, 384→94k tok/s (448+ exceeds
+    # compile memory on the attention scores); the masked-gather MLM head
+    # (top-20 positions of seq 128 ≈ 15% masking) shrinks the [B,S,vocab]
+    # logits 6.4x — loss-exact when the data pipeline caps masks at
+    # max_predictions_per_seq (standard BERT contract; the synthetic
+    # generator caps accordingly)
+    seq, batch, max_preds = 128, 384, 20
     steps = 20
 
     main_prog, startup, feeds, fetches = bert.build_pretraining_program(
-        cfg, seq_len=seq, optimizer_name="adamw")
+        cfg, seq_len=seq, optimizer_name="adamw",
+        max_predictions_per_seq=max_preds)
     exe = pt.Executor()
     scope = pt.Scope()
     exe.run(startup, scope=scope, use_compiled=False)
-    data = bert.synthetic_pretraining_batch(cfg, batch, seq)
+    data = bert.synthetic_pretraining_batch(
+        cfg, batch, seq, max_predictions_per_seq=max_preds)
 
     loss_v = fetches["loss"]
     # warmup/compile
@@ -67,7 +77,7 @@ def main():
     dt = (time.perf_counter() - t0) / steps
 
     tokens_per_sec = batch * seq / dt
-    flops = transformer_step_flops(cfg, batch, seq)
+    flops = transformer_step_flops(cfg, batch, seq, lm_positions=max_preds)
     mfu = flops / dt / peak_flops_per_chip()
     print(json.dumps({
         "metric": "bert_base_pretrain_tokens_per_sec_per_chip",

@@ -943,3 +943,13 @@ def auc(input, label, num_thresholds=4095, name=None):
                      {"AUC": [out], "StatPosOut": [pos], "StatNegOut": [neg]},
                      {"num_thresholds": num_thresholds})
     return out, [pos, neg]
+
+
+def take_along_axis(input, index, axis, name=None):
+    """Batched gather along `axis` with broadcastable index
+    (ops/extra_ops.py take_along_axis; numpy semantics)."""
+    helper = LayerHelper("take_along_axis", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("take_along_axis", {"Input": [input], "Index": [index]},
+                     {"Result": [out]}, {"Axis": axis})
+    return out

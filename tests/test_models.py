@@ -124,3 +124,32 @@ def test_transformer_tp_dryrun():
     lv, = exe.run(main, feed=batch, fetch_list=[fetches["loss"]], scope=scope,
                   mesh=mesh)
     assert np.isfinite(float(lv))
+
+
+def test_masked_gather_mlm_head_parity():
+    """max_predictions_per_seq gathers only masked positions before the
+    vocab projection; when the mask count fits, the loss is exact."""
+    import paddle_tpu as pt
+    from paddle_tpu.core import ir, unique_name
+    from paddle_tpu.models import bert
+
+    res = {}
+    for k in (0, 40):
+        ir._main_program, ir._startup_program = ir.Program(), ir.Program()
+        unique_name.switch()
+        cfg = bert.BertConfig(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=2, intermediate_size=128,
+            max_position_embeddings=64, hidden_dropout_prob=0.0,
+            attention_probs_dropout_prob=0.0)
+        main, startup, feeds, fetches = bert.build_pretraining_program(
+            cfg, seq_len=64, with_nsp=False, optimizer_name="adamw",
+            max_predictions_per_seq=k)
+        exe = pt.Executor()
+        sc = pt.Scope()
+        exe.run(startup, scope=sc, use_compiled=False)
+        batch = bert.synthetic_pretraining_batch(cfg, 4, 64)
+        out = exe.run(main, feed=batch, fetch_list=[fetches["loss"]],
+                      scope=sc)
+        res[k] = float(np.asarray(out[0]).reshape(-1)[0])
+    np.testing.assert_allclose(res[40], res[0], rtol=1e-5)
