@@ -38,6 +38,7 @@ from .core import monitor  # noqa: F401
 from . import utils  # noqa: F401
 from . import generator  # noqa: F401
 from .generator import seed  # noqa: F401
+from . import checkpoint  # noqa: F401
 
 __version__ = "0.1.0"
 

@@ -1,5 +1,6 @@
 """Model zoo covering the BASELINE workload ladder:
-MNIST LeNet, ResNet-50, BERT-base, ERNIE-large, Transformer-big.
+MNIST LeNet, ResNet-50, BERT-base, ERNIE-large, Transformer-big —
+plus word2vec and the seq2seq machine-translation book model.
 """
 
 from . import bert, lenet  # noqa: F401
@@ -10,5 +11,13 @@ except ImportError:
     pass
 try:
     from . import transformer  # noqa: F401
+except ImportError:
+    pass
+try:
+    from . import seq2seq  # noqa: F401
+except ImportError:
+    pass
+try:
+    from . import word2vec  # noqa: F401
 except ImportError:
     pass

@@ -8,5 +8,6 @@ collectives (scaling-book recipe: pick a mesh, annotate shardings, let XLA
 insert collectives).
 """
 
-from .mesh import create_mesh, get_mesh, set_mesh, mesh_axis_size  # noqa: F401
+from .mesh import (create_hybrid_mesh, create_mesh, get_mesh,  # noqa: F401
+                   mesh_axis_size, set_mesh)
 from .api import shard_tensor, shard_parameter, PartitionSpec  # noqa: F401

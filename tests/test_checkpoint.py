@@ -1,0 +1,81 @@
+"""Orbax-backed checkpoint tests: save/restore roundtrip, async save,
+manager retention + auto-resume (the checkpoint-restart failure-recovery
+path — SURVEY.md §5)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _program():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4], stop_gradient=True)
+        y = layers.fc(x, 8, act="relu")
+        loss = layers.mean(y)
+        pt.optimizer.AdamOptimizer(0.05).minimize(loss)
+    return main, startup, loss
+
+
+class TestCheckpoint:
+    def test_roundtrip_resumes_training_state(self, tmp_path, scope):
+        from paddle_tpu.checkpoint import load_checkpoint, save_checkpoint
+
+        main, startup, loss = _program()
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        x = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+        for _ in range(3):
+            exe.run(main, feed={"x": x}, fetch_list=[loss], scope=scope)
+        step_at_save = int(np.asarray(scope.find_var("@STEP_COUNTER@")))
+        save_checkpoint(str(tmp_path / "ck"), main, scope)
+        want, = exe.run(main, feed={"x": x}, fetch_list=[loss], scope=scope)
+
+        scope2 = pt.Scope()
+        exe.run(startup, scope=scope2, use_compiled=False)
+        step = load_checkpoint(str(tmp_path / "ck"), main, scope2)
+        assert step == step_at_save   # optimizer state + step restored
+        got, = exe.run(main, feed={"x": x}, fetch_list=[loss], scope=scope2)
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_async_save(self, tmp_path, scope):
+        from paddle_tpu.checkpoint import (load_checkpoint, save_checkpoint,
+                                           wait_for_checkpoint)
+
+        main, startup, loss = _program()
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        save_checkpoint(str(tmp_path / "a"), main, scope, async_save=True)
+        wait_for_checkpoint()
+        scope2 = pt.Scope()
+        exe.run(startup, scope=scope2, use_compiled=False)
+        load_checkpoint(str(tmp_path / "a"), main, scope2)
+
+    def test_manager_retention_and_resume(self, tmp_path, scope):
+        from paddle_tpu.checkpoint import CheckpointManager
+
+        main, startup, loss = _program()
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        x = np.ones((4, 4), np.float32)
+        mgr = CheckpointManager(str(tmp_path / "mgr"), max_to_keep=2,
+                                async_save=False)
+        for step in range(1, 5):
+            exe.run(main, feed={"x": x}, fetch_list=[loss], scope=scope)
+            mgr.save(step, main, scope)
+        mgr.wait_until_finished()
+        assert mgr._mgr.latest_step() == 4
+        assert len(list(mgr._mgr.all_steps())) == 2   # retention
+
+        scope2 = pt.Scope()
+        exe.run(startup, scope=scope2, use_compiled=False)
+        mgr2 = CheckpointManager(str(tmp_path / "mgr"), async_save=False)
+        resumed = mgr2.restore_latest(main, scope2)
+        assert resumed == 4
+        w1, = exe.run(main, feed={"x": x}, fetch_list=[loss], scope=scope)
+        w2, = exe.run(main, feed={"x": x}, fetch_list=[loss], scope=scope2)
+        np.testing.assert_allclose(w2, w1, atol=1e-6)
+        mgr.close()
+        mgr2.close()

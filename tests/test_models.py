@@ -153,3 +153,27 @@ def test_masked_gather_mlm_head_parity():
                       scope=sc)
         res[k] = float(np.asarray(out[0]).reshape(-1)[0])
     np.testing.assert_allclose(res[40], res[0], rtol=1e-5)
+
+
+def test_seq2seq_machine_translation_trains():
+    """Book config 'machine translation': LSTM encoder/decoder + Luong
+    attention trains on synthetic pairs (reference:
+    tests/book/test_machine_translation.py)."""
+    import paddle_tpu as pt
+    from paddle_tpu.models import seq2seq
+
+    cfg = seq2seq.Seq2SeqConfig(src_vocab_size=64, tgt_vocab_size=64,
+                                embed_dim=16, hidden_size=32)
+    main, startup, feeds, fetches = seq2seq.build_seq2seq_program(
+        cfg, src_len=10, tgt_len=8, lr=5e-3)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    exe.run(startup, scope=scope, use_compiled=False)
+    batch = seq2seq.synthetic_translation_batch(cfg, 8, 10, 8)
+    losses = []
+    for _ in range(15):
+        lv, = exe.run(main, feed=batch, fetch_list=[fetches["loss"]],
+                      scope=scope)
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert np.isfinite(losses[-1])
+    assert losses[-1] < losses[0] - 0.2
