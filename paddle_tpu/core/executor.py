@@ -132,18 +132,28 @@ def _analyze_block(block: Block) -> Tuple[List[str], List[str]]:
 
 
 def _collect_collective_ops(ops, _seen=None) -> List[OpDesc]:
-    """Collective ops in an op list, recursing into sub-blocks
-    (block_call / conditional_block / while hold blocks in attrs)."""
+    """Collective ops in an op list, recursing into EVERY block-holding
+    attr (sub_block, cond's true/false_block, while_loop's cond/body_block,
+    pipeline_forward's stages op-lists)."""
     out: List[OpDesc] = []
     _seen = _seen if _seen is not None else set()
     for op in ops:
         opdef = registry.lookup(op.type)
         if opdef is not None and opdef.is_collective:
             out.append(op)
-        sub = op.attrs.get("sub_block") if op.attrs else None
-        if sub is not None and id(sub) not in _seen:
-            _seen.add(id(sub))
-            out.extend(_collect_collective_ops(sub.ops, _seen))
+        for val in (op.attrs or {}).values():
+            subs = []
+            if isinstance(val, Block):
+                subs = [val.ops]
+            elif isinstance(val, list) and val and \
+                    all(isinstance(v, list) for v in val) and \
+                    any(v and isinstance(v[0], OpDesc) for v in val):
+                subs = val                      # list of op lists (stages)
+            for sub_ops in subs:
+                key = id(sub_ops)
+                if key not in _seen:
+                    _seen.add(key)
+                    out.extend(_collect_collective_ops(sub_ops, _seen))
     return out
 
 

@@ -1,0 +1,164 @@
+"""Control-flow layer surface: cond / while_loop / static_loop.
+
+Capability mirror of python/paddle/fluid/layers/control_flow.py (cond,
+While/while_loop, StaticRNN) over the sub-block ops in
+ops/control_flow_ops.py. Branch/body functions are traced into child
+Blocks of the current program (the reference's sub-block mechanism,
+conditional_block_op.cc / while_op.cc) and lowered to lax.cond /
+lax.while_loop / lax.scan.
+
+Differentiability: `cond` and `static_loop` differentiate through the
+generic vjp grad maker (lax.cond/scan support reverse AD);
+`while_loop` does NOT (lax.while_loop is forward-only in XLA) — use
+static_loop when the trip count is static and gradients are needed,
+mirroring the reference's StaticRNN-vs-While split.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Union
+
+from ..core.ir import Block, Variable, default_main_program
+
+from ..layer_helper import LayerHelper
+
+
+def _as_list(v):
+    if v is None:
+        return []
+    return list(v) if isinstance(v, (list, tuple)) else [v]
+
+
+def _trace_sub_block(fn, args=()):
+    """Run `fn` with ops captured into a fresh child block. Returns
+    (block, output Variables)."""
+    program = default_main_program()
+    blk = program.create_block()
+    try:
+        outs = fn(*args)
+    finally:
+        program.rollback()
+    return blk, _as_list(outs)
+
+
+def _block_external_reads(blocks: Sequence[Block],
+                          extra_needed: Sequence[str] = ()) -> List[str]:
+    """Names read by the blocks' ops but not produced inside them, plus
+    any `extra_needed` names (e.g. branch OUTPUTS no op produces — an
+    identity branch returns an outer var directly) — all must be fed to
+    the lowering's env. Reuses the executor's canonical dataflow walk."""
+    from ..core.executor import _analyze_block
+
+    reads: List[str] = []
+    seen = set()
+    produced = set()
+    for blk in blocks:
+        ext, writes = _analyze_block(blk)
+        produced.update(writes)
+        for n in ext:
+            if n not in seen:
+                seen.add(n)
+                reads.append(n)
+    for n in extra_needed:
+        if n not in produced and n not in seen:
+            seen.add(n)
+            reads.append(n)
+    return reads
+
+
+def cond(pred: Variable, true_fn: Callable, false_fn: Optional[Callable] = None,
+         name=None):
+    """paddle.static.nn.cond — both branches trace into sub-blocks and must
+    return the same structure of Variables (or both None)."""
+    helper = LayerHelper("cond", name=name)
+    true_blk, true_outs = _trace_sub_block(true_fn)
+    false_blk, false_outs = _trace_sub_block(false_fn) if false_fn else (None, [])
+    if len(true_outs) != len(false_outs):
+        # includes false_fn=None with a value-returning true_fn — lax.cond
+        # requires identical branch output structures
+        raise ValueError(
+            f"cond branches must return the same number of outputs "
+            f"(true: {len(true_outs)}, false: {len(false_outs)}"
+            f"{'; provide a false_fn' if false_fn is None else ''})")
+    ext = _block_external_reads(
+        [b for b in (true_blk, false_blk) if b],
+        extra_needed=[v.name for v in true_outs + false_outs])
+    ext = [n for n in ext if n != pred.name]
+    out_vars = [helper.create_variable_for_type_inference(
+        v.dtype if hasattr(v, "dtype") else "float32")
+        for v in (true_outs or [])]
+    helper.append_op(
+        "cond", {"Cond": [pred], "X": ext},
+        {"Out": [v.name for v in out_vars]},
+        {"true_block": true_blk, "false_block": false_blk,
+         "input_names": list(ext), "cond_name": pred.name,
+         "true_out_names": [v.name for v in true_outs],
+         "false_out_names": [v.name for v in false_outs]})
+    if not out_vars:
+        return None
+    return out_vars[0] if len(out_vars) == 1 else out_vars
+
+
+def while_loop(cond_fn: Callable, body_fn: Callable,
+               loop_vars: Sequence[Variable], name=None):
+    """paddle.static.nn.while_loop — dynamic trip count via
+    lax.while_loop. NOT reverse-differentiable; use static_loop for
+    training-time loops with a static count."""
+    helper = LayerHelper("while_loop", name=name)
+    loop_vars = _as_list(loop_vars)
+    cond_blk, cond_outs = _trace_sub_block(cond_fn, loop_vars)
+    if len(cond_outs) != 1:
+        raise ValueError("while_loop cond_fn must return one boolean")
+    body_blk, body_outs = _trace_sub_block(body_fn, loop_vars)
+    if len(body_outs) != len(loop_vars):
+        raise ValueError(
+            f"body_fn must return as many values as loop_vars "
+            f"({len(body_outs)} vs {len(loop_vars)})")
+    carry_names = [v.name for v in loop_vars]
+    ext = [n for n in _block_external_reads(
+        [cond_blk, body_blk],
+        extra_needed=[v.name for v in cond_outs + body_outs])
+        if n not in carry_names]
+    out_vars = [helper.create_variable_for_type_inference(v.dtype)
+                for v in loop_vars]
+    helper.append_op(
+        "while_loop", {"X": [v.name for v in loop_vars], "Ext": ext},
+        {"Out": [v.name for v in out_vars]},
+        {"cond_block": cond_blk, "body_block": body_blk,
+         "carry_names": carry_names,
+         "cond_out_name": cond_outs[0].name,
+         "body_out_names": [v.name for v in body_outs],
+         "ext_names": list(ext)})
+    return out_vars
+
+
+def static_loop(n: int, body_fn: Callable, loop_vars: Sequence[Variable],
+                name=None):
+    """Fixed-trip-count loop via lax.scan — reverse-differentiable (the
+    StaticRNN role). body_fn(i_var, *loop_vars) -> new loop_vars."""
+    helper = LayerHelper("static_loop", name=name)
+    loop_vars = _as_list(loop_vars)
+    program = default_main_program()
+    blk = program.create_block()
+    try:
+        i_var = blk.create_var(name=helper.name + ".i", shape=[],
+                               dtype="int32", stop_gradient=True)
+        body_outs = _as_list(body_fn(i_var, *loop_vars))
+    finally:
+        program.rollback()
+    if len(body_outs) != len(loop_vars):
+        raise ValueError("body_fn must return as many values as loop_vars")
+    carry_names = [v.name for v in loop_vars]
+    ext = [n for n in _block_external_reads(
+        [blk], extra_needed=[v.name for v in body_outs])
+        if n not in carry_names and n != i_var.name]
+    out_vars = [helper.create_variable_for_type_inference(v.dtype)
+                for v in loop_vars]
+    helper.append_op(
+        "static_loop", {"X": [v.name for v in loop_vars], "Ext": ext},
+        {"Out": [v.name for v in out_vars]},
+        {"body_block": blk, "carry_names": carry_names,
+         "i_name": i_var.name, "num_steps": int(n),
+         "body_out_names": [v.name for v in body_outs],
+         "ext_names": list(ext)})
+    return out_vars

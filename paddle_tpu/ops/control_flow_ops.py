@@ -117,3 +117,97 @@ def print_op(ins, attrs):
     x = ins["X"][0]
     jax.debug.print(attrs.get("message", "print_op") + ": {x}", x=x)
     return {"Out": x}
+
+
+@register_op("cond", skip_infer_shape=True, non_diff_inputs=("Cond",))
+def cond_two_branch(ins, attrs):
+    """Two-sub-block lax.cond (layers/control_flow.py cond): both branches
+    trace; reverse-differentiable via the generic vjp grad maker."""
+    import jax
+
+    tb, fb = attrs["true_block"], attrs.get("false_block")
+    in_names = list(attrs["input_names"])
+    t_out = list(attrs["true_out_names"])
+    f_out = list(attrs["false_out_names"])
+    step = attrs.get("__step__")
+    pred = ins["Cond"][0]
+    if getattr(pred, "ndim", 0):
+        pred = pred.reshape(())
+    vals = tuple(ins["X"])
+
+    cond_name = attrs.get("cond_name")
+
+    def run(blk, out_names):
+        def fn(vs):
+            env = dict(zip(in_names, vs))
+            if cond_name:
+                env[cond_name] = ins["Cond"][0]  # branches may read the pred
+            if blk is not None:
+                _run_sub_block(blk, env, step=step)
+            return tuple(env[n] for n in out_names)
+
+        return fn
+
+    if not t_out:                       # side-effect-free branch selection
+        return {"Out": []}
+    outs = jax.lax.cond(pred, run(tb, t_out), run(fb, f_out), vals)
+    return {"Out": list(outs)}
+
+
+@register_op("while_loop", skip_infer_shape=True, non_diff_inputs=("Ext",))
+def while_loop_op(ins, attrs):
+    """Separate cond/body sub-blocks (layers/control_flow.py while_loop).
+    lax.while_loop — forward-only (XLA has no reverse-mode while); use
+    static_loop for differentiable fixed-count loops."""
+    import jax
+
+    cond_blk, body_blk = attrs["cond_block"], attrs["body_block"]
+    carry_names = list(attrs["carry_names"])
+    body_out_names = list(attrs["body_out_names"])
+    ext_names = list(attrs["ext_names"])
+    cond_out = attrs["cond_out_name"]
+    step = attrs.get("__step__")
+    ext_env = dict(zip(ext_names, ins.get("Ext", [])))
+
+    def cond_fn(carry):
+        env = dict(ext_env)
+        env.update(zip(carry_names, carry))
+        _run_sub_block(cond_blk, env, step=step)
+        c = env[cond_out]
+        return c.reshape(()) if getattr(c, "ndim", 0) else c
+
+    def body_fn(carry):
+        env = dict(ext_env)
+        env.update(zip(carry_names, carry))
+        _run_sub_block(body_blk, env, step=step)
+        return tuple(env[n] for n in body_out_names)
+
+    outs = jax.lax.while_loop(cond_fn, body_fn, tuple(ins["X"]))
+    return {"Out": list(outs)}
+
+
+@register_op("static_loop", skip_infer_shape=True)
+def static_loop_op(ins, attrs):
+    """Fixed-trip lax.scan loop (layers/control_flow.py static_loop) —
+    reverse-differentiable; the StaticRNN role with static shapes."""
+    import jax
+    import jax.numpy as jnp
+
+    blk = attrs["body_block"]
+    carry_names = list(attrs["carry_names"])
+    body_out_names = list(attrs["body_out_names"])
+    ext_names = list(attrs["ext_names"])
+    i_name = attrs["i_name"]
+    n = int(attrs["num_steps"])
+    step = attrs.get("__step__")
+    ext_env = dict(zip(ext_names, ins.get("Ext", [])))
+
+    def body(carry, i):
+        env = dict(ext_env)
+        env.update(zip(carry_names, carry))
+        env[i_name] = i
+        _run_sub_block(blk, env, step=step)
+        return tuple(env[nm] for nm in body_out_names), None
+
+    (outs), _ = jax.lax.scan(body, tuple(ins["X"]), jnp.arange(n))
+    return {"Out": list(outs)}

@@ -1,0 +1,141 @@
+"""Control-flow layer tests: cond / while_loop / static_loop (reference:
+test_cond.py, test_while_loop_op.py, StaticRNN tests)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.layers.control_flow import cond, static_loop, while_loop
+
+
+class TestCond:
+    def test_branches_and_grad(self, scope):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [4], stop_gradient=False)
+            flag = layers.data("flag", [1], dtype="bool",
+                               append_batch_size=False)
+            out = cond(flag,
+                       lambda: layers.scale(x, scale=3.0),
+                       lambda: layers.scale(x, scale=0.5))
+            loss = layers.mean(out)
+            grads = pt.gradients([loss], [x])
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        xv = np.ones((2, 4), np.float32)
+        for flag_v, scale in ((True, 3.0), (False, 0.5)):
+            o, g = exe.run(main,
+                           feed={"x": xv, "flag": np.array([flag_v])},
+                           fetch_list=[out, grads[0]], scope=scope)
+            np.testing.assert_allclose(o, scale * xv, atol=1e-6)
+            np.testing.assert_allclose(g, scale / 8 * np.ones_like(xv),
+                                       atol=1e-6)
+
+    def test_mismatched_branches_rejected(self, scope):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [4])
+            flag = layers.data("flag", [1], dtype="bool",
+                               append_batch_size=False)
+            with pytest.raises(ValueError, match="same number"):
+                cond(flag, lambda: (layers.scale(x, scale=1.0),
+                                    layers.scale(x, scale=2.0)),
+                     lambda: layers.scale(x, scale=0.5))
+
+
+class TestWhileLoop:
+    def test_dynamic_count(self, scope):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            i = layers.fill_constant([1], "int32", 0)
+            acc = layers.fill_constant([1], "float32", 0.0)
+            limit = layers.data("limit", [1], dtype="int32",
+                                append_batch_size=False)
+
+            def c(i, acc):
+                return layers.less_than(i, limit)
+
+            def b(i, acc):
+                return layers.increment(i, 1.0), acc + 2.0
+
+            i_out, acc_out = while_loop(c, b, [i, acc])
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        for n in (3, 7):
+            iv, av = exe.run(main, feed={"limit": np.array([n], np.int32)},
+                             fetch_list=[i_out, acc_out], scope=scope)
+            assert int(np.asarray(iv).reshape(-1)[0]) == n
+            assert float(np.asarray(av).reshape(-1)[0]) == 2.0 * n
+
+
+class TestStaticLoop:
+    def test_scan_loop_with_grad(self, scope):
+        """x -> x * w repeated n times; d(out)/dw flows through the scan."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [3], stop_gradient=True)
+            w = layers.create_parameter([1], "float32", name="w",
+                                        default_initializer=pt.initializer
+                                        .Constant(1.5))
+
+            def body(i, acc):
+                return layers.elementwise_mul(acc, w, axis=-1)
+
+            (out,) = static_loop(3, body, [x])
+            loss = layers.reduce_sum(out)
+            grads = pt.gradients([loss], [w])
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        xv = np.ones((2, 3), np.float32)
+        o, g = exe.run(main, feed={"x": xv}, fetch_list=[out, grads[0]],
+                       scope=scope)
+        np.testing.assert_allclose(o, 1.5 ** 3 * xv, atol=1e-5)
+        # d/dw sum(x * w^3) = 3 w^2 * sum(x) = 3 * 2.25 * 6
+        np.testing.assert_allclose(np.asarray(g).reshape(-1)[0],
+                                   3 * 1.5 ** 2 * 6.0, rtol=1e-5)
+
+
+class TestCondEdgeCases:
+    def test_identity_branches(self, scope):
+        """Branches that return outer vars directly (no ops traced)."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [2])
+            y = layers.data("y", [2])
+            flag = layers.data("flag", [1], dtype="bool",
+                               append_batch_size=False)
+            out = cond(flag, lambda: x, lambda: y)
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        xv = np.ones((1, 2), np.float32)
+        yv = 2 * np.ones((1, 2), np.float32)
+        o, = exe.run(main, feed={"x": xv, "y": yv,
+                                 "flag": np.array([False])},
+                     fetch_list=[out], scope=scope)
+        np.testing.assert_allclose(o, yv)
+
+    def test_missing_false_fn_with_outputs_rejected(self, scope):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [2])
+            flag = layers.data("flag", [1], dtype="bool",
+                               append_batch_size=False)
+            with pytest.raises(ValueError, match="false_fn"):
+                cond(flag, lambda: layers.scale(x, scale=2.0))
+
+    def test_branch_reads_predicate(self, scope):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [2])
+            flag = layers.data("flag", [1], dtype="bool",
+                               append_batch_size=False)
+            out = cond(flag,
+                       lambda: layers.cast(flag, "float32"),
+                       lambda: layers.cast(flag, "float32") + 1.0)
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        o, = exe.run(main, feed={"x": np.ones((1, 2), np.float32),
+                                 "flag": np.array([True])},
+                     fetch_list=[out], scope=scope)
+        np.testing.assert_allclose(np.asarray(o).reshape(-1)[0], 1.0)
