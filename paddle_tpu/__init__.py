@@ -39,6 +39,8 @@ from . import utils  # noqa: F401
 from . import generator  # noqa: F401
 from .generator import seed  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import vision  # noqa: F401
+from . import text  # noqa: F401
 
 __version__ = "0.1.0"
 

@@ -26,6 +26,8 @@ __all__ = [
     "Flatten", "Pad2D", "Sequential", "LayerList", "ParameterList",
     "CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCEWithLogitsLoss",
     "SmoothL1Loss", "KLDivLoss", "Upsample", "functional",
+    "InstanceNorm2D", "LSTM", "GRU", "MultiHeadAttention",
+    "TransformerEncoderLayer", "TransformerEncoder",
 ]
 
 
@@ -483,3 +485,203 @@ class KLDivLoss(Layer):
 
     def forward(self, input, label):
         return F.kl_div(input, label, self._reduction)
+
+
+class InstanceNorm2D(Layer):
+    """reference: nn/layer/norm.py InstanceNorm2D (ops/extra_ops.py)."""
+
+    def __init__(self, num_features, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        self._eps = epsilon
+        self.weight = self.create_parameter(
+            [num_features], attr=weight_attr,
+            default_initializer=Constant(1.0))
+        self.bias = self.create_parameter([num_features], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x):
+        from .functional import _op
+
+        return _op("instance_norm",
+                   {"X": [x], "Scale": [self.weight], "Bias": [self.bias]},
+                   {"epsilon": self._eps}, out_slot="Y")
+
+
+class _RNNBase(Layer):
+    def __init__(self, op_type, input_size, hidden_size, gate_mult,
+                 weight_attr=None, bias_attr=None, is_reverse=False):
+        super().__init__()
+        self._op_type = op_type
+        self.hidden_size = hidden_size
+        self._is_reverse = is_reverse
+        self.weight_x = self.create_parameter(
+            [input_size, gate_mult * hidden_size], attr=weight_attr,
+            default_initializer=Xavier())
+        self.weight_h = self.create_parameter(
+            [hidden_size, gate_mult * hidden_size],
+            default_initializer=Xavier())
+        self.bias = self.create_parameter([gate_mult * hidden_size],
+                                          attr=bias_attr, is_bias=True)
+
+
+class LSTM(_RNNBase):
+    """Padded-batch LSTM over [B,S,D] (reference: nn/layer/rnn.py LSTM;
+    lax.scan recurrence — ops/rnn_ops.py). Returns (out, (h, c))."""
+
+    def __init__(self, input_size, hidden_size, weight_attr=None,
+                 bias_attr=None, is_reverse=False, name=None):
+        super().__init__("lstm", input_size, hidden_size, 4, weight_attr,
+                         bias_attr, is_reverse)
+
+    def forward(self, x, states=None, sequence_length=None):
+        from .functional import _op, _static_op
+        from ..core.ir import in_dygraph_mode
+
+        ins = {"Input": [x], "WeightX": [self.weight_x],
+               "WeightH": [self.weight_h], "Bias": [self.bias]}
+        if states is not None:
+            ins["H0"], ins["C0"] = [states[0]], [states[1]]
+        if sequence_length is not None:
+            ins["SequenceLength"] = [sequence_length]
+        attrs = {"is_reverse": self._is_reverse}
+        if in_dygraph_mode():
+            from ..dygraph.tracer import trace_op
+
+            outs = trace_op("lstm", ins, attrs)
+            return outs["Out"][0], (outs["LastH"][0], outs["LastC"][0])
+        out, h, c = _static_op("lstm", ins, attrs,
+                               out_slots=("Out", "LastH", "LastC"))
+        return out, (h, c)
+
+
+class GRU(_RNNBase):
+    """Padded-batch GRU over [B,S,D] (reference: nn/layer/rnn.py GRU)."""
+
+    def __init__(self, input_size, hidden_size, weight_attr=None,
+                 bias_attr=None, is_reverse=False, name=None):
+        super().__init__("gru", input_size, hidden_size, 3, weight_attr,
+                         bias_attr, is_reverse)
+
+    def forward(self, x, states=None, sequence_length=None):
+        from .functional import _static_op
+        from ..core.ir import in_dygraph_mode
+
+        ins = {"Input": [x], "WeightX": [self.weight_x],
+               "WeightH": [self.weight_h], "Bias": [self.bias]}
+        if states is not None:
+            ins["H0"] = [states]
+        if sequence_length is not None:
+            ins["SequenceLength"] = [sequence_length]
+        attrs = {"is_reverse": self._is_reverse}
+        if in_dygraph_mode():
+            from ..dygraph.tracer import trace_op
+
+            outs = trace_op("gru", ins, attrs)
+            return outs["Out"][0], outs["LastH"][0]
+        out, h = _static_op("gru", ins, attrs, out_slots=("Out", "LastH"))
+        return out, h
+
+
+class MultiHeadAttention(Layer):
+    """reference: nn/layer/transformer.py MultiHeadAttention — projections
+    + the Pallas flash-attention op (ops/attention_ops.py)."""
+
+    def __init__(self, embed_dim, num_heads, dropout=0.0, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        if embed_dim % num_heads:
+            raise ValueError(
+                f"num_heads ({num_heads}) must divide embed_dim "
+                f"({embed_dim})")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.q_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
+        self.k_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
+        self.v_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
+        self.out_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
+        # the flash kernel never materialises attention probabilities, so
+        # dropout applies to the attention OUTPUT (not probs — same trade
+        # as the flash path in models/bert.py)
+        self.dropout = Dropout(dropout)
+
+    def _split(self, t):
+        # [B,S,E] -> [B,H,S,hd] via registered ops (works in dygraph AND
+        # static/to_static; VarBase .reshape() would trace as a
+        # non-exportable closure op)
+        from .functional import _op
+
+        b, s = t.shape[0], t.shape[1]
+        r = _op("reshape2", {"X": [t]},
+                {"shape": [b, s, self.num_heads, self.head_dim]})
+        return _op("transpose2", {"X": [r]}, {"axis": [0, 2, 1, 3]})
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                causal=False):
+        from .functional import _op
+
+        key = query if key is None else key
+        value = key if value is None else value
+        q = self._split(self.q_proj(query))
+        k = self._split(self.k_proj(key))
+        v = self._split(self.v_proj(value))
+        ins = {"Q": [q], "K": [k], "V": [v]}
+        if attn_mask is not None:
+            ins["Bias"] = [attn_mask]
+        ctx = _op("flash_attention", ins,
+                  {"causal": causal, "scale": 1.0 / float(self.head_dim) ** 0.5})
+        b, s = query.shape[0], query.shape[1]
+        ctx = _op("transpose2", {"X": [ctx]}, {"axis": [0, 2, 1, 3]})
+        ctx = _op("reshape2", {"X": [ctx]},
+                  {"shape": [b, s, self.embed_dim]})
+        return self.out_proj(self.dropout(ctx))
+
+
+class TransformerEncoderLayer(Layer):
+    """reference: nn/layer/transformer.py TransformerEncoderLayer —
+    pre/post-LN self-attention + FFN block."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
+                 activation="gelu", normalize_before=False, name=None):
+        super().__init__()
+        self.self_attn = MultiHeadAttention(d_model, nhead, dropout)
+        self.linear1 = Linear(d_model, dim_feedforward)
+        self.linear2 = Linear(dim_feedforward, d_model)
+        self.norm1 = LayerNorm(d_model)
+        self.norm2 = LayerNorm(d_model)
+        self.dropout1 = Dropout(dropout)
+        self.dropout2 = Dropout(dropout)
+        self._act = activation
+        self._pre = normalize_before
+
+    def forward(self, src, src_mask=None):
+        import paddle_tpu.nn.functional as F
+
+        act = getattr(F, self._act)
+        x = src
+        attn_in = self.norm1(x) if self._pre else x
+        attn = self.dropout1(self.self_attn(attn_in, attn_mask=src_mask))
+        x = x + attn
+        if not self._pre:
+            x = self.norm1(x)
+        ffn_in = self.norm2(x) if self._pre else x
+        ffn = self.dropout2(self.linear2(act(self.linear1(ffn_in))))
+        x = x + ffn
+        if not self._pre:
+            x = self.norm2(x)
+        return x
+
+
+class TransformerEncoder(Layer):
+    def __init__(self, encoder_layer_fn, num_layers):
+        """encoder_layer_fn: zero-arg factory (layers must not share
+        parameters)."""
+        super().__init__()
+        self.layers = LayerList([encoder_layer_fn() for _ in range(num_layers)])
+
+    def forward(self, src, src_mask=None):
+        x = src
+        for layer in self.layers:
+            x = layer(x, src_mask=src_mask)
+        return x

@@ -1,0 +1,4 @@
+"""paddle.text parity: vocabulary + padding utilities (reference:
+python/paddle/text/; PaddleNLP-era data utils)."""
+
+from .vocab import Vocab, pad_sequences  # noqa: F401

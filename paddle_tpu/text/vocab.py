@@ -1,0 +1,57 @@
+"""Vocabulary + sequence padding (reference: paddle.text / PaddleNLP
+Vocab): token <-> id maps built from a counter, with the pad/unk
+conventions the book models use."""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+
+class Vocab:
+    def __init__(self, counter: Optional[Counter] = None, max_size=None,
+                 min_freq: int = 1,
+                 specials: Sequence[str] = ("<pad>", "<unk>")):
+        self.itos: List[str] = list(specials)
+        seen = set(self.itos)
+        if counter:
+            for tok, freq in counter.most_common(max_size):
+                if freq < min_freq or tok in seen:
+                    continue
+                seen.add(tok)
+                self.itos.append(tok)
+        self.stoi: Dict[str, int] = {t: i for i, t in enumerate(self.itos)}
+        self.unk_id = self.stoi.get("<unk>", 0)
+        self.pad_id = self.stoi.get("<pad>", 0)
+
+    @classmethod
+    def build(cls, corpus: Iterable[Sequence[str]], **kw) -> "Vocab":
+        c = Counter()
+        for sent in corpus:
+            c.update(sent)
+        return cls(c, **kw)
+
+    def __len__(self):
+        return len(self.itos)
+
+    def to_ids(self, tokens: Sequence[str]) -> List[int]:
+        return [self.stoi.get(t, self.unk_id) for t in tokens]
+
+    def to_tokens(self, ids: Sequence[int]) -> List[str]:
+        return [self.itos[i] for i in ids]
+
+
+def pad_sequences(seqs: Sequence[Sequence[int]], maxlen: Optional[int] = None,
+                  pad_id: int = 0, dtype=np.int64):
+    """Ragged id lists → (padded [B, maxlen], lengths [B])."""
+    if maxlen is None:
+        maxlen = max((len(s) for s in seqs), default=0)
+    out = np.full((len(seqs), maxlen), pad_id, dtype)
+    lens = np.zeros((len(seqs),), np.int64)
+    for i, s in enumerate(seqs):
+        k = min(len(s), maxlen)
+        out[i, :k] = np.asarray(list(s)[:k], dtype)
+        lens[i] = k
+    return out, lens

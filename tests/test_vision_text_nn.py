@@ -1,0 +1,140 @@
+"""vision/text modules + new nn 2.0 layers (LSTM/GRU/MHA/Transformer).
+
+Mirrors the reference's vision/transforms tests, text utils tests, and
+nn/layer/rnn + transformer tests."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import dygraph, nn
+from paddle_tpu.dygraph import to_variable
+
+
+class TestTransforms:
+    def test_compose_pipeline(self):
+        from paddle_tpu.vision import transforms
+
+        t = transforms.Compose([transforms.ToTensor(),
+                                transforms.Normalize([0.5], [0.5])])
+        out = t(np.full((28, 28, 1), 255, np.uint8))
+        assert out.shape == (1, 28, 28)
+        np.testing.assert_allclose(out, np.ones((1, 28, 28)), atol=1e-6)
+
+    def test_resize_and_crop(self):
+        from paddle_tpu.vision import transforms
+
+        img = np.arange(28 * 28, dtype=np.uint8).reshape(28, 28)
+        assert transforms.Resize(14)(img).shape == (14, 14)
+        rng = np.random.RandomState(0)
+        assert transforms.RandomCrop(24, rng=rng)(
+            np.zeros((1, 28, 28))).shape == (1, 24, 24)
+        flipped = transforms.RandomHorizontalFlip(
+            prob=1.0)(np.arange(4).reshape(1, 2, 2))
+        np.testing.assert_array_equal(flipped[0], [[1, 0], [3, 2]])
+        # HWC: flip the WIDTH axis, never the channel axis
+        hwc = np.arange(12).reshape(2, 2, 3)
+        fh = transforms.RandomHorizontalFlip(prob=1.0)(hwc)
+        np.testing.assert_array_equal(fh, hwc[:, ::-1])
+        with pytest.raises(ValueError, match="larger than image"):
+            transforms.RandomCrop(32)(np.zeros((28, 28)))
+
+    def test_fake_data_with_loader(self):
+        from paddle_tpu.reader import DataLoader
+        from paddle_tpu.vision import datasets, transforms
+
+        ds = datasets.FakeData(12, transform=transforms.ToTensor())
+        img, label = ds[0]
+        assert img.shape == (1, 28, 28) and label.shape == (1,)
+        batches = list(DataLoader(ds, batch_size=4, shuffle=False))
+        assert len(batches) == 3
+
+
+class TestTextUtils:
+    def test_vocab_roundtrip(self):
+        from paddle_tpu.text import Vocab
+
+        v = Vocab.build([["the", "cat"], ["the", "dog"]])
+        assert v.to_tokens(v.to_ids(["the", "cat"])) == ["the", "cat"]
+        assert v.to_ids(["unseen"]) == [v.unk_id]
+
+    def test_pad_sequences(self):
+        from paddle_tpu.text import pad_sequences
+
+        padded, lens = pad_sequences([[1, 2, 3], [4]], maxlen=4, pad_id=9)
+        np.testing.assert_array_equal(padded, [[1, 2, 3, 9], [4, 9, 9, 9]])
+        assert lens.tolist() == [3, 1]
+
+
+class TestNewNNLayers:
+    def test_lstm_gru_layers_train(self):
+        with dygraph.guard():
+            rng = np.random.RandomState(0)
+            x = to_variable(rng.randn(2, 5, 8).astype(np.float32))
+            lstm = nn.LSTM(8, 6)
+            out, (h, c) = lstm(x)
+            assert out.shape == [2, 5, 6] and h.shape == [2, 6]
+            gru = nn.GRU(8, 6)
+            gout, gh = gru(x)
+            assert gout.shape == [2, 5, 6]
+            loss = (out * out).mean() + (gout * gout).mean()
+            loss.backward()
+            assert any(p.gradient() is not None for p in lstm.parameters())
+
+    def test_transformer_encoder(self):
+        with dygraph.guard():
+            rng = np.random.RandomState(1)
+            x = to_variable(rng.randn(2, 6, 16).astype(np.float32))
+            enc = nn.TransformerEncoder(
+                lambda: nn.TransformerEncoderLayer(16, 2, 32, dropout=0.0), 2)
+            y = enc(x)
+            assert y.shape == [2, 6, 16]
+            (y * y).mean().backward()
+            grads = [p.gradient() for p in enc.parameters()]
+            assert sum(g is not None for g in grads) == len(grads)
+            # two layers must NOT share parameters
+            names = [p.name for p in enc.parameters()]
+            assert len(names) == len(set(names))
+
+    def test_lstm_through_to_static(self):
+        """nn Layers are dygraph-first; the static path is @to_static
+        (static programs use layers.lstm_unit_layer directly)."""
+        from paddle_tpu.dygraph import to_static
+
+        class Net(dygraph.Layer):
+            def __init__(self):
+                super().__init__()
+                self.rnn = nn.LSTM(8, 6)
+
+            @to_static
+            def forward(self, x):
+                out, _ = self.rnn(x)
+                return out
+
+        with dygraph.guard():
+            net = Net()
+            x = to_variable(np.ones((2, 5, 8), np.float32))
+            y = net(x)
+            assert y.shape == [2, 5, 6]
+
+    def test_mha_exports_through_jit_save(self, tmp_path):
+        """MultiHeadAttention uses registered ops only, so a to_static
+        trace of a transformer block is exportable."""
+        from paddle_tpu.dygraph import jit, to_static
+
+        class Net(dygraph.Layer):
+            def __init__(self):
+                super().__init__()
+                self.attn = nn.MultiHeadAttention(16, 2, dropout=0.0)
+
+            @to_static
+            def forward(self, x):
+                return self.attn(x)
+
+        with dygraph.guard():
+            net = Net()
+            x = np.random.RandomState(0).randn(2, 4, 16).astype(np.float32)
+            want = net(dygraph.to_variable(x)).numpy()
+            jit.save(net, str(tmp_path / "mha"))
+        loaded = jit.load(str(tmp_path / "mha"))
+        np.testing.assert_allclose(loaded(x), want, atol=1e-5)
