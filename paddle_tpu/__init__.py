@@ -41,6 +41,8 @@ from .generator import seed  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import vision  # noqa: F401
 from . import text  # noqa: F401
+from . import tensor  # noqa: F401
+from .tensor import to_tensor  # noqa: F401
 
 __version__ = "0.1.0"
 

@@ -1,0 +1,114 @@
+"""paddle.tensor-style 2.0 functional API tests — dual-mode dispatch
+(reference: python/paddle/tensor/ function lib tests)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import dygraph, layers
+from paddle_tpu import tensor as T
+
+
+class TestDygraphTensorApi:
+    def test_math_and_grad(self):
+        with dygraph.guard():
+            x = pt.to_tensor(np.arange(6.0).reshape(2, 3).astype(np.float32),
+                             stop_gradient=False)
+            y = T.matmul(x, T.transpose(x, [1, 0]))
+            assert y.shape == [2, 2]
+            # clip bounds strictly between samples (grads at exact
+            # boundaries are subgradient 0.5 in jax)
+            loss = T.sum(T.exp(T.clip(x, -0.5, 4.5)))
+            loss.backward()
+            g = x.gradient()
+            base = np.arange(6.0).reshape(2, 3)
+            want = np.exp(np.clip(base, -0.5, 4.5))
+            want[base > 4.5] = 0
+            np.testing.assert_allclose(g, want, rtol=1e-5)
+
+    def test_creation_and_manipulation(self):
+        with dygraph.guard():
+            o = T.ones([2, 2])
+            z = T.zeros_like(o)
+            a = T.concat([o, z], axis=0)
+            assert a.shape == [4, 2]
+            st = T.stack([o, o], axis=0)
+            assert st.shape == [2, 2, 2]
+            parts = T.split(T.ones([4, 2]), 2, axis=0)
+            assert len(parts) == 2 and parts[0].shape == [2, 2]
+            v, i = T.topk(pt.to_tensor(np.array([3.0, 1.0, 2.0], np.float32)), 2)
+            assert v.numpy().tolist() == [3.0, 2.0]
+            assert i.numpy().tolist() == [0, 2]
+            np.testing.assert_allclose(
+                T.tril(T.ones([3, 3])).numpy(),
+                np.tril(np.ones((3, 3))))
+            r = T.arange(5, dtype="int32")
+            assert r.numpy().tolist() == [0, 1, 2, 3, 4]
+
+    def test_reductions_and_compare(self):
+        with dygraph.guard():
+            x = pt.to_tensor(np.array([[1.0, 5.0], [3.0, 2.0]], np.float32))
+            assert float(T.max(x).numpy().reshape(-1)[0]) == 5.0
+            m = T.mean(x, axis=0)
+            np.testing.assert_allclose(m.numpy(), [2.0, 3.5])
+            eq = T.greater_than(x, T.full([2, 2], 2.5))
+            assert eq.numpy().astype(int).sum() == 2
+
+
+class TestStaticTensorApi:
+    def test_static_mode_builds_and_runs(self, scope):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [3], stop_gradient=True)
+            y = T.add(T.scale(x, scale=2.0), T.ones([1, 3]))
+            s = T.sum(y)
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        out, = exe.run(main, feed={"x": np.ones((1, 3), np.float32)},
+                       fetch_list=[s], scope=scope)
+        assert float(np.asarray(out).reshape(-1)[0]) == pytest.approx(9.0)
+
+    def test_static_split(self, scope):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [4], stop_gradient=True)
+            a, b = T.split(x, 2, axis=1)
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        av, bv = exe.run(main, feed={"x": np.arange(4.0, dtype=np.float32)
+                                     .reshape(1, 4)},
+                         fetch_list=[a, b], scope=scope)
+        np.testing.assert_allclose(av, [[0.0, 1.0]])
+        np.testing.assert_allclose(bv, [[2.0, 3.0]])
+
+
+class TestTensorApiEdgeCases:
+    def test_topk_axis0(self):
+        with dygraph.guard():
+            x = pt.to_tensor(np.array([[3, 1], [0, 5], [2, 4]], np.float32))
+            v, i = T.topk(x, 2, axis=0)
+            np.testing.assert_allclose(v.numpy(), [[3, 5], [2, 4]])
+            np.testing.assert_array_equal(i.numpy(), [[0, 1], [2, 2]])
+
+    def test_arange_float_inference(self):
+        with dygraph.guard():
+            r = T.arange(0, 1, 0.25)
+            np.testing.assert_allclose(r.numpy(), [0.0, 0.25, 0.5, 0.75])
+
+    def test_clip_preserves_int_dtype(self):
+        with dygraph.guard():
+            x = pt.to_tensor(np.array([1, 5, 9], np.int32))
+            y = T.clip(x, max=4)
+            assert "int" in str(y.numpy().dtype)
+            np.testing.assert_array_equal(y.numpy(), [1, 4, 4])
+
+    def test_eye_zero_columns(self):
+        with dygraph.guard():
+            assert T.eye(3, 0).shape == [3, 0]
+
+    def test_argmax_flatten_default(self):
+        with dygraph.guard():
+            x = pt.to_tensor(np.array([[1, 9], [3, 2]], np.float32))
+            assert int(T.argmax(x).numpy().reshape(-1)[0]) == 1
+            per_row = T.argmax(x, axis=1)
+            np.testing.assert_array_equal(per_row.numpy(), [1, 0])
