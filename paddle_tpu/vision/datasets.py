@@ -13,13 +13,8 @@ import struct
 
 import numpy as np
 
-
-class Dataset:
-    def __len__(self):
-        raise NotImplementedError
-
-    def __getitem__(self, idx):
-        raise NotImplementedError
+# the map-style Dataset base the DataLoader/hapi Model recognise
+from ..reader import Dataset
 
 
 class FakeData(Dataset):

@@ -138,3 +138,37 @@ class TestNewNNLayers:
             jit.save(net, str(tmp_path / "mha"))
         loaded = jit.load(str(tmp_path / "mha"))
         np.testing.assert_allclose(loaded(x), want, atol=1e-5)
+
+
+class TestHapiWithVision:
+    def test_model_fit_on_fake_mnist(self):
+        """hapi Model.fit over a vision dataset + transforms — the
+        reference's test_model.py MNIST recipe, end to end."""
+        from paddle_tpu.hapi import Model
+        from paddle_tpu.metric import Accuracy
+        from paddle_tpu.vision import datasets, transforms
+
+        class ConvNet(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.conv = nn.Conv2D(1, 4, 3, padding=1)
+                self.fc = nn.Linear(4 * 14 * 14, 10)
+                self.pool = nn.MaxPool2D(2, stride=2)
+
+            def forward(self, x):
+                h = self.pool(nn.functional.relu(self.conv(x)))
+                b = h.shape[0]
+                return self.fc(h.reshape([b, 4 * 14 * 14]))
+
+        ds = datasets.FakeData(
+            64, transform=transforms.Compose(
+                [transforms.ToTensor(), transforms.Normalize([0.5], [0.5])]))
+        with pt.dygraph.guard():
+            model = Model(ConvNet())
+            model.prepare(pt.optimizer.AdamOptimizer(
+                1e-3, parameter_list=model.network.parameters()),
+                nn.CrossEntropyLoss(), metrics=Accuracy())
+            hist = model.fit(ds, batch_size=16, epochs=2, verbose=0)
+            eval_out = model.evaluate(ds, batch_size=16, verbose=0)
+        assert np.isfinite(eval_out["eval_loss"])
+        assert 0.0 <= eval_out["eval_acc"] <= 1.0
