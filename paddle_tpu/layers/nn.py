@@ -953,3 +953,48 @@ def take_along_axis(input, index, axis, name=None):
     helper.append_op("take_along_axis", {"Input": [input], "Index": [index]},
                      {"Result": [out]}, {"Axis": axis})
     return out
+
+
+def switch_moe(x, num_experts, d_ff, capacity_factor=1.25, axis_name="ep",
+               ep_size=1, activation="gelu", param_attr=None, name=None):
+    """Switch-Transformer MoE FFN (ops/moe_ops.py, parallel/moe.py): top-1
+    routing with capacity; expert weights sharded over the 'ep' mesh axis.
+    Returns (out, aux_loss) — add aux_loss (scaled ~1e-2) to the training
+    loss. `ep_size` sets the collective rank requirement (the mesh's ep
+    extent; 1 = single device holds all experts)."""
+    from ..parallel.api import shard_tensor
+
+    helper = LayerHelper("switch_moe", name=name)
+    h = int(x.shape[-1])
+    dtype = x.dtype
+
+    def _attr(suffix):
+        base = ParamAttr._to_attr(param_attr) or ParamAttr()
+        import copy
+
+        a = copy.copy(base)
+        a.name = unique_name.generate((name or "moe") + suffix)
+        return a
+
+    gate_w = helper.create_parameter(_attr("_gate"), [h, num_experts], dtype)
+    w1 = helper.create_parameter(_attr("_w1"), [num_experts, h, d_ff], dtype)
+    b1 = helper.create_parameter(_attr("_b1"), [num_experts, d_ff], dtype,
+                                 is_bias=True)
+    w2 = helper.create_parameter(_attr("_w2"), [num_experts, d_ff, h], dtype)
+    b2 = helper.create_parameter(_attr("_b2"), [num_experts, h], dtype,
+                                 is_bias=True)
+    for p in (w1, b1, w2, b2):
+        shard_tensor(p, ("ep",) + (None,) * (len(p.shape) - 1))
+    out = helper.create_variable_for_type_inference(dtype)
+    out.desc.shape = list(x.shape)     # op is skip_infer_shape
+    # aux MUST be differentiable — it is the router's only balancing signal
+    aux = helper.create_variable_for_type_inference("float32")
+    aux.desc.shape = []
+    helper.append_op("switch_moe",
+                     {"X": [x], "GateW": [gate_w], "W1": [w1], "B1": [b1],
+                      "W2": [w2], "B2": [b2]},
+                     {"Out": [out], "AuxLoss": [aux]},
+                     {"capacity_factor": capacity_factor,
+                      "axis_name": axis_name, "activation": activation,
+                      "nranks": int(ep_size)})
+    return out, aux

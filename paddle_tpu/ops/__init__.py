@@ -43,3 +43,7 @@ try:
     from . import quant_ops  # noqa: F401
 except ImportError:
     pass
+try:
+    from . import moe_ops  # noqa: F401
+except ImportError:
+    pass

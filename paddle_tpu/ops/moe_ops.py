@@ -1,0 +1,30 @@
+"""MoE op — Switch FFN with expert parallelism (parallel/moe.py).
+
+Greenfield vs the reference (SURVEY.md §2.7: EP absent). The op flattens
+[B,S,H] to tokens, routes top-1 with capacity, and runs the expert shard
+held by this rank ('ep' mesh axis); outputs the combined tokens plus the
+load-balancing aux loss (add it to the training loss scaled by
+aux_weight, Switch Transformer recipe).
+"""
+
+from __future__ import annotations
+
+from ..core.registry import register_op
+
+
+@register_op("switch_moe", is_collective=True, skip_infer_shape=True)
+def switch_moe_op(ins, attrs):
+    from ..parallel.moe import switch_moe
+
+    x = ins["X"][0]
+    gate_w = ins["GateW"][0]
+    w1, b1 = ins["W1"][0], ins["B1"][0]
+    w2, b2 = ins["W2"][0], ins["B2"][0]
+    h = x.shape[-1]
+    flat = x.reshape(-1, h)
+    out, aux = switch_moe(
+        flat, gate_w, w1, b1, w2, b2,
+        capacity_factor=float(attrs.get("capacity_factor", 1.25)),
+        axis_name=attrs.get("axis_name", "ep"),
+        activation=attrs.get("activation", "gelu"))
+    return {"Out": out.reshape(x.shape), "AuxLoss": aux}

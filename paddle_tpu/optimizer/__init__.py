@@ -108,6 +108,15 @@ class Optimizer:
         var = layers_nn.create_global_var(
             shape or list(param.shape), fill_value, dtype, persistable=True,
             name=unique_name.generate(f"{param.name}_{name}"))
+        # moments of a sharded param must shard the same way (shard_map
+        # in_specs come from var annotations; a replicated moment would
+        # meet a sharded grad inside the update op)
+        if shape is None or list(shape) == list(param.shape):
+            from ..parallel.api import get_sharding_spec, shard_tensor
+
+            spec = get_sharding_spec(param)
+            if spec is not None:
+                shard_tensor(var, spec)
         acc[param.name] = var
         return var
 

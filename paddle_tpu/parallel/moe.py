@@ -1,0 +1,85 @@
+"""Mixture-of-Experts with expert parallelism over the 'ep' mesh axis.
+
+Greenfield capability (SURVEY.md §2.7: EP is absent from the reference —
+its sparse story is parameter servers). TPU-native design, the
+Switch/GShard recipe: top-1 gating with capacity, dense one-hot dispatch
+(einsum-shaped for the MXU), experts sharded over 'ep', and
+`lax.all_to_all` carrying token slots to their expert's rank and back over
+ICI. Reverse AD flows through (all_to_all transposes to all_to_all).
+
+Outside an SPMD region every expert lives on the one device and the
+all_to_alls drop out — same math, no comm.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops.collective_ops import _in_spmd
+
+
+def switch_moe(x, gate_w, w1, b1, w2, b2, capacity_factor: float = 1.25,
+               axis_name: str = "ep", activation: str = "gelu"):
+    """Top-1 (Switch) MoE FFN.
+
+    x       [T, H]   tokens (flattened batch — replicated over 'ep')
+    gate_w  [H, E]   router (replicated)
+    w1      [E_local, H, F], b1 [E_local, F]   this rank's expert shard
+    w2      [E_local, F, H], b2 [E_local, H]
+    Returns ([T, H] combined output, aux_loss scalar) — aux_loss is the
+    Switch load-balancing loss (mean_prob · fraction_routed · E).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    t, h = x.shape
+    e_local = w1.shape[0]
+    spmd = _in_spmd(axis_name)
+    ep = lax.axis_size(axis_name) if spmd else 1
+    e = e_local * ep
+
+    xf = x.astype(jnp.float32)
+    logits = xf @ gate_w.astype(jnp.float32)           # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)            # [T]
+    gate = jnp.max(probs, axis=-1)                     # [T]
+
+    cap = int(np.ceil(t / e * capacity_factor))
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)   # [T, E]
+    # position of each token within its expert's queue
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0             # [T, E]
+    keep = (pos >= 0) & (pos < cap)
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), cap,
+                            dtype=jnp.float32) * keep[..., None]
+    dispatch = onehot[..., None] * pos_oh                       # [T, E, C]
+    combine = dispatch * gate[:, None, None]
+
+    # aux load-balancing loss (Switch Transformer eq. 4)
+    frac_routed = jnp.mean(onehot, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = jnp.sum(frac_routed * mean_prob) * e
+
+    if spmd:
+        # tokens (and hence the dispatch tensor) are replicated over 'ep',
+        # so each rank SLICES its own experts' queues BEFORE the dispatch
+        # einsum (slicing after would burn ep-times the MXU work) and the
+        # results all_gather back — one collective. (With dp-sharded
+        # tokens the dispatch itself would shard and this becomes the
+        # all_to_all exchange; that composition is future work.)
+        idx = lax.axis_index(axis_name)
+        disp_local = lax.dynamic_index_in_dim(
+            dispatch.reshape(t, ep, e_local, cap), idx, axis=1,
+            keepdims=False)                                     # [T,E_l,C]
+        exp_in = jnp.einsum("tec,th->ech", disp_local, xf)      # [E_l,C,H]
+    else:
+        exp_in = jnp.einsum("tec,th->ech", dispatch, xf)        # [E, C, H]
+    act = jax.nn.gelu if activation == "gelu" else getattr(jax.nn, activation)
+    hmid = act(jnp.einsum("ekh,ehf->ekf", exp_in, w1.astype(jnp.float32))
+               + b1[:, None, :].astype(jnp.float32))
+    exp_out = jnp.einsum("ekf,efh->ekh", hmid, w2.astype(jnp.float32)) \
+        + b2[:, None, :].astype(jnp.float32)                    # [E_l, C, H]
+    if spmd:
+        exp_out = lax.all_gather(exp_out, axis_name).reshape(e, cap, h)
+    out = jnp.einsum("tec,ech->th", combine, exp_out)
+    return out.astype(x.dtype), aux.astype(jnp.float32)
