@@ -123,3 +123,187 @@ def synthetic_translation_batch(cfg: Seq2SeqConfig, batch: int, src_len: int,
     tgt_mask = (np.arange(tgt_len)[None, :] < tgt_l[:, None]).astype(np.float32)
     return dict(src_ids=src, src_mask=src_mask, tgt_in=tgt[:, :-1],
                 tgt_out=tgt[:, 1:], tgt_mask=tgt_mask)
+
+
+def _decode_params(scope):
+    import jax.numpy as jnp
+
+    fixed = ["src_embedding", "tgt_embedding", "enc_wx", "enc_b", "dec_wx",
+             "dec_b", "attn_w", "attn_b", "out_w", "out_b"]
+    params = {}
+    for n in fixed:
+        v = scope.find_var(n)
+        if v is None:
+            raise KeyError(f"decode: param '{n}' not in scope")
+        params[n] = jnp.asarray(v)
+    # recurrent weights carry a unique_name suffix (encoder_wh_<k>) that
+    # depends on how many LSTMs the process built — resolve by prefix
+    for key, prefix in (("enc_wh", "encoder_wh"), ("dec_wh", "decoder_wh")):
+        cands = sorted(n for n in scope.local_var_names()
+                       if n.startswith(prefix))
+        if not cands:
+            raise KeyError(f"decode: no '{prefix}*' param in scope")
+        params[key] = jnp.asarray(scope.find_var(cands[0]))
+    return params
+
+
+def _encode(p, src_emb, src_mask, hidden):
+    """Shared encoder recurrence for the decode paths — MUST match the
+    training-time lstm op (ops/rnn_ops.py: ifco gates, state frozen past
+    each row's true length)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    b = src_emb.shape[0]
+    lens = jnp.sum(src_mask, axis=1)
+
+    def enc_step(carry, xt):
+        hh, cc = carry
+        x_t, t = xt
+        gates = x_t @ p["enc_wx"] + p["enc_b"] + hh @ p["enc_wh"]
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        cc_n = jax.nn.sigmoid(f) * cc + jax.nn.sigmoid(i) * jnp.tanh(g)
+        hh_n = jax.nn.sigmoid(o) * jnp.tanh(cc_n)
+        alive = (t < lens)[:, None]
+        hh = jnp.where(alive, hh_n, hh)
+        cc = jnp.where(alive, cc_n, cc)
+        return (hh, cc), hh
+
+    init = (jnp.zeros((b, hidden)), jnp.zeros((b, hidden)))
+    ss = src_emb.shape[1]
+    (eh, ec), states = lax.scan(enc_step, init,
+                                (jnp.swapaxes(src_emb, 0, 1),
+                                 jnp.arange(ss)))
+    return eh, ec, jnp.swapaxes(states, 0, 1)
+
+
+def greedy_decode(cfg: Seq2SeqConfig, scope, src_ids, src_mask,
+                  bos_id: int = 1, eos_id: int = 2, max_len: int = 32):
+    """Greedy autoregressive decoding with the trained parameters — the
+    book model's inference step (reference: test_machine_translation.py
+    decode_main / beam_search). The whole loop is one lax.scan inside one
+    jit: per-step attention over the encoder states, argmax token feed-back.
+    Returns [B, max_len] int32 token ids."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    p = _decode_params(scope)
+    h = cfg.hidden_size
+    src_ids = jnp.asarray(src_ids, jnp.int32)
+    src_mask = jnp.asarray(src_mask, jnp.float32)
+    b = src_ids.shape[0]
+
+    @jax.jit
+    def run(src_ids, src_mask):
+        eh, ec, enc_states = _encode(p, p["src_embedding"][src_ids],
+                                     src_mask, h)
+        bias = (src_mask - 1.0) * 1e4                      # [B,Ss]
+
+        def dec_step(carry, _):
+            hh, cc, tok, done = carry
+            emb = p["tgt_embedding"][tok]                  # [B,E]
+            gates = emb @ p["dec_wx"] + p["dec_b"] + hh @ p["dec_wh"]
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            cc = jax.nn.sigmoid(f) * cc + jax.nn.sigmoid(i) * jnp.tanh(g)
+            hh = jax.nn.sigmoid(o) * jnp.tanh(cc)
+            scores = jnp.einsum("bh,bsh->bs", hh, enc_states) / np.sqrt(h)
+            probs = jax.nn.softmax(scores + bias, axis=-1)
+            ctx = jnp.einsum("bs,bsh->bh", probs, enc_states)
+            attn = jnp.tanh(jnp.concatenate([hh, ctx], -1) @ p["attn_w"]
+                            + p["attn_b"])
+            logits = attn @ p["out_w"] + p["out_b"]
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+            return (hh, cc, nxt, done), nxt
+
+        bos = jnp.full((b,), bos_id, jnp.int32)
+        done0 = jnp.zeros((b,), bool)
+        _, toks = lax.scan(dec_step, (eh, ec, bos, done0), None,
+                           length=max_len)
+        return jnp.swapaxes(toks, 0, 1)                    # [B, max_len]
+
+    return np.asarray(run(src_ids, src_mask))
+
+
+def beam_search_decode(cfg: Seq2SeqConfig, scope, src_ids, src_mask,
+                       beam_size: int = 4, bos_id: int = 1, eos_id: int = 2,
+                       max_len: int = 32, length_penalty: float = 0.6):
+    """Beam search (reference: layers/beam_search + beam_search_decode ops):
+    fixed-width beams as one lax.scan — beams live in a [B, K] batch axis,
+    finished beams freeze with a length-penalised score. Returns the best
+    sequence per example, [B, max_len] int32."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    p = _decode_params(scope)
+    h = cfg.hidden_size
+    v = cfg.tgt_vocab_size
+    src_ids = jnp.asarray(src_ids, jnp.int32)
+    src_mask = jnp.asarray(src_mask, jnp.float32)
+    b = src_ids.shape[0]
+    k = beam_size
+
+    @jax.jit
+    def run(src_ids, src_mask):
+        eh, ec, enc_states = _encode(p, p["src_embedding"][src_ids],
+                                     src_mask, h)
+
+        # tile beams: [B*K, ...]
+        def tile(x):
+            return jnp.repeat(x, k, axis=0)
+        enc_t, bias_t = tile(enc_states), tile((src_mask - 1.0) * 1e4)
+        hh, cc = tile(eh), tile(ec)
+        tok = jnp.full((b * k,), bos_id, jnp.int32)
+        # only beam 0 alive initially (others -inf so first expand is unique)
+        score = jnp.tile(jnp.array([0.0] + [-1e9] * (k - 1)), b)
+        done = jnp.zeros((b * k,), bool)
+        seqs = jnp.zeros((b * k, max_len), jnp.int32)
+
+        def step(carry, t):
+            hh, cc, tok, score, done, seqs = carry
+            emb = p["tgt_embedding"][tok]
+            gates = emb @ p["dec_wx"] + p["dec_b"] + hh @ p["dec_wh"]
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            cc_n = jax.nn.sigmoid(f) * cc + jax.nn.sigmoid(i) * jnp.tanh(g)
+            hh_n = jax.nn.sigmoid(o) * jnp.tanh(cc_n)
+            sc = jnp.einsum("bh,bsh->bs", hh_n, enc_t) / np.sqrt(h)
+            probs = jax.nn.softmax(sc + bias_t, axis=-1)
+            ctx = jnp.einsum("bs,bsh->bh", probs, enc_t)
+            attn = jnp.tanh(jnp.concatenate([hh_n, ctx], -1) @ p["attn_w"]
+                            + p["attn_b"])
+            logp = jax.nn.log_softmax(attn @ p["out_w"] + p["out_b"], -1)
+            # finished beams may only extend with EOS at no cost
+            eos_only = jnp.full((b * k, v), -1e9).at[:, eos_id].set(0.0)
+            logp = jnp.where(done[:, None], eos_only, logp)
+            cand = score[:, None] + logp                  # [B*K, V]
+            cand = cand.reshape(b, k * v)
+            top_sc, top_ix = lax.top_k(cand, k)           # [B, K]
+            beam_ix = top_ix // v                         # source beam
+            tok_ix = (top_ix % v).astype(jnp.int32)
+            flat_beam = (jnp.arange(b)[:, None] * k + beam_ix).reshape(-1)
+            hh_n = hh_n[flat_beam]
+            cc_n = cc_n[flat_beam]
+            seqs_n = seqs[flat_beam].at[:, t].set(tok_ix.reshape(-1))
+            done_n = done[flat_beam] | (tok_ix.reshape(-1) == eos_id)
+            return (hh_n, cc_n, tok_ix.reshape(-1), top_sc.reshape(-1),
+                    done_n, seqs_n), None
+
+        (hh, cc, tok, score, done, seqs), _ = lax.scan(
+            step, (hh, cc, tok, score, done, seqs), jnp.arange(max_len))
+        # length-penalised best beam (GNMT penalty); length = tokens up
+        # to and including the first EOS (token id 0 is a legitimate
+        # vocab entry, not padding)
+        iseos = seqs == eos_id
+        has_eos = jnp.any(iseos, axis=-1)
+        first_eos = jnp.argmax(iseos, axis=-1)
+        lengths = jnp.where(has_eos, first_eos + 1.0, float(max_len))
+        lp = ((5.0 + lengths) / 6.0) ** length_penalty
+        final = (score / lp).reshape(b, k)
+        best = jnp.argmax(final, axis=-1)
+        return seqs.reshape(b, k, max_len)[jnp.arange(b), best]
+
+    return np.asarray(run(src_ids, src_mask))

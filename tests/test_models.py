@@ -177,3 +177,48 @@ def test_seq2seq_machine_translation_trains():
         losses.append(float(np.asarray(lv).reshape(-1)[0]))
     assert np.isfinite(losses[-1])
     assert losses[-1] < losses[0] - 0.2
+
+
+def test_seq2seq_decoding_greedy_and_beam():
+    """Decoding after training a copy task: greedy + beam produce valid,
+    deterministic sequences; beam with k=1 equals greedy (reference:
+    book machine_translation decode_main/beam_search)."""
+    import paddle_tpu as pt
+    from paddle_tpu.models import seq2seq
+
+    cfg = seq2seq.Seq2SeqConfig(src_vocab_size=24, tgt_vocab_size=24,
+                                embed_dim=16, hidden_size=32)
+    S = 6
+    main, startup, feeds, fetches = seq2seq.build_seq2seq_program(
+        cfg, src_len=S, tgt_len=S, lr=2e-2)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    exe.run(startup, scope=scope, use_compiled=False)
+    rng = np.random.RandomState(0)
+    # copy task: target = source (teacher forced)
+    for _ in range(200):
+        src = rng.randint(3, cfg.src_vocab_size, (16, S)).astype(np.int64)
+        feed = dict(src_ids=src, src_mask=np.ones((16, S), np.float32),
+                    tgt_in=np.concatenate(
+                        [np.ones((16, 1), np.int64), src[:, :-1]], 1),
+                    tgt_out=src, tgt_mask=np.ones((16, S), np.float32))
+        exe.run(main, feed=feed, fetch_list=[fetches["loss"]], scope=scope)
+
+    src = rng.randint(3, cfg.src_vocab_size, (4, S)).astype(np.int64)
+    mask = np.ones((4, S), np.float32)
+    g1 = seq2seq.greedy_decode(cfg, scope, src, mask, max_len=S)
+    g2 = seq2seq.greedy_decode(cfg, scope, src, mask, max_len=S)
+    np.testing.assert_array_equal(g1, g2)        # deterministic
+    assert g1.shape == (4, S) and g1.dtype == np.int32
+    b1 = seq2seq.beam_search_decode(cfg, scope, src, mask, beam_size=1,
+                                    max_len=S, length_penalty=0.0)
+    np.testing.assert_array_equal(b1, g1)        # k=1 beam == greedy
+    b4 = seq2seq.beam_search_decode(cfg, scope, src, mask, beam_size=4,
+                                    max_len=S)
+    assert b4.shape == (4, S)
+    # decode must reflect the trained model: accuracy well above the
+    # 1/24 chance level (empirically it tracks exp(-loss) of the
+    # teacher-forced training loss, confirming the decode recurrence
+    # matches the training-time lstm op)
+    acc = float((g1 == src).mean())
+    assert acc > 0.15, acc          # ~4x chance
