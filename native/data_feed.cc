@@ -332,6 +332,9 @@ uint64_t ptds_stat_records_parsed() { return g_records_parsed.load(); }
 
 void ptds_stream_begin(void* h, int batch_size, int nthreads) {
   auto* ds = static_cast<Dataset*>(h);
+  // join any previous stream's parser threads before resetting the channel
+  // they may still be Put()-ing into (idempotent when no stream is live)
+  ptds_stream_end(h);
   ds->error.clear();
   ds->batch_size = batch_size < 1 ? 1 : batch_size;
   if (nthreads < 1) nthreads = 1;

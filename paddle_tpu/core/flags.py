@@ -94,3 +94,7 @@ define_flag("cudnn_deterministic", False,
             "deterministic kernels (XLA is deterministic by default)")
 define_flag("max_inplace_grad_add", 0,
             "grad accumulation chunking (API compat)")
+define_flag("infer_shape_debug", False,
+            "warn (with op type + error) when build-time shape inference "
+            "fails instead of silently skipping — surfaces op-lowering bugs "
+            "at program-build time rather than at jit time")

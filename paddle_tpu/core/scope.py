@@ -8,14 +8,19 @@ jax.Arrays (device-resident), numpy arrays, or opaque Python objects
 
 from __future__ import annotations
 
+import itertools
 from typing import Any, Dict, Iterator, Optional
 
 
 class Scope:
+    _uid_counter = itertools.count()
+
     def __init__(self, parent: Optional["Scope"] = None):
         self._vars: Dict[str, Any] = {}
         self.parent = parent
         self.kids: list[Scope] = []
+        # process-unique, never-reused identity for executor cache keys
+        self.uid = next(Scope._uid_counter)
 
     def new_scope(self) -> "Scope":
         kid = Scope(self)

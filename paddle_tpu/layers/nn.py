@@ -956,12 +956,18 @@ def take_along_axis(input, index, axis, name=None):
 
 
 def switch_moe(x, num_experts, d_ff, capacity_factor=1.25, axis_name="ep",
-               ep_size=1, activation="gelu", param_attr=None, name=None):
+               ep_size=1, activation="gelu", param_attr=None, name=None,
+               tokens_sharded=False):
     """Switch-Transformer MoE FFN (ops/moe_ops.py, parallel/moe.py): top-1
     routing with capacity; expert weights sharded over the 'ep' mesh axis.
     Returns (out, aux_loss) — add aux_loss (scaled ~1e-2) to the training
     loss. `ep_size` sets the collective rank requirement (the mesh's ep
-    extent; 1 = single device holds all experts)."""
+    extent; 1 = single device holds all experts).
+
+    tokens_sharded=True: the token batch is data-parallel over the SAME
+    'ep' axis (dp x ep composition) — token slots travel to their
+    expert's rank and back via all_to_all (GShard dispatch) instead of
+    being replicated."""
     from ..parallel.api import shard_tensor
 
     helper = LayerHelper("switch_moe", name=name)
@@ -996,5 +1002,6 @@ def switch_moe(x, num_experts, d_ff, capacity_factor=1.25, axis_name="ep",
                      {"Out": [out], "AuxLoss": [aux]},
                      {"capacity_factor": capacity_factor,
                       "axis_name": axis_name, "activation": activation,
+                      "tokens_sharded": bool(tokens_sharded),
                       "nranks": int(ep_size)})
     return out, aux

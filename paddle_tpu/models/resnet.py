@@ -95,9 +95,14 @@ def resnet_backbone(img, cfg: ResNetConfig, is_test=False):
 
 def build_classifier_program(cfg: ResNetConfig, batch_size: int = -1,
                              optimizer_name: str = "momentum", lr: float = 0.1,
-                             is_test: bool = False, with_optimizer: bool = True):
+                             is_test: bool = False, with_optimizer: bool = True,
+                             amp: bool = False):
     """ImageNet classification step. Feeds: img [B,3,H,W], label [B,1].
-    Fetches: loss, acc1, acc5."""
+    Fetches: loss, acc1, acc5.
+
+    amp=True wraps the optimizer in the static AMP decorator
+    (contrib/mixed_precision) so conv/matmul compute runs in bf16 —
+    the TPU equivalent of the reference's fp16 ResNet recipe."""
     main, startup = Program(), Program()
     with program_guard(main, startup):
         img = layers.static_data("img", [batch_size, *cfg.image_shape])
@@ -126,6 +131,10 @@ def build_classifier_program(cfg: ResNetConfig, batch_size: int = -1,
                 opt = opt_mod.AdamOptimizer(lr)
             else:
                 raise ValueError(f"unknown optimizer '{optimizer_name}'")
+            if amp:
+                from ..contrib.mixed_precision import decorate
+
+                opt = decorate(opt, use_dynamic_loss_scaling=False)
             opt.minimize(loss)
     feeds = dict(img=img, label=label)
     fetches = dict(loss=loss, acc1=acc1, acc5=acc5)

@@ -26,5 +26,6 @@ def switch_moe_op(ins, attrs):
         flat, gate_w, w1, b1, w2, b2,
         capacity_factor=float(attrs.get("capacity_factor", 1.25)),
         axis_name=attrs.get("axis_name", "ep"),
-        activation=attrs.get("activation", "gelu"))
+        activation=attrs.get("activation", "gelu"),
+        tokens_sharded=bool(attrs.get("tokens_sharded", False)))
     return {"Out": out.reshape(x.shape), "AuxLoss": aux}

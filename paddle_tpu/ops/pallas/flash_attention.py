@@ -23,8 +23,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# 512x512 blocks measured ~2x faster than 128x128 on v5e (fewer grid
+# steps -> less per-step VPU softmax bookkeeping; VMEM use stays < 4 MB)
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
 NEG_INF = -1e30
 
 
@@ -49,6 +51,139 @@ def reference_attention(q, k, v, bias_kv=None, causal=False, scale=None):
         s = jnp.where(mask, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# XLA path with recompute backward
+#
+# Measured on v5e (tools/bench_attention.py, slope timing): at d=64,
+# s<=512 plain XLA attention with bf16 MXU dots runs ~7x faster than the
+# Pallas flash kernels (ours AND jax's stock one — both are VPU/overhead
+# bound at small head_dim). Flash's real win at those sizes is MEMORY:
+# jax.vjp of plain attention saves the [B,H,S,S] probs for backward, which
+# is what made unfused ERNIE-large uncompilable. This custom_vjp keeps the
+# XLA forward but RECOMPUTES scores/probs in the backward (flash-style
+# recompute at the XLA level), so nothing O(S^2) is saved between fwd and
+# bwd. Only q, k, v, bias are residuals.
+# ---------------------------------------------------------------------------
+
+# Bound the per-chunk [B,H,chunk,Sk] f32 scores transient; without
+# chunking XLA's scheduler keeps several layers' full scores temps alive
+# at once and ERNIE-large (24 x 512 MB) OOMs at batch 32.
+XLA_ATTN_CHUNK_TARGET_BYTES = 256 << 20
+
+
+def _q_chunk(q, k):
+    sq = q.shape[2]
+    chunk = sq
+    bytes_per = 4.0 * q.shape[0] * q.shape[1] * k.shape[2]
+    while chunk > 128 and chunk % 2 == 0 and \
+            bytes_per * chunk > XLA_ATTN_CHUNK_TARGET_BYTES:
+        chunk //= 2
+    return chunk
+
+
+def _xla_scores(q, k, bias_kv, causal, scale, q_offset=0, full_sq=None):
+    """f32 logits for a q chunk starting at q_offset of a full_sq query
+    sequence (causal masking is bottom-right aligned, reference
+    semantics)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if bias_kv is not None:
+        s = s + bias_kv.astype(jnp.float32)[:, None, None, :]
+    if causal:
+        cq, sk = q.shape[2], k.shape[2]
+        full_sq = full_sq if full_sq is not None else cq
+        rows = q_offset + jax.lax.broadcasted_iota(jnp.int32, (cq, sk), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (cq, sk), 1)
+        s = jnp.where(rows + (sk - full_sq) >= cols, s, NEG_INF)
+    return s
+
+
+def _xla_attn_chunk(qc, k, v, bias_kv, causal, scale, off, full_sq):
+    p = jax.nn.softmax(
+        _xla_scores(qc, k, bias_kv, causal, scale, off, full_sq), axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(qc.dtype), v,
+                      preferred_element_type=jnp.float32).astype(qc.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _xla_attention(q, k, v, bias_kv, causal, scale):
+    b, h, sq, d = q.shape
+    chunk = _q_chunk(q, k)
+    if chunk == sq:
+        return _xla_attn_chunk(q, k, v, bias_kv, causal, scale, 0, sq)
+    n = sq // chunk
+    qs = jnp.moveaxis(q.reshape(b, h, n, chunk, d), 2, 0)
+    offs = jnp.arange(n, dtype=jnp.int32) * chunk
+
+    def body(args):
+        qc, off = args
+        return _xla_attn_chunk(qc, k, v, bias_kv, causal, scale, off, sq)
+
+    out = jax.lax.map(body, (qs, offs))            # [n,b,h,chunk,d]
+    return jnp.moveaxis(out, 0, 2).reshape(b, h, sq, d)
+
+
+def _xla_attention_fwd(q, k, v, bias_kv, causal, scale):
+    return _xla_attention(q, k, v, bias_kv, causal, scale), (q, k, v, bias_kv)
+
+
+def _xla_chunk_grads(qc, k, v, bias_kv, causal, scale, doc, off, full_sq):
+    """Per-q-chunk cotangents: dq chunk + f32 partials of dk/dv/dbias."""
+    p = jax.nn.softmax(
+        _xla_scores(qc, k, bias_kv, causal, scale, off, full_sq), axis=-1)
+    pb = p.astype(qc.dtype)
+    dof = doc.astype(qc.dtype)
+    dv_p = jnp.einsum("bhqk,bhqd->bhkd", pb, dof,
+                      preferred_element_type=jnp.float32)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", dof, v,
+                    preferred_element_type=jnp.float32)
+    ds = p * (dp - jnp.sum(p * dp, axis=-1, keepdims=True))  # f32
+    dsb = ds.astype(qc.dtype)
+    dq = (jnp.einsum("bhqk,bhkd->bhqd", dsb, k,
+                     preferred_element_type=jnp.float32)
+          * scale).astype(qc.dtype)
+    dk_p = jnp.einsum("bhqk,bhqd->bhkd", dsb, qc,
+                      preferred_element_type=jnp.float32) * scale
+    db_p = jnp.sum(ds, axis=(1, 2)) if bias_kv is not None else None
+    return dq, dk_p, dv_p, db_p
+
+
+def _xla_attention_bwd(causal, scale, res, do):
+    q, k, v, bias_kv = res
+    b, h, sq, d = q.shape
+    chunk = _q_chunk(q, k)
+    if chunk == sq:
+        dq, dk_p, dv_p, db_p = _xla_chunk_grads(
+            q, k, v, bias_kv, causal, scale, do, 0, sq)
+        dbias = None if db_p is None else db_p.astype(bias_kv.dtype)
+        return dq, dk_p.astype(k.dtype), dv_p.astype(v.dtype), dbias
+
+    n = sq // chunk
+    qs = jnp.moveaxis(q.reshape(b, h, n, chunk, d), 2, 0)
+    dos = jnp.moveaxis(do.reshape(b, h, n, chunk, d), 2, 0)
+    offs = jnp.arange(n, dtype=jnp.int32) * chunk
+    sk = k.shape[2]
+    acc0 = (jnp.zeros((b, h, sk, d), jnp.float32),
+            jnp.zeros((b, h, sk, d), jnp.float32),
+            jnp.zeros((b, sk), jnp.float32) if bias_kv is not None else 0.0)
+
+    def step(acc, args):
+        qc, doc, off = args
+        dk_a, dv_a, db_a = acc
+        dq, dk_p, dv_p, db_p = _xla_chunk_grads(
+            qc, k, v, bias_kv, causal, scale, doc, off, sq)
+        db_a = db_a + db_p if bias_kv is not None else db_a
+        return (dk_a + dk_p, dv_a + dv_p, db_a), dq
+
+    (dk_a, dv_a, db_a), dqs = jax.lax.scan(step, acc0, (qs, dos, offs))
+    dq = jnp.moveaxis(dqs, 0, 2).reshape(b, h, sq, d)
+    dbias = None if bias_kv is None else db_a.astype(bias_kv.dtype)
+    return dq, dk_a.astype(k.dtype), dv_a.astype(v.dtype), dbias
+
+
+_xla_attention.defvjp(_xla_attention_fwd, _xla_attention_bwd)
 
 
 # ---------------------------------------------------------------------------
@@ -112,7 +247,8 @@ def _fwd_pallas(q, k, v, bias_kv, causal, scale, interpret):
 
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    bq, bk = min(DEFAULT_BLOCK_Q, sq), min(DEFAULT_BLOCK_K, sk)
+    bq = _pick_block(sq, DEFAULT_BLOCK_Q)
+    bk = _pick_block(sk, DEFAULT_BLOCK_K)
     bh = b * h
     q3 = q.reshape(bh, sq, d)
     k3 = k.reshape(bh, sk, d)
@@ -166,8 +302,8 @@ def _bias_none_wrap(kernel, *refs, n_in, **kw):
 # ---------------------------------------------------------------------------
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
-                dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
-                block_q, block_k, causal_offset=0):
+                dk_ref, dv_ref, dbias_ref, dk_scr, dv_scr, db_scr, *,
+                scale, causal, block_q, block_k, causal_offset=0):
     from jax.experimental import pallas as pl
 
     i = pl.program_id(2)                      # q block (innermost)
@@ -177,6 +313,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
+        if db_scr is not None:
+            db_scr[:] = jnp.zeros_like(db_scr)
 
     q = q_ref[0]                              # (bq, d) native dtype
     k = k_ref[0]                              # (bk, d)
@@ -202,7 +340,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
                                      preferred_element_type=jnp.float32)
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
-    ds = p * (dp - delta) * scale             # (bq, bk)
+    ds_nos = p * (dp - delta)                 # cotangent of post-bias logits
+    ds = ds_nos * scale                       # (bq, bk)
+    if db_scr is not None:
+        db_scr[:] += jnp.sum(ds_nos, axis=0, keepdims=True)
     dk_scr[:] += jax.lax.dot_general(ds.astype(q.dtype), q,
                                      (((0,), (0,)), ((), ())),
                                      preferred_element_type=jnp.float32)
@@ -211,6 +352,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
     def _finish():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+        if dbias_ref is not None:
+            dbias_ref[0, 0] = db_scr[0, :]
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
@@ -261,7 +404,8 @@ def _bwd_pallas(q, k, v, bias_kv, causal, scale, interpret, o, lse, do):
 
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    bq, bk = min(DEFAULT_BLOCK_Q, sq), min(DEFAULT_BLOCK_K, sk)
+    bq = _pick_block(sq, DEFAULT_BLOCK_Q)
+    bk = _pick_block(sk, DEFAULT_BLOCK_K)
     bh = b * h
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1).reshape(bh, 1, sq)
@@ -287,25 +431,42 @@ def _bwd_pallas(q, k, v, bias_kv, causal, scale, interpret, o, lse, do):
         ((1, 1, bq), lambda bi, j, i: (bi, 0, i)),
     ])
     args = list(common_args)
+    kw = dict(scale=scale, causal=causal, block_q=bq, block_k=bk,
+              causal_offset=sk - sq)
+    out_specs = [pl.BlockSpec((1, bk, d), lambda bi, j, i: (bi, j, 0)),
+                 pl.BlockSpec((1, bk, d), lambda bi, j, i: (bi, j, 0))]
+    out_shape = [jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+                 jax.ShapeDtypeStruct((bh, sk, d), v.dtype)]
+    scratch = [pltpu.VMEM((bk, d), jnp.float32),
+               pltpu.VMEM((bk, d), jnp.float32)]
     if has_bias:
         in_specs.append(pl.BlockSpec((1, 1, bk),
                                      lambda bi, j, i, _h=h: (bi // _h, 0, j)))
         args.append(bias3)
-        kernel = _dkv_kernel
+        # per-(b,h) dbias accumulates over q blocks; summed over h outside
+        out_specs.append(pl.BlockSpec((1, 1, bk),
+                                      lambda bi, j, i: (bi, 0, j)))
+        out_shape.append(jax.ShapeDtypeStruct((bh, 1, sk), jnp.float32))
+        scratch.append(pltpu.VMEM((1, bk), jnp.float32))
+        kernel = functools.partial(_dkv_kernel, **kw)
     else:
-        kernel = functools.partial(_bias_none_wrap, _dkv_kernel, n_in=6)
-    dk3, dv3 = pl.pallas_call(
-        functools.partial(kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk, causal_offset=sk - sq),
+        def kernel(q, k, v, do, lse, delta, dk, dv, dks, dvs):
+            _dkv_kernel(q, k, v, do, lse, delta, None, dk, dv, None,
+                        dks, dvs, None, **kw)
+    outs = pl.pallas_call(
+        kernel,
         grid=(bh, sk // bk, sq // bq),
         in_specs=in_specs,
-        out_specs=[pl.BlockSpec((1, bk, d), lambda bi, j, i: (bi, j, 0)),
-                   pl.BlockSpec((1, bk, d), lambda bi, j, i: (bi, j, 0))],
-        out_shape=[jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
-                   jax.ShapeDtypeStruct((bh, sk, d), v.dtype)],
-        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
-                        pltpu.VMEM((bk, d), jnp.float32)],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
         interpret=interpret)(*args)
+    if has_bias:
+        dk3, dv3, dbias3 = outs
+        dbias = jnp.sum(dbias3.reshape(b, h, sk), axis=1)
+    else:
+        dk3, dv3 = outs
+        dbias = None
 
     # --- dq: grid (bh, q blocks, kv blocks) ---
     in_specs = specs([
@@ -334,7 +495,8 @@ def _bwd_pallas(q, k, v, bias_kv, causal, scale, interpret, o, lse, do):
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret)(*args)
 
-    return (dq3.reshape(q.shape), dk3.reshape(k.shape), dv3.reshape(v.shape))
+    return (dq3.reshape(q.shape), dk3.reshape(k.shape), dv3.reshape(v.shape),
+            dbias)
 
 
 # ---------------------------------------------------------------------------
@@ -354,12 +516,26 @@ def _flash_fwd(q, k, v, bias_kv, causal, scale, interpret):
 
 def _flash_bwd(causal, scale, interpret, res, do):
     q, k, v, bias_kv, o, lse = res
-    dq, dk, dv = _bwd_pallas(q, k, v, bias_kv, causal, scale, interpret,
-                             o, lse, do)
-    return dq, dk, dv, None
+    dq, dk, dv, dbias = _bwd_pallas(q, k, v, bias_kv, causal, scale,
+                                    interpret, o, lse, do)
+    if dbias is not None:
+        dbias = dbias.astype(bias_kv.dtype)
+    return dq, dk, dv, dbias
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _pick_block(s, prefer):
+    """Largest block <= prefer that divides s (multiples of 128 first, so
+    long sequences like 640 or 1920 keep kernel coverage); whole-s block
+    for short sequences; None if s is long but has no usable divisor."""
+    for c in (512, 384, 256, 128):
+        if c <= prefer and s % c == 0:
+            return c
+    if s <= prefer:
+        return s
+    return None
 
 
 def _supported(q, k, bias_kv):
@@ -367,7 +543,8 @@ def _supported(q, k, bias_kv):
     sk = k.shape[2]
     if d > 256:
         return False
-    if sq % min(DEFAULT_BLOCK_Q, sq) or sk % min(DEFAULT_BLOCK_K, sk):
+    if _pick_block(sq, DEFAULT_BLOCK_Q) is None or \
+            _pick_block(sk, DEFAULT_BLOCK_K) is None:
         return False
     if min(sq, sk) < 8:
         return False
@@ -384,11 +561,41 @@ def _pad_head_dim(x, target):
     return jnp.pad(x, pad)
 
 
+# v5e measurements (tools/bench_attention.py, slope timing, d=64):
+#   s=512:  xla-recompute 3.5 ms f+b vs pallas 9.1 ms  -> xla wins 2.6x
+#   s=2048: xla-recompute 9.4 ms f+b vs pallas 15.3 ms -> xla wins 1.6x
+#   s=4096: xla FAILS TO COMPILE (the [B,H,S,S] f32 transient = 8.6 GB);
+#           pallas runs — its O(S) HBM footprint is the only option.
+# So dispatch on the transient scores-buffer size, not sequence length.
+PALLAS_MIN_SCORES_BYTES = 2 << 30
+
+
+def _impl_choice(q, k):
+    import os
+
+    env = os.environ.get("PT_FLASH_IMPL", "auto").lower()
+    if env in ("pallas", "xla"):
+        return env
+    b, h, sq, _ = q.shape
+    scores_bytes = 4.0 * b * h * sq * k.shape[2]
+    return "pallas" if scores_bytes >= PALLAS_MIN_SCORES_BYTES else "xla"
+
+
 def flash_attention(q, k, v, bias=None, causal=False, scale=None):
-    """softmax(q k^T * scale + bias) v with flash blocking.
+    """softmax(q k^T * scale + bias) v, O(S)-memory in the backward.
 
     q [B,H,Sq,D]; k,v [B,H,Sk,D]; bias None or broadcastable to
     [B,1,1,Sk] (key padding mask) or exactly [B,Sk].
+
+    Two fused implementations (both save only q/k/v/bias for backward):
+      * 'xla' — plain XLA attention + recompute-backward custom_vjp;
+        fastest at moderate sequence lengths (softmax fuses into the
+        MXU matmuls, no kernel-launch granularity).
+      * 'pallas' — blockwise online-softmax kernels; never materialises
+        the [S,S] scores in HBM, wins when the transient scores buffer
+        would blow HBM.
+    Dispatch on the scores-buffer size (PALLAS_MIN_SCORES_BYTES);
+    override with PT_FLASH_IMPL=pallas|xla.
     """
     from . import kernel_mode
 
@@ -406,7 +613,25 @@ def flash_attention(q, k, v, bias=None, causal=False, scale=None):
             return reference_attention(q, k, v, bias, causal, scale)
 
     mode = kernel_mode()
-    if mode == "off" or not _supported(q, k, bias_kv):
+    if mode == "off":
+        return reference_attention(q, k, v, bias_kv, causal, scale)
+    if mode == "tpu" and _impl_choice(q, k) == "xla":
+        return _xla_attention(q, k, v, bias_kv, causal, scale)
+    if not _supported(q, k, bias_kv):
+        import os
+        import warnings
+
+        if os.environ.get("PT_FLASH_IMPL", "").lower() == "pallas":
+            warnings.warn(
+                f"PT_FLASH_IMPL=pallas requested but shape "
+                f"q={tuple(q.shape)} k={tuple(k.shape)} fails the kernel's "
+                f"tiling constraints — falling back to the "
+                f"{'XLA recompute' if mode == 'tpu' else 'reference'} path",
+                stacklevel=2)
+        # pallas tiling unsupported: prefer the O(S)-residual XLA
+        # recompute path on TPU over the probs-saving reference path
+        if mode == "tpu":
+            return _xla_attention(q, k, v, bias_kv, causal, scale)
         return reference_attention(q, k, v, bias_kv, causal, scale)
 
     # pad head dim only when it breaks sublane tiling (block covers the

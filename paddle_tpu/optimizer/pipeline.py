@@ -38,10 +38,17 @@ class PipelineOptimizer:
     pipeline schedule then backward/allreduce/apply."""
 
     def __init__(self, optimizer, num_microbatches: int = 1,
-                 axis_name: str = "pp"):
+                 axis_name: str = "pp", schedule: str = "gpipe"):
+        """schedule: 'gpipe' (all-forward-then-all-backward; backward via
+        jax.vjp of the forward scan, activation memory O(M)) or '1f1b'
+        (reference section_worker.cc steady-state schedule; per-stage vjp
+        with recompute, activation memory O(num_stages))."""
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"unknown pipeline schedule '{schedule}'")
         self.inner = optimizer
         self.num_microbatches = int(num_microbatches)
         self.axis_name = axis_name
+        self.schedule = schedule
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
@@ -115,14 +122,58 @@ class PipelineOptimizer:
         loss_partial = block.create_var(
             name=unique_name.generate("pipeline_loss_partial"),
             shape=[], dtype="float32")
+        common_attrs = {
+            "stages": stages, "boundaries": boundaries,
+            "mb_feed_names": mb_feed_names, "loss_name": loss.name,
+            "num_microbatches": m, "axis_name": self.axis_name,
+            "nranks": n}
+        from ..distributed.fleet.meta_optimizers import insert_grad_allreduce
+
+        if self.schedule == "1f1b":
+            # the 1f1b op computes grads itself (the backward schedule is
+            # interleaved with the forward — it cannot be a separate
+            # program section); grads come out as op outputs
+            allowed = None
+            if parameter_list is not None:
+                allowed = {p if isinstance(p, str) else p.name
+                           for p in parameter_list}
+            frozen = {g if isinstance(g, str) else g.name
+                      for g in (no_grad_set or ())}
+            param_names = [nm for nm in ext_reads
+                           if block.has_var(nm)
+                           and getattr(block.var(nm), "trainable", False)
+                           and (allowed is None or nm in allowed)
+                           and nm not in frozen]
+            grad_vars = []
+            for nm in param_names:
+                p = block.var(nm)
+                g = block.create_var(name=nm + "@GRAD", shape=p.shape,
+                                     dtype=p.dtype)
+                g.stop_gradient = True
+                grad_vars.append(g)
+            block.append_op(
+                "pipeline_1f1b", {"X": ext_reads},
+                {"LossPartial": [loss_partial],
+                 "ParamGrads": [g.name for g in grad_vars]},
+                dict(common_attrs, param_names=param_names,
+                     input_names={"X": list(ext_reads)}),
+                infer_shape=False)
+            block.append_op("c_allreduce_sum", {"X": [loss_partial]},
+                            {"Out": [loss_partial]},
+                            {"axis_name": self.axis_name, "nranks": n})
+            block.append_op("scale", {"X": [loss_partial]},
+                            {"Out": [loss.name]}, {"scale": 1.0 / m})
+            params_grads = [(block.var(nm), g)
+                            for nm, g in zip(param_names, grad_vars)]
+            insert_grad_allreduce(program, params_grads, nranks=n,
+                                  axis_name=self.axis_name, average=False)
+            ops = self.inner.apply_gradients(params_grads)
+            return ops, params_grads
+
         block.append_op(
             "pipeline_forward", {"X": ext_reads},
             {"LossPartial": [loss_partial]},
-            {"stages": stages, "boundaries": boundaries,
-             "mb_feed_names": mb_feed_names, "loss_name": loss.name,
-             "num_microbatches": m, "axis_name": self.axis_name,
-             "input_names": {"X": list(ext_reads)},
-             "nranks": n},
+            dict(common_attrs, input_names={"X": list(ext_reads)}),
             infer_shape=False)
         block.append_op("c_allreduce_sum", {"X": [loss_partial]},
                         {"Out": [loss_partial]},
@@ -133,8 +184,6 @@ class PipelineOptimizer:
         # -- 4. backward -> grad allreduce over 'pp' -> update --------------
         params_grads = self.inner.backward(loss, startup_program,
                                            parameter_list, no_grad_set)
-        from ..distributed.fleet.meta_optimizers import insert_grad_allreduce
-
         # per-rank grads are partials of the same global loss (each rank
         # executed only its stage) -> SUM over the ring, no averaging
         insert_grad_allreduce(program, params_grads, nranks=n,

@@ -19,15 +19,24 @@ from ..ops.collective_ops import _in_spmd
 
 
 def switch_moe(x, gate_w, w1, b1, w2, b2, capacity_factor: float = 1.25,
-               axis_name: str = "ep", activation: str = "gelu"):
+               axis_name: str = "ep", activation: str = "gelu",
+               tokens_sharded: bool = False):
     """Top-1 (Switch) MoE FFN.
 
-    x       [T, H]   tokens (flattened batch — replicated over 'ep')
+    x       [T, H]   tokens (flattened batch)
     gate_w  [H, E]   router (replicated)
     w1      [E_local, H, F], b1 [E_local, F]   this rank's expert shard
     w2      [E_local, F, H], b2 [E_local, H]
     Returns ([T, H] combined output, aux_loss scalar) — aux_loss is the
     Switch load-balancing loss (mean_prob · fraction_routed · E).
+
+    tokens_sharded=False: tokens are REPLICATED over 'ep' (each rank sees
+    all T tokens, computes its expert shard, all_gathers results).
+    tokens_sharded=True: x is THIS RANK's token shard [T_local, H] (the
+    batch is data-parallel over the same 'ep' axis — the GShard dp x ep
+    composition); token slots travel to their expert's rank and back via
+    two lax.all_to_all collectives. Capacity is per (expert, source
+    rank): C = ceil(T_local / E * capacity_factor).
     """
     import jax
     import jax.numpy as jnp
@@ -60,26 +69,53 @@ def switch_moe(x, gate_w, w1, b1, w2, b2, capacity_factor: float = 1.25,
     mean_prob = jnp.mean(probs, axis=0)
     aux = jnp.sum(frac_routed * mean_prob) * e
 
-    if spmd:
+    act = jax.nn.gelu if activation == "gelu" else getattr(jax.nn, activation)
+
+    def experts(exp_in):
+        """[E_local, K, H] queues -> expert FFN -> [E_local, K, H]."""
+        hmid = act(jnp.einsum("ekh,ehf->ekf", exp_in,
+                              w1.astype(jnp.float32))
+                   + b1[:, None, :].astype(jnp.float32))
+        return jnp.einsum("ekf,efh->ekh", hmid, w2.astype(jnp.float32)) \
+            + b2[:, None, :].astype(jnp.float32)
+
+    if spmd and tokens_sharded:
+        # GShard all_to_all dispatch: x here is THIS RANK's token shard
+        # ([T_local, H]); each rank builds per-expert queues from its own
+        # tokens, all_to_all rotates the expert-group axis so rank j
+        # receives every rank's queues for ITS experts, the FFN runs on
+        # the [E_local, ep*C] slots, and the reverse all_to_all carries
+        # results home. Two collectives, both riding ICI; grads flow
+        # (all_to_all transposes to all_to_all).
+        exp_in = jnp.einsum("tec,th->ech", dispatch, xf)    # [E, C, H]
+        # tiled a2a: dim0 (ep*E_l) splits into ep chunks of E_l, received
+        # chunks concat along the slot dim -> [E_l, ep*C, H]. (The
+        # non-tiled form's transpose is broken in this jax version, and
+        # tiled is the natural layout here anyway.)
+        exp_in = lax.all_to_all(exp_in, axis_name, split_axis=0,
+                                concat_axis=1, tiled=True)  # [E_l, ep*C, H]
+        exp_out = experts(exp_in)                           # [E_l, ep*C, H]
+        exp_out = lax.all_to_all(exp_out, axis_name, split_axis=1,
+                                 concat_axis=0, tiled=True)  # [E, C, H]
+        out = jnp.einsum("tec,ech->th", combine, exp_out)
+        # aux is a per-shard statistic; average it over the shards so every
+        # rank adds the same scalar to its loss
+        aux = lax.pmean(aux, axis_name)
+    elif spmd:
         # tokens (and hence the dispatch tensor) are replicated over 'ep',
         # so each rank SLICES its own experts' queues BEFORE the dispatch
         # einsum (slicing after would burn ep-times the MXU work) and the
-        # results all_gather back — one collective. (With dp-sharded
-        # tokens the dispatch itself would shard and this becomes the
-        # all_to_all exchange; that composition is future work.)
+        # results all_gather back — one collective.
         idx = lax.axis_index(axis_name)
         disp_local = lax.dynamic_index_in_dim(
             dispatch.reshape(t, ep, e_local, cap), idx, axis=1,
-            keepdims=False)                                     # [T,E_l,C]
-        exp_in = jnp.einsum("tec,th->ech", disp_local, xf)      # [E_l,C,H]
+            keepdims=False)                                 # [T,E_l,C]
+        exp_in = jnp.einsum("tec,th->ech", disp_local, xf)  # [E_l,C,H]
+        exp_out = lax.all_gather(experts(exp_in),
+                                 axis_name).reshape(e, cap, h)
+        out = jnp.einsum("tec,ech->th", combine, exp_out)
     else:
-        exp_in = jnp.einsum("tec,th->ech", dispatch, xf)        # [E, C, H]
-    act = jax.nn.gelu if activation == "gelu" else getattr(jax.nn, activation)
-    hmid = act(jnp.einsum("ekh,ehf->ekf", exp_in, w1.astype(jnp.float32))
-               + b1[:, None, :].astype(jnp.float32))
-    exp_out = jnp.einsum("ekf,efh->ekh", hmid, w2.astype(jnp.float32)) \
-        + b2[:, None, :].astype(jnp.float32)                    # [E_l, C, H]
-    if spmd:
-        exp_out = lax.all_gather(exp_out, axis_name).reshape(e, cap, h)
-    out = jnp.einsum("tec,ech->th", combine, exp_out)
+        exp_in = jnp.einsum("tec,th->ech", dispatch, xf)    # [E, C, H]
+        exp_out = experts(exp_in)
+        out = jnp.einsum("tec,ech->th", combine, exp_out)
     return out.astype(x.dtype), aux.astype(jnp.float32)

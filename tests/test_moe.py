@@ -37,6 +37,41 @@ class TestSwitchMoeFn:
         g2 = jax.grad(lambda w: jnp.sum(f(x, gw, w, b1, w2, b2)[0] ** 2))(w1)
         np.testing.assert_allclose(g1, g2, atol=1e-6)
 
+    def test_tokens_sharded_all_to_all_matches_dense(self):
+        """dp x ep composition (VERDICT r1 item 5): tokens data-parallel
+        over the 'ep' axis, slots exchanged via tiled lax.all_to_all.
+        With capacity high enough that nothing drops, output rows and
+        expert grads must equal the dense single-device run."""
+        from paddle_tpu.parallel.api import get_shard_map
+        from paddle_tpu.parallel.moe import switch_moe
+
+        shard_map, kw = get_shard_map()
+        rng = np.random.RandomState(0)
+        T, H, F, E, EP = 32, 16, 8, 4, 4
+        x = jnp.asarray(rng.randn(T, H).astype(np.float32))
+        gw = jnp.asarray(rng.randn(H, E).astype(np.float32))
+        w1 = jnp.asarray(rng.randn(E, H, F).astype(np.float32) * 0.1)
+        b1 = jnp.asarray(rng.randn(E, F).astype(np.float32) * 0.1)
+        w2 = jnp.asarray(rng.randn(E, F, H).astype(np.float32) * 0.1)
+        b2 = jnp.asarray(rng.randn(E, H).astype(np.float32) * 0.1)
+        cf = float(E)           # nothing drops at either sharding
+        out_d, _ = switch_moe(x, gw, w1, b1, w2, b2, capacity_factor=cf)
+        mesh = Mesh(np.array(jax.devices()[:EP]), ("ep",))
+        f = shard_map(
+            lambda *a: switch_moe(*a, capacity_factor=cf,
+                                  tokens_sharded=True),
+            mesh=mesh,
+            in_specs=(P("ep"), P(), P("ep"), P("ep"), P("ep"), P("ep")),
+            out_specs=(P("ep"), P()), **kw)
+        out_s, _ = jax.jit(f)(x, gw, w1, b1, w2, b2)
+        np.testing.assert_allclose(out_s, out_d, atol=2e-5)
+
+        g_d = jax.grad(lambda w: jnp.sum(switch_moe(
+            x, gw, w, b1, w2, b2, capacity_factor=cf)[0] ** 2))(w1)
+        g_s = jax.grad(lambda w: jnp.sum(
+            f(x, gw, w, b1, w2, b2)[0] ** 2))(w1)
+        np.testing.assert_allclose(g_s, g_d, atol=1e-4)
+
     def test_capacity_drops_overflow(self):
         from paddle_tpu.parallel.moe import switch_moe
 

@@ -1,0 +1,172 @@
+"""Model-step benchmark harness for the BASELINE workload ladder.
+
+Methodology (axon relay quirks measured in tools/perf.py):
+  * block_until_ready does not block; only host transfers sync, and one
+    sync costs ~100 ms. So: warmup steps (compile + pipeline fill), then
+    N steps WITHOUT fetches (state advances on-device via donation), one
+    final loss fetch to sync; ms/step = window / N. Repeat windows and
+    take the fastest (least interference on the shared chip).
+  * vs_baseline = MFU / 0.35 (BASELINE.json north-star target).
+
+Usage: python tools/bench_models.py --workload ernie_large [--steps 40]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def peak_flops_per_chip() -> float:
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    if "v5p" in kind or "v5 p" in kind:
+        return 459e12
+    if "v4" in kind:
+        return 275e12
+    if "v6" in kind or "trillium" in kind:
+        return 918e12
+    return 197e12  # v5e / v5 lite
+
+
+def transformer_step_flops(cfg, batch, seq, lm_positions=None) -> float:
+    """6 * non-embedding-params * tokens + attention term (fwd+bwd)."""
+    h, l, ff, v = (cfg.hidden_size, cfg.num_hidden_layers,
+                   cfg.intermediate_size, cfg.vocab_size)
+    per_layer = 4 * h * h + 2 * h * ff
+    tokens = batch * seq
+    lm_tokens = batch * (lm_positions if lm_positions else seq)
+    matmul = 6.0 * l * per_layer * tokens + 6.0 * h * v * lm_tokens
+    attn = 6.0 * 2 * l * batch * seq * seq * h
+    return matmul + attn
+
+
+def _time_steps(exe, prog, feed, loss_v, scope, *, steps, windows=3,
+                warmup=3):
+    """ms/step: fetch-free windows closed by a single loss fetch."""
+    for _ in range(warmup):
+        exe.run(prog, feed=feed, fetch_list=[loss_v], scope=scope)
+    best = float("inf")
+    loss = None
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(steps - 1):
+            exe.run(prog, feed=feed, fetch_list=[], scope=scope)
+        out = exe.run(prog, feed=feed, fetch_list=[loss_v], scope=scope)
+        dt = (time.perf_counter() - t0) / steps
+        best = min(best, dt)
+        loss = float(np.asarray(out[0]).reshape(-1)[0])
+    return best * 1e3, loss
+
+
+def bench_bert_like(model_cfg_fn, *, seq, batch, max_preds, steps,
+                    metric_name):
+    import paddle_tpu as pt
+    from paddle_tpu.models import bert
+
+    cfg = model_cfg_fn()
+    cfg.dtype = "bfloat16"
+    cfg.use_flash_attention = True
+
+    main_prog, startup, feeds, fetches = bert.build_pretraining_program(
+        cfg, seq_len=seq, optimizer_name="adamw",
+        max_predictions_per_seq=max_preds)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    exe.run(startup, scope=scope, use_compiled=False)
+    data = bert.synthetic_pretraining_batch(
+        cfg, batch, seq, max_predictions_per_seq=max_preds)
+    ms, loss = _time_steps(exe, main_prog, data, fetches["loss"], scope,
+                           steps=steps)
+    dt = ms / 1e3
+    tokens_per_sec = batch * seq / dt
+    flops = transformer_step_flops(cfg, batch, seq, lm_positions=max_preds)
+    mfu = flops / dt / peak_flops_per_chip()
+    return {
+        "metric": metric_name,
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.35, 4),
+        "extra": {"ms_per_step": round(ms, 2), "mfu": round(mfu, 4),
+                  "batch": batch, "seq_len": seq, "loss": round(loss, 4)},
+    }
+
+
+def bench_ernie_large(steps=30, batch=None, seq=512, max_preds=80):
+    from paddle_tpu.models import bert
+
+    batch = batch or int(os.environ.get("PT_BENCH_BATCH", "32"))
+    return bench_bert_like(
+        bert.ernie_large, seq=seq, batch=batch, max_preds=max_preds,
+        steps=steps, metric_name="ernie_large_pretrain_tokens_per_sec_per_chip")
+
+
+def bench_bert_base(steps=30, batch=None, seq=128, max_preds=20):
+    from paddle_tpu.models import bert
+
+    batch = batch or int(os.environ.get("PT_BENCH_BATCH", "384"))
+    return bench_bert_like(
+        bert.bert_base, seq=seq, batch=batch, max_preds=max_preds,
+        steps=steps, metric_name="bert_base_pretrain_tokens_per_sec_per_chip")
+
+
+def bench_resnet50(steps=20, batch=None, amp=True):
+    import paddle_tpu as pt
+    from paddle_tpu.models import resnet
+
+    batch = batch or int(os.environ.get("PT_BENCH_BATCH", "256"))
+    cfg = resnet.resnet50()
+    main_prog, startup, feeds, fetches = resnet.build_classifier_program(
+        cfg, batch_size=batch, amp=amp)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    exe.run(startup, scope=scope, use_compiled=False)
+    data = resnet.synthetic_batch(cfg, batch)
+    ms, loss = _time_steps(exe, main_prog, data, fetches["loss"], scope,
+                           steps=steps)
+    dt = ms / 1e3
+    # ResNet-50 ~3.8 GFLOPs fwd per 224x224 image -> ~3x for fwd+bwd
+    flops = 3 * 3.8e9 * batch
+    mfu = flops / dt / peak_flops_per_chip()
+    return {
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(batch / dt, 1),
+        "unit": "imgs/s",
+        "vs_baseline": round(mfu / 0.35, 4),
+        "extra": {"ms_per_step": round(ms, 2), "mfu": round(mfu, 4),
+                  "batch": batch, "loss": round(loss, 4)},
+    }
+
+
+WORKLOADS = {
+    "ernie_large": bench_ernie_large,
+    "bert_base": bench_bert_base,
+    "resnet50": bench_resnet50,
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workload", default="ernie_large")
+    ap.add_argument("--steps", type=int, default=None)
+    ap.add_argument("--batch", type=int, default=None)
+    args = ap.parse_args()
+    kw = {}
+    if args.steps:
+        kw["steps"] = args.steps
+    if args.batch:
+        kw["batch"] = args.batch
+    out = WORKLOADS[args.workload](**kw)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

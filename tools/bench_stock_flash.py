@@ -1,0 +1,89 @@
+"""Time jax's stock pallas TPU flash attention at ERNIE geometry."""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+CHAIN = 8
+PEAK = 197e12
+
+
+def timeit(fn, *args, iters=5):
+    out = fn(*args)
+    out.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    out.block_until_ready()
+    return (time.perf_counter() - t0) / iters / CHAIN * 1e3
+
+
+def main():
+    b, h, s, d = 32, 16, 512, 64
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (b, h, s, d), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (b, h, s, d), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, h, s, d), jnp.bfloat16)
+
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes, flash_attention)
+
+    fwd_flops = 4.0 * b * h * s * s * d
+    bwd_flops = fwd_flops * 3.5
+
+    for blocks in (None,
+                   dict(block_q=512, block_k_major=512, block_k=512,
+                        block_b=1,
+                        block_q_major_dkv=512, block_k_major_dkv=512,
+                        block_k_dkv=512, block_q_dkv=512,
+                        block_k_major_dq=512, block_k_dq=512,
+                        block_q_dq=512)):
+        bs = BlockSizes(**blocks) if blocks else BlockSizes.get_default(
+            batch_size=b, num_heads=h, q_seq_len=s, kv_len=s, d_model=d) \
+            if hasattr(BlockSizes, "get_default") else None
+        try:
+            if bs is None:
+                fn = lambda q, k, v: flash_attention(q, k, v, causal=False)
+            else:
+                fn = lambda q, k, v: flash_attention(q, k, v, causal=False,
+                                                     block_sizes=bs)
+
+            @jax.jit
+            def fwd_chain(q, k, v):
+                def body(i, q):
+                    return fn(q, k, v)
+                return jax.lax.fori_loop(0, CHAIN, body, q)
+
+            def loss(q, k, v):
+                return jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2) * 1e-6
+
+            g = jax.grad(loss, argnums=(0,))
+
+            @jax.jit
+            def bwd_chain(q, k, v):
+                def body(i, q):
+                    (dq,) = g(q, k, v)
+                    return dq.astype(q.dtype)
+                return jax.lax.fori_loop(0, CHAIN, body, q)
+
+            ms_f = timeit(fwd_chain, q, k, v)
+            ms_b = timeit(bwd_chain, q, k, v)
+            print(f"blocks={'default' if blocks is None else 'tuned'}  "
+                  f"fwd {ms_f:7.3f} ms ({fwd_flops/ms_f*1e3/PEAK*100:5.1f}%) "
+                  f"f+b {ms_b:7.3f} ms "
+                  f"({(fwd_flops+bwd_flops)/ms_b*1e3/PEAK*100:5.1f}%)",
+                  flush=True)
+        except Exception as e:
+            print(f"blocks={blocks}  FAILED {type(e).__name__}: "
+                  f"{str(e)[:200]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""Trustworthy timing on the axon-relayed TPU.
+
+Two traps on this platform:
+  * block_until_ready does not block — only a device->host transfer syncs;
+  * a large fixed per-session overhead (~100 ms) hides in any single
+    measurement window.
+
+So: chain calls with data dependence (each dispatch's input is the prior
+output) and report the SLOPE between a short and a long window, which
+cancels the fixed overhead.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+def sync(x):
+    import jax.numpy as jnp
+
+    return np.asarray(jnp.sum(x.astype(jnp.float32)))
+
+
+def _window(step, x0, iters):
+    x = x0
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        x = step(x)
+    sync(x)
+    return time.perf_counter() - t0
+
+
+def time_chain(step, x0, *, n1=10, n2=40, repeats=2):
+    """ms per call of step (x -> x), fixed overhead cancelled by slope.
+
+    step must map x to a same-shape/dtype x (chain-able).
+    """
+    x = step(x0)
+    sync(x)  # compile + warm
+    best = float("inf")
+    for _ in range(repeats):
+        t1 = _window(step, x0, n1)
+        t2 = _window(step, x0, n2)
+        slope = (t2 - t1) / (n2 - n1)
+        best = min(best, slope)
+    return best * 1e3

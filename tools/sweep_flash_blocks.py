@@ -1,0 +1,97 @@
+"""Sweep flash-attention block sizes on the real chip.
+
+Monkeypatches flash_attention module block-size globals and times
+fwd and fwd+bwd at a given geometry.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+CHAIN = 8
+PEAK = 197e12
+
+
+def timeit(fn, *args, iters=5):
+    out = fn(*args)
+    out.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    out.block_until_ready()
+    return (time.perf_counter() - t0) / iters / CHAIN * 1e3
+
+
+def main():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--geom", default="ernie")
+    ap.add_argument("--causal", action="store_true")
+    args = ap.parse_args()
+    geoms = {
+        "ernie": (32, 16, 512, 64),
+        "bert": (384, 12, 128, 64),
+        "long": (4, 16, 2048, 64),
+    }
+    b, h, s, d = geoms[args.geom]
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (b, h, s, d), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (b, h, s, d), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, h, s, d), jnp.bfloat16)
+    bias = jnp.zeros((b, s), jnp.float32)
+
+    import importlib
+
+    fa = importlib.import_module("paddle_tpu.ops.pallas.flash_attention")
+
+    fwd_flops = 4.0 * b * h * s * s * d * (0.5 if args.causal else 1.0)
+    bwd_flops = fwd_flops * 3.5
+
+    for bq in (128, 256, 512):
+        for bk in (128, 256, 512):
+            if bq > s or bk > s:
+                continue
+            fa.DEFAULT_BLOCK_Q = bq
+            fa.DEFAULT_BLOCK_K = bk
+
+            def fwd_chain(q, k, v):
+                def body(i, q):
+                    return fa.flash_attention(q, k, v, bias,
+                                              causal=args.causal)
+                return jax.lax.fori_loop(0, CHAIN, body, q)
+
+            def loss(q, k, v):
+                o = fa.flash_attention(q, k, v, bias, causal=args.causal)
+                return jnp.sum(o.astype(jnp.float32) ** 2) * 1e-6
+
+            g = jax.grad(loss, argnums=(0,))
+
+            def bwd_chain(q, k, v):
+                def body(i, q):
+                    (dq,) = g(q, k, v)
+                    return dq.astype(q.dtype)
+                return jax.lax.fori_loop(0, CHAIN, body, q)
+
+            try:
+                ms_f = timeit(jax.jit(fwd_chain), q, k, v)
+                ms_b = timeit(jax.jit(bwd_chain), q, k, v)
+                print(f"bq={bq:4d} bk={bk:4d}  fwd {ms_f:7.3f} ms "
+                      f"({fwd_flops/ms_f*1e3/PEAK*100:5.1f}%)  "
+                      f"f+b {ms_b:7.3f} ms "
+                      f"({(fwd_flops+bwd_flops)/ms_b*1e3/PEAK*100:5.1f}%)",
+                      flush=True)
+            except Exception as e:
+                print(f"bq={bq:4d} bk={bk:4d}  FAILED {type(e).__name__}: "
+                      f"{str(e)[:120]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
