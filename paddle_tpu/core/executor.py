@@ -29,6 +29,11 @@ from .registry import EMPTY_VAR
 from .scope import Scope, global_scope
 from .types import Place, default_place
 
+# ops whose lowerings do host network IO (ops/ps_ops.py) — they force the
+# interpreting executor path
+_PS_IO_TYPES = frozenset(
+    ("send", "recv", "send_barrier", "fetch_barrier", "listen_and_serv"))
+
 _MISSING = object()
 
 
@@ -179,6 +184,7 @@ class Executor:
     def __init__(self, place: Optional[Place] = None):
         self.place = place or default_place()
         self._cache: Dict[tuple, _CompiledEntry] = {}
+        self._ps_programs: Dict[tuple, bool] = {}
 
     def close(self):
         self._cache.clear()
@@ -217,6 +223,17 @@ class Executor:
             if block.has_var(name):
                 dtype = block.var(name).dtype
             feed[name] = _as_device_array(feed[name], dtype)
+
+        # PS send/recv ops do host network IO — route to the interpreting
+        # (op-by-op) path, the reference's executor model for PS workloads
+        # (answer cached per program uid/version: no per-step op scan)
+        ps_key = (program.uid, program.version)
+        has_ps = self._ps_programs.get(ps_key)
+        if has_ps is None:
+            has_ps = any(op.type in _PS_IO_TYPES for op in block.ops)
+            self._ps_programs[ps_key] = has_ps
+        if use_compiled and has_ps:
+            use_compiled = False
 
         if use_compiled:
             fetched = self._run_compiled(program, block, feed, fetch_names, scope,

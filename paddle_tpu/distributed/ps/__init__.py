@@ -1,0 +1,9 @@
+"""Parameter-server training runtime (reference: operators/distributed/,
+distributed_ops/, transpiler/distribute_transpiler.py — SURVEY.md §2.7
+'Parameter server' row): program-split transpiler, TCP RPC transport,
+and a pserver process that runs optimizer ops through the framework's
+own interpreting executor."""
+
+from .pserver import PServer  # noqa: F401
+from .rpc import RPCClient, RPCServer  # noqa: F401
+from .transpiler import DistributeTranspiler  # noqa: F401

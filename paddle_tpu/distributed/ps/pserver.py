@@ -1,0 +1,138 @@
+"""Parameter-server runtime: the listen-and-serve loop.
+
+Capability mirror of the reference pserver
+(operators/distributed_ops/listen_and_serv_op.cc:367 RunImpl — RPC server
+loop executing optimizer blocks on received grads;
+operators/distributed/communicator.h sync semantics). TPU-native twist:
+the pserver executes its optimizer sub-program with the framework's OWN
+interpreting executor on host CPU — the same op lowerings that run on
+device run the update, so optimizer semantics (sgd/momentum/adam/...)
+are identical to local training by construction.
+
+Sync mode (reference SyncCommunicator / DistributeTranspiler sync_mode):
+  each param applies its update once ALL trainers' grads for the step
+  arrived (mean), bumping the param's version; trainers block in recv
+  until the version they expect is published.
+Async mode (reference AsyncCommunicator, Downpour-style): every received
+  grad applies immediately (scaled 1/trainers); recv returns the current
+  value, no barriers.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .rpc import RPCServer
+
+
+class ParamState:
+    __slots__ = ("pending", "version", "cond")
+
+    def __init__(self):
+        self.pending: Dict[int, np.ndarray] = {}
+        self.version = 0
+        self.cond = threading.Condition()
+
+
+class PServer:
+    """One parameter-server process.
+
+    pserver_program: a Program whose ops are the optimizer ops for the
+    params this server owns (built by DistributeTranspiler);
+    startup_program initialises those params + accumulators + lr vars.
+    """
+
+    def __init__(self, endpoint: str, pserver_program, startup_program,
+                 num_trainers: int, sync_mode: bool = True,
+                 grad_to_param: Optional[Dict[str, str]] = None,
+                 grad_to_ops: Optional[Dict[str, list]] = None):
+        import paddle_tpu as pt
+
+        self.num_trainers = int(num_trainers)
+        self.sync_mode = bool(sync_mode)
+        self.program = pserver_program
+        self.scope = pt.Scope()
+        self.exe = pt.Executor(pt.CPUPlace())
+        self.exe.run(startup_program, scope=self.scope, use_compiled=False)
+        self.grad_to_param = grad_to_param or {}
+        self.grad_to_ops = grad_to_ops or {}
+        self.states: Dict[str, ParamState] = {
+            g: ParamState() for g in self.grad_to_param}
+        # one update at a time: connection threads race on the shared
+        # scope (items() iteration vs insertion) and on @PS_STEP@
+        self._apply_lock = threading.Lock()
+        self.server = RPCServer(endpoint, self._handle)
+        self.endpoint = self.server.endpoint
+
+    # -- update machinery ----------------------------------------------------
+    def _apply(self, grad_name: str, grad: np.ndarray):
+        """Run this grad's optimizer ops through the interpreting executor
+        (op-by-op, host CPU — the reference's executor.cc loop role)."""
+        from ...core.executor import run_op
+
+        with self._apply_lock:
+            env = {}
+            for name, val in self.scope.items():
+                env[name] = val
+            env[grad_name] = grad
+            step = self.scope.find_var("@PS_STEP@") or np.int32(0)
+            for op in self.grad_to_ops[grad_name]:
+                run_op(op, env, step=step)
+            # persist updated vars (param + accumulators)
+            for op in self.grad_to_ops[grad_name]:
+                for out in op.output_names():
+                    if out in env:
+                        self.scope.set(out, np.asarray(env[out]))
+            self.scope.set("@PS_STEP@", np.int32(int(step) + 1))
+
+    def _handle(self, method, name, arr, aux):
+        if method == "send_grad":
+            st = self.states[name]
+            with st.cond:
+                if self.sync_mode:
+                    st.pending[aux] = arr     # aux = trainer_id
+                    if len(st.pending) == self.num_trainers:
+                        mean = np.mean(list(st.pending.values()), axis=0)
+                        self._apply(name, mean.astype(arr.dtype))
+                        st.pending.clear()
+                        st.version += 1
+                        st.cond.notify_all()
+                else:
+                    self._apply(name, (arr / self.num_trainers)
+                                .astype(arr.dtype))
+                    st.version += 1
+            return None, st.version
+        if method == "recv_param":
+            # aux = minimum version the trainer expects (sync); 0 = latest.
+            # Returns the published version so the client can track it.
+            grad_name = self._grad_of(name)
+            ver = 0
+            if grad_name is not None:
+                st = self.states[grad_name]
+                if self.sync_mode and aux > 0:
+                    with st.cond:
+                        st.cond.wait_for(lambda: st.version >= aux,
+                                         timeout=120)
+                ver = st.version
+            val = self.scope.find_var(name)
+            return np.asarray(val), ver
+        if method == "barrier":
+            return None, 0
+        raise ValueError(f"unknown PS method '{method}'")
+
+    def _grad_of(self, param_name):
+        for g, p in self.grad_to_param.items():
+            if p == param_name:
+                return g
+        return None
+
+    def run(self):
+        """Block until a trainer sends __stop__ (reference:
+        ListenAndServOp::RunImpl loop)."""
+        self.server.wait()
+
+    def shutdown(self):
+        self.server.shutdown()

@@ -1,0 +1,177 @@
+"""Minimal RPC transport for the parameter-server runtime.
+
+Capability mirror of the reference's PS transport
+(operators/distributed/rpc_client.h, rpc_server.h, grpc/ + brpc/
+implementations, send_recv.proto.in): a length-prefixed binary protocol
+over TCP sockets carrying numpy tensors. The reference serialises
+through protobuf + zero-copy bytebuffers over gRPC/BRPC; here the framing
+is a 16-byte header (method id, dtype, ndim) + shape + raw array bytes —
+no pickle of untrusted data, payloads are raw tensor buffers.
+
+Server: a thread-per-connection loop dispatching to a handler object.
+Client: one persistent connection per endpoint, thread-safe via a lock.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+_HDR = struct.Struct("<IIHHI")  # method_len, name_len, dtype_code, ndim, aux
+_DTYPES = ["float32", "float64", "int32", "int64", "uint8", "bool",
+           "float16", "bfloat16"]
+
+
+def _send_msg(sock, method: str, name: str, arr: Optional[np.ndarray],
+              aux: int = 0):
+    mb = method.encode()
+    nb = name.encode()
+    if arr is None:
+        head = _HDR.pack(len(mb), len(nb), 0xFFFF, 0, aux)
+        body = b""
+        shape = b""
+    else:
+        arr = np.ascontiguousarray(arr)
+        code = _DTYPES.index(str(arr.dtype))
+        head = _HDR.pack(len(mb), len(nb), code, arr.ndim, aux)
+        shape = struct.pack(f"<{arr.ndim}q", *arr.shape)
+        body = arr.tobytes()
+    payload = head + mb + nb + shape + body
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_msg(sock) -> Tuple[str, str, Optional[np.ndarray], int]:
+    (total,) = struct.unpack("<Q", _recv_exact(sock, 8))
+    payload = _recv_exact(sock, total)
+    mlen, nlen, code, ndim, aux = _HDR.unpack_from(payload, 0)
+    off = _HDR.size
+    method = payload[off:off + mlen].decode(); off += mlen
+    name = payload[off:off + nlen].decode(); off += nlen
+    if code == 0xFFFF:
+        return method, name, None, aux
+    shape = struct.unpack_from(f"<{ndim}q", payload, off)
+    off += 8 * ndim
+    arr = np.frombuffer(payload, dtype=_DTYPES[code], offset=off)
+    return method, name, arr.reshape(shape).copy(), aux
+
+
+class RPCServer:
+    """reference: operators/distributed/rpc_server.h RPCServer +
+    request_handler_impl.cc — handler(method, name, array, aux) ->
+    (array|None, aux)."""
+
+    def __init__(self, endpoint: str, handler: Callable):
+        host, port = endpoint.rsplit(":", 1)
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, int(port)))
+        self._srv.listen(64)
+        self.endpoint = f"{host}:{self._srv.getsockname()[1]}"
+        self._handler = handler
+        self._stop = threading.Event()
+        self._threads = []
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, conn):
+        try:
+            while not self._stop.is_set():
+                method, name, arr, aux = _recv_msg(conn)
+                if method == "__stop__":
+                    _send_msg(conn, "ok", "", None)
+                    self._stop.set()
+                    try:
+                        self._srv.close()
+                    except OSError:
+                        pass
+                    return
+                out, oaux = self._handler(method, name, arr, aux)
+                _send_msg(conn, "ok", name, out, oaux)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def wait(self):
+        while not self._stop.is_set():
+            self._stop.wait(0.2)
+
+    def shutdown(self):
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+class RPCClient:
+    """reference: operators/distributed/rpc_client.h (AsyncSendVar /
+    AsyncGetVar surface, synchronous under the hood here)."""
+
+    _pool: Dict[str, "RPCClient"] = {}
+    _pool_lock = threading.Lock()
+
+    def __init__(self, endpoint: str, timeout: float = 120.0):
+        host, port = endpoint.rsplit(":", 1)
+        self.endpoint = endpoint
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=timeout)
+        self._lock = threading.Lock()
+
+    @classmethod
+    def get(cls, endpoint: str) -> "RPCClient":
+        with cls._pool_lock:
+            cli = cls._pool.get(endpoint)
+            if cli is None:
+                cli = cls(endpoint)
+                cls._pool[endpoint] = cli
+            return cli
+
+    @classmethod
+    def reset_pool(cls):
+        with cls._pool_lock:
+            for cli in cls._pool.values():
+                try:
+                    cli._sock.close()
+                except OSError:
+                    pass
+            cls._pool.clear()
+
+    def call(self, method: str, name: str = "", arr=None, aux: int = 0):
+        with self._lock:
+            _send_msg(self._sock, method, name,
+                      None if arr is None else np.asarray(arr), aux)
+            _, _, out, oaux = _recv_msg(self._sock)
+            return out, oaux
+
+    def stop_server(self):
+        try:
+            self.call("__stop__")
+        except (ConnectionError, OSError):
+            pass

@@ -1,0 +1,197 @@
+"""DistributeTranspiler — split a training program into trainer + pserver
+programs.
+
+Capability mirror of the reference's
+python/paddle/fluid/transpiler/distribute_transpiler.py:256 (transpile)
+and :545 (program splitting): optimizer-role ops move to parameter
+servers, the trainer keeps forward/backward and gains send(grad) /
+recv(param) ops, params are assigned to pservers balanced by size.
+
+Differences from the reference, by design:
+* whole-param placement (no block-splitting of one tensor across
+  pservers — the reference slices large tensors; here the large-sparse
+  path is the LargeScaleKV service instead);
+* trainer and pserver initialise from the SAME deterministic startup
+  program (same seeds), so no startup-time parameter broadcast is
+  needed;
+* the update runs through the framework's own interpreting executor on
+  the pserver (pserver.py), so optimizer semantics match local training
+  exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...core.ir import OpDesc, OpRole, Program
+
+
+def _op_role(op: OpDesc) -> int:
+    return int(op.attrs.get("op_role", 0))
+
+
+def _is_server_side(op: OpDesc) -> bool:
+    """Optimizer ops AND lr-schedule ops move to the pserver (reference
+    moves lr decay there too — distribute_transpiler.py)."""
+    r = _op_role(op)
+    return bool(r & int(OpRole.Optimize)) or bool(r & int(OpRole.LRSched))
+
+
+class DistributeTranspiler:
+    """reference: transpiler/distribute_transpiler.py DistributeTranspiler."""
+
+    def __init__(self, config=None):
+        self.config = config
+        self._done = False
+
+    def transpile(self, trainer_id: int, program: Optional[Program] = None,
+                  startup_program: Optional[Program] = None,
+                  pservers: str = "127.0.0.1:6174", trainers: int = 1,
+                  sync_mode: bool = True):
+        from ...core.ir import default_main_program, default_startup_program
+
+        self.trainer_id = int(trainer_id)
+        self.program = program or default_main_program()
+        self.startup = startup_program or default_startup_program()
+        self.endpoints = [e.strip() for e in pservers.split(",") if e.strip()]
+        self.trainers = int(trainers)
+        self.sync_mode = bool(sync_mode)
+
+        block = self.program.global_block()
+        # -- collect optimizer-role ops and their (param, grad) pairs -------
+        opt_ops = [op for op in block.ops if _is_server_side(op)]
+        pairs: List[Tuple[str, str]] = []      # (param, grad) in op order
+        for op in opt_ops:
+            p = op.inputs.get("Param")
+            g = op.inputs.get("Grad")
+            if p and g and (p[0], g[0]) not in pairs:
+                pairs.append((p[0], g[0]))
+        if not pairs:
+            raise ValueError(
+                "transpile: program has no optimizer ops (append them via "
+                "optimizer.minimize before transpiling)")
+        grad_names = {g for _, g in pairs}
+
+        # per-grad op groups: every Optimize op that reads or writes the
+        # grad (regularizer/clip scale ops included); ops touching no grad
+        # (lr schedules, counters) are replicated to every pserver
+        self.grad_to_ops: Dict[str, List[OpDesc]] = {g: [] for g in grad_names}
+        common_ops: List[OpDesc] = []
+        for op in opt_ops:
+            touched = [n for n in list(op.input_names())
+                       + list(op.output_names()) if n in grad_names]
+            if touched:
+                self.grad_to_ops[touched[0]].append(op)
+            else:
+                common_ops.append(op)
+
+        # -- assign params to pservers, balanced by parameter size ----------
+        def size_of(name):
+            v = block.var(name)
+            n = 1
+            for d in (v.shape or ()):
+                n *= max(int(d), 1)
+            return n
+
+        order = sorted(pairs, key=lambda pg: -size_of(pg[0]))
+        load = [0] * len(self.endpoints)
+        self.param_to_ep: Dict[str, str] = {}
+        self.grad_to_param: Dict[str, str] = {}
+        for p, g in order:
+            i = int(np.argmin(load))
+            self.param_to_ep[p] = self.endpoints[i]
+            self.grad_to_param[g] = p
+            load[i] += size_of(p)
+        self._pairs = pairs
+        self._common_ops = common_ops
+        self._done = True
+        return self
+
+    # -- trainer side --------------------------------------------------------
+    def get_trainer_program(self) -> Program:
+        """Forward + backward, optimizer ops replaced by send/recv."""
+        assert self._done, "call transpile() first"
+        trainer = Program()
+        dst = trainer.global_block()
+        dst._load_dict(self.program.global_block().to_dict())
+        dst.ops = [op for op in dst.ops if not _is_server_side(op)]
+        # send each grad to its param's pserver, then recv updated params
+        for p, g in self._pairs:
+            ep = self.param_to_ep[p]
+            dst.ops.append(OpDesc(
+                "send", {"X": [g]}, {},
+                {"endpoint": ep, "trainer_id": self.trainer_id,
+                 "var_names": [g], "sync_mode": self.sync_mode,
+                 "op_role": int(OpRole.Optimize)}))
+        dst.ops.append(OpDesc("send_barrier", {}, {}, {
+            "endpoints": list(self.endpoints),
+            "op_role": int(OpRole.Optimize)}))
+        for p, g in self._pairs:
+            ep = self.param_to_ep[p]
+            dst.ops.append(OpDesc(
+                "recv", {}, {"Out": [p]},
+                {"endpoint": ep, "var_names": [p],
+                 "sync_mode": self.sync_mode,
+                 "op_role": int(OpRole.Optimize)}))
+        dst.ops.append(OpDesc("fetch_barrier", {}, {}, {
+            "endpoints": list(self.endpoints),
+            "op_role": int(OpRole.Optimize)}))
+        trainer._bump_version()
+        return trainer
+
+    # -- pserver side --------------------------------------------------------
+    def get_pserver_programs(self, endpoint: str):
+        """(pserver_program, pserver_startup) for one endpoint; also
+        returns this endpoint's grad_to_param / grad_to_ops maps via
+        attributes on the program for PServer construction."""
+        assert self._done, "call transpile() first"
+        my_params = {p for p, ep in self.param_to_ep.items()
+                     if ep == endpoint}
+        my_grads = {g for g, p in self.grad_to_param.items()
+                    if p in my_params}
+        src_block = self.program.global_block()
+
+        prog = Program()
+        blk = prog.global_block()
+        my_ops: Dict[str, List[OpDesc]] = {}
+        needed_vars = set()
+        for g in my_grads:
+            ops = self._common_ops + self.grad_to_ops[g]
+            my_ops[g] = ops
+            for op in ops:
+                needed_vars.update(op.input_names())
+                needed_vars.update(op.output_names())
+        needed_vars.discard("@EMPTY@")
+        for name in sorted(needed_vars):
+            if src_block.has_var(name):
+                v = src_block.var(name)
+                blk._load_dict({"vars": [v.desc.to_dict()], "ops": []})
+        for g in sorted(my_grads):
+            blk.ops.extend(my_ops[g])
+        prog._bump_version()
+
+        # startup: original startup ops that produce the needed vars
+        startup = Program()
+        sblk = startup.global_block()
+        src_startup = self.startup.global_block()
+        for name in sorted(needed_vars):
+            if src_startup.has_var(name):
+                sblk._load_dict(
+                    {"vars": [src_startup.var(name).desc.to_dict()],
+                     "ops": []})
+        for op in src_startup.ops:
+            if any(o in needed_vars for o in op.output_names()):
+                sblk.ops.append(op)
+        startup._bump_version()
+
+        prog._ps_grad_to_param = {g: self.grad_to_param[g]
+                                  for g in my_grads}
+        prog._ps_grad_to_ops = my_ops
+        return prog, startup
+
+    def get_startup_program(self, endpoint=None, pserver_program=None):
+        """Trainer startup is the original startup (deterministic seeds
+        make trainer and pserver initial params identical)."""
+        return self.startup

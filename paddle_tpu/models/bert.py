@@ -259,7 +259,8 @@ def build_pretraining_program(cfg: BertConfig, seq_len: int = 128,
                               data_parallel: int = 1,
                               pipeline_stages: int = 0,
                               num_microbatches: int = 1,
-                              max_predictions_per_seq: int = 0):
+                              max_predictions_per_seq: int = 0,
+                              pipeline_schedule: str = "gpipe"):
     """MLM + NSP pretraining step (the reference-era BERT/ERNIE recipe).
 
     Feeds: src_ids, sent_ids, pos_ids, input_mask [B,S];
@@ -286,17 +287,17 @@ def build_pretraining_program(cfg: BertConfig, seq_len: int = 128,
     optimizer.py:5025).
     """
     pp = int(pipeline_stages or 0)
-    if pp > 1 and sequence_parallel and sequence_parallel > 1:
-        raise ValueError("pipeline_stages and sequence_parallel are "
-                         "mutually exclusive for now")
-    if max_predictions_per_seq and sequence_parallel and sequence_parallel > 1:
-        # under SP the top-k would run per sequence SHARD (k*sp per
-        # sequence globally) — not the documented per-sequence cap
-        raise ValueError("max_predictions_per_seq is not supported with "
-                         "sequence_parallel yet (the masked-position "
-                         "gather is not sequence-shard aware)")
     sp = int(sequence_parallel or 0)
     dp = int(data_parallel or 1)
+    if pp > 1 and sp > 1:
+        if cfg.num_hidden_layers % pp:
+            # composed SP x PP requires equal ring-attention collective
+            # counts in every lax.switch branch (stage) — see
+            # optimizer/pipeline.py post-op design
+            raise ValueError(
+                f"sequence_parallel with pipeline_stages needs "
+                f"num_hidden_layers ({cfg.num_hidden_layers}) divisible by "
+                f"pipeline_stages ({pp}) so stages are collective-uniform")
     if sp > 1:
         cfg = dataclasses.replace(cfg, use_ring_attention=True)
         with_nsp = False
@@ -319,7 +320,13 @@ def build_pretraining_program(cfg: BertConfig, seq_len: int = 128,
         # per example are gathered BEFORE the vocab projection — the
         # standard BERT recipe, cutting the [B,S,V] logits (the largest
         # activation) and its matmul to [B,k,V] (~5x at 15% masking).
+        # Under SP the gather runs PER SEQUENCE SHARD with
+        # k_local = min(k, S/sp): a shard cannot hold more than
+        # min(k, S_local) masked positions, so per-shard top-k followed by
+        # the global num/denom psum is loss-exact.
         k = int(max_predictions_per_seq or 0)
+        if k > 0 and sp > 1:
+            k = min(k, seq_len // sp)
         if k > 0:
             w_sel, pos = layers.topk(mask_weight, k)         # [B,k]
             lab_sel = layers.take_along_axis(mask_labels, pos, axis=1)
@@ -344,13 +351,28 @@ def build_pretraining_program(cfg: BertConfig, seq_len: int = 128,
         lm_loss_all = layers.squeeze(lm_loss_all, [2])
         num = layers.reduce_sum(lm_loss_all * mlm_weight)
         denom = layers.reduce_sum(mlm_weight)
-        if sp > 1:
+        if sp > 1 and pp > 1:
+            # composed SP x PP: the cross-shard psums may NOT live inside
+            # a pipeline stage (lax.switch branches must be
+            # collective-uniform), so normalisation happens in
+            # device_guard("post") ops that the PipelineOptimizer keeps
+            # OUTSIDE the schedule op, operating on microbatch-summed
+            # num/denom — exact global masked-token mean
+            from ..core.ir import device_guard
+
+            with device_guard("post"):
+                num = _allreduce_sum(num, ("dp", "sp"), nranks=sp * dp)
+                denom = _allreduce_sum(denom, ("dp", "sp"), nranks=sp * dp)
+                lm_loss = num / (denom + 1e-5)
+        elif sp > 1:
             # global normalisation: per-shard token sums → psum over the
             # data+sequence shards, so every rank computes the SAME global
             # loss (grads then SUM unscaled — see insert_grad_allreduce)
             num = _allreduce_sum(num, ("dp", "sp"), nranks=sp * dp)
             denom = _allreduce_sum(denom, ("dp", "sp"), nranks=sp * dp)
-        lm_loss = num / (denom + 1e-5)
+            lm_loss = num / (denom + 1e-5)
+        else:
+            lm_loss = num / (denom + 1e-5)
 
         if with_nsp:
             # NSP head on pooled [CLS]
@@ -363,6 +385,12 @@ def build_pretraining_program(cfg: BertConfig, seq_len: int = 128,
             nsp_loss = layers.mean(
                 layers.softmax_with_cross_entropy(nsp_logits, nsp_labels))
             loss = lm_loss + nsp_loss
+        elif sp > 1 and pp > 1:
+            from ..core.ir import device_guard
+
+            with device_guard("post"):
+                nsp_loss = layers.fill_constant([1], "float32", 0.0)
+            loss = lm_loss
         else:
             nsp_loss = layers.fill_constant([1], "float32", 0.0)
             loss = lm_loss
@@ -376,7 +404,24 @@ def build_pretraining_program(cfg: BertConfig, seq_len: int = 128,
                 opt = opt_mod.LambOptimizer(lr)
             else:
                 opt = opt_mod.AdamOptimizer(lr)
-            if sp > 1:
+            if sp > 1 and pp > 1:
+                # composed dp x sp x pp: the pipeline op accumulates
+                # num/denom, post ops psum them over (dp, sp), and grads
+                # SUM over all three axes (globally-normalised loss)
+                from ..optimizer.pipeline import PipelineOptimizer
+
+                if pipeline_schedule != "gpipe":
+                    raise ValueError(
+                        "sequence_parallel + pipeline_stages requires the "
+                        "gpipe schedule (1f1b cannot host the post-op loss "
+                        "normalisation — its grads are computed inside the "
+                        "schedule op)")
+                PipelineOptimizer(
+                    opt, num_microbatches=num_microbatches,
+                    schedule=pipeline_schedule,
+                    grad_axes=("dp", "sp", "pp"),
+                    grad_nranks=dp * sp * pp).minimize(loss)
+            elif sp > 1:
                 # backward → grad allreduce → update (the executor runs ops
                 # in block order, so the allreduce MUST precede the
                 # optimizer ops — same order fleet_base uses)
@@ -390,8 +435,8 @@ def build_pretraining_program(cfg: BertConfig, seq_len: int = 128,
             elif pp > 1:
                 from ..optimizer.pipeline import PipelineOptimizer
 
-                PipelineOptimizer(opt, num_microbatches=num_microbatches
-                                  ).minimize(loss)
+                PipelineOptimizer(opt, num_microbatches=num_microbatches,
+                                  schedule=pipeline_schedule).minimize(loss)
             else:
                 opt.minimize(loss)
 

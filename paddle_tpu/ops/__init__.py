@@ -47,3 +47,7 @@ try:
     from . import moe_ops  # noqa: F401
 except ImportError:
     pass
+try:
+    from . import ps_ops  # noqa: F401
+except ImportError:
+    pass

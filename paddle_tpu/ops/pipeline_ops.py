@@ -63,11 +63,16 @@ def pipeline_forward(ins, attrs):
 
     stages: List[List] = attrs["stages"]                # list of op lists
     boundaries: List[List[str]] = attrs["boundaries"]   # iface names per cut
-    mb_feed_names: List[str] = list(attrs["mb_feed_names"])
-    loss_name: str = attrs["loss_name"]
+    # scalars produced by the last stage and summed over microbatches; the
+    # classic form is a single loss, the composed (SP x PP) form is e.g.
+    # [num, denom] with normalisation + collectives as post-ops OUTSIDE
+    # this op (keeps every branch of the lax.switch collective-uniform)
+    acc_names: List[str] = list(attrs.get("acc_names")
+                                or [attrs["loss_name"]])
     m = int(attrs["num_microbatches"])
     axis = attrs.get("axis_name", "pp")
     n = len(stages)
+    na = len(acc_names)
 
     env, mb_feeds = _pipeline_env(ins, attrs)
     step = attrs.get("__step__")
@@ -90,17 +95,25 @@ def pipeline_forward(ins, attrs):
         run_stage(k, e)
         return e
 
+    def accs_of(e):
+        return tuple(e[nm].astype(jnp.float32).reshape(()) for nm in acc_names)
+
+    def pack(accs):
+        if len(accs) == 1:
+            return {"AccPartials": [accs[0]], "LossPartial": accs[0]}
+        return {"AccPartials": list(accs), "LossPartial": accs[0]}
+
     # -- single-rank / no-'pp'-axis mode: sequential microbatch loop ---------
     if n == 1 or not _in_spmd(axis):
-        total = jnp.float32(0.0)
+        total = (jnp.float32(0.0),) * na
         for mb in range(m):
             buf = ()
             for k in range(n):
                 e = stage_body(k, buf, mb)
                 if k < n - 1:
                     buf = tuple(e[nm] for nm in boundaries[k])
-            total = total + e[loss_name].astype(jnp.float32).reshape(())
-        return {"LossPartial": total}
+            total = tuple(t + a for t, a in zip(total, accs_of(e)))
+        return pack(total)
 
     # -- SPMD GPipe schedule over the 'pp' ring ------------------------------
     def branch(k):
@@ -108,9 +121,9 @@ def pipeline_forward(ins, attrs):
             e = stage_body(k, buf, mb)
             if k < n - 1:
                 return (tuple(e[nm] for nm in boundaries[k]),
-                        jnp.float32(0.0))
+                        (jnp.float32(0.0),) * na)
             zero_out = tuple(jnp.zeros_like(b) for b in buf)
-            return zero_out, e[loss_name].astype(jnp.float32).reshape(())
+            return zero_out, accs_of(e)
 
         return fn
 
@@ -128,17 +141,17 @@ def pipeline_forward(ins, attrs):
     # scan over ticks: each stage body is traced ONCE (inside switch), not
     # per tick — keeps HLO size O(n) instead of O(n * (m+n))
     def tick(carry, t):
-        buf, loss_acc = carry
+        buf, acc = carry
         mb_idx = jnp.clip(t - r, 0, m - 1).astype(jnp.int32)
         valid = jnp.logical_and(t - r >= 0, t - r < m)
-        out, l = lax.switch(r, branches, buf, mb_idx)
-        loss_acc = loss_acc + jnp.where(valid, l, 0.0)
+        out, ls = lax.switch(r, branches, buf, mb_idx)
+        acc = tuple(a + jnp.where(valid, l, 0.0) for a, l in zip(acc, ls))
         buf = tuple(lax.ppermute(o, axis, perm) for o in out)
-        return (buf, loss_acc), None
+        return (buf, acc), None
 
-    (_, loss_acc), _ = lax.scan(tick, (buf0, jnp.float32(0.0)),
-                                jnp.arange(ticks))
-    return {"LossPartial": loss_acc}
+    (_, acc), _ = lax.scan(tick, (buf0, (jnp.float32(0.0),) * na),
+                           jnp.arange(ticks))
+    return pack(acc)
 
 
 @register_op("pipeline_1f1b", is_collective=True, skip_infer_shape=True)

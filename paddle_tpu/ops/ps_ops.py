@@ -1,0 +1,100 @@
+"""Parameter-server IR ops: send / recv / barriers.
+
+Capability mirror of the reference's distributed_ops
+(operators/distributed_ops/send_op.cc, recv_op.cc, send_barrier_op.cc,
+fetch_barrier_op.cc, listen_and_serv_op.cc): side-effecting host ops
+carrying tensors between trainer and pserver over the ps.rpc transport.
+
+These ops do HOST network IO, so they run on the interpreting executor
+(op-by-op, the reference's executor.cc model — the natural home for PS
+workloads, whose reference workers are CPU Hogwild threads). The
+compiling executor refuses programs containing them; Executor.run
+auto-routes such programs to the interpreting path.
+
+Sync protocol (transpiler sync_mode=True): send carries trainer_id; the
+pserver applies a param's update once all trainers' grads arrived and
+bumps the param version; recv blocks for version >= step+1 — per-param
+versioned barriers, no global lockstep needed (the reference's
+send_barrier/fetch_barrier exist as explicit no-op markers).
+"""
+
+from __future__ import annotations
+
+from ..core.executor import _PS_IO_TYPES
+from ..core.registry import register_op
+
+PS_IO_OPS = ("send", "recv", "send_barrier", "fetch_barrier",
+             "listen_and_serv")
+# the executor keeps its own copy (core cannot import ops without a
+# cycle); fail loudly if the two ever drift
+assert set(PS_IO_OPS) == set(_PS_IO_TYPES), \
+    "ops/ps_ops.PS_IO_OPS and core/executor._PS_IO_TYPES must match"
+
+
+@register_op("send", skip_infer_shape=True)
+def send_op(ins, attrs):
+    import numpy as np
+
+    from ..distributed.ps.rpc import RPCClient
+
+    cli = RPCClient.get(attrs["endpoint"])
+    # values arrive positionally; var NAMES travel in the var_names attr
+    # (set by the transpiler) since lowerings never see names
+    for name, val in zip(attrs["var_names"], ins.get("X", [])):
+        cli.call("send_grad", name, np.asarray(val),
+                 aux=int(attrs.get("trainer_id", 0)))
+    return {}
+
+
+# client-side per-(endpoint, param) last-seen version: sync recv waits for
+# last+1 (one update per training step); after a trainer restart the dict
+# resets to 0 and the wait degrades to "current version" — safe resume
+_recv_versions = {}
+
+
+def reset_recv_versions():
+    _recv_versions.clear()
+
+
+@register_op("recv", skip_infer_shape=True)
+def recv_op(ins, attrs):
+    from ..distributed.ps.rpc import RPCClient
+
+    cli = RPCClient.get(attrs["endpoint"])
+    sync = bool(attrs.get("sync_mode", True))
+    outs = []
+    for name in attrs["var_names"]:
+        key = (attrs["endpoint"], name)
+        want = _recv_versions.get(key, 0) + 1 if sync else 0
+        val, ver = cli.call("recv_param", name, aux=want)
+        _recv_versions[key] = ver
+        outs.append(val)
+    return {"Out": outs}
+
+
+@register_op("send_barrier", skip_infer_shape=True)
+def send_barrier_op(ins, attrs):
+    from ..distributed.ps.rpc import RPCClient
+
+    for ep in attrs.get("endpoints", []):
+        RPCClient.get(ep).call("barrier")
+    return {}
+
+
+@register_op("fetch_barrier", skip_infer_shape=True)
+def fetch_barrier_op(ins, attrs):
+    from ..distributed.ps.rpc import RPCClient
+
+    for ep in attrs.get("endpoints", []):
+        RPCClient.get(ep).call("barrier")
+    return {}
+
+
+@register_op("listen_and_serv", skip_infer_shape=True)
+def listen_and_serv_op(ins, attrs):
+    """Marker op (reference listen_and_serv_op.cc) — the actual serving
+    loop is distributed.ps.pserver.PServer.run(); fleet/launch start it
+    directly. Executing the op raises to catch misuse."""
+    raise RuntimeError(
+        "listen_and_serv is a pserver-role marker; start the server via "
+        "paddle_tpu.distributed.ps.PServer(...).run()")

@@ -38,17 +38,28 @@ class PipelineOptimizer:
     pipeline schedule then backward/allreduce/apply."""
 
     def __init__(self, optimizer, num_microbatches: int = 1,
-                 axis_name: str = "pp", schedule: str = "gpipe"):
+                 axis_name: str = "pp", schedule: str = "gpipe",
+                 grad_axes=None, grad_nranks: int = 0,
+                 grad_average: bool = False):
         """schedule: 'gpipe' (all-forward-then-all-backward; backward via
         jax.vjp of the forward scan, activation memory O(M)) or '1f1b'
         (reference section_worker.cc steady-state schedule; per-stage vjp
-        with recompute, activation memory O(num_stages))."""
+        with recompute, activation memory O(num_stages)).
+
+        grad_axes/grad_nranks: mesh axes for the post-backward gradient
+        allreduce. Default is the pipeline axis alone; a composed program
+        (e.g. dp x sp x pp with a globally-normalised loss) passes all
+        three axes so stage partials and token-shard partials sum in one
+        collective."""
         if schedule not in ("gpipe", "1f1b"):
             raise ValueError(f"unknown pipeline schedule '{schedule}'")
         self.inner = optimizer
         self.num_microbatches = int(num_microbatches)
         self.axis_name = axis_name
         self.schedule = schedule
+        self.grad_axes = grad_axes
+        self.grad_nranks = int(grad_nranks)
+        self.grad_average = grad_average
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
@@ -56,11 +67,24 @@ class PipelineOptimizer:
         block = program.global_block()
         m = self.num_microbatches
 
-        # -- 1. partition forward ops into stages ---------------------------
+        # -- 1. partition forward ops into stages (+ trailing post ops) -----
+        # ops under device_guard("post") run AFTER the pipeline op on the
+        # microbatch-accumulated scalars — the home for cross-shard
+        # collectives (psum of loss numerator/denominator over dp/sp),
+        # which must NOT live inside a stage: lax.switch branches must be
+        # collective-uniform across ranks
         stages: List[List[OpDesc]] = []
+        post_ops: List[OpDesc] = []
         stage_idx = 0
         producer: Dict[str, int] = {}
         for op in block.ops:
+            if op.attrs.get("__device__") == "post":
+                post_ops.append(op)
+                continue
+            if post_ops:
+                raise ValueError(
+                    "pipeline: found a stage-tagged op after "
+                    "device_guard('post') ops — post ops must be trailing")
             stage_idx = _stage_of(op, stage_idx)
             while len(stages) <= stage_idx:
                 stages.append([])
@@ -71,7 +95,14 @@ class PipelineOptimizer:
         if any(not s for s in stages):
             raise ValueError("pipeline: some stages have no ops — check "
                              "device_guard tags")
-        if producer.get(loss.name) != n - 1:
+        if post_ops:
+            post_produced = {nm for op in post_ops
+                             for nm in op.output_names()}
+            if loss.name not in post_produced:
+                raise ValueError(
+                    "pipeline: with device_guard('post') ops present the "
+                    "loss must be produced by a post op")
+        elif producer.get(loss.name) != n - 1:
             raise ValueError(
                 f"pipeline: loss '{loss.name}' must be produced by the last "
                 f"stage (stage {producer.get(loss.name)} of {n})")
@@ -129,6 +160,12 @@ class PipelineOptimizer:
             "nranks": n}
         from ..distributed.fleet.meta_optimizers import insert_grad_allreduce
 
+        if self.schedule == "1f1b" and post_ops:
+            raise ValueError(
+                "schedule='1f1b' does not support device_guard('post') ops "
+                "— the 1f1b op computes grads inside the schedule, so the "
+                "loss must be the last stage's scalar (use gpipe for "
+                "post-op loss normalisation)")
         if self.schedule == "1f1b":
             # the 1f1b op computes grads itself (the backward schedule is
             # interleaved with the forward — it cannot be a separate
@@ -165,28 +202,64 @@ class PipelineOptimizer:
                             {"Out": [loss.name]}, {"scale": 1.0 / m})
             params_grads = [(block.var(nm), g)
                             for nm, g in zip(param_names, grad_vars)]
-            insert_grad_allreduce(program, params_grads, nranks=n,
-                                  axis_name=self.axis_name, average=False)
+            insert_grad_allreduce(program, params_grads,
+                                  nranks=self.grad_nranks or n,
+                                  axis_name=self.grad_axes or self.axis_name,
+                                  average=self.grad_average)
             ops = self.inner.apply_gradients(params_grads)
             return ops, params_grads
 
-        block.append_op(
-            "pipeline_forward", {"X": ext_reads},
-            {"LossPartial": [loss_partial]},
-            dict(common_attrs, input_names={"X": list(ext_reads)}),
-            infer_shape=False)
-        block.append_op("c_allreduce_sum", {"X": [loss_partial]},
-                        {"Out": [loss_partial]},
-                        {"axis_name": self.axis_name, "nranks": n})
-        block.append_op("scale", {"X": [loss_partial]}, {"Out": [loss.name]},
-                        {"scale": 1.0 / m})
+        if post_ops:
+            # accumulables: stage-produced vars the post ops consume; they
+            # keep their names, so post ops re-appended below read the
+            # microbatch-summed (and pp-allreduced) values transparently
+            acc_names = []
+            for op in post_ops:
+                for nm in op.input_names():
+                    if producer.get(nm) is not None and nm not in acc_names:
+                        acc_names.append(nm)
+            for nm in acc_names:
+                if producer[nm] != n - 1:
+                    raise ValueError(
+                        f"pipeline: post op reads '{nm}' produced at stage "
+                        f"{producer[nm]}; only last-stage scalars may cross "
+                        f"into post ops")
+            block.append_op(
+                "pipeline_forward", {"X": ext_reads},
+                {"AccPartials": list(acc_names)},
+                dict(common_attrs, acc_names=list(acc_names),
+                     input_names={"X": list(ext_reads)}),
+                infer_shape=False)
+            # partials are nonzero only on the last rank -> sum over 'pp'.
+            # NOTE the accumulables are microbatch SUMS (not means): a
+            # num/denom post normalisation is exact across microbatches —
+            # tighter semantics than the single-loss mean-of-ratios path
+            for nm in acc_names:
+                block.append_op("c_allreduce_sum", {"X": [nm]},
+                                {"Out": [nm]},
+                                {"axis_name": self.axis_name, "nranks": n})
+            block.ops.extend(post_ops)
+        else:
+            block.append_op(
+                "pipeline_forward", {"X": ext_reads},
+                {"LossPartial": [loss_partial]},
+                dict(common_attrs, input_names={"X": list(ext_reads)}),
+                infer_shape=False)
+            block.append_op("c_allreduce_sum", {"X": [loss_partial]},
+                            {"Out": [loss_partial]},
+                            {"axis_name": self.axis_name, "nranks": n})
+            block.append_op("scale", {"X": [loss_partial]},
+                            {"Out": [loss.name]}, {"scale": 1.0 / m})
 
-        # -- 4. backward -> grad allreduce over 'pp' -> update --------------
+        # -- 4. backward -> grad allreduce -> update ------------------------
         params_grads = self.inner.backward(loss, startup_program,
                                            parameter_list, no_grad_set)
         # per-rank grads are partials of the same global loss (each rank
-        # executed only its stage) -> SUM over the ring, no averaging
-        insert_grad_allreduce(program, params_grads, nranks=n,
-                              axis_name=self.axis_name, average=False)
+        # executed only its stage) -> SUM over the ring, no averaging;
+        # composed programs widen the allreduce to grad_axes
+        insert_grad_allreduce(program, params_grads,
+                              nranks=self.grad_nranks or n,
+                              axis_name=self.grad_axes or self.axis_name,
+                              average=self.grad_average)
         ops = self.inner.apply_gradients(params_grads)
         return ops, params_grads

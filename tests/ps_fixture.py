@@ -1,0 +1,131 @@
+"""Subprocess fixture for parameter-server tests (reference:
+test_dist_base.py TestDistRunnerBase — a runner script started as
+pserver or trainer role).
+
+Usage:
+  python ps_fixture.py pserver  <endpoint> <all_endpoints> <trainers> <sync>
+  python ps_fixture.py trainer  <trainer_id> <all_endpoints> <trainers> \
+      <sync> <steps>
+  python ps_fixture.py local    <steps>
+
+Prints one line per step: LOSS <step> <value>. Deterministic model +
+data so trainer losses are comparable to the local run.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+
+def build_model():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [32], stop_gradient=True)
+        label = layers.data("label", [1], dtype="int64", stop_gradient=True)
+        from paddle_tpu.initializer import Xavier
+
+        h = layers.fc(x, 64, act="relu",
+                      param_attr=pt.ParamAttr(name="w0",
+                                              initializer=Xavier(seed=7)),
+                      bias_attr=pt.ParamAttr(name="b0"))
+        h = layers.fc(h, 64, act="relu",
+                      param_attr=pt.ParamAttr(name="w1",
+                                              initializer=Xavier(seed=8)),
+                      bias_attr=pt.ParamAttr(name="b1"))
+        logits = layers.fc(h, 10,
+                           param_attr=pt.ParamAttr(name="w2",
+                                                   initializer=Xavier(seed=9)),
+                           bias_attr=pt.ParamAttr(name="b2"))
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        opt = pt.optimizer.SGDOptimizer(0.5)
+        opt.minimize(loss)
+    return main, startup, loss
+
+
+def batch_for(step, trainer_id=None, trainers=1):
+    """Full batch of 32; trainer i takes its contiguous half."""
+    rng = np.random.RandomState(1000 + step)
+    x = rng.randn(32, 32).astype(np.float32)
+    y = rng.randint(0, 10, (32, 1)).astype(np.int64)
+    if trainer_id is None:
+        return x, y
+    n = 32 // trainers
+    sl = slice(trainer_id * n, (trainer_id + 1) * n)
+    return x[sl], y[sl]
+
+
+def run_local(steps):
+    import paddle_tpu as pt
+
+    main, startup, loss = build_model()
+    exe = pt.Executor(pt.CPUPlace())
+    scope = pt.Scope()
+    exe.run(startup, scope=scope, use_compiled=False)
+    for s in range(steps):
+        x, y = batch_for(s)
+        out = exe.run(main, feed={"x": x, "label": y}, fetch_list=[loss],
+                      scope=scope)
+        print(f"LOSS {s} {float(np.asarray(out[0]).reshape(-1)[0]):.6f}",
+              flush=True)
+
+
+def run_pserver(endpoint, all_eps, trainers, sync):
+    from paddle_tpu.distributed.ps import DistributeTranspiler, PServer
+
+    main, startup, loss = build_model()
+    t = DistributeTranspiler()
+    t.transpile(0, program=main, startup_program=startup, pservers=all_eps,
+                trainers=trainers, sync_mode=sync)
+    prog, ps_startup = t.get_pserver_programs(endpoint)
+    server = PServer(endpoint, prog, ps_startup, num_trainers=trainers,
+                     sync_mode=sync, grad_to_param=prog._ps_grad_to_param,
+                     grad_to_ops=prog._ps_grad_to_ops)
+    print(f"SERVING {server.endpoint}", flush=True)
+    server.run()
+
+
+def run_trainer(trainer_id, all_eps, trainers, sync, steps):
+    import paddle_tpu as pt
+    from paddle_tpu.distributed.ps import DistributeTranspiler
+
+    main, startup, loss = build_model()
+    t = DistributeTranspiler()
+    t.transpile(trainer_id, program=main, startup_program=startup,
+                pservers=all_eps, trainers=trainers, sync_mode=sync)
+    trainer_prog = t.get_trainer_program()
+    exe = pt.Executor(pt.CPUPlace())
+    scope = pt.Scope()
+    exe.run(t.get_startup_program(), scope=scope, use_compiled=False)
+    for s in range(steps):
+        x, y = batch_for(s, trainer_id, trainers)
+        out = exe.run(trainer_prog, feed={"x": x, "label": y},
+                      fetch_list=[loss], scope=scope)
+        print(f"LOSS {s} {float(np.asarray(out[0]).reshape(-1)[0]):.6f}",
+              flush=True)
+    print("DONE", flush=True)
+    # servers are stopped by the test harness once ALL trainers finish
+    # (a trainer stopping them early would cut off slower peers mid-step)
+
+
+if __name__ == "__main__":
+    role = sys.argv[1]
+    if role == "local":
+        run_local(int(sys.argv[2]))
+    elif role == "pserver":
+        run_pserver(sys.argv[2], sys.argv[3], int(sys.argv[4]),
+                    sys.argv[5] == "1")
+    elif role == "trainer":
+        run_trainer(int(sys.argv[2]), sys.argv[3], int(sys.argv[4]),
+                    sys.argv[5] == "1", int(sys.argv[6]))
+    else:
+        raise SystemExit(f"unknown role {role}")

@@ -1,0 +1,150 @@
+"""Parameter-server runtime tests.
+
+Mirrors the reference's dist tests (test_dist_base.py:578 TestDistBase —
+real localhost subprocesses, no mocks): 2 pservers x 2 trainers in sync
+mode must track the single-process run exactly (the average of the two
+trainers' half-batch losses equals the local full-batch loss, since
+grads are averaged server-side and inits are seed-deterministic).
+"""
+
+import os
+import re
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "ps_fixture.py")
+
+
+def _losses(txt):
+    return {int(m[0]): float(m[1])
+            for m in re.findall(r"LOSS (\d+) ([\d.]+)", txt)}
+
+
+class TestTranspiler:
+    def _build(self):
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+        from paddle_tpu.core import ir, unique_name
+
+        ir._main_program, ir._startup_program = ir.Program(), ir.Program()
+        unique_name.switch()
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [16], stop_gradient=True)
+            h = layers.fc(x, 32, param_attr=pt.ParamAttr(name="w0"),
+                          bias_attr=pt.ParamAttr(name="b0"))
+            y = layers.fc(h, 4, param_attr=pt.ParamAttr(name="w1"),
+                          bias_attr=pt.ParamAttr(name="b1"))
+            loss = layers.mean(y * y)
+            pt.optimizer.SGDOptimizer(0.1).minimize(loss)
+        return main, startup, loss
+
+    def test_program_split(self):
+        from paddle_tpu.distributed.ps import DistributeTranspiler
+
+        main, startup, loss = self._build()
+        eps = "127.0.0.1:7000,127.0.0.1:7001"
+        t = DistributeTranspiler()
+        t.transpile(0, program=main, startup_program=startup, pservers=eps,
+                    trainers=2, sync_mode=True)
+
+        trainer = t.get_trainer_program()
+        ttypes = [op.type for op in trainer.global_block().ops]
+        assert "sgd" not in ttypes          # optimizer moved off trainer
+        assert ttypes.count("send") == 4 and ttypes.count("recv") == 4
+        assert "send_barrier" in ttypes and "fetch_barrier" in ttypes
+
+        # params balanced across both endpoints; every pserver program
+        # holds only optimizer ops for its own params
+        all_params = set()
+        for ep in eps.split(","):
+            prog, ps_startup = t.get_pserver_programs(ep)
+            ops = prog.global_block().ops
+            assert ops and all(op.type == "sgd" for op in ops)
+            params = set(prog._ps_grad_to_param.values())
+            assert params, f"pserver {ep} owns no params"
+            all_params |= params
+            # startup initialises exactly the vars this pserver needs
+            sblk = ps_startup.global_block()
+            for p in params:
+                assert any(p in op.output_names() for op in sblk.ops)
+        assert all_params == {"w0", "b0", "w1", "b1"}
+
+    def test_no_optimizer_raises(self):
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+        from paddle_tpu.core import ir, unique_name
+        from paddle_tpu.distributed.ps import DistributeTranspiler
+
+        ir._main_program, ir._startup_program = ir.Program(), ir.Program()
+        unique_name.switch()
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [4])
+            layers.fc(x, 2)
+        with pytest.raises(ValueError, match="no optimizer"):
+            DistributeTranspiler().transpile(
+                0, program=main, startup_program=startup)
+
+
+class TestPSCluster:
+    """reference: test_dist_base.py TestDistBase.check_with_place:1007 —
+    launch pservers + trainers as subprocesses, compare losses."""
+
+    def _run_cluster(self, sync, steps=4, ports=(17411, 17412)):
+        eps = ",".join(f"127.0.0.1:{p}" for p in ports)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        servers = [subprocess.Popen(
+            [sys.executable, FIXTURE, "pserver", ep, eps, "2",
+             "1" if sync else "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env) for ep in eps.split(",")]
+        time.sleep(5)
+        try:
+            trainers = [subprocess.Popen(
+                [sys.executable, FIXTURE, "trainer", str(tid), eps, "2",
+                 "1" if sync else "0", str(steps)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+                env=env) for tid in range(2)]
+            outs = [p.communicate(timeout=180)[0] for p in trainers]
+            assert all("DONE" in o for o in outs), \
+                f"trainer failed:\n{outs[0][-2000:]}\n{outs[1][-2000:]}"
+        finally:
+            from paddle_tpu.distributed.ps.rpc import RPCClient
+
+            for ep in eps.split(","):
+                try:
+                    RPCClient(ep).stop_server()
+                except Exception:
+                    pass
+            for s in servers:
+                try:
+                    s.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    s.kill()
+        local = subprocess.run(
+            [sys.executable, FIXTURE, "local", str(steps)],
+            capture_output=True, text=True, env=env, timeout=180).stdout
+        return [_losses(o) for o in outs], _losses(local)
+
+    def test_sync_2x2_matches_local(self):
+        (l0, l1), ll = self._run_cluster(sync=True)
+        assert len(l0) == len(l1) == len(ll) == 4
+        for s in ll:
+            dist = (l0[s] + l1[s]) / 2   # grads averaged server-side
+            assert abs(dist - ll[s]) < 1e-4, \
+                f"step {s}: dist {dist} vs local {ll[s]}"
+
+    def test_async_2x2_trains(self, ):
+        (l0, l1), ll = self._run_cluster(sync=False, steps=6,
+                                         ports=(17421, 17422))
+        # async has no step-equivalence guarantee; it must run all steps
+        # and stay in a sane loss range (reference asserts convergence
+        # over many steps; 6 steps here just proves the machinery)
+        assert len(l0) == len(l1) == 6
+        assert all(np.isfinite(v) for v in l0.values())
+        assert all(np.isfinite(v) for v in l1.values())

@@ -79,7 +79,8 @@ def main():
           f"bias={args.bias}")
 
     if args.sweep:
-        for bq in (128, 256, 512):
+        os.environ["PT_FLASH_IMPL"] = "pallas"  # sweep the KERNEL, not
+        for bq in (128, 256, 512):              # the auto-dispatched path
             for bk in (128, 256, 512):
                 if bq > s or bk > s:
                     continue
@@ -88,6 +89,7 @@ def main():
                            lambda x, kk, vv: fa.flash_attention(
                                x, kk, vv, bias, causal=causal),
                            q, k, v, causal, fwd_flops, bwd_flops)
+        os.environ["PT_FLASH_IMPL"] = "auto"
         return
 
     scale = 1.0 / d ** 0.5
