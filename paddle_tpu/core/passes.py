@@ -43,9 +43,18 @@ def registered_passes() -> List[str]:
     return sorted(_PASS_REGISTRY)
 
 
-def apply_passes(program: Program, names: List[str]) -> Program:
+def apply_passes(program: Program, names: List[str], scope=None) -> Program:
+    """Value-level passes (weight-folding fusions like conv+BN) declare a
+    `scope` parameter and receive the parameter store; pure structural
+    passes keep the Program -> Program signature."""
+    import inspect
+
     for n in names:
-        program = get_pass(n)(program)
+        fn = get_pass(n)
+        if "scope" in inspect.signature(fn).parameters:
+            program = fn(program, scope=scope)
+        else:
+            program = fn(program)
     return program
 
 
@@ -148,6 +157,83 @@ def fc_fuse_pass(program: Program) -> Program:
                         {"Out": [_out(add, "Out")]},
                         {"in_num_col_dims": ncol}))
                     fused_away.add(id(add))
+                    continue
+        new_ops.append(op)
+    block.ops = new_ops
+    program._bump_version()
+    return program
+
+
+@register_pass("conv_bn_fuse_pass")
+def conv_bn_fuse_pass(program: Program, scope=None) -> Program:
+    """conv2d → batch_norm(is_test) folded into one conv + bias add
+    (reference: ir/conv_bn_fuse_pass.cc). BN at inference is an affine
+    per-channel transform: y = k*conv(x) + c with k = scale/sqrt(var+eps)
+    and c = bias - mean*k, so the conv filter absorbs k (OIHW out-channel
+    axis) and c becomes a bias. Weight folding needs parameter VALUES —
+    the pass requires the predictor scope and is a no-op without one."""
+    if scope is None:
+        return program
+    import numpy as np
+
+    from . import unique_name
+
+    block = program.global_block()
+    consumers = _single_consumer_map(block.ops)
+    dead = set()
+    new_ops: List[OpDesc] = []
+    for op in block.ops:
+        if id(op) in dead:
+            continue
+        if op.type == "conv2d" and int(op.attrs.get("groups", 1) or 1) == 1:
+            out = _out(op, "Output")
+            cons = consumers.get(out, [])
+            bn = cons[0] if len(cons) == 1 and \
+                cons[0].type == "batch_norm" else None
+            if bn is not None and (bool(bn.attrs.get("is_test", False))
+                                   or bool(bn.attrs.get(
+                                       "use_global_stats", False))):
+                names = {s: _in(bn, s)
+                         for s in ("Scale", "Bias", "Mean", "Variance")}
+                w_name = _in(op, "Filter")
+                vals = {s: scope.find_var(n) for s, n in names.items()}
+                w = scope.find_var(w_name)
+                if w is not None and all(v is not None
+                                         for v in vals.values()):
+                    eps = float(bn.attrs.get("epsilon", 1e-5))
+                    k = np.asarray(vals["Scale"], np.float32) / np.sqrt(
+                        np.asarray(vals["Variance"], np.float32) + eps)
+                    new_w = (np.asarray(w, np.float32)
+                             * k[:, None, None, None]).astype(
+                                 np.asarray(w).dtype)
+                    new_b = (np.asarray(vals["Bias"], np.float32)
+                             - np.asarray(vals["Mean"], np.float32) * k)
+                    wf_name = unique_name.generate(w_name + "@bn_fused")
+                    bf_name = unique_name.generate(w_name + "@bn_bias")
+                    wv = block.var(w_name)
+                    block.create_parameter(name=wf_name,
+                                           shape=tuple(wv.shape),
+                                           dtype=str(wv.dtype))
+                    block.create_parameter(name=bf_name,
+                                           shape=(len(new_b),),
+                                           dtype="float32")
+                    scope.set(wf_name, new_w)
+                    scope.set(bf_name, new_b.astype(np.float32))
+                    conv_out = block.create_var(
+                        name=unique_name.generate(out + "@fused"),
+                        shape=tuple(block.var(out).shape)
+                        if block.has_var(out) else None)
+                    fused_conv = OpDesc(
+                        "conv2d",
+                        {"Input": op.inputs["Input"], "Filter": [wf_name]},
+                        {"Output": [conv_out.name]}, dict(op.attrs))
+                    y = _out(bn, "Y")
+                    new_ops.append(fused_conv)
+                    new_ops.append(OpDesc(
+                        "elementwise_add",
+                        {"X": [conv_out.name], "Y": [bf_name]},
+                        {"Out": [y]}, {"axis": 1}))
+                    dead.add(id(bn))
                     continue
         new_ops.append(op)
     block.ops = new_ops

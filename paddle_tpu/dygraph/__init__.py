@@ -16,6 +16,7 @@ from .layers import Layer
 from .tracer import Tracer, get_tracer, grad, trace_fn, trace_op
 from .varbase import ParamBase, VarBase, to_variable
 from . import jit  # noqa: F401
+from .parallel import DataParallel, ParallelStrategy, prepare_context  # noqa: F401,E501
 from .jit import (ProgramTranslator, TracedLayer, declarative,  # noqa: F401
                   to_static)
 

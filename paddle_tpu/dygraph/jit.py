@@ -20,7 +20,10 @@ callable in memory, rejected at export time with a clear message.
 
 from __future__ import annotations
 
+import ast
 import functools
+import inspect
+import textwrap
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -31,6 +34,272 @@ from ..core.registry import EMPTY_VAR, register_op
 from .varbase import VarBase
 
 _capture_stack: List["_CaptureState"] = []
+
+
+# ---------------------------------------------------------------------------
+# AST if-rewrite: tensor-dependent `if` under @to_static
+#
+# The reference compiles Python control flow into program ops via
+# source-to-source transformers (dygraph_to_static/ifelse_transformer.py
+# under program_translator.py:691). Here the same outcome with a far
+# smaller mechanism: every eligible `if` in the decorated function is
+# rewritten to
+#
+#     def _jst_true():  <body>;   return (a, b, ...)
+#     def _jst_false(): <orelse>; return (a, b, ...)
+#     (a, b, ...) = _jst_if(<test>, _jst_true, _jst_false)
+#
+# Each branch function receives a SNAPSHOT of the assigned names' pre-if
+# values (taken once, before either branch runs) and binds them as
+# locals, so the branches are isolated from each other and augmented
+# assignments work. At RUNTIME `_jst_if` dispatches: a plain-Python test
+# keeps exact Python semantics (and the bool is part of the trace
+# signature, so each value gets its own trace — no silent
+# specialisation); a traced tensor test evaluates BOTH branches and
+# blends every assigned tensor with a `where` select op, so ONE traced
+# program handles either outcome. Branches containing
+# return/break/continue or `global` names are left untransformed (tensor
+# tests there raise with guidance, see VarBase.__bool__).
+# ---------------------------------------------------------------------------
+
+
+class _Missing:
+    __slots__ = ()
+
+    def __repr__(self):
+        return "<undefined before if>"
+
+
+_JST_MISSING = _Missing()
+
+
+def _jst_peek(fn):
+    try:
+        return fn()
+    except NameError:
+        return _JST_MISSING
+
+
+class _ControlFinder(ast.NodeVisitor):
+    def __init__(self):
+        self.blocked = False
+
+    def visit_Return(self, node):
+        self.blocked = True
+
+    def visit_Break(self, node):
+        self.blocked = True
+
+    def visit_Continue(self, node):
+        self.blocked = True
+
+    def visit_Global(self, node):
+        self.blocked = True
+
+    def visit_FunctionDef(self, node):
+        pass            # nested defs own their control statements
+
+    def visit_Lambda(self, node):
+        pass
+
+
+def _assigned_names(stmts) -> set:
+    names = set()
+
+    class V(ast.NodeVisitor):
+        def visit_Assign(self, node):
+            for t in node.targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        names.add(n.id)
+            self.generic_visit(node.value)
+
+        def visit_AugAssign(self, node):
+            if isinstance(node.target, ast.Name):
+                names.add(node.target.id)
+            self.generic_visit(node.value)
+
+        def visit_AnnAssign(self, node):
+            if isinstance(node.target, ast.Name) and node.value is not None:
+                names.add(node.target.id)
+
+        def visit_For(self, node):
+            for n in ast.walk(node.target):
+                if isinstance(n, ast.Name):
+                    names.add(n.id)
+            self.generic_visit(node)
+
+        def visit_With(self, node):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    for n in ast.walk(item.optional_vars):
+                        if isinstance(n, ast.Name):
+                            names.add(n.id)
+            self.generic_visit(node)
+
+        def visit_Import(self, node):
+            for alias in node.names:
+                names.add((alias.asname or alias.name).split(".")[0])
+
+        def visit_ImportFrom(self, node):
+            for alias in node.names:
+                names.add(alias.asname or alias.name)
+
+        def visit_FunctionDef(self, node):
+            names.add(node.name)
+
+        def visit_Lambda(self, node):
+            pass
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return names
+
+
+class _IfTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.counter = 0
+
+    def visit_If(self, node: ast.If):
+        self.generic_visit(node)
+        finder = _ControlFinder()
+        for s in node.body + node.orelse:
+            finder.visit(s)
+        if finder.blocked:
+            return node
+        assigned = sorted(_assigned_names(node.body)
+                          | _assigned_names(node.orelse))
+        if not assigned:
+            return node
+        i = self.counter
+        self.counter += 1
+        ret = ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ast.Load()) for n in assigned],
+            ctx=ast.Load()))
+        # branch fns take the pre-if snapshot and bind it as locals
+        bind = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store()) for n in assigned],
+                ctx=ast.Store())],
+            value=ast.Name(id="__jst_snap__", ctx=ast.Load()))
+
+        def mk(name, body):
+            return ast.FunctionDef(
+                name=name,
+                args=ast.arguments(
+                    posonlyargs=[],
+                    args=[ast.arg(arg="__jst_snap__")],
+                    kwonlyargs=[], kw_defaults=[], defaults=[]),
+                body=[bind] + list(body) + [ret], decorator_list=[])
+
+        # snapshot: per-name guarded closure reads (undefined -> MISSING)
+        snap = ast.Tuple(
+            elts=[ast.Call(
+                func=ast.Name(id="_jst_peek", ctx=ast.Load()),
+                args=[ast.Lambda(
+                    args=ast.arguments(posonlyargs=[], args=[],
+                                       kwonlyargs=[], kw_defaults=[],
+                                       defaults=[]),
+                    body=ast.Name(id=n, ctx=ast.Load()))],
+                keywords=[]) for n in assigned],
+            ctx=ast.Load())
+        t_name, f_name = f"_jst_true_{i}", f"_jst_false_{i}"
+        t_def = mk(t_name, node.body)
+        f_def = mk(f_name, node.orelse or [ast.Pass()])
+        call = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store()) for n in assigned],
+                ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Name(id="_jst_if", ctx=ast.Load()),
+                args=[node.test,
+                      ast.Name(id=t_name, ctx=ast.Load()),
+                      ast.Name(id=f_name, ctx=ast.Load()),
+                      snap],
+                keywords=[]))
+        out = [t_def, f_def, call]
+        for n in out:
+            ast.copy_location(n, node)
+            ast.fix_missing_locations(n)
+        return out
+
+
+def _jst_if(pred, t_fn, f_fn, snap):
+    """Runtime dispatch for transformed ifs (see module docstring)."""
+    if _capture_stack and isinstance(pred, VarBase):
+        from .tracer import trace_op
+
+        t_vals = t_fn(snap)
+        f_vals = f_fn(snap)
+        blended = []
+        for t, f in zip(t_vals, f_vals):
+            if t is f:
+                blended.append(t)
+            elif isinstance(t, _Missing) or isinstance(f, _Missing):
+                # a name only one branch ever defines: keep the defined
+                # side (using it when the other branch ran is a user
+                # error the reference also leaves to runtime)
+                blended.append(t if isinstance(f, _Missing) else f)
+            elif isinstance(t, (VarBase, np.ndarray)) or \
+                    isinstance(f, (VarBase, np.ndarray)):
+                tv = t if isinstance(t, VarBase) else VarBase(np.asarray(t))
+                fv = f if isinstance(f, VarBase) else VarBase(np.asarray(f))
+                blended.append(trace_op(
+                    "where", {"Condition": pred, "X": tv, "Y": fv},
+                    {})["Out"][0])
+            elif t != f:
+                raise TypeError(
+                    f"to_static: a tensor-dependent `if` assigns a "
+                    f"non-tensor value that differs between branches "
+                    f"({t!r} vs {f!r}) — only tensors can be selected "
+                    f"at runtime")
+            else:
+                blended.append(t)
+        return tuple(blended)
+    cond = bool(pred._array.reshape(-1)[0]) if isinstance(pred, VarBase) \
+        else bool(pred)
+    return t_fn(snap) if cond else f_fn(snap)
+
+
+def _transform_fn(fn):
+    """Rewrite fn's `if` statements via _IfTransformer; falls back to the
+    original on any source/compile issue (e.g. source unavailable in a
+    REPL)."""
+    if fn.__closure__:
+        return fn              # closures can't be re-materialised; keep
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+        fdef = tree.body[0]
+        # drop decorators — we're already inside the decorator
+        fdef.decorator_list = []
+        tr = _IfTransformer()
+        tr.visit(fdef)
+        if tr.counter == 0:
+            return fn
+        ast.fix_missing_locations(tree)
+        code = compile(tree, f"<to_static {fn.__name__}>", "exec")
+
+        # live global resolution: a plain dict copy would freeze module
+        # globals at decoration time (later-defined helpers, test
+        # monkeypatches); fall through to the function's real globals
+        class _Globals(dict):
+            def __missing__(self, k):
+                return fn.__globals__[k]
+
+        glb = _Globals()
+        glb["_jst_if"] = _jst_if
+        glb["_jst_peek"] = _jst_peek
+        glb["__builtins__"] = fn.__globals__.get("__builtins__", __builtins__)
+        loc: Dict[str, Any] = {}
+        exec(code, glb, loc)
+        new_fn = loc[fdef.name]
+        new_fn.__defaults__ = fn.__defaults__
+        new_fn.__kwdefaults__ = fn.__kwdefaults__
+        return new_fn
+    except (OSError, TypeError, SyntaxError, KeyError):
+        return fn
 
 
 @register_op("__jax_fn__", skip_infer_shape=True)
@@ -183,7 +452,8 @@ class StaticFunction:
     captured block as one jitted computation on the tape."""
 
     def __init__(self, fn, input_spec=None):
-        self._fn = fn
+        self._fn = _transform_fn(fn)
+        self._fn_original = fn
         self._input_spec = input_spec
         self._cache: Dict[tuple, ConcreteProgram] = {}
         # signature tuples embed id(obj) for non-tensor args; pin those

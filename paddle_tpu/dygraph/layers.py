@@ -56,8 +56,14 @@ class Layer:
 
     # -- parameter creation ---------------------------------------------------
     def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
-                         default_initializer=None) -> ParamBase:
+                         default_initializer=None):
+        """Dual-mode (reference 2.0 Layers work in dygraph AND static):
+        in dygraph, an eager ParamBase; in static mode (no tracer,
+        typically inside program_guard), a static Parameter with its init
+        op in the startup program — so nn.* classes build programs the
+        same way layers.* functions do."""
         from .. import initializer as I
+        from ..core.ir import in_dygraph_mode
         from ..param_attr import ParamAttr
 
         dtype = dtype or self._dtype
@@ -70,6 +76,20 @@ class Layer:
         if init is None:
             init = (I.Constant(0.0) if is_bias
                     else I._default_weight_initializer())
+
+        if not in_dygraph_mode():
+            from ..layer_helper import LayerHelper
+
+            helper = LayerHelper(self._full_name)
+            a = attr
+            if a.initializer is None:
+                import copy as _copy
+
+                a = _copy.copy(attr)
+                a.initializer = init
+            return helper.create_parameter(a, list(shape), dtype=dtype,
+                                           is_bias=is_bias)
+
         name = attr.name if (attr is not None and attr.name) else None
         value = _eager_initialize(init, shape, dtype)
         p = ParamBase(value, name=name, is_bias=is_bias)
@@ -91,8 +111,36 @@ class Layer:
         self._sub_layers[name] = sublayer
         return sublayer
 
-    def register_buffer(self, name: str, tensor: Optional[VarBase],
+    def register_buffer(self, name: str, tensor,
                         persistable: bool = True):
+        """Dual-mode like create_parameter: static mode creates a
+        persistable var initialised from the value in the startup program
+        (BatchNorm running stats, etc.)."""
+        from ..core.ir import in_dygraph_mode
+
+        if tensor is not None and not in_dygraph_mode() \
+                and not isinstance(tensor, VarBase):
+            import numpy as _np
+
+            from ..core.ir import default_main_program, \
+                default_startup_program
+            from ..core import unique_name as _un
+            from ..initializer import NumpyArrayInitializer
+
+            value = _np.asarray(tensor)
+            vname = _un.generate(f"{self._full_name}.{name}")
+            block = default_main_program().global_block()
+            var = block.create_var(name=vname, shape=tuple(value.shape),
+                                   dtype=str(value.dtype),
+                                   persistable=persistable)
+            var.stop_gradient = True
+            sblock = default_startup_program().global_block()
+            svar = sblock.create_var(name=vname, shape=tuple(value.shape),
+                                     dtype=str(value.dtype),
+                                     persistable=persistable)
+            NumpyArrayInitializer(value)(svar, sblock)
+            self._buffers[name] = var
+            return var
         if tensor is not None and not isinstance(tensor, VarBase):
             tensor = VarBase(tensor)
         if tensor is not None:
@@ -101,10 +149,13 @@ class Layer:
         return tensor
 
     def __setattr__(self, name: str, value):
+        from ..core.ir import Parameter as _StaticParameter
+
         params = self.__dict__.get("_parameters")
         layers = self.__dict__.get("_sub_layers")
         buffers = self.__dict__.get("_buffers")
-        if isinstance(value, ParamBase) and params is not None:
+        if isinstance(value, (ParamBase, _StaticParameter)) and \
+                params is not None:
             if layers is not None:
                 layers.pop(name, None)
             params[name] = value
@@ -113,8 +164,11 @@ class Layer:
                 params.pop(name, None)
             layers[name] = value
         elif buffers is not None and name in buffers:
-            buffers[name] = value if (value is None or isinstance(value, VarBase)) \
-                else VarBase(value)
+            from ..core.ir import Variable as _StaticVariable
+
+            ok = value is None or isinstance(value, (VarBase,
+                                                     _StaticVariable))
+            buffers[name] = value if ok else VarBase(value)
         else:
             # overwriting a registered param/sublayer with a plain value
             # deregisters it so parameters()/state_dict() stay consistent

@@ -1,0 +1,154 @@
+"""Dygraph DataParallel — eager multi-process data parallelism.
+
+Capability mirror of python/paddle/fluid/dygraph/parallel.py
+(DataParallel:335, scale_loss:432, apply_collective_grads:441 — there
+backed by imperative::AllReduce over NCCL, imperative/all_reduce.cc:39).
+TPU re-design: one rank per PROCESS; cross-process gradient reduction
+builds a tiny global array over a one-device-per-process 'dp' mesh
+(jax.distributed is the rendezvous — the reference's nccl_context TCP
+store) and jit-sums it with replicated output, so the collective rides
+jax's cross-host transport. Gradients are COALESCED into flat buffers
+per dtype (comm_buffer_size MB groups, the reference's coalesce + one
+allreduce per group) before the exchange.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from .layers import Layer
+from .varbase import VarBase
+
+
+class ParallelStrategy:
+    """reference: dygraph/parallel.py ParallelStrategy (env-backed)."""
+
+    def __init__(self):
+        from ..distributed.parallel import get_rank, get_world_size
+
+        self.nranks = get_world_size()
+        self.local_rank = get_rank()
+        self.trainer_endpoints: List[str] = []
+        self.current_endpoint = ""
+
+
+def prepare_context(strategy: Optional[ParallelStrategy] = None):
+    """reference: dygraph/parallel.py prepare_context — jax.distributed
+    plays the nccl_context role; init happens in init_parallel_env."""
+    return strategy or ParallelStrategy()
+
+
+def _dp_mesh():
+    """One device per process -> ('dp', nprocs) mesh for eager grad
+    reduction."""
+    import jax
+    from jax.sharding import Mesh
+
+    per_proc = {}
+    for d in jax.devices():
+        per_proc.setdefault(d.process_index, d)
+    devs = [per_proc[k] for k in sorted(per_proc)]
+    return Mesh(np.array(devs), ("dp",))
+
+
+def _allreduce_across_processes(arr: np.ndarray, mesh) -> np.ndarray:
+    """Sum an eager per-process array across all processes."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = mesh.devices.size
+    if n <= 1:
+        return np.asarray(arr)
+    sharding = NamedSharding(mesh, P("dp"))
+    garr = jax.make_array_from_process_local_data(
+        sharding, np.asarray(arr)[None], (n,) + tuple(arr.shape))
+    out = jax.jit(lambda v: v.sum(0),
+                  out_shardings=NamedSharding(mesh, P()))(garr)
+    return np.asarray(out)
+
+
+class DataParallel(Layer):
+    """reference: dygraph/parallel.py:335 DataParallel."""
+
+    def __init__(self, layers: Layer,
+                 strategy: Optional[ParallelStrategy] = None,
+                 comm_buffer_size: int = 25,
+                 last_comm_buffer_size: int = 1,
+                 find_unused_parameters: bool = False):
+        super().__init__()
+        self._layers = layers
+        self._strategy = strategy or ParallelStrategy()
+        self.comm_buffer_size = int(comm_buffer_size)
+        self.find_unused_parameters = find_unused_parameters
+        self._mesh = None
+
+    @property
+    def nranks(self) -> int:
+        return max(1, self._strategy.nranks)
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    # reference scale_loss:432 — divide the loss so the SUMMED grads of
+    # all ranks form the global mean
+    def scale_loss(self, loss):
+        if self.nranks <= 1:
+            return loss
+        return loss * (1.0 / self.nranks)
+
+    def apply_collective_grads(self):
+        """reference apply_collective_grads:441 — coalesce + allreduce."""
+        if self.nranks <= 1:
+            return
+        if self._mesh is None:
+            self._mesh = _dp_mesh()
+        params = [p for p in self._layers.parameters()
+                  if p is not None and getattr(p, "trainable", True)
+                  and p.grad is not None]
+        # group by dtype into ~comm_buffer_size MB flat buffers
+        groups: List[List] = []
+        cur: List = []
+        cur_bytes = 0
+        cur_dtype = None
+        limit = self.comm_buffer_size * (1 << 20)
+        for p in params:
+            g = np.asarray(p.grad._array)
+            if cur and (g.dtype != cur_dtype or cur_bytes >= limit):
+                groups.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append((p, g))
+            cur_dtype = g.dtype
+            cur_bytes += g.nbytes
+        if cur:
+            groups.append(cur)
+        for group in groups:
+            flat = np.concatenate([g.reshape(-1) for _, g in group])
+            reduced = _allreduce_across_processes(flat, self._mesh)
+            off = 0
+            for p, g in group:
+                n = g.size
+                p.grad._array = reduced[off:off + n].reshape(g.shape) \
+                    .astype(g.dtype)
+                off += n
+
+    # passthroughs the reference exposes
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, *a, **kw):
+        return self._layers.set_state_dict(*a, **kw)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+
+def scale_loss(loss, nranks: Optional[int] = None):
+    """Module-level helper (reference keeps it on DataParallel; fleet's
+    dygraph path calls it free-standing)."""
+    from ..distributed.parallel import get_world_size
+
+    n = nranks or get_world_size()
+    return loss * (1.0 / n) if n > 1 else loss

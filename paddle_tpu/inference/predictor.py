@@ -24,6 +24,7 @@ from ..core.scope import Scope
 
 DEFAULT_PASSES = [
     "delete_dropout_pass",
+    "conv_bn_fuse_pass",
     "multihead_attention_fuse_pass",
     "fc_fuse_pass",
 ]
@@ -113,7 +114,8 @@ class AnalysisPredictor:
         self.fetch_names = list(fetch_names or [])
         if config.ir_optim:
             self.program = apply_passes(self.program,
-                                        config.enabled_passes())
+                                        config.enabled_passes(),
+                                        scope=self.scope)
         self._staged: Dict[str, np.ndarray] = {}
         self._last_outputs: Optional[Dict[str, Any]] = None
         self._cache: Dict[tuple, Any] = {}

@@ -100,10 +100,17 @@ def cond(pred: Variable, true_fn: Callable, false_fn: Optional[Callable] = None,
 
 
 def while_loop(cond_fn: Callable, body_fn: Callable,
-               loop_vars: Sequence[Variable], name=None):
+               loop_vars: Sequence[Variable], name=None,
+               grad_max_iters: int = 0):
     """paddle.static.nn.while_loop — dynamic trip count via
-    lax.while_loop. NOT reverse-differentiable; use static_loop for
-    training-time loops with a static count."""
+    lax.while_loop.
+
+    grad_max_iters=N makes the loop reverse-differentiable (the
+    reference while_op's sub-block grad capability,
+    controlflow/while_op.cc): the lowering becomes a bounded N-step
+    scan whose carry freezes once the condition turns false, so
+    backward flows through exactly the iterations that ran. Without
+    it the loop is forward-only (XLA while has no transpose)."""
     helper = LayerHelper("while_loop", name=name)
     loop_vars = _as_list(loop_vars)
     cond_blk, cond_outs = _trace_sub_block(cond_fn, loop_vars)
@@ -128,7 +135,8 @@ def while_loop(cond_fn: Callable, body_fn: Callable,
          "carry_names": carry_names,
          "cond_out_name": cond_outs[0].name,
          "body_out_names": [v.name for v in body_outs],
-         "ext_names": list(ext)})
+         "ext_names": list(ext),
+         "grad_max_iters": int(grad_max_iters)})
     return out_vars
 
 

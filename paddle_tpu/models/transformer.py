@@ -195,11 +195,12 @@ def decoder(tgt_ids, enc_out, src_mask, cfg, is_test=False):
 def build_wmt_program(cfg: TransformerConfig, seq_len: int = 64,
                       batch_size: int = -1, warmup_steps: int = 4000,
                       lr_scale: float = 2.0, is_test=False,
-                      with_optimizer=True):
+                      with_optimizer=True, amp: bool = False):
     """Teacher-forced training step.
 
     Feeds: src_ids, tgt_ids, lbl_ids [B,S] int64; src_mask, lbl_weight [B,S]
     float32 (1 on real tokens). Fetches: loss (weighted token mean), token_num.
+    amp=True runs matmul-class compute in bf16 via the static AMP rewrite.
     """
     main, startup = Program(), Program()
     with program_guard(main, startup):
@@ -238,6 +239,10 @@ def build_wmt_program(cfg: TransformerConfig, seq_len: int = 64,
                                    learning_rate=lr_scale)
             opt = opt_mod.AdamOptimizer(lr, beta1=0.9, beta2=0.997,
                                         epsilon=1e-9)
+            if amp:
+                from ..contrib.mixed_precision import decorate
+
+                opt = decorate(opt, use_dynamic_loss_scaling=False)
             opt.minimize(loss)
 
     feeds = dict(src_ids=src_ids, tgt_ids=tgt_ids, lbl_ids=lbl_ids,

@@ -51,3 +51,10 @@ try:
     from . import ps_ops  # noqa: F401
 except ImportError:
     pass
+from . import beam_search_ops  # noqa: F401
+from . import extra_ops2  # noqa: F401
+from . import fused_ops  # noqa: F401
+from . import interp_ops  # noqa: F401
+from . import linalg_ops  # noqa: F401
+from . import metrics_ops  # noqa: F401
+from . import vision_ops  # noqa: F401

@@ -1,0 +1,94 @@
+"""Beam-search program ops.
+
+Capability mirror of the reference's in-program beam search
+(operators/math/beam_search.cc beam_search op, beam_search_decode_op.cc,
+gather_tree_op.cc). The reference threads LoD through selected ids;
+here the dense TPU form is used: fixed [batch, beam] lanes per step
+(finished lanes keep emitting end_id with frozen scores), so every
+shape is static and the whole decode loop can live inside one jitted
+while_loop. models/seq2seq.py uses the same scheme inline; these ops
+expose it at the program level.
+"""
+
+from __future__ import annotations
+
+from ..core.registry import register_op
+
+
+@register_op("beam_search", non_diff_inputs=("pre_ids", "pre_scores",
+                                             "scores", "ids"))
+def beam_search(ins, attrs):
+    """One step of beam expansion (reference: math/beam_search.cc).
+
+    Dense form: pre_ids [B*W, 1], pre_scores [B*W, 1], scores [B*W, V]
+    (probabilities, or accumulated log-probs when is_accumulated).
+    Selects top beam_size of the W*V candidates per batch row.
+    Outputs selected_ids/selected_scores [B*W, 1] and parent_idx [B*W]
+    (flat index into the incoming lanes).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    pre_ids = ins["pre_ids"][0].reshape(-1)
+    pre_scores = ins["pre_scores"][0].reshape(-1)
+    scores = ins["scores"][0]
+    w = int(attrs["beam_size"])
+    end_id = int(attrs["end_id"])
+    accumulated = bool(attrs.get("is_accumulated", True))
+    bw, v = scores.shape
+    b = bw // w
+
+    logp = scores if accumulated else jnp.log(jnp.maximum(scores, 1e-20))
+    total = jnp.where(accumulated, logp,
+                      pre_scores[:, None] + logp)
+    # finished lanes (pre_id == end_id) only propagate end_id with their
+    # frozen score; mask every other candidate out
+    finished = pre_ids == end_id
+    neg = jnp.full_like(total, -1e9)
+    frozen = neg.at[:, end_id].set(pre_scores)
+    total = jnp.where(finished[:, None], frozen, total)
+
+    flat = total.reshape(b, w * v)
+    top_scores, top_idx = jax.lax.top_k(flat, w)             # [B, W]
+    parent_in_row = top_idx // v
+    token = top_idx % v
+    parent_flat = (jnp.arange(b)[:, None] * w + parent_in_row).reshape(-1)
+    return {"selected_ids": token.reshape(-1, 1).astype(pre_ids.dtype),
+            "selected_scores": top_scores.reshape(-1, 1),
+            "parent_idx": parent_flat.astype(jnp.int32)}
+
+
+@register_op("gather_tree", non_diff_inputs=("Ids", "Parents"))
+def gather_tree(ins, attrs):
+    """Back-trace beams to full sequences (reference:
+    gather_tree_op.cc): Ids/Parents [T, B, W] -> sequences [T, B, W]."""
+    import jax
+    import jax.numpy as jnp
+
+    ids = ins["Ids"][0]
+    parents = ins["Parents"][0]
+    t, b, w = ids.shape
+    rows = jnp.arange(b)[:, None]
+
+    def step(parent, inputs):
+        id_t, par_t = inputs
+        tok = id_t[rows, parent]
+        parent = par_t[rows, parent]
+        return parent, tok
+
+    init = jnp.broadcast_to(jnp.arange(w)[None, :], (b, w))
+    _, toks = jax.lax.scan(step, init, (ids, parents), reverse=True)
+    return {"Out": toks}
+
+
+@register_op("beam_search_decode", non_diff_inputs=("Ids", "Scores",
+                                                    "ParentIdx"))
+def beam_search_decode(ins, attrs):
+    """Assemble final sequences + scores after the loop (reference:
+    beam_search_decode_op.cc). Dense form: stacked per-step
+    Ids/ParentIdx [T, B, W] and final-step Scores [B, W]; returns the
+    back-traced token grid and the per-beam scores."""
+    ids = ins["Ids"][0]
+    parents = ins["ParentIdx"][0]
+    out = gather_tree({"Ids": [ids], "Parents": [parents]}, {})["Out"]
+    return {"SentenceIds": out, "SentenceScores": ins["Scores"][0]}

@@ -235,3 +235,77 @@ def _identity_grad(op, out_grads, in_grads):
 for _t in ("c_allreduce_sum", "allreduce", "c_reduce_sum", "c_identity",
            "c_sync_calc_stream", "c_sync_comm_stream"):
     register_grad_maker(_t)(_identity_grad)
+
+
+@register_op("c_reduce_max", is_collective=True)
+def c_reduce_max(ins, attrs):
+    """reference: collective/c_reduce_op.h (max variant)."""
+    import jax
+
+    x = ins["X"][0]
+    ax = _axis_name(attrs)
+    return {"Out": jax.lax.pmax(x, ax) if _in_spmd(ax) else x}
+
+
+@register_op("c_reduce_min", is_collective=True)
+def c_reduce_min(ins, attrs):
+    """reference: collective/c_reduce_op.h (min variant)."""
+    import jax
+
+    x = ins["X"][0]
+    ax = _axis_name(attrs)
+    return {"Out": jax.lax.pmin(x, ax) if _in_spmd(ax) else x}
+
+
+@register_op("c_reduce_prod", is_collective=True)
+def c_reduce_prod(ins, attrs):
+    """reference: collective/c_reduce_op.h (prod variant)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    ax = _axis_name(attrs)
+    if _in_spmd(ax):
+        x = jnp.exp(jax.lax.psum(jnp.log(jnp.maximum(jnp.abs(x), 1e-30)),
+                                 ax)) * jnp.prod(
+            jnp.sign(jax.lax.all_gather(x, ax)), axis=0)
+    return {"Out": x}
+
+
+@register_op("c_scatter", is_collective=True)
+def c_scatter(ins, attrs):
+    """Root's tensor split across ranks (reference:
+    collective/c_scatter_op.cc). SPMD form: every rank holds the full
+    input replicated; each keeps its own slice."""
+    import jax
+
+    x = ins["X"][0]
+    ax = _axis_name(attrs)
+    if _in_spmd(ax):
+        n = jax.lax.axis_size(ax)
+        idx = jax.lax.axis_index(ax)
+        chunk = x.shape[0] // n
+        x = jax.lax.dynamic_slice_in_dim(x, idx * chunk, chunk, axis=0)
+    return {"Out": x}
+
+
+@register_op("broadcast", is_collective=True)
+def broadcast(ins, attrs):
+    """Legacy broadcast op (reference: distributed_ops/broadcast_op.cc);
+    same lowering as c_broadcast."""
+    return c_broadcast(ins, attrs)
+
+
+@register_op("c_comm_init_all", is_collective=True)
+def c_comm_init_all(ins, attrs):
+    """reference: collective/c_comm_init_all_op.cc — comm setup is mesh
+    construction on TPU; no-op marker like c_comm_init."""
+    return {}
+
+
+@register_op("c_gen_nccl_id", is_collective=True)
+def c_gen_nccl_id(ins, attrs):
+    """reference: collective/c_gen_nccl_id_op.cc (TCP bootstrap of the
+    NCCL unique id) — jax.distributed's coordinator plays this role; the
+    op is a no-op marker kept for program parity."""
+    return {}

@@ -154,12 +154,23 @@ def cond_two_branch(ins, attrs):
     return {"Out": list(outs)}
 
 
-@register_op("while_loop", skip_infer_shape=True, non_diff_inputs=("Ext",))
+@register_op("while_loop", skip_infer_shape=True)
 def while_loop_op(ins, attrs):
     """Separate cond/body sub-blocks (layers/control_flow.py while_loop).
-    lax.while_loop — forward-only (XLA has no reverse-mode while); use
-    static_loop for differentiable fixed-count loops."""
+
+    Two lowerings (reference while_op.cc differentiates via a sub-block
+    grad program; XLA's while primitive is forward-only, so):
+      * default — lax.while_loop, dynamic trip count, NOT
+        reverse-differentiable;
+      * grad_max_iters=N attr — a bounded lax.scan of N steps whose
+        carry only advances while the condition holds (masked
+        pass-through after convergence). scan has a transpose, so the
+        generic vjp grad maker differentiates it — grads flow through
+        exactly the active iterations. This is the documented
+        bounded-iteration lowering for grad-of-while.
+    """
     import jax
+    import jax.numpy as jnp
 
     cond_blk, body_blk = attrs["cond_block"], attrs["body_block"]
     carry_names = list(attrs["carry_names"])
@@ -182,8 +193,43 @@ def while_loop_op(ins, attrs):
         _run_sub_block(body_blk, env, step=step)
         return tuple(env[n] for n in body_out_names)
 
+    max_iters = int(attrs.get("grad_max_iters", 0) or 0)
+    if max_iters > 0:
+        def scan_body(carry, _):
+            active = cond_fn(carry)
+            new = body_fn(carry)
+            out = tuple(jnp.where(active, n, c)
+                        for n, c in zip(new, carry))
+            return out, None
+
+        outs, _ = jax.lax.scan(scan_body, tuple(ins["X"]), None,
+                               length=max_iters)
+        return {"Out": list(outs)}
+
     outs = jax.lax.while_loop(cond_fn, body_fn, tuple(ins["X"]))
     return {"Out": list(outs)}
+
+
+from ..core.registry import default_grad_maker, register_grad_maker  # noqa: E402
+
+
+@register_grad_maker("while_loop")
+def _while_loop_grad_maker(op, out_grads, in_grads):
+    """Grads of an UNBOUNDED while would crash deep inside jax ('reverse
+    -mode differentiation does not work for lax.while_loop'); surface the
+    fix at program-build time instead. With grad_max_iters the bounded
+    scan lowering transposes fine -> generic vjp."""
+    if not int(op.attrs.get("grad_max_iters", 0) or 0):
+        wanted = any(g is not None
+                     for gs in in_grads.values() for g in (gs or []))
+        if wanted:
+            raise ValueError(
+                "while_loop is not reverse-differentiable with a dynamic "
+                "trip count (XLA while has no transpose); pass "
+                "grad_max_iters=N to while_loop for the bounded-scan "
+                "lowering, or use static_loop")
+        return []
+    return default_grad_maker(op, out_grads, in_grads)
 
 
 @register_op("static_loop", skip_infer_shape=True)

@@ -1,0 +1,358 @@
+"""Additional op-surface batch: 3-D convs, shape utilities, recurrent
+units, CTC, sampling losses, normalisation variants.
+
+Capability mirror of the corresponding reference root ops
+(conv3d from conv_op.cc, pad3d_op.cc, crop_op.cc/crop_tensor_op.cc,
+flatten_op.cc, row_conv_op.cc, conv_shift_op.cc, gru_unit_op.cc,
+lstm_unit_op.cc, warpctc_op.cc, nce_op.cc, sample_logits_op.cc,
+segment_pool from segment_ops, data_norm_op.cc, im2sequence_op.cc,
+hash_op.cc, get_tensor_from_selected_rows_op.cc,
+merge_selected_rows_op.cc).
+"""
+
+from __future__ import annotations
+
+from ..core.registry import register_op
+
+
+@register_op("conv3d")
+def conv3d(ins, attrs):
+    """NCDHW 3-D conv (reference: conv_op.cc conv3d registration)."""
+    import jax.lax as lax
+
+    x, w = ins["Input"][0], ins["Filter"][0]
+    s = tuple(int(v) for v in attrs.get("strides", [1, 1, 1]))
+    d = tuple(int(v) for v in attrs.get("dilations", [1, 1, 1]))
+    p = [int(v) for v in attrs.get("paddings", [0, 0, 0])]
+    groups = int(attrs.get("groups", 1) or 1)
+    pads = [(v, v) for v in p] if len(p) == 3 else \
+        [(p[0], p[1]), (p[2], p[3]), (p[4], p[5])]
+    out = lax.conv_general_dilated(
+        x, w, s, pads, rhs_dilation=d, feature_group_count=groups,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    return {"Output": out}
+
+
+@register_op("conv3d_transpose")
+def conv3d_transpose(ins, attrs):
+    """reference: conv_transpose_op.cc (IODHW filter)."""
+    import jax.lax as lax
+
+    x, w = ins["Input"][0], ins["Filter"][0]
+    s = tuple(int(v) for v in attrs.get("strides", [1, 1, 1]))
+    p = [int(v) for v in attrs.get("paddings", [0, 0, 0])]
+    kd, kh, kw = w.shape[2], w.shape[3], w.shape[4]
+    pads = [(kd - 1 - p[0], kd - 1 - p[0]),
+            (kh - 1 - p[1], kh - 1 - p[1]),
+            (kw - 1 - p[2], kw - 1 - p[2])]
+    w_t = w.transpose(1, 0, 2, 3, 4)[:, :, ::-1, ::-1, ::-1]
+    out = lax.conv_general_dilated(
+        x, w_t, (1, 1, 1), pads, lhs_dilation=s,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    return {"Output": out}
+
+
+@register_op("pad3d")
+def pad3d(ins, attrs):
+    """reference: pad3d_op.cc (NCDHW; constant/reflect/replicate)."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    p = [int(v) for v in attrs["paddings"]]  # [l, r, t, b, f, back]
+    mode = attrs.get("mode", "constant")
+    val = float(attrs.get("value", 0.0))
+    pads = [(0, 0), (0, 0), (p[4], p[5]), (p[2], p[3]), (p[0], p[1])]
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    kw = {"constant_values": val} if mode == "constant" else {}
+    return {"Out": jnp.pad(x, pads, mode=jmode, **kw)}
+
+
+@register_op("crop")
+def crop(ins, attrs):
+    """Static crop at offsets (reference: crop_op.cc)."""
+    import jax.lax as lax
+
+    x = ins["X"][0]
+    offsets = [int(v) for v in attrs.get("offsets", [0] * x.ndim)]
+    shape = [int(v) for v in attrs["shape"]]
+    return {"Out": lax.dynamic_slice(x, offsets, shape)}
+
+
+@register_op("crop_tensor")
+def crop_tensor(ins, attrs):
+    """reference: crop_tensor_op.cc — crop with shape/offsets as attrs
+    (tensor-valued offsets fall back to attr form on TPU)."""
+    return crop(ins, attrs)
+
+
+@register_op("flatten")
+def flatten(ins, attrs):
+    """Flatten trailing dims from `axis` (reference: flatten_op.cc)."""
+    import numpy as np
+
+    x = ins["X"][0]
+    axis = int(attrs.get("axis", 1))
+    lead = int(np.prod(x.shape[:axis])) if axis > 0 else 1
+    return {"Out": x.reshape(lead, -1)}
+
+
+@register_op("row_conv")
+def row_conv(ins, attrs):
+    """Lookahead row convolution (reference: row_conv_op.cc):
+    Out[t] = sum_k X[t+k] * W[k], zero past the end. X [B, S, D],
+    Filter [future_len, D]."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    w = ins["Filter"][0]
+    b, s, d = x.shape
+    k = w.shape[0]
+    out = jnp.zeros_like(x)
+    for i in range(k):
+        rolled = jnp.pad(x, ((0, 0), (0, i), (0, 0)))[:, i:i + s]
+        out = out + rolled * w[i][None, None, :]
+    return {"Out": out}
+
+
+@register_op("conv_shift")
+def conv_shift(ins, attrs):
+    """Circular correlation (reference: conv_shift_op.cc): X [B, M],
+    Y [B, N] (N odd, N<=M): Out[b,i] = sum_j X[b,(i+j-N/2) mod M]*Y[b,j]."""
+    import jax.numpy as jnp
+
+    x, y = ins["X"][0], ins["Y"][0]
+    m, n = x.shape[1], y.shape[1]
+    half = n // 2
+    idx = (jnp.arange(m)[:, None] + jnp.arange(n)[None, :] - half) % m
+    gathered = x[:, idx]                         # [B, M, N]
+    return {"Out": jnp.einsum("bmn,bn->bm", gathered, y)}
+
+
+@register_op("gru_unit")
+def gru_unit(ins, attrs):
+    """Single GRU step (reference: gru_unit_op.cc). Input [B, 3D] holds
+    the projected x contributions (update, reset, cand)."""
+    import jax
+    import jax.numpy as jnp
+
+    xp = ins["Input"][0]
+    h_prev = ins["HiddenPrev"][0]
+    w = ins["Weight"][0]                  # [D, 3D] (u/r first 2D, c last D)
+    bias = ins.get("Bias", [None])[0]
+    d = h_prev.shape[1]
+    g = xp + (bias if bias is not None else 0.0)
+    ur = g[:, :2 * d] + h_prev @ w[:, :2 * d]
+    gate = jax.nn.sigmoid(ur)
+    u, r = gate[:, :d], gate[:, d:]
+    c = jnp.tanh(g[:, 2 * d:] + (r * h_prev) @ w[:, 2 * d:])
+    h = u * h_prev + (1.0 - u) * c
+    return {"Hidden": h, "Gate": jnp.concatenate([gate, c], axis=1),
+            "ResetHiddenPrev": r * h_prev}
+
+
+@register_op("lstm_unit")
+def lstm_unit(ins, attrs):
+    """Single LSTM cell step (reference: lstm_unit_op.cc). X [B, 4D]
+    pre-activation gates (i, f, c, o)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    c_prev = ins["C_prev"][0]
+    forget_bias = float(attrs.get("forget_bias", 0.0))
+    d = c_prev.shape[1]
+    i, f, cc, o = (x[:, :d], x[:, d:2 * d], x[:, 2 * d:3 * d], x[:, 3 * d:])
+    c = jax.nn.sigmoid(f + forget_bias) * c_prev \
+        + jax.nn.sigmoid(i) * jnp.tanh(cc)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return {"C": c, "H": h}
+
+
+@register_op("warpctc", non_diff_inputs=("Label", "LogitsLength",
+                                         "LabelLength"))
+def warpctc(ins, attrs):
+    """CTC loss (reference: warpctc_op.cc wrapping the warp-ctc lib;
+    here optax.ctc_loss — a native XLA lattice implementation)."""
+    import jax.numpy as jnp
+    import optax
+
+    logits = ins["Logits"][0]            # [B, T, C] (batch_first form)
+    labels = ins["Label"][0]             # [B, L]
+    blank = int(attrs.get("blank", 0))
+    lt = ins.get("LogitsLength", [None])[0]
+    ll = ins.get("LabelLength", [None])[0]
+    b, t, _ = logits.shape
+    lpad = jnp.zeros((b, t)) if lt is None else (
+        jnp.arange(t)[None, :] >= lt.reshape(-1, 1)).astype(jnp.float32)
+    l = labels.shape[1]
+    labpad = jnp.zeros((b, l)) if ll is None else (
+        jnp.arange(l)[None, :] >= ll.reshape(-1, 1)).astype(jnp.float32)
+    loss = optax.ctc_loss(logits, lpad, labels.astype(jnp.int32), labpad,
+                          blank_id=blank)
+    return {"Loss": loss.reshape(-1, 1),
+            "WarpCTCGrad": jnp.zeros_like(logits)}
+
+
+@register_op("nce", non_diff_inputs=("Label", "SampleWeight",
+                                     "CustomDistProbs", "CustomDistAlias",
+                                     "CustomDistAliasProbs"))
+def nce(ins, attrs):
+    """Noise-contrastive estimation loss (reference: nce_op.cc).
+    Deterministic striding replaces host-side alias sampling (sampler
+    attr) so the lowering stays traceable; uniform noise distribution."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ins["Input"][0]                  # [B, D]
+    label = ins["Label"][0].reshape(-1).astype(jnp.int32)
+    w = ins["Weight"][0]                 # [C, D]
+    bias = ins.get("Bias", [None])[0]
+    num_neg = int(attrs["num_neg_samples"])
+    c = int(attrs["num_total_classes"])
+    from .tensor_ops import _rng_key
+
+    b = x.shape[0]
+    noise = jax.random.randint(_rng_key(attrs), (b, num_neg), 0, c)
+    pos_logit = jnp.sum(x * w[label], axis=1, keepdims=True)
+    neg_logit = jnp.einsum("bd,bkd->bk", x, w[noise])
+    if bias is not None:
+        pos_logit = pos_logit + bias[label][:, None]
+        neg_logit = neg_logit + bias[noise]
+    pn = 1.0 / c
+    pos = jax.nn.log_sigmoid(pos_logit - jnp.log(num_neg * pn))
+    neg = jax.nn.log_sigmoid(-(neg_logit - jnp.log(num_neg * pn)))
+    cost = -(pos.sum(1) + neg.sum(1))
+    return {"Cost": cost.reshape(-1, 1),
+            "SampleLogits": jnp.concatenate([pos_logit, neg_logit], 1),
+            "SampleLabels": jnp.concatenate(
+                [label[:, None], noise], 1)}
+
+
+@register_op("sample_logits", non_diff_inputs=("Labels",))
+def sample_logits(ins, attrs):
+    """Sampled-softmax helper (reference: sample_logits_op.cc):
+    gathers true + uniformly sampled logits and corrects by log(q)."""
+    import jax
+    import jax.numpy as jnp
+
+    logits = ins["Logits"][0]            # [B, C]
+    labels = ins["Labels"][0].astype(jnp.int32)   # [B, T]
+    num_samples = int(attrs["num_samples"])
+    from .tensor_ops import _rng_key
+
+    b, c = logits.shape
+    samples = jax.random.randint(_rng_key(attrs), (b, num_samples), 0, c)
+    all_ids = jnp.concatenate([labels, samples], axis=1)
+    sampled = jnp.take_along_axis(logits, all_ids, axis=1)
+    if not bool(attrs.get("remove_accidental_hits", False)):
+        pass
+    q = jnp.full_like(sampled, 1.0 / c)
+    out = sampled - jnp.log(q * num_samples)
+    return {"SampledLogits": out, "Samples": all_ids,
+            "SampledLabels": jnp.zeros((b,), jnp.int32),
+            "Probabilities": q, "LogitsDim": jnp.zeros((2,), jnp.int64),
+            "LabelsDim": jnp.zeros((2,), jnp.int64)}
+
+
+@register_op("segment_pool", non_diff_inputs=("SegmentIds",))
+def segment_pool(ins, attrs):
+    """Pool rows by segment id (reference: segment_ops — SUM/MEAN/MAX/MIN).
+    Ids must be sorted, last id+1 segments emitted statically as
+    max(ids)+1 can't be traced: uses attr num_segments or X rows."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    ids = ins["SegmentIds"][0].reshape(-1).astype(jnp.int32)
+    ptype = str(attrs.get("pooltype", "SUM")).upper()
+    n = int(attrs.get("num_segments", 0)) or x.shape[0]
+    if ptype == "SUM":
+        out = jax.ops.segment_sum(x, ids, num_segments=n)
+    elif ptype == "MEAN":
+        s = jax.ops.segment_sum(x, ids, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones_like(ids, x.dtype), ids,
+                                  num_segments=n)
+        out = s / jnp.maximum(cnt, 1.0)[:, None]
+    elif ptype == "MAX":
+        out = jax.ops.segment_max(x, ids, num_segments=n)
+    else:
+        out = jax.ops.segment_min(x, ids, num_segments=n)
+    return {"Out": out}
+
+
+@register_op("data_norm", non_diff_inputs=("BatchSize", "BatchSum",
+                                           "BatchSquareSum"))
+def data_norm(ins, attrs):
+    """Global data normalisation from accumulated statistics
+    (reference: data_norm_op.cc — CTR feature scaling)."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    bsize = ins["BatchSize"][0]
+    bsum = ins["BatchSum"][0]
+    bsq = ins["BatchSquareSum"][0]
+    mean = bsum / bsize
+    scale = jnp.sqrt(bsize / bsq)
+    return {"Y": (x - mean) * scale, "Means": mean, "Scales": scale}
+
+
+@register_op("im2sequence")
+def im2sequence(ins, attrs):
+    """Image patches to sequence rows (reference: im2sequence_op.cc):
+    [N, C, H, W] -> [N*OH*OW, C*kh*kw]."""
+    import jax.lax as lax
+
+    x = ins["X"][0]
+    kh, kw = [int(v) for v in attrs["kernels"]]
+    sh, sw = [int(v) for v in attrs.get("strides", [1, 1])]
+    p = [int(v) for v in attrs.get("paddings", [0, 0, 0, 0])]
+    n, c = x.shape[0], x.shape[1]
+    patches = lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), [(p[0], p[2]), (p[1], p[3])],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    n_, ckk, oh, ow = patches.shape
+    return {"Out": patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, ckk)}
+
+
+@register_op("hash", non_diff_inputs=("X",))
+def hash_op(ins, attrs):
+    """Deterministic feature hashing (reference: hash_op.cc uses xxhash;
+    here a multiplicative LCG hash per num_hash seed — same contract:
+    int ids -> [B, S, num_hash] bucket ids)."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0].astype(jnp.uint32)
+    num_hash = int(attrs.get("num_hash", 1))
+    mod = int(attrs["mod_by"])
+    outs = []
+    for i in range(num_hash):
+        h = (x * jnp.uint32(2654435761 + 97 * i)
+             + jnp.uint32(0x9E3779B9 * (i + 1)))
+        h = h ^ (h >> 16)
+        outs.append((h % jnp.uint32(mod)).astype(jnp.int64))
+    return {"Out": jnp.stack(outs, axis=-1)}
+
+
+@register_op("get_tensor_from_selected_rows")
+def get_tensor_from_selected_rows(ins, attrs):
+    """SelectedRows value extraction (reference:
+    get_tensor_from_selected_rows_op.cc). Dense substrate: identity."""
+    return {"Out": ins["X"][0]}
+
+
+@register_op("merge_selected_rows")
+def merge_selected_rows(ins, attrs):
+    """Merge duplicate sparse rows (reference:
+    merge_selected_rows_op.cc). Dense substrate: identity."""
+    return {"Out": ins["X"][0]}
+
+
+@register_op("lod_reset", non_diff_inputs=("Y",))
+def lod_reset(ins, attrs):
+    """Replace a tensor's LoD (reference: lod_reset_op.cc). Padded
+    substrate: values pass through, the new lengths ride along."""
+    out = {"Out": ins["X"][0]}
+    if ins.get("Y") and ins["Y"][0] is not None:
+        out["OutLod"] = ins["Y"][0]
+    return out

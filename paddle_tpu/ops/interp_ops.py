@@ -1,0 +1,62 @@
+"""Image interpolation / resize op family.
+
+Capability mirror of the reference's interpolate ops
+(operators/interpolate_op.cc + *_interp_v2 variants: nearest, (bi)linear,
+bicubic, trilinear) lowered onto jax.image.resize — one implementation,
+six registered op names, NCHW/NCDHW layouts like the reference.
+"""
+
+from __future__ import annotations
+
+from ..core.registry import register_op
+
+_METHODS = {
+    "nearest": "nearest",
+    "bilinear": "linear",
+    "linear": "linear",
+    "bicubic": "cubic",
+    "trilinear": "linear",
+}
+
+
+def _interp(ins, attrs, method, ndim_spatial):
+    import jax.image
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    out_hw = None
+    if ins.get("OutSize") and ins["OutSize"][0] is not None:
+        out_hw = [int(v) for v in list(jnp.asarray(ins["OutSize"][0]))] \
+            if not hasattr(ins["OutSize"][0], "aval") else None
+    if out_hw is None:
+        keys = ["out_d", "out_h", "out_w"][-ndim_spatial:]
+        out_hw = [int(attrs.get(k, 0) or 0) for k in keys]
+        if not all(v > 0 for v in out_hw):
+            scale = attrs.get("scale", 0.0)
+            scales = (list(scale) if isinstance(scale, (list, tuple))
+                      else [float(scale)] * ndim_spatial)
+            out_hw = [int(round(float(d) * s))
+                      for d, s in zip(x.shape[-ndim_spatial:], scales)]
+    new_shape = tuple(x.shape[:-ndim_spatial]) + tuple(out_hw)
+    # jax.image.resize's default sampling matches align_corners=False,
+    # half_pixel; the align_corners=True variant is approximated by the
+    # same kernel (exact only at the corners — documented deviation)
+    out = jax.image.resize(x, new_shape, method=_METHODS[method])
+    return {"Out": out.astype(x.dtype)}
+
+
+for _name, _m, _nd in [
+    ("nearest_interp", "nearest", 2), ("nearest_interp_v2", "nearest", 2),
+    ("bilinear_interp", "bilinear", 2), ("bilinear_interp_v2", "bilinear", 2),
+    ("linear_interp", "linear", 1), ("linear_interp_v2", "linear", 1),
+    ("bicubic_interp", "bicubic", 2), ("bicubic_interp_v2", "bicubic", 2),
+    ("trilinear_interp", "trilinear", 3),
+    ("trilinear_interp_v2", "trilinear", 3),
+]:
+    def _make(m=_m, nd=_nd):
+        def op(ins, attrs):
+            return _interp(ins, attrs, m, nd)
+        return op
+
+    register_op(_name, non_diff_inputs=("OutSize", "SizeTensor", "Scale"),
+                skip_infer_shape=False)(_make())

@@ -1,0 +1,183 @@
+"""Linear-algebra + small tensor ops.
+
+Capability mirror of the reference's root-dir math ops
+(operators/addmm_op.cc, cross_op.cc, mv_op.cc, trace_op.cc,
+inverse_op.cc, cholesky_op.cc, logsumexp from reduce family,
+frobenius_norm_op.cc, l1_norm_op.cc, multiplex_op.cc, minus_op.cc,
+expand_as_op.cc, pad_constant_like_op.cc, shard_index_op.cc) as direct
+jnp lowerings — the autodiff comes from the generic vjp grad maker.
+"""
+
+from __future__ import annotations
+
+from ..core.registry import register_op
+
+
+@register_op("addmm")
+def addmm(ins, attrs):
+    """Out = beta * Input + alpha * (X @ Y) (operators/addmm_op.cc)."""
+    import jax.numpy as jnp
+
+    inp, x, y = ins["Input"][0], ins["X"][0], ins["Y"][0]
+    alpha = float(attrs.get("Alpha", attrs.get("alpha", 1.0)))
+    beta = float(attrs.get("Beta", attrs.get("beta", 1.0)))
+    return {"Out": beta * inp + alpha * jnp.matmul(x, y)}
+
+
+@register_op("cross")
+def cross(ins, attrs):
+    """3-vector cross product along `dim` (operators/cross_op.cc)."""
+    import jax.numpy as jnp
+
+    x, y = ins["X"][0], ins["Y"][0]
+    dim = attrs.get("dim", None)
+    if dim is None or int(dim) == -100:   # reference's kDefaultDim
+        dim = next(i for i, d in enumerate(x.shape) if d == 3)
+    return {"Out": jnp.cross(x, y, axis=int(dim))}
+
+
+@register_op("mv")
+def mv(ins, attrs):
+    """Matrix-vector product (operators/mv_op.cc)."""
+    import jax.numpy as jnp
+
+    return {"Out": jnp.matmul(ins["X"][0], ins["Vec"][0])}
+
+
+@register_op("trace")
+def trace(ins, attrs):
+    """Sum along a diagonal (operators/trace_op.cc)."""
+    import jax.numpy as jnp
+
+    return {"Out": jnp.trace(ins["Input"][0],
+                             offset=int(attrs.get("offset", 0)),
+                             axis1=int(attrs.get("axis1", 0)),
+                             axis2=int(attrs.get("axis2", 1)))}
+
+
+@register_op("inverse")
+def inverse(ins, attrs):
+    """Batched matrix inverse (operators/inverse_op.cc)."""
+    import jax.numpy as jnp
+
+    return {"Output": jnp.linalg.inv(ins["Input"][0])}
+
+
+@register_op("cholesky")
+def cholesky(ins, attrs):
+    """Cholesky factor (operators/cholesky_op.cc)."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    upper = bool(attrs.get("upper", False))
+    l = jnp.linalg.cholesky(x)
+    return {"Out": jnp.swapaxes(l, -1, -2) if upper else l}
+
+
+@register_op("logsumexp")
+def logsumexp(ins, attrs):
+    """reference: operators/reduce_ops/logsumexp_op.cc."""
+    import jax.scipy.special as jsp
+
+    x = ins["X"][0]
+    axis = attrs.get("axis", attrs.get("dim", None))
+    keepdim = bool(attrs.get("keepdim", attrs.get("keep_dim", False)))
+    if attrs.get("reduce_all", False) or axis is None or axis == []:
+        axis = None
+    elif isinstance(axis, (list, tuple)):
+        axis = tuple(int(a) for a in axis)
+    else:
+        axis = int(axis)
+    return {"Out": jsp.logsumexp(x, axis=axis, keepdims=keepdim)}
+
+
+@register_op("frobenius_norm")
+def frobenius_norm(ins, attrs):
+    """reference: operators/reduce_ops/frobenius_norm_op.cc."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    axis = attrs.get("dim", attrs.get("axis", None))
+    keepdim = bool(attrs.get("keep_dim", False))
+    if attrs.get("reduce_all", False) or not axis:
+        axis = None
+    else:
+        axis = tuple(int(a) for a in axis)
+    return {"Out": jnp.sqrt(jnp.sum(jnp.square(x), axis=axis,
+                                    keepdims=keepdim))}
+
+
+@register_op("l1_norm")
+def l1_norm(ins, attrs):
+    """Sum of absolute values (operators/l1_norm_op.cc)."""
+    import jax.numpy as jnp
+
+    return {"Out": jnp.sum(jnp.abs(ins["X"][0]))}
+
+
+@register_op("multiplex", non_diff_inputs=("Ids",))
+def multiplex(ins, attrs):
+    """Row-wise select among N candidate tensors by index
+    (operators/multiplex_op.cc): Out[i] = X[Ids[i]][i]."""
+    import jax.numpy as jnp
+
+    ids = ins["Ids"][0].reshape(-1).astype(jnp.int32)
+    stacked = jnp.stack(ins["X"], axis=0)        # [N, B, ...]
+    rows = jnp.arange(stacked.shape[1])
+    return {"Out": stacked[ids, rows]}
+
+
+@register_op("minus")
+def minus(ins, attrs):
+    """Out = X - Y (operators/minus_op.cc)."""
+    return {"Out": ins["X"][0] - ins["Y"][0]}
+
+
+@register_op("expand_as")
+def expand_as(ins, attrs):
+    """Tile X to the shape of target_tensor (operators/expand_as_op.cc)."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    target = ins.get("target_tensor", ins.get("Y"))[0]
+    reps = tuple(int(t) // int(s) for s, t in zip(x.shape, target.shape))
+    return {"Out": jnp.tile(x, reps)}
+
+
+@register_op("pad_constant_like")
+def pad_constant_like(ins, attrs):
+    """Pad Y at the tail of every axis up to X's shape
+    (operators/pad_constant_like_op.cc)."""
+    import jax.numpy as jnp
+
+    x, y = ins["X"][0], ins["Y"][0]
+    val = float(attrs.get("pad_value", 0.0))
+    pads = [(0, int(dx) - int(dy)) for dx, dy in zip(x.shape, y.shape)]
+    return {"Out": jnp.pad(y, pads, constant_values=val)}
+
+
+@register_op("shard_index", non_diff_inputs=("X",))
+def shard_index(ins, attrs):
+    """Map global ids to shard-local ids (operators/shard_index_op.cc):
+    ids in this shard -> id % shard_size, others -> ignore_value."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    index_num = int(attrs["index_num"])
+    nshards = int(attrs["nshards"])
+    shard_id = int(attrs["shard_id"])
+    ignore = int(attrs.get("ignore_value", -1))
+    size = (index_num + nshards - 1) // nshards
+    mine = (x // size) == shard_id
+    return {"Out": jnp.where(mine, x % size, ignore)}
+
+
+@register_op("reverse")
+def reverse(ins, attrs):
+    """Flip along axes (operators/reverse_op.cc)."""
+    import jax.numpy as jnp
+
+    axes = attrs.get("axis", [0])
+    if not isinstance(axes, (list, tuple)):
+        axes = [axes]
+    return {"Out": jnp.flip(ins["X"][0], axis=tuple(int(a) for a in axes))}

@@ -1,0 +1,173 @@
+"""Metric + ranking-loss ops.
+
+Capability mirror of the reference's metrics/ and loss ops
+(operators/metrics/precision_recall_op.cc, positive_negative_pair_op.cc,
+operators/bpr_loss_op.cc, center_loss_op.cc, sigmoid_focal_loss from
+detection/, operators/cvm_op.cc): static-shape jnp lowerings; streaming
+states are carried as explicit inputs/outputs (the reference's
+"states" convention), which maps cleanly onto the executor's scope
+threading.
+"""
+
+from __future__ import annotations
+
+from ..core.registry import register_op
+
+
+@register_op("precision_recall", non_diff_inputs=(
+    "MaxProbs", "Indices", "Labels", "Weights", "StatesInfo"))
+def precision_recall(ins, attrs):
+    """Multi-class (macro/micro-averaged) precision / recall / F1
+    (operators/metrics/precision_recall_op.cc). Indices are the
+    predicted class per row, Labels the ground truth; per-class
+    [TP, FP, TN, FN] accumulates through StatesInfo.
+
+    Outputs: BatchMetrics [6] (macro P/R/F1, micro P/R/F1 of this batch),
+    AccumMetrics [6] (same over accumulated states),
+    AccumStatesInfo [C, 4]."""
+    import jax.numpy as jnp
+
+    idx = ins["Indices"][0].reshape(-1).astype(jnp.int32)
+    labels = ins["Labels"][0].reshape(-1).astype(jnp.int32)
+    c = int(attrs["class_number"])
+    w = None
+    if ins.get("Weights") and ins["Weights"][0] is not None:
+        w = ins["Weights"][0].reshape(-1).astype(jnp.float32)
+    else:
+        w = jnp.ones_like(idx, jnp.float32)
+
+    pred_oh = (idx[:, None] == jnp.arange(c)[None, :]).astype(jnp.float32)
+    true_oh = (labels[:, None] == jnp.arange(c)[None, :]).astype(jnp.float32)
+    wcol = w[:, None]
+    tp = jnp.sum(pred_oh * true_oh * wcol, axis=0)
+    fp = jnp.sum(pred_oh * (1 - true_oh) * wcol, axis=0)
+    fn = jnp.sum((1 - pred_oh) * true_oh * wcol, axis=0)
+    tn = jnp.sum((1 - pred_oh) * (1 - true_oh) * wcol, axis=0)
+    batch_states = jnp.stack([tp, fp, tn, fn], axis=1)       # [C, 4]
+
+    if ins.get("StatesInfo") and ins["StatesInfo"][0] is not None:
+        acc_states = batch_states + ins["StatesInfo"][0].astype(jnp.float32)
+    else:
+        acc_states = batch_states
+
+    def metrics(states):
+        tp_, fp_, tn_, fn_ = (states[:, 0], states[:, 1],
+                              states[:, 2], states[:, 3])
+        prec = jnp.where(tp_ + fp_ > 0, tp_ / (tp_ + fp_ + 1e-12), 0.0)
+        rec = jnp.where(tp_ + fn_ > 0, tp_ / (tp_ + fn_ + 1e-12), 0.0)
+        f1 = jnp.where(prec + rec > 0,
+                       2 * prec * rec / (prec + rec + 1e-12), 0.0)
+        macro = jnp.stack([jnp.mean(prec), jnp.mean(rec), jnp.mean(f1)])
+        stp, sfp, sfn = jnp.sum(tp_), jnp.sum(fp_), jnp.sum(fn_)
+        mp = jnp.where(stp + sfp > 0, stp / (stp + sfp + 1e-12), 0.0)
+        mr = jnp.where(stp + sfn > 0, stp / (stp + sfn + 1e-12), 0.0)
+        mf = jnp.where(mp + mr > 0, 2 * mp * mr / (mp + mr + 1e-12), 0.0)
+        return jnp.concatenate([macro, jnp.stack([mp, mr, mf])])
+
+    return {"BatchMetrics": metrics(batch_states),
+            "AccumMetrics": metrics(acc_states),
+            "AccumStatesInfo": acc_states}
+
+
+@register_op("positive_negative_pair", non_diff_inputs=(
+    "Score", "Label", "QueryID"))
+def positive_negative_pair(ins, attrs):
+    """Ranking metric: within each query, count score-ordered pairs that
+    agree/disagree with label order
+    (operators/metrics/positive_negative_pair_op.cc)."""
+    import jax.numpy as jnp
+
+    score = ins["Score"][0].reshape(-1)
+    label = ins["Label"][0].reshape(-1)
+    qid = ins["QueryID"][0].reshape(-1)
+    same_q = qid[:, None] == qid[None, :]
+    ds = score[:, None] - score[None, :]
+    dl = label[:, None] - label[None, :]
+    valid = same_q & (dl > 0)
+    pos = jnp.sum(jnp.where(valid & (ds > 0), 1.0, 0.0))
+    neg = jnp.sum(jnp.where(valid & (ds < 0), 1.0, 0.0))
+    neu = jnp.sum(jnp.where(valid & (ds == 0), 1.0, 0.0))
+    return {"PositivePair": pos.reshape(1),
+            "NegativePair": neg.reshape(1),
+            "NeutralPair": neu.reshape(1)}
+
+
+@register_op("bpr_loss", non_diff_inputs=("Label",))
+def bpr_loss(ins, attrs):
+    """Bayesian personalised ranking loss (operators/bpr_loss_op.cc):
+    -mean_j log(sigmoid(x_label - x_j))."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ins["X"][0]                                  # [B, C] scores
+    label = ins["Label"][0].reshape(-1).astype(jnp.int32)
+    b, c = x.shape
+    pos = x[jnp.arange(b), label][:, None]
+    diff = pos - x
+    logsig = jax.nn.log_sigmoid(diff)
+    mask = jnp.ones((b, c)).at[jnp.arange(b), label].set(0.0)
+    loss = -jnp.sum(logsig * mask, axis=1, keepdims=True) / (c - 1)
+    return {"Y": loss}
+
+
+@register_op("center_loss", non_diff_inputs=("Label", "CenterUpdateRate"))
+def center_loss(ins, attrs):
+    """Class-center pull loss (operators/center_loss_op.cc): loss is
+    ||x - c_y||^2/2; centers move toward their class means."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]                                  # [B, D]
+    label = ins["Label"][0].reshape(-1).astype(jnp.int32)
+    centers = ins["Centers"][0]                      # [C, D]
+    alpha = ins["CenterUpdateRate"][0].reshape(())
+    need_update = bool(attrs.get("need_update", True))
+    diff = x - centers[label]
+    loss = 0.5 * jnp.sum(jnp.square(diff), axis=1, keepdims=True)
+    if need_update:
+        c = centers.shape[0]
+        oh = (label[:, None] == jnp.arange(c)[None, :]).astype(x.dtype)
+        cnt = jnp.sum(oh, axis=0) + 1.0
+        delta = (oh.T @ diff) / cnt[:, None]
+        new_centers = centers + alpha * delta
+    else:
+        new_centers = centers
+    return {"Loss": loss, "SampleCenterDiff": diff,
+            "CentersOut": new_centers}
+
+
+@register_op("sigmoid_focal_loss", non_diff_inputs=("Label", "FgNum"))
+def sigmoid_focal_loss(ins, attrs):
+    """Focal loss on per-class sigmoid logits
+    (operators/detection/sigmoid_focal_loss_op.cc). Label 0 =
+    background, k in [1, C] marks class k-1 positive."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ins["X"][0]                                  # [B, C]
+    label = ins["Label"][0].reshape(-1).astype(jnp.int32)
+    fg = jnp.maximum(ins["FgNum"][0].reshape(()).astype(jnp.float32), 1.0)
+    gamma = float(attrs.get("gamma", 2.0))
+    alpha = float(attrs.get("alpha", 0.25))
+    c = x.shape[1]
+    t = ((label[:, None] - 1) == jnp.arange(c)[None, :]).astype(x.dtype)
+    p = jax.nn.sigmoid(x)
+    ce = -(t * jax.nn.log_sigmoid(x) + (1 - t) * jax.nn.log_sigmoid(-x))
+    w = t * alpha * jnp.power(1 - p, gamma) \
+        + (1 - t) * (1 - alpha) * jnp.power(p, gamma)
+    return {"Out": w * ce / fg}
+
+
+@register_op("cvm", non_diff_inputs=("CVM",))
+def cvm(ins, attrs):
+    """Click-view normalisation for CTR features (operators/cvm_op.cc):
+    strips or normalises the leading show/click columns."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    use_cvm = bool(attrs.get("use_cvm", True))
+    if use_cvm:
+        show = jnp.maximum(x[:, :1], 1.0)
+        first = jnp.log(show)
+        second = jnp.log(jnp.maximum(x[:, 1:2], 0.0) + 1.0) - jnp.log(show)
+        return {"Y": jnp.concatenate([first, second, x[:, 2:]], axis=1)}
+    return {"Y": x[:, 2:]}

@@ -169,3 +169,130 @@ def sequence_expand(ins, attrs):
     pos = jnp.arange(total)
     seg = jnp.searchsorted(ref_lod[1:], pos, side="right")
     return {"Out": x[seg]}
+
+
+@register_op("sequence_concat", non_diff_inputs=("Lod",))
+def sequence_concat(ins, attrs):
+    """Concatenate corresponding sequences of N inputs (reference:
+    sequence_ops/sequence_concat_op.cc). Padded form: inputs
+    [B, S_i, ...] concat along the time axis -> [B, sum(S_i), ...];
+    per-input Lod lengths [N, B] give the new lengths."""
+    import jax.numpy as jnp
+
+    xs = ins["X"]
+    out = jnp.concatenate(xs, axis=1)
+    lod = None
+    if ins.get("Lod") and ins["Lod"][0] is not None:
+        lod = jnp.sum(ins["Lod"][0], axis=0)
+    else:
+        lod = jnp.full((xs[0].shape[0],),
+                       sum(x.shape[1] for x in xs), jnp.int32)
+    return {"Out": out, "OutLod": lod}
+
+
+@register_op("sequence_slice", non_diff_inputs=("Offset", "Length"))
+def sequence_slice(ins, attrs):
+    """Per-sequence [offset, offset+length) window (reference:
+    sequence_ops/sequence_slice_op.cc). Padded form: gathers a
+    max(Length)-wide window per row; positions past a row's Length are
+    zeroed."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]                       # [B, S, ...]
+    off = ins["Offset"][0].reshape(-1).astype(jnp.int32)
+    ln = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    b, s = x.shape[0], x.shape[1]
+    width = int(attrs.get("max_length", 0)) or s
+    pos = off[:, None] + jnp.arange(width)[None, :]          # [B, W]
+    valid = jnp.arange(width)[None, :] < ln[:, None]
+    pos = jnp.clip(pos, 0, s - 1)
+    rows = jnp.arange(b)[:, None]
+    out = x[rows, pos]
+    mask = valid.reshape(valid.shape + (1,) * (out.ndim - 2))
+    return {"Out": jnp.where(mask, out, 0), "OutLength": ln}
+
+
+@register_op("sequence_reshape", non_diff_inputs=("Lod",))
+def sequence_reshape(ins, attrs):
+    """Re-chunk flat timesteps to a new feature width (reference:
+    sequence_ops/sequence_reshape_op.cc): [B, S, D] -> [B, S*D/new, new]."""
+    x = ins["X"][0]
+    new_dim = int(attrs["new_dim"])
+    b, s, d = x.shape
+    return {"Out": x.reshape(b, s * d // new_dim, new_dim)}
+
+
+@register_op("sequence_enumerate", non_diff_inputs=("X",))
+def sequence_enumerate(ins, attrs):
+    """Sliding win_size id windows per step (reference:
+    sequence_ops/sequence_enumerate_op.cc): [B, S] ids ->
+    [B, S, win]; positions past the end filled with pad_value."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    win = int(attrs["win_size"])
+    pad = int(attrs.get("pad_value", 0))
+    b, s = x.shape
+    idx = jnp.arange(s)[:, None] + jnp.arange(win)[None, :]   # [S, win]
+    valid = idx < s
+    gathered = x[:, jnp.clip(idx, 0, s - 1)]                  # [B, S, win]
+    return {"Out": jnp.where(valid[None], gathered, pad)}
+
+
+@register_op("sequence_scatter", non_diff_inputs=("Ids",))
+def sequence_scatter(ins, attrs):
+    """Scatter per-sequence updates into X at Ids (reference:
+    sequence_ops/sequence_scatter_op.cc). Padded form: Ids/Updates
+    [B, K], X [B, S]: X[b, Ids[b,k]] += Updates[b,k]."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    ids = ins["Ids"][0].astype(jnp.int32)
+    upd = ins["Updates"][0]
+    rows = jnp.arange(x.shape[0])[:, None]
+    return {"Out": x.at[rows, ids].add(upd)}
+
+
+@register_op("sequence_erase", non_diff_inputs=("X",))
+def sequence_erase(ins, attrs):
+    """Remove listed tokens (reference: sequence_ops/sequence_erase_op.cc).
+    Static-shape form: erased positions compact left, tail zero-padded,
+    new lengths in OutLength."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]                       # [B, S] int ids
+    tokens = jnp.asarray(list(attrs.get("tokens", [])), x.dtype)
+    keep = jnp.all(x[..., None] != tokens[None, None, :], axis=-1)
+    b, s = x.shape
+    # stable left-compaction: target position = cumsum of keeps - 1
+    tgt = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    out = jnp.zeros_like(x)
+    rows = jnp.arange(b)[:, None]
+    tgt_safe = jnp.where(keep, tgt, s - 1)
+    out = out.at[rows, tgt_safe].add(jnp.where(keep, x, 0))
+    return {"Out": out, "OutLength": jnp.sum(keep, axis=1)}
+
+
+@register_op("sequence_conv")
+def sequence_conv(ins, attrs):
+    """1-D sequence convolution (reference:
+    sequence_ops/sequence_conv_op.cc): context window of rows stacked
+    then projected by Filter [win*D, M]."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]                       # [B, S, D]
+    w = ins["Filter"][0]                  # [win*D, M]
+    stride = int(attrs.get("contextStride", 1))
+    start = int(attrs.get("contextStart", 0))
+    win = int(attrs.get("contextLength", w.shape[0] // x.shape[-1]))
+    assert stride == 1, "sequence_conv: only contextStride=1 (reference too)"
+    b, s, d = x.shape
+    cols = []
+    for k in range(win):
+        off = start + k
+        idx = jnp.clip(jnp.arange(s) + off, 0, s - 1)
+        valid = ((jnp.arange(s) + off >= 0)
+                 & (jnp.arange(s) + off < s))[None, :, None]
+        cols.append(jnp.where(valid, x[:, idx], 0))
+    ctx = jnp.concatenate(cols, axis=-1)              # [B, S, win*D]
+    return {"Out": jnp.einsum("bsc,cm->bsm", ctx, w)}

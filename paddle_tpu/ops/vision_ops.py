@@ -1,0 +1,260 @@
+"""Vision / spatial rearrangement ops.
+
+Capability mirror of the reference's spatial ops (pixel_shuffle_op.cc,
+space_to_depth_op.cc, shuffle_channel_op.cc, temporal_shift_op.cc,
+unfold_op.cc, grid_sampler_op.cc, affine_channel_op.cc, lrn_op.cc,
+roi_align_op.cc, unpool_op.cc, max_pool2d_with_index) — NCHW layouts,
+pure-jnp lowerings built from reshape/transpose/gather so XLA fuses them;
+roi_align is a vectorised bilinear gather (the reference's CUDA kernel
+loop becomes one batched interpolation einsum).
+"""
+
+from __future__ import annotations
+
+from ..core.registry import register_op
+
+
+@register_op("pixel_shuffle")
+def pixel_shuffle(ins, attrs):
+    """[N, C*r^2, H, W] -> [N, C, H*r, W*r] (pixel_shuffle_op.cc)."""
+    r = int(attrs.get("upscale_factor", 1))
+    x = ins["X"][0]
+    n, c, h, w = x.shape
+    oc = c // (r * r)
+    y = x.reshape(n, oc, r, r, h, w).transpose(0, 1, 4, 2, 5, 3)
+    return {"Out": y.reshape(n, oc, h * r, w * r)}
+
+
+@register_op("space_to_depth")
+def space_to_depth(ins, attrs):
+    """[N, C, H, W] -> [N, C*b^2, H/b, W/b] (space_to_depth_op.cc)."""
+    b = int(attrs.get("blocksize", 1))
+    x = ins["X"][0]
+    n, c, h, w = x.shape
+    y = x.reshape(n, c, h // b, b, w // b, b).transpose(0, 3, 5, 1, 2, 4)
+    return {"Out": y.reshape(n, c * b * b, h // b, w // b)}
+
+
+@register_op("shuffle_channel")
+def shuffle_channel(ins, attrs):
+    """Channel shuffle by groups (shuffle_channel_op.cc)."""
+    g = int(attrs.get("group", 1))
+    x = ins["X"][0]
+    n, c, h, w = x.shape
+    y = x.reshape(n, g, c // g, h, w).transpose(0, 2, 1, 3, 4)
+    return {"Out": y.reshape(n, c, h, w)}
+
+
+@register_op("temporal_shift")
+def temporal_shift(ins, attrs):
+    """Shift a fraction of channels one step along time
+    (temporal_shift_op.cc): input [N*T, C, H, W]."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    t = int(attrs["seg_num"])
+    ratio = float(attrs.get("shift_ratio", 0.25))
+    nt, c, h, w = x.shape
+    n = nt // t
+    v = x.reshape(n, t, c, h, w)
+    c1 = int(c * ratio)
+    c2 = int(c * 2 * ratio)
+    fwd = jnp.roll(v[:, :, :c1], 1, axis=1).at[:, 0, :].set(0.0)
+    bwd = jnp.roll(v[:, :, c1:c2], -1, axis=1).at[:, -1, :].set(0.0)
+    out = jnp.concatenate([fwd, bwd, v[:, :, c2:]], axis=2)
+    return {"Out": out.reshape(nt, c, h, w)}
+
+
+@register_op("unfold")
+def unfold(ins, attrs):
+    """im2col: [N,C,H,W] -> [N, C*kh*kw, L] (unfold_op.cc)."""
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    kh, kw = [int(v) for v in attrs["kernel_sizes"]]
+    sh, sw = [int(v) for v in attrs.get("strides", [1, 1])]
+    pads = [int(v) for v in attrs.get("paddings", [0, 0, 0, 0])]
+    dh, dw = [int(v) for v in attrs.get("dilations", [1, 1])]
+    if len(pads) == 2:
+        pads = pads * 2
+    n, c, h, w = x.shape
+    patches = lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw),
+        [(pads[0], pads[2]), (pads[1], pads[3])],
+        rhs_dilation=(dh, dw),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))  # [N, C*kh*kw, OH, OW]
+    return {"Y": patches.reshape(n, c * kh * kw, -1)}
+
+
+@register_op("affine_channel")
+def affine_channel(ins, attrs):
+    """Per-channel scale + bias (affine_channel_op.cc)."""
+    x = ins["X"][0]
+    scale, bias = ins["Scale"][0], ins["Bias"][0]
+    layout = attrs.get("data_layout", "NCHW")
+    if layout == "NCHW":
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+    else:
+        shape = (1,) * (x.ndim - 1) + (-1,)
+    return {"Out": x * scale.reshape(shape) + bias.reshape(shape)}
+
+
+@register_op("lrn")
+def lrn(ins, attrs):
+    """Local response normalisation across channels (lrn_op.cc)."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    n = int(attrs.get("n", 5))
+    alpha = float(attrs.get("alpha", 1e-4))
+    beta = float(attrs.get("beta", 0.75))
+    k = float(attrs.get("k", 1.0))
+    sq = jnp.square(x)
+    half = n // 2
+    pads = [(0, 0), (half, n - 1 - half), (0, 0), (0, 0)]
+    sq = jnp.pad(sq, pads)
+    acc = sum(sq[:, i:i + x.shape[1]] for i in range(n))
+    mid = k + alpha * acc
+    return {"Out": x / jnp.power(mid, beta), "MidOut": mid}
+
+
+@register_op("grid_sampler")
+def grid_sampler(ins, attrs):
+    """Bilinear sampling at normalized grid locations
+    (grid_sampler_op.cc, align_corners semantics)."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]          # [N, C, H, W]
+    grid = ins["Grid"][0]    # [N, Hg, Wg, 2] in [-1, 1]
+    n, c, h, w = x.shape
+    gx = (grid[..., 0] + 1.0) * (w - 1) / 2.0
+    gy = (grid[..., 1] + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(gx); y0 = jnp.floor(gy)
+    wx = gx - x0; wy = gy - y0
+
+    def gather(yi, xi):
+        yi = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        xi = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        batch = jnp.arange(n)[:, None, None]
+        return x[batch, :, yi, xi]          # [N, Hg, Wg, C]
+
+    v00 = gather(y0, x0); v01 = gather(y0, x0 + 1)
+    v10 = gather(y0 + 1, x0); v11 = gather(y0 + 1, x0 + 1)
+    wx = wx[..., None]; wy = wy[..., None]
+    out = (v00 * (1 - wx) * (1 - wy) + v01 * wx * (1 - wy)
+           + v10 * (1 - wx) * wy + v11 * wx * wy)
+    return {"Output": jnp.transpose(out, (0, 3, 1, 2))}
+
+
+@register_op("roi_align", non_diff_inputs=("ROIs", "RoisNum"))
+def roi_align(ins, attrs):
+    """Average of bilinear samples over ROI bins (roi_align_op.cc).
+    ROIs [R, 4] (x1, y1, x2, y2) in input scale; all ROIs index batch 0
+    unless RoisNum/LoD assigns them (single-image form here)."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]                  # [N, C, H, W]
+    rois = ins["ROIs"][0]            # [R, 4]
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    ratio = int(attrs.get("sampling_ratio", -1))
+    ratio = ratio if ratio > 0 else 2
+    n, c, h, w = x.shape
+
+    x1 = rois[:, 0] * scale
+    y1 = rois[:, 1] * scale
+    x2 = rois[:, 2] * scale
+    y2 = rois[:, 3] * scale
+    rw = jnp.maximum(x2 - x1, 1.0)
+    rh = jnp.maximum(y2 - y1, 1.0)
+    bin_w = rw / pw
+    bin_h = rh / ph
+
+    iy = (jnp.arange(ratio) + 0.5) / ratio                   # [S]
+    gy = (y1[:, None, None] + (jnp.arange(ph)[None, :, None]
+          + iy[None, None, :]) * bin_h[:, None, None])       # [R, ph, S]
+    gx = (x1[:, None, None] + (jnp.arange(pw)[None, :, None]
+          + iy[None, None, :]) * bin_w[:, None, None])       # [R, pw, S]
+
+    def bilinear(yy, xx):
+        """[R, ph*S], [R, pw*S] -> [R, C, ph*S, pw*S]."""
+        y0 = jnp.clip(jnp.floor(yy), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xx), 0, w - 1)
+        y1i = jnp.clip(y0 + 1, 0, h - 1).astype(jnp.int32)
+        x1i = jnp.clip(x0 + 1, 0, w - 1).astype(jnp.int32)
+        y0i, x0i = y0.astype(jnp.int32), x0.astype(jnp.int32)
+        wy = (yy - y0)[:, None, :, None]
+        wx = (xx - x0)[:, None, None, :]
+        img = x[0]                                           # [C, H, W]
+
+        # gather per (R, S) pair: advanced indexing on flattened HW
+        def take(yi, xi):
+            flat = img.reshape(c, h * w)                     # [C, HW]
+            idx = yi[:, :, None] * w + xi[:, None, :]        # [R, Sy, Sx]
+            return flat[:, idx].transpose(1, 0, 2, 3)        # [R, C, Sy, Sx]
+        v00 = take(y0i, x0i); v01 = take(y0i, x1i)
+        v10 = take(y1i, x0i); v11 = take(y1i, x1i)
+        return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+                + v10 * wy * (1 - wx) + v11 * wy * wx)
+
+    yy = gy.reshape(gy.shape[0], -1)                         # [R, ph*S]
+    xx = gx.reshape(gx.shape[0], -1)                         # [R, pw*S]
+    vals = bilinear(yy, xx)                                  # [R,C,phS,pwS]
+    vals = vals.reshape(vals.shape[0], c, ph, ratio, pw, ratio)
+    return {"Out": vals.mean(axis=(3, 5))}
+
+
+@register_op("max_pool2d_with_index")
+def max_pool2d_with_index(ins, attrs):
+    """Max pool returning flat spatial argmax indices
+    (operators/pool_with_index_op.cc)."""
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    ks = [int(v) for v in attrs["ksize"]]
+    st = [int(v) for v in attrs.get("strides", ks)]
+    pd = [int(v) for v in attrs.get("paddings", [0, 0])]
+    n, c, h, w = x.shape
+    flat_idx = jnp.broadcast_to(
+        (jnp.arange(h)[:, None] * w + jnp.arange(w)[None, :])
+        .astype(jnp.float32), x.shape)
+    neg = jnp.finfo(x.dtype).min
+
+    def reducer(a, b):
+        av, ai = a
+        bv, bi = b
+        pick = bv > av
+        return jnp.where(pick, bv, av), jnp.where(pick, bi, ai)
+
+    out, idx = lax.reduce_window(
+        (x, flat_idx), (neg, jnp.float32(-1.0)), reducer,
+        (1, 1, ks[0], ks[1]), (1, 1, st[0], st[1]),
+        [(0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])])
+    return {"Out": out, "Mask": idx.astype(jnp.int32)}
+
+
+@register_op("unpool", non_diff_inputs=("Indices",))
+def unpool(ins, attrs):
+    """Scatter pooled values back to their argmax positions
+    (operators/unpool_op.cc)."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]                      # [N, C, h, w]
+    idx = ins["Indices"][0]              # [N, C, h, w] flat HW indices
+    oh, ow = [int(v) for v in attrs["unpooled_size"]] \
+        if attrs.get("unpooled_size") else (None, None)
+    if oh is None:
+        ks = [int(v) for v in attrs["ksize"]]
+        st = [int(v) for v in attrs.get("strides", ks)]
+        oh = x.shape[2] * st[0]
+        ow = x.shape[3] * st[1]
+    n, c = x.shape[:2]
+    flat = jnp.zeros((n, c, oh * ow), x.dtype)
+    ii = idx.reshape(n, c, -1).astype(jnp.int32)
+    vv = x.reshape(n, c, -1)
+    flat = flat.at[jnp.arange(n)[:, None, None],
+                   jnp.arange(c)[None, :, None], ii].set(vv)
+    return {"Out": flat.reshape(n, c, oh, ow)}

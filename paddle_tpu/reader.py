@@ -24,7 +24,7 @@ import itertools
 import queue
 import random as _random
 import threading
-from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Sequence
 
 import numpy as np
 
@@ -467,6 +467,7 @@ class DataLoader:
                  batch_size: int = 1, shuffle: bool = False,
                  drop_last: bool = False, collate_fn: Optional[Callable] = None,
                  num_workers: int = 0, use_buffer_reader: bool = True,
+                 use_shared_memory: bool = True,
                  prefetch_factor: int = 2, timeout: float = 0,
                  worker_init_fn=None):
         self.dataset = dataset
@@ -474,6 +475,10 @@ class DataLoader:
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = int(num_workers)
+        # True -> PROCESS workers + shared-memory result transport
+        # (reference: reader.py:147 multiprocess DataLoader with
+        # memory/allocation/mmap_allocator); False -> thread pool
+        self.use_shared_memory = bool(use_shared_memory)
         self.prefetch_factor = prefetch_factor
         self._iterable_dataset = isinstance(dataset, IterableDataset)
         if self._iterable_dataset:
@@ -514,6 +519,115 @@ class DataLoader:
         arrays = collated if isinstance(collated, (tuple, list)) else (collated,)
         return dict(zip(names, arrays))
 
+    def _iter_process_workers(self):
+        """Fork-based worker processes with shared-memory batch transport
+        (reference: dataloader/dataloader_iter.py _DataLoaderIterMultiProcess
+        + memory/allocation/mmap_allocator.cc): each worker pulls index
+        lists from a task queue, collates, copies every array of the
+        batch into a multiprocessing.shared_memory block and ships only
+        (name, dtype, shape) descriptors — Python-heavy preprocessing
+        scales past the GIL, and large batches cross processes without
+        being pickled through a pipe. In-order delivery via batch ids."""
+        import multiprocessing as mp
+        from multiprocessing import shared_memory
+
+        ctx = mp.get_context("fork")
+        # bounded task queue = backpressure: at most
+        # num_workers * prefetch_factor batches in flight, so /dev/shm
+        # holds a bounded working set, not the whole epoch
+        depth = max(1, self.num_workers * int(self.prefetch_factor))
+        task_q = ctx.Queue(maxsize=depth)
+        result_q = ctx.Queue()
+        batches = list(self.batch_sampler)
+        nw = self.num_workers
+
+        dataset, collate = self.dataset, self.collate_fn
+
+        def worker():
+            while True:
+                job = task_q.get()
+                if job is None:
+                    return
+                bid, indices = job
+                try:
+                    collated = collate([dataset[i] for i in indices])
+                    arrays = collated if isinstance(collated, (tuple, list)) \
+                        else (collated,)
+                    descs = []
+                    for a in arrays:
+                        a = np.ascontiguousarray(a)
+                        shm = shared_memory.SharedMemory(
+                            create=True, size=max(a.nbytes, 1))
+                        np.ndarray(a.shape, a.dtype,
+                                   buffer=shm.buf)[...] = a
+                        descs.append((shm.name, str(a.dtype), a.shape))
+                        shm.close()
+                    result_q.put((bid, descs, None))
+                except Exception as e:        # surface, don't hang
+                    result_q.put((bid, None, repr(e)))
+
+        procs = [ctx.Process(target=worker, daemon=True)
+                 for _ in range(nw)]
+        for p in procs:
+            p.start()
+
+        def feed():
+            for bid, indices in enumerate(batches):
+                task_q.put((bid, indices))      # blocks at depth
+            for _ in range(nw):
+                task_q.put(None)
+
+        feeder = threading.Thread(target=feed, daemon=True)
+        feeder.start()
+        pending: Dict[int, Any] = {}
+
+        def unlink_descs(descs):
+            for name, _, _ in descs or ():
+                try:
+                    shm = shared_memory.SharedMemory(name=name)
+                    shm.close()
+                    shm.unlink()
+                except FileNotFoundError:
+                    pass
+
+        try:
+            next_bid = 0
+            received = 0
+            while received < len(batches):
+                bid, descs, err = result_q.get()
+                received += 1
+                if err is not None:
+                    raise RuntimeError(
+                        f"DataLoader worker failed on batch {bid}: {err}")
+                pending[bid] = descs
+                while next_bid in pending:
+                    arrays = []
+                    for name, dtype, shape in pending.pop(next_bid):
+                        shm = shared_memory.SharedMemory(name=name)
+                        arrays.append(np.array(np.ndarray(
+                            shape, dtype, buffer=shm.buf)))
+                        shm.close()
+                        shm.unlink()
+                    collated = tuple(arrays) if len(arrays) != 1 \
+                        else arrays[0]
+                    yield self._emit(collated)
+                    next_bid += 1
+        finally:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+            for p in procs:
+                p.join(timeout=5)
+            # reclaim shm of batches never consumed (error / early close)
+            for descs in pending.values():
+                unlink_descs(descs)
+            try:
+                while True:
+                    _, descs, _ = result_q.get_nowait()
+                    unlink_descs(descs)
+            except queue.Empty:
+                pass
+
     def __iter__(self):
         if self._iterable_dataset:
             def gen():
@@ -533,6 +647,10 @@ class DataLoader:
         if self.num_workers <= 0:
             for indices in self.batch_sampler:
                 yield self._emit(self._fetch(indices))
+            return
+
+        if self.use_shared_memory:
+            yield from self._iter_process_workers()
             return
 
         # threaded workers with in-order delivery

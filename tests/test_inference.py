@@ -107,6 +107,41 @@ class TestPasses:
                        scope=scope)
         np.testing.assert_allclose(got, want, atol=2e-5)
 
+    def test_conv_bn_fuse(self, scope):
+        """conv2d + batch_norm(is_test) folds into conv + bias add
+        (reference: ir/conv_bn_fuse_pass.cc); outputs must match the
+        unfused program on the same weights."""
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+        from paddle_tpu.core.passes import apply_passes
+
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [3, 8, 8])
+            h = layers.conv2d(x, 6, 3, padding=1, bias_attr=False)
+            y = layers.batch_norm(h, is_test=True)
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        # non-trivial running stats so the fold actually does arithmetic
+        import numpy as _np
+
+        for name, v in list(scope.items()):
+            arr = _np.asarray(v)
+            if "mean" in name:
+                scope.set(name, _np.linspace(-0.5, 0.5,
+                                             arr.size).astype(arr.dtype))
+            if "var" in name.lower() or "variance" in name:
+                scope.set(name, _np.linspace(0.5, 2.0,
+                                             arr.size).astype(arr.dtype))
+        xv = np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32)
+        want, = exe.run(main, feed={"x": xv}, fetch_list=[y], scope=scope)
+        apply_passes(main, ["conv_bn_fuse_pass"], scope=scope)
+        types = [o.type for o in main.global_block().ops]
+        assert "batch_norm" not in types
+        assert types.count("conv2d") == 1
+        got, = exe.run(main, feed={"x": xv}, fetch_list=[y], scope=scope)
+        np.testing.assert_allclose(got, want, atol=2e-5)
+
     def test_fc_fuse_simple(self, scope):
         import paddle_tpu as pt
         from paddle_tpu import layers

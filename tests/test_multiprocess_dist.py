@@ -69,3 +69,107 @@ def test_two_process_mesh(tmp_path):
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
         assert f"RANK {rank} OK" in out
+
+
+_DP_WORKER = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+os.environ["PADDLE_COORDINATOR_ADDR"] = "127.0.0.1:%PORT%"
+from paddle_tpu.distributed.parallel import (get_rank, get_world_size,
+                                             init_parallel_env)
+init_parallel_env()   # returns False in the 1-process control run
+import numpy as np
+from paddle_tpu import dygraph, nn
+from paddle_tpu.dygraph import DataParallel, to_variable
+from paddle_tpu.optimizer import SGDOptimizer
+from paddle_tpu.initializer import Xavier, Constant
+from paddle_tpu import ParamAttr
+import paddle_tpu.nn.functional as F
+
+rank, world = get_rank(), get_world_size()
+rng = np.random.RandomState(0)
+X = rng.rand(16, 8).astype(np.float32)
+Y = rng.randint(0, 4, (16, 1)).astype(np.int64)
+half = 16 // world
+xs = X[rank * half:(rank + 1) * half]
+ys = Y[rank * half:(rank + 1) * half]
+
+with dygraph.guard():
+    m = nn.Linear(8, 4,
+                  weight_attr=ParamAttr(initializer=Xavier(seed=11)),
+                  bias_attr=ParamAttr(initializer=Constant(0.0)))
+    dp = DataParallel(m)
+    opt = SGDOptimizer(0.5, parameter_list=m.parameters())
+    for step in range(3):
+        loss = F.cross_entropy(dp(to_variable(xs)), to_variable(ys))
+        loss = dp.scale_loss(loss)
+        loss.backward()
+        dp.apply_collective_grads()
+        opt.minimize(loss)
+        m.clear_gradients()
+    w = m.parameters()[0].numpy()
+    print("WSUM", rank, float(np.abs(w).sum()))
+print("RANK", rank, "OK")
+"""
+
+
+@pytest.mark.skipif(os.environ.get("PT_SKIP_MULTIPROC") == "1",
+                    reason="multiproc disabled")
+def test_dygraph_data_parallel_matches_single_process(tmp_path):
+    """reference: parallel_dygraph_mnist.py via TestParallelDyGraphRunnerBase
+    — 2-process DataParallel must land on the same weights as the
+    single-process full-batch run (scale_loss + summed grads == full mean)."""
+    import re
+    import socket
+
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.pop("PYTEST_CURRENT_TEST", None)
+        env["PADDLE_TRAINER_ID"] = str(rank)
+        env["PADDLE_TRAINERS_NUM"] = "2"
+        script = _DP_WORKER.replace("%PORT%", str(port))
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", script], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append(out)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
+        assert f"RANK {rank} OK" in out
+    wsums = [float(re.search(r"WSUM \d ([\d.eE+-]+)", o).group(1))
+             for o in outs]
+    # both ranks hold identical weights after collective training
+    assert abs(wsums[0] - wsums[1]) < 1e-5
+
+    # single-process full-batch run for parity
+    env = dict(os.environ)
+    env.pop("PYTEST_CURRENT_TEST", None)
+    env["PADDLE_TRAINER_ID"] = "0"
+    env["PADDLE_TRAINERS_NUM"] = "1"
+    sock = socket.socket(); sock.bind(("127.0.0.1", 0))
+    port1 = sock.getsockname()[1]; sock.close()
+    script = _DP_WORKER.replace("%PORT%", str(port1))
+    single = subprocess.run([sys.executable, "-c", script], env=env,
+                            capture_output=True, text=True, timeout=240,
+                            cwd=os.path.dirname(os.path.dirname(
+                                os.path.abspath(__file__))))
+    assert single.returncode == 0, single.stdout[-2000:]
+    wsum1 = float(re.search(r"WSUM \d ([\d.eE+-]+)",
+                            single.stdout).group(1))
+    assert abs(wsums[0] - wsum1) < 1e-4, (wsums, wsum1)

@@ -50,10 +50,21 @@ def transformer_step_flops(cfg, batch, seq, lm_positions=None) -> float:
 
 
 def _time_steps(exe, prog, feed, loss_v, scope, *, steps, windows=3,
-                warmup=3):
-    """ms/step: fetch-free windows closed by a single loss fetch."""
+                warmup=2):
+    """ms/step: fetch-free windows closed by a single loss fetch.
+
+    Feeds are pre-transferred to the device ONCE — the axon tunnel moves
+    host data at ~10 MB/s, so re-feeding numpy every step measures the
+    tunnel, not the chip (real input pipelines overlap transfers).
+    Both cache entries (with and without the loss fetch) are warmed so
+    no compile lands inside a timed window.
+    """
+    import jax.numpy as jnp
+
+    feed = {k: jnp.asarray(v) for k, v in feed.items()}
     for _ in range(warmup):
         exe.run(prog, feed=feed, fetch_list=[loss_v], scope=scope)
+        exe.run(prog, feed=feed, fetch_list=[], scope=scope)
     best = float("inf")
     loss = None
     for _ in range(windows):
@@ -146,10 +157,79 @@ def bench_resnet50(steps=20, batch=None, amp=True):
     }
 
 
+def bench_mnist(steps=30, batch=None):
+    """Ladder config 1: LeNet MNIST smoke (reference fixture:
+    tests/book/test_recognize_digits.py). Tiny model — throughput is
+    dispatch-bound; reported for ladder completeness."""
+    import paddle_tpu as pt
+    from paddle_tpu.models import lenet
+
+    batch = batch or 512
+    main_prog, startup, feeds, fetches = lenet.build_lenet_program(
+        batch_size=batch)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    exe.run(startup, scope=scope, use_compiled=False)
+    rng = np.random.RandomState(0)
+    data = {"img": rng.randn(batch, 1, 28, 28).astype(np.float32),
+            "label": rng.randint(0, 10, (batch, 1)).astype(np.int64)}
+    ms, loss = _time_steps(exe, main_prog, data, fetches["loss"], scope,
+                           steps=steps)
+    dt = ms / 1e3
+    flops = 3 * 2.3e6 * batch  # ~2.3 MFLOPs/img fwd
+    mfu = flops / dt / peak_flops_per_chip()
+    return {
+        "metric": "mnist_lenet_images_per_sec_per_chip",
+        "value": round(batch / dt, 1),
+        "unit": "imgs/s",
+        "vs_baseline": round(mfu / 0.35, 4),
+        "extra": {"ms_per_step": round(ms, 2), "batch": batch,
+                  "loss": round(loss, 4)},
+    }
+
+
+def bench_transformer_big(steps=15, batch=None, seq=256):
+    """Ladder config 5: Transformer-big WMT14 En-De (reference
+    dist_transformer.py fixture geometry), bf16 via static AMP."""
+    import paddle_tpu as pt
+    from paddle_tpu.models import transformer
+
+    batch = batch or int(os.environ.get("PT_BENCH_BATCH", "48"))
+    cfg = transformer.transformer_big()
+    main_prog, startup, feeds, fetches = transformer.build_wmt_program(
+        cfg, seq_len=seq, amp=True)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    exe.run(startup, scope=scope, use_compiled=False)
+    data = transformer.synthetic_batch(cfg, batch, seq)
+    ms, loss = _time_steps(exe, main_prog, data, fetches["loss"], scope,
+                           steps=steps)
+    dt = ms / 1e3
+    h, ff, v = cfg.d_model, cfg.d_inner, cfg.tgt_vocab_size
+    l_enc, l_dec = cfg.n_encoder_layers, cfg.n_decoder_layers
+    tokens = batch * seq
+    # enc: qkv/out + ffn; dec adds cross-attention projections
+    enc = l_enc * (4 * h * h + 2 * h * ff)
+    dec = l_dec * (8 * h * h + 2 * h * ff)
+    matmul = 6.0 * (enc + dec) * tokens + 6.0 * h * v * tokens
+    attn = 6.0 * 2 * (l_enc + 3 * l_dec) * batch * seq * seq * h
+    mfu = (matmul + attn) / dt / peak_flops_per_chip()
+    return {
+        "metric": "transformer_big_wmt_tokens_per_sec_per_chip",
+        "value": round(tokens / dt, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.35, 4),
+        "extra": {"ms_per_step": round(ms, 2), "mfu": round(mfu, 4),
+                  "batch": batch, "seq_len": seq, "loss": round(loss, 4)},
+    }
+
+
 WORKLOADS = {
+    "mnist": bench_mnist,
     "ernie_large": bench_ernie_large,
     "bert_base": bench_bert_base,
     "resnet50": bench_resnet50,
+    "transformer_big": bench_transformer_big,
 }
 
 
