@@ -241,6 +241,109 @@ def conv_bn_fuse_pass(program: Program, scope=None) -> Program:
     return program
 
 
+@register_pass("embedding_eltwise_layernorm_fuse_pass")
+def embedding_eltwise_layernorm_fuse_pass(program: Program) -> Program:
+    """N x lookup_table(_v2) summed then layer_norm'd (the BERT embedding
+    stack) -> ONE fused_embedding_eltwise_layernorm op (reference:
+    ir/embedding_eltwise_layernorm_fuse_pass.cc driving
+    fused/fused_embedding_eltwise_layernorm_op.cu)."""
+    block = program.global_block()
+    consumers = _single_consumer_map(block.ops)
+    producer = _producer_map(block.ops)
+    dead = set()
+    new_ops: List[OpDesc] = []
+
+    def as_lookup(name):
+        op = producer.get(name)
+        if op is not None and op.type in ("lookup_table",
+                                          "lookup_table_v2") and \
+                len(consumers.get(name, [])) == 1:
+            return op
+        return None
+
+    for op in block.ops:
+        if id(op) in dead:
+            continue
+        # anchor on layer_norm; walk the add tree beneath it
+        if op.type == "layer_norm" and \
+                int(op.attrs.get("begin_norm_axis", 1)) == 2:
+            chain = []
+            ids, embs = [], []
+
+            def collect(name):
+                lk = as_lookup(name)
+                if lk is not None:
+                    ids.append(lk.inputs["Ids"][0])
+                    embs.append(lk.inputs["W"][0])
+                    chain.append(lk)
+                    return True
+                add = producer.get(name)
+                if add is not None and add.type == "elementwise_add" and \
+                        len(consumers.get(name, [])) == 1:
+                    if collect(_in(add, "X")) and collect(_in(add, "Y")):
+                        chain.append(add)
+                        return True
+                return False
+
+            has_affine = bool(op.inputs.get("Scale")) and \
+                bool(op.inputs.get("Bias"))
+            if has_affine and collect(_in(op, "X")) and len(ids) >= 2:
+                # scale=False/shift=False layer_norms are left unfused —
+                # the fused lowering requires the affine pair
+                new_ops.append(OpDesc(
+                    "fused_embedding_eltwise_layernorm",
+                    {"Ids": list(ids), "Embs": list(embs),
+                     "Scale": op.inputs["Scale"],
+                     "Bias": op.inputs["Bias"]},
+                    {"Out": [_out(op, "Y")]},
+                    {"epsilon": op.attrs.get("epsilon", 1e-5)}))
+                dead.update(id(o) for o in chain)
+                continue
+        new_ops.append(op)
+    block.ops = [o for o in new_ops if id(o) not in dead]
+    program._bump_version()
+    return program
+
+
+@register_pass("fuse_elewise_add_act_pass")
+def fuse_elewise_add_act_pass(program: Program) -> Program:
+    """elementwise_add -> relu/gelu/tanh/sigmoid becomes one
+    fused_elemwise_activation op (reference: ir/fuse_elewise_add_act_pass.cc
+    — there it picks a fused CUDA kernel; here the compound op keeps the
+    graph smaller and XLA fuses the arithmetic either way)."""
+    block = program.global_block()
+    consumers = _single_consumer_map(block.ops)
+    dead = set()
+    new_ops: List[OpDesc] = []
+    acts = ("relu", "gelu", "tanh", "sigmoid")
+    for op in block.ops:
+        if id(op) in dead:
+            continue
+        if op.type == "elementwise_add" and \
+                int(op.attrs.get("axis", -1)) == -1:
+            out = _out(op, "Out")
+            cons = consumers.get(out, [])
+            if len(cons) == 1 and cons[0].type in acts:
+                act = cons[0]
+                # carry the act op's attrs so e.g. gelu(approximate=...)
+                # keeps its exact numerics through the fuse
+                fattrs = dict(act.attrs)
+                fattrs.pop("op_role", None)
+                fattrs["functor_list"] = ["elementwise_add", act.type]
+                new_ops.append(OpDesc(
+                    "fused_elemwise_activation",
+                    {"X": op.inputs["X"], "Y": op.inputs["Y"]},
+                    {"Out": [_out(act, "Out")],
+                     "IntermediateOut": [out]},
+                    fattrs))
+                dead.add(id(act))
+                continue
+        new_ops.append(op)
+    block.ops = new_ops
+    program._bump_version()
+    return program
+
+
 @register_pass("multihead_attention_fuse_pass")
 def multihead_attention_fuse_pass(program: Program) -> Program:
     """matmul(QK^T, alpha) [+ bias] → softmax [→ dropout] → matmul(·V)

@@ -1,0 +1,81 @@
+"""Elastic training: checkpoint-restart failure recovery.
+
+Capability mirror of the reference's failure-detection story (SURVEY.md
+§5): the reference has a pserver-side HeartBeatMonitor
+(operators/distributed/heart_beat_monitor.h:51) and a placeholder
+`DistributedStrategy.elastic` flag but NO in-tree trainer recovery —
+"checkpoint-restart based recovery is the realistic TPU equivalent".
+This module provides that equivalent: a supervised step loop that
+checkpoints periodically and, when a step raises a recoverable error,
+restores the newest checkpoint and resumes, up to max_restarts.
+
+    runner = ElasticRunner(ckpt_dir, program, scope,
+                           save_interval_steps=10)
+    runner.run(step_fn, num_steps)   # step_fn(step) -> loss
+
+On a multi-host job the same script re-launched by the cluster manager
+lands in restore_latest() and continues — the reference's
+checkpoint_notify flow without the pserver middleman.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional, Tuple
+
+_LOG = logging.getLogger("paddle_tpu.elastic")
+
+# error types worth a restart (device resets, transient RPC failures);
+# programming errors (TypeError, ValueError, ...) re-raise immediately
+RECOVERABLE = (RuntimeError, ConnectionError, OSError, TimeoutError)
+
+
+class ElasticRunner:
+    def __init__(self, ckpt_dir: str, program=None, scope=None,
+                 save_interval_steps: int = 10, max_to_keep: int = 3,
+                 max_restarts: int = 3,
+                 recoverable: Tuple[type, ...] = RECOVERABLE):
+        from ..checkpoint import CheckpointManager
+
+        self.program = program
+        self.scope = scope
+        self.max_restarts = int(max_restarts)
+        self.recoverable = tuple(recoverable)
+        self.save_interval = int(save_interval_steps)
+        self.mgr = CheckpointManager(ckpt_dir, max_to_keep=max_to_keep,
+                                     save_interval_steps=save_interval_steps)
+        self.restarts = 0
+
+    def run(self, step_fn: Callable[[int], object], num_steps: int,
+            on_restart: Optional[Callable[[int, BaseException], None]] = None):
+        """Run step_fn(step) for num_steps with failure recovery.
+
+        Returns the last step_fn result. Restores from the newest
+        checkpoint on a recoverable exception; re-raises after
+        max_restarts (or immediately for non-recoverable types)."""
+        step = self.mgr.restore_latest(self.program, self.scope)
+        if step:
+            _LOG.info("elastic: resumed from checkpoint step %d", step)
+        result = None
+        while step < num_steps:
+            try:
+                result = step_fn(step)
+            except self.recoverable as e:
+                self.restarts += 1
+                if self.restarts > self.max_restarts:
+                    _LOG.error("elastic: step %d failed after %d restarts",
+                               step, self.max_restarts)
+                    raise
+                restored = self.mgr.restore_latest(self.program, self.scope)
+                _LOG.warning(
+                    "elastic: step %d raised %r — restart %d/%d from "
+                    "checkpoint step %d", step, e, self.restarts,
+                    self.max_restarts, restored)
+                if on_restart is not None:
+                    on_restart(step, e)
+                step = restored
+                continue
+            step += 1
+            self.mgr.save(step, self.program, self.scope)
+        self.mgr.wait_until_finished()
+        return result

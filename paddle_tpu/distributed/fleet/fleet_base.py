@@ -95,7 +95,20 @@ class DistributedOptimizer:
             inner = GradientMergeOptimizer(
                 inner, strategy.gradient_merge_configs.get("k_steps", 1),
                 strategy.gradient_merge_configs.get("avg", True))
+        if strategy.dgc:
+            from .meta_optimizers import DGCOptimizer
+
+            inner = DGCOptimizer(inner, strategy.dgc_configs,
+                                 nranks=fleet_obj.worker_num())
+        if strategy.localsgd:
+            from .meta_optimizers import LocalSGDOptimizer
+
+            inner = LocalSGDOptimizer(inner, strategy.localsgd_configs,
+                                      nranks=fleet_obj.worker_num())
         self.inner = inner
+        # localsgd replaces grad allreduce with periodic param averaging;
+        # dgc carries its own (compressed-grad) allreduce
+        self._skip_grad_allreduce = bool(strategy.localsgd or strategy.dgc)
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
@@ -103,8 +116,9 @@ class DistributedOptimizer:
             loss, startup_program=startup_program,
             parameter_list=parameter_list, no_grad_set=no_grad_set)
         # DP allreduce before the update ops (graph_execution equivalent)
-        insert_grad_allreduce(loss.block.program, params_grads,
-                              self.fleet.worker_num())
+        if not self._skip_grad_allreduce:
+            insert_grad_allreduce(loss.block.program, params_grads,
+                                  self.fleet.worker_num())
         ops = self.inner.apply_gradients(params_grads)
         return ops, params_grads
 

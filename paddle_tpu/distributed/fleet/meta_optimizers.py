@@ -375,22 +375,107 @@ def maybe_swap_large_batch_optimizer(inner, strategy):
 
 
 class LocalSGDOptimizer:
-    """Stub with documented mapping (reference: localsgd_optimizer.py): on
-    TPU, k local steps + periodic psum of params. Not on the north-star
-    path; raises with guidance if enabled."""
+    """reference: fleet/meta_optimizers/localsgd_optimizer.py +
+    transpiler/collective.py:270 — each rank takes k LOCAL optimizer
+    steps (grads are NOT allreduced), then params are averaged across
+    the dp ring every k-th step via the local_sgd_sync op."""
 
-    def __init__(self, inner, configs):
-        raise NotImplementedError(
-            "localsgd: run k steps with mesh-local params then "
-            "paddle_tpu.distributed.all_reduce the params; planned")
+    def __init__(self, inner, configs: Optional[dict] = None,
+                 nranks: int = 1, axis_name="dp"):
+        self.inner = inner
+        self.k_steps = int((configs or {}).get("k_steps", 1))
+        self.nranks = int(nranks)
+        self.axis_name = axis_name
+
+    def backward(self, loss, **kw):
+        return self.inner.backward(loss, **kw)
+
+    def apply_gradients(self, params_grads):
+        ops = self.inner.apply_gradients(params_grads)
+        block = default_main_program().current_block()
+        for p, _ in params_grads:
+            block.append_op(
+                "local_sgd_sync", {"X": [p]}, {"Out": [p]},
+                {"axis_name": self.axis_name, "nranks": self.nranks,
+                 "k_steps": self.k_steps})
+        return ops
+
+    def minimize(self, loss, **kw):
+        pg = self.backward(loss, **kw)
+        return self.apply_gradients(pg), pg
+
+    def __getattr__(self, item):
+        return getattr(self.inner, item)
 
 
 class DGCOptimizer:
-    """Stub (reference: dgc_optimizer.py, operators/dgc_op.cc): top-k grad
-    sparsification makes dense ICI allreduce slower on TPU, not faster —
-    intentionally unsupported; dense allreduce is the recommended path."""
+    """reference: fleet/meta_optimizers/dgc_optimizer.py +
+    operators/dgc_op.cc (DGCMomentumOptimizer optimizer.py:1185): deep
+    gradient compression — momentum-corrected top-k sparsification of
+    each grad BEFORE the allreduce; the carry buffers (U momentum, V
+    residual) keep the unsent mass. The dgc op ITSELF performs the
+    momentum correction, so the parameter update applies the released
+    gradient with plain SGD (the reference's dgc_momentum_op.h switches
+    momentum -> sgd once DGC is active past rampup; applying the inner
+    momentum again would square the steady-state multiplier). On ICI
+    the sparse exchange buys nothing (round-1 note) but the compression
+    math and convergence behaviour are reproduced — the capability."""
 
-    def __init__(self, inner, configs):
-        raise NotImplementedError(
-            "DGC is a bandwidth workaround for commodity NICs; ICI allreduce "
-            "does not need it. Use plain data parallelism.")
+    def __init__(self, inner, configs: Optional[dict] = None,
+                 nranks: int = 1, axis_name="dp"):
+        self.inner = inner
+        cfgs = configs or {}
+        self.ratio = float(cfgs.get("sparsity", [0.01])[0]
+                           if isinstance(cfgs.get("sparsity"), list)
+                           else cfgs.get("sparsity", 0.01))
+        self.momentum = float(cfgs.get("momentum", 0.9))
+        self.nranks = int(nranks)
+        self.axis_name = axis_name
+
+    def backward(self, loss, **kw):
+        return self.inner.backward(loss, **kw)
+
+    def apply_gradients(self, params_grads):
+        block = default_main_program().current_block()
+        with block.program._role_guard(OpRole.Backward):
+            for p, g in params_grads:
+                u = L.create_global_var(list(p.shape), 0.0, "float32",
+                                        persistable=True,
+                                        name=unique_name.generate(
+                                            p.name + "_dgc_u"))
+                v = L.create_global_var(list(p.shape), 0.0, "float32",
+                                        persistable=True,
+                                        name=unique_name.generate(
+                                            p.name + "_dgc_v"))
+                block.append_op(
+                    "dgc",
+                    {"U": [u], "V": [v], "Grad": [g], "Param": [p]},
+                    {"U_out": [u], "V_out": [v], "EncodeGrad": [g],
+                     "Grad_out": [g], "GatherBuff": [g]},
+                    {"m": self.momentum, "ratios": self.ratio})
+                if self.nranks > 1:
+                    block.append_op(
+                        "c_allreduce_sum", {"X": [g]}, {"Out": [g]},
+                        {"axis_name": self.axis_name,
+                         "nranks": self.nranks})
+                    block.append_op(
+                        "scale", {"X": [g]}, {"Out": [g]},
+                        {"scale": 1.0 / self.nranks})
+        # SGD update with the inner optimizer's learning rate: the dgc
+        # op already applied the momentum correction
+        with block.program._role_guard(OpRole.Optimize):
+            self.inner._create_global_learning_rate()
+            for p, g in params_grads:
+                block.append_op(
+                    "sgd",
+                    {"Param": [p], "Grad": [g],
+                     "LearningRate": [self.inner._lr_var]},
+                    {"ParamOut": [p]}, {})
+        return []
+
+    def minimize(self, loss, **kw):
+        pg = self.backward(loss, **kw)
+        return self.apply_gradients(pg), pg
+
+    def __getattr__(self, item):
+        return getattr(self.inner, item)

@@ -5,5 +5,5 @@ and a pserver process that runs optimizer ops through the framework's
 own interpreting executor."""
 
 from .pserver import PServer  # noqa: F401
-from .rpc import RPCClient, RPCServer  # noqa: F401
+from .rpc import RPCClient, RPCServer, start_heartbeat  # noqa: F401
 from .transpiler import DistributeTranspiler  # noqa: F401

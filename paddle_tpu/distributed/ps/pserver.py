@@ -37,6 +37,66 @@ class ParamState:
         self.cond = threading.Condition()
 
 
+class HeartBeatMonitor:
+    """Worker-liveness watchdog (reference:
+    operators/distributed/heart_beat_monitor.h:51 — the pserver-side
+    monitor that watches trainer pings and flags silent workers).
+    Trainers ping implicitly with every send_grad/recv_param (and
+    explicitly via the 'heartbeat' RPC); a background thread marks a
+    trainer dead after `timeout` seconds of silence and invokes
+    `on_dead` (default: log). The PS protocol survives a dead trainer in
+    async mode; in sync mode the monitor is what tells the operator WHY
+    a barrier stalled."""
+
+    def __init__(self, num_trainers: int, timeout: float = 60.0,
+                 interval: float = 5.0, on_dead=None):
+        self.timeout = float(timeout)
+        self.interval = float(interval)
+        self.on_dead = on_dead
+        self.last_seen: Dict[int, float] = {}
+        self.num_trainers = int(num_trainers)
+        self.dead: set = set()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._watch, daemon=True)
+
+    def start(self):
+        import time
+
+        # pre-register every expected trainer (reference initialises the
+        # full worker table up front) so one that DIES BEFORE its first
+        # contact is still flagged
+        now = time.monotonic()
+        for tid in range(self.num_trainers):
+            self.last_seen.setdefault(tid, now)
+        self._thread.start()
+        return self
+
+    def ping(self, trainer_id: int):
+        import time
+
+        self.last_seen[int(trainer_id)] = time.monotonic()
+        self.dead.discard(int(trainer_id))
+
+    def _watch(self):
+        import logging
+        import time
+
+        while not self._stop.wait(self.interval):
+            now = time.monotonic()
+            for tid, seen in list(self.last_seen.items()):
+                if tid not in self.dead and now - seen > self.timeout:
+                    self.dead.add(tid)
+                    if self.on_dead is not None:
+                        self.on_dead(tid)
+                    else:
+                        logging.getLogger("paddle_tpu.ps").warning(
+                            "trainer %d silent for %.0fs — marked DEAD",
+                            tid, now - seen)
+
+    def stop(self):
+        self._stop.set()
+
+
 class PServer:
     """One parameter-server process.
 
@@ -48,7 +108,8 @@ class PServer:
     def __init__(self, endpoint: str, pserver_program, startup_program,
                  num_trainers: int, sync_mode: bool = True,
                  grad_to_param: Optional[Dict[str, str]] = None,
-                 grad_to_ops: Optional[Dict[str, list]] = None):
+                 grad_to_ops: Optional[Dict[str, list]] = None,
+                 heartbeat_timeout: float = 0.0):
         import paddle_tpu as pt
 
         self.num_trainers = int(num_trainers)
@@ -64,6 +125,11 @@ class PServer:
         # one update at a time: connection threads race on the shared
         # scope (items() iteration vs insertion) and on @PS_STEP@
         self._apply_lock = threading.Lock()
+        self.monitor = None
+        if heartbeat_timeout > 0:
+            self.monitor = HeartBeatMonitor(
+                num_trainers, timeout=heartbeat_timeout,
+                interval=min(heartbeat_timeout / 4, 5.0)).start()
         self.server = RPCServer(endpoint, self._handle)
         self.endpoint = self.server.endpoint
 
@@ -89,6 +155,14 @@ class PServer:
             self.scope.set("@PS_STEP@", np.int32(int(step) + 1))
 
     def _handle(self, method, name, arr, aux):
+        # every contact is a liveness signal; recv_param's aux is a
+        # version (not a trainer id), so sync-blocked trainers ping via
+        # their preceding sends + explicit heartbeats
+        if self.monitor is not None and method in ("send_grad",
+                                                   "heartbeat"):
+            self.monitor.ping(aux)
+        if method == "heartbeat":
+            return None, 0
         if method == "send_grad":
             st = self.states[name]
             with st.cond:
@@ -135,4 +209,6 @@ class PServer:
         self.server.wait()
 
     def shutdown(self):
+        if self.monitor is not None:
+            self.monitor.stop()
         self.server.shutdown()

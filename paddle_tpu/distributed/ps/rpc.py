@@ -175,3 +175,27 @@ class RPCClient:
             self.call("__stop__")
         except (ConnectionError, OSError):
             pass
+
+
+def start_heartbeat(endpoints, trainer_id: int, interval: float = 10.0):
+    """Trainer-side liveness pings (reference: the trainer's periodic
+    beat consumed by heart_beat_monitor.h). A daemon thread pings every
+    pserver on its own connection so a trainer blocked in a sync recv
+    still reads as alive. Returns a stop() callable."""
+    import threading
+
+    if isinstance(endpoints, str):
+        endpoints = [e.strip() for e in endpoints.split(",") if e.strip()]
+    stop = threading.Event()
+    clients = [RPCClient(ep) for ep in endpoints]
+
+    def beat():
+        while not stop.wait(interval):
+            for cli in clients:
+                try:
+                    cli.call("heartbeat", aux=int(trainer_id))
+                except (ConnectionError, OSError):
+                    pass
+
+    threading.Thread(target=beat, daemon=True).start()
+    return stop.set

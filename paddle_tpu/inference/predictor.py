@@ -25,8 +25,12 @@ from ..core.scope import Scope
 DEFAULT_PASSES = [
     "delete_dropout_pass",
     "conv_bn_fuse_pass",
+    "embedding_eltwise_layernorm_fuse_pass",
     "multihead_attention_fuse_pass",
     "fc_fuse_pass",
+    # AFTER fc_fuse: this one would otherwise grab the (bias-add, act)
+    # pair that fc_fuse wants
+    "fuse_elewise_add_act_pass",
 ]
 
 

@@ -309,3 +309,27 @@ def c_gen_nccl_id(ins, attrs):
     NCCL unique id) — jax.distributed's coordinator plays this role; the
     op is a no-op marker kept for program parity."""
     return {}
+
+
+@register_op("local_sgd_sync", is_collective=True)
+def local_sgd_sync(ins, attrs):
+    """Every k steps, replace the local param with its cross-rank mean
+    (reference: fleet/meta_optimizers/localsgd_optimizer.py — k local
+    steps then averaged sync; transpiler/collective.py:270 LocalSGD).
+    The pmean runs UNCONDITIONALLY every step (collectives must execute
+    on every rank every step for SPMD uniformity); a where() keeps the
+    local value between sync points."""
+    import jax
+    import jax.numpy as jnp
+
+    p = ins["X"][0]
+    ax = _axis_name(attrs)
+    k = int(attrs.get("k_steps", 1))
+    step = attrs.get("__step__")
+    if not _in_spmd(ax):
+        return {"Out": p}
+    mean = jax.lax.pmean(p, ax)
+    if k <= 1 or step is None:
+        return {"Out": mean}
+    do_sync = ((jnp.asarray(step) + 1) % k) == 0
+    return {"Out": jnp.where(do_sync, mean, p)}

@@ -130,7 +130,10 @@ def fused_elemwise_activation(ins, attrs):
     functors = list(attrs.get("functor_list", []))
     unary = {"relu": jax.nn.relu, "sigmoid": jax.nn.sigmoid,
              "tanh": jnp.tanh, "scale": lambda v: v * float(
-                 attrs.get("scale", 1.0)), "gelu": jax.nn.gelu}
+                 attrs.get("scale", 1.0)),
+             # match the standalone gelu op's default (erf form)
+             "gelu": lambda v: jax.nn.gelu(
+                 v, approximate=bool(attrs.get("approximate", False)))}
     binary = {"elementwise_add": jnp.add, "elementwise_sub": jnp.subtract,
               "elementwise_mul": jnp.multiply}
 

@@ -107,6 +107,83 @@ class TestPasses:
                        scope=scope)
         np.testing.assert_allclose(got, want, atol=2e-5)
 
+    def test_embedding_eltwise_layernorm_fuse(self, scope):
+        """The BERT embedding stack (3 lookups + adds + layer_norm)
+        collapses to one fused op with identical outputs (reference:
+        ir/embedding_eltwise_layernorm_fuse_pass.cc)."""
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+        from paddle_tpu.core.passes import apply_passes
+
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            a = layers.data("a", [8], dtype="int64", stop_gradient=True)
+            b = layers.data("b", [8], dtype="int64", stop_gradient=True)
+            c = layers.data("c", [8], dtype="int64", stop_gradient=True)
+            ea = layers.embedding(a, [32, 16])
+            eb = layers.embedding(b, [4, 16])
+            ec = layers.embedding(c, [8, 16])
+            y = layers.layer_norm(ea + eb + ec, begin_norm_axis=2)
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        rng = np.random.RandomState(0)
+        feed = {"a": rng.randint(0, 32, (2, 8)).astype(np.int64),
+                "b": rng.randint(0, 4, (2, 8)).astype(np.int64),
+                "c": rng.randint(0, 8, (2, 8)).astype(np.int64)}
+        want, = exe.run(main, feed=feed, fetch_list=[y], scope=scope)
+        apply_passes(main, ["embedding_eltwise_layernorm_fuse_pass"])
+        types = [o.type for o in main.global_block().ops]
+        assert "fused_embedding_eltwise_layernorm" in types
+        assert "lookup_table_v2" not in types
+        assert "layer_norm" not in types
+        got, = exe.run(main, feed=feed, fetch_list=[y], scope=scope)
+        np.testing.assert_allclose(got, want, atol=2e-5)
+
+    def test_fuse_elewise_add_act(self, scope):
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+        from paddle_tpu.core.passes import apply_passes
+
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [8])
+            y2 = layers.data("y2", [8])
+            z = layers.relu(layers.elementwise_add(x, y2))
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        feed = {"x": np.random.RandomState(0).randn(3, 8).astype(np.float32),
+                "y2": np.random.RandomState(1).randn(3, 8).astype(np.float32)}
+        want, = exe.run(main, feed=feed, fetch_list=[z], scope=scope)
+        apply_passes(main, ["fuse_elewise_add_act_pass"])
+        types = [o.type for o in main.global_block().ops]
+        assert "fused_elemwise_activation" in types and "relu" not in types
+        got, = exe.run(main, feed=feed, fetch_list=[z], scope=scope)
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_fuse_add_gelu_keeps_exact_form(self, scope):
+        """gelu's approximate attr must survive the fuse (erf vs tanh
+        forms differ ~1e-3 — the equivalence contract would break)."""
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+        from paddle_tpu.core.passes import apply_passes
+
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [8])
+            y2 = layers.data("y2", [8])
+            z = layers.gelu(layers.elementwise_add(x, y2))  # erf default
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        feed = {"x": np.random.RandomState(0).randn(3, 8).astype(np.float32)
+                * 2.0,
+                "y2": np.random.RandomState(1).randn(3, 8).astype(np.float32)}
+        want, = exe.run(main, feed=feed, fetch_list=[z], scope=scope)
+        apply_passes(main, ["fuse_elewise_add_act_pass"])
+        types = [o.type for o in main.global_block().ops]
+        assert "fused_elemwise_activation" in types and "gelu" not in types
+        got, = exe.run(main, feed=feed, fetch_list=[z], scope=scope)
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
     def test_conv_bn_fuse(self, scope):
         """conv2d + batch_norm(is_test) folds into conv + bias add
         (reference: ir/conv_bn_fuse_pass.cc); outputs must match the

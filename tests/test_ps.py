@@ -148,3 +148,63 @@ class TestPSCluster:
         assert len(l0) == len(l1) == 6
         assert all(np.isfinite(v) for v in l0.values())
         assert all(np.isfinite(v) for v in l1.values())
+
+
+class TestHeartBeat:
+    def test_monitor_flags_silent_trainer(self):
+        """reference: operators/distributed/heart_beat_monitor.h:51 —
+        a trainer that stops pinging is marked dead; pinging revives."""
+        import time
+
+        from paddle_tpu.distributed.ps.pserver import HeartBeatMonitor
+
+        dead = []
+        m = HeartBeatMonitor(2, timeout=0.3, interval=0.05,
+                             on_dead=dead.append).start()
+        m.ping(0)
+        m.ping(1)
+        for _ in range(20):         # keep trainer 0 alive, let 1 go silent
+            m.ping(0)
+            time.sleep(0.05)
+        m.stop()
+        assert dead == [1]
+        assert 1 in m.dead and 0 not in m.dead
+
+    def test_pserver_heartbeat_rpc(self):
+        """A PServer with heartbeat_timeout accepts heartbeat RPCs and
+        tracks last-seen per trainer."""
+        import numpy as np
+
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+        from paddle_tpu.core import ir, unique_name
+        from paddle_tpu.distributed.ps import (DistributeTranspiler,
+                                               PServer)
+        from paddle_tpu.distributed.ps.rpc import RPCClient
+
+        ir._main_program, ir._startup_program = ir.Program(), ir.Program()
+        unique_name.switch()
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [4], stop_gradient=True)
+            y = layers.fc(x, 2, param_attr=pt.ParamAttr(name="w"))
+            loss = layers.mean(y * y)
+            pt.optimizer.SGDOptimizer(0.1).minimize(loss)
+        ep = "127.0.0.1:0"
+        t = DistributeTranspiler()
+        t.transpile(0, program=main, startup_program=startup,
+                    pservers="127.0.0.1:17461", trainers=1, sync_mode=False)
+        prog, ps_startup = t.get_pserver_programs("127.0.0.1:17461")
+        server = PServer("127.0.0.1:17461", prog, ps_startup,
+                         num_trainers=1, sync_mode=False,
+                         grad_to_param=prog._ps_grad_to_param,
+                         grad_to_ops=prog._ps_grad_to_ops,
+                         heartbeat_timeout=30.0)
+        try:
+            cli = RPCClient(server.endpoint)
+            cli.call("heartbeat", aux=0)
+            cli.call("heartbeat", aux=0)
+            assert 0 in server.monitor.last_seen
+            assert server.monitor.dead == set()
+        finally:
+            server.shutdown()
