@@ -42,6 +42,10 @@ from . import checkpoint  # noqa: F401
 from . import vision  # noqa: F401
 from . import text  # noqa: F401
 from . import tensor  # noqa: F401
+from . import static  # noqa: F401
+from .static import disable_static, enable_static  # noqa: F401
+from . import dygraph  # noqa: F401
+from .dygraph import jit  # noqa: F401
 from .tensor import to_tensor  # noqa: F401
 
 __version__ = "0.1.0"

@@ -254,9 +254,12 @@ def embedding_eltwise_layernorm_fuse_pass(program: Program) -> Program:
     new_ops: List[OpDesc] = []
 
     def as_lookup(name):
+        # v2 only (v1 squeezes a trailing ids dim the fused op doesn't);
+        # padding_idx zeroes rows in the unfused op — the fused lowering
+        # has no mask, so those lookups must stay unfused
         op = producer.get(name)
-        if op is not None and op.type in ("lookup_table",
-                                          "lookup_table_v2") and \
+        if op is not None and op.type == "lookup_table_v2" and \
+                int(op.attrs.get("padding_idx", -1)) < 0 and \
                 len(consumers.get(name, [])) == 1:
             return op
         return None

@@ -8,6 +8,7 @@ jax.Arrays (device-resident), numpy arrays, or opaque Python objects
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 from typing import Any, Dict, Iterator, Optional
 
@@ -77,3 +78,16 @@ def reset_global_scope():
     global _global_scope
     _global_scope = Scope()
     return _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    """Swap the global scope for a `with` region (reference:
+    fluid.executor.scope_guard / paddle.static.scope_guard)."""
+    global _global_scope
+    old = _global_scope
+    _global_scope = scope
+    try:
+        yield scope
+    finally:
+        _global_scope = old

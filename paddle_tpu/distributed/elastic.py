@@ -56,6 +56,14 @@ class ElasticRunner:
         step = self.mgr.restore_latest(self.program, self.scope)
         if step:
             _LOG.info("elastic: resumed from checkpoint step %d", step)
+        else:
+            # baseline checkpoint of the INITIAL weights: a failure before
+            # the first periodic save must restore to step 0's state, not
+            # keep the partially-trained scope and re-run from step 0
+            try:
+                self.mgr.save(0, self.program, self.scope)
+            except ValueError:
+                pass     # nothing persistable yet -> nothing to restore
         result = None
         while step < num_steps:
             try:

@@ -425,9 +425,13 @@ class DGCOptimizer:
                  nranks: int = 1, axis_name="dp"):
         self.inner = inner
         cfgs = configs or {}
-        self.ratio = float(cfgs.get("sparsity", [0.01])[0]
-                           if isinstance(cfgs.get("sparsity"), list)
-                           else cfgs.get("sparsity", 0.01))
+        # reference semantics: sparsity = fraction DROPPED (default
+        # 0.999 keeps the top 0.1%); the dgc op's `ratios` attr is the
+        # fraction KEPT
+        sparsity = cfgs.get("sparsity", [0.999])
+        if isinstance(sparsity, (list, tuple)):
+            sparsity = sparsity[0]
+        self.ratio = max(1.0 - float(sparsity), 1e-6)
         self.momentum = float(cfgs.get("momentum", 0.9))
         self.nranks = int(nranks)
         self.axis_name = axis_name

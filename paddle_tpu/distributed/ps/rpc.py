@@ -187,15 +187,25 @@ def start_heartbeat(endpoints, trainer_id: int, interval: float = 10.0):
     if isinstance(endpoints, str):
         endpoints = [e.strip() for e in endpoints.split(",") if e.strip()]
     stop = threading.Event()
-    clients = [RPCClient(ep) for ep in endpoints]
+    clients: Dict[str, Optional[RPCClient]] = {ep: None for ep in endpoints}
 
     def beat():
+        # connect lazily + reconnect after any failure: a pserver that is
+        # not up yet (launch race) or restarts mid-run must not silence
+        # heartbeats forever
         while not stop.wait(interval):
-            for cli in clients:
+            for ep in endpoints:
                 try:
-                    cli.call("heartbeat", aux=int(trainer_id))
+                    if clients[ep] is None:
+                        clients[ep] = RPCClient(ep, timeout=interval)
+                    clients[ep].call("heartbeat", aux=int(trainer_id))
                 except (ConnectionError, OSError):
-                    pass
+                    try:
+                        if clients[ep] is not None:
+                            clients[ep]._sock.close()
+                    except OSError:
+                        pass
+                    clients[ep] = None
 
     threading.Thread(target=beat, daemon=True).start()
     return stop.set

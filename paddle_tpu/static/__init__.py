@@ -1,0 +1,65 @@
+"""paddle.static — the 2.0 static-graph namespace.
+
+Capability mirror of python/paddle/static/ (an alias layer re-exporting
+the fluid static-graph surface under the 2.0 name: Program,
+program_guard, Executor, data, nn.*, save/load_inference_model;
+paddle.enable_static/disable_static toggle the global mode). Here the
+framework is static-first, so enable_static() simply leaves (or exits)
+dygraph mode.
+"""
+
+from __future__ import annotations
+
+from .. import io as _io
+from ..core import (CPUPlace, Executor, Program, Scope,  # noqa: F401
+                    default_main_program, default_startup_program,
+                    program_guard)
+from ..core.compiler import (BuildStrategy, CompiledProgram,  # noqa: F401
+                             ExecutionStrategy)
+from ..core.ir import Variable, device_guard, in_dygraph_mode  # noqa: F401
+from ..layers import data as _fluid_data
+from ..layers import static_data  # noqa: F401
+from . import nn  # noqa: F401
+
+save_inference_model = _io.save_inference_model
+load_inference_model = _io.load_inference_model
+save = _io.save if hasattr(_io, "save") else None
+load = _io.load if hasattr(_io, "load") else None
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """paddle.static.data — unlike fluid layers.data, `shape` INCLUDES
+    the batch dim (use None/-1 for variable batch)."""
+    shape = [(-1 if d is None else int(d)) for d in shape]
+    return static_data(name, shape, dtype)
+
+
+def enable_static():
+    """paddle.enable_static — leave dygraph mode (static is the
+    default mode here)."""
+    from ..dygraph import disable_dygraph
+
+    disable_dygraph()
+
+
+def disable_static():
+    """paddle.disable_static — enter dygraph mode."""
+    from ..dygraph import enable_dygraph
+
+    enable_dygraph()
+
+
+def cpu_places(device_count=None):
+    return [CPUPlace()]
+
+
+def global_scope():
+    from ..core.scope import global_scope as _gs
+
+    return _gs()
+
+
+def scope_guard(scope):
+    from ..core.scope import scope_guard as _sg
+
+    return _sg(scope)

@@ -1,0 +1,7 @@
+"""paddle.static.nn — 2.0 re-exports of the op-emitting layer functions
+(reference: python/paddle/static/nn/__init__.py aliasing fluid.layers)."""
+
+from ..layers import (batch_norm, conv2d, conv2d_transpose,  # noqa: F401
+                      embedding, fc, layer_norm, pool2d)
+from ..layers.control_flow import (cond, static_loop,  # noqa: F401
+                                   while_loop)

@@ -212,7 +212,7 @@ def test_fleet_dgc_compressed_grads_train():
     fleet.init(is_collective=True)
     s = fleet.DistributedStrategy()
     s.dgc = True
-    s.dgc_configs = {"sparsity": 0.3, "momentum": 0.9}
+    s.dgc_configs = {"sparsity": 0.7, "momentum": 0.9}  # drop 70%, keep top 30%
     main, startup, loss = _build(
         s, opt_factory=lambda lr: pt.optimizer.MomentumOptimizer(lr, 0.9))
     ops = [op.type for op in main.global_block().ops]

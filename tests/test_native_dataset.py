@@ -212,3 +212,35 @@ class TestInferFromDataset:
         ds.set_filelist([bad])
         with pytest.raises(RuntimeError, match="bad float"):
             list(ds.stream_batches(2, 1))
+
+
+class TestStreamConcurrency:
+    def test_restart_stream_while_workers_live(self, tmp_path):
+        """Regression for the ADVICE r1 use-after-free: calling
+        stream_begin while a previous stream's parser threads are mid-Put
+        must join them first (native/data_feed.cc ptds_stream_begin now
+        calls ptds_stream_end). Abandon iterators mid-stream repeatedly —
+        with the bug this crashes/hangs; fixed it re-streams cleanly."""
+        import paddle_tpu as pt
+        import paddle_tpu.layers as layers
+        from paddle_tpu.core import ir
+
+        files, truth = _write_multislot(tmp_path, n_files=4, rows=50)
+        ir._main_program = ir.Program()
+        feat = layers.data("feat", [8], stop_gradient=True)
+        label = layers.data("label", [1], dtype="int64", stop_gradient=True)
+
+        ds = pt.DatasetFactory().create_dataset("QueueDataset")
+        ds.set_batch_size(3)
+        ds.set_thread(4)
+        ds.set_use_var([feat, label])
+        ds.set_filelist(files)
+        for trial in range(5):
+            it = ds.iter_batches()
+            next(it)           # pull one batch, abandon the rest
+            del it
+        # final full pass still yields every record exactly once
+        seen = []
+        for feed in ds.iter_batches():
+            seen.extend(feed["label"].reshape(-1).tolist())
+        assert sorted(seen) == sorted(t[1] for t in truth)

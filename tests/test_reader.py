@@ -168,18 +168,25 @@ class TestProcessWorkers:
                 return np.array([acc], np.int64)
 
         ds = Heavy()
-        t0 = time.perf_counter()
         serial = list(DataLoader(ds, batch_size=2, num_workers=0))
-        t_serial = time.perf_counter() - t0
-        t0 = time.perf_counter()
         par = list(DataLoader(ds, batch_size=2, num_workers=4,
                               use_shared_memory=True))
-        t_par = time.perf_counter() - t0
         for a, b in zip(serial, par):
             np.testing.assert_array_equal(a[0], b[0])
-        # faster than serial (4 workers; modest bar — the suite may share
-        # the machine with other jobs, so only clear regressions fail)
-        assert t_par < t_serial * 0.9, (t_serial, t_par)
+        # timing assertion with retries: the suite shares the machine
+        # with other jobs, so only a REPEATED absence of speedup fails
+        for attempt in range(3):
+            t0 = time.perf_counter()
+            list(DataLoader(ds, batch_size=2, num_workers=0))
+            t_serial = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            list(DataLoader(ds, batch_size=2, num_workers=4,
+                            use_shared_memory=True))
+            t_par = time.perf_counter() - t0
+            if t_par < t_serial * 0.9:
+                break
+        else:
+            raise AssertionError((t_serial, t_par))
 
     def test_worker_exception_propagates(self):
         import numpy as np
