@@ -1,0 +1,64 @@
+"""SelectedRows — the sparse-gradient value type.
+
+Capability mirror of the reference SelectedRows
+(framework/selected_rows.h:41): a (rows, values) pair representing a
+tall tensor where only `rows` are populated — the gradient of an
+embedding lookup touches batch-many rows of a vocab-sized table, and
+materialising the dense [V, D] gradient wastes memory and an HBM pass.
+
+Static-shape twist: on XLA `rows` has the fixed length of the lookup's
+id count (duplicates allowed — consumers scatter-ADD, so duplicate rows
+accumulate exactly like the reference's merge step). SelectedRows
+values flow between ops inside a traced program like any other env
+value; the ops that understand them are:
+
+  lookup_table_v2 grad (is_sparse=True)  — produces them
+  sum (gradient accumulation)            — concatenates them
+  scale / clip-type elementwise          — NOT supported (dense-ify)
+  sgd / momentum / adagrad               — scatter-style row updates
+
+Everything else receives `.to_dense(height)` semantics via an explicit
+error, mirroring the reference's kernel-level SelectedRows support
+matrix.
+"""
+
+from __future__ import annotations
+
+
+class SelectedRows:
+    __slots__ = ("rows", "values", "height")
+
+    def __init__(self, rows, values, height: int):
+        self.rows = rows          # [N] int32 row ids (duplicates ok)
+        self.values = values      # [N, D] row gradients
+        self.height = int(height)  # dense dim 0 (vocab size)
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.values.shape[1:])
+
+    def to_dense(self):
+        import jax.numpy as jnp
+
+        dense = jnp.zeros(self.shape, self.values.dtype)
+        return dense.at[self.rows].add(self.values)
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, "
+                f"nrows={self.values.shape[0]})")
+
+
+def concat(parts):
+    """Gradient accumulation of SelectedRows = row concatenation
+    (reference: the SelectedRows branch of sum_op.cc; duplicates merge
+    at scatter time)."""
+    import jax.numpy as jnp
+
+    assert parts and all(isinstance(p, SelectedRows) for p in parts)
+    h = parts[0].height
+    return SelectedRows(jnp.concatenate([p.rows for p in parts]),
+                        jnp.concatenate([p.values for p in parts]), h)

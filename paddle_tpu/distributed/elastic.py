@@ -62,6 +62,9 @@ class ElasticRunner:
             # keep the partially-trained scope and re-run from step 0
             try:
                 self.mgr.save(0, self.program, self.scope)
+                # the manager saves ASYNC by default; the baseline must be
+                # durable before any step can fail and need it
+                self.mgr.wait_until_finished()
             except ValueError:
                 pass     # nothing persistable yet -> nothing to restore
         result = None

@@ -23,14 +23,33 @@ _OPT = dict(non_diff_inputs=("Param", "Grad", "LearningRate", "Moment", "Moment1
                              "MeanSquare", "MeanGrad"))
 
 
+def _dense_grad(g):
+    """Optimizers without a dedicated SelectedRows kernel densify the
+    sparse grad (the reference's fallback for ops lacking a
+    SelectedRows specialisation; sgd has the real sparse path)."""
+    from ..core.selected_rows import SelectedRows
+
+    return g.to_dense() if isinstance(g, SelectedRows) else g
+
+
 @register_op("sgd", **_OPT)
 def sgd(ins, attrs):
+    """reference sgd_op.cc — including its SelectedRows grad kernel:
+    a sparse embedding gradient updates only the touched rows
+    (duplicates accumulate via scatter-add, the reference merge)."""
+    from ..core.selected_rows import SelectedRows
+
     p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    if isinstance(g, SelectedRows):
+        step = (lr.astype(p.dtype).reshape(())
+                * g.values.astype(p.dtype))
+        return {"ParamOut": p.at[g.rows].add(-step)}
     return {"ParamOut": p - lr.astype(p.dtype) * g.astype(p.dtype)}
 
 
 @register_op("momentum", **_OPT)
 def momentum(ins, attrs):
+    ins = dict(ins, Grad=[_dense_grad(ins["Grad"][0])])
     p, g, v, lr = (ins["Param"][0], ins["Grad"][0], ins["Velocity"][0],
                    ins["LearningRate"][0])
     mu = np.asarray(attrs.get("mu", 0.9), p.dtype)
@@ -50,6 +69,7 @@ def momentum(ins, attrs):
 @register_op("adam", **_OPT)
 def adam(ins, attrs):
     """reference: operators/optimizers/adam_op.h AdamFunctor."""
+    ins = dict(ins, Grad=[_dense_grad(ins["Grad"][0])])
     import jax.numpy as jnp
 
     p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
@@ -70,6 +90,7 @@ def adam(ins, attrs):
 
 @register_op("adamw", **_OPT)
 def adamw(ins, attrs):
+    ins = dict(ins, Grad=[_dense_grad(ins["Grad"][0])])
     import jax.numpy as jnp
 
     p, lr = ins["Param"][0], ins["LearningRate"][0]
@@ -99,6 +120,7 @@ def adamw(ins, attrs):
 
 @register_op("adagrad", **_OPT)
 def adagrad(ins, attrs):
+    ins = dict(ins, Grad=[_dense_grad(ins["Grad"][0])])
     import jax.numpy as jnp
 
     p, g, mom, lr = (ins["Param"][0], ins["Grad"][0], ins["Moment"][0],
@@ -110,6 +132,7 @@ def adagrad(ins, attrs):
 
 @register_op("adamax", **_OPT)
 def adamax(ins, attrs):
+    ins = dict(ins, Grad=[_dense_grad(ins["Grad"][0])])
     import jax.numpy as jnp
 
     p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
@@ -127,6 +150,7 @@ def adamax(ins, attrs):
 
 @register_op("adadelta", **_OPT)
 def adadelta(ins, attrs):
+    ins = dict(ins, Grad=[_dense_grad(ins["Grad"][0])])
     import jax.numpy as jnp
 
     p, g = ins["Param"][0], ins["Grad"][0]
@@ -142,6 +166,7 @@ def adadelta(ins, attrs):
 
 @register_op("rmsprop", **_OPT)
 def rmsprop(ins, attrs):
+    ins = dict(ins, Grad=[_dense_grad(ins["Grad"][0])])
     import jax.numpy as jnp
 
     p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
@@ -169,6 +194,7 @@ def rmsprop(ins, attrs):
 def lars_momentum(ins, attrs):
     """reference: operators/optimizers/lars_momentum_op.cc — layer-wise
     adaptive rate scaling for large-batch training."""
+    ins = dict(ins, Grad=[_dense_grad(ins["Grad"][0])])
     import jax.numpy as jnp
 
     p, g, v, lr = (ins["Param"][0], ins["Grad"][0], ins["Velocity"][0],
@@ -187,6 +213,7 @@ def lars_momentum(ins, attrs):
 @register_op("lamb", **_OPT)
 def lamb(ins, attrs):
     """reference: operators/optimizers/lamb_op.h — LAMB for large-batch BERT."""
+    ins = dict(ins, Grad=[_dense_grad(ins["Grad"][0])])
     import jax.numpy as jnp
 
     p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
@@ -213,6 +240,7 @@ def lamb(ins, attrs):
 
 @register_op("ftrl", **_OPT)
 def ftrl(ins, attrs):
+    ins = dict(ins, Grad=[_dense_grad(ins["Grad"][0])])
     import jax.numpy as jnp
 
     p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
@@ -231,6 +259,7 @@ def ftrl(ins, attrs):
 
 @register_op("decayed_adagrad", **_OPT)
 def decayed_adagrad(ins, attrs):
+    ins = dict(ins, Grad=[_dense_grad(ins["Grad"][0])])
     import jax.numpy as jnp
 
     p, g, mom, lr = (ins["Param"][0], ins["Grad"][0], ins["Moment"][0],
@@ -255,6 +284,7 @@ def clip_by_norm(ins, attrs):
 def proximal_gd(ins, attrs):
     """reference: optimizers/proximal_gd_op.cc — SGD step followed by
     L1/L2 proximal shrinkage."""
+    ins = dict(ins, Grad=[_dense_grad(ins["Grad"][0])])
     import jax.numpy as jnp
 
     p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
@@ -270,6 +300,7 @@ def proximal_gd(ins, attrs):
 @register_op("proximal_adagrad", **_OPT)
 def proximal_adagrad(ins, attrs):
     """reference: optimizers/proximal_adagrad_op.cc."""
+    ins = dict(ins, Grad=[_dense_grad(ins["Grad"][0])])
     import jax.numpy as jnp
 
     p, g = ins["Param"][0], ins["Grad"][0]
@@ -291,6 +322,7 @@ def proximal_adagrad(ins, attrs):
 def dpsgd(ins, attrs):
     """Differentially-private SGD (reference: optimizers/dpsgd_op.cc):
     clip the gradient to clip-norm, add Gaussian noise sigma, step."""
+    ins = dict(ins, Grad=[_dense_grad(ins["Grad"][0])])
     import jax
     import jax.numpy as jnp
 
@@ -358,6 +390,7 @@ def dgc(ins, attrs):
 def dgc_momentum(ins, attrs):
     """reference: optimizers/dgc_momentum_op.h — momentum applied to the
     DGC-released gradient."""
+    ins = dict(ins, Grad=[_dense_grad(ins["Grad"][0])])
     p, g = ins["Param"][0], ins["Grad"][0]
     v = ins["Velocity"][0]
     lr = ins["LearningRate"][0].astype(p.dtype).reshape(())

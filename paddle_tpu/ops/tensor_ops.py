@@ -14,7 +14,8 @@ from typing import Any, Dict, List
 
 import numpy as np
 
-from ..core.registry import register_op
+from ..core.ir import OpDesc
+from ..core.registry import register_grad_maker, register_op
 from ..core.types import convert_dtype
 
 
@@ -339,6 +340,46 @@ def lookup_table_v2(ins, attrs):
     return {"Out": out}
 
 
+@register_grad_maker("lookup_table_v2")
+def _lookup_table_v2_grad_maker(op, out_grads, in_grads):
+    """Dense grads via the generic vjp; is_sparse=True emits a
+    SelectedRows gradient instead (reference: lookup_table_op.cc grad
+    kernel's SelectedRows branch — the memory path for huge vocab
+    tables)."""
+    from ..core.registry import default_grad_maker
+
+    if not bool(op.attrs.get("is_sparse", False)):
+        return default_grad_maker(op, out_grads, in_grads)
+    og = (out_grads.get("Out") or [None])[0]
+    wg = (in_grads.get("W") or [None])[0]
+    if og is None or wg is None:
+        return []
+    return [OpDesc("lookup_table_sparse_grad",
+                   {"Ids": list(op.inputs["Ids"]),
+                    "W": list(op.inputs["W"]), "OutGrad": [og]},
+                   {"WGrad": [wg]},
+                   {"padding_idx": int(op.attrs.get("padding_idx", -1))})]
+
+
+@register_op("lookup_table_sparse_grad", skip_infer_shape=True,
+             non_diff_inputs=("Ids", "W", "OutGrad"))
+def lookup_table_sparse_grad(ins, attrs):
+    """d(lookup)/dW as SelectedRows: rows = the looked-up ids, values =
+    the incoming cotangents — no [V, D] dense buffer."""
+    import jax.numpy as jnp
+
+    from ..core.selected_rows import SelectedRows
+
+    ids = ins["Ids"][0].reshape(-1).astype(jnp.int32)
+    w = ins["W"][0]
+    og = ins["OutGrad"][0]
+    vals = og.reshape(ids.shape[0], og.shape[-1]).astype(w.dtype)
+    pad = int(attrs.get("padding_idx", -1))
+    if pad >= 0:
+        vals = vals * (ids != pad)[:, None].astype(vals.dtype)
+    return {"WGrad": SelectedRows(ids, vals, w.shape[0])}
+
+
 @register_op("lookup_table", non_diff_inputs=("Ids",))
 def lookup_table(ins, attrs):
     import jax.numpy as jnp
@@ -373,8 +414,16 @@ def one_hot_v2(ins, attrs):
 @register_op("sum")
 def sum_op(ins, attrs):
     """Multi-input add — the gradient-accumulation op
-    (reference: operators/sum_op.cc)."""
+    (reference: operators/sum_op.cc, including its SelectedRows branch:
+    sparse + sparse concatenates rows; sparse + dense densifies)."""
+    from ..core.selected_rows import SelectedRows, concat
+
     xs = [x for x in ins["X"] if x is not None]
+    if any(isinstance(x, SelectedRows) for x in xs):
+        if all(isinstance(x, SelectedRows) for x in xs):
+            return {"Out": concat(xs)}
+        xs = [x.to_dense() if isinstance(x, SelectedRows) else x
+              for x in xs]
     out = xs[0]
     for x in xs[1:]:
         out = out + x

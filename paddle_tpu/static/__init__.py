@@ -17,7 +17,6 @@ from ..core import (CPUPlace, Executor, Program, Scope,  # noqa: F401
 from ..core.compiler import (BuildStrategy, CompiledProgram,  # noqa: F401
                              ExecutionStrategy)
 from ..core.ir import Variable, device_guard, in_dygraph_mode  # noqa: F401
-from ..layers import data as _fluid_data
 from ..layers import static_data  # noqa: F401
 from . import nn  # noqa: F401
 
@@ -31,7 +30,7 @@ def data(name, shape, dtype="float32", lod_level=0):
     """paddle.static.data — unlike fluid layers.data, `shape` INCLUDES
     the batch dim (use None/-1 for variable batch)."""
     shape = [(-1 if d is None else int(d)) for d in shape]
-    return static_data(name, shape, dtype)
+    return static_data(name, shape, dtype, lod_level=lod_level)
 
 
 def enable_static():
