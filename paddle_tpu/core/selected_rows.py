@@ -13,13 +13,15 @@ values flow between ops inside a traced program like any other env
 value; the ops that understand them are:
 
   lookup_table_v2 grad (is_sparse=True)  — produces them
-  sum (gradient accumulation)            — concatenates them
-  scale / clip-type elementwise          — NOT supported (dense-ify)
-  sgd / momentum / adagrad               — scatter-style row updates
+  sum (gradient accumulation)            — concatenates them (mixed
+                                           sparse+dense densifies)
+  sgd                                    — true scatter-row update
+  every other optimizer op               — densifies via _dense_grad
+                                           (optimizer_ops.py) before
+                                           updating
 
-Everything else receives `.to_dense(height)` semantics via an explicit
-error, mirroring the reference's kernel-level SelectedRows support
-matrix.
+Ops outside that set do not understand SelectedRows; reaching one is a
+programming error that surfaces as a type error at trace time.
 """
 
 from __future__ import annotations

@@ -79,8 +79,11 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    # sparse=True routes the backward through the SelectedRows grad
+    # (core/selected_rows.py) — no dense [V, D] gradient buffer
     return _op("lookup_table_v2", {"Ids": x, "W": weight},
-               {"padding_idx": -1 if padding_idx is None else padding_idx})
+               {"padding_idx": -1 if padding_idx is None else padding_idx,
+                "is_sparse": bool(sparse)})
 
 
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,

@@ -181,3 +181,248 @@ def reverse(ins, attrs):
     if not isinstance(axes, (list, tuple)):
         axes = [axes]
     return {"Out": jnp.flip(ins["X"][0], axis=tuple(int(a) for a in axes))}
+
+
+@register_op("bilinear_tensor_product")
+def bilinear_tensor_product(ins, attrs):
+    """Out[b, k] = x[b] @ W[k] @ y[b] + bias (reference:
+    operators/bilinear_tensor_product_op.cc)."""
+    import jax.numpy as jnp
+
+    x, y, w = ins["X"][0], ins["Y"][0], ins["Weight"][0]
+    out = jnp.einsum("bi,kij,bj->bk", x, w, y)
+    if ins.get("Bias") and ins["Bias"][0] is not None:
+        out = out + ins["Bias"][0]
+    return {"Out": out}
+
+
+@register_op("size", non_diff_inputs=("Input",))
+def size_op(ins, attrs):
+    """Element count (reference: operators/size_op.cc)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    x = ins["Input"][0]
+    return {"Out": jnp.asarray(int(np.prod(x.shape)), jnp.int64)}
+
+
+@register_op("scatter_nd", non_diff_inputs=("Index", "Shape"))
+def scatter_nd(ins, attrs):
+    """Scatter updates into zeros of `shape` (reference:
+    operators/scatter_nd_add_op.cc family)."""
+    import jax.numpy as jnp
+
+    idx = ins["Index"][0]
+    upd = ins["Updates"][0]
+    shape = tuple(int(v) for v in attrs["shape"])
+    out = jnp.zeros(shape, upd.dtype)
+    return {"Out": out.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)}
+
+
+@register_op("diag")
+def diag(ins, attrs):
+    """Vector -> diagonal matrix (reference: operators/diag_op.cc)."""
+    import jax.numpy as jnp
+
+    return {"Out": jnp.diag(ins["Diagonal"][0].reshape(-1))}
+
+
+@register_op("diag_v2")
+def diag_v2(ins, attrs):
+    """reference: diag_v2 — vector<->matrix diagonal with offset."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    off = int(attrs.get("offset", 0))
+    if x.ndim == 1:
+        pad = float(attrs.get("padding_value", 0.0))
+        out = jnp.diag(x, k=off)
+        if pad:
+            mask = jnp.diag(jnp.ones_like(x), k=off)
+            out = jnp.where(mask > 0, out, pad)
+        return {"Out": out}
+    return {"Out": jnp.diagonal(x, offset=off)}
+
+
+@register_op("histogram", non_diff_inputs=("X",))
+def histogram(ins, attrs):
+    """reference: operators/histogram_op.cc."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0].reshape(-1)
+    bins = int(attrs.get("bins", 100))
+    lo = float(attrs.get("min", 0))
+    hi = float(attrs.get("max", 0))
+    if lo == 0 and hi == 0:
+        lo, hi = jnp.min(x), jnp.max(x)
+    hist, _ = jnp.histogram(x, bins=bins, range=(lo, hi))
+    return {"Out": hist.astype(jnp.int64)}
+
+
+@register_op("bincount", non_diff_inputs=("X", "Weights"))
+def bincount(ins, attrs):
+    """reference: bincount_op.cc — static minlength required on TPU."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0].reshape(-1).astype(jnp.int32)
+    w = None
+    if ins.get("Weights") and ins["Weights"][0] is not None:
+        w = ins["Weights"][0].reshape(-1)
+    n = int(attrs.get("minlength", 0))
+    if n <= 0:
+        raise ValueError("bincount on TPU needs a static minlength attr "
+                         "(dynamic output sizes cannot be jitted)")
+    return {"Out": jnp.bincount(x, weights=w, length=n)}
+
+
+@register_op("isinf", non_diff_inputs=("X",))
+def isinf_op(ins, attrs):
+    import jax.numpy as jnp
+
+    return {"Out": jnp.any(jnp.isinf(ins["X"][0])).reshape(1)}
+
+
+@register_op("isnan", non_diff_inputs=("X",))
+def isnan_op(ins, attrs):
+    import jax.numpy as jnp
+
+    return {"Out": jnp.any(jnp.isnan(ins["X"][0])).reshape(1)}
+
+
+@register_op("isinf_v2", non_diff_inputs=("X",))
+def isinf_v2(ins, attrs):
+    import jax.numpy as jnp
+
+    return {"Out": jnp.isinf(ins["X"][0])}
+
+
+@register_op("isnan_v2", non_diff_inputs=("X",))
+def isnan_v2(ins, attrs):
+    import jax.numpy as jnp
+
+    return {"Out": jnp.isnan(ins["X"][0])}
+
+
+@register_op("rank", non_diff_inputs=("Input",))
+def rank_op(ins, attrs):
+    import jax.numpy as jnp
+
+    return {"Out": jnp.asarray(ins["Input"][0].ndim, jnp.int32)}
+
+
+@register_op("cumprod")
+def cumprod(ins, attrs):
+    import jax.numpy as jnp
+
+    return {"Out": jnp.cumprod(ins["X"][0],
+                               axis=int(attrs.get("dim", -1)))}
+
+
+@register_op("kthvalue", non_diff_inputs=("X",))
+def kthvalue(ins, attrs):
+    """reference: kthvalue_op.cc — k-th SMALLEST along axis."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    k = int(attrs["k"])
+    axis = int(attrs.get("axis", -1))
+    keepdim = bool(attrs.get("keepdim", False))
+    n = x.shape[axis]
+    if not 1 <= k <= n:
+        raise ValueError(f"kthvalue: k={k} out of range for axis "
+                         f"length {n}")
+    arg = jnp.argsort(x, axis=axis)           # one sort serves both
+    srt = jnp.take_along_axis(x, arg, axis=axis)
+    val = jnp.take(srt, k - 1, axis=axis)
+    idx = jnp.take(arg, k - 1, axis=axis).astype(jnp.int64)
+    if keepdim:
+        val = jnp.expand_dims(val, axis)
+        idx = jnp.expand_dims(idx, axis)
+    return {"Out": val, "Indices": idx}
+
+
+@register_op("median", non_diff_inputs=("X",))
+def median(ins, attrs):
+    import jax.numpy as jnp
+
+    axis = attrs.get("axis", None)
+    keepdim = bool(attrs.get("keepdim", False))
+    return {"Out": jnp.median(ins["X"][0],
+                              axis=None if axis is None else int(axis),
+                              keepdims=keepdim)}
+
+
+@register_op("mode", non_diff_inputs=("X",))
+def mode_op(ins, attrs):
+    """Most frequent value along the last axis (reference: mode_op.cc)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    axis = int(attrs.get("axis", -1)) % x.ndim
+    keepdim = bool(attrs.get("keepdim", False))
+    x = jnp.moveaxis(x, axis, -1)
+    srt = jnp.sort(x, axis=-1)
+    # run-length trick: count equal neighbours in the sorted order
+    eq = (srt[..., 1:] == srt[..., :-1])
+    runs = jnp.concatenate(
+        [jnp.zeros(x.shape[:-1] + (1,), jnp.int32),
+         jnp.cumsum(eq, axis=-1, dtype=jnp.int32)], axis=-1)
+    start = runs - jax.lax.cummax(
+        jnp.where(jnp.concatenate(
+            [jnp.ones(x.shape[:-1] + (1,), bool), ~eq], axis=-1),
+            runs, 0), axis=x.ndim - 1)
+    lengths = start + 1
+    best = jnp.argmax(lengths, axis=-1)
+    val = jnp.take_along_axis(srt, best[..., None], axis=-1)[..., 0]
+    idx = jnp.argmax(x == val[..., None], axis=-1).astype(jnp.int64)
+    if keepdim:
+        val = jnp.expand_dims(val, axis)
+        idx = jnp.expand_dims(idx, axis)
+    return {"Out": val, "Indices": idx}
+
+
+@register_op("searchsorted", non_diff_inputs=("SortedSequence", "Values"))
+def searchsorted(ins, attrs):
+    import jax.numpy as jnp
+
+    import jax
+
+    seq = ins["SortedSequence"][0]
+    vals = ins["Values"][0]
+    side = "right" if attrs.get("right", False) else "left"
+    if seq.ndim == 1:
+        out = jnp.searchsorted(seq, vals.reshape(-1), side=side) \
+            .reshape(vals.shape)
+    else:
+        # per-row search (reference semantics for N-D sequences):
+        # leading dims of seq and vals must match
+        s2 = seq.reshape(-1, seq.shape[-1])
+        v2 = vals.reshape(s2.shape[0], -1)
+        out = jax.vmap(
+            lambda sq, vv: jnp.searchsorted(sq, vv, side=side))(s2, v2) \
+            .reshape(vals.shape)
+    dt = jnp.int32 if attrs.get("out_int32", False) else jnp.int64
+    return {"Out": out.astype(dt)}
+
+
+@register_op("lgamma")
+def lgamma(ins, attrs):
+    import jax.scipy.special as jsp
+
+    return {"Out": jsp.gammaln(ins["X"][0])}
+
+
+@register_op("digamma")
+def digamma(ins, attrs):
+    import jax.scipy.special as jsp
+
+    return {"Out": jsp.digamma(ins["X"][0])}
+
+
+@register_op("frac")
+def frac(ins, attrs):
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    return {"Out": x - jnp.trunc(x)}

@@ -665,3 +665,80 @@ class TestDGC:
         assert 10 <= nz <= 12              # ~top-10% released (ties ok)
         # released mass leaves the carry buffers
         assert np.all(np.asarray(out["V_out"])[enc != 0] == 0)
+
+
+class TestOpsBatch3:
+    """Direct lowering checks for the last op batch (mode/kthvalue/
+    median/searchsorted/bincount/diag/scatter_nd/size/lgamma/...)."""
+
+    def _run(self, name, ins, attrs={}):
+        from paddle_tpu.core import registry
+
+        return registry.lookup(name).forward(ins, dict(attrs))
+
+    def test_order_statistics(self):
+        import jax.numpy as jnp
+
+        x = jnp.asarray(np.array([[3., 1., 3., 2., 3.],
+                                  [5., 5., 1., 1., 1.]], np.float32))
+        np.testing.assert_array_equal(
+            np.asarray(self._run("mode", {"X": [x]})["Out"]), [3., 1.])
+        np.testing.assert_array_equal(
+            np.asarray(self._run("kthvalue", {"X": [x]},
+                                 {"k": 2, "axis": -1})["Out"]), [2., 1.])
+        np.testing.assert_array_equal(
+            np.asarray(self._run("median", {"X": [x]},
+                                 {"axis": 1})["Out"]), [3., 1.])
+
+    def test_search_and_counts(self):
+        import jax.numpy as jnp
+
+        out = self._run("searchsorted",
+                        {"SortedSequence": [jnp.asarray([1., 3., 5., 7.])],
+                         "Values": [jnp.asarray([[2., 6.]])]})
+        np.testing.assert_array_equal(np.asarray(out["Out"]), [[1, 3]])
+        out = self._run("bincount", {"X": [jnp.asarray([1, 2, 2, 5])]},
+                        {"minlength": 7})
+        np.testing.assert_array_equal(np.asarray(out["Out"]),
+                                      [0, 1, 2, 0, 0, 1, 0])
+
+    def test_scatter_diag_size(self):
+        import jax.numpy as jnp
+
+        out = self._run("scatter_nd",
+                        {"Index": [jnp.asarray([[0], [2], [0]])],
+                         "Updates": [jnp.asarray([1., 2., 3.])]},
+                        {"shape": [4]})
+        np.testing.assert_array_equal(np.asarray(out["Out"]),
+                                      [4., 0., 2., 0.])
+        out = self._run("diag_v2", {"X": [jnp.asarray([1., 2.])]},
+                        {"offset": 0})
+        np.testing.assert_array_equal(np.asarray(out["Out"]),
+                                      [[1., 0.], [0., 2.]])
+        out = self._run("size", {"Input": [jnp.zeros((3, 4))]})
+        assert int(out["Out"]) == 12
+
+    def test_special_functions(self):
+        import jax.numpy as jnp
+        from math import lgamma as ref_lgamma
+
+        x = jnp.asarray([0.5, 2.0, 5.0])
+        got = np.asarray(self._run("lgamma", {"X": [x]})["Out"])
+        want = [ref_lgamma(v) for v in [0.5, 2.0, 5.0]]
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+        got = np.asarray(self._run("frac",
+                                   {"X": [jnp.asarray([1.5, -1.5])]})["Out"])
+        np.testing.assert_allclose(got, [0.5, -0.5], atol=1e-6)
+
+    def test_bilinear_tensor_product(self):
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(2, 3).astype(np.float32))
+        y = jnp.asarray(rng.randn(2, 4).astype(np.float32))
+        w = jnp.asarray(rng.randn(5, 3, 4).astype(np.float32))
+        out = np.asarray(self._run(
+            "bilinear_tensor_product",
+            {"X": [x], "Y": [y], "Weight": [w]})["Out"])
+        want = np.einsum("bi,kij,bj->bk", x, w, y)
+        np.testing.assert_allclose(out, want, rtol=1e-5)
