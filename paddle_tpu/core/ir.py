@@ -46,6 +46,7 @@ class OpRole:
 # Sentinel used to trace dynamic dims through jax.eval_shape.
 _DYN_SENTINEL = 509    # primes: two eval_shape runs at different
 _DYN_SENTINEL_B = 521  # substitutions identify dynamic output dims exactly
+_EVAL_SHAPE_WARNED: set = set()  # op types already warned-once about
 
 
 def _json_attr(v):
@@ -494,6 +495,18 @@ class Block:
         except Exception as e:  # inference is best-effort; runtime uses
             debug(f"lowering raised during eval_shape: "
                   f"{type(e).__name__}: {e}")  # real arrays
+            # a broken lowering degrading to shapeless vars should not be
+            # fully silent: warn ONCE per op type even without the flag
+            if op.type not in _EVAL_SHAPE_WARNED:
+                _EVAL_SHAPE_WARNED.add(op.type)
+                if not flag("infer_shape_debug"):
+                    import warnings
+
+                    warnings.warn(
+                        f"infer_shape[{op.type}]: lowering raised during "
+                        f"eval_shape ({type(e).__name__}); output shapes "
+                        f"unknown — set FLAGS_infer_shape_debug=1 for "
+                        f"per-occurrence detail", stacklevel=4)
             return
 
         if not isinstance(out_a, dict):

@@ -109,6 +109,7 @@ class PServer:
                  num_trainers: int, sync_mode: bool = True,
                  grad_to_param: Optional[Dict[str, str]] = None,
                  grad_to_ops: Optional[Dict[str, list]] = None,
+                 common_ops: Optional[list] = None,
                  heartbeat_timeout: float = 0.0):
         import paddle_tpu as pt
 
@@ -120,10 +121,16 @@ class PServer:
         self.exe.run(startup_program, scope=self.scope, use_compiled=False)
         self.grad_to_param = grad_to_param or {}
         self.grad_to_ops = grad_to_ops or {}
+        # LR-schedule / counter ops shared by every param on this server
+        # (transpiler._common_ops) — run once per GLOBAL step, not once
+        # per parameter apply
+        self.common_ops = list(common_ops or [])
+        self._apply_count: Dict[str, int] = {}
+        self._global_step = 0
         self.states: Dict[str, ParamState] = {
             g: ParamState() for g in self.grad_to_param}
         # one update at a time: connection threads race on the shared
-        # scope (items() iteration vs insertion) and on @PS_STEP@
+        # scope (items() iteration vs insertion) and on the step counters
         self._apply_lock = threading.Lock()
         self.monitor = None
         if heartbeat_timeout > 0:
@@ -143,16 +150,33 @@ class PServer:
             env = {}
             for name, val in self.scope.items():
                 env[name] = val
+
+            def persist(ops):
+                for op in ops:
+                    for out in op.output_names():
+                        if out in env:
+                            self.scope.set(out, np.asarray(env[out]))
+
+            # the Nth apply of any grad belongs to global step N-1; the
+            # fastest-advancing grad opens the new step, running the
+            # common/LR-schedule ops (e.g. the increment on
+            # @LR_DECAY_COUNTER@) exactly ONCE per step — a server
+            # hosting K params must not decay K× per step
+            count = self._apply_count.get(grad_name, 0) + 1
+            self._apply_count[grad_name] = count
+            step = np.int32(count - 1)
+            if count > self._global_step:
+                self._global_step = count
+                for op in self.common_ops:
+                    run_op(op, env, step=step)
+                persist(self.common_ops)
+                # observability only (nothing reads it back): global
+                # steps applied, inspectable from tests/monitoring
+                self.scope.set("@PS_STEP@", np.int32(self._global_step))
             env[grad_name] = grad
-            step = self.scope.find_var("@PS_STEP@") or np.int32(0)
             for op in self.grad_to_ops[grad_name]:
                 run_op(op, env, step=step)
-            # persist updated vars (param + accumulators)
-            for op in self.grad_to_ops[grad_name]:
-                for out in op.output_names():
-                    if out in env:
-                        self.scope.set(out, np.asarray(env[out]))
-            self.scope.set("@PS_STEP@", np.int32(int(step) + 1))
+            persist(self.grad_to_ops[grad_name])
 
     def _handle(self, method, name, arr, aux):
         # every contact is a liveness signal; recv_param's aux is a
@@ -170,8 +194,13 @@ class PServer:
                     st.pending[aux] = arr     # aux = trainer_id
                     if len(st.pending) == self.num_trainers:
                         mean = np.mean(list(st.pending.values()), axis=0)
-                        self._apply(name, mean.astype(arr.dtype))
-                        st.pending.clear()
+                        try:
+                            self._apply(name, mean.astype(arr.dtype))
+                        finally:
+                            # a failed apply must not leave this step's
+                            # grads pending — the NEXT step's first send
+                            # would complete the barrier with a stale mix
+                            st.pending.clear()
                         st.version += 1
                         st.cond.notify_all()
                 else:
@@ -188,8 +217,18 @@ class PServer:
                 st = self.states[grad_name]
                 if self.sync_mode and aux > 0:
                     with st.cond:
-                        st.cond.wait_for(lambda: st.version >= aux,
-                                         timeout=120)
+                        ok = st.cond.wait_for(lambda: st.version >= aux,
+                                              timeout=120)
+                    if not ok:
+                        # surface the stalled barrier instead of silently
+                        # serving a stale parameter (the RPC layer relays
+                        # this to the trainer as an error status)
+                        dead = (sorted(self.monitor.dead)
+                                if self.monitor else None)
+                        raise RuntimeError(
+                            f"sync barrier timed out: '{name}' at version "
+                            f"{st.version}, trainer expects >= {aux}"
+                            + (f"; dead trainers: {dead}" if dead else ""))
                 ver = st.version
             val = self.scope.find_var(name)
             return np.asarray(val), ver

@@ -24,6 +24,8 @@ import numpy as np
 _HDR = struct.Struct("<IIHHI")  # method_len, name_len, dtype_code, ndim, aux
 _DTYPES = ["float32", "float64", "int32", "int64", "uint8", "bool",
            "float16", "bfloat16"]
+_MAX_FRAME = 1 << 33  # 8 GiB: generous tensor cap, rejects garbage lengths
+_MAX_NDIM = 32
 
 
 def _send_msg(sock, method: str, name: str, arr: Optional[np.ndarray],
@@ -55,17 +57,41 @@ def _recv_exact(sock, n: int) -> bytes:
 
 
 def _recv_msg(sock) -> Tuple[str, str, Optional[np.ndarray], int]:
+    """Decode one frame. Every header field is validated against the
+    payload before any allocation/frombuffer — a malformed or truncated
+    frame raises ConnectionError (connection-fatal, never mis-frames the
+    next message) instead of IndexError deep in numpy."""
     (total,) = struct.unpack("<Q", _recv_exact(sock, 8))
+    if total < _HDR.size or total > _MAX_FRAME:
+        raise ConnectionError(f"malformed RPC frame: length {total}")
     payload = _recv_exact(sock, total)
     mlen, nlen, code, ndim, aux = _HDR.unpack_from(payload, 0)
     off = _HDR.size
+    if off + mlen + nlen > total or ndim > _MAX_NDIM:
+        raise ConnectionError(
+            f"malformed RPC frame: header (mlen={mlen} nlen={nlen} "
+            f"ndim={ndim}) exceeds payload of {total}")
     method = payload[off:off + mlen].decode(); off += mlen
     name = payload[off:off + nlen].decode(); off += nlen
     if code == 0xFFFF:
+        if off != total:
+            raise ConnectionError("malformed RPC frame: trailing bytes "
+                                  "on tensor-less message")
         return method, name, None, aux
+    if code >= len(_DTYPES) or off + 8 * ndim > total:
+        raise ConnectionError(
+            f"malformed RPC frame: dtype code {code} / shape overrun")
     shape = struct.unpack_from(f"<{ndim}q", payload, off)
     off += 8 * ndim
-    arr = np.frombuffer(payload, dtype=_DTYPES[code], offset=off)
+    if any(d < 0 for d in shape):
+        raise ConnectionError(f"malformed RPC frame: negative dim {shape}")
+    dt = np.dtype(_DTYPES[code])
+    count = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    if off + count * dt.itemsize != total:
+        raise ConnectionError(
+            f"malformed RPC frame: {total - off} body bytes for shape "
+            f"{shape} {dt}")
+    arr = np.frombuffer(payload, dtype=dt, offset=off, count=count)
     return method, name, arr.reshape(shape).copy(), aux
 
 
@@ -111,7 +137,12 @@ class RPCServer:
                     except OSError:
                         pass
                     return
-                out, oaux = self._handler(method, name, arr, aux)
+                try:
+                    out, oaux = self._handler(method, name, arr, aux)
+                except Exception as e:  # surface to the caller, keep serving
+                    _send_msg(conn, "__err__",
+                              f"{type(e).__name__}: {e}", None)
+                    continue
                 _send_msg(conn, "ok", name, out, oaux)
         except (ConnectionError, OSError):
             pass
@@ -167,7 +198,10 @@ class RPCClient:
         with self._lock:
             _send_msg(self._sock, method, name,
                       None if arr is None else np.asarray(arr), aux)
-            _, _, out, oaux = _recv_msg(self._sock)
+            status, err, out, oaux = _recv_msg(self._sock)
+            if status == "__err__":
+                raise RuntimeError(
+                    f"PS RPC '{method}' failed on {self.endpoint}: {err}")
             return out, oaux
 
     def stop_server(self):

@@ -157,17 +157,24 @@ class DistributeTranspiler:
         blk = prog.global_block()
         my_ops: Dict[str, List[OpDesc]] = {}
         needed_vars = set()
+        # common (LR-schedule/counter) ops are kept SEPARATE from the
+        # per-grad groups: the PServer runs them once per global step,
+        # not once per parameter apply
+        common = list(self._common_ops) if my_grads else []
         for g in my_grads:
-            ops = self._common_ops + self.grad_to_ops[g]
-            my_ops[g] = ops
-            for op in ops:
+            my_ops[g] = list(self.grad_to_ops[g])
+            for op in my_ops[g]:
                 needed_vars.update(op.input_names())
                 needed_vars.update(op.output_names())
+        for op in common:
+            needed_vars.update(op.input_names())
+            needed_vars.update(op.output_names())
         needed_vars.discard("@EMPTY@")
         for name in sorted(needed_vars):
             if src_block.has_var(name):
                 v = src_block.var(name)
                 blk._load_dict({"vars": [v.desc.to_dict()], "ops": []})
+        blk.ops.extend(common)
         for g in sorted(my_grads):
             blk.ops.extend(my_ops[g])
         prog._bump_version()
@@ -189,6 +196,7 @@ class DistributeTranspiler:
         prog._ps_grad_to_param = {g: self.grad_to_param[g]
                                   for g in my_grads}
         prog._ps_grad_to_ops = my_ops
+        prog._ps_common_ops = common
         return prog, startup
 
     def get_startup_program(self, endpoint=None, pserver_program=None):

@@ -240,6 +240,13 @@ def _jst_if(pred, t_fn, f_fn, snap):
                 # a name only one branch ever defines: keep the defined
                 # side (using it when the other branch ran is a user
                 # error the reference also leaves to runtime)
+                import warnings
+
+                warnings.warn(
+                    "to_static: a name assigned in only ONE branch of a "
+                    "tensor-dependent `if` cannot be selected at "
+                    "runtime; the defined branch's value is kept "
+                    "regardless of the predicate", stacklevel=3)
                 blended.append(t if isinstance(f, _Missing) else f)
             elif isinstance(t, (VarBase, np.ndarray)) or \
                     isinstance(f, (VarBase, np.ndarray)):

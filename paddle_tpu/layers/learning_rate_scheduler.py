@@ -30,29 +30,39 @@ def _decay_step_counter() -> Variable:
     layers/learning_rate_scheduler.py _decay_step_counter — an `increment`
     op inside the main program, so the count tracks MAIN-program runs, not
     arbitrary executor runs). Initialised to -1; first run reads 0."""
+    from ..core.ir import OpRole
     from .nn import create_global_var
 
-    block = default_main_program().global_block()
+    prog = default_main_program()
+    block = prog.global_block()
     if _COUNTER_NAME in block.vars:
         return block.vars[_COUNTER_NAME]
     counter = create_global_var([1], -1.0, "float32", persistable=True,
                                 name=_COUNTER_NAME)
-    block.append_op("increment", {"X": [counter]}, {"Out": [counter]},
-                    {"step": 1.0}, infer_shape=False)
+    # LRSched role (reference: program.lr_schedule_guard) so the PS
+    # transpiler moves the counter increment to the pserver, where it
+    # advances once per GLOBAL step
+    with prog._role_guard(OpRole.LRSched):
+        block.append_op("increment", {"X": [counter]}, {"Out": [counter]},
+                        {"step": 1.0}, infer_shape=False)
     return counter
 
 
 def _lr_op(schedule: str, attrs: dict, base_lr: Optional[Variable] = None,
            name: str = "learning_rate") -> Variable:
-    block = default_main_program().current_block()
+    from ..core.ir import OpRole
+
+    prog = default_main_program()
+    block = prog.current_block()
     step = _decay_step_counter()
     out = block.create_var(name=unique_name.generate(name), shape=(1,),
-                           dtype="float32")
+                           dtype="float32", persistable=True)
     ins = {"Step": [step]}
     if base_lr is not None:
         ins["BaseLR"] = [base_lr]
-    block.append_op("lr_schedule", ins, {"Out": [out]},
-                    {"schedule": schedule, **attrs}, infer_shape=False)
+    with prog._role_guard(OpRole.LRSched):
+        block.append_op("lr_schedule", ins, {"Out": [out]},
+                        {"schedule": schedule, **attrs}, infer_shape=False)
     return out
 
 

@@ -594,7 +594,38 @@ class DataLoader:
             next_bid = 0
             received = 0
             while received < len(batches):
-                bid, descs, err = result_q.get()
+                # bounded waits + worker-liveness check: a worker killed
+                # without posting a result (OOM kill, segfault in user
+                # dataset code) must raise, not hang the training loop.
+                # A worker killed while IDLE leaves its queued tasks for
+                # the survivors, so a crash alone is not fatal — raise
+                # only once results also stop flowing (progress stall).
+                import time as _time
+
+                last_progress = _time.monotonic()
+                while True:
+                    try:
+                        bid, descs, err = result_q.get(timeout=5.0)
+                        break
+                    except queue.Empty:
+                        crashed = [p.exitcode for p in procs
+                                   if not p.is_alive()
+                                   and p.exitcode not in (0, None)]
+                        stalled = _time.monotonic() - last_progress > 60.0
+                        if crashed and (stalled or
+                                        all(not p.is_alive()
+                                            for p in procs)):
+                            raise RuntimeError(
+                                f"DataLoader worker died (exitcodes "
+                                f"{crashed}) and results stalled with "
+                                f"{len(batches) - received} batches "
+                                f"outstanding — a batch was likely lost "
+                                f"with the worker")
+                        if all(not p.is_alive() for p in procs):
+                            raise RuntimeError(
+                                "all DataLoader workers exited with "
+                                f"{len(batches) - received} batches "
+                                "outstanding")
                 received += 1
                 if err is not None:
                     raise RuntimeError(

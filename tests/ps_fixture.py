@@ -89,7 +89,8 @@ def run_pserver(endpoint, all_eps, trainers, sync):
     prog, ps_startup = t.get_pserver_programs(endpoint)
     server = PServer(endpoint, prog, ps_startup, num_trainers=trainers,
                      sync_mode=sync, grad_to_param=prog._ps_grad_to_param,
-                     grad_to_ops=prog._ps_grad_to_ops)
+                     grad_to_ops=prog._ps_grad_to_ops,
+                     common_ops=prog._ps_common_ops)
     print(f"SERVING {server.endpoint}", flush=True)
     server.run()
 

@@ -74,6 +74,70 @@ class TestTranspiler:
                 assert any(p in op.output_names() for op in sblk.ops)
         assert all_params == {"w0", "b0", "w1", "b1"}
 
+    def test_lr_decay_runs_once_per_global_step(self):
+        """A pserver hosting N params must advance the LR-decay counter
+        once per GLOBAL step, not N times (advisor r2 medium): the
+        schedule's increment/lr ops are common_ops run by the first grad
+        of each step."""
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+        from paddle_tpu.core import ir, unique_name
+        from paddle_tpu.distributed.ps import DistributeTranspiler, PServer
+        from paddle_tpu.distributed.ps.rpc import RPCClient
+
+        ir._main_program, ir._startup_program = ir.Program(), ir.Program()
+        unique_name.switch()
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [4], stop_gradient=True)
+            h = layers.fc(x, 4, param_attr=pt.ParamAttr(name="w0"),
+                          bias_attr=pt.ParamAttr(name="b0"))
+            y = layers.fc(h, 2, param_attr=pt.ParamAttr(name="w1"),
+                          bias_attr=pt.ParamAttr(name="b1"))
+            loss = layers.mean(y * y)
+            lr = layers.exponential_decay(0.1, decay_steps=1,
+                                          decay_rate=0.5, staircase=True)
+            pt.optimizer.SGDOptimizer(learning_rate=lr).minimize(loss)
+
+        t = DistributeTranspiler()
+        t.transpile(0, program=main, startup_program=startup,
+                    pservers="127.0.0.1:17471", trainers=1, sync_mode=True)
+        # LR-schedule ops are moved OFF the trainer and are NOT
+        # replicated into any per-grad group
+        ttypes = [op.type for op in
+                  t.get_trainer_program().global_block().ops]
+        assert "lr_schedule" not in ttypes and "increment" not in ttypes
+        prog, ps_startup = t.get_pserver_programs("127.0.0.1:17471")
+        assert [op.type for op in prog._ps_common_ops] \
+            == ["increment", "lr_schedule"]
+        assert all(op.type == "sgd"
+                   for ops in prog._ps_grad_to_ops.values() for op in ops)
+
+        server = PServer("127.0.0.1:17471", prog, ps_startup,
+                         num_trainers=1, sync_mode=True,
+                         grad_to_param=prog._ps_grad_to_param,
+                         grad_to_ops=prog._ps_grad_to_ops,
+                         common_ops=prog._ps_common_ops)
+        try:
+            cli = RPCClient(server.endpoint)
+            steps = 3
+            for s in range(steps):
+                for g, p in prog._ps_grad_to_param.items():
+                    shape = main.global_block().var(p).shape
+                    cli.call("send_grad", g,
+                             np.ones(shape, np.float32) * 0.01, aux=0)
+            # counter initialised -1, +1 per STEP (4 params must not
+            # advance it 4x): after 3 steps it reads steps-1
+            counter = server.scope.find_var("@LR_DECAY_COUNTER@")
+            assert counter is not None
+            assert int(np.asarray(counter)[0]) == steps - 1, \
+                f"LR counter advanced {np.asarray(counter)[0]} in {steps} steps"
+            lr_val = float(np.asarray(
+                server.scope.find_var(lr.name))[0])
+            assert lr_val == pytest.approx(0.1 * 0.5 ** (steps - 1))
+        finally:
+            server.shutdown()
+
     def test_no_optimizer_raises(self):
         import paddle_tpu as pt
         from paddle_tpu import layers
@@ -199,6 +263,7 @@ class TestHeartBeat:
                          num_trainers=1, sync_mode=False,
                          grad_to_param=prog._ps_grad_to_param,
                          grad_to_ops=prog._ps_grad_to_ops,
+                         common_ops=prog._ps_common_ops,
                          heartbeat_timeout=30.0)
         try:
             cli = RPCClient(server.endpoint)
