@@ -783,10 +783,21 @@ def dropout_with_impl(x, p, is_test=False):
                    dropout_implementation="upscale_in_train")
 
 
-def flash_attention(q, k, v, bias=None, causal=False, scale=None, name=None):
+def _attn_dropout_attrs(attrs, dropout_rate, is_test, seed):
+    """Shared build-time attrs for attention-probs dropout (flash + ring)."""
+    if dropout_rate and not is_test:
+        attrs["dropout_prob"] = float(dropout_rate)
+        attrs["seed"] = (default_main_program().next_op_seed()
+                         if seed is None else int(seed))
+
+
+def flash_attention(q, k, v, bias=None, causal=False, scale=None,
+                    dropout_rate=0.0, is_test=False, seed=None, name=None):
     """Fused attention: softmax(q k^T * scale + bias) v via the Pallas
     flash-attention kernel (ops/attention_ops.py). q [B,H,Sq,D];
-    k,v [B,H,Sk,D]; bias optional, broadcastable to [B,1,1,Sk]."""
+    k,v [B,H,Sk,D]; bias optional, broadcastable to [B,1,1,Sk].
+    dropout_rate>0 (and not is_test) applies attention-probs dropout
+    with a per-step position-keyed mask (recomputed in the backward)."""
     helper = LayerHelper("flash_attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype)
     inputs = {"Q": [q], "K": [k], "V": [v]}
@@ -795,15 +806,19 @@ def flash_attention(q, k, v, bias=None, causal=False, scale=None, name=None):
     attrs = {"causal": causal}
     if scale is not None:
         attrs["scale"] = float(scale)
+    _attn_dropout_attrs(attrs, dropout_rate, is_test, seed)
     helper.append_op("flash_attention", inputs, {"Out": [out]}, attrs)
     return out
 
 
 def ring_attention(q, k, v, bias=None, causal=False, scale=None,
-                   axis_name="sp", nranks=1, name=None):
+                   axis_name="sp", nranks=1, dropout_rate=0.0,
+                   is_test=False, seed=None, name=None):
     """Sequence-parallel ring attention (parallel/ring_attention.py).
     q/k/v are sequence shards [B,H,S_local,D]; bias a key-bias shard
-    [B,S_local] travelling with kv around the ring."""
+    [B,S_local] travelling with kv around the ring. dropout_rate applies
+    the globally-position-keyed probs dropout (same mask as the unsharded
+    paths — sp sharding does not change numerics)."""
     helper = LayerHelper("ring_attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype)
     inputs = {"Q": [q], "K": [k], "V": [v]}
@@ -812,6 +827,7 @@ def ring_attention(q, k, v, bias=None, causal=False, scale=None,
     attrs = {"causal": causal, "axis_name": axis_name, "nranks": nranks}
     if scale is not None:
         attrs["scale"] = float(scale)
+    _attn_dropout_attrs(attrs, dropout_rate, is_test, seed)
     helper.append_op("ring_attention", inputs, {"Out": [out]}, attrs)
     return out
 

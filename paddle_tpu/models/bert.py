@@ -122,21 +122,15 @@ def _attention(x, attn_bias, cfg: BertConfig, name: str, is_test=False,
     q = layers.squeeze(q, [0])
     k = layers.squeeze(k, [0])
     v = layers.squeeze(v, [0])
-    if (cfg.use_ring_attention or cfg.use_flash_attention) and \
-            not is_test and cfg.attention_probs_dropout_prob > 0.0:
-        import warnings
-
-        warnings.warn(
-            "flash/ring attention does not materialise attention "
-            "probabilities, so attention_probs_dropout_prob="
-            f"{cfg.attention_probs_dropout_prob} is ignored on this path "
-            "(hidden dropout still applies)", stacklevel=3)
     if cfg.use_ring_attention:
-        ctx = layers.ring_attention(q, k, v, bias=attn_bias2d,
-                                    scale=1.0 / np.sqrt(hd), axis_name="sp")
+        ctx = layers.ring_attention(
+            q, k, v, bias=attn_bias2d, scale=1.0 / np.sqrt(hd),
+            axis_name="sp",
+            dropout_rate=cfg.attention_probs_dropout_prob, is_test=is_test)
     elif cfg.use_flash_attention:
-        ctx = layers.flash_attention(q, k, v, bias=attn_bias,
-                                     scale=1.0 / np.sqrt(hd))
+        ctx = layers.flash_attention(
+            q, k, v, bias=attn_bias, scale=1.0 / np.sqrt(hd),
+            dropout_rate=cfg.attention_probs_dropout_prob, is_test=is_test)
     else:
         scores = layers.matmul(q, k, transpose_y=True, alpha=1.0 / np.sqrt(hd))
         if attn_bias is not None:

@@ -13,13 +13,39 @@ from __future__ import annotations
 from ..core.registry import register_op
 
 
+def _attn_dropout(attrs):
+    """(rate, seed) for attention-probs dropout. seed is a uint32 scalar
+    folding the build-time op seed, the runtime step (fresh mask per step
+    without retrace) and the dp rank (dp shards see different global
+    batches). sp/mp ranks are deliberately NOT folded: the mask is keyed
+    on GLOBAL (b, h, q, k) positions, so sequence/model shards of one
+    logical batch must agree on it."""
+    rate = float(attrs.get("dropout_prob", 0.0) or 0.0)
+    if rate <= 0.0 or bool(attrs.get("is_test", False)):
+        return 0.0, None
+    import jax
+    import jax.numpy as jnp
+
+    key = jax.random.PRNGKey(int(attrs.get("seed", 0) or 0))
+    step = attrs.get("__step__")
+    if step is not None:
+        key = jax.random.fold_in(key, step)
+    try:
+        key = jax.random.fold_in(key, jax.lax.axis_index("dp"))
+    except Exception:
+        pass
+    kd = jnp.asarray(jax.random.key_data(key)).reshape(-1).astype(jnp.uint32)
+    return rate, kd[0] ^ kd[-1]
+
+
 @register_op("flash_attention", non_diff_inputs=("Bias",))
 def flash_attention_op(ins, attrs):
     """Out = softmax(Q K^T * scale + Bias) V.
 
     Q [B,H,Sq,D]; K,V [B,H,Sk,D]; Bias optional, broadcastable to
     [B,1,1,Sk] (key padding mask). Attrs: causal (bool), scale (float,
-    default 1/sqrt(D)).
+    default 1/sqrt(D)), dropout_prob/is_test/seed (attention-probs
+    dropout, reference attention_probs_dropout_prob semantics).
     """
     from .pallas import flash_attention
 
@@ -27,9 +53,11 @@ def flash_attention_op(ins, attrs):
     bias = None
     if ins.get("Bias") and ins["Bias"][0] is not None:
         bias = ins["Bias"][0]
+    rate, seed = _attn_dropout(attrs)
     out = flash_attention(q, k, v, bias=bias,
                           causal=bool(attrs.get("causal", False)),
-                          scale=attrs.get("scale", None))
+                          scale=attrs.get("scale", None),
+                          dropout_rate=rate, dropout_seed=seed)
     return {"Out": out}
 
 
@@ -45,10 +73,12 @@ def ring_attention_op(ins, attrs):
     bias = None
     if ins.get("Bias") and ins["Bias"][0] is not None:
         bias = ins["Bias"][0]
+    rate, seed = _attn_dropout(attrs)
     out = ring_attention(q, k, v, bias_kv=bias,
                          causal=bool(attrs.get("causal", False)),
                          scale=attrs.get("scale", None),
-                         axis_name=attrs.get("axis_name", "sp"))
+                         axis_name=attrs.get("axis_name", "sp"),
+                         dropout_rate=rate, dropout_seed=seed)
     return {"Out": out}
 
 

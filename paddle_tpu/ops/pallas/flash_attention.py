@@ -31,13 +31,90 @@ NEG_INF = -1e30
 
 
 # ---------------------------------------------------------------------------
+# attention-probs dropout
+#
+# The reference recipe applies dropout to the softmax probabilities
+# (attention_probs_dropout_prob — e.g. tests/unittests/dist_transformer.py
+# attention dropout). A fused/recompute attention cannot save the mask, so
+# the mask is a STATELESS position-keyed hash: keep(b, h, q, k) =
+# splitmix32(lattice_index ^ seed·φ) — recomputable bit-exactly in the
+# backward, and identical across the dense, q-chunked, Pallas and ring
+# paths because it depends only on GLOBAL coordinates. Sequence/model
+# sharding therefore never changes the mask (parity tests stay exact);
+# data-parallel decorrelation comes from folding the dp rank into `seed`
+# at the op layer (ops/attention_ops.py).
+# ---------------------------------------------------------------------------
+
+def _splitmix(x):
+    """splitmix32 finalizer over a uint32 array."""
+    U = jnp.uint32
+    x = (x ^ (x >> U(16))) * U(0x85EBCA6B)
+    x = (x ^ (x >> U(13))) * U(0xC2B2AE35)
+    return x ^ (x >> U(16))
+
+
+def _bh_seed(seed, bh):
+    """Per-(batch*heads + head) derived seed: hashing (b, h) into the seed
+    keeps the (q, k) lattice below 2^32 (wrap-free up to 64k sequence
+    length) instead of one flat index over b*h*q*k that would alias."""
+    U = jnp.uint32
+    return _splitmix(jnp.asarray(bh, U) ^ (jnp.asarray(seed, U)
+                                           * U(0x9E3779B9)))
+
+
+def _keep_scale_from_lin(lin, seed2, rate):
+    """f32 keep/(1-rate)-or-0 multiplier from a q*Sk+k lattice index and a
+    per-(b,h) seed (shared by the XLA, Pallas and ring paths). Threshold
+    compare in uint space: drop iff hash < rate * 2^32."""
+    U = jnp.uint32
+    x = _splitmix(lin ^ (jnp.asarray(seed2, U) * U(0x9E3779B9)))
+    thresh = U(min(int(float(rate) * 4294967296.0), 4294967295))
+    return jnp.where(x >= thresh, jnp.float32(1.0 / (1.0 - rate)),
+                     jnp.float32(0.0))
+
+
+def _attn_keep_scale(seed, rate, shape, q_off, k_off, n_heads, sq_g, sk_g):
+    """f32 multiplier tensor over `shape` = (b, h, cq, ck): keep/(1-rate)
+    or 0. seed uint32 scalar (may be traced); q_off/k_off global offsets
+    of this tile; sq_g/sk_g the GLOBAL sequence extents (lattice strides —
+    they must agree across shards for mask coherence)."""
+    U = jnp.uint32
+    b, h = shape[0], shape[1]
+    bh = (jax.lax.broadcasted_iota(U, (b, h, 1, 1), 0) * U(n_heads)
+          + jax.lax.broadcasted_iota(U, (b, h, 1, 1), 1))
+    seed2 = _bh_seed(seed, bh)                       # (b, h, 1, 1)
+    qi = jax.lax.broadcasted_iota(U, (1, 1, shape[2], shape[3]), 2) \
+        + jnp.asarray(q_off, U)
+    ki = jax.lax.broadcasted_iota(U, (1, 1, shape[2], shape[3]), 3) \
+        + jnp.asarray(k_off, U)
+    lin = qi * jnp.asarray(sk_g, U) + ki             # (1, 1, cq, ck)
+    return _keep_scale_from_lin(jnp.broadcast_to(lin, shape),
+                                jnp.broadcast_to(seed2, shape), rate)
+
+
+def _keep_scale_tile(seed, rate, bidx, n_heads, q0, k0, bq, bk, sq_g, sk_g):
+    """Kernel-side tile of the same mask: (bq, bk) multiplier for batch*head
+    index `bidx` (already b*n_heads + h in the flattened grid) at tile
+    origin (q0, k0) — bit-identical to _attn_keep_scale at the same
+    global coordinates."""
+    U = jnp.uint32
+    seed2 = _bh_seed(seed, jnp.asarray(bidx, U))
+    qi = jnp.asarray(q0, U) + jax.lax.broadcasted_iota(U, (bq, bk), 0)
+    ki = jnp.asarray(k0, U) + jax.lax.broadcasted_iota(U, (bq, bk), 1)
+    lin = qi * U(sk_g) + ki
+    return _keep_scale_from_lin(lin, seed2, rate)
+
+
+# ---------------------------------------------------------------------------
 # jnp reference (used for fallback and as the test oracle)
 # ---------------------------------------------------------------------------
 
-def reference_attention(q, k, v, bias_kv=None, causal=False, scale=None):
+def reference_attention(q, k, v, bias_kv=None, causal=False, scale=None,
+                        dropout_rate=0.0, dropout_seed=None):
     """Plain XLA attention: softmax(q k^T * scale + bias) v, fp32 softmax.
     bias_kv may be [B, Sk] (key-padding form) or any [B,H,Sq,Sk]-broadcastable
-    4-D bias."""
+    4-D bias. dropout_rate>0 applies the position-keyed mask to the probs
+    (upscale_in_train semantics, identical to every fused path)."""
     d = q.shape[-1]
     scale = float(scale) if scale is not None else 1.0 / np.sqrt(d)
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
@@ -50,6 +127,10 @@ def reference_attention(q, k, v, bias_kv=None, causal=False, scale=None):
         mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
         s = jnp.where(mask, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
+    if dropout_rate > 0.0:
+        seed = jnp.uint32(0) if dropout_seed is None else dropout_seed
+        p = p * _attn_keep_scale(seed, float(dropout_rate), p.shape, 0, 0,
+                                 q.shape[1], q.shape[2], k.shape[2])
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
@@ -100,45 +181,64 @@ def _xla_scores(q, k, bias_kv, causal, scale, q_offset=0, full_sq=None):
     return s
 
 
-def _xla_attn_chunk(qc, k, v, bias_kv, causal, scale, off, full_sq):
+def _xla_attn_chunk(qc, k, v, bias_kv, causal, scale, off, full_sq,
+                    seed=None, rate=0.0):
     p = jax.nn.softmax(
         _xla_scores(qc, k, bias_kv, causal, scale, off, full_sq), axis=-1)
+    if rate > 0.0:
+        p = p * _attn_keep_scale(seed, rate, p.shape, off, 0,
+                                 qc.shape[1], full_sq, k.shape[2])
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(qc.dtype), v,
                       preferred_element_type=jnp.float32).astype(qc.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _xla_attention(q, k, v, bias_kv, causal, scale):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _xla_attention(q, k, v, bias_kv, seed, causal, scale, rate=0.0):
     b, h, sq, d = q.shape
     chunk = _q_chunk(q, k)
     if chunk == sq:
-        return _xla_attn_chunk(q, k, v, bias_kv, causal, scale, 0, sq)
+        return _xla_attn_chunk(q, k, v, bias_kv, causal, scale, 0, sq,
+                               seed, rate)
     n = sq // chunk
     qs = jnp.moveaxis(q.reshape(b, h, n, chunk, d), 2, 0)
     offs = jnp.arange(n, dtype=jnp.int32) * chunk
 
     def body(args):
         qc, off = args
-        return _xla_attn_chunk(qc, k, v, bias_kv, causal, scale, off, sq)
+        return _xla_attn_chunk(qc, k, v, bias_kv, causal, scale, off, sq,
+                               seed, rate)
 
     out = jax.lax.map(body, (qs, offs))            # [n,b,h,chunk,d]
     return jnp.moveaxis(out, 0, 2).reshape(b, h, sq, d)
 
 
-def _xla_attention_fwd(q, k, v, bias_kv, causal, scale):
-    return _xla_attention(q, k, v, bias_kv, causal, scale), (q, k, v, bias_kv)
+def _xla_attention_fwd(q, k, v, bias_kv, seed, causal, scale, rate):
+    return (_xla_attention(q, k, v, bias_kv, seed, causal, scale, rate),
+            (q, k, v, bias_kv, seed))
 
 
-def _xla_chunk_grads(qc, k, v, bias_kv, causal, scale, doc, off, full_sq):
-    """Per-q-chunk cotangents: dq chunk + f32 partials of dk/dv/dbias."""
+def _xla_chunk_grads(qc, k, v, bias_kv, causal, scale, doc, off, full_sq,
+                     seed=None, rate=0.0):
+    """Per-q-chunk cotangents: dq chunk + f32 partials of dk/dv/dbias.
+    Recomputes the (identical, position-keyed) dropout mask: with
+    pd = m*p the vjp is dv = pd^T do, dp = m*(do v^T),
+    ds = p*(dp - <p,dp>)."""
     p = jax.nn.softmax(
         _xla_scores(qc, k, bias_kv, causal, scale, off, full_sq), axis=-1)
-    pb = p.astype(qc.dtype)
+    if rate > 0.0:
+        m = _attn_keep_scale(seed, rate, p.shape, off, 0,
+                             qc.shape[1], full_sq, k.shape[2])
+        pd = p * m
+    else:
+        m, pd = None, p
+    pb = pd.astype(qc.dtype)
     dof = doc.astype(qc.dtype)
     dv_p = jnp.einsum("bhqk,bhqd->bhkd", pb, dof,
                       preferred_element_type=jnp.float32)
     dp = jnp.einsum("bhqd,bhkd->bhqk", dof, v,
                     preferred_element_type=jnp.float32)
+    if m is not None:
+        dp = dp * m
     ds = p * (dp - jnp.sum(p * dp, axis=-1, keepdims=True))  # f32
     dsb = ds.astype(qc.dtype)
     dq = (jnp.einsum("bhqk,bhkd->bhqd", dsb, k,
@@ -150,15 +250,15 @@ def _xla_chunk_grads(qc, k, v, bias_kv, causal, scale, doc, off, full_sq):
     return dq, dk_p, dv_p, db_p
 
 
-def _xla_attention_bwd(causal, scale, res, do):
-    q, k, v, bias_kv = res
+def _xla_attention_bwd(causal, scale, rate, res, do):
+    q, k, v, bias_kv, seed = res
     b, h, sq, d = q.shape
     chunk = _q_chunk(q, k)
     if chunk == sq:
         dq, dk_p, dv_p, db_p = _xla_chunk_grads(
-            q, k, v, bias_kv, causal, scale, do, 0, sq)
+            q, k, v, bias_kv, causal, scale, do, 0, sq, seed, rate)
         dbias = None if db_p is None else db_p.astype(bias_kv.dtype)
-        return dq, dk_p.astype(k.dtype), dv_p.astype(v.dtype), dbias
+        return dq, dk_p.astype(k.dtype), dv_p.astype(v.dtype), dbias, None
 
     n = sq // chunk
     qs = jnp.moveaxis(q.reshape(b, h, n, chunk, d), 2, 0)
@@ -173,14 +273,14 @@ def _xla_attention_bwd(causal, scale, res, do):
         qc, doc, off = args
         dk_a, dv_a, db_a = acc
         dq, dk_p, dv_p, db_p = _xla_chunk_grads(
-            qc, k, v, bias_kv, causal, scale, doc, off, sq)
+            qc, k, v, bias_kv, causal, scale, doc, off, sq, seed, rate)
         db_a = db_a + db_p if bias_kv is not None else db_a
         return (dk_a + dk_p, dv_a + dv_p, db_a), dq
 
     (dk_a, dv_a, db_a), dqs = jax.lax.scan(step, acc0, (qs, dos, offs))
     dq = jnp.moveaxis(dqs, 0, 2).reshape(b, h, sq, d)
     dbias = None if bias_kv is None else db_a.astype(bias_kv.dtype)
-    return dq, dk_a.astype(k.dtype), dv_a.astype(v.dtype), dbias
+    return dq, dk_a.astype(k.dtype), dv_a.astype(v.dtype), dbias, None
 
 
 _xla_attention.defvjp(_xla_attention_fwd, _xla_attention_bwd)
@@ -190,9 +290,9 @@ _xla_attention.defvjp(_xla_attention_fwd, _xla_attention_bwd)
 # forward kernel
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k,
-                causal_offset=0):
+                causal_offset=0, rate=0.0, n_heads=1, sq_g=1, sk_g=1):
     from jax.experimental import pallas as pl
 
     j = pl.program_id(2)
@@ -226,9 +326,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
     m_new = jnp.maximum(m_prev, m_cur)
     p = jnp.exp(s - m_new)                         # (bq, bk)
     alpha = jnp.exp(m_prev - m_new)
+    # dropout multiplies the NORMALISED probs, so l accumulates the
+    # unmasked p while only the acc contribution is masked:
+    # out = sum(m*p~, v) / sum(p~)
+    if rate > 0.0:
+        mt = _keep_scale_tile(seed_ref[0], rate, pl.program_id(0), n_heads,
+                              pl.program_id(1) * block_q, j * block_k,
+                              block_q, block_k, sq_g, sk_g)
+        pa = p * mt
+    else:
+        pa = p
     l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
     acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
-        p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+        pa.astype(v.dtype), v, preferred_element_type=jnp.float32)
     m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
     l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
@@ -241,7 +351,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
                          + jnp.log(jnp.maximum(l_scr[:, :1], 1e-30)))[:, 0]
 
 
-def _fwd_pallas(q, k, v, bias_kv, causal, scale, interpret):
+def _seed_spec(pl, pltpu):
+    """SMEM spec for the (1,) uint32 dropout seed."""
+    return pl.BlockSpec((1,), lambda *_: (0,), memory_space=pltpu.SMEM)
+
+
+def _fwd_pallas(q, k, v, bias_kv, causal, scale, interpret,
+                seed=None, rate=0.0):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -254,6 +370,7 @@ def _fwd_pallas(q, k, v, bias_kv, causal, scale, interpret):
     k3 = k.reshape(bh, sk, d)
     v3 = v.reshape(bh, sk, d)
     grid = (bh, sq // bq, sk // bk)
+    seed_arr = jnp.asarray([0 if seed is None else seed], jnp.uint32)
 
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda bi, i, j: (bi, i, 0)),
@@ -268,6 +385,8 @@ def _fwd_pallas(q, k, v, bias_kv, causal, scale, interpret):
         kernel = _fwd_kernel
     else:
         kernel = functools.partial(_bias_none_wrap, _fwd_kernel, n_in=3)
+    in_specs.append(_seed_spec(pl, pltpu))
+    args.append(seed_arr)
 
     out_shape = [
         jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
@@ -284,7 +403,8 @@ def _fwd_pallas(q, k, v, bias_kv, causal, scale, interpret):
     ]
     o3, lse = pl.pallas_call(
         functools.partial(kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk, causal_offset=sk - sq),
+                          block_q=bq, block_k=bk, causal_offset=sk - sq,
+                          rate=rate, n_heads=h, sq_g=sq, sk_g=sk),
         grid=grid, in_specs=in_specs, out_specs=out_specs,
         out_shape=out_shape, scratch_shapes=scratch,
         interpret=interpret)(*args)
@@ -302,8 +422,9 @@ def _bias_none_wrap(kernel, *refs, n_in, **kw):
 # ---------------------------------------------------------------------------
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
-                dk_ref, dv_ref, dbias_ref, dk_scr, dv_scr, db_scr, *,
-                scale, causal, block_q, block_k, causal_offset=0):
+                seed_ref, dk_ref, dv_ref, dbias_ref, dk_scr, dv_scr, db_scr,
+                *, scale, causal, block_q, block_k, causal_offset=0,
+                rate=0.0, n_heads=1, sq_g=1, sk_g=1):
     from jax.experimental import pallas as pl
 
     i = pl.program_id(2)                      # q block (innermost)
@@ -335,11 +456,22 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
                                                       (block_q, block_k), 1)
         s = jnp.where(rows >= cols, s, NEG_INF)
     p = jnp.exp(s - lse)                      # (bq, bk) fp32
-    dv_scr[:] += jax.lax.dot_general(p.astype(do.dtype), do,
+    # recomputed dropout: pd = m*p feeds dv; dp is masked before the
+    # softmax vjp (delta = sum_k pd*dp already carries the mask)
+    if rate > 0.0:
+        mt = _keep_scale_tile(seed_ref[0], rate, pl.program_id(0), n_heads,
+                              i * block_q, pl.program_id(1) * block_k,
+                              block_q, block_k, sq_g, sk_g)
+        pd_ = p * mt
+    else:
+        mt, pd_ = None, p
+    dv_scr[:] += jax.lax.dot_general(pd_.astype(do.dtype), do,
                                      (((0,), (0,)), ((), ())),
                                      preferred_element_type=jnp.float32)
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
+    if mt is not None:
+        dp = dp * mt
     ds_nos = p * (dp - delta)                 # cotangent of post-bias logits
     ds = ds_nos * scale                       # (bq, bk)
     if db_scr is not None:
@@ -357,8 +489,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
-               dq_ref, dq_scr, *, scale, causal, block_q, block_k,
-               causal_offset=0):
+               seed_ref, dq_ref, dq_scr, *, scale, causal, block_q, block_k,
+               causal_offset=0, rate=0.0, n_heads=1, sq_g=1, sk_g=1):
     from jax.experimental import pallas as pl
 
     j = pl.program_id(2)                      # kv block (innermost)
@@ -389,6 +521,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
     p = jnp.exp(s - lse)
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
+    if rate > 0.0:
+        dp = dp * _keep_scale_tile(
+            seed_ref[0], rate, pl.program_id(0), n_heads,
+            pl.program_id(1) * block_q, j * block_k,
+            block_q, block_k, sq_g, sk_g)
     ds = p * (dp - delta) * scale
     dq_scr[:] += jax.lax.dot(ds.astype(k.dtype), k,
                              preferred_element_type=jnp.float32)
@@ -398,7 +535,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _bwd_pallas(q, k, v, bias_kv, causal, scale, interpret, o, lse, do):
+def _bwd_pallas(q, k, v, bias_kv, causal, scale, interpret, o, lse, do,
+                seed=None, rate=0.0):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -414,6 +552,7 @@ def _bwd_pallas(q, k, v, bias_kv, causal, scale, interpret, o, lse, do):
     lse3 = lse.reshape(bh, 1, sq)
     bias3 = (None if bias_kv is None
              else bias_kv.reshape(bias_kv.shape[0], 1, bias_kv.shape[1]))
+    seed_arr = jnp.asarray([0 if seed is None else seed], jnp.uint32)
 
     def specs(maps):
         return [pl.BlockSpec(shape, m) for shape, m in maps]
@@ -432,7 +571,7 @@ def _bwd_pallas(q, k, v, bias_kv, causal, scale, interpret, o, lse, do):
     ])
     args = list(common_args)
     kw = dict(scale=scale, causal=causal, block_q=bq, block_k=bk,
-              causal_offset=sk - sq)
+              causal_offset=sk - sq, rate=rate, n_heads=h, sq_g=sq, sk_g=sk)
     out_specs = [pl.BlockSpec((1, bk, d), lambda bi, j, i: (bi, j, 0)),
                  pl.BlockSpec((1, bk, d), lambda bi, j, i: (bi, j, 0))]
     out_shape = [jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
@@ -443,6 +582,8 @@ def _bwd_pallas(q, k, v, bias_kv, causal, scale, interpret, o, lse, do):
         in_specs.append(pl.BlockSpec((1, 1, bk),
                                      lambda bi, j, i, _h=h: (bi // _h, 0, j)))
         args.append(bias3)
+        in_specs.append(_seed_spec(pl, pltpu))
+        args.append(seed_arr)
         # per-(b,h) dbias accumulates over q blocks; summed over h outside
         out_specs.append(pl.BlockSpec((1, 1, bk),
                                       lambda bi, j, i: (bi, 0, j)))
@@ -450,8 +591,11 @@ def _bwd_pallas(q, k, v, bias_kv, causal, scale, interpret, o, lse, do):
         scratch.append(pltpu.VMEM((1, bk), jnp.float32))
         kernel = functools.partial(_dkv_kernel, **kw)
     else:
-        def kernel(q, k, v, do, lse, delta, dk, dv, dks, dvs):
-            _dkv_kernel(q, k, v, do, lse, delta, None, dk, dv, None,
+        in_specs.append(_seed_spec(pl, pltpu))
+        args.append(seed_arr)
+
+        def kernel(q, k, v, do, lse, delta, seed, dk, dv, dks, dvs):
+            _dkv_kernel(q, k, v, do, lse, delta, None, seed, dk, dv, None,
                         dks, dvs, None, **kw)
     outs = pl.pallas_call(
         kernel,
@@ -485,9 +629,12 @@ def _bwd_pallas(q, k, v, bias_kv, causal, scale, interpret, o, lse, do):
         kernel = _dq_kernel
     else:
         kernel = functools.partial(_bias_none_wrap, _dq_kernel, n_in=6)
+    in_specs.append(_seed_spec(pl, pltpu))
+    args.append(seed_arr)
     dq3 = pl.pallas_call(
         functools.partial(kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk, causal_offset=sk - sq),
+                          block_q=bq, block_k=bk, causal_offset=sk - sq,
+                          rate=rate, n_heads=h, sq_g=sq, sk_g=sk),
         grid=(bh, sq // bq, sk // bk),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, bq, d), lambda bi, i, j: (bi, i, 0)),
@@ -503,24 +650,26 @@ def _bwd_pallas(q, k, v, bias_kv, causal, scale, interpret, o, lse, do):
 # custom_vjp wrapper + public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash(q, k, v, bias_kv, causal, scale, interpret):
-    o, _ = _fwd_pallas(q, k, v, bias_kv, causal, scale, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash(q, k, v, bias_kv, seed, causal, scale, interpret, rate=0.0):
+    o, _ = _fwd_pallas(q, k, v, bias_kv, causal, scale, interpret,
+                       seed, rate)
     return o
 
 
-def _flash_fwd(q, k, v, bias_kv, causal, scale, interpret):
-    o, lse = _fwd_pallas(q, k, v, bias_kv, causal, scale, interpret)
-    return o, (q, k, v, bias_kv, o, lse)
+def _flash_fwd(q, k, v, bias_kv, seed, causal, scale, interpret, rate):
+    o, lse = _fwd_pallas(q, k, v, bias_kv, causal, scale, interpret,
+                         seed, rate)
+    return o, (q, k, v, bias_kv, seed, o, lse)
 
 
-def _flash_bwd(causal, scale, interpret, res, do):
-    q, k, v, bias_kv, o, lse = res
+def _flash_bwd(causal, scale, interpret, rate, res, do):
+    q, k, v, bias_kv, seed, o, lse = res
     dq, dk, dv, dbias = _bwd_pallas(q, k, v, bias_kv, causal, scale,
-                                    interpret, o, lse, do)
+                                    interpret, o, lse, do, seed, rate)
     if dbias is not None:
         dbias = dbias.astype(bias_kv.dtype)
-    return dq, dk, dv, dbias
+    return dq, dk, dv, dbias, None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -581,11 +730,16 @@ def _impl_choice(q, k):
     return "pallas" if scores_bytes >= PALLAS_MIN_SCORES_BYTES else "xla"
 
 
-def flash_attention(q, k, v, bias=None, causal=False, scale=None):
+def flash_attention(q, k, v, bias=None, causal=False, scale=None,
+                    dropout_rate=0.0, dropout_seed=None):
     """softmax(q k^T * scale + bias) v, O(S)-memory in the backward.
 
     q [B,H,Sq,D]; k,v [B,H,Sk,D]; bias None or broadcastable to
     [B,1,1,Sk] (key padding mask) or exactly [B,Sk].
+    dropout_rate>0 applies attention-probs dropout (reference recipe's
+    attention_probs_dropout_prob, upscale_in_train) via the position-keyed
+    stateless mask — recomputed bit-identically in every backward, no mask
+    storage. dropout_seed: uint32 scalar (vary per step for fresh masks).
 
     Two fused implementations (both save only q/k/v/bias for backward):
       * 'xla' — plain XLA attention + recompute-backward custom_vjp;
@@ -601,6 +755,9 @@ def flash_attention(q, k, v, bias=None, causal=False, scale=None):
 
     d = q.shape[-1]
     scale = float(scale) if scale is not None else 1.0 / float(np.sqrt(d))
+    rate = float(dropout_rate or 0.0)
+    seed = jnp.asarray(0 if dropout_seed is None else dropout_seed,
+                       jnp.uint32)
 
     bias_kv = None
     if bias is not None:
@@ -610,13 +767,15 @@ def flash_attention(q, k, v, bias=None, causal=False, scale=None):
             else (bias if bias.ndim == 2 else None)
         if bias_kv is None:
             # general bias → reference path
-            return reference_attention(q, k, v, bias, causal, scale)
+            return reference_attention(q, k, v, bias, causal, scale,
+                                       rate, seed)
 
     mode = kernel_mode()
     if mode == "off":
-        return reference_attention(q, k, v, bias_kv, causal, scale)
+        return reference_attention(q, k, v, bias_kv, causal, scale,
+                                   rate, seed)
     if mode == "tpu" and _impl_choice(q, k) == "xla":
-        return _xla_attention(q, k, v, bias_kv, causal, scale)
+        return _xla_attention(q, k, v, bias_kv, seed, causal, scale, rate)
     if not _supported(q, k, bias_kv):
         import os
         import warnings
@@ -631,14 +790,17 @@ def flash_attention(q, k, v, bias=None, causal=False, scale=None):
         # pallas tiling unsupported: prefer the O(S)-residual XLA
         # recompute path on TPU over the probs-saving reference path
         if mode == "tpu":
-            return _xla_attention(q, k, v, bias_kv, causal, scale)
-        return reference_attention(q, k, v, bias_kv, causal, scale)
+            return _xla_attention(q, k, v, bias_kv, seed, causal, scale,
+                                  rate)
+        return reference_attention(q, k, v, bias_kv, causal, scale,
+                                   rate, seed)
 
     # pad head dim only when it breaks sublane tiling (block covers the
     # whole d, so any multiple of 8 is legal; zero pads don't change
     # scores and padded v columns are sliced off)
     dpad = d if d % 8 == 0 else int(np.ceil(d / 8) * 8)
     qp, kp, vp = (_pad_head_dim(t, dpad) for t in (q, k, v))
-    out = _flash(qp, kp, vp, bias_kv, causal, scale, mode == "interpret")
+    out = _flash(qp, kp, vp, bias_kv, seed, causal, scale,
+                 mode == "interpret", rate)
     return out[..., :d]
 

@@ -23,13 +23,18 @@ NEG_INF = -1e30
 
 
 def ring_attention(q, k, v, bias_kv=None, causal=False, scale=None,
-                   axis_name: str = "sp"):
+                   axis_name: str = "sp", dropout_rate=0.0,
+                   dropout_seed=None):
     """softmax(q k^T * scale + bias) v with q/k/v sequence-sharded over
     `axis_name`.
 
     q, k, v: local shards [B, H, S_local, D] (global S = n * S_local).
     bias_kv: local additive key-bias shard [B, S_local] (e.g. padding mask);
         rotates around the ring together with its kv shard.
+    dropout_rate>0 applies attention-probs dropout with the GLOBAL
+    position-keyed mask (ops/pallas/flash_attention._attn_keep_scale), so
+    the masked result is bit-identical to the unsharded fused paths for
+    the same seed — sp sharding never changes training numerics.
     Returns the local output shard [B, H, S_local, D].
     """
     import jax
@@ -38,12 +43,15 @@ def ring_attention(q, k, v, bias_kv=None, causal=False, scale=None,
 
     d = q.shape[-1]
     scale = float(scale) if scale is not None else 1.0 / float(np.sqrt(d))
+    rate = float(dropout_rate or 0.0)
 
     if not _in_spmd(axis_name):
         from ..ops.pallas.flash_attention import flash_attention
 
         bias = None if bias_kv is None else bias_kv[:, None, None, :]
-        return flash_attention(q, k, v, bias=bias, causal=causal, scale=scale)
+        return flash_attention(q, k, v, bias=bias, causal=causal,
+                               scale=scale, dropout_rate=rate,
+                               dropout_seed=dropout_seed)
 
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
@@ -73,9 +81,20 @@ def ring_attention(q, k, v, bias_kv=None, causal=False, scale=None,
         m_new = jnp.maximum(m, m_cur)
         p = jnp.exp(s - m_new[..., None])
         alpha = jnp.exp(m - m_new)
+        # dropout masks only the value contribution (post-softmax
+        # semantics): l sums the unmasked p so out = sum(mask*p~,v)/sum(p~)
+        if rate > 0.0:
+            from ..ops.pallas.flash_attention import _attn_keep_scale
+
+            seed = jnp.uint32(0) if dropout_seed is None else dropout_seed
+            mt = _attn_keep_scale(seed, rate, p.shape, idx * sl, src * skl,
+                                  h, n * sl, n * skl)
+            pa = p * mt
+        else:
+            pa = p
         l_new = alpha * l + jnp.sum(p, axis=-1)
         acc_new = acc * alpha[..., None] + jnp.einsum(
-            "bhqk,bhkd->bhqd", p.astype(v_c.dtype), v_c,
+            "bhqk,bhkd->bhqd", pa.astype(v_c.dtype), v_c,
             preferred_element_type=jnp.float32)
         k_c = lax.ppermute(k_c, axis_name, perm)
         v_c = lax.ppermute(v_c, axis_name, perm)

@@ -62,6 +62,46 @@ class TestRingAttentionFn:
         ref = reference_attention(q, k, v)
         np.testing.assert_allclose(out, ref, atol=2e-5)
 
+    def test_dropout_sharding_invariant(self):
+        """Attention-probs dropout is keyed on GLOBAL positions, so the
+        4-way sp-sharded result (and grads) must equal the unsharded
+        reference with the same seed — sequence sharding never changes
+        training numerics (VERDICT r2 #3)."""
+        from paddle_tpu.parallel.api import get_shard_map
+        from paddle_tpu.parallel.ring_attention import ring_attention
+        from paddle_tpu.ops.pallas.flash_attention import reference_attention
+
+        shard_map, kw = get_shard_map()
+        mesh = _sp_mesh(4)
+        rate, seed = 0.25, jnp.uint32(99)
+        q, k, v = (_rand(2, 2, 64, 16, seed=s) for s in range(3))
+        bias = jnp.asarray(
+            ((np.random.RandomState(3).rand(2, 64) < 0.2) * -10000.0)
+            .astype(np.float32))
+        spec = P(None, None, "sp", None)
+        f = shard_map(
+            lambda q, k, v, b: ring_attention(q, k, v, bias_kv=b,
+                                              dropout_rate=rate,
+                                              dropout_seed=seed),
+            mesh=mesh, in_specs=(spec, spec, spec, P(None, "sp")),
+            out_specs=spec, **kw)
+        out = f(q, k, v, bias)
+        ref = reference_attention(q, k, v, bias_kv=bias,
+                                  dropout_rate=rate, dropout_seed=seed)
+        assert float(jnp.max(jnp.abs(
+            ref - reference_attention(q, k, v, bias_kv=bias)))) > 1e-3
+        np.testing.assert_allclose(out, ref, atol=3e-5)
+
+        g1 = jax.grad(lambda q, k, v: jnp.sum(f(q, k, v, bias) ** 2),
+                      argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(
+            lambda q, k, v: jnp.sum(
+                reference_attention(q, k, v, bias_kv=bias, dropout_rate=rate,
+                                    dropout_seed=seed) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(a, b, atol=5e-5)
+
 
 class TestSequenceParallelBert:
     def test_sp_training_matches_dense(self):

@@ -101,7 +101,7 @@ def main():
     os.environ["PT_FLASH_IMPL"] = "auto"
     bench_impl("xla-rcmp",
                lambda x, kk, vv: fa._xla_attention(
-                   x, kk, vv, bias, causal, scale),
+                   x, kk, vv, bias, jnp.uint32(0), causal, scale),
                q, k, v, causal, fwd_flops, bwd_flops)
     bench_impl("xla-ref",
                lambda x, kk, vv: fa.reference_attention(x, kk, vv, bias,
