@@ -54,6 +54,29 @@ class SelectedRows:
                 f"nrows={self.values.shape[0]})")
 
 
+def merge_duplicates(sr: "SelectedRows"):
+    """Reference merge step (operators/math/selected_rows_functor.cc
+    MergeAdd) under static shapes: sort rows, sum each duplicate group's
+    values into its first slot. Returns (rows_u [N] int32, values_u
+    [N, D]) where unused (duplicate) slots carry row id == height — a
+    sentinel consumers scatter with mode='drop' and mask on gather.
+    Needed because moment-based optimizers must see each touched row's
+    TOTAL gradient once, not one partial update per occurrence."""
+    import jax.numpy as jnp
+
+    rows, values = sr.rows, sr.values
+    n = rows.shape[0]
+    order = jnp.argsort(rows)
+    sr_rows = rows[order]
+    sr_vals = values[order]
+    first = jnp.concatenate([jnp.ones((1,), bool),
+                             sr_rows[1:] != sr_rows[:-1]])
+    seg = jnp.cumsum(first.astype(jnp.int32)) - 1       # [N] group index
+    values_u = jnp.zeros_like(sr_vals).at[seg].add(sr_vals)
+    rows_u = jnp.full((n,), sr.height, sr_rows.dtype).at[seg].set(sr_rows)
+    return rows_u, values_u
+
+
 def concat(parts):
     """Gradient accumulation of SelectedRows = row concatenation
     (reference: the SelectedRows branch of sum_op.cc; duplicates merge

@@ -26,14 +26,9 @@ def _attn_dropout(attrs):
     import jax
     import jax.numpy as jnp
 
-    key = jax.random.PRNGKey(int(attrs.get("seed", 0) or 0))
-    step = attrs.get("__step__")
-    if step is not None:
-        key = jax.random.fold_in(key, step)
-    try:
-        key = jax.random.fold_in(key, jax.lax.axis_index("dp"))
-    except Exception:
-        pass
+    from .tensor_ops import _rng_key
+
+    key = _rng_key(attrs, axes=("dp",))
     kd = jnp.asarray(jax.random.key_data(key)).reshape(-1).astype(jnp.uint32)
     return rate, kd[0] ^ kd[-1]
 

@@ -26,10 +26,23 @@ _OPT = dict(non_diff_inputs=("Param", "Grad", "LearningRate", "Moment", "Moment1
 def _dense_grad(g):
     """Optimizers without a dedicated SelectedRows kernel densify the
     sparse grad (the reference's fallback for ops lacking a
-    SelectedRows specialisation; sgd has the real sparse path)."""
+    SelectedRows specialisation; sgd/momentum/adam/adamw have real
+    sparse paths)."""
     from ..core.selected_rows import SelectedRows
 
     return g.to_dense() if isinstance(g, SelectedRows) else g
+
+
+def _sparse_rows(g):
+    """Duplicate-merged (rows_u, values_u, valid) for a SelectedRows grad,
+    or None for dense grads. valid masks the live slots; dead slots carry
+    row id == height so scatter writes drop them (mode='drop')."""
+    from ..core.selected_rows import SelectedRows, merge_duplicates
+
+    if not isinstance(g, SelectedRows):
+        return None
+    rows_u, values_u = merge_duplicates(g)
+    return rows_u, values_u, rows_u < g.height
 
 
 @register_op("sgd", **_OPT)
@@ -49,14 +62,40 @@ def sgd(ins, attrs):
 
 @register_op("momentum", **_OPT)
 def momentum(ins, attrs):
-    ins = dict(ins, Grad=[_dense_grad(ins["Grad"][0])])
+    """reference: momentum_op.h MomentumFunctor + its SparseMomentum
+    branch: a SelectedRows grad updates velocity/param only on the
+    touched rows (untouched velocities do not decay — the reference's
+    sparse kernel semantics)."""
+    sp = _sparse_rows(ins["Grad"][0])
+    mu32 = attrs.get("mu", 0.9)
+    rd = attrs.get("regularization_coeff", 0.0)
+    l2 = attrs.get("regularization_method", "") == "l2_decay" and rd
+    if sp is not None:
+        import jax.numpy as jnp
+
+        rows, gv, valid = sp
+        p, v, lr = ins["Param"][0], ins["Velocity"][0], ins["LearningRate"][0]
+        mu = np.asarray(mu32, p.dtype)
+        lr = lr.astype(p.dtype).reshape(())
+        rows_c = jnp.where(valid, rows, 0)
+        p_r = p[rows_c]
+        g_r = gv.astype(p.dtype)
+        if l2:
+            g_r = g_r + np.asarray(rd, p.dtype) * p_r
+        v_r = mu * v[rows_c] + g_r
+        if attrs.get("use_nesterov", False):
+            p_new = p_r - (g_r + mu * v_r) * lr
+        else:
+            p_new = p_r - lr * v_r
+        return {"ParamOut": p.at[rows].set(p_new, mode="drop"),
+                "VelocityOut": v.at[rows].set(v_r.astype(v.dtype),
+                                              mode="drop")}
     p, g, v, lr = (ins["Param"][0], ins["Grad"][0], ins["Velocity"][0],
                    ins["LearningRate"][0])
-    mu = np.asarray(attrs.get("mu", 0.9), p.dtype)
+    mu = np.asarray(mu32, p.dtype)
     g = g.astype(p.dtype)
     lr = lr.astype(p.dtype)
-    rd = attrs.get("regularization_coeff", 0.0)
-    if attrs.get("regularization_method", "") == "l2_decay" and rd:
+    if l2:
         g = g + np.asarray(rd, p.dtype) * p
     v_out = mu * v + g
     if attrs.get("use_nesterov", False):
@@ -66,9 +105,46 @@ def momentum(ins, attrs):
     return {"ParamOut": p_out, "VelocityOut": v_out}
 
 
+def _sparse_adam(ins, attrs, sp, coeff=0.0):
+    """Row-wise Adam(W) on a merged SelectedRows grad (reference
+    SparseAdamFunctor lazy_mode, operators/optimizers/adam_op.h:404):
+    gather the touched rows' state, update, scatter back — never
+    materialising a [V, D] dense gradient or a full-table moment pass."""
+    import jax.numpy as jnp
+
+    rows, gv, valid = sp
+    p, lr = ins["Param"][0], ins["LearningRate"][0]
+    m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    b1 = np.asarray(attrs.get("beta1", 0.9), np.float32)
+    b2 = np.asarray(attrs.get("beta2", 0.999), np.float32)
+    eps = np.asarray(attrs.get("epsilon", 1e-8), np.float32)
+    rows_c = jnp.where(valid, rows, 0)
+    gf = gv.astype(m1.dtype)
+    m1n = b1 * m1[rows_c] + (1 - b1) * gf
+    m2n = b2 * m2[rows_c] + (1 - b2) * gf * gf
+    p_r = p[rows_c].astype(jnp.float32)
+    lr_t = (lr * jnp.sqrt(1 - b2p) / (1 - b1p)).reshape(())
+    step = lr_t * m1n / (jnp.sqrt(m2n) + eps)
+    if coeff:
+        step = step + lr.reshape(()) * np.float32(coeff) * p_r
+    p_new = (p_r - step).astype(p.dtype)
+    return {"ParamOut": p.at[rows].set(p_new, mode="drop"),
+            "Moment1Out": m1.at[rows].set(m1n.astype(m1.dtype),
+                                          mode="drop"),
+            "Moment2Out": m2.at[rows].set(m2n.astype(m2.dtype),
+                                          mode="drop"),
+            "Beta1PowOut": b1p * b1, "Beta2PowOut": b2p * b2}
+
+
 @register_op("adam", **_OPT)
 def adam(ins, attrs):
-    """reference: operators/optimizers/adam_op.h AdamFunctor."""
+    """reference: operators/optimizers/adam_op.h AdamFunctor (+ the
+    SparseAdamFunctor lazy_mode row-wise branch)."""
+    if attrs.get("lazy_mode", False):
+        sp = _sparse_rows(ins["Grad"][0])
+        if sp is not None:
+            return _sparse_adam(ins, attrs, sp)
     ins = dict(ins, Grad=[_dense_grad(ins["Grad"][0])])
     import jax.numpy as jnp
 
@@ -90,6 +166,13 @@ def adam(ins, attrs):
 
 @register_op("adamw", **_OPT)
 def adamw(ins, attrs):
+    if attrs.get("lazy_mode", False):
+        sp = _sparse_rows(ins["Grad"][0])
+        if sp is not None:
+            return _sparse_adam(
+                ins, attrs, sp,
+                coeff=float(attrs.get("coeff", 0.01))
+                if attrs.get("with_decay", True) else 0.0)
     ins = dict(ins, Grad=[_dense_grad(ins["Grad"][0])])
     import jax.numpy as jnp
 

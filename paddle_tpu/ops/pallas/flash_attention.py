@@ -73,11 +73,28 @@ def _keep_scale_from_lin(lin, seed2, rate):
                      jnp.float32(0.0))
 
 
+def _warn_lattice_wrap(sq_g, sk_g):
+    """The (q, k) lattice is uint32: above 64k global sequence length
+    q*Sk+k wraps and mask bits alias across q rows. Warn once — dropout
+    still runs, but with correlated (non-i.i.d.) positions."""
+    if float(sq_g) * float(sk_g) >= 4294967296.0 and \
+            not getattr(_warn_lattice_wrap, "_done", False):
+        import warnings
+
+        _warn_lattice_wrap._done = True
+        warnings.warn(
+            f"attention dropout lattice {sq_g}x{sk_g} exceeds 2^32: mask "
+            f"bits alias across query rows (correlated dropout). Global "
+            f"sequence lengths above 64k need a 64-bit lattice.",
+            stacklevel=3)
+
+
 def _attn_keep_scale(seed, rate, shape, q_off, k_off, n_heads, sq_g, sk_g):
     """f32 multiplier tensor over `shape` = (b, h, cq, ck): keep/(1-rate)
     or 0. seed uint32 scalar (may be traced); q_off/k_off global offsets
     of this tile; sq_g/sk_g the GLOBAL sequence extents (lattice strides —
     they must agree across shards for mask coherence)."""
+    _warn_lattice_wrap(sq_g, sk_g)
     U = jnp.uint32
     b, h = shape[0], shape[1]
     bh = (jax.lax.broadcasted_iota(U, (b, h, 1, 1), 0) * U(n_heads)
@@ -800,6 +817,8 @@ def flash_attention(q, k, v, bias=None, causal=False, scale=None,
     # scores and padded v columns are sliced off)
     dpad = d if d % 8 == 0 else int(np.ceil(d / 8) * 8)
     qp, kp, vp = (_pad_head_dim(t, dpad) for t in (q, k, v))
+    if rate > 0.0:
+        _warn_lattice_wrap(q.shape[2], k.shape[2])
     out = _flash(qp, kp, vp, bias_kv, seed, causal, scale,
                  mode == "interpret", rate)
     return out[..., :d]

@@ -19,7 +19,14 @@ from ..core.registry import register_grad_maker, register_op
 from ..core.types import convert_dtype
 
 
-def _rng_key(attrs):
+def _rng_key(attrs, axes=("dp", "sp")):
+    """Build-time seed + runtime step + mesh-axis decorrelation.
+
+    `axes` are the shard_map axes whose rank folds into the key — default
+    dp AND sp (elementwise masks over sharded activations must differ per
+    shard). Attention-probs dropout passes axes=("dp",) only: its mask is
+    keyed on GLOBAL positions, so sp shards of one logical batch must
+    agree. mp/pp shards replicate activations and are never folded."""
     import jax
 
     seed = int(attrs.get("seed", 0) or 0)
@@ -27,13 +34,10 @@ def _rng_key(attrs):
     step = attrs.get("__step__")
     if step is not None:
         key = jax.random.fold_in(key, step)
-    # inside a shard_map SPMD region, decorrelate random masks across the
-    # data/sequence shards (mp/pp shards replicate activations, so they are
-    # deliberately NOT folded — replicas must agree)
-    for ax in ("dp", "sp"):
+    for ax in axes:
         try:
             key = jax.random.fold_in(key, jax.lax.axis_index(ax))
-        except Exception:
+        except Exception:  # not inside an SPMD region binding this axis
             pass
     return key
 

@@ -392,6 +392,9 @@ class AdamOptimizer(Optimizer):
                  epsilon=1e-8, lazy_mode=False, **kw):
         super().__init__(learning_rate, **kw)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        # reference AdamOp lazy_mode: SelectedRows grads take the
+        # row-wise SparseAdamFunctor path (adam_op.h:404)
+        self._lazy_mode = bool(lazy_mode)
 
     def _create_accumulators(self, block, parameters):
         for p in parameters:
@@ -418,7 +421,8 @@ class AdamOptimizer(Optimizer):
             {"ParamOut": [p], "Moment1Out": [m1], "Moment2Out": [m2],
              "Beta1PowOut": [b1p], "Beta2PowOut": [b2p]},
             {"beta1": self._beta1, "beta2": self._beta2,
-             "epsilon": self._epsilon, **extra})
+             "epsilon": self._epsilon, "lazy_mode": self._lazy_mode,
+             **extra})
 
 
 class AdamWOptimizer(AdamOptimizer):

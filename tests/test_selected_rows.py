@@ -33,6 +33,10 @@ def _build(is_sparse, optimizer="sgd", two_lookups=False):
             layers.reduce_sum(h * h, dim=1, keep_dim=True))
         if optimizer == "sgd":
             pt.optimizer.SGDOptimizer(0.5).minimize(loss)
+        elif optimizer == "adam_lazy":
+            pt.optimizer.AdamOptimizer(0.01, lazy_mode=True).minimize(loss)
+        elif optimizer == "momentum":
+            pt.optimizer.MomentumOptimizer(0.1, momentum=0.9).minimize(loss)
         else:
             pt.optimizer.AdamOptimizer(0.01).minimize(loss)
     return main, startup, loss
@@ -90,6 +94,75 @@ class TestSelectedRowsGrad:
         ls, ws = _train(True, optimizer="adam")
         np.testing.assert_allclose(ls, ld, rtol=1e-5)
         np.testing.assert_allclose(ws, wd, rtol=1e-5)
+
+    def test_sparse_adam_lazy_matches_dense(self):
+        """lazy_mode Adam consumes SelectedRows row-wise (reference
+        SparseAdamFunctor, adam_op.h:404). With a fixed id set the
+        row-wise update is EXACTLY the dense update (untouched rows have
+        zero moments, so dense moves them by 0), including duplicate-id
+        merge."""
+        for dup in (False, True):
+            ld, wd = _train(False, optimizer="adam", dup_ids=dup)
+            ls, ws = _train(True, optimizer="adam_lazy", dup_ids=dup)
+            np.testing.assert_allclose(ls, ld, rtol=1e-5)
+            np.testing.assert_allclose(ws, wd, rtol=1e-5)
+
+    def test_sparse_momentum_matches_dense(self):
+        """Momentum's SelectedRows branch (reference momentum_op.h sparse
+        kernel): touched-rows-only velocity update == dense result for a
+        fixed id set (untouched velocities are zero either way)."""
+        for dup in (False, True):
+            ld, wd = _train(False, optimizer="momentum", dup_ids=dup)
+            ls, ws = _train(True, optimizer="momentum", dup_ids=dup)
+            np.testing.assert_allclose(ls, ld, rtol=1e-5)
+            np.testing.assert_allclose(ws, wd, rtol=1e-5)
+
+    def test_lazy_adam_never_materialises_dense_grad(self):
+        """Trace assert (VERDICT r2 #5): the lazy-mode sparse Adam jaxpr
+        must contain NO [V, D]-shaped value outside the three scatter
+        writes to param/moments — i.e. no densified gradient buffer and
+        no full-table moment pass."""
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.core import registry
+        from paddle_tpu.core.selected_rows import SelectedRows
+
+        V, D, N = 4096, 8, 12
+        fwd = registry.lookup("adam").forward
+
+        def step(p, rows, vals, m1, m2, b1p, b2p, lr):
+            outs = fwd({"Param": [p],
+                        "Grad": [SelectedRows(rows, vals, V)],
+                        "LearningRate": [lr], "Moment1": [m1],
+                        "Moment2": [m2], "Beta1Pow": [b1p],
+                        "Beta2Pow": [b2p]}, {"lazy_mode": True})
+            return (outs["ParamOut"], outs["Moment1Out"],
+                    outs["Moment2Out"])
+
+        args = (jnp.zeros((V, D)), jnp.zeros((N,), jnp.int32),
+                jnp.ones((N, D)), jnp.zeros((V, D)), jnp.zeros((V, D)),
+                jnp.full((1,), 0.9), jnp.full((1,), 0.999),
+                jnp.full((1,), 0.01))
+        jaxpr = jax.make_jaxpr(step)(*args)
+
+        offenders = []
+
+        def scan(jp):
+            for eqn in jp.eqns:
+                for sub in eqn.params.values():
+                    if hasattr(sub, "jaxpr"):
+                        scan(sub.jaxpr)
+                if "scatter" in eqn.primitive.name:
+                    continue
+                for out in eqn.outvars:
+                    shape = getattr(out.aval, "shape", ())
+                    if tuple(shape) == (V, D):
+                        offenders.append(eqn.primitive.name)
+
+        scan(jaxpr.jaxpr)
+        assert not offenders, \
+            f"dense [V,D] intermediates materialised by: {offenders}"
 
     def test_sparse_grad_object(self):
         """The grad reaching sgd really is SelectedRows (not a silently
