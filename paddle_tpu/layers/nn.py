@@ -913,6 +913,83 @@ def sequence_pool(input, pool_type="sum", lod=None, name=None):
     return out
 
 
+def linear_chain_crf(input, label, param_attr=None, length=None, name=None):
+    """CRF NLL layer (reference: layers/nn.py linear_chain_crf): creates
+    the [T+2, T] 'transition' parameter (rows 0/1 = start/stop weights)
+    and returns the per-sequence negative log-likelihood [B, 1].
+    input [B, S, T] emissions, label [B, S] int, length [B] optional."""
+    helper = LayerHelper("linear_chain_crf", name=name)
+    t = int(input.shape[-1])
+    trans = helper.create_parameter(param_attr or ParamAttr(),
+                                    [t + 2, t], "float32")
+    ll = helper.create_variable_for_type_inference("float32")
+    alpha = helper.create_variable_for_type_inference("float32", True)
+    ee = helper.create_variable_for_type_inference("float32", True)
+    te = helper.create_variable_for_type_inference("float32", True)
+    ins = {"Emission": [input], "Transition": [trans], "Label": [label]}
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op("linear_chain_crf", ins,
+                     {"LogLikelihood": [ll], "Alpha": [alpha],
+                      "EmissionExps": [ee], "TransitionExps": [te]}, {})
+    return ll
+
+
+def crf_decoding(input, param_attr, label=None, length=None, name=None):
+    """Viterbi decode under a trained CRF (reference: layers/nn.py
+    crf_decoding). param_attr must name the SAME transition parameter the
+    linear_chain_crf layer trained."""
+    helper = LayerHelper("crf_decoding", name=name)
+    t = int(input.shape[-1])
+    trans = helper.create_parameter(param_attr, [t + 2, t], "float32")
+    out = helper.create_variable_for_type_inference("int64", True)
+    ins = {"Emission": [input], "Transition": [trans]}
+    if label is not None:
+        ins["Label"] = [label]
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op("crf_decoding", ins, {"ViterbiPath": [out]}, {})
+    return out
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, padding_value=0,
+                       name=None):
+    """Greedy CTC decode = argmax per step + ctc_align collapse
+    (reference: layers/nn.py ctc_greedy_decoder)."""
+    helper = LayerHelper("ctc_greedy_decoder", name=name)
+    am = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op("arg_max", {"X": [input]}, {"Out": [am]},
+                     {"axis": -1, "keepdims": False})
+    out = helper.create_variable_for_type_inference("int64", True)
+    ln = helper.create_variable_for_type_inference("int32", True)
+    ins = {"Input": [am]}
+    if input_length is not None:
+        ins["InputLength"] = [input_length]
+    helper.append_op("ctc_align", ins,
+                     {"Output": [out], "OutputLength": [ln]},
+                     {"blank": int(blank),
+                      "padding_value": int(padding_value)})
+    return out, ln
+
+
+def edit_distance(input, label, normalized=True, input_length=None,
+                  label_length=None, name=None):
+    """Levenshtein distance per row (reference: layers/nn.py
+    edit_distance). Returns (distance [B,1] f32, seq_num [1])."""
+    helper = LayerHelper("edit_distance", name=name)
+    out = helper.create_variable_for_type_inference("float32", True)
+    sn = helper.create_variable_for_type_inference("int64", True)
+    ins = {"Hyps": [input], "Refs": [label]}
+    if input_length is not None:
+        ins["HypsLength"] = [input_length]
+    if label_length is not None:
+        ins["RefsLength"] = [label_length]
+    helper.append_op("edit_distance", ins,
+                     {"Out": [out], "SequenceNum": [sn]},
+                     {"normalized": bool(normalized)})
+    return out, sn
+
+
 def cos_sim(X, Y, name=None):
     helper = LayerHelper("cos_sim", name=name)
     out = helper.create_variable_for_type_inference(X.dtype)

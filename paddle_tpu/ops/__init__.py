@@ -52,6 +52,7 @@ try:
 except ImportError:
     pass
 from . import beam_search_ops  # noqa: F401
+from . import crf_ops  # noqa: F401
 from . import extra_ops2  # noqa: F401
 from . import fused_ops  # noqa: F401
 from . import interp_ops  # noqa: F401

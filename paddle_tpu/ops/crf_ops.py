@@ -1,0 +1,252 @@
+"""Sequence-labeling ops: linear-chain CRF, Viterbi decoding, CTC
+alignment, edit distance.
+
+Capability mirror of the reference's sequence-labeling family
+(operators/linear_chain_crf_op.{cc,h}, crf_decoding_op.{cc,h},
+ctc_align_op.cc, edit_distance_op.cc) under this framework's
+padded-dense sequence convention (Emission [B, S, T] + Length [B]
+instead of LoD). TPU twist: the reference's per-sequence CPU loops with
+L1-renormalised alphas become batched log-space `lax.scan` recurrences
+(logsumexp is the numerically-stable equivalent of the reference's
+NormalizeL1), and the analytic backward kernels are replaced by
+autodiff through the scan.
+
+Transition layout matches the reference exactly
+(linear_chain_crf_op.h:184): row 0 = start weights, row 1 = stop
+weights, rows 2.. = [T, T] tag-to-tag transition weights.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.registry import register_op
+
+
+def _lengths(ins, b, s):
+    import jax.numpy as jnp
+
+    ln = ins.get("Length", [None])[0]
+    if ln is None:
+        return jnp.full((b,), s, jnp.int32)
+    return ln.reshape(-1).astype(jnp.int32)
+
+
+@register_op("linear_chain_crf", non_diff_inputs=("Label", "Length"))
+def linear_chain_crf(ins, attrs):
+    """NLL of a linear-chain CRF (reference linear_chain_crf_op.h
+    ForwardOneSequence): LogLikelihood[b] = log Z_b - score(label_b),
+    the same -ll the reference returns.
+
+    Emission [B, S, T] (unnormalised tag scores), Transition [T+2, T],
+    Label [B, S] int, Length [B] (optional; default all S).
+    Outputs: LogLikelihood [B, 1]; Alpha [B, S, T] (LOG-space forward
+    variables — the reference stores L1-normalised linear-space alphas,
+    same information); EmissionExps / TransitionExps for contract parity.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    e = ins["Emission"][0].astype(jnp.float32)        # [B, S, T]
+    w = ins["Transition"][0].astype(jnp.float32)      # [T+2, T]
+    label = ins["Label"][0].astype(jnp.int32)         # [B, S]
+    b, s, t = e.shape
+    length = _lengths(ins, b, s)
+    start_w, stop_w, trans = w[0], w[1], w[2:]        # [T],[T],[T,T]
+
+    valid = (jnp.arange(s)[None, :] < length[:, None])  # [B, S]
+
+    # -- log Z via forward recurrence ------------------------------------
+    alpha0 = start_w[None, :] + e[:, 0]               # [B, T]
+
+    def step(alpha, xs):
+        e_t, v_t = xs                                  # [B,T], [B]
+        nxt = jax.nn.logsumexp(alpha[:, :, None] + trans[None], axis=1) \
+            + e_t
+        alpha = jnp.where(v_t[:, None], nxt, alpha)
+        return alpha, alpha
+
+    e_rest = jnp.moveaxis(e[:, 1:], 1, 0)             # [S-1, B, T]
+    v_rest = jnp.moveaxis(valid[:, 1:], 1, 0)         # [S-1, B]
+    alpha_last, alphas = lax.scan(step, alpha0, (e_rest, v_rest))
+    log_z = jax.nn.logsumexp(alpha_last + stop_w[None, :], axis=1)  # [B]
+
+    # -- gold-path score --------------------------------------------------
+    em_lab = jnp.take_along_axis(e, label[:, :, None], axis=2)[..., 0]
+    score = start_w[label[:, 0]] + jnp.sum(
+        jnp.where(valid, em_lab, 0.0), axis=1)
+    tr_lab = trans[label[:, :-1], label[:, 1:]]       # [B, S-1]
+    score = score + jnp.sum(jnp.where(valid[:, 1:], tr_lab, 0.0), axis=1)
+    last = jnp.maximum(length - 1, 0)
+    last_lab = jnp.take_along_axis(label, last[:, None], axis=1)[:, 0]
+    score = score + stop_w[last_lab]
+
+    # reference linear_chain_crf_op.h:152 pads 0 cost for an empty
+    # sequence (and its emissions/transitions get no gradient)
+    ll = jnp.where(length > 0, log_z - score, 0.0)     # [B] (NLL)
+    alpha_full = jnp.concatenate([alpha0[:, None], jnp.moveaxis(
+        alphas, 0, 1)], axis=1)                        # [B, S, T]
+    return {"LogLikelihood": ll[:, None],
+            "Alpha": alpha_full,
+            "EmissionExps": jnp.exp(e - jnp.max(e, -1, keepdims=True)),
+            "TransitionExps": jnp.exp(w)}
+
+
+@register_op("crf_decoding", non_diff_inputs=("Emission", "Transition",
+                                              "Label", "Length"))
+def crf_decoding(ins, attrs):
+    """Viterbi decoding (reference crf_decoding_op.h Decode): max-score
+    tag path under the trained CRF. With a Label input the output is the
+    reference's 0/1 correctness mask (1 where the Viterbi tag equals the
+    label); otherwise the tag path itself. Padded positions output 0."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    e = ins["Emission"][0].astype(jnp.float32)        # [B, S, T]
+    w = ins["Transition"][0].astype(jnp.float32)
+    b, s, t = e.shape
+    length = _lengths(ins, b, s)
+    start_w, stop_w, trans = w[0], w[1], w[2:]
+    valid = (jnp.arange(s)[None, :] < length[:, None])
+
+    a0 = start_w[None, :] + e[:, 0]                   # [B, T]
+
+    def fwd(alpha, xs):
+        e_t, v_t = xs
+        cand = alpha[:, :, None] + trans[None]        # [B, T, T]
+        best = jnp.max(cand, axis=1) + e_t
+        arg = jnp.argmax(cand, axis=1).astype(jnp.int32)
+        alpha = jnp.where(v_t[:, None], best, alpha)
+        return alpha, arg                              # arg: [B, T]
+
+    e_rest = jnp.moveaxis(e[:, 1:], 1, 0)
+    v_rest = jnp.moveaxis(valid[:, 1:], 1, 0)
+    alpha_last, back = lax.scan(fwd, a0, (e_rest, v_rest))  # back [S-1,B,T]
+
+    last_tag = jnp.argmax(alpha_last + stop_w[None, :],
+                          axis=1).astype(jnp.int32)   # [B]
+
+    # backtrack from each row's (length-1) position: walk the pointer
+    # chain right-to-left, freezing the tag until t < length
+    def bwd(tag, xs):
+        ptr, t_idx = xs                                # ptr [B, T]
+        prev = jnp.take_along_axis(ptr, tag[:, None], axis=1)[:, 0]
+        # ptr points from position t_idx+1 back to t_idx; only steps
+        # with t_idx+1 <= length-1 (inside the path) move the chain
+        move = (t_idx + 1) <= (length - 1)
+        tag = jnp.where(move, prev, tag)
+        return tag, tag
+
+    t_ids = jnp.arange(s - 1 - 1, -1, -1, dtype=jnp.int32) \
+        if s > 1 else jnp.zeros((0,), jnp.int32)
+    rev_back = back[::-1] if s > 1 else back
+    tag0, tags_rev = lax.scan(bwd, last_tag, (rev_back, t_ids))
+    if s > 1:
+        path = jnp.concatenate([tags_rev[::-1],
+                                last_tag[None]], axis=0)  # [S, B]
+        # tags_rev[i] is the tag at position t_ids[i]; after reversal,
+        # entry t holds the tag at position t for t < length-1; positions
+        # >= length-1 hold frozen values — fix by substituting last_tag
+        # at exactly length-1 and masking beyond
+        pos = jnp.arange(s)[:, None]
+        path = jnp.where(pos == (length - 1)[None, :], last_tag[None],
+                         path)
+    else:
+        path = last_tag[None]
+    path = jnp.moveaxis(path, 0, 1)                    # [B, S]
+    path = jnp.where(valid, path, 0).astype(jnp.int64)
+
+    label = ins.get("Label", [None])[0]
+    if label is not None:
+        ok = (path == label.astype(jnp.int64)) & valid
+        return {"ViterbiPath": ok.astype(jnp.int64)}
+    return {"ViterbiPath": path}
+
+
+@register_op("ctc_align", non_diff_inputs=("Input", "InputLength"))
+def ctc_align(ins, attrs):
+    """CTC greedy-path collapse (reference ctc_align_op.cc): merge
+    repeated tokens then drop blanks. Padded form: Output keeps shape
+    [B, S], left-packed, tail filled with padding_value; OutputLength
+    holds the collapsed lengths."""
+    import jax.numpy as jnp
+
+    x = ins["Input"][0].astype(jnp.int32)              # [B, S]
+    b, s = x.shape
+    blank = int(attrs.get("blank", 0))
+    pad_val = int(attrs.get("padding_value", 0))
+    length = _lengths({"Length": ins.get("InputLength", [None])}, b, s)
+    valid = (jnp.arange(s)[None, :] < length[:, None])
+
+    first = jnp.concatenate([jnp.ones((b, 1), bool),
+                             x[:, 1:] != x[:, :-1]], axis=1)
+    keep = first & (x != blank) & valid
+    dst = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1   # target slot
+    dst = jnp.where(keep, dst, s)                          # drop sentinel
+    out = jnp.full((b, s), pad_val, x.dtype)
+    out = jax_vmap_scatter(out, dst, x)
+    return {"Output": out.astype(jnp.int64),
+            "OutputLength": jnp.sum(keep, axis=1).astype(jnp.int32)
+            .reshape(b, 1)}
+
+
+def jax_vmap_scatter(out, dst, vals):
+    import jax
+
+    def one(o, d, v):
+        return o.at[d].set(v, mode="drop")
+
+    return jax.vmap(one)(out, dst, vals)
+
+
+@register_op("edit_distance", non_diff_inputs=("Hyps", "Refs",
+                                               "HypsLength", "RefsLength"))
+def edit_distance(ins, attrs):
+    """Levenshtein distance per batch row (reference
+    edit_distance_op.cc). Padded form: Hyps [B, S1], Refs [B, S2] with
+    optional *Length inputs. normalized=True divides by the reference
+    length (reference attr). Outputs Out [B, 1] f32, SequenceNum [1]."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    hyp = ins["Hyps"][0].astype(jnp.int32)
+    ref = ins["Refs"][0].astype(jnp.int32)
+    b, s1 = hyp.shape
+    s2 = ref.shape[1]
+    hl = _lengths({"Length": ins.get("HypsLength", [None])}, b, s1)
+    rl = _lengths({"Length": ins.get("RefsLength", [None])}, b, s2)
+
+    # DP over hyp positions; carry the [B, S2+1] row. Cells beyond a
+    # row's lengths are computed but masked at the end (static shapes).
+    row0 = jnp.broadcast_to(jnp.arange(s2 + 1, dtype=jnp.float32),
+                            (b, s2 + 1))
+
+    def outer(row, xs):
+        h_t, i = xs                                    # [B], scalar
+        # row' computed left-to-right: row'[0] = i+1;
+        # row'[j] = min(row[j]+1, row'[j-1]+1, row[j-1]+cost)
+        sub_cost = (ref != h_t[:, None]).astype(jnp.float32)  # [B, S2]
+        base = jnp.minimum(row[:, 1:] + 1.0, row[:, :-1] + sub_cost)
+
+        def inner(prev, xs_j):
+            base_j = xs_j                              # [B]
+            cur = jnp.minimum(base_j, prev + 1.0)
+            return cur, cur
+
+        first = jnp.broadcast_to((i + 1).astype(jnp.float32), (b,))
+        _, cols = lax.scan(inner, first, jnp.moveaxis(base, 1, 0))
+        new_row = jnp.concatenate([first[:, None],
+                                   jnp.moveaxis(cols, 0, 1)], axis=1)
+        # rows past this hyp's length keep the previous values
+        new_row = jnp.where((i < hl)[:, None], new_row, row)
+        return new_row, None
+
+    hyp_t = jnp.moveaxis(hyp, 1, 0)                    # [S1, B]
+    idxs = jnp.arange(s1, dtype=jnp.int32)
+    final, _ = lax.scan(outer, row0, (hyp_t, idxs))
+    dist = jnp.take_along_axis(final, rl[:, None], axis=1)[:, 0]
+    if bool(attrs.get("normalized", False)):
+        dist = dist / jnp.maximum(rl.astype(jnp.float32), 1.0)
+    return {"Out": dist[:, None].astype(jnp.float32),
+            "SequenceNum": jnp.asarray([b], jnp.int64)}

@@ -124,6 +124,140 @@ def pool2d(ins, attrs):
     return {"Out": out}
 
 
+@register_op("pool3d")
+def pool3d(ins, attrs):
+    """reference: operators/pool_op.cc Pool3D variant — max/avg, NCDHW."""
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    ptype = attrs.get("pooling_type", "max")
+    if attrs.get("global_pooling", False):
+        axis = (2, 3, 4)
+        out = (jnp.max(x, axis=axis, keepdims=True) if ptype == "max"
+               else jnp.mean(x, axis=axis, keepdims=True))
+        return {"Out": out}
+    ksize = tuple(attrs.get("ksize", [2, 2, 2]))
+    strides = tuple(attrs.get("strides", ksize))
+    pad = _conv_padding(attrs, spatial_rank=3)
+    padding = pad if isinstance(pad, str) else [(0, 0), (0, 0)] + list(pad)
+    window = (1, 1) + ksize
+    strides5 = (1, 1) + strides
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+            else jnp.iinfo(x.dtype).min
+        out = lax.reduce_window(x, np.asarray(init, x.dtype), lax.max,
+                                window, strides5, padding)
+    else:
+        summed = lax.reduce_window(x, np.asarray(0.0, x.dtype), lax.add,
+                                   window, strides5, padding)
+        if attrs.get("exclusive", True) and padding != "VALID":
+            counts = lax.reduce_window(
+                jnp.ones_like(x), np.asarray(0.0, x.dtype), lax.add,
+                window, strides5, padding)
+            out = summed / counts
+        else:
+            out = summed / float(np.prod(ksize))
+    return {"Out": out}
+
+
+@register_op("spectral_norm", non_diff_inputs=("U", "V"))
+def spectral_norm(ins, attrs):
+    """reference: operators/spectral_norm_op.cc — weight / sigma, with
+    sigma from `power_iters` rounds of power iteration on the weight
+    matricised over `dim`. U/V inputs hold the persistent iteration
+    vectors (treated read-only here: the functional update returns the
+    normalised weight; the reference mutates U/V in place, a state
+    convention the Layer owns)."""
+    import jax.numpy as jnp
+
+    w = ins["Weight"][0]
+    u = ins["U"][0].reshape(-1)
+    v = ins["V"][0].reshape(-1)
+    dim = int(attrs.get("dim", 0))
+    iters = int(attrs.get("power_iters", 1))
+    eps = float(attrs.get("eps", 1e-12))
+    perm = (dim,) + tuple(i for i in range(w.ndim) if i != dim)
+    wm = jnp.transpose(w, perm).reshape(w.shape[dim], -1)  # [H, W]
+
+    def norm(x):
+        return x / (jnp.linalg.norm(x) + eps)
+
+    for _ in range(max(iters, 0)):
+        v = norm(wm.T @ u)
+        u = norm(wm @ v)
+    sigma = u @ wm @ v
+    return {"Out": w / sigma}
+
+
+@register_op("affine_grid", non_diff_inputs=("OutputShape",))
+def affine_grid(ins, attrs):
+    """reference: operators/affine_grid_op.cc — 2-D affine sampling grid
+    from Theta [N, 2, 3]; Out [N, H, W, 2] in [-1, 1] coords."""
+    import jax.numpy as jnp
+
+    theta = ins["Theta"][0]
+    shape = attrs.get("output_shape")
+    if not shape and ins.get("OutputShape"):
+        os_t = ins["OutputShape"][0]
+        if hasattr(os_t, "aval") and not hasattr(os_t, "__array__"):
+            raise NotImplementedError(
+                "affine_grid: a traced OutputShape tensor is not "
+                "XLA-compatible — pass the static output_shape attr "
+                "(same constraint as ShapeTensor, tensor_ops.py)")
+        shape = [int(d) for d in np.asarray(os_t)]
+    n, _, h, w = [int(d) for d in shape]
+    align = bool(attrs.get("align_corners", True))
+    if align:
+        xs = jnp.linspace(-1.0, 1.0, w)
+        ys = jnp.linspace(-1.0, 1.0, h)
+    else:
+        xs = (jnp.arange(w) * 2 + 1) / w - 1.0
+        ys = (jnp.arange(h) * 2 + 1) / h - 1.0
+    gx, gy = jnp.meshgrid(xs, ys)                     # [H, W]
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H, W, 3]
+    out = jnp.einsum("hwk,nck->nhwc", base.astype(theta.dtype), theta)
+    return {"Output": out}
+
+
+@register_op("hierarchical_sigmoid", non_diff_inputs=("Label", "PathTable",
+                                                      "PathCode"))
+def hierarchical_sigmoid(ins, attrs):
+    """reference: operators/hierarchical_sigmoid_op.cc — O(log C) softmax
+    over the default complete binary tree (SimpleCode: node index
+    ((c + C) >> (i+1)) - 1, bit (c + C) >> i & 1), or a custom tree via
+    PathTable/PathCode. Cost[b] = sum_i softplus(pre_i) - bit_i * pre_i."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ins["X"][0]                                  # [B, D]
+    w = ins["W"][0]                                  # [C-1, D]
+    label = ins["Label"][0].reshape(-1).astype(jnp.int32)  # [B]
+    bias = ins.get("Bias", [None])[0]
+    path = ins.get("PathTable", [None])[0]
+    code = ins.get("PathCode", [None])[0]
+    if path is None:
+        c = int(attrs["num_classes"])
+        max_len = int(np.floor(np.log2(max(c - 1, 1)))) + 1
+        lc = label + c
+        i = jnp.arange(max_len)
+        idx = (lc[:, None] >> (i[None, :] + 1)) - 1   # [B, L] W row ids
+        bit = (lc[:, None] >> i[None, :]) & 1
+        valid = idx >= 0                              # stop above the root
+    else:
+        idx = path.astype(jnp.int32)
+        bit = code.astype(jnp.int32)
+        valid = idx >= 0
+    idx_c = jnp.where(valid, idx, 0)
+    pre = jnp.einsum("bd,bld->bl", x, w[idx_c])
+    if bias is not None:
+        pre = pre + bias.reshape(-1)[idx_c]
+    cost = jax.nn.softplus(pre) - bit.astype(pre.dtype) * pre
+    cost = jnp.where(valid, cost, 0.0)
+    return {"Cost": jnp.sum(cost, axis=1, keepdims=True),
+            "PreOut": jnp.where(valid, pre, 0.0)}
+
+
 @register_op("batch_norm")
 def batch_norm(ins, attrs):
     """reference: operators/batch_norm_op.cc. Outputs Y plus updated running

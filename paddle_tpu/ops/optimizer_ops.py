@@ -179,9 +179,16 @@ def adamw(ins, attrs):
     p, lr = ins["Param"][0], ins["LearningRate"][0]
     coeff = np.asarray(attrs.get("coeff", 0.01), np.float32)
 
+    import os
+
     from .pallas import fused_adamw, kernel_mode
 
-    if kernel_mode() != "off" and attrs.get("with_decay", True):
+    # measured (tools/ablate_ernie.py, v5e, round 3): one Pallas
+    # custom-call per parameter is ~18 ms/step SLOWER on ERNIE-large than
+    # letting XLA fuse the per-param update chains — the kernel is
+    # opt-in (PT_FUSED_ADAMW=1), not the default
+    if kernel_mode() != "off" and attrs.get("with_decay", True) \
+            and os.environ.get("PT_FUSED_ADAMW"):
         g = ins["Grad"][0]
         m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
         b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]

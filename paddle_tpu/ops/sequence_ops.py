@@ -296,3 +296,76 @@ def sequence_conv(ins, attrs):
         cols.append(jnp.where(valid, x[:, idx], 0))
     ctx = jnp.concatenate(cols, axis=-1)              # [B, S, win*D]
     return {"Out": jnp.einsum("bsc,cm->bsm", ctx, w)}
+
+
+@register_op("sequence_expand_as", non_diff_inputs=("Y", "YLength"))
+def sequence_expand_as(ins, attrs):
+    """Broadcast each sequence's single row of X to its reference length
+    (reference: sequence_ops/sequence_expand_as_op.h
+    SequenceExpandFunctor — row h repeated ref_lod span times). Padded
+    form: X [B, D], Y [B, S, ...] or YLength [B] giving the per-row
+    span; Out [B, S, D] with positions past the span zeroed."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]                        # [B, D]
+    y = ins.get("Y", [None])[0]
+    ln = ins.get("YLength", [None])[0]
+    if ln is not None:
+        ln = ln.reshape(-1).astype(jnp.int32)
+        s = int(attrs.get("max_len", 0)) or (
+            y.shape[1] if y is not None else 0)
+        if not s:
+            # a traced YLength cannot size the output under jit — the
+            # static max_len attr (or a Y tensor) is required
+            raise ValueError(
+                "sequence_expand_as: pass max_len= (or a Y input) — "
+                "the padded output extent must be static under XLA")
+    else:
+        s = y.shape[1]
+        ln = jnp.full((x.shape[0],), s, jnp.int32)
+    out = jnp.broadcast_to(x[:, None], (x.shape[0], s) + x.shape[1:])
+    mask = (jnp.arange(s)[None, :] < ln[:, None])
+    mask = mask.reshape(mask.shape + (1,) * (out.ndim - 2))
+    return {"Out": jnp.where(mask, out, 0).astype(x.dtype),
+            "OutLength": ln}
+
+
+@register_op("sequence_topk_avg_pooling", non_diff_inputs=("ROW", "COLUMN"))
+def sequence_topk_avg_pooling(ins, attrs):
+    """Top-k average pooling over match-matrix rows (reference:
+    sequence_ops/sequence_topk_avg_pooling_op.h). Padded form: X is the
+    stacked match matrix [B, C, R, W]; ROW/COLUMN carry the per-sequence
+    row/column lengths in their Length slot ([B] int). For each valid
+    row and channel, Out holds sum(top-k values)/k per k in `topks`
+    (reference semantics: fewer than k valid columns carry the partial
+    prefix sum forward, denominator stays k); pos holds the top-max_k
+    column indices, -1-padded."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0].astype(jnp.float32)     # [B, C, R, W]
+    b, c, r, w = x.shape
+    row_ln = ins["ROW"][0].reshape(-1).astype(jnp.int32)
+    col_ln = ins["COLUMN"][0].reshape(-1).astype(jnp.int32)
+    topks = [int(k) for k in attrs.get("topks", [1])]
+    max_k = max(topks)
+    col_valid = (jnp.arange(w)[None, None, None, :]
+                 < col_ln[:, None, None, None])
+    neg = jnp.asarray(-3.4e38, jnp.float32)
+    masked = jnp.where(col_valid, x, neg)
+    order = jnp.argsort(-masked, axis=-1)[..., :max_k]   # [B,C,R,K]
+    vals = jnp.take_along_axis(masked, order, axis=-1)
+    kth_valid = (jnp.arange(max_k)[None, None, None, :]
+                 < col_ln[:, None, None, None])
+    vals = jnp.where(kth_valid, vals, 0.0)
+    prefix = jnp.cumsum(vals, axis=-1)                    # [B,C,R,max_k]
+    outs = [prefix[..., k - 1] / float(k) for k in topks]
+    out = jnp.stack(outs, axis=-1)                        # [B,C,R,K]
+    row_valid = (jnp.arange(r)[None, None, :]
+                 < row_ln[:, None, None])
+    out = jnp.where(row_valid[..., None], out, 0.0)
+    # reference layout: [rows, channel * num_k]
+    out = jnp.moveaxis(out, 1, 2).reshape(b, r, c * len(topks))
+    pos = jnp.where(kth_valid, order, -1)
+    pos = jnp.moveaxis(pos, 1, 2).reshape(b, r, c * max_k)
+    pos = jnp.where(row_valid[:, 0, :, None], pos, -1)
+    return {"Out": out.astype(ins["X"][0].dtype), "pos": pos.astype(jnp.int32)}

@@ -1,0 +1,341 @@
+"""CRF / CTC-align / edit-distance op family (VERDICT r2 #8).
+
+Strategy mirrors the reference's unit tests
+(test_linear_chain_crf_op.py — numpy brute-force oracle over all paths;
+test_crf_decoding_op.py; test_ctc_align_op.py; test_edit_distance_op.py)
+plus a tiny NER end-to-end fixture."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _crf_brute(e, w, label, length):
+    """Enumerate all tag paths: returns (nll per row, best path per row).
+    e [B,S,T] f64, w [T+2,T], label [B,S], length [B]."""
+    b, s, t = e.shape
+    start_w, stop_w, trans = w[0], w[1], w[2:]
+    nll = np.zeros(b)
+    best = np.zeros((b, s), np.int64)
+    for i in range(b):
+        ln = int(length[i])
+        scores = {}
+        for path in itertools.product(range(t), repeat=ln):
+            sc = start_w[path[0]] + e[i, 0, path[0]]
+            for k in range(1, ln):
+                sc += trans[path[k - 1], path[k]] + e[i, k, path[k]]
+            sc += stop_w[path[-1]]
+            scores[path] = sc
+        arr = np.array(list(scores.values()))
+        m = arr.max()
+        log_z = m + np.log(np.exp(arr - m).sum())
+        gold = tuple(int(x) for x in label[i, :ln])
+        nll[i] = log_z - scores[gold]
+        bp = max(scores, key=scores.get)
+        best[i, :ln] = bp
+    return nll, best
+
+
+class TestLinearChainCRF(OpTest):
+    op_type = "linear_chain_crf"
+
+    def setup(self):
+        rng = np.random.RandomState(7)
+        b, s, t = 3, 4, 3
+        e = rng.randn(b, s, t).astype(np.float32)
+        w = (rng.randn(t + 2, t) * 0.5).astype(np.float32)
+        label = rng.randint(0, t, (b, s)).astype(np.int64)
+        length = np.array([4, 3, 2], np.int64)
+        nll, _ = _crf_brute(e.astype(np.float64), w.astype(np.float64),
+                            label, length)
+        self.inputs = {"Emission": e, "Transition": w, "Label": label,
+                       "Length": length}
+        self.outputs = {"LogLikelihood": nll[:, None].astype(np.float32)}
+
+    def test_output_vs_bruteforce(self):
+        self.check_output(atol=1e-4, no_check_set=("Alpha", "Exps"))
+
+    def test_numeric_grad(self):
+        # the reference's analytic-grad check (test_linear_chain_crf_op
+        # check_grad) — here vs central differences through the scan
+        self.check_grad(["Emission", "Transition"], "LogLikelihood",
+                        max_relative_error=0.01)
+
+
+class TestCRFDecoding:
+    def _decode(self, e, w, length, label=None):
+        import jax.numpy as jnp
+
+        from paddle_tpu.core import registry
+
+        ins = {"Emission": [jnp.asarray(e)], "Transition": [jnp.asarray(w)],
+               "Length": [jnp.asarray(length)]}
+        if label is not None:
+            ins["Label"] = [jnp.asarray(label)]
+        return np.asarray(registry.lookup("crf_decoding").forward(
+            ins, {})["ViterbiPath"])
+
+    def test_viterbi_vs_bruteforce(self):
+        rng = np.random.RandomState(3)
+        b, s, t = 4, 5, 3
+        e = rng.randn(b, s, t).astype(np.float32)
+        w = (rng.randn(t + 2, t) * 0.7).astype(np.float32)
+        length = np.array([5, 4, 2, 1], np.int64)
+        _, best = _crf_brute(e.astype(np.float64), w.astype(np.float64),
+                             np.zeros((b, s), np.int64), length)
+        got = self._decode(e, w, length)
+        for i in range(b):
+            ln = int(length[i])
+            np.testing.assert_array_equal(got[i, :ln], best[i, :ln],
+                                          err_msg=f"row {i}")
+            assert (got[i, ln:] == 0).all()
+
+    def test_label_mode_correctness_mask(self):
+        rng = np.random.RandomState(4)
+        e = rng.randn(2, 4, 3).astype(np.float32)
+        w = rng.randn(5, 3).astype(np.float32)
+        length = np.array([4, 3], np.int64)
+        path = self._decode(e, w, length)
+        mask = self._decode(e, w, length, label=path)
+        valid = np.arange(4)[None, :] < length[:, None]
+        np.testing.assert_array_equal(mask, valid.astype(np.int64))
+
+
+class TestCTCAlign(OpTest):
+    op_type = "ctc_align"
+
+    def setup(self):
+        # reference test_ctc_align_op fixture style: merge repeats, drop
+        # blanks (0), respect per-row lengths, pad with padding_value
+        x = np.array([[0, 1, 1, 0, 2, 2, 0, 3],
+                      [1, 1, 2, 0, 0, 3, 3, 0]], np.int32)
+        length = np.array([8, 6], np.int64)
+        out = np.array([[1, 2, 3, -1, -1, -1, -1, -1],
+                        [1, 2, 3, -1, -1, -1, -1, -1]], np.int64)
+        self.inputs = {"Input": x, "InputLength": length}
+        self.attrs = {"blank": 0, "padding_value": -1}
+        self.outputs = {"Output": out,
+                        "OutputLength": np.array([[3], [3]], np.int32)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestEditDistance(OpTest):
+    op_type = "edit_distance"
+
+    @staticmethod
+    def _lev(a, b):
+        dp = np.arange(len(b) + 1, dtype=np.float64)
+        for i, ca in enumerate(a):
+            prev = dp.copy()
+            dp[0] = i + 1
+            for j, cb in enumerate(b):
+                dp[j + 1] = min(prev[j + 1] + 1, dp[j] + 1,
+                                prev[j] + (ca != cb))
+        return dp[len(b)]
+
+    def setup(self):
+        rng = np.random.RandomState(11)
+        b, s1, s2 = 4, 6, 5
+        hyp = rng.randint(1, 5, (b, s1)).astype(np.int64)
+        ref = rng.randint(1, 5, (b, s2)).astype(np.int64)
+        hl = np.array([6, 4, 3, 1], np.int64)
+        rl = np.array([5, 5, 2, 4], np.int64)
+        want = np.array([[self._lev(hyp[i, :hl[i]], ref[i, :rl[i]])]
+                         for i in range(b)], np.float32)
+        self.inputs = {"Hyps": hyp, "Refs": ref, "HypsLength": hl,
+                       "RefsLength": rl}
+        self.attrs = {"normalized": False}
+        self.outputs = {"Out": want,
+                        "SequenceNum": np.array([b], np.int64)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_normalized(self):
+        self.setup()
+        self.attrs = {"normalized": True}
+        rl = self.inputs["RefsLength"]
+        self.outputs = {"Out": (self.outputs["Out"]
+                                / np.maximum(rl[:, None], 1)).astype(
+                                    np.float32),
+                        "SequenceNum": self.outputs["SequenceNum"]}
+        inputs, attrs, outputs = self.inputs, self.attrs, self.outputs
+        self.setup = lambda: (setattr(self, "inputs", inputs),
+                              setattr(self, "attrs", attrs),
+                              setattr(self, "outputs", outputs))
+        self.check_output()
+
+
+class TestSequenceExpandAs(OpTest):
+    op_type = "sequence_expand_as"
+
+    def setup(self):
+        x = np.arange(6, dtype=np.float32).reshape(3, 2)
+        ln = np.array([3, 0, 2], np.int64)
+        out = np.zeros((3, 4, 2), np.float32)
+        for i, n in enumerate(ln):
+            out[i, :n] = x[i]
+        self.inputs = {"X": x, "YLength": ln}
+        self.attrs = {"max_len": 4}
+        self.outputs = {"Out": out,
+                        "OutLength": ln.astype(np.int32)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestSequenceTopkAvgPooling:
+    def test_matches_reference_semantics(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.core import registry
+
+        rng = np.random.RandomState(5)
+        b, c, r, w = 2, 2, 3, 5
+        x = rng.randn(b, c, r, w).astype(np.float32)
+        row_ln = np.array([3, 2], np.int32)
+        col_ln = np.array([5, 3], np.int32)
+        topks = [1, 3]
+        got = registry.lookup("sequence_topk_avg_pooling").forward(
+            {"X": [jnp.asarray(x)], "ROW": [jnp.asarray(row_ln)],
+             "COLUMN": [jnp.asarray(col_ln)]},
+            {"topks": topks, "channel_num": c})
+        out = np.asarray(got["Out"])
+        assert out.shape == (b, r, c * len(topks))
+        for i in range(b):
+            for rr in range(r):
+                for j in range(c):
+                    vals = np.sort(x[i, j, rr, :col_ln[i]])[::-1]
+                    for ki, k in enumerate(topks):
+                        want = vals[:min(k, len(vals))].sum() / k
+                        if rr >= row_ln[i]:
+                            want = 0.0
+                        np.testing.assert_allclose(
+                            out[i, rr, j * len(topks) + ki], want,
+                            rtol=1e-5, atol=1e-6)
+
+
+class TestNERFixture:
+    def test_tiny_ner_trains(self):
+        """Tiny BiLSTM-free NER: embedding → fc emissions → CRF loss must
+        decrease, and crf_decoding accuracy on the training batch must
+        beat chance (the reference's sequence-labeling demo contract,
+        e.g. test_linear_chain_crf layers usage)."""
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+        from paddle_tpu.core import ir, unique_name
+
+        ir._main_program, ir._startup_program = ir.Program(), ir.Program()
+        unique_name.switch()
+        main, startup = pt.Program(), pt.Program()
+        B, S, V, T = 8, 6, 30, 4
+        with pt.program_guard(main, startup):
+            words = layers.data("words", [S], dtype="int64",
+                                stop_gradient=True)
+            label = layers.data("label", [S], dtype="int64",
+                                stop_gradient=True)
+            length = layers.data("length", [], dtype="int64",
+                                 stop_gradient=True)
+            emb = layers.embedding(words, [V, 16])
+            emission = layers.fc(emb, T, num_flatten_dims=2)
+            nll = layers.linear_chain_crf(
+                emission, label, length=length,
+                param_attr=pt.ParamAttr(name="crf_trans"))
+            loss = layers.mean(nll)
+            decoded = layers.crf_decoding(
+                emission, pt.ParamAttr(name="crf_trans"), length=length)
+            pt.optimizer.AdamOptimizer(0.05).minimize(loss)
+
+        rng = np.random.RandomState(0)
+        w = rng.randint(0, V, (B, S)).astype(np.int64)
+        y = (w % T).astype(np.int64)          # learnable mapping
+        ln = rng.randint(3, S + 1, (B,)).astype(np.int64)
+        exe = pt.Executor()
+        scope = pt.Scope()
+        exe.run(startup, scope=scope, use_compiled=False)
+        losses = []
+        for _ in range(30):
+            out = exe.run(main, feed={"words": w, "label": y, "length": ln},
+                          fetch_list=[loss, decoded], scope=scope)
+            losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+        assert losses[-1] < losses[0] * 0.5, losses
+        path = np.asarray(out[1])
+        valid = np.arange(S)[None, :] < ln[:, None]
+        acc = (path == y)[valid].mean()
+        assert acc > 0.8, f"decode accuracy {acc}"
+
+
+class TestRow6Ops:
+    """pool3d / spectral_norm / affine_grid / hierarchical_sigmoid
+    (coverage row 6 leftovers)."""
+
+    def _fwd(self, op, ins, attrs):
+        import jax.numpy as jnp
+
+        from paddle_tpu.core import registry
+
+        return registry.lookup(op).forward(
+            {k: [jnp.asarray(v)] for k, v in ins.items()}, attrs)
+
+    def test_pool3d(self):
+        x = np.arange(2 * 1 * 4 * 4 * 4, dtype=np.float32).reshape(
+            2, 1, 4, 4, 4)
+        out = np.asarray(self._fwd("pool3d", {"X": x},
+                                   {"ksize": [2, 2, 2],
+                                    "pooling_type": "max"})["Out"])
+        assert out.shape == (2, 1, 2, 2, 2)
+        np.testing.assert_allclose(out[0, 0, 0, 0, 0], x[0, 0, :2, :2, :2].max())
+        avg = np.asarray(self._fwd("pool3d", {"X": x},
+                                   {"pooling_type": "avg",
+                                    "global_pooling": True})["Out"])
+        np.testing.assert_allclose(avg[1, 0, 0, 0, 0], x[1].mean(), rtol=1e-6)
+
+    def test_spectral_norm(self):
+        rng = np.random.RandomState(0)
+        w = rng.randn(8, 6).astype(np.float32) * 3
+        u = rng.randn(8).astype(np.float32)
+        v = rng.randn(6).astype(np.float32)
+        out = np.asarray(self._fwd(
+            "spectral_norm", {"Weight": w, "U": u, "V": v},
+            {"dim": 0, "power_iters": 50})["Out"])
+        sv = np.linalg.svd(out, compute_uv=False)
+        np.testing.assert_allclose(sv[0], 1.0, rtol=1e-4)
+
+    def test_affine_grid_identity(self):
+        theta = np.tile(np.array([[[1, 0, 0], [0, 1, 0]]], np.float32),
+                        (2, 1, 1))
+        grid = np.asarray(self._fwd(
+            "affine_grid", {"Theta": theta},
+            {"output_shape": [2, 1, 3, 5], "align_corners": True})["Output"])
+        assert grid.shape == (2, 3, 5, 2)
+        np.testing.assert_allclose(grid[0, 0, :, 0],
+                                   np.linspace(-1, 1, 5), atol=1e-6)
+        np.testing.assert_allclose(grid[0, :, 0, 1],
+                                   np.linspace(-1, 1, 3), atol=1e-6)
+
+    @pytest.mark.parametrize("c", [8, 6])
+    def test_hierarchical_sigmoid_is_a_distribution(self, c):
+        """sum_c exp(-cost(c)) == 1 for any x — the tree codes partition
+        probability mass exactly (reference SimpleCode contract)."""
+        rng = np.random.RandomState(1)
+        b, d = 4, 5
+        x = rng.randn(b, d).astype(np.float32)
+        w = rng.randn(c - 1, d).astype(np.float32)
+        bias = rng.randn(c - 1).astype(np.float32)
+        total = np.zeros(b)
+        for cls in range(c):
+            label = np.full((b, 1), cls, np.int64)
+            cost = np.asarray(self._fwd(
+                "hierarchical_sigmoid",
+                {"X": x, "W": w, "Label": label, "Bias": bias},
+                {"num_classes": c})["Cost"]).reshape(-1)
+            total += np.exp(-cost)
+        np.testing.assert_allclose(total, 1.0, rtol=1e-5)
