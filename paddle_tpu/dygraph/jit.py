@@ -161,6 +161,134 @@ class _IfTransformer(ast.NodeTransformer):
     def __init__(self):
         self.counter = 0
 
+    # -- loops (reference: dygraph_to_static/loop_transformer.py) ----------
+    #
+    # `while <test>: <body>` becomes
+    #
+    #     def _jst_cond_i(__jst_snap__):  bind; return <test>
+    #     def _jst_body_i(__jst_snap__):  bind; <body>; return (a, b, ...)
+    #     (a, b, ...) = _jst_while(_jst_cond_i, _jst_body_i, snap)
+    #
+    # with the same snapshot/bind design as the if-rewrite: the loop state
+    # is every name assigned in the body (plus names the test reads that
+    # are also assigned — reads of untouched outer locals stay closure
+    # lookups). At runtime a Python predicate runs the plain eager loop
+    # (trace-time freeze, exact semantics); a traced-tensor predicate
+    # sub-traces cond/body ONCE each and records a single `while_loop`
+    # program op (bounded-scan lowering → differentiable), so the trip
+    # count is a runtime value and changing it does not retrace.
+    #
+    # `for i in range(...)` (1- or 2-arg) desugars to that while form
+    # first; other iterables keep Python semantics.
+
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)
+        if node.orelse:
+            return node
+        finder = _ControlFinder()
+        for s in node.body:
+            finder.visit(s)
+        if finder.blocked:
+            return node
+        # generated _jst_* defs (from already-transformed nested ifs/loops)
+        # are body-local machinery, never loop state
+        assigned = sorted(n for n in _assigned_names(node.body)
+                          if not n.startswith("_jst_"))
+        if not assigned:
+            return node
+        i = self.counter
+        self.counter += 1
+        ret = ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ast.Load()) for n in assigned],
+            ctx=ast.Load()))
+        bind = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store()) for n in assigned],
+                ctx=ast.Store())],
+            value=ast.Name(id="__jst_snap__", ctx=ast.Load()))
+
+        def mk(name, body):
+            return ast.FunctionDef(
+                name=name,
+                args=ast.arguments(
+                    posonlyargs=[],
+                    args=[ast.arg(arg="__jst_snap__")],
+                    kwonlyargs=[], kw_defaults=[], defaults=[]),
+                body=[bind] + list(body) + [ret], decorator_list=[])
+
+        snap = ast.Tuple(
+            elts=[ast.Call(
+                func=ast.Name(id="_jst_peek", ctx=ast.Load()),
+                args=[ast.Lambda(
+                    args=ast.arguments(posonlyargs=[], args=[],
+                                       kwonlyargs=[], kw_defaults=[],
+                                       defaults=[]),
+                    body=ast.Name(id=n, ctx=ast.Load()))],
+                keywords=[]) for n in assigned],
+            ctx=ast.Load())
+        c_name, b_name = f"_jst_cond_{i}", f"_jst_body_{i}"
+        c_def = mk(c_name, [ast.Return(value=node.test)])
+        # strip mk's trailing tuple-return from the cond fn
+        c_def.body = c_def.body[:-1]
+        b_def = mk(b_name, node.body)
+        call = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store()) for n in assigned],
+                ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Name(id="_jst_while", ctx=ast.Load()),
+                args=[ast.Name(id=c_name, ctx=ast.Load()),
+                      ast.Name(id=b_name, ctx=ast.Load()),
+                      snap],
+                keywords=[]))
+        out = [c_def, b_def, call]
+        for n in out:
+            ast.copy_location(n, node)
+            ast.fix_missing_locations(n)
+        return out
+
+    def visit_For(self, node: ast.For):
+        if node.orelse or not isinstance(node.target, ast.Name):
+            self.generic_visit(node)
+            return node
+        it = node.iter
+        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and not it.keywords
+                and len(it.args) in (1, 2)):
+            self.generic_visit(node)
+            return node
+        finder = _ControlFinder()
+        for s in node.body:
+            finder.visit(s)
+        if finder.blocked:
+            self.generic_visit(node)
+            return node
+        i_name = node.target.id
+        start = (ast.Constant(value=0) if len(it.args) == 1
+                 else it.args[0])
+        stop_name = f"_jst_stop_{self.counter}"
+        init = [ast.Assign(targets=[ast.Name(id=i_name, ctx=ast.Store())],
+                           value=start),
+                ast.Assign(targets=[ast.Name(id=stop_name,
+                                             ctx=ast.Store())],
+                           value=it.args[-1])]
+        bump = ast.AugAssign(target=ast.Name(id=i_name, ctx=ast.Store()),
+                             op=ast.Add(), value=ast.Constant(value=1))
+        while_node = ast.While(
+            test=ast.Compare(left=ast.Name(id=i_name, ctx=ast.Load()),
+                             ops=[ast.Lt()],
+                             comparators=[ast.Name(id=stop_name,
+                                                   ctx=ast.Load())]),
+            body=list(node.body) + [bump], orelse=[])
+        for n in init + [while_node]:
+            ast.copy_location(n, node)
+            ast.fix_missing_locations(n)
+        replaced = self.visit_While(while_node)   # also visits the body
+        if replaced is while_node:           # not transformable: keep For
+            self.generic_visit(node)
+            return node
+        return init + replaced
+
     def visit_If(self, node: ast.If):
         self.generic_visit(node)
         finder = _ControlFinder()
@@ -168,8 +296,9 @@ class _IfTransformer(ast.NodeTransformer):
             finder.visit(s)
         if finder.blocked:
             return node
-        assigned = sorted(_assigned_names(node.body)
-                          | _assigned_names(node.orelse))
+        assigned = sorted(n for n in (_assigned_names(node.body)
+                                      | _assigned_names(node.orelse))
+                          if not n.startswith("_jst_"))
         if not assigned:
             return node
         i = self.counter
@@ -227,7 +356,7 @@ class _IfTransformer(ast.NodeTransformer):
 
 def _jst_if(pred, t_fn, f_fn, snap):
     """Runtime dispatch for transformed ifs (see module docstring)."""
-    if _capture_stack and isinstance(pred, VarBase):
+    if _capture_stack and not _suppress_capture and isinstance(pred, VarBase):
         from .tracer import trace_op
 
         t_vals = t_fn(snap)
@@ -269,6 +398,179 @@ def _jst_if(pred, t_fn, f_fn, snap):
     return t_fn(snap) if cond else f_fn(snap)
 
 
+_suppress_capture = 0       # >0: trace_op executes eagerly, records nothing
+_active_loop_bound = 0      # StaticFunction's loop_max_iters during _trace
+
+
+def _jst_truth(v):
+    return bool(v._array.reshape(-1)[0]) if isinstance(v, VarBase) \
+        else bool(v)
+
+
+def _subtrace(fn, state_vbs):
+    """Trace fn over fresh feed VarBases mirroring state_vbs; returns
+    (capture, feed_names, result). Used to build the cond/body sub-blocks
+    of a tensor-dependent loop."""
+    feeds = [VarBase(vb._array, stop_gradient=True) for vb in state_vbs]
+    cap = _CaptureState()
+    for f in feeds:
+        cap.mark_feed(f)
+    _capture_stack.append(cap)
+    try:
+        result = fn(feeds)
+    finally:
+        _capture_stack.pop()
+    return cap, result
+
+
+def _jst_while(cond_fn, body_fn, snap):
+    """Runtime dispatch for transformed while/for loops (see the
+    transformer comment)."""
+    global _suppress_capture
+    state = tuple(snap)
+    capturing = bool(_capture_stack) and not _suppress_capture
+    if capturing:
+        # peek the predicate WITHOUT recording the test's ops twice
+        _suppress_capture += 1
+        try:
+            pred0 = cond_fn(state)
+        finally:
+            _suppress_capture -= 1
+    else:
+        pred0 = cond_fn(state)
+    if not capturing or not isinstance(pred0, VarBase):
+        # plain-Python predicate (or eager mode): exact Python semantics;
+        # under capture the iterations freeze into the trace
+        while _jst_truth(cond_fn(state)):
+            state = tuple(body_fn(state))
+        return state
+
+    # tensor-dependent loop: ONE while_loop op, runtime trip count
+    from .tracer import trace_op
+
+    # probe the loop eagerly (capture suppressed) on the example input:
+    # counts iterations for the default bound AND detects non-tensor
+    # state the body mutates (e.g. the desugared for-loop counter),
+    # which must be promoted to tensors to be carried at runtime
+    bound = _active_loop_bound
+    probe_limit = 10_000 if not bound else 16
+    changed = set()
+
+    def diff_positions(old, new):
+        for j, (a, b) in enumerate(zip(old, new)):
+            if isinstance(b, VarBase) or isinstance(a, _Missing):
+                continue
+            try:
+                if isinstance(a, VarBase) or (a is not b and a != b):
+                    changed.add(j)
+            except Exception:       # ambiguous array truth etc.
+                changed.add(j)
+
+    _suppress_capture += 1
+    try:
+        # one unconditional body probe so a zero-trip example input still
+        # reveals which numeric state the body mutates (best-effort: a
+        # body invalid outside the guard just skips detection)
+        try:
+            diff_positions(state, tuple(body_fn(state)))
+        except Exception:
+            pass
+        cnt, probe = 0, state
+        while _jst_truth(cond_fn(probe)) and cnt < probe_limit:
+            new = tuple(body_fn(probe))
+            diff_positions(probe, new)
+            probe = new
+            cnt += 1
+    finally:
+        _suppress_capture -= 1
+    if not bound:
+        bound = max(2 * cnt, cnt + 8)
+        import warnings
+
+        warnings.warn(
+            f"to_static: tensor-dependent loop bounded at {bound} "
+            f"iterations (2x the traced input's {cnt}); pass "
+            f"to_static(fn, loop_max_iters=N) to set the bound "
+            f"explicitly", stacklevel=2)
+
+    state = list(state)
+    for j in changed:
+        v = state[j]
+        if isinstance(v, (bool, int, float, np.integer, np.floating)):
+            state[j] = VarBase(np.asarray(v))
+        else:
+            raise TypeError(
+                f"to_static: a tensor-dependent loop mutates "
+                f"non-numeric state (position {j}: {v!r}) — only "
+                f"tensors/numbers can be carried at runtime")
+    state = tuple(state)
+    # _Missing positions are body-local temps (assigned each iteration
+    # before use): not carried; their post-loop value is undefined on
+    # the traced path (the plain-Python path keeps exact semantics)
+    t_idx = [i for i, v in enumerate(state) if isinstance(v, VarBase)]
+    if not t_idx:
+        raise TypeError("to_static loop: tensor predicate but no tensor "
+                        "loop state")
+    state_vbs = [state[i] for i in t_idx]
+
+    def run_cond(feeds):
+        s = list(state)
+        for i, f in zip(t_idx, feeds):
+            s[i] = f
+        return cond_fn(tuple(s))
+
+    def run_body(feeds):
+        s = list(state)
+        for i, f in zip(t_idx, feeds):
+            s[i] = f
+        out = body_fn(tuple(s))
+        for i, (a, b) in enumerate(zip(s, out)):
+            if isinstance(b, VarBase) or isinstance(a, _Missing):
+                continue
+            if a is not b and a != b:
+                raise TypeError(
+                    f"to_static: a tensor-dependent loop changes "
+                    f"non-tensor state (position {i}: {a!r} -> {b!r}) — "
+                    f"only tensors can be carried at runtime")
+        return [out[i] for i in t_idx]
+
+    cap_c, pred = _subtrace(run_cond, state_vbs)
+    if not isinstance(pred, VarBase):
+        raise TypeError("to_static loop: predicate ceased to be a tensor "
+                        "inside the sub-trace")
+    cap_b, outs = _subtrace(run_body, state_vbs)
+    carry_names = list(cap_b.feed_names)
+    body_out_names = []
+    for i, vb in enumerate(outs):
+        name = cap_b.names.get(id(vb))
+        if name is None:                  # constant/external result
+            name = cap_b.name_of(vb)
+        body_out_names.append(name)
+    # cond feeds must share the body's carry names inside the op env
+    rename = dict(zip(cap_c.feed_names, carry_names))
+    for op in cap_c.block.ops:
+        for slot, names in op.inputs.items():
+            op.inputs[slot] = [rename.get(n, n) for n in names]
+    cond_out = rename.get(cap_c.names[id(pred)], cap_c.names[id(pred)])
+
+    ext = {}
+    ext.update(cap_c.param_values)
+    ext.update(cap_b.param_values)
+    ext_names = list(ext)
+    ext_vbs = [ext[n] for n in ext_names]
+    res = trace_op(
+        "while_loop",
+        {"X": state_vbs, "Ext": ext_vbs},
+        {"cond_block": cap_c.block, "body_block": cap_b.block,
+         "carry_names": carry_names, "body_out_names": body_out_names,
+         "ext_names": ext_names, "cond_out_name": cond_out,
+         "grad_max_iters": int(bound)})["Out"]
+    final = list(state)
+    for i, vb in zip(t_idx, res):
+        final[i] = vb
+    return tuple(final)
+
+
 def _transform_fn(fn):
     """Rewrite fn's `if` statements via _IfTransformer; falls back to the
     original on any source/compile issue (e.g. source unavailable in a
@@ -297,6 +599,7 @@ def _transform_fn(fn):
 
         glb = _Globals()
         glb["_jst_if"] = _jst_if
+        glb["_jst_while"] = _jst_while
         glb["_jst_peek"] = _jst_peek
         glb["__builtins__"] = fn.__globals__.get("__builtins__", __builtins__)
         loc: Dict[str, Any] = {}
@@ -369,7 +672,7 @@ class _CaptureState:
 
 def capture_op(op_type: str, norm_inputs, attrs, out_vars):
     """Called by tracer.trace_op after eager execution to record the op."""
-    if not _capture_stack:
+    if not _capture_stack or _suppress_capture:
         return
     cap = _capture_stack[-1]
     inputs: Dict[str, List[str]] = {}
@@ -458,10 +761,11 @@ class StaticFunction:
     """@to_static wrapper: trace-on-first-call per signature, then run the
     captured block as one jitted computation on the tape."""
 
-    def __init__(self, fn, input_spec=None):
+    def __init__(self, fn, input_spec=None, loop_max_iters=0):
         self._fn = _transform_fn(fn)
         self._fn_original = fn
         self._input_spec = input_spec
+        self._loop_max_iters = int(loop_max_iters or 0)
         self._cache: Dict[tuple, ConcreteProgram] = {}
         # signature tuples embed id(obj) for non-tensor args; pin those
         # objects so CPython id reuse can never alias a stale cache entry
@@ -478,7 +782,8 @@ class StaticFunction:
         key = "_sf_" + self._fn.__name__
         inst_sf = obj.__dict__.get(key)
         if inst_sf is None:
-            inst_sf = StaticFunction(self._fn, self._input_spec)
+            inst_sf = StaticFunction(self._fn, self._input_spec,
+                                     self._loop_max_iters)
             obj.__dict__[key] = inst_sf
         bound = functools.partial(inst_sf.__call__, obj)
         bound.__self__ = obj
@@ -511,10 +816,14 @@ class StaticFunction:
         full_args = list(args)
         for i, vb in zip(tensor_idx, vb_args):
             full_args[i] = vb
+        global _active_loop_bound
         _capture_stack.append(cap)
+        prev_bound = _active_loop_bound
+        _active_loop_bound = self._loop_max_iters
         try:
             result = self._fn(*full_args)
         finally:
+            _active_loop_bound = prev_bound
             _capture_stack.pop()
         flat, treedef = _flatten_result(result)
         fetch_names = []
@@ -548,11 +857,15 @@ def _flatten_result(result):
     raise TypeError(f"unsupported to_static return type {type(result)}")
 
 
-def to_static(function=None, input_spec=None, **kwargs):
-    """@paddle.jit.to_static (reference: jit.py declarative)."""
+def to_static(function=None, input_spec=None, loop_max_iters=0, **kwargs):
+    """@paddle.jit.to_static (reference: jit.py declarative).
+
+    loop_max_iters bounds tensor-dependent Python loops (the
+    differentiable bounded-scan lowering needs a static trip bound);
+    without it the bound defaults to 2x the traced input's count."""
 
     def deco(fn):
-        return StaticFunction(fn, input_spec)
+        return StaticFunction(fn, input_spec, loop_max_iters)
 
     if function is not None:
         return deco(function)

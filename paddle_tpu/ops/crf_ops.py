@@ -249,4 +249,4 @@ def edit_distance(ins, attrs):
     if bool(attrs.get("normalized", False)):
         dist = dist / jnp.maximum(rl.astype(jnp.float32), 1.0)
     return {"Out": dist[:, None].astype(jnp.float32),
-            "SequenceNum": jnp.asarray([b], jnp.int64)}
+            "SequenceNum": jnp.asarray([b], jnp.int32)}

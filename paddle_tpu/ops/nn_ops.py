@@ -132,7 +132,9 @@ def pool3d(ins, attrs):
 
     x = ins["X"][0]
     ptype = attrs.get("pooling_type", "max")
-    if attrs.get("global_pooling", False):
+    if attrs.get("global_pooling", False) or (
+            attrs.get("adaptive", False)
+            and tuple(attrs.get("ksize", ())) == (1, 1, 1)):
         axis = (2, 3, 4)
         out = (jnp.max(x, axis=axis, keepdims=True) if ptype == "max"
                else jnp.mean(x, axis=axis, keepdims=True))
@@ -254,7 +256,8 @@ def hierarchical_sigmoid(ins, attrs):
         pre = pre + bias.reshape(-1)[idx_c]
     cost = jax.nn.softplus(pre) - bit.astype(pre.dtype) * pre
     cost = jnp.where(valid, cost, 0.0)
-    return {"Cost": jnp.sum(cost, axis=1, keepdims=True),
+    # reference output slot is "Out" (hierarchical_sigmoid_op.cc)
+    return {"Out": jnp.sum(cost, axis=1, keepdims=True),
             "PreOut": jnp.where(valid, pre, 0.0)}
 
 

@@ -151,7 +151,7 @@ class TestEditDistance(OpTest):
                        "RefsLength": rl}
         self.attrs = {"normalized": False}
         self.outputs = {"Out": want,
-                        "SequenceNum": np.array([b], np.int64)}
+                        "SequenceNum": np.array([b], np.int32)}
 
     def test_output(self):
         self.check_output()
@@ -336,6 +336,6 @@ class TestRow6Ops:
             cost = np.asarray(self._fwd(
                 "hierarchical_sigmoid",
                 {"X": x, "W": w, "Label": label, "Bias": bias},
-                {"num_classes": c})["Cost"]).reshape(-1)
+                {"num_classes": c})["Out"]).reshape(-1)
             total += np.exp(-cost)
         np.testing.assert_allclose(total, 1.0, rtol=1e-5)

@@ -28,7 +28,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
-def build(attn_dropout=0.1, optimizer="adamw", prune=None):
+def build(attn_dropout=0.1, hidden_dropout=0.1, optimizer="adamw",
+          prune=None, chunk_mb=None):
     """Build the bench-identical ERNIE-large program; prune='fwd' drops
     backward+optimizer ops, prune='bwd' drops optimizer ops."""
     import paddle_tpu as pt
@@ -36,12 +37,17 @@ def build(attn_dropout=0.1, optimizer="adamw", prune=None):
     from paddle_tpu.core.ir import OpRole
     from paddle_tpu.models import bert
 
+    if chunk_mb is not None:
+        from paddle_tpu.ops.pallas import flash_attention as fa
+
+        fa.XLA_ATTN_CHUNK_TARGET_BYTES = chunk_mb << 20
     ir._main_program, ir._startup_program = ir.Program(), ir.Program()
     unique_name.switch()
     cfg = bert.ernie_large()
     cfg.dtype = "bfloat16"
     cfg.use_flash_attention = True
     cfg.attention_probs_dropout_prob = attn_dropout
+    cfg.hidden_dropout_prob = hidden_dropout
     main, startup, feeds, fetches = bert.build_pretraining_program(
         cfg, seq_len=512, optimizer_name=optimizer,
         max_predictions_per_seq=80)
@@ -140,10 +146,14 @@ def measure(main, startup, loss_v, *, steps, rotate_feeds, windows=3):
 VARIANTS = {
     # name: (build kwargs, rotate_feeds)
     "full": (dict(), False),
-    "no_dropout": (dict(attn_dropout=0.0), False),
+    "no_attn_dropout": (dict(attn_dropout=0.0), False),
+    "no_hid_dropout": (dict(hidden_dropout=0.0), False),
+    "no_dropout": (dict(attn_dropout=0.0, hidden_dropout=0.0), False),
     "sgd": (dict(optimizer="sgd"), False),
     "fwd_bwd": (dict(prune="bwd"), True),
     "fwd": (dict(prune="fwd"), True),
+    "chunk512": (dict(chunk_mb=512), False),
+    "chunk128": (dict(chunk_mb=128), False),
     "pallas_adamw": (dict(), False),       # PT_FUSED_ADAMW=1
 }
 
