@@ -21,11 +21,39 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 
+def id_keyed_init(seed: int = 0, scale: float = 0.01):
+    """Deterministic per-ID initializer: the row depends only on (seed,
+    id), never on shard layout — a table sharded across N pservers
+    initialises identically to a single-host table (required for the
+    local-vs-distributed parity contract, test_distributed_kv.py).
+
+    Vectorised splitmix64 over the (id, seed, column) lattice (a
+    RandomState per missing row costs ~µs each inside the shard lock —
+    far too slow for 100k-new-id cold pulls). Rows are uniform in
+    [-sqrt(3)·scale, sqrt(3)·scale] (mean 0, std `scale`)."""
+    U = np.uint64
+
+    def init(dim, key):
+        with np.errstate(over="ignore"):
+            x = (U(int(key) & 0xFFFFFFFFFFFFFFFF) * U(0x9E3779B97F4A7C15)
+                 + np.arange(dim, dtype=np.uint64) * U(0xBF58476D1CE4E5B9)
+                 + U(seed) * U(0x94D049BB133111EB))
+            x ^= x >> U(30)
+            x *= U(0xBF58476D1CE4E5B9)
+            x ^= x >> U(27)
+            x *= U(0x94D049BB133111EB)
+            x ^= x >> U(31)
+        u = (x >> U(11)).astype(np.float64) * (1.0 / (1 << 53))  # [0, 1)
+        return ((u * 2.0 - 1.0) * (np.sqrt(3.0) * scale)).astype(np.float32)
+
+    return init
+
+
 class SparseShard:
     def __init__(self, dim: int, initializer):
         self.dim = dim
         self.table: Dict[int, np.ndarray] = {}
-        self.init = initializer
+        self.init = initializer          # init(dim, id) -> row
         self.lock = threading.Lock()
 
     def pull(self, ids: np.ndarray) -> np.ndarray:
@@ -34,7 +62,7 @@ class SparseShard:
             for i, key in enumerate(ids):
                 row = self.table.get(int(key))
                 if row is None:
-                    row = self.init(self.dim).astype(np.float32)
+                    row = self.init(self.dim, int(key)).astype(np.float32)
                     self.table[int(key)] = row
                 out[i] = row
         return out
@@ -45,7 +73,7 @@ class SparseShard:
                 k = int(key)
                 row = self.table.get(k)
                 if row is None:
-                    row = self.init(self.dim).astype(np.float32)
+                    row = self.init(self.dim, k).astype(np.float32)
                 self.table[k] = row - lr * g
 
 
@@ -54,18 +82,10 @@ class LargeScaleKV:
     + DownpourWorker pull/push flow, downpour_worker.cc)."""
 
     def __init__(self, dim: int, num_shards: int = 8, seed: int = 0,
-                 initializer: Optional[Callable[[int], np.ndarray]] = None):
+                 initializer: Optional[Callable] = None):
         self.dim = dim
-        # one RNG per shard (RandomState is not thread-safe; shards are
-        # pulled concurrently under per-shard locks only)
-        self.shards = []
-        for i in range(num_shards):
-            if initializer is not None:
-                init = initializer
-            else:
-                rng = np.random.RandomState(seed * 1000003 + i)
-                init = (lambda d, _r=rng: _r.randn(d) * 0.01)
-            self.shards.append(SparseShard(dim, init))
+        init = initializer or id_keyed_init(seed)
+        self.shards = [SparseShard(dim, init) for _ in range(num_shards)]
 
     def _shard_of(self, ids: np.ndarray):
         return np.mod(ids, len(self.shards)).astype(np.int64)

@@ -1,0 +1,212 @@
+"""Multi-node sharded KV service for massive sparse embeddings.
+
+Capability mirror of the reference's distributed large-scale sparse
+stack: pserver-side sharded tables
+(operators/distributed/large_scale_kv.h), the pslib pull/push client
+(framework/fleet/fleet_wrapper.h:111 PullSparseVarsSync /
+PushSparseVarsWithLabelAsync) and the trainer-side op
+(operators/distributed_ops/distributed_lookup_table_op.cc). TPU twist:
+tables live in pserver HOST memory (tables far larger than HBM never
+touch the chip); trainers reach them through the existing PS RPC layer
+(rpc.py), and the program-side op pulls/pushes via jax.io_callback so
+the lookup composes with the jitted training step.
+
+Sharding: id -> endpoint by `id % num_endpoints` (the reference's hash
+partition), then LargeScaleKV's internal shards within each server.
+Row initialisation is id-keyed (large_scale_kv.id_keyed_init), so ANY
+sharding layout initialises identically — the local-vs-distributed
+parity contract.
+
+Wire format (rpc.py frames carry one tensor each):
+  kv_pull:  name=<table>, arr=int64 ids [N]        -> f32 rows [N, D]
+  kv_push:  name=<table>, arr=uint8 payload        -> None
+            payload = int64 N | int64 ids [N] | f32 grads [N*D]
+            aux = lr as 1e-9-fixed-point int
+  kv_size:  name=<table>                           -> aux = #rows
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..large_scale_kv import LargeScaleKV, id_keyed_init
+from .rpc import RPCClient
+
+_LR_SCALE = 1e9
+
+
+def encode_push(ids: np.ndarray, grads: np.ndarray) -> np.ndarray:
+    ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
+    grads = np.ascontiguousarray(grads, np.float32)
+    head = np.asarray([len(ids)], np.int64)
+    return np.concatenate([head.view(np.uint8), ids.view(np.uint8),
+                           grads.reshape(-1).view(np.uint8)])
+
+
+def decode_push(payload: np.ndarray, dim: int):
+    buf = np.ascontiguousarray(payload, np.uint8)
+    n = int(buf[:8].view(np.int64)[0])
+    ids = buf[8:8 + 8 * n].view(np.int64).copy()
+    grads = buf[8 + 8 * n:].view(np.float32).reshape(n, dim).copy()
+    return ids, grads
+
+
+class KVTables:
+    """The pserver-side table registry; PServer delegates kv_* RPC
+    methods here (reference: listen_and_serv's sparse table handlers)."""
+
+    def __init__(self):
+        self.tables: Dict[str, LargeScaleKV] = {}
+        self._specs: Dict[str, tuple] = {}
+        self._lock = threading.Lock()
+
+    def ensure(self, name: str, dim: int, seed: int = 0) -> LargeScaleKV:
+        with self._lock:
+            kv = self.tables.get(name)
+            if kv is None:
+                kv = LargeScaleKV(dim, initializer=id_keyed_init(seed))
+                self.tables[name] = kv
+                self._specs[name] = (int(dim), int(seed))
+            elif self._specs.get(name) != (int(dim), int(seed)):
+                # the first client's config must not silently win
+                raise ValueError(
+                    f"KV table '{name}' already exists with "
+                    f"(dim, seed)={self._specs[name]}, request asked for "
+                    f"({dim}, {seed}) — use a different table_name or "
+                    f"restart the server")
+            return kv
+
+    def handle(self, method: str, name: str, arr, aux: int):
+        table, _, spec = name.partition("|")   # "emb|dim=64;seed=0"
+        opts = dict(kv.split("=") for kv in spec.split(";") if "=" in kv)
+        dim = int(opts.get("dim", 0))
+        seed = int(opts.get("seed", 0))
+        if method == "kv_pull":
+            kv = self.ensure(table, dim, seed)
+            return kv.pull(np.asarray(arr, np.int64)), 0
+        if method == "kv_push":
+            kv = self.ensure(table, dim, seed)
+            ids, grads = decode_push(arr, kv.dim)
+            kv.push(ids, grads, lr=aux / _LR_SCALE)
+            return None, 0
+        if method == "kv_size":
+            kv = self.tables.get(table)
+            return None, (kv.size() if kv else 0)
+        raise ValueError(f"unknown KV method '{method}'")
+
+
+class KVServer:
+    """Standalone KV-only server (a PServer also serves kv_* methods —
+    use this when no dense-param optimizer blocks are hosted)."""
+
+    def __init__(self, endpoint: str):
+        from .rpc import RPCServer
+
+        self.kv = KVTables()
+        self.server = RPCServer(endpoint, self._handle)
+        self.endpoint = self.server.endpoint
+
+    def _handle(self, method, name, arr, aux):
+        if method == "heartbeat" or method == "barrier":
+            return None, 0
+        if method.startswith("kv_"):
+            return self.kv.handle(method, name, arr, aux)
+        raise ValueError(f"KVServer: unknown method '{method}'")
+
+    def run(self):
+        self.server.wait()
+
+    def shutdown(self):
+        self.server.shutdown()
+
+
+class DistributedKV:
+    """Trainer-side client: one logical table sharded over N pservers
+    (reference: fleet_wrapper.h PullSparseVarsSync — splits ids by
+    server, issues per-server requests, reassembles)."""
+
+    def __init__(self, endpoints, table: str, dim: int, seed: int = 0):
+        if isinstance(endpoints, str):
+            endpoints = [e.strip() for e in endpoints.split(",") if e.strip()]
+        self.endpoints = list(endpoints)
+        self.table = table
+        self.dim = int(dim)
+        self._name = f"{table}|dim={int(dim)};seed={int(seed)}"
+
+    def _split(self, ids: np.ndarray):
+        part = np.mod(ids, len(self.endpoints))
+        return [(ep, np.flatnonzero(part == i))
+                for i, ep in enumerate(self.endpoints)]
+
+    @staticmethod
+    def _fanout(jobs):
+        """Run the per-server jobs concurrently; a failed RPC re-raises
+        on the CALLER (a swallowed error would silently drop a shard's
+        gradients / leave pull rows unset)."""
+        errors = []
+
+        def wrap(fn):
+            try:
+                fn()
+            except Exception as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=wrap, args=(j,)) for j in jobs]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+
+    def pull(self, ids) -> np.ndarray:
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        out = np.empty((len(ids), self.dim), np.float32)
+        parts = self._split(ids)
+
+        def one(ep, idx):
+            rows, _ = RPCClient.get(ep).call("kv_pull", self._name,
+                                             ids[idx])
+            out[idx] = rows
+
+        self._fanout([(lambda ep=ep, idx=idx: one(ep, idx))
+                      for ep, idx in parts if len(idx)])
+        return out
+
+    def push(self, ids, grads, lr: float = 0.01):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(len(ids), self.dim)
+        aux = int(round(lr * _LR_SCALE))
+
+        def one(ep, idx):
+            RPCClient.get(ep).call("kv_push", self._name,
+                                   encode_push(ids[idx], grads[idx]),
+                                   aux=aux)
+
+        self._fanout([(lambda ep=ep, idx=idx: one(ep, idx))
+                      for ep, idx in self._split(ids) if len(idx)])
+
+    def size(self) -> int:
+        total = 0
+        for ep in self.endpoints:
+            _, n = RPCClient.get(ep).call("kv_size", self._name)
+            total += n
+        return total
+
+
+_client_cache: Dict[tuple, DistributedKV] = {}
+_client_lock = threading.Lock()
+
+
+def get_kv_client(endpoints: str, table: str, dim: int,
+                  seed: int = 0) -> DistributedKV:
+    key = (endpoints, table, int(dim), int(seed))
+    with _client_lock:
+        cli = _client_cache.get(key)
+        if cli is None:
+            cli = DistributedKV(endpoints, table, dim, seed)
+            _client_cache[key] = cli
+        return cli

@@ -137,6 +137,11 @@ class PServer:
             self.monitor = HeartBeatMonitor(
                 num_trainers, timeout=heartbeat_timeout,
                 interval=min(heartbeat_timeout / 4, 5.0)).start()
+        # sparse KV tables served from THIS host's memory (reference:
+        # large_scale_kv.h server tables; see kv_service.py)
+        from .kv_service import KVTables
+
+        self.kv = KVTables()
         self.server = RPCServer(endpoint, self._handle)
         self.endpoint = self.server.endpoint
 
@@ -187,6 +192,8 @@ class PServer:
             self.monitor.ping(aux)
         if method == "heartbeat":
             return None, 0
+        if method.startswith("kv_"):
+            return self.kv.handle(method, name, arr, aux)
         if method == "send_grad":
             st = self.states[name]
             with st.cond:

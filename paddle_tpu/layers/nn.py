@@ -913,6 +913,29 @@ def sequence_pool(input, pool_type="sum", lod=None, name=None):
     return out
 
 
+def distributed_embedding(ids, table_name, dim, endpoints, seed=0,
+                          lr=0.01, name=None):
+    """Embedding lookup against the multi-node sharded KV service
+    (reference: layers emitting distributed_lookup_table_op for
+    is_distributed tables). The table lives in pserver host memory — far
+    larger than HBM; the backward pushes row grads for the server-side
+    SGD apply. Creates the [1, dim] proxy parameter that threads the op
+    into the grad graph (the real rows are remote)."""
+    helper = LayerHelper("distributed_embedding", name=name)
+    w = helper.create_parameter(
+        ParamAttr(name=unique_name.generate(f"{table_name}_proxy")),
+        [1, dim], "float32")
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "distributed_lookup_table", {"Ids": [ids], "W": [w]},
+        {"Out": [out]},
+        {"endpoints": endpoints if isinstance(endpoints, str)
+         else ",".join(endpoints),
+         "table_name": table_name, "dim": int(dim), "seed": int(seed),
+         "lr": float(lr)})
+    return out
+
+
 def linear_chain_crf(input, label, param_attr=None, length=None, name=None):
     """CRF NLL layer (reference: layers/nn.py linear_chain_crf): creates
     the [T+2, T] 'transition' parameter (rows 0/1 = start/stop weights)
