@@ -415,3 +415,70 @@ def multihead_attention_fuse_pass(program: Program) -> Program:
     block.ops = new_ops
     program._bump_version()
     return program
+
+
+@register_pass("fuse_bn_act_pass")
+def fuse_bn_act_pass(program: Program) -> Program:
+    """TRAINING-time batch_norm(+elementwise_add)+relu → one
+    fused_bn_add_act op (reference: ir/fuse_bn_act_pass.cc and
+    fuse_bn_add_act_pass.cc installing fused_bn_activation /
+    fused_bn_add_activation). Run BEFORE append_backward: the fused op's
+    pinned-residual custom_vjp then owns the whole backward segment.
+
+    Patterns (Y single-consumed at every hop, training-mode BN only):
+      batch_norm → relu
+      batch_norm → elementwise_add(± either operand order) → relu
+    """
+    block = program.global_block()
+    consumers = _single_consumer_map(block.ops)
+    dead = set()
+    # fused op INSERTS at the relu's position (the pattern's last op) —
+    # a residual Z may be produced between the bn and the relu (the
+    # shortcut branch), so replacing at the bn's position would read Z
+    # before its producer runs
+    fused_at: Dict[int, OpDesc] = {}
+    for op in block.ops:
+        if op.type != "batch_norm" or op.attrs.get("is_test", False) \
+                or op.attrs.get("use_global_stats", False):
+            continue
+        y = _out(op, "Y")
+        cons = consumers.get(y, [])
+        nxt = cons[0] if len(cons) == 1 else None
+        if nxt is None or id(nxt) in dead:
+            continue            # (dead: chain absorbed by an earlier
+        z = None                # match, e.g. the OTHER bn feeding the
+        add_op = None           # same residual add)
+        if nxt.type == "elementwise_add" and \
+                int(nxt.attrs.get("axis", -1)) in (-1, 0):
+            other = _in(nxt, "Y") if _in(nxt, "X") == y else _in(nxt, "X")
+            add_out = _out(nxt, "Out")
+            cons2 = consumers.get(add_out, [])
+            relu = cons2[0] if len(cons2) == 1 and \
+                cons2[0].type == "relu" and id(cons2[0]) not in dead \
+                else None
+            if relu is None:
+                continue
+            add_op, z, nxt = nxt, other, relu
+        if nxt.type != "relu":
+            continue
+        inputs = dict(op.inputs)
+        if z is not None:
+            inputs["Z"] = [z]
+        outputs = dict(op.outputs)
+        outputs["Y"] = [_out(nxt, "Out")]
+        fused_at[id(nxt)] = OpDesc(
+            "fused_bn_add_act", inputs, outputs,
+            {**{k: v for k, v in op.attrs.items()}, "act": "relu"})
+        dead.update((id(op), id(nxt)))
+        if add_op is not None:
+            dead.add(id(add_op))
+
+    new_ops: List[OpDesc] = []
+    for op in block.ops:
+        if id(op) in fused_at:
+            new_ops.append(fused_at[id(op)])
+        elif id(op) not in dead:
+            new_ops.append(op)
+    block.ops = new_ops
+    program._bump_version()
+    return program

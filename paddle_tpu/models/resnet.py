@@ -96,13 +96,16 @@ def resnet_backbone(img, cfg: ResNetConfig, is_test=False):
 def build_classifier_program(cfg: ResNetConfig, batch_size: int = -1,
                              optimizer_name: str = "momentum", lr: float = 0.1,
                              is_test: bool = False, with_optimizer: bool = True,
-                             amp: bool = False):
+                             amp: bool = False, fuse_bn_act: bool = True):
     """ImageNet classification step. Feeds: img [B,3,H,W], label [B,1].
     Fetches: loss, acc1, acc5.
 
     amp=True wraps the optimizer in the static AMP decorator
     (contrib/mixed_precision) so conv/matmul compute runs in bf16 —
-    the TPU equivalent of the reference's fp16 ResNet recipe."""
+    the TPU equivalent of the reference's fp16 ResNet recipe.
+    fuse_bn_act=True rewrites batch_norm(+add)+relu chains into
+    fused_bn_add_act BEFORE the backward builds (training analog of the
+    reference's fuse_bn_act/fuse_bn_add_act passes)."""
     main, startup = Program(), Program()
     with program_guard(main, startup):
         img = layers.static_data("img", [batch_size, *cfg.image_shape])
@@ -120,6 +123,10 @@ def build_classifier_program(cfg: ResNetConfig, batch_size: int = -1,
         prob = layers.softmax(logits)
         acc1 = layers.accuracy(prob, label, k=1)
         acc5 = layers.accuracy(prob, label, k=min(5, cfg.num_classes))
+        if fuse_bn_act and not is_test:
+            from ..core.passes import apply_passes
+
+            apply_passes(main, ["fuse_bn_act_pass"])
         if with_optimizer:
             from .. import optimizer as opt_mod
 

@@ -77,6 +77,30 @@ def ring_attention_op(ins, attrs):
     return {"Out": out}
 
 
+@register_op("fused_bn_add_act", non_diff_inputs=("Mean", "Variance"))
+def fused_bn_add_act_op(ins, attrs):
+    """Training-time BatchNorm(+residual)+ReLU as ONE op with the
+    pinned-residual custom_vjp backward (ops/pallas/bn_act.py; reference
+    fused_bn_add_activation_op.cu). Same contract as batch_norm plus the
+    optional Z side input added before the activation."""
+    from .pallas.bn_act import fused_batch_norm_act
+
+    x = ins["X"][0]
+    scale, bias = ins["Scale"][0], ins["Bias"][0]
+    mean, var = ins["Mean"][0], ins["Variance"][0]
+    z = ins.get("Z", [None])[0]
+    layout = attrs.get("data_layout", "NCHW")
+    y, mo, vo, sm, sv = fused_batch_norm_act(
+        x, scale, bias, mean, var, z,
+        eps=float(attrs.get("epsilon", 1e-5)),
+        momentum=float(attrs.get("momentum", 0.9)),
+        c_axis=1 if layout == "NCHW" else -1,
+        act=attrs.get("act", "relu"),
+        is_test=bool(attrs.get("is_test", False)))
+    return {"Y": y, "MeanOut": mo, "VarianceOut": vo,
+            "SavedMean": sm, "SavedVariance": sv}
+
+
 @register_op("fused_layer_norm")
 def fused_layer_norm_op(ins, attrs):
     """layer_norm over the last axis via the Pallas kernel (nn_ops.layer_norm

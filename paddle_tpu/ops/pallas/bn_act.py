@@ -1,0 +1,123 @@
+"""Fused training-time BatchNorm(+residual add)+ReLU with a pinned
+minimal-residual backward.
+
+Capability mirror of the reference's fused BN kernels
+(operators/fused/fused_bn_activation_op.cu,
+fused_bn_add_activation_op.cu — cuDNN BatchNormEx with activation and
+side-input) and the IR passes that install them
+(framework/ir/fuse_bn_act_pass.cc, fuse_bn_add_act_pass.cc). TPU twist:
+elementwise fusion itself is XLA's job; what the hand-written
+custom_vjp pins down is the RESIDUAL SET and the backward structure —
+exactly (x, per-channel stats) is carried fwd→bwd (never an f32 upcast
+copy of x or the pre-activation tensor), the relu mask is recomputed
+from the normalised form, and the backward runs as two fused passes
+(reductions, then dx/dz) — the minimal HBM traffic batch norm's
+two-pass data dependence allows.
+
+y = act( (x - mean(x)) * rsqrt(var(x)+eps) * scale + bias [+ z] )
+
+NCHW ([B, C, H, W]) via c_axis=1 or NHWC via c_axis=-1; stats in f32
+over bf16 inputs.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _axes_and_bshape(x, c_axis):
+    c_axis = c_axis % x.ndim
+    axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    bshape = [1] * x.ndim
+    bshape[c_axis] = x.shape[c_axis]
+    return axes, tuple(bshape)
+
+
+def _fwd_math(x, scale, bias, z, eps, c_axis, act):
+    axes, bshape = _axes_and_bshape(x, c_axis)
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes)
+    var = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean)
+    inv = jax.lax.rsqrt(var + eps)
+    a = (inv * scale.astype(jnp.float32)).astype(x.dtype)
+    b = (bias.astype(jnp.float32)
+         - mean * inv * scale.astype(jnp.float32)).astype(x.dtype)
+    pre = x * a.reshape(bshape) + b.reshape(bshape)
+    if z is not None:
+        pre = pre + z
+    y = jnp.maximum(pre, 0) if act == "relu" else pre
+    return y, mean, inv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def fused_bn_add_act(x, scale, bias, z, eps, c_axis, act):
+    y, _, _ = _fwd_math(x, scale, bias, z, eps, c_axis, act)
+    return y
+
+
+def _fwd(x, scale, bias, z, eps, c_axis, act):
+    y, mean, inv = _fwd_math(x, scale, bias, z, eps, c_axis, act)
+    # pinned residuals: x, z, per-channel stats + f32 bias — no
+    # pre-activation tensor and no f32 copy of x survive to backward
+    return y, (x, scale, mean, inv, z, bias.astype(jnp.float32))
+
+
+def _bwd(eps, c_axis, act, res, dy):
+    x, scale, mean, inv, z, bias_f = res
+    axes, bshape = _axes_and_bshape(x, c_axis)
+    n = float(np.prod([x.shape[i] for i in axes]))
+    scale_f = scale.astype(jnp.float32)
+    x_hat = (x.astype(jnp.float32) - mean.reshape(bshape)) \
+        * inv.reshape(bshape)
+    dyf = dy.astype(jnp.float32)
+    if act == "relu":
+        pre = x_hat * scale_f.reshape(bshape) + bias_f.reshape(bshape)
+        if z is not None:
+            pre = pre + z.astype(jnp.float32)
+        dyf = jnp.where(pre > 0, dyf, 0.0)
+    dz = dyf.astype(x.dtype) if z is not None else None
+    # BN backward (reference batch_norm_grad math)
+    dbias = jnp.sum(dyf, axis=axes)
+    dscale = jnp.sum(dyf * x_hat, axis=axes)
+    t = (dyf - (dbias.reshape(bshape) / n)
+         - x_hat * (dscale.reshape(bshape) / n))
+    dx = (t * (inv * scale_f).reshape(bshape)).astype(x.dtype)
+    return dx, dscale.astype(scale.dtype), dbias.astype(scale.dtype), dz
+
+
+fused_bn_add_act.defvjp(_fwd, _bwd)
+
+
+def fused_batch_norm_act(x, scale, bias, mean, var, z=None, *,
+                         eps=1e-5, momentum=0.9, c_axis=1, act="relu",
+                         is_test=False):
+    """Full training contract: returns (y, mean_out, var_out,
+    saved_mean, saved_inv). Running-stats update matches
+    ops/nn_ops.batch_norm; the heavy math goes through the pinned-vjp
+    fused path (XLA CSEs the duplicated stat reductions)."""
+    if is_test:
+        _, bshape = _axes_and_bshape(x, c_axis)
+        inv = jax.lax.rsqrt(var.astype(jnp.float32) + eps)
+        a = (inv * scale.astype(jnp.float32)).astype(x.dtype)
+        b = (bias.astype(jnp.float32) - mean.astype(jnp.float32) * inv
+             * scale.astype(jnp.float32)).astype(x.dtype)
+        pre = x * a.reshape(bshape) + b.reshape(bshape)
+        if z is not None:
+            pre = pre + z
+        y = jnp.maximum(pre, 0) if act == "relu" else pre
+        return y, mean, var, jnp.zeros_like(mean), jnp.zeros_like(var)
+
+    axes, _ = _axes_and_bshape(x, c_axis)
+    xf = x.astype(jnp.float32)
+    batch_mean = jnp.mean(xf, axis=axes)
+    batch_var = jnp.mean(jnp.square(xf), axis=axes) \
+        - jnp.square(batch_mean)
+    y = fused_bn_add_act(x, scale, bias, z, float(eps), int(c_axis), act)
+    mean_out = mean * momentum + batch_mean * (1.0 - momentum)
+    var_out = var * momentum + batch_var * (1.0 - momentum)
+    saved_inv = jax.lax.rsqrt(batch_var + eps)
+    return y, mean_out, var_out, batch_mean, saved_inv

@@ -1,0 +1,105 @@
+"""Training-time fused BN(+add)+ReLU (VERDICT r2 #2; reference
+fuse_bn_act_pass.cc / fused_bn_add_activation_op.cu). Contract: the
+fused op + pass must train EXACTLY like the unfused chain."""
+
+import numpy as np
+import pytest
+
+
+class TestFusedOpNumerics:
+    def _ref(self, x, scale, bias, z, eps=1e-5):
+        import jax
+        import jax.numpy as jnp
+
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=(0, 2, 3))
+        var = jnp.var(xf, axis=(0, 2, 3))
+        inv = 1.0 / jnp.sqrt(var + eps)
+        y = (xf - mean[None, :, None, None]) * inv[None, :, None, None] \
+            * scale[None, :, None, None] + bias[None, :, None, None]
+        if z is not None:
+            y = y + z.astype(jnp.float32)
+        return jnp.maximum(y, 0.0)
+
+    @pytest.mark.parametrize("with_z", [False, True])
+    def test_fwd_and_grads_match_autodiff(self, with_z):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops.pallas.bn_act import fused_bn_add_act
+
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(4, 6, 5, 5).astype(np.float32))
+        scale = jnp.asarray(rng.rand(6).astype(np.float32) + 0.5)
+        bias = jnp.asarray(rng.randn(6).astype(np.float32) * 0.1)
+        z = jnp.asarray(rng.randn(4, 6, 5, 5).astype(np.float32)) \
+            if with_z else None
+
+        out = fused_bn_add_act(x, scale, bias, z, 1e-5, 1, "relu")
+        ref = self._ref(x, scale, bias, z)
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+        def loss_fused(*a):
+            zz = a[3] if with_z else None
+            return jnp.sum(fused_bn_add_act(a[0], a[1], a[2], zz,
+                                            1e-5, 1, "relu") ** 2)
+
+        def loss_ref(*a):
+            zz = a[3] if with_z else None
+            return jnp.sum(self._ref(a[0], a[1], a[2], zz) ** 2)
+
+        args = (x, scale, bias) + ((z,) if with_z else ())
+        idx = tuple(range(len(args)))
+        g1 = jax.grad(loss_fused, argnums=idx)(*args)
+        g2 = jax.grad(loss_ref, argnums=idx)(*args)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(a, b, atol=2e-4, rtol=1e-4)
+
+
+class TestFusePassParity:
+    def _train(self, fuse, steps=2):
+        import paddle_tpu as pt
+        from paddle_tpu.core import ir, unique_name
+        from paddle_tpu.models import resnet
+
+        ir._main_program, ir._startup_program = ir.Program(), ir.Program()
+        unique_name.switch()
+        cfg = resnet.ResNetConfig(18, num_classes=4,
+                                  image_shape=(3, 32, 32))
+        main, startup, feeds, fetches = resnet.build_classifier_program(
+            cfg, batch_size=4, lr=0.001, fuse_bn_act=fuse)
+        types = [op.type for op in main.global_block().ops]
+        if fuse:
+            assert "fused_bn_add_act" in types
+            # every relu got absorbed (resnet18: bn+relu and bn+add+relu)
+            assert "relu" not in types[:types.index("pool2d")]
+        else:
+            assert "fused_bn_add_act" not in types
+        exe = pt.Executor()
+        scope = pt.Scope()
+        exe.run(startup, scope=scope, use_compiled=False)
+        rng = np.random.RandomState(1)
+        feed = {"img": rng.randn(4, 3, 32, 32).astype(np.float32),
+                "label": rng.randint(0, 4, (4, 1)).astype(np.int64)}
+        losses = []
+        for _ in range(steps):
+            out = exe.run(main, feed=feed, fetch_list=[fetches["loss"]],
+                          scope=scope)
+            losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+        stats = np.asarray(scope.find_var("conv1_bn_mean"))
+        w = np.asarray(scope.find_var("res2a_c1_w"))
+        return losses, stats, w
+
+    def test_fused_matches_unfused(self):
+        lf, sf, wf = self._train(True)
+        lu, su, wu = self._train(False)
+        # the analytic fused backward is algebraically identical to the
+        # unfused autodiff chain but reassociates f32 math (elementwise
+        # grad parity pinned tight by TestFusedOpNumerics): step-0 loss
+        # and the post-update params/stats must agree closely; later
+        # losses only to reassociation-amplified tolerance
+        np.testing.assert_allclose(lf[0], lu[0], rtol=2e-5)
+        np.testing.assert_allclose(wf, wu, rtol=1e-3, atol=5e-5)
+        np.testing.assert_allclose(sf, su, rtol=1e-3, atol=1e-6)
+        np.testing.assert_allclose(lf, lu, rtol=2e-2)
+        assert lf[-1] < lf[0]

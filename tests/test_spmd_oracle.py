@@ -1,0 +1,107 @@
+"""SPMD interpreting oracle (VERDICT r2 #7): collective programs run
+rank-by-rank op-by-op must match the compiled shard_map path exactly —
+every collective lowering gets a differential check, not just the parity
+tests someone remembered to write. Reference analog: the single-device
+Executor as ParallelExecutor's oracle (framework/executor.cc:180)."""
+
+import numpy as np
+import pytest
+
+
+def _fresh():
+    from paddle_tpu.core import ir, unique_name
+
+    ir._main_program, ir._startup_program = ir.Program(), ir.Program()
+    unique_name.switch()
+
+
+def _train(use_compiled, mesh_axes, build_fn, steps=3):
+    import paddle_tpu as pt
+    from paddle_tpu.parallel import create_mesh
+
+    _fresh()
+    mesh = create_mesh(mesh_axes)
+    main, startup, feed_fn, loss = build_fn(mesh)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    exe.run(startup, scope=scope, use_compiled=False)
+    losses = []
+    for s in range(steps):
+        out = exe.run(main, feed=feed_fn(s), fetch_list=[loss],
+                      scope=scope, use_compiled=use_compiled, mesh=mesh)
+        losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+    params = {n: np.asarray(scope.find_var(n))
+              for n in ("w0", "b0") if scope.find_var(n) is not None}
+    return losses, params
+
+
+def _build_dp(mesh):
+    """Plain data-parallel MLP: per-shard loss + c_allreduce'd grads."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.distributed.fleet.meta_optimizers import \
+        insert_grad_allreduce
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [8], stop_gradient=True)
+        label = layers.data("label", [1], dtype="int64", stop_gradient=True)
+        h = layers.fc(x, 16, act="relu",
+                      param_attr=pt.ParamAttr(
+                          name="w0", initializer=pt.initializer.Xavier(
+                              seed=3)),
+                      bias_attr=pt.ParamAttr(name="b0"))
+        logits = layers.fc(h, 4, param_attr=pt.ParamAttr(
+            name="w1", initializer=pt.initializer.Xavier(seed=4)),
+            bias_attr=pt.ParamAttr(name="b1"))
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        opt = pt.optimizer.SGDOptimizer(0.2)
+        params_grads = opt.backward(loss)
+        insert_grad_allreduce(main, params_grads, nranks=4,
+                              axis_name="dp", average=True)
+        opt.apply_gradients(params_grads)
+
+    def feed_fn(s):
+        rng = np.random.RandomState(100 + s)
+        return {"x": rng.randn(8, 8).astype(np.float32),
+                "label": rng.randint(0, 4, (8, 1)).astype(np.int64)}
+
+    return main, startup, feed_fn, loss
+
+
+def _build_dp_sp_bert(mesh):
+    """dp2 x sp2 BERT MLM: ring attention + global loss psums — the
+    composed collective program from the SP test suite."""
+    import paddle_tpu as pt
+    from paddle_tpu.models import bert
+
+    cfg = bert.BertConfig(vocab_size=64, hidden_size=32,
+                          num_hidden_layers=2, num_attention_heads=2,
+                          intermediate_size=64, max_position_embeddings=32,
+                          hidden_dropout_prob=0.0,
+                          attention_probs_dropout_prob=0.0,
+                          use_ring_attention=True)
+    main, startup, feeds, fetches = bert.build_pretraining_program(
+        cfg, seq_len=32, batch_size=4, lr=5e-3, with_nsp=False,
+        sequence_parallel=2, data_parallel=2)
+
+    def feed_fn(s):
+        return bert.synthetic_pretraining_batch(cfg, 4, 32, seed=200 + s)
+
+    return main, startup, feed_fn, fetches["loss"]
+
+
+class TestSPMDOracle:
+    def test_dp_program_interpreted_matches_compiled(self):
+        lc, pc = _train(True, {"dp": 4}, _build_dp)
+        li, pi = _train(False, {"dp": 4}, _build_dp)
+        np.testing.assert_allclose(li, lc, rtol=2e-5)
+        for n in pc:
+            np.testing.assert_allclose(pi[n], pc[n], rtol=2e-5,
+                                       err_msg=n)
+        assert lc[-1] < lc[0]
+
+    def test_dp_sp_ring_attention_interpreted_matches_compiled(self):
+        lc, _ = _train(True, {"dp": 2, "sp": 2}, _build_dp_sp_bert)
+        li, _ = _train(False, {"dp": 2, "sp": 2}, _build_dp_sp_bert)
+        np.testing.assert_allclose(li, lc, rtol=5e-5)

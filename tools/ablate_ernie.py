@@ -34,7 +34,6 @@ def build(attn_dropout=0.1, hidden_dropout=0.1, optimizer="adamw",
     backward+optimizer ops, prune='bwd' drops optimizer ops."""
     import paddle_tpu as pt
     from paddle_tpu.core import ir, unique_name
-    from paddle_tpu.core.ir import OpRole
     from paddle_tpu.models import bert
 
     if chunk_mb is not None:
@@ -53,66 +52,74 @@ def build(attn_dropout=0.1, hidden_dropout=0.1, optimizer="adamw",
         max_predictions_per_seq=80)
     fetch = fetches["loss"]
     if prune:
-        blk = main.global_block()
-
-        def drop(op):
-            r = int(op.attrs.get("op_role", 0))
-            if r & int(OpRole.Optimize) or r & int(OpRole.LRSched):
-                return True
-            if prune == "fwd" and (r & 0xF) == int(OpRole.Backward):
-                return True
-            return False
-
-        blk.ops = [op for op in blk.ops if not drop(op)]
-        if prune == "bwd":
-            # grads are not persistable: without a consumer XLA would DCE
-            # the whole backward (especially every dW matmul, which only
-            # feeds the removed optimizer). Probe = sum of all grad means,
-            # fetched instead of the loss (~one extra bf16 read pass).
-            from paddle_tpu.core.ir import OpDesc
-
-            parts = []
-            for i, (p, g) in enumerate(sorted(main.grad_var_map.items())):
-                if not blk.has_var(g):
-                    continue
-                out = blk.create_var(name=f"_probe_{i}", shape=(1,),
-                                     dtype="float32")
-                blk.ops.append(OpDesc(
-                    "reduce_mean", {"X": [g]}, {"Out": [out.name]},
-                    {"dim": None, "keep_dim": False, "reduce_all": True}))
-                parts.append(out.name)
-            probe = blk.create_var(name="_grad_probe", shape=(1,),
-                                   dtype="float32")
-            blk.ops.append(OpDesc("sum", {"X": parts},
-                                  {"Out": [probe.name]}, {}))
-            fetch = probe
-        # Without persistable writes the executor's no-fetch executable
-        # DCEs the whole computation (outputs = state + fetches only).
-        # Accumulate the probe into a persistable scalar: keeps every
-        # step's compute alive AND chains steps through device state so
-        # no dispatch sees repeated inputs.
-        from paddle_tpu.core.ir import OpDesc as _Op
-
-        acc = blk.create_var(name="_probe_acc", shape=(1,),
-                             dtype="float32", persistable=True)
-        src = fetch.name if prune == "bwd" else fetches["loss"].name
-        blk.ops.append(_Op("cast", {"X": [src]}, {"Out": ["_probe_f32"]},
-                           {"out_dtype": "float32"}))
-        blk.create_var(name="_probe_f32", shape=(1,), dtype="float32")
-        blk.ops.append(_Op("sum", {"X": [acc.name, "_probe_f32"]},
-                           {"Out": [acc.name]}, {}))
-        sblk = startup.global_block()
-        sblk.create_var(name=acc.name, shape=(1,), dtype="float32",
-                        persistable=True)
-        sblk.ops.append(_Op("fill_constant", {}, {"Out": [acc.name]},
-                            {"shape": [1], "value": 0.0,
-                             "dtype": "float32"}))
-        main._bump_version()
-        startup._bump_version()
+        fetch = prune_program(main, startup, fetches["loss"], prune)
     return cfg, main, startup, fetch
 
 
-def measure(main, startup, loss_v, *, steps, rotate_feeds, windows=3):
+def prune_program(main, startup, loss_var, prune):
+    """Drop optimizer (+ backward for prune='fwd') ops and install the
+    probe machinery that defeats the executor's DCE (see module doc).
+    Returns the fetch variable for the pruned program."""
+    from paddle_tpu.core.ir import OpDesc, OpRole
+
+    blk = main.global_block()
+    fetch = loss_var
+
+    def drop(op):
+        r = int(op.attrs.get("op_role", 0))
+        if r & int(OpRole.Optimize) or r & int(OpRole.LRSched):
+            return True
+        if prune == "fwd" and (r & 0xF) == int(OpRole.Backward):
+            return True
+        return False
+
+    blk.ops = [op for op in blk.ops if not drop(op)]
+    if prune == "bwd":
+        # grads are not persistable: without a consumer XLA would DCE
+        # the whole backward (especially every dW matmul, which only
+        # feeds the removed optimizer). Probe = sum of all grad means,
+        # fetched instead of the loss (~one extra bf16 read pass).
+        parts = []
+        for i, (p, g) in enumerate(sorted(main.grad_var_map.items())):
+            if not blk.has_var(g):
+                continue
+            out = blk.create_var(name=f"_probe_{i}", shape=(1,),
+                                 dtype="float32")
+            blk.ops.append(OpDesc(
+                "reduce_mean", {"X": [g]}, {"Out": [out.name]},
+                {"dim": None, "keep_dim": False, "reduce_all": True}))
+            parts.append(out.name)
+        probe = blk.create_var(name="_grad_probe", shape=(1,),
+                               dtype="float32")
+        blk.ops.append(OpDesc("sum", {"X": parts},
+                              {"Out": [probe.name]}, {}))
+        fetch = probe
+    # Without persistable writes the executor's no-fetch executable
+    # DCEs the whole computation (outputs = state + fetches only).
+    # Accumulate the probe into a persistable scalar: keeps every
+    # step's compute alive AND chains steps through device state so
+    # no dispatch sees repeated inputs.
+    acc = blk.create_var(name="_probe_acc", shape=(1,),
+                         dtype="float32", persistable=True)
+    src = fetch.name if prune == "bwd" else loss_var.name
+    blk.ops.append(OpDesc("cast", {"X": [src]}, {"Out": ["_probe_f32"]},
+                          {"out_dtype": "float32"}))
+    blk.create_var(name="_probe_f32", shape=(1,), dtype="float32")
+    blk.ops.append(OpDesc("sum", {"X": [acc.name, "_probe_f32"]},
+                          {"Out": [acc.name]}, {}))
+    sblk = startup.global_block()
+    sblk.create_var(name=acc.name, shape=(1,), dtype="float32",
+                    persistable=True)
+    sblk.ops.append(OpDesc("fill_constant", {}, {"Out": [acc.name]},
+                           {"shape": [1], "value": 0.0,
+                            "dtype": "float32"}))
+    main._bump_version()
+    startup._bump_version()
+    return fetch
+
+
+def measure(main, startup, loss_v, *, steps, rotate_feeds, windows=3,
+            make_feed=None, n_rotate=8):
     import jax.numpy as jnp
 
     import paddle_tpu as pt
@@ -121,12 +128,14 @@ def measure(main, startup, loss_v, *, steps, rotate_feeds, windows=3):
     exe = pt.Executor()
     scope = pt.Scope()
     exe.run(startup, scope=scope, use_compiled=False)
-    cfg = bert.ernie_large()
-    n_feeds = 8 if rotate_feeds else 1
+    if make_feed is None:
+        cfg = bert.ernie_large()
+        make_feed = lambda i: bert.synthetic_pretraining_batch(  # noqa: E731
+            cfg, 32, 512, seed=i, max_predictions_per_seq=80)
+    n_feeds = n_rotate if rotate_feeds else 1
     feeds = []
     for i in range(n_feeds):
-        data = bert.synthetic_pretraining_batch(
-            cfg, 32, 512, seed=i, max_predictions_per_seq=80)
+        data = make_feed(i)
         feeds.append({k: jnp.asarray(v) for k, v in data.items()})
     for _ in range(2):
         exe.run(main, feed=feeds[0], fetch_list=[loss_v], scope=scope)

@@ -1,0 +1,88 @@
+"""ResNet-50 step-time ablation (BASELINE ladder row 2, 13.8% MFU at
+round 2 — BN/bandwidth-bound hypothesis). Same methodology as
+tools/ablate_ernie.py (probe accumulators, rotating feeds).
+
+Variants: full | fwd | fwd_bwd | bn_frozen (use_global_stats: BN uses
+running stats — removes the batch-stat reduction passes) | fp32 (AMP
+off) | nhwc-check left to XLA.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from tools.ablate_ernie import measure, prune_program
+
+BATCH = 256
+
+
+def build(amp=True, prune=None, bn_global_stats=False, fuse_bn_act=True):
+    import paddle_tpu as pt
+    from paddle_tpu.core import ir, unique_name
+    from paddle_tpu.models import resnet
+
+    ir._main_program, ir._startup_program = ir.Program(), ir.Program()
+    unique_name.switch()
+    cfg = resnet.resnet50()
+    main, startup, feeds, fetches = resnet.build_classifier_program(
+        cfg, batch_size=BATCH, amp=amp,
+        fuse_bn_act=fuse_bn_act and not bn_global_stats)
+    if bn_global_stats:
+        # forward-side stats freeze only: __vjp_grad__ snapshots
+        # fwd_attrs at build (registry.py), so the backward still
+        # recomputes batch stats — the variant isolates the forward
+        # reduction cost, nothing more
+        for op in main.global_block().ops:
+            if op.type == "batch_norm":
+                op.attrs["use_global_stats"] = True
+    fetch = fetches["loss"]
+    if prune:
+        fetch = prune_program(main, startup, fetches["loss"], prune)
+    return main, startup, fetch
+
+
+VARIANTS = {
+    "full": (dict(), False),
+    "fwd": (dict(prune="fwd"), True),
+    "fwd_bwd": (dict(prune="bwd"), True),
+    "bn_frozen": (dict(bn_global_stats=True), False),
+    "fp32": (dict(amp=False), False),
+}
+
+
+def main():
+    from paddle_tpu.models import resnet
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--variants", default="full,fwd,fwd_bwd,bn_frozen")
+    args = ap.parse_args()
+    cfg = resnet.resnet50()
+
+    def make_feed(i):
+        return resnet.synthetic_batch(cfg, BATCH, seed=i)
+
+    results = {}
+    for name in args.variants.split(","):
+        kw, rotate = VARIANTS[name]
+        try:
+            mainp, startup, fetch = build(**kw)
+            ms, loss = measure(mainp, startup, fetch, steps=args.steps,
+                               rotate_feeds=rotate, make_feed=make_feed,
+                               n_rotate=2)
+            results[name] = {"ms": round(ms, 2), "loss": round(loss, 4)}
+        except Exception as e:
+            results[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        print(json.dumps({name: results[name]}), flush=True)
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()
