@@ -194,6 +194,10 @@ class Executor:
     oracle (differential-testing / debugging).
     """
 
+    # process-wide: the backend does not support unsafe_buffer_pointer
+    # (axon raises UNIMPLEMENTED — and the raise round-trips the relay)
+    _buf_ptr_unsupported = False
+
     def __init__(self, place: Optional[Place] = None):
         self.place = place or default_place()
         self._cache: Dict[tuple, _CompiledEntry] = {}
@@ -533,19 +537,33 @@ class Executor:
                     f"persistable var '{n}' not initialised in scope — "
                     f"did you run the startup program?")
             # state buffers are donated: two names aliasing one device
-            # buffer would fail Execute(); copy the duplicate
-            ptr = getattr(v, "unsafe_buffer_pointer", None)
+            # buffer would fail Execute(); copy the duplicate. The axon
+            # backend raises UNIMPLEMENTED for unsafe_buffer_pointer and
+            # the raise costs a relay round trip PER VAR PER STEP
+            # (measured ~5 ms/step on MNIST) — remember the failure and
+            # fall back to object identity, which catches the common
+            # same-array-two-names aliasing
+            ptr = None if Executor._buf_ptr_unsupported else \
+                getattr(v, "unsafe_buffer_pointer", None)
             if ptr is not None:
                 try:
-                    key = v.unsafe_buffer_pointer()
-                    if key in seen_bufs:
-                        import jax.numpy as jnp
+                    key = ptr()
+                except Exception as e:
+                    # latch ONLY the backend-wide unsupported case; a
+                    # per-array failure (deleted/sharded array) must not
+                    # disable real pointer dedup for the whole process
+                    msg = str(e).lower()
+                    if "unimplemented" in msg or "unsupported" in msg:
+                        Executor._buf_ptr_unsupported = True
+                    key = id(v)
+            else:
+                key = id(v)
+            if key in seen_bufs:
+                import jax.numpy as jnp
 
-                        v = jnp.copy(v)
-                    else:
-                        seen_bufs[key] = n
-                except Exception:
-                    pass
+                v = jnp.copy(v)
+            else:
+                seen_bufs[key] = n
             state[n] = v
         ro = {n: scope.find_var(n) for n in entry.ro_names}
         step = scope.find_var("@STEP_COUNTER@")

@@ -110,11 +110,20 @@ class PServer:
                  grad_to_param: Optional[Dict[str, str]] = None,
                  grad_to_ops: Optional[Dict[str, list]] = None,
                  common_ops: Optional[list] = None,
-                 heartbeat_timeout: float = 0.0):
+                 heartbeat_timeout: float = 0.0,
+                 mode: Optional[str] = None, merge_size: int = 0):
+        """mode: 'sync' | 'async' | 'half_async' (overrides the legacy
+        sync_mode bool). half_async (reference communicator.h:343
+        HalfAsyncCommunicator): no cross-trainer barriers, but received
+        grads BUFFER and apply as the MEAN of `merge_size` contributions
+        (default num_trainers) — async liveness with sync-like merged
+        updates."""
         import paddle_tpu as pt
 
         self.num_trainers = int(num_trainers)
-        self.sync_mode = bool(sync_mode)
+        self.mode = mode or ("sync" if sync_mode else "async")
+        self.sync_mode = self.mode == "sync"
+        self.merge_size = int(merge_size or num_trainers)
         self.program = pserver_program
         self.scope = pt.Scope()
         self.exe = pt.Executor(pt.CPUPlace())
@@ -207,6 +216,19 @@ class PServer:
                             # a failed apply must not leave this step's
                             # grads pending — the NEXT step's first send
                             # would complete the barrier with a stale mix
+                            st.pending.clear()
+                        st.version += 1
+                        st.cond.notify_all()
+                elif self.mode == "half_async":
+                    # buffer by arrival order (duplicates from one fast
+                    # trainer merge too — reference HalfAsync's queue
+                    # semantics), apply the MEAN per merge_size batch
+                    st.pending[len(st.pending)] = arr
+                    if len(st.pending) >= self.merge_size:
+                        mean = np.mean(list(st.pending.values()), axis=0)
+                        try:
+                            self._apply(name, mean.astype(arr.dtype))
+                        finally:
                             st.pending.clear()
                         st.version += 1
                         st.cond.notify_all()

@@ -170,3 +170,257 @@ def static_loop(n: int, body_fn: Callable, loop_vars: Sequence[Variable],
          "body_out_names": [v.name for v in body_outs],
          "ext_names": list(ext)})
     return out_vars
+
+
+class DynamicRNN:
+    """Variable-length RNN builder (reference: layers/control_flow.py
+    DynamicRNN — LoD-driven decode loops over lod_rank_table). Padded
+    -dense redesign: sequences stay [B, S, D] with a Length tensor; the
+    loop is ONE differentiable static_loop (lax.scan) over S steps whose
+    memories FREEZE once a row passes its length (`where(i < len, new,
+    old)`) — bit-equal final states to the reference's shrinking-batch
+    schedule, compiler-friendly static shapes instead of LoD
+    bookkeeping. The reference's array read/write ops back the per-step
+    access (ops/control_flow_ops.py array_read/array_write).
+
+    Usage (fluid surface):
+        drnn = DynamicRNN()
+        with drnn.block():
+            w = drnn.step_input(emb, length=seq_len)   # [B, D] per step
+            prev = drnn.memory(shape=[H])
+            h = some_layers(w, prev)
+            drnn.update_memory(prev, h)
+            drnn.output(h)
+        out = drnn()                 # [B, S, H], zero past each length
+    """
+
+    def __init__(self, name=None):
+        from ..core import unique_name
+
+        self.helper = LayerHelper("dynamic_rnn", name=name)
+        self._uname = unique_name.generate(name or "drnn")
+        self._program = default_main_program()
+        self._step_inputs = []     # (stacked outer [S,B,D], step var)
+        self._memories = []        # dict per memory
+        self._outputs = []         # (outer zero buffer, inblock buf name,
+        #                             step value var, out name)
+        self._length = None
+        self._max_len = None
+        self._blk = None
+        self._i = None
+        self._results = None
+
+    # -- inside-block API ---------------------------------------------------
+    def block(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            self._blk = self._program.create_block()
+            self._i = self._blk.create_var(
+                name=f"{self._uname}.i", shape=[], dtype="int32",
+                stop_gradient=True)
+            try:
+                yield
+            except BaseException:
+                # assembling a half-built block would mask the user's
+                # error with an unrelated secondary failure
+                self._program.rollback()
+                raise
+            else:
+                self._program.rollback()
+                self._assemble()
+
+        return cm()
+
+    def _parent_block(self):
+        return self._program.blocks[self._blk.parent_idx]
+
+    def step_input(self, x: Variable, length: Optional[Variable] = None):
+        """Declare a [B, S, D...] sequence input; returns its [B, D...]
+        slice for the current step."""
+        assert self._blk is not None, "call inside drnn.block()"
+        if length is not None:
+            self._length = length
+        if self._max_len is None:
+            if x.shape[1] is None or int(x.shape[1]) <= 0:
+                raise ValueError(
+                    f"DynamicRNN.step_input: the sequence dim of "
+                    f"{x.name} is dynamic ({x.shape}) — the padded loop "
+                    f"needs a static max length (reshape/pad the input)")
+            self._max_len = int(x.shape[1])
+        parent = self._parent_block()
+        perm = [1, 0] + list(range(2, len(x.shape)))
+        stacked = parent.create_var(
+            name=f"{x.name}.{self._uname}.steps",
+            shape=[x.shape[1], x.shape[0]] + list(x.shape[2:]),
+            dtype=x.dtype, stop_gradient=bool(x.stop_gradient))
+        parent.append_op("transpose2", {"X": [x.name]},
+                         {"Out": [stacked.name]}, {"axis": perm})
+        step = self._blk.create_var(
+            name=f"{stacked.name}.t", shape=[x.shape[0]] + list(x.shape[2:]),
+            dtype=x.dtype, stop_gradient=bool(x.stop_gradient))
+        self._blk.append_op("array_read", {"X": [stacked.name],
+                                           "I": [self._i.name]},
+                            {"Out": [step.name]}, {})
+        self._step_inputs.append((stacked, step))
+        return step
+
+    def static_input(self, x: Variable):
+        """A non-sequence input visible every step (ext capture)."""
+        return x
+
+    def memory(self, init: Optional[Variable] = None, shape=None,
+               value: float = 0.0, dtype="float32"):
+        assert self._blk is not None, "call inside drnn.block()"
+        parent = self._parent_block()
+        if init is None:
+            if shape is None:
+                raise ValueError("memory() needs init= or shape=")
+            if not self._step_inputs:
+                raise ValueError("declare a step_input before a "
+                                 "shape-initialised memory (batch size)")
+            stacked = self._step_inputs[0][0]
+            b = stacked.shape[1]
+            init = parent.create_var(
+                name=f"{self._uname}.mem{len(self._memories)}.init",
+                shape=[b] + list(shape), dtype=dtype, stop_gradient=True)
+            # batch dim may be dynamic (-1): copy it from the stacked
+            # input at run time (reference fill_constant_batch_size_like)
+            parent.append_op(
+                "fill_constant_batch_size_like",
+                {"Input": [stacked.name]}, {"Out": [init.name]},
+                {"shape": [1] + list(shape), "value": float(value),
+                 "dtype": dtype, "input_dim_idx": 1,
+                 "output_dim_idx": 0})
+        mem = self._blk.create_var(
+            name=f"{self._uname}.mem{len(self._memories)}",
+            shape=list(init.shape), dtype=init.dtype)
+        self._memories.append({"init": init, "mem": mem, "update": None})
+        return mem
+
+    def update_memory(self, mem: Variable, new: Variable):
+        assert self._blk is not None, "call inside drnn.block()"
+        rec = next(r for r in self._memories if r["mem"] is mem)
+        if self._length is not None:
+            new = self._masked(new, mem)
+        rec["update"] = new
+
+    def _masked(self, new: Variable, old: Variable):
+        """where(i < length, new, old) — freeze finished rows."""
+        blk = self._blk
+        self._mask_n = getattr(self, "_mask_n", 0) + 1
+        n = self._mask_n
+        cond = blk.create_var(name=f"{self._uname}.live{n}",
+                              shape=[old.shape[0]], dtype="bool",
+                              stop_gradient=True)
+        blk.append_op("less_than",
+                      {"X": [self._i.name], "Y": [self._length.name]},
+                      {"Out": [cond.name]}, {})
+        for _ in range(max(len(old.shape) - 1, 0)):
+            c2 = blk.create_var(name=f"{cond.name}.u",
+                                shape=list(cond.shape) + [1],
+                                dtype="bool", stop_gradient=True)
+            blk.append_op("unsqueeze2", {"X": [cond.name]},
+                          {"Out": [c2.name]},
+                          {"axes": [len(cond.shape)]}, infer_shape=False)
+            cond = c2
+        out = blk.create_var(name=f"{new.name}.sel{n}",
+                             shape=list(old.shape), dtype=old.dtype)
+        blk.append_op("where", {"Condition": [cond.name], "X": [new.name],
+                                "Y": [old.name]}, {"Out": [out.name]}, {})
+        return out
+
+    def output(self, *outs):
+        assert self._blk is not None, "call inside drnn.block()"
+        parent = self._parent_block()
+        for o in outs:
+            s = self._max_len
+            buf_init = parent.create_var(
+                name=f"{self._uname}.out{len(self._outputs)}.buf",
+                shape=[s] + list(o.shape), dtype=o.dtype,
+                stop_gradient=True)
+            if self._step_inputs and any(d in (-1, None)
+                                         for d in o.shape or ()):
+                parent.append_op(
+                    "fill_constant_batch_size_like",
+                    {"Input": [self._step_inputs[0][0].name]},
+                    {"Out": [buf_init.name]},
+                    {"shape": [s, 1] + list(o.shape[1:]), "value": 0.0,
+                     "dtype": str(o.dtype), "input_dim_idx": 1,
+                     "output_dim_idx": 1})
+            else:
+                parent.append_op(
+                    "fill_constant", {}, {"Out": [buf_init.name]},
+                    {"shape": [s] + list(o.shape), "value": 0.0,
+                     "dtype": str(o.dtype)})
+            buf = self._blk.create_var(
+                name=f"{buf_init.name}.c", shape=list(buf_init.shape),
+                dtype=o.dtype)
+            if self._length is not None:
+                zero = self._blk.create_var(
+                    name=f"{o.name}.z{len(self._outputs)}",
+                    shape=list(o.shape), dtype=o.dtype,
+                    stop_gradient=True)
+                self._blk.append_op(
+                    "fill_constant_batch_size_like",
+                    {"Input": [o.name]}, {"Out": [zero.name]},
+                    {"shape": [1] + list(o.shape[1:]), "value": 0.0,
+                     "dtype": str(o.dtype), "input_dim_idx": 0,
+                     "output_dim_idx": 0})
+                o = self._masked(o, zero)
+            new_buf = self._blk.create_var(
+                name=f"{buf.name}.w", shape=list(buf.shape), dtype=o.dtype)
+            self._blk.append_op("array_write",
+                                {"X": [buf.name], "I": [self._i.name],
+                                 "V": [o.name]},
+                                {"Out": [new_buf.name]}, {})
+            self._outputs.append({"init": buf_init, "buf": buf,
+                                  "new_buf": new_buf})
+
+    # -- assembly -----------------------------------------------------------
+    def _assemble(self):
+        blk = self._blk
+        carries = [r["mem"] for r in self._memories] \
+            + [r["buf"] for r in self._outputs]
+        inits = [r["init"] for r in self._memories] \
+            + [r["init"] for r in self._outputs]
+        body_outs = []
+        for r in self._memories:
+            body_outs.append(r["update"] if r["update"] is not None
+                             else r["mem"])
+        body_outs += [r["new_buf"] for r in self._outputs]
+        ext = [n for n in _block_external_reads(
+            [blk], extra_needed=[v.name for v in body_outs])
+            if n not in {c.name for c in carries} and n != self._i.name]
+        out_vars = [self.helper.create_variable_for_type_inference(v.dtype)
+                    for v in inits]
+        self.helper.append_op(
+            "static_loop", {"X": [v.name for v in inits], "Ext": ext},
+            {"Out": [v.name for v in out_vars]},
+            {"body_block": blk, "carry_names": [c.name for c in carries],
+             "i_name": self._i.name, "num_steps": int(self._max_len),
+             "body_out_names": [v.name for v in body_outs],
+             "ext_names": list(ext)})
+        n_mem = len(self._memories)
+        finals = []
+        for k, bufv in enumerate(out_vars[n_mem:]):
+            # [S, B, D...] -> [B, S, D...] (rank from the init buffer —
+            # static_loop outputs skip shape inference)
+            rank = len(self._outputs[k]["init"].shape)
+            out = self.helper.create_variable_for_type_inference(bufv.dtype)
+            self.helper.append_op("transpose2", {"X": [bufv.name]},
+                                  {"Out": [out.name]},
+                                  {"axis": [1, 0] + list(range(2, rank))})
+            finals.append(out)
+        self._results = {"memories": out_vars[:n_mem], "outputs": finals}
+
+    def __call__(self):
+        assert self._results is not None, "finish drnn.block() first"
+        outs = self._results["outputs"]
+        return outs[0] if len(outs) == 1 else outs
+
+    def final_memories(self):
+        """Final (length-frozen) memory states — the reference's
+        drnn memory at sequence end."""
+        return self._results["memories"]

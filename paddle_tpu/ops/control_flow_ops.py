@@ -257,3 +257,42 @@ def static_loop_op(ins, attrs):
 
     (outs), _ = jax.lax.scan(body, tuple(ins["X"]), jnp.arange(n))
     return {"Out": list(outs)}
+
+
+@register_op("array_read", non_diff_inputs=("I",))
+def array_read(ins, attrs):
+    """Read slot I of a step-stacked tensor array (reference:
+    controlflow/tensor_array_read_write.cc ReadFromArray — LoDTensorArray
+    becomes a [S, ...] stacked tensor under static shapes; dynamic index
+    lowers to lax.dynamic_index inside scans)."""
+    import jax.numpy as jnp
+
+    x, i = ins["X"][0], ins["I"][0]
+    return {"Out": jnp.take(x, jnp.asarray(i, jnp.int32).reshape(()),
+                            axis=0)}
+
+
+@register_op("array_write", non_diff_inputs=("I",))
+def array_write(ins, attrs):
+    """Write V into slot I of the stacked array (reference WriteToArray);
+    functional: returns the updated buffer (the executor threads it
+    in-place through the var name)."""
+    import jax.numpy as jnp
+
+    x, i, v = ins["X"][0], ins["I"][0], ins["V"][0]
+    return {"Out": x.at[jnp.asarray(i, jnp.int32).reshape(())].set(
+        v.astype(x.dtype))}
+
+
+@register_op("lod_rank_table", non_diff_inputs=("X",))
+def lod_rank_table(ins, attrs):
+    """Length-descending rank table (reference:
+    lod_rank_table_op.cc — items sorted by sequence length desc, used to
+    schedule shrinking-batch RNN decoding). Padded form: X carries the
+    per-row Length [B]; outputs Items (sorted lengths) and Index (the
+    original row of each sorted position)."""
+    import jax.numpy as jnp
+
+    ln = ins["X"][0].reshape(-1).astype(jnp.int32)
+    order = jnp.argsort(-ln, stable=True)
+    return {"Items": ln[order], "Index": order.astype(jnp.int32)}

@@ -546,3 +546,20 @@ def tril_triu(ins, attrs):
 def increment(ins, attrs):
     x = ins["X"][0]
     return {"Out": x + np.asarray(attrs.get("step", 1.0), x.dtype)}
+
+
+@register_op("fill_constant_batch_size_like", non_diff_inputs=("Input",))
+def fill_constant_batch_size_like(ins, attrs):
+    """reference: fill_constant_batch_size_like_op.cc — fill with the
+    batch dim copied from Input at runtime (dynamic-batch inits for RNN
+    memories)."""
+    import jax.numpy as jnp
+
+    x = ins["Input"][0]
+    shape = [int(d) for d in attrs["shape"]]
+    in_idx = int(attrs.get("input_dim_idx", 0))
+    out_idx = int(attrs.get("output_dim_idx", 0))
+    shape[out_idx] = x.shape[in_idx]
+    dtype = convert_dtype(attrs.get("dtype", "float32"))
+    return {"Out": jnp.full(tuple(shape), attrs.get("value", 0.0),
+                            np.dtype(dtype))}

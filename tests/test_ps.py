@@ -138,6 +138,51 @@ class TestTranspiler:
         finally:
             server.shutdown()
 
+    def test_half_async_merges_before_apply(self):
+        """HalfAsync (reference communicator.h:343): no barriers, but
+        grads buffer and apply as the mean of merge_size contributions —
+        two sends of g and -g/3 must apply ONE update with their mean."""
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+        from paddle_tpu.core import ir, unique_name
+        from paddle_tpu.distributed.ps import DistributeTranspiler, PServer
+        from paddle_tpu.distributed.ps.rpc import RPCClient
+
+        ir._main_program, ir._startup_program = ir.Program(), ir.Program()
+        unique_name.switch()
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [4], stop_gradient=True)
+            y = layers.fc(x, 2, param_attr=pt.ParamAttr(name="w"))
+            loss = layers.mean(y * y)
+            pt.optimizer.SGDOptimizer(1.0).minimize(loss)
+        t = DistributeTranspiler()
+        t.transpile(0, program=main, startup_program=startup,
+                    pservers="127.0.0.1:17481", trainers=2, sync_mode=False)
+        prog, ps_startup = t.get_pserver_programs("127.0.0.1:17481")
+        server = PServer("127.0.0.1:17481", prog, ps_startup,
+                         num_trainers=2, mode="half_async", merge_size=2,
+                         grad_to_param=prog._ps_grad_to_param,
+                         grad_to_ops=prog._ps_grad_to_ops,
+                         common_ops=prog._ps_common_ops)
+        try:
+            cli = RPCClient(server.endpoint)
+            (g,) = [g for g in prog._ps_grad_to_param
+                    if prog._ps_grad_to_param[g] == "w"]
+            w0 = np.asarray(server.scope.find_var("w")).copy()
+            gv = np.ones_like(w0)
+            cli.call("send_grad", g, gv, aux=0)
+            # buffered, not yet applied
+            np.testing.assert_allclose(
+                np.asarray(server.scope.find_var("w")), w0)
+            cli.call("send_grad", g, -gv / 3.0, aux=0)
+            # applied once with mean (1 - 1/3)/2 = 1/3, lr 1.0
+            np.testing.assert_allclose(
+                np.asarray(server.scope.find_var("w")), w0 - gv / 3.0,
+                rtol=1e-6)
+        finally:
+            server.shutdown()
+
     def test_no_optimizer_raises(self):
         import paddle_tpu as pt
         from paddle_tpu import layers
