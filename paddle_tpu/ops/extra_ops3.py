@@ -1,0 +1,632 @@
+"""Third batch of op-surface parity lowerings (round 3).
+
+Capability mirror of assorted remaining reference ops
+(paddle/fluid/operators/: allclose_op.cc, bernoulli_op.cc, empty_op.cc,
+fill_op.cc, diag_embed_op.cc, is_empty_op.cc, unique_op.cc,
+unique_with_counts_op.cc, where_index_op.cc, sampling_id_op.cc,
+seed_op.cc, shuffle_batch_op.cc, squared_l2_distance_op.cc,
+teacher_student_sigmoid_loss_op.cc, chunk_eval_op.cc,
+average_accumulates_op.cc, *_batch_size_like ops, scatter_nd_add_op.cc,
+add_position_encoding_op.cc, roi_pool_op.cc, spp_op.cc,
+split_ids_op.cc, split_selected_rows_op.cc, coalesce_tensor_op.cc,
+assert_op.cc, select_input_op.cc / select_output_op.cc,
+rnn_memory_helper_op.cc, tensor_array_to_tensor_op.cc,
+lod_array_length_op.cc, squeeze_op.cc / unsqueeze_op.cc aliases).
+
+Static-shape twists are documented per op (unique/where_index pad to the
+input extent with a count output, the reference's LoD-style dynamic
+results being XLA-hostile).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.registry import register_op
+
+
+@register_op("allclose", non_diff_inputs=("Input", "Other"))
+def allclose(ins, attrs):
+    import jax.numpy as jnp
+
+    x, y = ins["Input"][0], ins["Other"][0]
+    return {"Out": jnp.allclose(x, y,
+                                rtol=float(attrs.get("rtol", 1e-5)),
+                                atol=float(attrs.get("atol", 1e-8)),
+                                equal_nan=bool(attrs.get("equal_nan",
+                                                         False)))}
+
+
+@register_op("bernoulli", non_diff_inputs=("X",))
+def bernoulli(ins, attrs):
+    import jax
+
+    from .tensor_ops import _rng_key
+
+    x = ins["X"][0]
+    return {"Out": jax.random.bernoulli(
+        _rng_key(attrs), x.astype(np.float32)).astype(x.dtype)}
+
+
+@register_op("empty")
+def empty(ins, attrs):
+    from .tensor_ops import fill_constant
+
+    return fill_constant(ins, {**attrs, "value": 0.0})
+
+
+@register_op("fill", non_diff_inputs=("X",))
+def fill(ins, attrs):
+    from .tensor_ops import assign_value
+
+    return assign_value(ins, {**attrs, "values": attrs["value"]})
+
+
+@register_op("diag_embed")
+def diag_embed(ins, attrs):
+    import jax.numpy as jnp
+
+    x = ins["Input"][0]
+    off = int(attrs.get("offset", 0))
+    d1 = int(attrs.get("dim1", -2))
+    d2 = int(attrs.get("dim2", -1))
+    n = x.shape[-1] + abs(off)
+    out = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    r = idx + max(-off, 0)
+    c = idx + max(off, 0)
+    out = out.at[..., r, c].set(x)
+    # reference places the matrix dims at dim1/dim2
+    nd = out.ndim
+    d1, d2 = d1 % nd, d2 % nd
+    perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+    order = []
+    k = 0
+    for i in range(nd):
+        if i == d1:
+            order.append(nd - 2)
+        elif i == d2:
+            order.append(nd - 1)
+        else:
+            order.append(perm[k])
+            k += 1
+    return {"Out": jnp.transpose(out, order)}
+
+
+@register_op("is_empty", non_diff_inputs=("X",))
+def is_empty(ins, attrs):
+    import jax.numpy as jnp
+
+    return {"Out": jnp.asarray(ins["X"][0].size == 0)}
+
+
+@register_op("unique", non_diff_inputs=("X",))
+def unique(ins, attrs):
+    """Static-shape form (reference unique_op.cc returns dynamic size):
+    Out is padded to len(X) — first `Count` slots hold the uniques in
+    first-occurrence order, Index maps each input to its unique slot."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0].reshape(-1)
+    n = x.shape[0]
+    # O(n log n): stable sort, adjacent-compare for group boundaries,
+    # then first-occurrence order recovered by min original position
+    order = jnp.argsort(x, stable=True)
+    xs = x[order]
+    new_grp = jnp.concatenate([jnp.ones((1,), bool), xs[1:] != xs[:-1]])
+    gid_sorted = jnp.cumsum(new_grp.astype(jnp.int32)) - 1   # by value
+    gid = jnp.zeros((n,), jnp.int32).at[order].set(gid_sorted)
+    first_pos = jnp.full((n,), n, jnp.int32).at[gid].min(
+        jnp.arange(n, dtype=jnp.int32))
+    # rank groups by first occurrence -> first-occurrence slot ids
+    grp_order = jnp.argsort(first_pos, stable=True)          # [n] slots
+    slot_of_gid = jnp.zeros((n,), jnp.int32).at[grp_order].set(
+        jnp.arange(n, dtype=jnp.int32))
+    index = slot_of_gid[gid]
+    count = jnp.sum(new_grp.astype(jnp.int32))
+    out = jnp.zeros_like(x).at[index].set(x)
+    return {"Out": out, "Index": index, "Count": count}
+
+
+@register_op("unique_with_counts", non_diff_inputs=("X",))
+def unique_with_counts(ins, attrs):
+    import jax.numpy as jnp
+
+    res = unique(ins, attrs)
+    x = ins["X"][0].reshape(-1)
+    n = x.shape[0]
+    counts = jnp.zeros((n,), jnp.int32).at[res["Index"]].add(1)
+    return {"Out": res["Out"], "Index": res["Index"],
+            "Count": counts}
+
+
+@register_op("where_index", non_diff_inputs=("Condition",))
+def where_index(ins, attrs):
+    """nonzero() under static shapes: Out [numel, ndim] int32 (int64 in
+    the reference; 64-bit is truncated under default JAX anyway), rows
+    past `Count` are -1 (the reference returns a dynamic row count)."""
+    import jax.numpy as jnp
+
+    c = ins["Condition"][0]
+    flat = c.reshape(-1) != 0
+    n = flat.shape[0]
+    order = jnp.argsort(~flat, stable=True)     # true positions first
+    cnt = jnp.sum(flat.astype(jnp.int32))
+    coords = jnp.stack(jnp.unravel_index(order, c.shape), axis=1)
+    valid = jnp.arange(n)[:, None] < cnt
+    return {"Out": jnp.where(valid, coords, -1).astype(jnp.int32),
+            "Count": cnt}
+
+
+@register_op("sampling_id", non_diff_inputs=("X",))
+def sampling_id(ins, attrs):
+    import jax
+
+    from .tensor_ops import _rng_key
+
+    x = ins["X"][0]                              # [B, C] probabilities
+    import jax.numpy as jnp
+
+    logp = jnp.log(jnp.maximum(x.astype(jnp.float32), 1e-20))
+    return {"Out": jax.random.categorical(_rng_key(attrs), logp,
+                                          axis=-1).astype(np.int32)}
+
+
+@register_op("seed")
+def seed_op(ins, attrs):
+    import jax.numpy as jnp
+
+    return {"Out": jnp.asarray([int(attrs.get("seed", 0))], jnp.int32)}
+
+
+@register_op("shuffle_batch", non_diff_inputs=("Seed",))
+def shuffle_batch(ins, attrs):
+    import jax
+
+    from .tensor_ops import _rng_key
+
+    x = ins["X"][0]
+    perm = jax.random.permutation(_rng_key(attrs), x.shape[0])
+    return {"Out": x[perm], "ShuffleIdx": perm.astype(np.int32),
+            "SeedOut": ins.get("Seed", [np.zeros(1, np.int64)])[0]}
+
+
+@register_op("squared_l2_distance")
+def squared_l2_distance(ins, attrs):
+    import jax.numpy as jnp
+
+    x, y = ins["X"][0], ins["Y"][0]
+    d = x - y
+    return {"Out": jnp.sum(jnp.square(d), axis=tuple(range(1, d.ndim)),
+                           keepdims=True).reshape(x.shape[0], 1),
+            "sub_result": d}
+
+
+@register_op("teacher_student_sigmoid_loss", non_diff_inputs=("Label",))
+def teacher_student_sigmoid_loss(ins, attrs):
+    """reference: teacher_student_sigmoid_loss_op.cc — CTR distillation
+    loss: sigmoid CE vs the binary click + soft teacher score."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ins["X"][0].reshape(-1)
+    label = ins["Label"][0].reshape(-1).astype(jnp.float32)
+    soft_max_up = float(attrs.get("soft_max_up_bound", 15.0))
+    soft_max_lo = float(attrs.get("soft_max_lower_bound", -15.0))
+    z = jnp.clip(x, soft_max_lo, soft_max_up)
+    # hard part: label>0 counts as click
+    hard = (label > 0).astype(jnp.float32)
+    ce = jnp.maximum(z, 0) - z * hard + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    # soft part for teacher scores in (0, 1)
+    soft = jnp.where((label > 0.0) & (label < 1.0),
+                     jnp.maximum(z, 0) - z * label
+                     + jnp.log1p(jnp.exp(-jnp.abs(z))), 0.0)
+    return {"Y": (ce + soft).reshape(-1, 1)}
+
+
+@register_op("chunk_eval", non_diff_inputs=("Inference", "Label", "SeqLength"))
+def chunk_eval(ins, attrs):
+    """reference: chunk_eval_op.cc — chunk-level precision/recall/F1 for
+    IOB sequence labeling (the evaluator pairing with linear_chain_crf).
+    Padded form with SeqLength [B]. Exact chunk matching: each in-chunk
+    position carries the key (row, chunk start, type); a chunk counts
+    correct iff prediction and label agree on the key at every position
+    and the two chunks have equal extent (equal key histograms)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    pred = ins["Inference"][0].astype(jnp.int32)
+    label = ins["Label"][0].astype(jnp.int32)
+    if pred.ndim > 2:
+        pred = pred.reshape(pred.shape[0], -1)
+        label = label.reshape(label.shape[0], -1)
+    b, s = pred.shape
+    ln = ins.get("SeqLength", [None])[0]
+    if ln is None:
+        ln = jnp.full((b,), s, jnp.int32)
+    valid = jnp.arange(s)[None, :] < ln.reshape(-1, 1)
+    t_types = int(attrs.get("num_chunk_types", 1))
+    scheme = str(attrs.get("chunk_scheme", "IOB"))
+    if scheme != "IOB":
+        raise NotImplementedError(
+            f"chunk_eval: chunk_scheme '{scheme}' not supported (IOB "
+            f"only — reference chunk_eval_op.h also offers IOE/IOBES/"
+            f"plain)")
+    excluded = [int(t) for t in attrs.get("excluded_chunk_types", [])]
+
+    def analyse(seq):
+        # reference encoding (chunk_eval_op.h, IOB): label =
+        # chunk_type * 2 + tag with tag 0 = B, 1 = I; any label
+        # >= 2 * num_chunk_types is outside (O)
+        typ = seq // 2                         # chunk type (0-based)
+        in_tag = (seq >= 0) & (seq < 2 * t_types) & valid
+        for ex in excluded:
+            in_tag = in_tag & (typ != ex)
+        is_b = in_tag & (seq % 2 == 0)
+        is_i = in_tag & (seq % 2 == 1)
+        prev = jnp.concatenate(
+            [jnp.full((b, 1), -1, jnp.int32), seq[:, :-1]], axis=1)
+        prev_typ = prev // 2
+        prev_in = (prev >= 0) & (prev < 2 * t_types)
+        cont = is_i & prev_in & (prev_typ == typ)
+        st = is_b | (is_i & ~cont)
+        # start position of each position's own chunk (running max)
+        spos = lax.cummax(
+            jnp.where(st, jnp.arange(s)[None, :], -1), axis=1)
+        in_chunk = in_tag & (spos >= 0)
+        key = jnp.where(
+            in_chunk,
+            ((jnp.arange(b)[:, None] * s + spos) * (t_types + 1)
+             + typ + 1),
+            0)
+        return st, key
+
+    pst, pkey = analyse(pred)
+    lst, lkey = analyse(label)
+    nbuck = b * s * (t_types + 1)
+    ph = jnp.zeros((nbuck,), jnp.int32).at[pkey.reshape(-1)].add(
+        (pkey > 0).reshape(-1).astype(jnp.int32), mode="drop")
+    lh = jnp.zeros((nbuck,), jnp.int32).at[lkey.reshape(-1)].add(
+        (lkey > 0).reshape(-1).astype(jnp.int32), mode="drop")
+    mism = jnp.zeros((nbuck,), jnp.int32).at[pkey.reshape(-1)].add(
+        ((pkey > 0) & (pkey != lkey)).reshape(-1).astype(jnp.int32),
+        mode="drop")
+    correct = (ph > 0) & (ph == lh) & (mism == 0)
+    num_correct = jnp.sum(correct.astype(jnp.int64))
+    num_pred = jnp.sum(pst.astype(jnp.int64))
+    num_label = jnp.sum(lst.astype(jnp.int64))
+    precision = num_correct / jnp.maximum(num_pred, 1)
+    recall = num_correct / jnp.maximum(num_label, 1)
+    f1 = jnp.where(precision + recall > 0,
+                   2 * precision * recall
+                   / jnp.maximum(precision + recall, 1e-12), 0.0)
+    return {"Precision": precision.astype(jnp.float32).reshape(1),
+            "Recall": recall.astype(jnp.float32).reshape(1),
+            "F1-Score": f1.astype(jnp.float32).reshape(1),
+            "NumInferChunks": num_pred.reshape(1),
+            "NumLabelChunks": num_label.reshape(1),
+            "NumCorrectChunks": num_correct.reshape(1)}
+
+
+@register_op("average_accumulates", non_diff_inputs=(
+    "param", "in_sum_1", "in_sum_2", "in_sum_3", "in_num_accumulates",
+    "in_old_num_accumulates", "in_num_updates"))
+def average_accumulates(ins, attrs):
+    """reference: average_accumulates_op.h (ModelAverage support):
+    sum_1 += param each step; every 16384 updates sum_1 shifts into
+    sum_2 (precision shuffle); when num_accumulates >= min_average_window
+    AND >= min(max_average_window, num_updates * average_window), the
+    window rolls: sum_3 = sum_1 + sum_2 (REPLACED), sums 1/2 reset."""
+    import jax.numpy as jnp
+
+    p = ins["param"][0]
+    s1, s2, s3 = (ins[k][0] for k in ("in_sum_1", "in_sum_2", "in_sum_3"))
+    na = ins["in_num_accumulates"][0].reshape(()).astype(jnp.int64)
+    ona = ins["in_old_num_accumulates"][0].reshape(()).astype(jnp.int64)
+    nu = ins["in_num_updates"][0].reshape(()).astype(jnp.int64)
+    avg_window = float(attrs.get("average_window", 0))
+    max_avg = int(attrs.get("max_average_window", 10000))
+    min_avg = int(attrs.get("min_average_window", 10000))
+    k_max = 16384
+    na = na + 1
+    nu = nu + 1
+    s1 = s1 + p
+    shuffle = (nu % k_max) == 0
+    s2 = jnp.where(shuffle, s2 + s1, s2)
+    s1 = jnp.where(shuffle, jnp.zeros_like(s1), s1)
+    roll = (na >= min_avg) & (
+        na >= jnp.minimum(jnp.int64(max_avg),
+                          (nu * avg_window).astype(jnp.int64)))
+    s3n = jnp.where(roll, s1 + s2, s3)
+    s1n = jnp.where(roll, jnp.zeros_like(s1), s1)
+    s2n = jnp.where(roll, jnp.zeros_like(s2), s2)
+    onan = jnp.where(roll, na, ona)
+    nan_ = jnp.where(roll, jnp.zeros_like(na), na)
+    return {"out_sum_1": s1n, "out_sum_2": s2n, "out_sum_3": s3n,
+            "out_num_accumulates": nan_.astype(jnp.int32).reshape(1),
+            "out_old_num_accumulates": onan.astype(jnp.int32).reshape(1),
+            "out_num_updates": nu.astype(jnp.int32).reshape(1)}
+
+
+@register_op("uniform_random_batch_size_like", non_diff_inputs=("Input",))
+def uniform_random_batch_size_like(ins, attrs):
+    import jax
+
+    from .tensor_ops import _rng_key
+
+    x = ins["Input"][0]
+    shape = [int(d) for d in attrs["shape"]]
+    shape[int(attrs.get("output_dim_idx", 0))] = \
+        x.shape[int(attrs.get("input_dim_idx", 0))]
+    return {"Out": jax.random.uniform(
+        _rng_key(attrs), tuple(shape), minval=float(attrs.get("min", -1.0)),
+        maxval=float(attrs.get("max", 1.0)))}
+
+
+@register_op("gaussian_random_batch_size_like", non_diff_inputs=("Input",))
+def gaussian_random_batch_size_like(ins, attrs):
+    import jax
+
+    from .tensor_ops import _rng_key
+
+    x = ins["Input"][0]
+    shape = [int(d) for d in attrs["shape"]]
+    shape[int(attrs.get("output_dim_idx", 0))] = \
+        x.shape[int(attrs.get("input_dim_idx", 0))]
+    out = jax.random.normal(_rng_key(attrs), tuple(shape))
+    return {"Out": out * float(attrs.get("std", 1.0))
+            + float(attrs.get("mean", 0.0))}
+
+
+@register_op("scatter_nd_add", non_diff_inputs=("Index",))
+def scatter_nd_add(ins, attrs):
+    import jax.numpy as jnp
+
+    x, idx, upd = ins["X"][0], ins["Index"][0], ins["Updates"][0]
+    k = idx.shape[-1]
+    flat_idx = tuple(idx[..., i] for i in range(k))
+    return {"Out": x.at[flat_idx].add(upd)}
+
+
+@register_op("add_position_encoding")
+def add_position_encoding(ins, attrs):
+    """reference: add_position_encoding_op.cc — sinusoidal PE added to
+    [B, S, D]."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    alpha = float(attrs.get("alpha", 1.0))
+    beta = float(attrs.get("beta", 1.0))
+    b, s, d = x.shape
+    pos = jnp.arange(s, dtype=jnp.float32)[:, None]
+    half = d // 2
+    div = jnp.power(10000.0, jnp.arange(half, dtype=jnp.float32) / half)
+    pe = jnp.concatenate([jnp.sin(pos / div), jnp.cos(pos / div)], axis=1)
+    return {"Out": alpha * x + beta * pe[None, :, :].astype(x.dtype)}
+
+
+@register_op("roi_pool", non_diff_inputs=("ROIs", "RoisNum"))
+def roi_pool(ins, attrs):
+    """reference: roi_pool_op.cc — max pooling over ROI bins (the
+    roi_align sibling; nearest-bin max instead of bilinear average)."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]                         # [N, C, H, W]
+    rois = ins["ROIs"][0]                   # [R, 4] (x1, y1, x2, y2)
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    n, c, h, w = x.shape
+    r = rois.shape[0]
+    batch_idx = jnp.zeros((r,), jnp.int32)
+    if n > 1 and not (ins.get("RoisNum")
+                      and ins["RoisNum"][0] is not None):
+        raise ValueError(
+            "roi_pool: RoisNum is required when the batch has more than "
+            "one image (otherwise every ROI would read image 0)")
+    if ins.get("RoisNum") and ins["RoisNum"][0] is not None:
+        counts = ins["RoisNum"][0].astype(jnp.int32)
+        batch_idx = jnp.repeat(jnp.arange(counts.shape[0]), counts,
+                               total_repeat_length=r)
+
+    x1 = jnp.round(rois[:, 0] * scale).astype(jnp.int32)
+    y1 = jnp.round(rois[:, 1] * scale).astype(jnp.int32)
+    x2 = jnp.round(rois[:, 2] * scale).astype(jnp.int32)
+    y2 = jnp.round(rois[:, 3] * scale).astype(jnp.int32)
+    rh = jnp.maximum(y2 - y1 + 1, 1)
+    rw = jnp.maximum(x2 - x1 + 1, 1)
+
+    gy = jnp.arange(h)
+    gx = jnp.arange(w)
+    outs = []
+    for i in range(ph):
+        for j in range(pw):
+            ys = y1 + (rh * i) // ph
+            ye = y1 + jnp.maximum((rh * (i + 1)) // ph, (rh * i) // ph + 1)
+            xs = x1 + (rw * j) // pw
+            xe = x1 + jnp.maximum((rw * (j + 1)) // pw, (rw * j) // pw + 1)
+            my = (gy[None, :] >= ys[:, None]) & (gy[None, :] < ye[:, None])
+            mx = (gx[None, :] >= xs[:, None]) & (gx[None, :] < xe[:, None])
+            mask = my[:, None, :, None] & mx[:, None, None, :]  # [R,1,H,W]
+            feat = x[batch_idx]                                  # [R,C,H,W]
+            val = jnp.max(jnp.where(mask, feat, -jnp.inf), axis=(2, 3))
+            outs.append(jnp.where(jnp.isfinite(val), val, 0.0))
+    out = jnp.stack(outs, axis=-1).reshape(r, c, ph, pw)
+    return {"Out": out.astype(x.dtype),
+            "Argmax": jnp.zeros((r, c, ph, pw), np.int32)}
+
+
+@register_op("spp")
+def spp(ins, attrs):
+    """reference: spp_op.cc — spatial pyramid pooling: concat of
+    pyramid_height levels of adaptive max/avg pools, flattened."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    levels = int(attrs.get("pyramid_height", 1))
+    ptype = attrs.get("pooling_type", "max")
+    n, c, h, w = x.shape
+    feats = []
+    for lv in range(levels):
+        bins = 2 ** lv
+        # adaptive pooling via reshape-trick when divisible, else pad
+        ph = -(-h // bins) * bins
+        pw = -(-w // bins) * bins
+        pad = [(0, 0), (0, 0), (0, ph - h), (0, pw - w)]
+        if ptype == "max":
+            xp = jnp.pad(x, pad, constant_values=-np.inf)
+            v = xp.reshape(n, c, bins, ph // bins, bins, pw // bins)
+            v = jnp.max(v, axis=(3, 5))
+        else:
+            xp = jnp.pad(x, pad)
+            v = xp.reshape(n, c, bins, ph // bins, bins, pw // bins)
+            ones = jnp.pad(jnp.ones((1, 1, h, w), x.dtype), pad)
+            cnt = ones.reshape(1, 1, bins, ph // bins, bins, pw // bins)
+            v = jnp.sum(v, axis=(3, 5)) / jnp.sum(cnt, axis=(3, 5))
+        feats.append(v.reshape(n, -1))
+    return {"Out": jnp.concatenate(feats, axis=1)}
+
+
+@register_op("split_ids", non_diff_inputs=("Ids",))
+def split_ids(ins, attrs):
+    """reference: distributed_ops/split_ids_op.cc — partition ids by
+    id % N for per-pserver routing. Static form: N outputs of the input
+    length, invalid slots = -1, per-shard counts in Counts."""
+    import jax.numpy as jnp
+
+    ids = ins["Ids"][0].reshape(-1)
+    n_parts = int(attrs.get("n_parts", 2))
+    outs = []
+    counts = []
+    for k in range(n_parts):
+        mask = (ids % n_parts) == k
+        order = jnp.argsort(~mask, stable=True)
+        sel = jnp.where(jnp.arange(ids.shape[0])
+                        < jnp.sum(mask.astype(jnp.int32)),
+                        ids[order], -1)
+        outs.append(sel)
+        counts.append(jnp.sum(mask.astype(jnp.int32)))
+    return {"Out": outs, "Counts": jnp.stack(counts)}
+
+
+@register_op("split_selected_rows", non_diff_inputs=("X",))
+def split_selected_rows(ins, attrs):
+    """reference: split_selected_rows_op.cc — split a SelectedRows grad
+    by row residue across height_sections (PS routing)."""
+    from ..core.selected_rows import SelectedRows
+
+    import jax.numpy as jnp
+
+    sr = ins["X"][0]
+    if not isinstance(sr, SelectedRows):
+        raise TypeError("split_selected_rows expects a SelectedRows input")
+    sections = [int(s) for s in attrs.get("height_sections", [])]
+    outs = []
+    start = 0
+    for sec in sections:
+        in_part = (sr.rows >= start) & (sr.rows < start + sec)
+        # static shape: keep all slots, zero out non-members (consumers
+        # scatter-add, so zero rows are inert); rebase row ids
+        rows = jnp.where(in_part, sr.rows - start, 0)
+        vals = jnp.where(in_part[:, None], sr.values, 0)
+        outs.append(SelectedRows(rows, vals, sec))
+        start += sec
+    return {"Out": outs}
+
+
+@register_op("coalesce_tensor")
+def coalesce_tensor(ins, attrs):
+    """reference: coalesce_tensor_op.cc — flatten a list of params into
+    one fused buffer + views (grad-fusion support). Functional form:
+    FusedOutput is the concatenation; Output mirrors the inputs."""
+    import jax.numpy as jnp
+
+    xs = ins["Input"]
+    flat = jnp.concatenate([x.reshape(-1) for x in xs])
+    return {"FusedOutput": flat, "Output": list(xs)}
+
+
+@register_op("assert", non_diff_inputs=("Cond", "Data"))
+def assert_op(ins, attrs):
+    """reference: controlflow/assert_op.cc. Host-checked on the
+    interpreting path; under jit it degrades to a checkify-free no-op
+    pass-through (XLA has no aborts)."""
+    c = ins["Cond"][0]
+    try:
+        ok = bool(np.asarray(c).reshape(-1)[0])
+    except Exception:      # traced value: cannot host-check under jit
+        return {}
+    if not ok:
+        raise AssertionError(attrs.get("summarize_message",
+                                       "Assert failed"))
+    return {}
+
+
+@register_op("select_input", non_diff_inputs=("Mask",))
+def select_input(ins, attrs):
+    """reference: controlflow/select_input_op.cc — pick inputs[mask]."""
+    import jax.numpy as jnp
+
+    xs = ins["X"]
+    m = jnp.asarray(ins["Mask"][0]).reshape(()).astype(jnp.int32)
+    out = xs[0]
+    for k in range(1, len(xs)):
+        out = jnp.where(m == k, xs[k], out)
+    return {"Out": out}
+
+
+@register_op("select_output", non_diff_inputs=("Mask",))
+def select_output(ins, attrs):
+    """reference: controlflow/select_output_op.cc — route input to
+    output[mask]; static form writes X to every output, consumers gate
+    by the same mask."""
+    xs = ins["X"][0]
+    outs = int(attrs.get("branch_num", 2))
+    return {"Out": [xs for _ in range(outs)]}
+
+
+@register_op("rnn_memory_helper")
+def rnn_memory_helper(ins, attrs):
+    """reference: rnn_memory_helper_op.cc — identity bridge for RNN
+    memories."""
+    return {"Out": ins["X"][0]}
+
+
+@register_op("tensor_array_to_tensor")
+def tensor_array_to_tensor(ins, attrs):
+    """reference: tensor_array_to_tensor_op.cc — concat/stack the
+    step-stacked array along `axis`."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]                        # [S, ...] stacked array
+    axis = int(attrs.get("axis", 0))
+    if bool(attrs.get("use_stack", False)):
+        out = jnp.moveaxis(x, 0, axis)
+    else:
+        parts = [x[i] for i in range(x.shape[0])]
+        out = jnp.concatenate(parts, axis=axis)
+    part = x.shape[axis + 1] if x.ndim > axis + 1 else 1
+    return {"Out": out,
+            "OutIndex": jnp.full((x.shape[0],), part, jnp.int32)}
+
+
+@register_op("lod_array_length")
+def lod_array_length(ins, attrs):
+    import jax.numpy as jnp
+
+    return {"Out": jnp.asarray([ins["X"][0].shape[0]], jnp.int32)}
+
+
+# squeeze/unsqueeze aliases of the *2 forms (reference registers both)
+from .tensor_ops import squeeze2 as _sq2  # noqa: E402
+from .tensor_ops import unsqueeze2 as _unsq2  # noqa: E402
+
+
+@register_op("squeeze")
+def squeeze(ins, attrs):
+    return {"Out": _sq2(ins, attrs)["Out"]}
+
+
+@register_op("unsqueeze")
+def unsqueeze(ins, attrs):
+    return {"Out": _unsq2(ins, attrs)["Out"]}

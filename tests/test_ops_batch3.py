@@ -1,0 +1,207 @@
+"""Round-3 op batch (ops/extra_ops3.py) — quick numpy-oracle checks."""
+
+import numpy as np
+import pytest
+
+
+def _fwd(op, ins, attrs=None):
+    import jax.numpy as jnp
+
+    import paddle_tpu  # noqa: F401  (registers ops)
+    from paddle_tpu.core import registry
+
+    wrapped = {k: [None if v is None else
+                   (v if not isinstance(v, (np.ndarray, int, float))
+                    else jnp.asarray(v)) for v in vs]
+               for k, vs in ins.items()}
+    return registry.lookup(op).forward(wrapped, attrs or {})
+
+
+class TestBatch3:
+    def test_allclose_and_is_empty(self):
+        x = np.ones((3,), np.float32)
+        assert bool(np.asarray(_fwd("allclose", {"Input": [x],
+                                                 "Other": [x + 1e-9]})["Out"]))
+        assert not bool(np.asarray(_fwd(
+            "allclose", {"Input": [x], "Other": [x + 1.0]})["Out"]))
+        assert not bool(np.asarray(_fwd("is_empty", {"X": [x]})["Out"]))
+
+    def test_unique_and_counts(self):
+        x = np.array([5, 3, 5, 1, 3, 5], np.int64)
+        out = _fwd("unique", {"X": [x]})
+        cnt = int(np.asarray(out["Count"]))
+        assert cnt == 3
+        np.testing.assert_array_equal(np.asarray(out["Out"])[:cnt],
+                                      [5, 3, 1])
+        np.testing.assert_array_equal(out["Index"], [0, 1, 0, 2, 1, 0])
+        wc = _fwd("unique_with_counts", {"X": [x]})
+        np.testing.assert_array_equal(np.asarray(wc["Count"])[:3],
+                                      [3, 2, 1])
+
+    def test_where_index(self):
+        c = np.array([[1, 0], [0, 1]], np.int32)
+        out = _fwd("where_index", {"Condition": [c]})
+        assert int(np.asarray(out["Count"])) == 2
+        np.testing.assert_array_equal(np.asarray(out["Out"])[:2],
+                                      [[0, 0], [1, 1]])
+
+    def test_diag_embed(self):
+        x = np.array([[1.0, 2.0, 3.0]], np.float32)
+        out = np.asarray(_fwd("diag_embed", {"Input": [x]})["Out"])
+        np.testing.assert_allclose(out[0], np.diag([1, 2, 3]))
+
+    def test_scatter_nd_add(self):
+        x = np.zeros((3, 3), np.float32)
+        idx = np.array([[0, 0], [1, 2], [0, 0]], np.int64)
+        upd = np.array([1.0, 2.0, 3.0], np.float32)
+        out = np.asarray(_fwd("scatter_nd_add",
+                              {"X": [x], "Index": [idx],
+                               "Updates": [upd]})["Out"])
+        assert out[0, 0] == 4.0 and out[1, 2] == 2.0
+
+    def test_add_position_encoding(self):
+        x = np.zeros((1, 4, 6), np.float32)
+        out = np.asarray(_fwd("add_position_encoding", {"X": [x]},
+                              {"alpha": 1.0, "beta": 1.0})["Out"])
+        assert out.shape == (1, 4, 6)
+        np.testing.assert_allclose(out[0, 0, :3], 0.0, atol=1e-6)  # sin(0)
+        np.testing.assert_allclose(out[0, 0, 3:], 1.0, atol=1e-6)  # cos(0)
+
+    def test_squared_l2_distance(self):
+        x = np.array([[1.0, 2.0], [0.0, 0.0]], np.float32)
+        y = np.array([[0.0, 0.0], [3.0, 4.0]], np.float32)
+        out = np.asarray(_fwd("squared_l2_distance",
+                              {"X": [x], "Y": [y]})["Out"])
+        np.testing.assert_allclose(out.reshape(-1), [5.0, 25.0])
+
+    def test_chunk_eval_exact(self):
+        # reference IOB with 1 type: B=0, I=1, O=2
+        pred = np.array([[0, 1, 2, 0, 1, 1]], np.int64)
+        lab = np.array([[0, 1, 2, 0, 2, 2]], np.int64)
+        out = _fwd("chunk_eval", {"Inference": [pred], "Label": [lab]},
+                   {"num_chunk_types": 1})
+        assert int(np.asarray(out["NumInferChunks"])) == 2
+        assert int(np.asarray(out["NumLabelChunks"])) == 2
+        # only the first chunk matches exactly (second differs in extent)
+        assert int(np.asarray(out["NumCorrectChunks"])) == 1
+        np.testing.assert_allclose(np.asarray(out["Precision"]), 0.5)
+        np.testing.assert_allclose(np.asarray(out["Recall"]), 0.5)
+
+    def test_chunk_eval_respects_length(self):
+        pred = np.array([[0, 1, 0, 0]], np.int64)
+        lab = np.array([[0, 1, 0, 1]], np.int64)
+        out = _fwd("chunk_eval", {"Inference": [pred], "Label": [lab],
+                                  "SeqLength": [np.array([2], np.int64)]},
+                   {"num_chunk_types": 1})
+        assert int(np.asarray(out["NumCorrectChunks"])) == 1
+        assert int(np.asarray(out["NumInferChunks"])) == 1
+
+    def test_spp_shapes(self):
+        x = np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32)
+        out = np.asarray(_fwd("spp", {"X": [x]},
+                              {"pyramid_height": 2,
+                               "pooling_type": "max"})["Out"])
+        assert out.shape == (2, 3 * (1 + 4))
+        np.testing.assert_allclose(out[:, :3],
+                                   x.max(axis=(2, 3)), rtol=1e-6)
+
+    def test_roi_pool(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        rois = np.array([[0.0, 0.0, 3.0, 3.0]], np.float32)
+        out = np.asarray(_fwd("roi_pool", {"X": [x], "ROIs": [rois]},
+                              {"pooled_height": 2, "pooled_width": 2,
+                               "spatial_scale": 1.0})["Out"])
+        np.testing.assert_allclose(out[0, 0], [[5, 7], [13, 15]])
+
+    def test_split_ids_and_selected_rows(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.selected_rows import SelectedRows
+
+        ids = np.array([0, 3, 4, 7, 2], np.int64)
+        out = _fwd("split_ids", {"Ids": [ids]}, {"n_parts": 2})
+        c = np.asarray(out["Counts"])
+        assert c.tolist() == [3, 2]
+        np.testing.assert_array_equal(np.asarray(out["Out"][0])[:3],
+                                      [0, 4, 2])
+        sr = SelectedRows(jnp.asarray([1, 5], jnp.int32),
+                          jnp.ones((2, 3)), 8)
+        parts = _fwd("split_selected_rows", {"X": [sr]},
+                     {"height_sections": [4, 4]})["Out"]
+        assert np.asarray(parts[0].to_dense())[1].sum() == 3.0
+        assert np.asarray(parts[1].to_dense())[1].sum() == 3.0
+
+    def test_tensor_array_to_tensor_and_length(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 2, 2)
+        cat = np.asarray(_fwd("tensor_array_to_tensor", {"X": [x]},
+                              {"axis": 0})["Out"])
+        assert cat.shape == (6, 2)
+        st = np.asarray(_fwd("tensor_array_to_tensor", {"X": [x]},
+                             {"axis": 1, "use_stack": True})["Out"])
+        assert st.shape == (2, 3, 2)
+        ln = np.asarray(_fwd("lod_array_length", {"X": [x]})["Out"])
+        assert ln[0] == 3
+
+    def test_random_family(self):
+        x = np.full((2000,), 0.3, np.float32)
+        b = np.asarray(_fwd("bernoulli", {"X": [x]}, {"seed": 3})["Out"])
+        assert abs(b.mean() - 0.3) < 0.05
+        probs = np.array([[0.0, 1.0], [1.0, 0.0]], np.float32)
+        sid = np.asarray(_fwd("sampling_id", {"X": [probs]},
+                              {"seed": 1})["Out"])
+        np.testing.assert_array_equal(sid, [1, 0])
+        ref = np.zeros((5, 2), np.float32)
+        u = np.asarray(_fwd("uniform_random_batch_size_like",
+                            {"Input": [ref]},
+                            {"shape": [1, 7], "seed": 2})["Out"])
+        assert u.shape == (5, 7)
+        sh = _fwd("shuffle_batch", {"X": [np.arange(8.0)]}, {"seed": 4})
+        assert sorted(np.asarray(sh["Out"]).tolist()) == list(range(8))
+
+    def test_average_accumulates_rolls(self):
+        p = np.full((2,), 2.0, np.float32)
+        s1 = np.zeros((2,), np.float32)
+        s2 = np.zeros((2,), np.float32)
+        s3 = np.zeros((2,), np.float32)
+        na = np.zeros((1,), np.int64)
+        ona = np.zeros((1,), np.int64)
+        nu = np.zeros((1,), np.int64)
+        for _ in range(3):
+            out = _fwd("average_accumulates",
+                       {"param": [p], "in_sum_1": [s1], "in_sum_2": [s2],
+                        "in_sum_3": [s3], "in_num_accumulates": [na],
+                        "in_old_num_accumulates": [ona],
+                        "in_num_updates": [nu]},
+                       {"average_window": 0.0, "max_average_window": 2,
+                        "min_average_window": 2})
+            s1, s2, s3 = (np.asarray(out[k]) for k in
+                          ("out_sum_1", "out_sum_2", "out_sum_3"))
+            na = np.asarray(out["out_num_accumulates"])
+            ona = np.asarray(out["out_old_num_accumulates"])
+            nu = np.asarray(out["out_num_updates"])
+        # window of 2 rolled once: s3 holds 2 accumulations, s1 restarted
+        assert s3.sum() == 8.0 and s1.sum() == 4.0 and int(nu[0]) == 3
+
+    def test_misc_passthroughs(self):
+        x = np.ones((2, 1, 3), np.float32)
+        sq = np.asarray(_fwd("squeeze", {"X": [x]}, {"axes": [1]})["Out"])
+        assert sq.shape == (2, 3)
+        un = np.asarray(_fwd("unsqueeze", {"X": [sq]},
+                             {"axes": [0]})["Out"])
+        assert un.shape == (1, 2, 3)
+        assert np.asarray(_fwd("rnn_memory_helper",
+                               {"X": [x]})["Out"]).shape == x.shape
+        sel = np.asarray(_fwd("select_input",
+                              {"X": [x, x * 2],
+                               "Mask": [np.int32(1)]})["Out"])
+        np.testing.assert_allclose(sel, x * 2)
+        co = _fwd("coalesce_tensor", {"Input": [x, sq]})
+        assert np.asarray(co["FusedOutput"]).shape == (12,)
+        with pytest.raises(AssertionError):
+            _fwd("assert", {"Cond": [np.asarray(False)], "Data": [x]})
+        # empty / fill / seed
+        assert np.asarray(_fwd("empty", {}, {"shape": [2, 2]})["Out"]
+                          ).shape == (2, 2)
+        f = np.asarray(_fwd("fill", {}, {"shape": [2], "value": [3, 4],
+                                         "dtype": "float32"})["Out"])
+        np.testing.assert_allclose(f, [3.0, 4.0])
