@@ -104,7 +104,14 @@ def ring_attention(q, k, v, bias_kv=None, causal=False, scale=None,
 
     bias0 = bias_kv if has_bias else jnp.zeros((b, skl), q.dtype)
     carry = (k, v, bias0, m0, l0, acc0)
-    (k_c, v_c, b_c, m, l, acc), _ = lax.scan(step_fn, carry, jnp.arange(n))
+    # rematerialise each ring step in the backward: without this, scan
+    # autodiff saves the [B, H, S/n, S/n] probs of EVERY step (O(S^2/n)
+    # residual per device — exactly what ring attention exists to
+    # avoid); checkpointed, only the rotating kv carries survive
+    # (O(S*D) per device) and probs recompute from them
+    step_remat = jax.checkpoint(step_fn, prevent_cse=False)
+    (k_c, v_c, b_c, m, l, acc), _ = lax.scan(step_remat, carry,
+                                             jnp.arange(n))
     # l >= 1 always (the running-max entry contributes exp(0)=1, even for
     # fully NEG_INF-masked rows, which degrade to uniform attention exactly
     # like the dense reference)

@@ -1,7 +1,7 @@
 """ERNIE-large step-time ablation — decompose the north-star step.
 
 Runs several program variants in ONE process on the chip and prints
-ms/step for each, so the 505 ms full step can be attributed to
+ms/step for each, so the full step can be attributed to
 forward / backward / optimizer / attention-dropout / chunking.
 
 Measurement traps handled (see tools/bench_models.py):
