@@ -8,9 +8,11 @@ servers, the trainer keeps forward/backward and gains send(grad) /
 recv(param) ops, params are assigned to pservers balanced by size.
 
 Differences from the reference, by design:
-* whole-param placement (no block-splitting of one tensor across
-  pservers — the reference slices large tensors; here the large-sparse
-  path is the LargeScaleKV service instead);
+* whole-param placement by DEFAULT; `transpile(slice_var_up=True)`
+  enables the reference's block-splitting (one block per pserver along
+  dim 0, per-block accumulators, grad split / param concat on the
+  trainer) — and the large-sparse path is the sharded LargeScaleKV
+  service (kv_service.py) rather than sliced dense tables;
 * trainer and pserver initialise from the SAME deterministic startup
   program (same seeds), so no startup-time parameter broadcast is
   needed;
@@ -49,7 +51,16 @@ class DistributeTranspiler:
     def transpile(self, trainer_id: int, program: Optional[Program] = None,
                   startup_program: Optional[Program] = None,
                   pservers: str = "127.0.0.1:6174", trainers: int = 1,
-                  sync_mode: bool = True):
+                  sync_mode: bool = True, slice_var_up: bool = False,
+                  min_block_size: int = 8192):
+        """slice_var_up=True splits every large parameter into one block
+        per pserver along dim 0 (reference distribute_transpiler.py:545
+        slice_variable) — no single server holds a whole giant tensor.
+        Each block becomes an independent (param, grad) pair: the trainer
+        splits the grad before send and concats the blocks after recv;
+        block accumulators are created per block; the block's INITIAL
+        value is sliced from the full deterministic init, so sliced
+        training matches whole-param (and local) training exactly."""
         from ...core.ir import default_main_program, default_startup_program
 
         self.trainer_id = int(trainer_id)
@@ -87,6 +98,12 @@ class DistributeTranspiler:
             else:
                 common_ops.append(op)
 
+        # -- optional: slice big params into per-pserver blocks -------------
+        # self._sliced: param -> {"sections", "p_blocks", "g_blocks"}
+        self._sliced: Dict[str, dict] = {}
+        if slice_var_up and len(self.endpoints) > 1:
+            pairs = self._slice_vars(block, pairs, int(min_block_size))
+
         # -- assign params to pservers, balanced by parameter size ----------
         def size_of(name):
             v = block.var(name)
@@ -99,7 +116,18 @@ class DistributeTranspiler:
         load = [0] * len(self.endpoints)
         self.param_to_ep: Dict[str, str] = {}
         self.grad_to_param: Dict[str, str] = {}
+        # sliced blocks pin block k to endpoint k (the point of slicing);
+        # whole params balance greedily over the remaining load
+        for info in self._sliced.values():
+            for k, (pb, gb) in enumerate(zip(info["p_blocks"],
+                                             info["g_blocks"])):
+                ep_i = k % len(self.endpoints)
+                self.param_to_ep[pb] = self.endpoints[ep_i]
+                self.grad_to_param[gb] = pb
+                load[ep_i] += size_of(pb)
         for p, g in order:
+            if p in self.param_to_ep:
+                continue
             i = int(np.argmin(load))
             self.param_to_ep[p] = self.endpoints[i]
             self.grad_to_param[g] = p
@@ -109,35 +137,134 @@ class DistributeTranspiler:
         self._done = True
         return self
 
+    def _slice_vars(self, block, pairs, min_block_size):
+        """Split each big param's (param, grad) pair and optimizer op
+        group into per-block versions (reference slice_variable +
+        _create_vars_from_blocklist)."""
+        n_eps = len(self.endpoints)
+        new_pairs: List[Tuple[str, str]] = []
+        # block var -> (full var, row start, row end); rows None = scalar
+        self._block_src: Dict[str, tuple] = {}
+
+        def bvar(name, shape, dtype, **kw):
+            # create_var silently returns an existing var: re-transpiling
+            # the same program with a different pserver count would reuse
+            # stale-shaped blocks — fail loudly instead
+            if block.has_var(name) and \
+                    list(block.var(name).shape or ()) != list(shape):
+                raise ValueError(
+                    f"slice_var_up: block var '{name}' already exists "
+                    f"with shape {block.var(name).shape}, new slicing "
+                    f"wants {shape} — transpile a fresh program (or the "
+                    f"same pserver count)")
+            return block.create_var(name=name, shape=shape, dtype=dtype,
+                                    **kw)
+        for p, g in pairs:
+            pv = block.var(p)
+            shape = list(pv.shape or ())
+            rows = int(shape[0]) if shape else 0
+            numel = int(np.prod([max(int(d), 1) for d in shape])) if shape \
+                else 0
+            if rows < n_eps or numel < min_block_size * n_eps:
+                new_pairs.append((p, g))
+                continue
+            base, rem = divmod(rows, n_eps)
+            sections = [base + (1 if k < rem else 0) for k in range(n_eps)]
+            starts = list(np.cumsum([0] + sections[:-1]))
+            p_blocks, g_blocks = [], []
+            ops = self.grad_to_ops.pop(g)
+            for k, rk in enumerate(sections):
+                bshape = [rk] + shape[1:]
+                pb, gb = f"{p}.block{k}", f"{g}.block{k}"
+                bvar(pb, bshape, pv.dtype, persistable=True)
+                bvar(gb, bshape, pv.dtype, stop_gradient=True)
+                self._block_src[pb] = (p, int(starts[k]),
+                                       int(starts[k]) + rk)
+                p_blocks.append(pb)
+                g_blocks.append(gb)
+                blk_ops = []
+                for op in ops:
+                    nop = OpDesc(op.type, dict(op.inputs),
+                                 dict(op.outputs), dict(op.attrs))
+                    writes = set(op.output_names())
+                    rename = {p: pb, g: gb}
+                    # param-shaped aux state (moments/velocity) slices
+                    # with the param; [1]-shaped state (beta pows)
+                    # replicates per block under a block-suffixed name
+                    for name in list(nop.input_names()) \
+                            + list(nop.output_names()):
+                        if name in rename or name in (p, g):
+                            continue
+                        v = block._find_var_recursive(name)
+                        if v is None or not getattr(v, "persistable", False):
+                            continue
+                        vshape = list(v.shape or ())
+                        if vshape and int(vshape[0]) == rows:
+                            nb = f"{name}.block{k}"
+                            bvar(nb, [rk] + vshape[1:], v.dtype,
+                                 persistable=True)
+                            rename[name] = nb
+                            self._block_src[nb] = (name, int(starts[k]),
+                                                   int(starts[k]) + rk)
+                        elif vshape == [1] and name in writes:
+                            # read-WRITE scalar state (beta pows)
+                            # replicates per block; input-only scalars
+                            # (the shared LR var, whatever its name)
+                            # stay shared so LR schedules keep working
+                            nb = f"{name}.block{k}"
+                            bvar(nb, [1], v.dtype, persistable=True)
+                            rename[name] = nb
+                            self._block_src[nb] = (name, None, None)
+                    for slot, names in nop.inputs.items():
+                        nop.inputs[slot] = [rename.get(n, n) for n in names]
+                    for slot, names in nop.outputs.items():
+                        nop.outputs[slot] = [rename.get(n, n)
+                                             for n in names]
+                    blk_ops.append(nop)
+                self.grad_to_ops[gb] = blk_ops
+                new_pairs.append((pb, gb))
+            self._sliced[p] = {"sections": sections, "grad": g,
+                               "p_blocks": p_blocks, "g_blocks": g_blocks}
+        return new_pairs
+
     # -- trainer side --------------------------------------------------------
     def get_trainer_program(self) -> Program:
-        """Forward + backward, optimizer ops replaced by send/recv."""
+        """Forward + backward, optimizer ops replaced by send/recv; for
+        sliced params the grad SPLITS before the sends and the received
+        blocks CONCAT back (reference: the splited-var send/concat the
+        transpiler emits around grad/param blocks)."""
         assert self._done, "call transpile() first"
         trainer = Program()
         dst = trainer.global_block()
         dst._load_dict(self.program.global_block().to_dict())
         dst.ops = [op for op in dst.ops if not _is_server_side(op)]
+        role = {"op_role": int(OpRole.Optimize)}
+        for info in self._sliced.values():
+            dst.ops.append(OpDesc(
+                "split", {"X": [info["grad"]]},
+                {"Out": list(info["g_blocks"])},
+                {"sections": list(info["sections"]), "axis": 0, **role}))
         # send each grad to its param's pserver, then recv updated params
         for p, g in self._pairs:
             ep = self.param_to_ep[p]
             dst.ops.append(OpDesc(
                 "send", {"X": [g]}, {},
                 {"endpoint": ep, "trainer_id": self.trainer_id,
-                 "var_names": [g], "sync_mode": self.sync_mode,
-                 "op_role": int(OpRole.Optimize)}))
+                 "var_names": [g], "sync_mode": self.sync_mode, **role}))
         dst.ops.append(OpDesc("send_barrier", {}, {}, {
-            "endpoints": list(self.endpoints),
-            "op_role": int(OpRole.Optimize)}))
+            "endpoints": list(self.endpoints), **role}))
         for p, g in self._pairs:
             ep = self.param_to_ep[p]
             dst.ops.append(OpDesc(
                 "recv", {}, {"Out": [p]},
                 {"endpoint": ep, "var_names": [p],
-                 "sync_mode": self.sync_mode,
-                 "op_role": int(OpRole.Optimize)}))
+                 "sync_mode": self.sync_mode, **role}))
+        for full, info in self._sliced.items():
+            dst.ops.append(OpDesc(
+                "concat", {"X": list(info["p_blocks"])}, {"Out": [full]},
+                {"axis": 0, **role}))
         dst.ops.append(OpDesc("fetch_barrier", {}, {}, {
-            "endpoints": list(self.endpoints),
-            "op_role": int(OpRole.Optimize)}))
+            "endpoints": list(self.endpoints), **role}))
         trainer._bump_version()
         return trainer
 
@@ -179,18 +306,51 @@ class DistributeTranspiler:
             blk.ops.extend(my_ops[g])
         prog._bump_version()
 
-        # startup: original startup ops that produce the needed vars
+        # startup: original startup ops that produce the needed vars;
+        # sliced-block vars initialise by running the FULL var's original
+        # init then slicing the block out — bit-identical to the
+        # whole-param (and local) initialisation, whatever the
+        # initializer (reference keeps init on the pserver side too)
+        block_src = getattr(self, "_block_src", {})
+        full_needed = set()
+        for name in needed_vars:
+            if name in block_src:
+                full_needed.add(block_src[name][0])
         startup = Program()
         sblk = startup.global_block()
         src_startup = self.startup.global_block()
-        for name in sorted(needed_vars):
+        for name in sorted(needed_vars | full_needed):
             if src_startup.has_var(name):
-                sblk._load_dict(
-                    {"vars": [src_startup.var(name).desc.to_dict()],
-                     "ops": []})
+                d = src_startup.var(name).desc.to_dict()
+                if name in full_needed and name not in needed_vars:
+                    # init-then-slice scratch: non-persistable, so the
+                    # interpreting startup run DISCARDS the full tensor —
+                    # a pserver must not retain whole sliced params
+                    d = dict(d, persistable=False)
+                sblk._load_dict({"vars": [d], "ops": []})
         for op in src_startup.ops:
-            if any(o in needed_vars for o in op.output_names()):
+            if any(o in needed_vars or o in full_needed
+                   for o in op.output_names()):
                 sblk.ops.append(op)
+        for name in sorted(needed_vars):
+            src = block_src.get(name)
+            if src is None:
+                continue
+            # declare the block var in the startup block (persistable —
+            # the interpreting run only writes DECLARED persistables back
+            # to the scope) using the main-block descriptor
+            if not sblk.has_var(name) and src_block.has_var(name):
+                sblk._load_dict(
+                    {"vars": [src_block.var(name).desc.to_dict()],
+                     "ops": []})
+            full, s0, s1 = src
+            if s0 is None:               # [1]-shaped replica (beta pows)
+                sblk.ops.append(OpDesc("assign", {"X": [full]},
+                                       {"Out": [name]}, {}))
+            else:
+                sblk.ops.append(OpDesc(
+                    "slice", {"Input": [full]}, {"Out": [name]},
+                    {"axes": [0], "starts": [s0], "ends": [s1]}))
         startup._bump_version()
 
         prog._ps_grad_to_param = {g: self.grad_to_param[g]

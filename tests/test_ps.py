@@ -138,6 +138,104 @@ class TestTranspiler:
         finally:
             server.shutdown()
 
+    def test_slice_var_up_matches_whole_param(self):
+        """slice_var_up (reference distribute_transpiler.py:545): big
+        params split into one block per pserver; each server holds and
+        updates ONLY its block, the trainer splits grads / concats
+        params — and training matches the single-process run EXACTLY
+        (momentum, so per-block accumulators are exercised too)."""
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+        from paddle_tpu.core import ir, unique_name
+        from paddle_tpu.distributed.ps import DistributeTranspiler, PServer
+        from paddle_tpu.distributed.ps.rpc import RPCClient
+        from paddle_tpu.ops.ps_ops import reset_recv_versions
+
+        def build():
+            ir._main_program, ir._startup_program = ir.Program(), ir.Program()
+            unique_name.switch()
+            main, startup = pt.Program(), pt.Program()
+            with pt.program_guard(main, startup):
+                x = layers.data("x", [16], stop_gradient=True)
+                label = layers.data("label", [1], dtype="int64",
+                                    stop_gradient=True)
+                h = layers.fc(x, 64, act="relu",
+                              param_attr=pt.ParamAttr(
+                                  name="w_big",
+                                  initializer=pt.initializer.Xavier(
+                                      seed=5)),
+                              bias_attr=pt.ParamAttr(name="b0"))
+                logits = layers.fc(h, 4, param_attr=pt.ParamAttr(
+                    name="w_out", initializer=pt.initializer.Xavier(
+                        seed=6)), bias_attr=pt.ParamAttr(name="b1"))
+                loss = layers.mean(
+                    layers.softmax_with_cross_entropy(logits, label))
+                pt.optimizer.MomentumOptimizer(0.1, 0.9).minimize(loss)
+            return main, startup, loss
+
+        rng = np.random.RandomState(0)
+        xv = rng.randn(8, 16).astype(np.float32)
+        yv = rng.randint(0, 4, (8, 1)).astype(np.int64)
+
+        # local baseline
+        main, startup, loss = build()
+        exe = pt.Executor(pt.CPUPlace())
+        scope = pt.Scope()
+        exe.run(startup, scope=scope, use_compiled=False)
+        local_losses = []
+        for _ in range(4):
+            out = exe.run(main, feed={"x": xv, "label": yv},
+                          fetch_list=[loss], scope=scope,
+                          use_compiled=False)
+            local_losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+        w_local = np.asarray(scope.find_var("w_big"))
+
+        # sliced 2-pserver cluster (in-process servers, 1 trainer)
+        main, startup, loss = build()
+        eps = "127.0.0.1:17491,127.0.0.1:17492"
+        t = DistributeTranspiler()
+        t.transpile(0, program=main, startup_program=startup, pservers=eps,
+                    trainers=1, sync_mode=True, slice_var_up=True,
+                    min_block_size=1)
+        assert "w_big" in t._sliced
+        assert t._sliced["w_big"]["sections"] == [8, 8]
+        servers = []
+        try:
+            for ep in eps.split(","):
+                prog, ps_startup = t.get_pserver_programs(ep)
+                servers.append(PServer(
+                    ep, prog, ps_startup, num_trainers=1, sync_mode=True,
+                    grad_to_param=prog._ps_grad_to_param,
+                    grad_to_ops=prog._ps_grad_to_ops,
+                    common_ops=prog._ps_common_ops))
+            # each server owns exactly one block of the sliced param
+            owned = [{p for p in s.grad_to_param.values()
+                      if p.startswith("w_big.block")} for s in servers]
+            assert all(len(o) == 1 for o in owned) and owned[0] != owned[1]
+
+            reset_recv_versions()
+            trainer_prog = t.get_trainer_program()
+            exe2 = pt.Executor(pt.CPUPlace())
+            scope2 = pt.Scope()
+            exe2.run(t.get_startup_program(), scope=scope2,
+                     use_compiled=False)
+            ps_losses = []
+            for _ in range(4):
+                out = exe2.run(trainer_prog, feed={"x": xv, "label": yv},
+                               fetch_list=[loss], scope=scope2,
+                               use_compiled=False)
+                ps_losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+            np.testing.assert_allclose(ps_losses, local_losses, rtol=1e-5)
+            w_blocks = np.concatenate(
+                [np.asarray(servers[k].scope.find_var(f"w_big.block{k}"))
+                 for k in range(2)], axis=0)
+            np.testing.assert_allclose(w_blocks, w_local, rtol=1e-5)
+        finally:
+            for s in servers:
+                s.shutdown()
+            RPCClient.reset_pool()
+            reset_recv_versions()
+
     def test_half_async_merges_before_apply(self):
         """HalfAsync (reference communicator.h:343): no barriers, but
         grads buffer and apply as the mean of merge_size contributions —
