@@ -975,6 +975,39 @@ def crf_decoding(input, param_attr, label=None, length=None, name=None):
     return out
 
 
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             path_table=None, path_code=None, name=None):
+    """Hierarchical sigmoid loss layer (reference: layers/nn.py hsigmoid
+    → hierarchical_sigmoid_op.cc): O(log C) softmax over the default
+    complete binary tree, or a custom tree via path_table/path_code.
+    Returns Cost [B, 1]."""
+    if (path_table is None) != (path_code is None):
+        raise ValueError(
+            "hsigmoid: path_table and path_code must be passed together "
+            "(custom-tree mode) or both omitted (default complete tree)")
+    helper = LayerHelper("hsigmoid", name=name)
+    d = int(input.shape[-1])
+    # reference shapes: default tree has num_classes-1 internal nodes;
+    # a custom tree's node ids may reach num_classes-1, so its table is
+    # [num_classes, d] (fluid layers/nn.py hsigmoid)
+    rows = num_classes - 1 if path_table is None else num_classes
+    w = helper.create_parameter(param_attr, [rows, d], input.dtype)
+    ins = {"X": [input], "W": [w], "Label": [label]}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [rows], input.dtype,
+                                    is_bias=True)
+        ins["Bias"] = [b]
+    if path_table is not None:
+        ins["PathTable"] = [path_table]
+        ins["PathCode"] = [path_code]
+    out = helper.create_variable_for_type_inference(input.dtype)
+    pre = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op("hierarchical_sigmoid", ins,
+                     {"Out": [out], "PreOut": [pre]},
+                     {"num_classes": int(num_classes)})
+    return out
+
+
 def ctc_greedy_decoder(input, blank, input_length=None, padding_value=0,
                        name=None):
     """Greedy CTC decode = argmax per step + ctc_align collapse
