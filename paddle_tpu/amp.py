@@ -23,6 +23,7 @@ WHITE_LIST = frozenset({"matmul", "matmul_v2", "mul", "bmm", "conv2d",
                         "depthwise_conv2d", "conv2d_transpose"})
 BLACK_LIST = frozenset({"softmax", "log_softmax", "softmax_with_cross_entropy",
                         "cross_entropy", "layer_norm", "batch_norm",
+                        "sync_batch_norm",
                         "group_norm", "mean", "reduce_mean", "reduce_sum",
                         "exp", "log", "sum"})
 

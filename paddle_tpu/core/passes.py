@@ -188,8 +188,10 @@ def conv_bn_fuse_pass(program: Program, scope=None) -> Program:
         if op.type == "conv2d" and int(op.attrs.get("groups", 1) or 1) == 1:
             out = _out(op, "Output")
             cons = consumers.get(out, [])
+            # sync_batch_norm folds identically: its is_test path uses
+            # only running stats (no cross-rank reduction)
             bn = cons[0] if len(cons) == 1 and \
-                cons[0].type == "batch_norm" else None
+                cons[0].type in ("batch_norm", "sync_batch_norm") else None
             if bn is not None and (bool(bn.attrs.get("is_test", False))
                                    or bool(bn.attrs.get(
                                        "use_global_stats", False))):

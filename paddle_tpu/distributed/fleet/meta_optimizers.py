@@ -61,6 +61,28 @@ def insert_grad_allreduce(program: Program, params_grads, nranks: int,
                              "op_role_var": [p.name, g.name]})
 
 
+def rewrite_sync_batch_norm(program: Program, axis_name="dp"):
+    """Flip every batch_norm op to sync_batch_norm (reference:
+    BuildStrategy.sync_batch_norm — framework/ir/sync_batch_norm_pass.cc
+    rewrites op type so stats allreduce across ranks). MUST run BEFORE
+    backward() so the grad maker re-traces the sync forward (its psum
+    transposes into the reference grad kernel's cross-rank reductions)."""
+    n = 0
+    for block in program.blocks:
+        for op in block.ops:
+            if op.type == "__vjp_grad__" and \
+                    op.attrs.get("fwd_type") == "batch_norm":
+                raise ValueError(
+                    "rewrite_sync_batch_norm must run BEFORE backward(): a "
+                    "batch_norm grad op already exists and would keep rank-"
+                    "local statistics, silently desyncing fwd and bwd")
+            if op.type == "batch_norm":
+                op.type = "sync_batch_norm"
+                op.attrs.setdefault("axis_name", axis_name)
+                n += 1
+    return n
+
+
 # ---------------------------------------------------------------------------
 # AMP: bf16 rewrite + loss scaling
 # ---------------------------------------------------------------------------
@@ -68,7 +90,8 @@ def insert_grad_allreduce(program: Program, params_grads, nranks: int,
 AMP_WHITE_LIST = {"matmul", "matmul_v2", "mul", "conv2d", "depthwise_conv2d",
                   "bmm"}
 AMP_BLACK_LIST = {"softmax_with_cross_entropy", "cross_entropy", "layer_norm",
-                  "batch_norm", "mean", "reduce_mean", "softmax", "exp", "log"}
+                  "batch_norm", "sync_batch_norm", "mean", "reduce_mean",
+                  "softmax", "exp", "log"}
 
 
 def rewrite_program_bf16(program: Program, white_list=None, black_list=None):

@@ -20,7 +20,8 @@ from . import functional as F
 
 __all__ = [
     "Layer", "Linear", "Conv2D", "Conv2DTranspose", "Embedding", "Dropout",
-    "BatchNorm", "BatchNorm1D", "BatchNorm2D", "LayerNorm", "GroupNorm",
+    "BatchNorm", "BatchNorm1D", "BatchNorm2D", "SyncBatchNorm", "LayerNorm",
+    "GroupNorm",
     "ReLU", "GELU", "Sigmoid", "Tanh", "Softmax", "LeakyReLU", "Hardswish",
     "Silu", "MaxPool2D", "AvgPool2D", "AdaptiveAvgPool2D", "AdaptiveMaxPool2D",
     "Flatten", "Pad2D", "Sequential", "LayerList", "ParameterList",
@@ -176,10 +177,48 @@ class BatchNorm2D(_BatchNormBase):
 
 
 class SyncBatchNorm(_BatchNormBase):
-    """On TPU, batch stats sync falls out of SPMD compilation: under a dp
-    mesh the reduction is global (reference: sync_batch_norm_op.cu needs an
-    explicit NCCL allreduce)."""
-    pass
+    """Cross-rank batch norm (reference: operators/sync_batch_norm_op.cu:21
+    and python/paddle/nn/layer/norm.py SyncBatchNorm). Emits the
+    `sync_batch_norm` op, whose batch statistics are psum'd over the data-
+    parallel mesh axis inside the shard_map SPMD region — the reference's
+    explicit NCCL allreduce of sum/sumsq. Under GSPMD auto-sharding a plain
+    batch_norm's reduction is already global, but the framework's primary
+    collective mode is shard_map, where per-rank `mean` is rank-LOCAL;
+    this layer is the correct choice there."""
+
+    def forward(self, x):
+        y = F.sync_batch_norm(
+            x, self._mean, self._variance, self.weight, self.bias,
+            training=self.training, momentum=self._momentum,
+            epsilon=self._epsilon, data_format=self._data_format)
+        # fluid-style BatchNorm(act=...) converted layers keep their act
+        act = getattr(self, "_act", None)
+        if act:
+            y = getattr(F, act)(y)
+        return y
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        """Recursively replace BatchNorm* sublayers with SyncBatchNorm,
+        reusing parameters and running-stat buffers (reference:
+        python/paddle/nn/layer/norm.py convert_sync_batchnorm)."""
+        if isinstance(layer, _BatchNormBase) and not isinstance(
+                layer, SyncBatchNorm):
+            out = cls.__new__(cls)
+            Layer.__init__(out)
+            out._momentum, out._epsilon = layer._momentum, layer._epsilon
+            out._data_format = layer._data_format
+            out._act = getattr(layer, "_act", None)
+            # adopt params/buffers in place so optimizer state carries
+            # over — alias the existing vars directly (register_buffer
+            # would re-create them in static mode)
+            out.weight, out.bias = layer.weight, layer.bias
+            out._buffers["_mean"] = layer._mean
+            out._buffers["_variance"] = layer._variance
+            return out
+        for name, sub in list(getattr(layer, "_sub_layers", {}).items()):
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return layer
 
 
 class LayerNorm(Layer):

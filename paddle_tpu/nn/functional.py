@@ -119,7 +119,8 @@ def dropout(x, p=0.5, training=True, mode="upscale_in_train", name=None):
 
 
 def batch_norm(x, running_mean, running_var, weight, bias, training=False,
-               momentum=0.9, epsilon=1e-5, data_format="NCHW", name=None):
+               momentum=0.9, epsilon=1e-5, data_format="NCHW", name=None,
+               _op_type="batch_norm"):
     ins = {"X": x, "Scale": weight, "Bias": bias, "Mean": running_mean,
            "Variance": running_var}
     attrs = {"momentum": momentum, "epsilon": epsilon,
@@ -127,7 +128,7 @@ def batch_norm(x, running_mean, running_var, weight, bias, training=False,
     if in_dygraph_mode():
         from ..dygraph.tracer import trace_op
 
-        outs = trace_op("batch_norm", ins, attrs)
+        outs = trace_op(_op_type, ins, attrs)
         if training:
             # thread running stats back into the caller's buffers
             running_mean._array = outs["MeanOut"][0]._array
@@ -139,11 +140,23 @@ def batch_norm(x, running_mean, running_var, weight, bias, training=False,
     y = block.create_var(name=unique_name.generate("batch_norm.y"))
     sm = block.create_var(name=unique_name.generate("batch_norm.saved_mean"))
     sv = block.create_var(name=unique_name.generate("batch_norm.saved_var"))
-    block.append_op("batch_norm", ins,
+    block.append_op(_op_type, ins,
                     {"Y": [y], "MeanOut": [running_mean],
                      "VarianceOut": [running_var], "SavedMean": [sm],
                      "SavedVariance": [sv]}, attrs)
     return y
+
+
+def sync_batch_norm(x, running_mean, running_var, weight, bias,
+                    training=False, momentum=0.9, epsilon=1e-5,
+                    data_format="NCHW", name=None):
+    """batch_norm with cross-rank statistics allreduce (reference:
+    operators/sync_batch_norm_op.cu). Degenerates to batch_norm outside an
+    SPMD region."""
+    return batch_norm(x, running_mean, running_var, weight, bias,
+                      training=training, momentum=momentum, epsilon=epsilon,
+                      data_format=data_format, name=name,
+                      _op_type="sync_batch_norm")
 
 
 def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5, name=None):

@@ -261,11 +261,10 @@ def hierarchical_sigmoid(ins, attrs):
             "PreOut": jnp.where(valid, pre, 0.0)}
 
 
-@register_op("batch_norm")
-def batch_norm(ins, attrs):
-    """reference: operators/batch_norm_op.cc. Outputs Y plus updated running
-    stats (MeanOut/VarianceOut alias the input stat vars — in-place through
-    scope threading) and SavedMean/SavedVariance for the backward."""
+def _batch_norm_impl(ins, attrs, cross_rank=False):
+    """Shared batch_norm body. cross_rank=True allreduces the batch
+    sum/sumsq/count over the mesh axis before normalising
+    (sync_batch_norm)."""
     import jax.numpy as jnp
 
     x = ins["X"][0]
@@ -287,8 +286,23 @@ def batch_norm(ins, attrs):
         saved_var = jnp.zeros_like(var)
     else:
         xf = x.astype(jnp.float32)
-        use_mean = jnp.mean(xf, axis=axes)
-        use_var = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(use_mean)
+        s = jnp.sum(xf, axis=axes)
+        ss = jnp.sum(jnp.square(xf), axis=axes)
+        cnt = jnp.asarray(float(np.prod([x.shape[a] for a in axes])),
+                          jnp.float32)
+        if cross_rank:
+            import jax
+
+            from .collective_ops import _axis_name, _bound_axes
+
+            bound = _bound_axes(_axis_name(attrs))
+            if bound:
+                ax = bound if len(bound) > 1 else bound[0]
+                s = jax.lax.psum(s, ax)
+                ss = jax.lax.psum(ss, ax)
+                cnt = jax.lax.psum(cnt, ax)
+        use_mean = s / cnt
+        use_var = ss / cnt - jnp.square(use_mean)
         mean_out = mean * momentum + use_mean * (1.0 - momentum)
         var_out = var * momentum + use_var * (1.0 - momentum)
         saved_mean = use_mean
@@ -299,6 +313,29 @@ def batch_norm(ins, attrs):
         bias.reshape(bshape).astype(x.dtype)
     return {"Y": y, "MeanOut": mean_out, "VarianceOut": var_out,
             "SavedMean": saved_mean, "SavedVariance": saved_var}
+
+
+@register_op("batch_norm")
+def batch_norm(ins, attrs):
+    """reference: operators/batch_norm_op.cc. Outputs Y plus updated running
+    stats (MeanOut/VarianceOut alias the input stat vars — in-place through
+    scope threading) and SavedMean/SavedVariance for the backward."""
+    return _batch_norm_impl(ins, attrs, cross_rank=False)
+
+
+@register_op("sync_batch_norm", is_collective=True)
+def sync_batch_norm(ins, attrs):
+    """reference: operators/sync_batch_norm_op.cu:21 (SyncBatchNormKernel) —
+    batch_norm whose batch statistics are allreduced across data-parallel
+    ranks before normalisation. The reference does an explicit NCCL
+    allreduce of per-rank sum/sumsq; here the op emits lax.psum over the
+    mesh axis (attrs axis_name, default "dp"), which XLA lowers to an ICI
+    allreduce. Outside an SPMD region (world size 1) it degenerates to
+    batch_norm exactly — matching the reference where a ring of size 1 is
+    a no-op. The backward needs no special handling: JAX transposes the
+    psum in the re-traced forward, reproducing the reference grad kernel's
+    cross-rank dy/dy·x̂ reductions."""
+    return _batch_norm_impl(ins, attrs, cross_rank=True)
 
 
 @register_op("layer_norm")

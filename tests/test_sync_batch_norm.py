@@ -1,0 +1,125 @@
+"""sync_batch_norm (VERDICT r3 #2): cross-rank batch statistics.
+
+Reference: operators/sync_batch_norm_op.cu:21 (SyncBatchNormKernel does an
+explicit NCCL allreduce of sum/sumsq before normalising) and
+framework/ir/sync_batch_norm_pass.cc (BuildStrategy flips batch_norm ->
+sync_batch_norm). The decisive check: under the shard_map collective mode a
+dp4 SyncBatchNorm run must match single-rank full-batch BN exactly, while
+plain BatchNorm (rank-local stats) must NOT."""
+
+import numpy as np
+import pytest
+
+
+def _fresh():
+    from paddle_tpu.core import ir, unique_name
+
+    ir._main_program, ir._startup_program = ir.Program(), ir.Program()
+    unique_name.switch()
+
+
+PARAM_NAMES = ("bn_s", "bn_b", "bn_m", "bn_v")
+
+
+def _feed(s):
+    rng = np.random.RandomState(50 + s)
+    x = rng.randn(8, 4, 2, 2).astype(np.float32)
+    # make per-rank shards statistically distinct so local-vs-global
+    # stats visibly diverge: shift each dp shard (2 samples) differently
+    for r in range(4):
+        x[2 * r:2 * r + 2] += 2.0 * r
+    return {"x": x}
+
+
+def _train(sync, nranks, steps=3):
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.distributed.fleet.meta_optimizers import (
+        insert_grad_allreduce, rewrite_sync_batch_norm)
+    from paddle_tpu.parallel import create_mesh
+
+    _fresh()
+    mesh = create_mesh({"dp": nranks}) if nranks > 1 else None
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.static_data("x", [8, 4, 2, 2])
+        y = layers.batch_norm(
+            x, param_attr=pt.ParamAttr(name="bn_s"),
+            bias_attr=pt.ParamAttr(name="bn_b"),
+            moving_mean_name="bn_m", moving_variance_name="bn_v")
+        loss = layers.mean(y * y * y + y)  # nonlinear: grads see the stats
+        if sync:
+            assert rewrite_sync_batch_norm(main) == 1
+        opt = pt.optimizer.SGDOptimizer(0.1)
+        params_grads = opt.backward(loss)
+        if nranks > 1:
+            insert_grad_allreduce(main, params_grads, nranks=nranks,
+                                  axis_name="dp", average=True)
+        opt.apply_gradients(params_grads)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    exe.run(startup, scope=scope, use_compiled=False)
+    for s in range(steps):
+        exe.run(main, feed=_feed(s), fetch_list=[loss], scope=scope,
+                mesh=mesh)
+    return {n: np.asarray(scope.find_var(n)) for n in PARAM_NAMES}
+
+
+class TestSyncBatchNorm:
+    def test_dp4_sync_matches_single_rank_full_batch(self):
+        oracle = _train(sync=False, nranks=1)
+        dp4 = _train(sync=True, nranks=4)
+        for n in PARAM_NAMES:
+            np.testing.assert_allclose(dp4[n], oracle[n], rtol=2e-5,
+                                       atol=1e-6, err_msg=n)
+
+    def test_dp4_plain_bn_diverges(self):
+        """The hole sync_batch_norm closes: rank-local stats drift."""
+        oracle = _train(sync=False, nranks=1)
+        dp4 = _train(sync=False, nranks=4)
+        diff = max(np.abs(dp4[n] - oracle[n]).max() for n in PARAM_NAMES)
+        assert diff > 1e-3, "plain BN unexpectedly matched global stats"
+
+    def test_single_rank_sync_degenerates_to_bn(self):
+        a = _train(sync=False, nranks=1)
+        b = _train(sync=True, nranks=1)
+        for n in PARAM_NAMES:
+            np.testing.assert_allclose(b[n], a[n], rtol=1e-6, err_msg=n)
+
+    def test_registry_has_op(self):
+        from paddle_tpu.core.registry import registered_ops
+
+        assert "sync_batch_norm" in registered_ops()
+
+
+class TestSyncBatchNormLayer:
+    def test_dygraph_forward_matches_bn_single_rank(self):
+        import paddle_tpu as pt
+        import paddle_tpu.nn as nn
+
+        _fresh()
+        with pt.dygraph.guard():
+            x = pt.to_tensor(
+                np.random.RandomState(0).randn(4, 3, 2, 2).astype(
+                    np.float32))
+            bn = nn.BatchNorm2D(3)
+            sbn = nn.SyncBatchNorm(3)
+            bn.train(), sbn.train()
+            np.testing.assert_allclose(np.asarray(sbn(x)), np.asarray(bn(x)),
+                                       rtol=1e-6)
+
+    def test_convert_sync_batchnorm(self):
+        import paddle_tpu as pt
+        import paddle_tpu.nn as nn
+
+        _fresh()
+        with pt.dygraph.guard():
+            net = nn.Sequential(nn.Conv2D(3, 4, 3), nn.BatchNorm2D(4),
+                                nn.ReLU())
+            w_before = np.asarray(net[1].weight)
+            net = nn.SyncBatchNorm.convert_sync_batchnorm(net)
+            assert isinstance(net[1], nn.SyncBatchNorm)
+            np.testing.assert_array_equal(np.asarray(net[1].weight), w_before)
+            x = pt.to_tensor(np.ones((2, 3, 4, 4), np.float32))
+            y = net(x)
+            assert tuple(y.shape) == (2, 4, 2, 2)
