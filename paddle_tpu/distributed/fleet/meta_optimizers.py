@@ -67,7 +67,9 @@ def rewrite_sync_batch_norm(program: Program, axis_name="dp"):
     rewrites op type so stats allreduce across ranks). MUST run BEFORE
     backward() so the grad maker re-traces the sync forward (its psum
     transposes into the reference grad kernel's cross-rank reductions)."""
-    n = 0
+    # guard pass FIRST (grad ops sit after forward ops in block order —
+    # mutating while scanning would leave the program half-rewritten
+    # when the raise fires)
     for block in program.blocks:
         for op in block.ops:
             if op.type == "__vjp_grad__" and \
@@ -76,6 +78,9 @@ def rewrite_sync_batch_norm(program: Program, axis_name="dp"):
                     "rewrite_sync_batch_norm must run BEFORE backward(): a "
                     "batch_norm grad op already exists and would keep rank-"
                     "local statistics, silently desyncing fwd and bwd")
+    n = 0
+    for block in program.blocks:
+        for op in block.ops:
             if op.type == "batch_norm":
                 op.type = "sync_batch_norm"
                 op.attrs.setdefault("axis_name", axis_name)

@@ -424,3 +424,57 @@ class DynamicRNN:
         """Final (length-frozen) memory states — the reference's
         drnn memory at sequence end."""
         return self._results["memories"]
+
+
+def lod_rank_table(x, level=0, name=None):
+    """Build the length-descending rank table (reference:
+    layers/control_flow.py lod_rank_table over lod_rank_table_op.cc).
+    Padded form: x is the per-row Length tensor [B]; returns the
+    (Items, Index) pair consumed by lod_tensor_to_array /
+    array_to_lod_tensor / shrink_memory."""
+    helper = LayerHelper("lod_rank_table", name=name)
+    items = helper.create_variable_for_type_inference("int32", True)
+    index = helper.create_variable_for_type_inference("int32", True)
+    helper.append_op("lod_rank_table", {"X": [x]},
+                     {"Items": [items], "Index": [index]}, {})
+    return items, index
+
+
+def lod_tensor_to_array(x, table, name=None):
+    """reference: layers/control_flow.py lod_tensor_to_array
+    (lod_tensor_to_array_op.cc). `table` is the (Items, Index) pair from
+    lod_rank_table; returns the [S, B, ...] step-stacked array with
+    finished rows zeroed."""
+    helper = LayerHelper("lod_tensor_to_array", name=name)
+    items, index = table
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("lod_tensor_to_array",
+                     {"X": [x], "RankTable": [items, index]},
+                     {"Out": [out]}, {})
+    return out
+
+
+def array_to_lod_tensor(x, table, name=None):
+    """reference: layers/control_flow.py array_to_lod_tensor — inverse of
+    lod_tensor_to_array."""
+    helper = LayerHelper("array_to_lod_tensor", name=name)
+    items, index = table
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("array_to_lod_tensor",
+                     {"X": [x], "RankTable": [items, index]},
+                     {"Out": [out]}, {})
+    return out
+
+
+def shrink_memory(x, i, table, name=None):
+    """reference: layers/control_flow.py shrink_memory
+    (shrink_rnn_memory_op.cc) — zero the rank-ordered memory rows whose
+    sequence finished before step i (static-shape form of the
+    shrinking-batch decode)."""
+    helper = LayerHelper("shrink_memory", name=name)
+    items, index = table
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("shrink_rnn_memory",
+                     {"X": [x], "RankTable": [items, index], "I": [i]},
+                     {"Out": [out]}, {})
+    return out

@@ -1154,3 +1154,66 @@ def switch_moe(x, num_experts, d_ff, capacity_factor=1.25, axis_name="ep",
                       "tokens_sharded": bool(tokens_sharded),
                       "nranks": int(ep_size)})
     return out, aux
+
+
+def masked_select(x, mask, name=None):
+    """reference: masked_select_op.cc via python masked_select API. Static
+    form returns (values, count): values is padded to x.size with the
+    first `count` slots holding the selected elements."""
+    helper = LayerHelper("masked_select", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    cnt = helper.create_variable_for_type_inference("int32", True)
+    helper.append_op("masked_select", {"X": [x], "Mask": [mask]},
+                     {"Y": [out], "Count": [cnt]}, {})
+    return out, cnt
+
+
+def partial_sum(input, start_index=0, length=-1, name=None):
+    """reference: contrib partial_sum (partial_sum_op.cc)."""
+    helper = LayerHelper("partial_sum", name=name)
+    xs = input if isinstance(input, (list, tuple)) else [input]
+    out = helper.create_variable_for_type_inference(xs[0].dtype)
+    helper.append_op("partial_sum", {"X": list(xs)}, {"Out": [out]},
+                     {"start_index": int(start_index), "length": int(length)})
+    return out
+
+
+def partial_concat(input, start_index=0, length=-1, name=None):
+    """reference: contrib partial_concat (partial_concat_op.cc)."""
+    helper = LayerHelper("partial_concat", name=name)
+    xs = input if isinstance(input, (list, tuple)) else [input]
+    out = helper.create_variable_for_type_inference(xs[0].dtype)
+    helper.append_op("partial_concat", {"X": list(xs)}, {"Out": [out]},
+                     {"start_index": int(start_index), "length": int(length)})
+    return out
+
+
+def py_func(func, x, out, backward_func=None, name=None):
+    """reference: python/paddle/fluid/layers/nn.py py_func (py_func_op.cc)
+    — run a Python callable as a program op via jax.pure_callback.
+
+    `out` vars must be pre-created with concrete shape/dtype (the host
+    round-trip needs static result shapes). backward_func, if given,
+    receives (*forward_inputs, *out_grads) and returns one grad per
+    forward input."""
+    from ..ops.extra_ops4 import register_py_func
+
+    helper = LayerHelper("py_func", name=name)
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    for o in outs:
+        if o.shape is None or any(int(d) < 0 for d in o.shape):
+            raise ValueError(
+                "py_func out vars need fully static shapes (got "
+                f"{o.name}: {o.shape})")
+    attrs = {
+        "callable_id": register_py_func(func),
+        "out_shapes": [[int(d) for d in o.shape] for o in outs],
+        "out_dtypes": [str(o.dtype) for o in outs],
+        "backward_callable_id": (
+            register_py_func(backward_func) if backward_func else -1),
+        "in_shapes_for_grad": [[int(d) for d in v.shape] for v in xs],
+        "in_dtypes_for_grad": [str(v.dtype) for v in xs],
+    }
+    helper.append_op("py_func", {"X": list(xs)}, {"Out": list(outs)}, attrs)
+    return out

@@ -328,7 +328,7 @@ def hash_op(ins, attrs):
     outs = []
     for i in range(num_hash):
         h = (x * jnp.uint32(2654435761 + 97 * i)
-             + jnp.uint32(0x9E3779B9 * (i + 1)))
+             + jnp.uint32((0x9E3779B9 * (i + 1)) & 0xFFFFFFFF))
         h = h ^ (h >> 16)
         outs.append((h % jnp.uint32(mod)).astype(jnp.int64))
     return {"Out": jnp.stack(outs, axis=-1)}

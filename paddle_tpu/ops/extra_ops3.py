@@ -247,39 +247,59 @@ def chunk_eval(ins, attrs):
     valid = jnp.arange(s)[None, :] < ln.reshape(-1, 1)
     t_types = int(attrs.get("num_chunk_types", 1))
     scheme = str(attrs.get("chunk_scheme", "IOB"))
-    if scheme != "IOB":
-        raise NotImplementedError(
-            f"chunk_eval: chunk_scheme '{scheme}' not supported (IOB "
-            f"only — reference chunk_eval_op.h also offers IOE/IOBES/"
-            f"plain)")
+    # (num_tag_types, tag_begin, tag_inside, tag_end, tag_single) —
+    # exactly the scheme table in chunk_eval_op.h Compute; -1 marks a
+    # tag role the scheme lacks (never matches, tags are >= 0)
+    cfgs = {"IOB": (2, 0, 1, -1, -1), "IOE": (2, -1, 0, 1, -1),
+            "IOBES": (4, 0, 1, 2, 3), "plain": (1, -1, -1, -1, -1)}
+    if scheme not in cfgs:
+        raise ValueError(f"chunk_eval: unknown chunk_scheme '{scheme}'")
+    ntag, tag_b, tag_i, tag_e, tag_s = cfgs[scheme]
+    other = t_types  # other_chunk_type == num_chunk_types
     excluded = [int(t) for t in attrs.get("excluded_chunk_types", [])]
 
     def analyse(seq):
-        # reference encoding (chunk_eval_op.h, IOB): label =
-        # chunk_type * 2 + tag with tag 0 = B, 1 = I; any label
-        # >= 2 * num_chunk_types is outside (O)
-        typ = seq // 2                         # chunk type (0-based)
-        in_tag = (seq >= 0) & (seq < 2 * t_types) & valid
+        # label = chunk_type * num_tag_types + tag; type ==
+        # num_chunk_types is outside (O). Padded/excluded positions are
+        # mapped to O before the boundary rules run.
+        o_label = other * ntag
+        seq = jnp.where(valid & (seq >= 0) & (seq <= o_label), seq,
+                        o_label)
+        typ = seq // ntag
         for ex in excluded:
-            in_tag = in_tag & (typ != ex)
-        is_b = in_tag & (seq % 2 == 0)
-        is_i = in_tag & (seq % 2 == 1)
-        prev = jnp.concatenate(
-            [jnp.full((b, 1), -1, jnp.int32), seq[:, :-1]], axis=1)
-        prev_typ = prev // 2
-        prev_in = (prev >= 0) & (prev < 2 * t_types)
-        cont = is_i & prev_in & (prev_typ == typ)
-        st = is_b | (is_i & ~cont)
-        # start position of each position's own chunk (running max)
-        spos = lax.cummax(
-            jnp.where(st, jnp.arange(s)[None, :], -1), axis=1)
-        in_chunk = in_tag & (spos >= 0)
+            seq = jnp.where(typ == ex, o_label, seq)
+            typ = jnp.where(typ == ex, other, typ)
+        tag = seq % ntag
+        prev_seq = jnp.concatenate(
+            [jnp.full((b, 1), o_label, jnp.int32), seq[:, :-1]], axis=1)
+        ptag, ptyp = prev_seq % ntag, prev_seq // ntag
+        # vectorised ChunkBegin/ChunkEnd (chunk_eval_op.h:88-113): pure
+        # functions of the consecutive (tag, type) pair
+        end = jnp.select(
+            [ptyp == other, typ == other, typ != ptyp,
+             (ptag == tag_b) | (ptag == tag_i),
+             (ptag == tag_e) | (ptag == tag_s)],
+            [jnp.zeros_like(valid), jnp.ones_like(valid),
+             jnp.ones_like(valid), (tag == tag_b) | (tag == tag_s),
+             jnp.ones_like(valid)],
+            default=jnp.zeros_like(valid))
+        beg = jnp.select(
+            [ptyp == other, typ == other, typ != ptyp,
+             (tag == tag_b) | (tag == tag_s),
+             (tag == tag_i) | (tag == tag_e)],
+            [typ != other, jnp.zeros_like(valid), jnp.ones_like(valid),
+             jnp.ones_like(valid), (ptag == tag_e) | (ptag == tag_s)],
+            default=jnp.zeros_like(valid))
+        idx = jnp.arange(s, dtype=jnp.int32)[None, :]
+        spos = lax.cummax(jnp.where(beg, idx, -1), axis=1)
+        cpos = lax.cummax(jnp.where(end, idx, -1), axis=1)
+        in_chunk = (spos >= 0) & (spos >= cpos)
         key = jnp.where(
             in_chunk,
             ((jnp.arange(b)[:, None] * s + spos) * (t_types + 1)
              + typ + 1),
             0)
-        return st, key
+        return beg, key
 
     pst, pkey = analyse(pred)
     lst, lkey = analyse(label)

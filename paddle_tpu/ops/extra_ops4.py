@@ -1,0 +1,397 @@
+"""Round-4 op tail: the mainstream stragglers from VERDICT r3 #6.
+
+Capability mirror of paddle/fluid/operators/ masked_select_op.cc,
+cross_entropy_op.cc (CrossEntropyOp2), partial_sum_op.cc,
+partial_concat_op.cc, inplace_abn_op.cc, shrink_rnn_memory_op.cc,
+lod_tensor_to_array_op.cc, array_to_lod_tensor_op.cc, py_func_op.cc.
+
+Static-shape conventions follow the established designs: dynamic-sized
+outputs pad to the input extent with a Count scalar (unique/where_index,
+extra_ops3.py); LoD sequence state uses the padded-dense [B, S, ...]
+form with rank-table reordering (control_flow_ops.py); host escapes go
+through jax.pure_callback (ps_ops.py's io_callback pattern).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.registry import register_grad_maker, register_op
+
+
+@register_op("masked_select", non_diff_inputs=("Mask",))
+def masked_select(ins, attrs):
+    """reference: masked_select_op.cc — Y = X[Mask], 1-D. Static form:
+    Y padded to X.size, the first Count slots hold selected elements in
+    row-major order (rows past Count are 0). The gather is differentiable,
+    so the generic vjp reproduces masked_select_grad's scatter."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0].reshape(-1)
+    mask = ins["Mask"][0].reshape(-1) != 0
+    n = x.shape[0]
+    order = jnp.argsort(~mask, stable=True)      # selected positions first
+    cnt = jnp.sum(mask.astype(jnp.int32))
+    y = jnp.where(jnp.arange(n) < cnt, x[order], jnp.zeros_like(x))
+    return {"Y": y, "Count": cnt}
+
+
+@register_op("cross_entropy2", non_diff_inputs=("Label",))
+def cross_entropy2(ins, attrs):
+    """reference: cross_entropy_op.cc CrossEntropyOp2 / cross_entropy2
+    kernel — hard-label CE on probabilities: Y = -log(X[..., label]),
+    MatchX holds the matched probability (the reference backward consumes
+    it; here the generic vjp re-traces), XShape carries X's shape for
+    reshape-style grad plumbing."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    label = ins["Label"][0].astype(jnp.int32)
+    ignore_index = int(attrs.get("ignore_index", -100))
+    if label.ndim == x.ndim:
+        label = label.squeeze(-1)
+    safe = jnp.where(label == ignore_index, 0, label)
+    match = jnp.take_along_axis(x, safe[..., None], axis=-1)
+    eps = 1e-12
+    y = -jnp.log(jnp.maximum(match.astype(jnp.float32), eps))
+    y = jnp.where((label == ignore_index)[..., None], 0.0, y)
+    return {"Y": y.astype(x.dtype), "MatchX": match,
+            "XShape": jnp.zeros((x.ndim,), jnp.int64)}
+
+
+def _partial_slice(x, start, length):
+    import jax.numpy as jnp
+
+    cols = x.shape[1]
+    s = start if start >= 0 else start + cols
+    ln = length if length > 0 else cols - s
+    return jnp.asarray(x)[:, s:s + ln]
+
+
+@register_op("partial_sum")
+def partial_sum(ins, attrs):
+    """reference: partial_sum_op.cc — sum the [start_index,
+    start_index+length) column slice of every 2-D input."""
+    xs = ins["X"]
+    start = int(attrs.get("start_index", 0))
+    length = int(attrs.get("length", -1))
+    out = _partial_slice(xs[0], start, length)
+    for x in xs[1:]:
+        out = out + _partial_slice(x, start, length)
+    return {"Out": out}
+
+
+@register_op("partial_concat")
+def partial_concat(ins, attrs):
+    """reference: partial_concat_op.cc — concat the column slice of every
+    input along axis 1."""
+    import jax.numpy as jnp
+
+    xs = ins["X"]
+    start = int(attrs.get("start_index", 0))
+    length = int(attrs.get("length", -1))
+    return {"Out": jnp.concatenate(
+        [_partial_slice(x, start, length) for x in xs], axis=1)}
+
+
+@register_op("inplace_abn", is_collective=True)
+def inplace_abn(ins, attrs):
+    """reference: inplace_abn_op.cc — batch norm with a fused activation
+    (identity / leaky_relu / elu), memory-optimised in the reference by
+    aliasing Y onto X (XLA's buffer reuse subsumes that); use_sync_bn
+    routes the statistics through the cross-rank path."""
+    import jax.numpy as jnp
+
+    from .nn_ops import _batch_norm_impl
+
+    out = _batch_norm_impl(ins, attrs,
+                           cross_rank=bool(attrs.get("use_sync_bn", False)))
+    act = str(attrs.get("activation", "identity"))
+    alpha = float(attrs.get("alpha", 0.1))
+    y = out["Y"]
+    if act == "leaky_relu":
+        y = jnp.where(y >= 0, y, alpha * y)
+    elif act == "elu":
+        y = jnp.where(y >= 0, y, alpha * (jnp.exp(y) - 1.0))
+    elif act not in ("identity", ""):
+        raise ValueError(f"inplace_abn: unsupported activation '{act}'")
+    out["Y"] = y
+    return out
+
+
+@register_op("shrink_rnn_memory", non_diff_inputs=("RankTable", "I"))
+def shrink_rnn_memory(ins, attrs):
+    """reference: shrink_rnn_memory_op.cc — at decode step I keep only
+    the rows of the (rank-ordered) RNN memory whose sequence is still
+    active (length > I). Static form: rows >= active count are zeroed
+    instead of shrinking the leading dim (the padded-dense DynamicRNN
+    convention); the grad through the mask matches the reference's
+    zero-padded memory grad. RankTable slot carries [Items, Index] from
+    lod_rank_table."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    items = ins["RankTable"][0].reshape(-1).astype(jnp.int32)
+    i = jnp.asarray(ins["I"][0], jnp.int32).reshape(())
+    active = jnp.sum((items > i).astype(jnp.int32))
+    keep = jnp.arange(x.shape[0]) < active
+    mask = keep.reshape((-1,) + (1,) * (x.ndim - 1))
+    return {"Out": jnp.where(mask, x, jnp.zeros_like(x))}
+
+
+@register_op("lod_tensor_to_array", non_diff_inputs=("RankTable",))
+def lod_tensor_to_array(ins, attrs):
+    """reference: lod_tensor_to_array_op.cc — split a LoD tensor into a
+    TensorArray, step t holding the still-active sequences in rank-table
+    order. Padded-dense form: X [B, S, ...] -> Out [S, B, ...] with
+    Out[t, j] = X[Index[j], t] for Items[j] > t else 0 (arrays are
+    [S, ...]-stacked per control_flow_ops.py). RankTable slot carries
+    [Items, Index]."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    items = ins["RankTable"][0].reshape(-1).astype(jnp.int32)
+    index = ins["RankTable"][1].reshape(-1).astype(jnp.int32)
+    b, s = x.shape[0], x.shape[1]
+    reordered = jnp.moveaxis(x[index], 1, 0)          # [S, B, ...]
+    alive = (jnp.arange(s)[:, None] < items[None, :])  # [S, B]
+    mask = alive.reshape((s, b) + (1,) * (x.ndim - 2))
+    return {"Out": jnp.where(mask, reordered, jnp.zeros_like(reordered))}
+
+
+@register_op("array_to_lod_tensor", non_diff_inputs=("RankTable",))
+def array_to_lod_tensor(ins, attrs):
+    """reference: array_to_lod_tensor_op.cc — inverse of
+    lod_tensor_to_array: re-assemble [S, B, ...] rank-ordered steps into
+    the original row order [B, S, ...]."""
+    import jax.numpy as jnp
+
+    a = ins["X"][0]
+    index = ins["RankTable"][1].reshape(-1).astype(jnp.int32)
+    s, b = a.shape[0], a.shape[1]
+    inv = jnp.zeros((b,), jnp.int32).at[index].set(
+        jnp.arange(b, dtype=jnp.int32))
+    return {"Out": jnp.moveaxis(a, 0, 1)[inv]}
+
+
+# --------------------------------------------------------------------------
+# py_func: the user escape hatch for custom Python ops inside a program
+# --------------------------------------------------------------------------
+
+# module-level callable registry (reference: py_func_op.cc keeps a static
+# std::vector<py::object>; python/paddle/fluid/layers/nn.py PyFuncRegistry)
+_PY_FUNC_REGISTRY: list = []
+_PY_FUNC_IDS: dict = {}
+
+
+def register_py_func(fn) -> int:
+    # dedup by identity: program rebuilds re-register the same callables
+    # (the reference keeps a process-lifetime registry too, py_func_op.cc)
+    key = id(fn)
+    if key in _PY_FUNC_IDS and _PY_FUNC_REGISTRY[_PY_FUNC_IDS[key]] is fn:
+        return _PY_FUNC_IDS[key]
+    _PY_FUNC_REGISTRY.append(fn)
+    _PY_FUNC_IDS[key] = len(_PY_FUNC_REGISTRY) - 1
+    return _PY_FUNC_IDS[key]
+
+
+@register_op("py_func", skip_infer_shape=True)
+def py_func(ins, attrs):
+    """reference: py_func_op.cc — run a registered Python callable on the
+    inputs. Lowers to jax.pure_callback (the io_callback pattern of
+    ops/ps_ops.py) with output shapes/dtypes recorded at build time by
+    layers.py_func. Gradients: a custom grad maker emits a py_func op
+    over the registered backward callable."""
+    import jax
+
+    fid = int(attrs["callable_id"])
+    fn = _PY_FUNC_REGISTRY[fid]
+    shapes = attrs["out_shapes"]
+    dtypes = attrs["out_dtypes"]
+    result_shape = [jax.ShapeDtypeStruct(tuple(s), np.dtype(d))
+                    for s, d in zip(shapes, dtypes)]
+
+    pick = attrs.get("grad_input_slots")   # backward op: select live grads
+
+    def host_fn(*arrays):
+        outs = fn(*arrays)
+        if not isinstance(outs, (list, tuple)):
+            outs = (outs,)
+        if pick is not None:
+            outs = [outs[i] for i in pick]
+        return tuple(np.asarray(o).astype(d)
+                     for o, d in zip(outs, dtypes))
+
+    outs = jax.pure_callback(host_fn, tuple(result_shape),
+                             *[x for x in ins.get("X", [])])
+    return {"Out": list(outs)}
+
+
+@register_grad_maker("py_func")
+def _py_func_grad(op, out_grads, in_grads):
+    from ..core.ir import OpDesc
+
+    bid = op.attrs.get("backward_callable_id", -1)
+    if bid is None or int(bid) < 0:
+        return []   # non-differentiable py_func
+    # keep POSITIONAL alignment with the forward outputs: an output off
+    # the loss path has grad None — substitute zeros, don't drop the slot
+    # (backward_func's signature is (*inputs, *out_grads) by position)
+    fwd_outs = list(op.outputs.get("Out", []))
+    ogs_all = list(out_grads.get("Out") or [])
+    ogs_all += [None] * (len(fwd_outs) - len(ogs_all))
+    pre_ops, ogs = [], []
+    for name, g in zip(fwd_outs, ogs_all):
+        if g is None:
+            g = name + "@ZERO_GRAD@pyfunc"
+            pre_ops.append(OpDesc("fill_zeros_like", {"X": [name]},
+                                  {"Out": [g]}, {}))
+        ogs.append(g)
+    igs = in_grads.get("X") or []
+    live = [(i, g) for i, g in enumerate(igs) if g is not None]
+    if not live:
+        return []
+    # backward callable receives (*forward_inputs, *out_grads) and must
+    # return one grad per forward input; only the live (differentiable)
+    # slots are kept, selected inside the lowering via grad_input_slots
+    shapes = op.attrs["in_shapes_for_grad"]
+    dtypes = op.attrs["in_dtypes_for_grad"]
+    return pre_ops + [OpDesc(
+        "py_func",
+        {"X": list(op.inputs.get("X", [])) + ogs},
+        {"Out": [g for _, g in live]},
+        {"callable_id": int(bid),
+         "out_shapes": [shapes[i] for i, _ in live],
+         "out_dtypes": [dtypes[i] for i, _ in live],
+         "grad_input_slots": [i for i, _ in live]})]
+
+
+@register_op("lstmp", non_diff_inputs=("SequenceLength",))
+def lstmp(ins, attrs):
+    """reference: lstmp_op.cc (dynamic_lstmp) — LSTM with a recurrent
+    projection layer: r_t = act_proj(h_t @ ProjWeight) feeds back instead
+    of h_t. Padded-dense form (rnn_ops.py conventions): Input [B,S,4H]
+    already holds x@Wx (the reference takes the pre-projected input too),
+    Weight [P,4H] is the recurrent weight over the projection, ProjWeight
+    [H,P]. Bias [4H], or [7H] with use_peepholes (the extra 3H are the
+    W_ic/W_fc/W_oc peephole diagonals, math/lstm_compute order).
+    Outputs Projection [B,S,P], Cell [B,S,H]."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    x = ins["Input"][0]
+    wh = ins["Weight"][0]                       # [P, 4H]
+    wproj = ins["ProjWeight"][0]                # [H, P]
+    b, s, four_h = x.shape
+    h_size, p_size = wproj.shape
+    use_peep = bool(attrs.get("use_peepholes", False))
+    bias = ins["Bias"][0] if ins.get("Bias") and ins["Bias"][0] is not None \
+        else None
+    w_ic = w_fc = w_oc = None
+    if bias is not None:
+        bias = bias.reshape(-1)
+        if use_peep:
+            bias, w_ic, w_fc, w_oc = (bias[:four_h],
+                                      bias[four_h:four_h + h_size],
+                                      bias[four_h + h_size:four_h + 2 * h_size],
+                                      bias[four_h + 2 * h_size:])
+        x = x + bias
+
+    acts = {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+            "relu": jax.nn.relu, "identity": lambda v: v, "": lambda v: v}
+    act_gate = acts[str(attrs.get("gate_activation", "sigmoid"))]
+    act_cell = acts[str(attrs.get("cell_activation", "tanh"))]
+    act_cand = acts[str(attrs.get("candidate_activation", "tanh"))]
+    act_proj = acts[str(attrs.get("proj_activation", "identity"))]
+    cell_clip = float(attrs.get("cell_clip", 0.0))
+    proj_clip = float(attrs.get("proj_clip", 0.0))
+
+    h0 = ins["H0"][0] if ins.get("H0") and ins["H0"][0] is not None else \
+        jnp.zeros((b, p_size), x.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") and ins["C0"][0] is not None else \
+        jnp.zeros((b, h_size), x.dtype)
+    seq_len = None
+    if ins.get("SequenceLength") and ins["SequenceLength"][0] is not None:
+        seq_len = ins["SequenceLength"][0].reshape(-1)
+    reverse = bool(attrs.get("is_reverse", False))
+
+    xs = jnp.swapaxes(x, 0, 1)
+    if reverse:
+        xs = xs[::-1]
+
+    def step(carry, inp):
+        r, c = carry
+        xp, t = inp
+        gates = xp + r @ wh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        if use_peep:
+            i = i + c * w_ic
+            f = f + c * w_fc
+        i, f = act_gate(i), act_gate(f)
+        c_new = f * c + i * act_cand(g)
+        if cell_clip > 0:
+            c_new = jnp.clip(c_new, -cell_clip, cell_clip)
+        if use_peep:
+            o = o + c_new * w_oc
+        o = act_gate(o)
+        h_new = o * act_cell(c_new)
+        r_new = act_proj(h_new @ wproj)
+        if proj_clip > 0:
+            r_new = jnp.clip(r_new, -proj_clip, proj_clip)
+        if seq_len is not None:
+            tt = (s - 1 - t) if reverse else t
+            alive = (tt < seq_len)[:, None]
+            r_new = jnp.where(alive, r_new, r)
+            c_new = jnp.where(alive, c_new, c)
+        return (r_new, c_new), (r_new, c_new)
+
+    _, (rs, cs) = lax.scan(step, (h0, c0), (xs, jnp.arange(s)))
+    if reverse:
+        rs, cs = rs[::-1], cs[::-1]
+    return {"Projection": jnp.swapaxes(rs, 0, 1),
+            "Cell": jnp.swapaxes(cs, 0, 1)}
+
+
+@register_op("batch_fc")
+def batch_fc(ins, attrs):
+    """reference: batch_fc_op.cc — per-slot fc: Input
+    [slots, ins, in_dim] x W [slots, in_dim, out_dim] + Bias
+    [slots, 1, out_dim]. One bmm on the MXU."""
+    import jax.numpy as jnp
+
+    x, w = ins["Input"][0], ins["W"][0]
+    out = jnp.einsum("sni,sio->sno", x, w)
+    if ins.get("Bias") and ins["Bias"][0] is not None:
+        out = out + ins["Bias"][0]
+    return {"Out": out}
+
+
+@register_op("filter_by_instag", non_diff_inputs=("Ins_tag", "Filter_tag"))
+def filter_by_instag(ins, attrs):
+    """reference: filter_by_instag_op.cc — keep the rows of Ins whose tag
+    set intersects Filter_tag. Padded form (the established pad-to-extent
+    convention): Ins_tag is [N, K] with -1 padding; Out is [N, D] with
+    selected rows first (rest zero), IndexMap [N] the original row per
+    out slot (-1 past Count), LossWeight [N, 1] 1.0 for selected rows.
+    The row gather is differentiable, matching the reference grad's
+    scatter of out-grads to selected rows."""
+    import jax.numpy as jnp
+
+    x = ins["Ins"][0]
+    tags = ins["Ins_tag"][0]
+    filt = ins["Filter_tag"][0].reshape(-1)
+    if tags.ndim == 1:
+        tags = tags[:, None]
+    n = x.shape[0]
+    hit = (tags[:, :, None] == filt[None, None, :]) & (tags >= 0)[:, :, None]
+    sel = jnp.any(hit, axis=(1, 2))                      # [N]
+    order = jnp.argsort(~sel, stable=True)
+    cnt = jnp.sum(sel.astype(jnp.int32))
+    valid = jnp.arange(n) < cnt
+    out = jnp.where(valid.reshape((-1,) + (1,) * (x.ndim - 1)),
+                    x[order], jnp.zeros_like(x))
+    index_map = jnp.where(valid, order, -1).astype(jnp.int32)
+    loss_w = sel.astype(jnp.float32)[order] * valid
+    return {"Out": out, "LossWeight": loss_w[:, None].astype(jnp.float32),
+            "IndexMap": index_map, "Count": cnt}

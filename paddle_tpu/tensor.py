@@ -361,3 +361,17 @@ def less_than(x, y):
 
 def greater_than(x, y):
     return _binary("greater_than", x, y)
+
+
+def masked_select(x, mask, name=None):
+    """reference: python/paddle/tensor/search.py masked_select
+    (masked_select_op.cc). Static-shape form returns (values, count):
+    values padded to x.size, first `count` slots valid."""
+    if in_dygraph_mode():
+        from .dygraph.tracer import trace_op
+
+        outs = trace_op("masked_select", {"X": [x], "Mask": [mask]}, {})
+        return outs["Y"][0], outs["Count"][0]
+    from . import layers
+
+    return layers.masked_select(x, mask, name=name)

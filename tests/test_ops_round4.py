@@ -1,0 +1,378 @@
+"""Round-4 op batch (ops/extra_ops4.py + chunk_eval schemes) tests."""
+
+import numpy as np
+import pytest
+
+from tests.test_ops_batch3 import _fwd
+
+
+def _fresh():
+    from paddle_tpu.core import ir, unique_name
+
+    ir._main_program, ir._startup_program = ir.Program(), ir.Program()
+    unique_name.switch()
+
+
+class TestMaskedSelect:
+    def test_forward(self):
+        x = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+        m = np.array([[1, 0], [1, 1]], np.int32)
+        out = _fwd("masked_select", {"X": [x], "Mask": [m]})
+        assert int(np.asarray(out["Count"])) == 3
+        np.testing.assert_allclose(np.asarray(out["Y"]),
+                                   [1.0, 3.0, 4.0, 0.0])
+
+    def test_grad(self):
+        from tests.op_test import OpTest
+
+        class T(OpTest):
+            op_type = "masked_select"
+
+            def setup(self):
+                rng = np.random.RandomState(0)
+                x = rng.randn(3, 4).astype(np.float32)
+                m = (rng.rand(3, 4) > 0.4).astype(np.int32)
+                sel = x.reshape(-1)[np.argsort(~m.reshape(-1).astype(bool),
+                                               kind="stable")]
+                cnt = int(m.sum())
+                y = np.where(np.arange(12) < cnt, sel, 0).astype(np.float32)
+                self.inputs = {"X": x, "Mask": m}
+                self.outputs = {"Y": y,
+                                "Count": np.asarray(cnt, np.int32)}
+
+        t = T()
+        t.check_output(no_check_set=("Count",))
+        t.check_grad(["X"], "Y")
+
+
+class TestCrossEntropy2:
+    def test_forward_and_grad(self):
+        from tests.op_test import OpTest
+
+        class T(OpTest):
+            op_type = "cross_entropy2"
+
+            def setup(self):
+                rng = np.random.RandomState(1)
+                x = rng.rand(5, 7).astype(np.float32) + 0.1
+                x /= x.sum(-1, keepdims=True)
+                lab = rng.randint(0, 7, (5, 1)).astype(np.int64)
+                match = np.take_along_axis(x, lab.astype(np.int64), 1)
+                self.inputs = {"X": x, "Label": lab}
+                self.outputs = {"Y": -np.log(match),
+                                "MatchX": match,
+                                "XShape": np.zeros((2,), np.int64)}
+
+        t = T()
+        t.check_output(no_check_set=("XShape",))
+        t.check_grad(["X"], "Y")
+
+    def test_ignore_index(self):
+        x = np.full((2, 3), 1 / 3, np.float32)
+        lab = np.array([[0], [-100]], np.int64)
+        out = _fwd("cross_entropy2", {"X": [x], "Label": [lab]},
+                   {"ignore_index": -100})
+        y = np.asarray(out["Y"]).reshape(-1)
+        assert abs(y[0] - np.log(3)) < 1e-5 and y[1] == 0.0
+
+
+class TestPartialOps:
+    def test_partial_sum(self):
+        a = np.arange(8, dtype=np.float32).reshape(2, 4)
+        b = 10 * np.arange(8, dtype=np.float32).reshape(2, 4)
+        out = np.asarray(_fwd("partial_sum", {"X": [a, b]},
+                              {"start_index": 1, "length": 2})["Out"])
+        np.testing.assert_allclose(out, (a + b)[:, 1:3])
+
+    def test_partial_concat(self):
+        a = np.arange(8, dtype=np.float32).reshape(2, 4)
+        b = -a
+        out = np.asarray(_fwd("partial_concat", {"X": [a, b]},
+                              {"start_index": 2, "length": -1})["Out"])
+        np.testing.assert_allclose(out, np.concatenate(
+            [a[:, 2:], b[:, 2:]], axis=1))
+
+    def test_partial_sum_grad(self):
+        from tests.op_test import OpTest
+
+        class T(OpTest):
+            op_type = "partial_sum"
+
+            def setup(self):
+                rng = np.random.RandomState(2)
+                a = rng.randn(3, 5).astype(np.float32)
+                b = rng.randn(3, 5).astype(np.float32)
+                self.inputs = {"X": [("a", a), ("b", b)]}
+                self.attrs = {"start_index": 1, "length": 3}
+                self.outputs = {"Out": (a + b)[:, 1:4]}
+
+        t = T()
+        t.check_output()
+        t.check_grad(["a"], "Out")
+
+
+class TestInplaceABN:
+    def test_matches_bn_plus_act(self):
+        rng = np.random.RandomState(3)
+        x = rng.randn(4, 3, 2, 2).astype(np.float32)
+        scale = rng.rand(3).astype(np.float32) + 0.5
+        bias = rng.randn(3).astype(np.float32)
+        mean = np.zeros(3, np.float32)
+        var = np.ones(3, np.float32)
+        ins = {"X": [x], "Scale": [scale], "Bias": [bias],
+               "Mean": [mean], "Variance": [var]}
+        bn = _fwd("batch_norm", ins, {})
+        abn = _fwd("inplace_abn", ins, {"activation": "leaky_relu",
+                                        "alpha": 0.2})
+        ref = np.asarray(bn["Y"])
+        ref = np.where(ref >= 0, ref, 0.2 * ref)
+        np.testing.assert_allclose(np.asarray(abn["Y"]), ref, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(abn["MeanOut"]),
+                                   np.asarray(bn["MeanOut"]))
+
+
+class TestRankTableBridges:
+    def _table(self, lengths):
+        t = _fwd("lod_rank_table", {"X": [np.asarray(lengths, np.int64)]})
+        return np.asarray(t["Items"]), np.asarray(t["Index"])
+
+    def test_lod_tensor_to_array_roundtrip(self):
+        rng = np.random.RandomState(4)
+        lengths = [2, 4, 1]
+        x = rng.randn(3, 4, 5).astype(np.float32)
+        for b, ln in enumerate(lengths):
+            x[b, ln:] = 0.0  # padded region
+        items, index = self._table(lengths)
+        arr = _fwd("lod_tensor_to_array",
+                   {"X": [x], "RankTable": [items, index]})["Out"]
+        arr = np.asarray(arr)
+        assert arr.shape == (4, 3, 5)
+        # step 0 holds all 3 sequences in rank order (lens 4,2,1)
+        np.testing.assert_allclose(arr[0], x[index][:, 0])
+        # step 2: only the len-4 sequence is alive
+        assert np.all(arr[2, 1:] == 0)
+        np.testing.assert_allclose(arr[2, 0], x[index[0], 2])
+        back = _fwd("array_to_lod_tensor",
+                    {"X": [arr], "RankTable": [items, index]})["Out"]
+        np.testing.assert_allclose(np.asarray(back), x)
+
+    def test_shrink_rnn_memory(self):
+        items, index = self._table([2, 4, 1])
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        out = np.asarray(_fwd(
+            "shrink_rnn_memory",
+            {"X": [x], "RankTable": [items, index],
+             "I": [np.asarray(1, np.int64)]})["Out"])
+        # lengths sorted desc = [4,2,1]; step 1 -> 2 rows still active
+        np.testing.assert_allclose(out[:2], x[:2])
+        assert np.all(out[2] == 0)
+
+
+class TestLstmp:
+    def test_projection_semantics(self):
+        rng = np.random.RandomState(5)
+        b, s, h, p = 2, 3, 4, 3
+        xw = rng.randn(b, s, 4 * h).astype(np.float32) * 0.3
+        wh = rng.randn(p, 4 * h).astype(np.float32) * 0.3
+        wp = rng.randn(h, p).astype(np.float32) * 0.3
+        out = _fwd("lstmp", {"Input": [xw], "Weight": [wh],
+                             "ProjWeight": [wp], "Bias": [None],
+                             "H0": [None], "C0": [None],
+                             "SequenceLength": [None]}, {})
+        proj, cell = np.asarray(out["Projection"]), np.asarray(out["Cell"])
+        assert proj.shape == (b, s, p) and cell.shape == (b, s, h)
+
+        def sig(v):
+            return 1 / (1 + np.exp(-v))
+
+        r = np.zeros((b, p), np.float32)
+        c = np.zeros((b, h), np.float32)
+        for t in range(s):
+            g = xw[:, t] + r @ wh
+            i, f, cand, o = np.split(g, 4, axis=-1)
+            c = sig(f) * c + sig(i) * np.tanh(cand)
+            hh = sig(o) * np.tanh(c)
+            r = hh @ wp
+            np.testing.assert_allclose(proj[:, t], r, atol=1e-5)
+            np.testing.assert_allclose(cell[:, t], c, atol=1e-5)
+
+    def test_seq_length_freeze(self):
+        rng = np.random.RandomState(6)
+        xw = rng.randn(2, 4, 8).astype(np.float32)
+        wh = rng.randn(3, 8).astype(np.float32) * 0.3
+        wp = rng.randn(2, 3).astype(np.float32) * 0.3
+        out = _fwd("lstmp", {"Input": [xw], "Weight": [wh],
+                             "ProjWeight": [wp], "Bias": [None],
+                             "H0": [None], "C0": [None],
+                             "SequenceLength": [np.array([2, 4])]}, {})
+        proj = np.asarray(out["Projection"])
+        # row 0 frozen after step 2
+        np.testing.assert_allclose(proj[0, 1], proj[0, 3])
+
+
+class TestBatchFC:
+    def test_forward(self):
+        rng = np.random.RandomState(7)
+        x = rng.randn(3, 4, 5).astype(np.float32)
+        w = rng.randn(3, 5, 2).astype(np.float32)
+        bias = rng.randn(3, 1, 2).astype(np.float32)
+        out = np.asarray(_fwd("batch_fc", {"Input": [x], "W": [w],
+                                           "Bias": [bias]})["Out"])
+        np.testing.assert_allclose(out, np.einsum("sni,sio->sno", x, w) + bias,
+                                   rtol=1e-5)
+
+
+class TestFilterByInstag:
+    def test_semantics(self):
+        x = np.arange(12, dtype=np.float32).reshape(4, 3)
+        tags = np.array([[1, -1], [2, 3], [4, -1], [3, -1]], np.int64)
+        filt = np.array([3], np.int64)
+        out = _fwd("filter_by_instag",
+                   {"Ins": [x], "Ins_tag": [tags], "Filter_tag": [filt]})
+        assert int(np.asarray(out["Count"])) == 2
+        got = np.asarray(out["Out"])
+        np.testing.assert_allclose(got[0], x[1])
+        np.testing.assert_allclose(got[1], x[3])
+        assert np.all(got[2:] == 0)
+        np.testing.assert_allclose(np.asarray(out["IndexMap"]),
+                                   [1, 3, -1, -1])
+        np.testing.assert_allclose(np.asarray(out["LossWeight"]).reshape(-1),
+                                   [1, 1, 0, 0])
+
+
+# --------------------------------------------------------------------------
+# chunk_eval: all schemes vs a direct port of chunk_eval_op.h GetSegments
+# --------------------------------------------------------------------------
+
+SCHEMES = {"IOB": (2, 0, 1, -1, -1), "IOE": (2, -1, 0, 1, -1),
+           "IOBES": (4, 0, 1, 2, 3), "plain": (1, -1, -1, -1, -1)}
+
+
+def _segments(seq, n_types, scheme):
+    """Literal port of chunk_eval_op.h GetSegments/ChunkBegin/ChunkEnd."""
+    ntag, tb, ti, te, ts = SCHEMES[scheme]
+    other = n_types
+
+    def chunk_end(pt, pty, t, ty):
+        if pty == other:
+            return False
+        if ty == other or ty != pty:
+            return True
+        if pt == tb or pt == ti:
+            return t == tb or t == ts
+        if pt == te or pt == ts:
+            return True
+        return False
+
+    def chunk_begin(pt, pty, t, ty):
+        if pty == other:
+            return ty != other
+        if ty == other:
+            return False
+        if ty != pty:
+            return True
+        if t == tb or t == ts:
+            return True
+        if t == ti or t == te:
+            return pt == te or pt == ts
+        return False
+
+    segs, in_chunk, start = [], False, 0
+    tag, typ = -1, other
+    for i, lab in enumerate(seq):
+        ptag, ptyp = tag, typ
+        tag, typ = lab % ntag, lab // ntag
+        if in_chunk and chunk_end(ptag, ptyp, tag, typ):
+            segs.append((start, i - 1, ptyp))
+            in_chunk = False
+        if chunk_begin(ptag, ptyp, tag, typ):
+            start, in_chunk = i, True
+    if in_chunk:
+        segs.append((start, len(seq) - 1, typ))
+    return segs
+
+
+@pytest.mark.parametrize("scheme", ["IOB", "IOE", "IOBES", "plain"])
+def test_chunk_eval_schemes_vs_reference_port(scheme):
+    ntag = SCHEMES[scheme][0]
+    n_types = 3
+    rng = np.random.RandomState(hash(scheme) % 1000)
+    b, s = 6, 12
+    hi = n_types * ntag + 1   # includes the O label
+    pred = rng.randint(0, hi, (b, s)).astype(np.int64)
+    lab = rng.randint(0, hi, (b, s)).astype(np.int64)
+    out = _fwd("chunk_eval", {"Inference": [pred], "Label": [lab],
+                              "SeqLength": [None]},
+               {"num_chunk_types": n_types, "chunk_scheme": scheme})
+    n_inf = n_lab = n_cor = 0
+    for r in range(b):
+        ps = _segments(pred[r], n_types, scheme)
+        ls = _segments(lab[r], n_types, scheme)
+        n_inf += len(ps)
+        n_lab += len(ls)
+        n_cor += len(set(ps) & set(ls))
+    assert int(np.asarray(out["NumInferChunks"])) == n_inf, scheme
+    assert int(np.asarray(out["NumLabelChunks"])) == n_lab, scheme
+    assert int(np.asarray(out["NumCorrectChunks"])) == n_cor, scheme
+
+
+def test_chunk_eval_excluded_types():
+    pred = np.array([[0, 1, 2, 3, 4, 4]], np.int64)   # IOB, 3 types
+    lab = np.array([[0, 1, 2, 3, 4, 4]], np.int64)
+    base = _fwd("chunk_eval", {"Inference": [pred], "Label": [lab],
+                               "SeqLength": [None]},
+                {"num_chunk_types": 3})
+    excl = _fwd("chunk_eval", {"Inference": [pred], "Label": [lab],
+                               "SeqLength": [None]},
+                {"num_chunk_types": 3, "excluded_chunk_types": [1]})
+    # chunks: [0,1]->t0, [2,3]->t1, [4]->t2, [5]->t2 (B after B splits)
+    assert int(np.asarray(base["NumInferChunks"]).reshape(())) == 4
+    assert int(np.asarray(excl["NumInferChunks"]).reshape(())) == 3
+
+
+# --------------------------------------------------------------------------
+# py_func: end-to-end through a program with a custom backward
+# --------------------------------------------------------------------------
+
+class TestPyFunc:
+    def test_forward_and_backward(self):
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+
+        _fresh()
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.static_data("x", [4, 3])
+            w = layers.create_parameter([3, 3], "float32", name="pfw")
+            h = layers.matmul(x, w)
+            out = main.current_block().create_var(
+                name="pyfunc_out", shape=[4, 3], dtype="float32")
+
+            def fwd(a):
+                return 2.0 * a
+
+            def bwd(a, dy):
+                return 2.0 * dy
+
+            layers.py_func(fwd, h, out, backward_func=bwd)
+            loss = layers.mean(out)
+            opt = pt.optimizer.SGDOptimizer(0.1)
+            pg = opt.backward(loss)
+            opt.apply_gradients(pg)
+        exe = pt.Executor()
+        scope = pt.Scope()
+        exe.run(startup, scope=scope, use_compiled=False)
+        rng = np.random.RandomState(8)
+        feed = {"x": rng.randn(4, 3).astype(np.float32)}
+        w0 = np.array(scope.find_var("pfw"), np.float32).copy()
+        out1 = exe.run(main, feed=feed, fetch_list=[loss], scope=scope,
+                       use_compiled=False)
+        w1 = np.asarray(scope.find_var("pfw"))
+        # forward: loss == mean(2 * x @ w0)
+        np.testing.assert_allclose(
+            float(np.asarray(out1[0])),
+            float(np.mean(2.0 * feed["x"] @ w0)), rtol=1e-5)
+        # backward flowed through the custom bwd: w updated by -lr * dW
+        expect_gw = feed["x"].T @ np.full((4, 3), 2.0 / 12, np.float32)
+        np.testing.assert_allclose(w1, w0 - 0.1 * expect_gw, rtol=1e-4,
+                                   atol=1e-6)
