@@ -29,10 +29,14 @@ from .registry import EMPTY_VAR
 from .scope import Scope, global_scope
 from .types import Place, default_place
 
-# ops whose lowerings do host network IO (ops/ps_ops.py) — they force the
-# interpreting executor path
+# ops whose lowerings do host IO (PS RPC, file save/load, py_func) —
+# they force the interpreting executor path: the axon TPU backend
+# rejects compiled host send/recv callbacks (io_callback/pure_callback
+# under jit), and the reference runs these through side programs anyway
 _PS_IO_TYPES = frozenset(
-    ("send", "recv", "send_barrier", "fetch_barrier", "listen_and_serv"))
+    ("send", "recv", "send_barrier", "fetch_barrier", "listen_and_serv",
+     "save", "load", "save_combine", "load_combine", "checkpoint_notify",
+     "py_func"))
 
 _MISSING = object()
 
@@ -75,8 +79,13 @@ def _resolve_inputs(op: OpDesc, env: Dict[str, Any]) -> Dict[str, List[Any]]:
     return ins
 
 
-def run_op(op: OpDesc, env: Dict[str, Any], step=None):
-    """Execute one op's lowering against env (shared by both executors)."""
+def run_op(op: OpDesc, env: Dict[str, Any], step=None, axis_coords=None):
+    """Execute one op's lowering against env (shared by both executors).
+
+    axis_coords ({axis: rank}) is the SPMD oracle's per-rank mesh
+    coordinate: outside shard_map, random ops can't see axis_index, so
+    _rng_key folds this instead — keeping per-rank dropout masks
+    decorrelated exactly like the compiled path (ADVICE r3)."""
     opdef = registry.get(op.type)
     if opdef.forward is None:
         raise ExecutionError(f"op '{op.type}' has no registered lowering")
@@ -84,6 +93,8 @@ def run_op(op: OpDesc, env: Dict[str, Any], step=None):
     attrs = dict(op.attrs)
     if step is not None:
         attrs["__step__"] = step
+    if axis_coords:
+        attrs["__axis_coords__"] = axis_coords
     try:
         from .. import profiler as _prof
 
@@ -108,9 +119,10 @@ def run_op(op: OpDesc, env: Dict[str, Any], step=None):
     return env
 
 
-def run_block(block: Block, env: Dict[str, Any], step=None) -> Dict[str, Any]:
+def run_block(block: Block, env: Dict[str, Any], step=None,
+              axis_coords=None) -> Dict[str, Any]:
     for op in block.ops:
-        run_op(op, env, step=step)
+        run_op(op, env, step=step, axis_coords=axis_coords)
     return env
 
 
@@ -410,10 +422,12 @@ class Executor:
                 coll_ids.add(id(op))
         from . import registry
 
+        rank_coords = [{ax: int(c[i]) for i, ax in enumerate(axes)}
+                       for c in coords]
         for op in block.ops:
             if id(op) not in coll_ids:
-                for env in envs:
-                    run_op(op, env, step=step)
+                for r, env in enumerate(envs):
+                    run_op(op, env, step=step, axis_coords=rank_coords[r])
                 continue
             # collective: one shard_map dispatch over the stacked ranks
             opdef = registry.get(op.type)
@@ -428,33 +442,62 @@ class Executor:
                         mesh_shape + np.shape(per_rank_ins[0][slot][i]))
                     if ok else None
                     for i, ok in enumerate(present)]
-            attrs = dict(op.attrs)
-            attrs["__step__"] = step
             nax = len(axes)
             out_slots = {slot: len(names)
                          for slot, names in op.outputs.items() if names}
 
-            def inner(st):
-                ins = {slot: [None if v is None else
-                              v.reshape(v.shape[nax:]) for v in vals]
-                       for slot, vals in st.items()}
-                outs = registry.normalize_outputs(
-                    opdef.forward(ins, attrs))
-                res = {}
-                for s, n in out_slots.items():
-                    vs = outs.get(s) or []
-                    if len(vs) != n:
-                        raise ExecutionError(
-                            f"oracle: '{op.type}' produced {len(vs)} "
-                            f"values for slot {s}, program declares {n}")
-                    res[s] = [v.reshape((1,) * nax + v.shape) for v in vs]
-                return res
+            # under jit: EAGER shard_map tracers don't support jax.vjp
+            # (full_lower unimplemented), and __vjp_grad__ of pipeline
+            # ops re-traces through vjp — the compiled path always runs
+            # under jit, so the oracle's per-op dispatch must too. The
+            # jitted dispatcher is CACHED per (op, mesh) with step as a
+            # traced argument, so each op compiles once, not once per
+            # step (the cache pins op/mesh so ids can't be recycled).
+            cache = getattr(self, "_oracle_jit_cache", None)
+            if cache is None:
+                cache = self._oracle_jit_cache = {}
+            ckey = (id(op), id(mesh))
+            hit = cache.get(ckey)
+            if hit is None:
+                # factory binds THIS op's values — a plain closure would
+                # share the loop iteration's cells across every cached
+                # dispatcher and blow up on any later jit re-trace
+                def make_inner(opdef_, base_attrs_, out_slots_, nax_,
+                               op_type_):
+                    def inner(st, step_arr):
+                        attrs = dict(base_attrs_)
+                        attrs["__step__"] = step_arr
+                        ins = {slot: [None if v is None else
+                                      v.reshape(v.shape[nax_:])
+                                      for v in vals]
+                               for slot, vals in st.items()}
+                        outs = registry.normalize_outputs(
+                            opdef_.forward(ins, attrs))
+                        res = {}
+                        for s, n in out_slots_.items():
+                            vs = outs.get(s) or []
+                            if len(vs) != n:
+                                raise ExecutionError(
+                                    f"oracle: '{op_type_}' produced "
+                                    f"{len(vs)} values for slot {s}, "
+                                    f"program declares {n}")
+                            res[s] = [v.reshape((1,) * nax_ + v.shape)
+                                      for v in vs]
+                        return res
 
-            in_specs = jax.tree_util.tree_map(
-                lambda _: P(*axes), stacked)
-            out_specs = {s: [P(*axes)] * n for s, n in out_slots.items()}
-            outs = shard_map(inner, mesh=mesh, in_specs=(in_specs,),
-                             out_specs=out_specs, **sm_kwargs)(stacked)
+                    return inner
+
+                in_specs = jax.tree_util.tree_map(
+                    lambda _: P(*axes), stacked)
+                out_specs = {s: [P(*axes)] * n
+                             for s, n in out_slots.items()}
+                fn = jax.jit(shard_map(
+                    make_inner(opdef, dict(op.attrs), dict(out_slots),
+                               nax, op.type),
+                    mesh=mesh, in_specs=(in_specs, P()),
+                    out_specs=out_specs, **sm_kwargs))
+                cache[ckey] = hit = (fn, op, mesh)
+            outs = hit[0](stacked, jnp.asarray(step, jnp.int32))
             for slot, names in op.outputs.items():
                 vals = outs.get(slot, [])
                 for name, v in zip(names, vals):

@@ -170,7 +170,14 @@ def _vjp_grad_lowering(ins: Dict[str, List[Any]], attrs: Dict[str, Any]):
     import jax.numpy as jnp
 
     fwd_def = get(attrs["fwd_type"])
-    fwd_attrs = attrs["fwd_attrs"]
+    # thread the runtime-injected attrs into the re-traced forward:
+    # without __step__/__axis_coords__ a stochastic forward (dropout)
+    # would re-trace with a DIFFERENT key than the forward op ran with —
+    # the backward mask silently disagreeing with the forward mask
+    fwd_attrs = dict(attrs["fwd_attrs"])
+    for _k in ("__step__", "__axis_coords__"):
+        if _k in attrs:
+            fwd_attrs[_k] = attrs[_k]
     fwd_ins = {s[len(_IN_PREFIX):]: v for s, v in ins.items()
                if s.startswith(_IN_PREFIX)}
 

@@ -97,6 +97,38 @@ class KVTables:
             return None, (kv.size() if kv else 0)
         raise ValueError(f"unknown KV method '{method}'")
 
+    def save_all(self, dirname: str, tag: str):
+        """Snapshot every table + its (dim, seed) spec under dirname
+        (reference: large-scale table save triggered by
+        checkpoint_notify_op)."""
+        import json
+        import os
+
+        os.makedirs(dirname, exist_ok=True)
+        with self._lock:
+            specs = dict(self._specs)
+            tables = dict(self.tables)
+        for name, kv in tables.items():
+            kv.save(os.path.join(dirname, f"kv_{tag}_{name}.npz"))
+        with open(os.path.join(dirname, f"kv_{tag}_specs.json"), "w") as f:
+            json.dump({n: list(s) for n, s in specs.items()}, f)
+
+    def load_all(self, dirname: str, tag: str):
+        import json
+        import os
+
+        spec_path = os.path.join(dirname, f"kv_{tag}_specs.json")
+        if not os.path.exists(spec_path):
+            return
+        with open(spec_path) as f:
+            specs = json.load(f)
+        for name, (dim, seed) in specs.items():
+            kv = self.ensure(name, int(dim), int(seed))
+            for shard in kv.shards:
+                with shard.lock:
+                    shard.table.clear()
+            kv.load(os.path.join(dirname, f"kv_{tag}_{name}.npz"))
+
 
 class KVServer:
     """Standalone KV-only server (a PServer also serves kv_* methods —
@@ -114,6 +146,14 @@ class KVServer:
             return None, 0
         if method.startswith("kv_"):
             return self.kv.handle(method, name, arr, aux)
+        if method == "checkpoint":
+            dirname, _, tag = name.partition("|")
+            self.kv.save_all(dirname, tag or "kvserver")
+            return None, 0
+        if method == "checkpoint_load":
+            dirname, _, tag = name.partition("|")
+            self.kv.load_all(dirname, tag or "kvserver")
+            return None, 0
         raise ValueError(f"KVServer: unknown method '{method}'")
 
     def run(self):

@@ -20,6 +20,7 @@ Async mode (reference AsyncCommunicator, Downpour-style): every received
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Dict, List, Optional
 
@@ -202,7 +203,10 @@ class PServer:
         if method == "heartbeat":
             return None, 0
         if method.startswith("kv_"):
-            return self.kv.handle(method, name, arr, aux)
+            # under the apply lock: checkpoint snapshots take the same
+            # lock, so dense params and KV rows form one consistent cut
+            with self._apply_lock:
+                return self.kv.handle(method, name, arr, aux)
         if method == "send_grad":
             st = self.states[name]
             with st.cond:
@@ -263,7 +267,61 @@ class PServer:
             return np.asarray(val), ver
         if method == "barrier":
             return None, 0
+        if method == "checkpoint":
+            # name carries "dirname|tag" — tag is the notifier-assigned
+            # server index, stable across restarts (endpoints are not:
+            # port-0 servers rebind)
+            dirname, _, tag = name.partition("|")
+            self.save_checkpoint(dirname, tag or None)
+            return None, 0
+        if method == "checkpoint_load":
+            dirname, _, tag = name.partition("|")
+            self.load_checkpoint(dirname, tag or None)
+            return None, 0
         raise ValueError(f"unknown PS method '{method}'")
+
+    # -- checkpoint/restore (reference: checkpoint_notify_op.cc flow) -------
+    def _ckpt_tag(self) -> str:
+        return self.endpoint.replace(":", "_").replace(".", "-")
+
+    def save_checkpoint(self, dirname: str, tag: str = None):
+        """Snapshot params + optimizer accumulators (the whole scope),
+        the step counters, and every KV table. Taken under the apply
+        lock so the snapshot is a consistent cut."""
+        import json
+
+        os.makedirs(dirname, exist_ok=True)
+        tag = tag or self._ckpt_tag()
+        with self._apply_lock:
+            arrays = {n: np.asarray(v) for n, v in self.scope.items()}
+            meta = {"global_step": self._global_step,
+                    "apply_count": dict(self._apply_count)}
+            # still inside the lock: kv_* RPCs also serialise on it, so
+            # the table snapshot pairs with the dense cut above
+            self.kv.save_all(dirname, tag)
+        np.savez(os.path.join(dirname, f"pserver_{tag}.npz"),
+                 **{k.replace("/", "%SLASH%"): a
+                    for k, a in arrays.items()})
+        with open(os.path.join(dirname, f"pserver_{tag}_meta.json"),
+                  "w") as f:
+            json.dump(meta, f)
+
+    def load_checkpoint(self, dirname: str, tag: str = None):
+        import json
+
+        tag = tag or self._ckpt_tag()
+        path = os.path.join(dirname, f"pserver_{tag}.npz")
+        with self._apply_lock:
+            with np.load(path) as z:
+                for k in z.files:
+                    self.scope.set(k.replace("%SLASH%", "/"), z[k])
+            with open(os.path.join(dirname,
+                                   f"pserver_{tag}_meta.json")) as f:
+                meta = json.load(f)
+            self._global_step = int(meta["global_step"])
+            self._apply_count = {k: int(v)
+                                 for k, v in meta["apply_count"].items()}
+        self.kv.load_all(dirname, tag)
 
     def _grad_of(self, param_name):
         for g, p in self.grad_to_param.items():

@@ -73,6 +73,32 @@ class _Missing:
 _JST_MISSING = _Missing()
 
 
+def _jst_not(x):
+    """Tensor-aware `not` for generated break/continue guards."""
+    if isinstance(x, VarBase):
+        from .tracer import trace_op
+
+        return trace_op("logical_not", {"X": [x]}, {})["Out"][0]
+    return not x
+
+
+def _jst_bool2(op):
+    def f(a, b):
+        if isinstance(a, VarBase) or isinstance(b, VarBase):
+            from .tracer import trace_op
+
+            av = a if isinstance(a, VarBase) else VarBase(np.asarray(a))
+            bv = b if isinstance(b, VarBase) else VarBase(np.asarray(b))
+            return trace_op(op, {"X": [av], "Y": [bv]}, {})["Out"][0]
+        return (a or b) if op == "logical_or" else (a and b)
+
+    return f
+
+
+_jst_or = _jst_bool2("logical_or")
+_jst_and = _jst_bool2("logical_and")
+
+
 def _jst_peek(fn):
     try:
         return fn()
@@ -181,7 +207,138 @@ class _IfTransformer(ast.NodeTransformer):
     # `for i in range(...)` (1- or 2-arg) desugars to that while form
     # first; other iterables keep Python semantics.
 
+    # -- break/continue (reference: dygraph_to_static/
+    # break_continue_transformer.py:86) -------------------------------------
+    #
+    # `break`/`continue` directly owned by a loop become flag variables:
+    # break  -> _bc_brk_i = True   (loop test gains `and not brk`)
+    # continue -> _bc_cnt_i = True (reset False each iteration)
+    # (names must NOT carry the _jst_ prefix: _jst_* is machinery the
+    # state collectors deliberately exclude)
+    # and every statement after a flag-setting `if` is guarded by
+    # `if _jst_not(_jst_or(brk, cnt)): ...` — which the if-transformer
+    # then lowers to select form when the flags are tensors. A for-loop's
+    # desugared counter bump is guarded by `not brk` ONLY (Python's
+    # `continue` still increments the index).
+
+    @staticmethod
+    def _has_direct_bc(stmts) -> bool:
+        found = [False]
+
+        class F(ast.NodeVisitor):
+            def visit_Break(self, n):
+                found[0] = True
+
+            def visit_Continue(self, n):
+                found[0] = True
+
+            def visit_While(self, n):     # nested loops own theirs
+                pass
+
+            def visit_For(self, n):
+                pass
+
+            def visit_FunctionDef(self, n):
+                pass
+
+            def visit_Lambda(self, n):
+                pass
+
+        f = F()
+        for s in stmts:
+            f.visit(s)
+        return found[0]
+
+    def _rewrite_bc(self, body, bf, cf):
+        def assign_flag(name):
+            a = ast.Assign(targets=[ast.Name(id=name, ctx=ast.Store())],
+                           value=ast.Constant(value=True))
+            return a
+
+        def guard_test():
+            return ast.Call(
+                func=ast.Name(id="_jst_not", ctx=ast.Load()),
+                args=[ast.Call(
+                    func=ast.Name(id="_jst_or", ctx=ast.Load()),
+                    args=[ast.Name(id=bf, ctx=ast.Load()),
+                          ast.Name(id=cf, ctx=ast.Load())],
+                    keywords=[])],
+                keywords=[])
+
+        out = []
+        for idx, s in enumerate(body):
+            if isinstance(s, ast.Break):
+                out.append(assign_flag(bf))
+                return out                       # rest is unreachable
+            if isinstance(s, ast.Continue):
+                out.append(assign_flag(cf))
+                return out
+            if isinstance(s, ast.If) and self._has_direct_bc([s]):
+                new_if = ast.If(
+                    test=s.test,
+                    body=self._rewrite_bc(s.body, bf, cf) or [ast.Pass()],
+                    orelse=(self._rewrite_bc(s.orelse, bf, cf)
+                            if s.orelse else []))
+                out.append(new_if)
+                rest = self._rewrite_bc(list(body[idx + 1:]), bf, cf)
+                if rest:
+                    out.append(ast.If(test=guard_test(), body=rest,
+                                      orelse=[]))
+                return out
+            out.append(s)
+        return out
+
+    def _maybe_rewrite_loop_bc(self, body, test, bump=None):
+        """Returns (pre_stmts, new_test, new_body); pre empty when the
+        body has no directly-owned break/continue."""
+        if not self._has_direct_bc(body):
+            return [], test, list(body) + ([bump] if bump is not None
+                                           else [])
+        i = self.counter
+        self.counter += 1
+        bf, cf = f"_bc_brk_{i}", f"_bc_cnt_{i}"
+        pre = [ast.Assign(targets=[ast.Name(id=n_, ctx=ast.Store())],
+                          value=ast.Constant(value=False))
+               for n_ in (bf, cf)]
+        new_body = [ast.Assign(
+            targets=[ast.Name(id=cf, ctx=ast.Store())],
+            value=ast.Constant(value=False))]
+        new_body += self._rewrite_bc(body, bf, cf)
+        if self._has_direct_bc(new_body):
+            # break/continue inside constructs the rewriter doesn't
+            # reach (with/try) — give up, keep Python semantics (the
+            # raw-loop fallback); rewriting again would recurse forever
+            return [], test, list(body) + ([bump] if bump is not None
+                                           else [])
+        if bump is not None:
+            new_body.append(ast.If(
+                test=ast.Call(func=ast.Name(id="_jst_not", ctx=ast.Load()),
+                              args=[ast.Name(id=bf, ctx=ast.Load())],
+                              keywords=[]),
+                body=[bump], orelse=[]))
+        new_test = ast.Call(
+            func=ast.Name(id="_jst_and", ctx=ast.Load()),
+            args=[ast.Call(func=ast.Name(id="_jst_not", ctx=ast.Load()),
+                           args=[ast.Name(id=bf, ctx=ast.Load())],
+                           keywords=[]),
+                  test],
+            keywords=[])
+        return pre, new_test, new_body
+
     def visit_While(self, node: ast.While):
+        if not node.orelse:
+            pre, new_test, new_body = self._maybe_rewrite_loop_bc(
+                node.body, node.test)
+            if pre:
+                new_node = ast.While(test=new_test, body=new_body,
+                                     orelse=[])
+                for n in pre + [new_node]:
+                    ast.copy_location(n, node)
+                    ast.fix_missing_locations(n)
+                result = self.visit_While(new_node)
+                if isinstance(result, list):
+                    return pre + result
+                return pre + [result]
         self.generic_visit(node)
         if node.orelse:
             return node
@@ -231,6 +388,12 @@ class _IfTransformer(ast.NodeTransformer):
         # strip mk's trailing tuple-return from the cond fn
         c_def.body = c_def.body[:-1]
         b_def = mk(b_name, node.body)
+        # break/continue flags must be loop-carried TENSORS on the
+        # traced path even when the example input never flips them (the
+        # probe's changed-set would otherwise leave them frozen python
+        # False in the predicate — runtime break silently ignored)
+        flag_pos = [k for k, n in enumerate(assigned)
+                    if n.startswith("_bc_")]
         call = ast.Assign(
             targets=[ast.Tuple(
                 elts=[ast.Name(id=n, ctx=ast.Store()) for n in assigned],
@@ -240,7 +403,11 @@ class _IfTransformer(ast.NodeTransformer):
                 args=[ast.Name(id=c_name, ctx=ast.Load()),
                       ast.Name(id=b_name, ctx=ast.Load()),
                       snap],
-                keywords=[]))
+                keywords=[ast.keyword(
+                    arg="flag_positions",
+                    value=ast.Tuple(
+                        elts=[ast.Constant(value=k) for k in flag_pos],
+                        ctx=ast.Load()))] if flag_pos else []))
         out = [c_def, b_def, call]
         for n in out:
             ast.copy_location(n, node)
@@ -260,7 +427,10 @@ class _IfTransformer(ast.NodeTransformer):
         finder = _ControlFinder()
         for s in node.body:
             finder.visit(s)
-        if finder.blocked:
+        has_bc = self._has_direct_bc(node.body)
+        if finder.blocked and not has_bc:
+            # Return/Global (or bc inside with/try constructs the
+            # rewriter does not reach) — keep Python semantics
             self.generic_visit(node)
             return node
         i_name = node.target.id
@@ -274,12 +444,25 @@ class _IfTransformer(ast.NodeTransformer):
                            value=it.args[-1])]
         bump = ast.AugAssign(target=ast.Name(id=i_name, ctx=ast.Store()),
                              op=ast.Add(), value=ast.Constant(value=1))
-        while_node = ast.While(
-            test=ast.Compare(left=ast.Name(id=i_name, ctx=ast.Load()),
-                             ops=[ast.Lt()],
-                             comparators=[ast.Name(id=stop_name,
-                                                   ctx=ast.Load())]),
-            body=list(node.body) + [bump], orelse=[])
+        test = ast.Compare(left=ast.Name(id=i_name, ctx=ast.Load()),
+                           ops=[ast.Lt()],
+                           comparators=[ast.Name(id=stop_name,
+                                                 ctx=ast.Load())])
+        if has_bc:
+            # rewrite here so the counter bump is guarded by `not brk`
+            # ONLY (`continue` still increments, matching Python)
+            pre_bc, test, body = self._maybe_rewrite_loop_bc(
+                list(node.body), test, bump=bump)
+            after_bc = _ControlFinder()
+            for s in body:
+                after_bc.visit(s)
+            if after_bc.blocked:       # Return alongside break etc.
+                self.generic_visit(node)
+                return node
+            init += pre_bc
+        else:
+            body = list(node.body) + [bump]
+        while_node = ast.While(test=test, body=body, orelse=[])
         for n in init + [while_node]:
             ast.copy_location(n, node)
             ast.fix_missing_locations(n)
@@ -385,11 +568,23 @@ def _jst_if(pred, t_fn, f_fn, snap):
                     "where", {"Condition": pred, "X": tv, "Y": fv},
                     {})["Out"][0])
             elif t != f:
-                raise TypeError(
-                    f"to_static: a tensor-dependent `if` assigns a "
-                    f"non-tensor value that differs between branches "
-                    f"({t!r} vs {f!r}) — only tensors can be selected "
-                    f"at runtime")
+                num = (bool, int, float, np.integer, np.floating)
+                if isinstance(t, num) and isinstance(f, num):
+                    # promote differing plain scalars (break/continue
+                    # flags, counters) to a runtime select — the loop
+                    # transformer's numeric-state promotion, applied to
+                    # branch state
+                    blended.append(trace_op(
+                        "where", {"Condition": pred,
+                                  "X": VarBase(np.asarray(t)),
+                                  "Y": VarBase(np.asarray(f))},
+                        {})["Out"][0])
+                else:
+                    raise TypeError(
+                        f"to_static: a tensor-dependent `if` assigns a "
+                        f"non-tensor value that differs between branches "
+                        f"({t!r} vs {f!r}) — only tensors can be "
+                        f"selected at runtime")
             else:
                 blended.append(t)
         return tuple(blended)
@@ -423,7 +618,7 @@ def _subtrace(fn, state_vbs):
     return cap, result
 
 
-def _jst_while(cond_fn, body_fn, snap):
+def _jst_while(cond_fn, body_fn, snap, flag_positions=()):
     """Runtime dispatch for transformed while/for loops (see the
     transformer comment)."""
     global _suppress_capture
@@ -438,6 +633,19 @@ def _jst_while(cond_fn, body_fn, snap):
             _suppress_capture -= 1
     else:
         pred0 = cond_fn(state)
+    if capturing and not isinstance(pred0, VarBase):
+        # break/continue flags start as Python False, so the rewritten
+        # predicate `not brk and <test>` can look Python-valued on
+        # iteration 0 and only turn into a tensor once a tensor-if sets
+        # a flag — probe ONE iteration to find out
+        _suppress_capture += 1
+        try:
+            if _jst_truth(pred0):
+                pred1 = cond_fn(tuple(body_fn(state)))
+                if isinstance(pred1, VarBase):
+                    pred0 = pred1          # take the tensor loop path
+        finally:
+            _suppress_capture -= 1
     if not capturing or not isinstance(pred0, VarBase):
         # plain-Python predicate (or eager mode): exact Python semantics;
         # under capture the iterations freeze into the trace
@@ -494,8 +702,15 @@ def _jst_while(cond_fn, body_fn, snap):
             f"explicitly", stacklevel=2)
 
     state = list(state)
+    # break/continue flags: ALWAYS tensors on this path — the probe only
+    # flips them when the example input happens to hit the branch, but
+    # the runtime predicate must carry them regardless
+    for j in flag_positions:
+        changed.add(j)
     for j in changed:
         v = state[j]
+        if isinstance(v, VarBase):
+            continue
         if isinstance(v, (bool, int, float, np.integer, np.floating)):
             state[j] = VarBase(np.asarray(v))
         else:
@@ -542,6 +757,10 @@ def _jst_while(cond_fn, body_fn, snap):
     carry_names = list(cap_b.feed_names)
     body_out_names = []
     for i, vb in enumerate(outs):
+        if not isinstance(vb, VarBase):
+            # a carried position the body leaves as a plain scalar (a
+            # never-flipped break/continue flag): a constant output var
+            vb = VarBase(np.asarray(vb))
         name = cap_b.names.get(id(vb))
         if name is None:                  # constant/external result
             name = cap_b.name_of(vb)
@@ -601,6 +820,9 @@ def _transform_fn(fn):
         glb["_jst_if"] = _jst_if
         glb["_jst_while"] = _jst_while
         glb["_jst_peek"] = _jst_peek
+        glb["_jst_not"] = _jst_not
+        glb["_jst_or"] = _jst_or
+        glb["_jst_and"] = _jst_and
         glb["__builtins__"] = fn.__globals__.get("__builtins__", __builtins__)
         loc: Dict[str, Any] = {}
         exec(code, glb, loc)

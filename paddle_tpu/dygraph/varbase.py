@@ -69,6 +69,11 @@ class VarBase:
     def numpy(self) -> np.ndarray:
         return np.asarray(self._array)
 
+    # numpy must defer mixed ops to OUR dunders (np.float32(0) < vb has
+    # to produce a traced VarBase, not silently convert through
+    # __array__ and freeze the trace)
+    __array_priority__ = 100
+
     def __array__(self, dtype=None, copy=None) -> np.ndarray:
         # numpy interop: without this, np.asarray falls back to
         # element-wise __getitem__ (each one a traced gather — unusably

@@ -122,6 +122,29 @@ def save_vars(executor=None, dirname: str = "", main_program: Optional[Program] 
     scope = global_scope() if scope is None else scope
     targets = _select_vars(program, vars, predicate)
     os.makedirs(dirname, exist_ok=True)
+    if executor is not None:
+        # reference behavior (io.py save_vars:224): build a program of
+        # save/save_combine OPS and run it through the executor — the
+        # checkpoint happens inside the program runtime (io_callback
+        # lowering, ops/io_ops.py), not as a host-side special case
+        save_prog = Program()
+        block = save_prog.global_block()
+        if filename is not None:
+            path = os.path.join(dirname, filename)
+            block.append_op(
+                "save_combine", {"X": [v.name for v in targets]},
+                {"Token": ["@io_token@"]},
+                {"file_path": path,
+                 "var_names": [v.name for v in targets]})
+        else:
+            for i, v in enumerate(targets):
+                block.append_op(
+                    "save", {"X": [v.name]}, {"Token": [f"@io_token@{i}"]},
+                    {"file_path": os.path.join(
+                        dirname, _encode_name(v.name) + ".npy")})
+        executor.run(save_prog, feed={}, fetch_list=[], scope=scope,
+                     use_compiled=False)
+        return sorted(v.name for v in targets)
     arrays: Dict[str, np.ndarray] = {}
     for v in targets:
         val = scope.find_var(v.name)
@@ -145,6 +168,42 @@ def load_vars(executor=None, dirname: str = "", main_program: Optional[Program] 
     program = main_program or default_main_program()
     scope = global_scope() if scope is None else scope
     targets = _select_vars(program, vars, predicate)
+    if executor is not None:
+        # reference load_vars: a program of load/load_combine ops; the
+        # block declares the outputs persistable so the executor writes
+        # them back into the scope
+        def _static_shape(v):
+            shp = tuple(int(d) for d in (v.shape or ()))
+            if any(d < 0 for d in shp):
+                raise RuntimeError(
+                    f"load_vars (op path): '{v.name}' has dynamic shape "
+                    f"{shp} — persistables must be static")
+            return shp
+
+        load_prog = Program()
+        block = load_prog.global_block()
+        for v in targets:
+            block.create_var(name=v.name, shape=list(v.shape or ()),
+                             dtype=str(v.dtype), persistable=True)
+        if filename is not None:
+            path = os.path.join(dirname, filename)
+            block.append_op(
+                "load_combine", {}, {"Out": [v.name for v in targets]},
+                {"file_path": path,
+                 "var_names": [v.name for v in targets],
+                 "shapes": [list(_static_shape(v)) for v in targets],
+                 "dtypes": [str(v.dtype) for v in targets]})
+        else:
+            for v in targets:
+                block.append_op(
+                    "load", {}, {"Out": [v.name]},
+                    {"file_path": os.path.join(
+                        dirname, _encode_name(v.name) + ".npy"),
+                     "shape": list(_static_shape(v)),
+                     "dtype": str(v.dtype)})
+        executor.run(load_prog, feed={}, fetch_list=[], scope=scope,
+                     use_compiled=False)
+        return sorted(v.name for v in targets)
     if filename is not None:
         path = os.path.join(dirname, filename)
         if not path.endswith(".npz"):

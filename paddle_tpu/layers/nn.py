@@ -1217,3 +1217,34 @@ def py_func(func, x, out, backward_func=None, name=None):
     }
     helper.append_op("py_func", {"X": list(xs)}, {"Out": list(outs)}, attrs)
     return out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """reference: fluid.layers.spectral_norm (spectral_norm_op.cc).
+    Creates the persistent U/V power-iteration vectors and threads the
+    op's UOut/VOut back through them (the reference mutates U/V in
+    place), so one iteration per step converges over training."""
+    from ..initializer import Normal
+
+    helper = LayerHelper("spectral_norm", name=name)
+    h = int(weight.shape[dim])
+    w = int(np.prod([int(d) for i, d in enumerate(weight.shape)
+                     if i != dim]))
+    u = helper.create_parameter(
+        ParamAttr(name=unique_name.generate((name or "spectral_norm")
+                                            + ".u"),
+                  initializer=Normal(0.0, 1.0), trainable=False),
+        [h], "float32")
+    v = helper.create_parameter(
+        ParamAttr(name=unique_name.generate((name or "spectral_norm")
+                                            + ".v"),
+                  initializer=Normal(0.0, 1.0), trainable=False),
+        [w], "float32")
+    u.stop_gradient, v.stop_gradient = True, True
+    out = helper.create_variable_for_type_inference(weight.dtype)
+    helper.append_op("spectral_norm",
+                     {"Weight": [weight], "U": [u], "V": [v]},
+                     {"Out": [out], "UOut": [u], "VOut": [v]},
+                     {"dim": int(dim), "power_iters": int(power_iters),
+                      "eps": float(eps)})
+    return out

@@ -56,6 +56,7 @@ from . import crf_ops  # noqa: F401
 from . import extra_ops2  # noqa: F401
 from . import extra_ops3  # noqa: F401
 from . import extra_ops4  # noqa: F401
+from . import io_ops  # noqa: F401
 from . import fused_ops  # noqa: F401
 from . import interp_ops  # noqa: F401
 from . import linalg_ops  # noqa: F401

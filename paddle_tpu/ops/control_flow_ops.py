@@ -20,10 +20,10 @@ from typing import Any, Dict
 from ..core.registry import register_op
 
 
-def _run_sub_block(blk, env: Dict[str, Any], step=None):
+def _run_sub_block(blk, env: Dict[str, Any], step=None, axis_coords=None):
     from ..core.executor import run_block
 
-    run_block(blk, env, step=step)
+    run_block(blk, env, step=step, axis_coords=axis_coords)
     return env
 
 
@@ -43,7 +43,7 @@ def block_call(ins, attrs):
 
     def body(*vals):
         env = dict(zip(in_names, vals))
-        _run_sub_block(blk, env, step=step)
+        _run_sub_block(blk, env, step=step, axis_coords=attrs.get('__axis_coords__'))
         return tuple(env[n] for n in out_names)
 
     if attrs.get("remat", False):
@@ -70,7 +70,7 @@ def conditional_block(ins, attrs):
 
     def true_fn(vals):
         env = dict(zip(in_names, vals))
-        _run_sub_block(blk, env, step=step)
+        _run_sub_block(blk, env, step=step, axis_coords=attrs.get('__axis_coords__'))
         return tuple(env[n] for n in out_names)
 
     def false_fn(vals):
@@ -101,7 +101,7 @@ def while_op(ins, attrs):
 
     def body_fn(vals):
         env = dict(zip(carry_names, vals))
-        _run_sub_block(blk, env, step=step)
+        _run_sub_block(blk, env, step=step, axis_coords=attrs.get('__axis_coords__'))
         return tuple(env[n] for n in carry_names)
 
     outs = jax.lax.while_loop(cond_fn, body_fn, tuple(ins["X"]))
@@ -143,7 +143,7 @@ def cond_two_branch(ins, attrs):
             if cond_name:
                 env[cond_name] = ins["Cond"][0]  # branches may read the pred
             if blk is not None:
-                _run_sub_block(blk, env, step=step)
+                _run_sub_block(blk, env, step=step, axis_coords=attrs.get('__axis_coords__'))
             return tuple(env[n] for n in out_names)
 
         return fn
@@ -183,14 +183,14 @@ def while_loop_op(ins, attrs):
     def cond_fn(carry):
         env = dict(ext_env)
         env.update(zip(carry_names, carry))
-        _run_sub_block(cond_blk, env, step=step)
+        _run_sub_block(cond_blk, env, step=step, axis_coords=attrs.get('__axis_coords__'))
         c = env[cond_out]
         return c.reshape(()) if getattr(c, "ndim", 0) else c
 
     def body_fn(carry):
         env = dict(ext_env)
         env.update(zip(carry_names, carry))
-        _run_sub_block(body_blk, env, step=step)
+        _run_sub_block(body_blk, env, step=step, axis_coords=attrs.get('__axis_coords__'))
         return tuple(env[n] for n in body_out_names)
 
     max_iters = int(attrs.get("grad_max_iters", 0) or 0)
@@ -204,6 +204,40 @@ def while_loop_op(ins, attrs):
 
         outs, _ = jax.lax.scan(scan_body, tuple(ins["X"]), None,
                                length=max_iters)
+        # runtime truncation guard (ADVICE r3): if the condition still
+        # holds after max_iters steps the result is silently wrong for
+        # THIS input (the trace-time warning only saw the example input).
+        # Interpreting path (concrete values): raise. Compiled path
+        # (tracers): loud host-side warning via debug callback — raising
+        # inside an XLA callback does not propagate reliably.
+        nc = cond_fn(outs)
+        trunc_msg = (
+            f"while_loop: bounded scan truncated at {max_iters} "
+            f"iterations — the runtime trip count exceeds grad_max_iters "
+            f"(set from the traced example input); results are WRONG for "
+            f"this input. Pass to_static(fn, loop_max_iters=N) / "
+            f"while_loop(grad_max_iters=N) with a larger bound.")
+        concrete = True
+        try:
+            truncated = bool(nc)
+        except Exception:
+            concrete = False
+        if concrete:
+            if truncated:
+                raise RuntimeError(trunc_msg)
+        elif jax.default_backend() == "cpu":
+            # compiled-path guard via debug callback — CPU only: the
+            # axon TPU backend rejects host send/recv callbacks under
+            # jit (UNIMPLEMENTED), so on TPU the compiled path keeps the
+            # trace-time warning only (the interpreting oracle still
+            # raises for any input)
+            def _host_guard(t):
+                if t:
+                    import warnings
+
+                    warnings.warn(trunc_msg, stacklevel=2)
+
+            jax.debug.callback(_host_guard, nc)
         return {"Out": list(outs)}
 
     outs = jax.lax.while_loop(cond_fn, body_fn, tuple(ins["X"]))
@@ -252,7 +286,7 @@ def static_loop_op(ins, attrs):
         env = dict(ext_env)
         env.update(zip(carry_names, carry))
         env[i_name] = i
-        _run_sub_block(blk, env, step=step)
+        _run_sub_block(blk, env, step=step, axis_coords=attrs.get('__axis_coords__'))
         return tuple(env[nm] for nm in body_out_names), None
 
     (outs), _ = jax.lax.scan(body, tuple(ins["X"]), jnp.arange(n))

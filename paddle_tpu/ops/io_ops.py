@@ -1,0 +1,156 @@
+"""save/load as PROGRAM OPS + pserver checkpoint notify (VERDICT r4 #4).
+
+Capability mirror of paddle/fluid/operators/ save_op.cc, load_op.cc,
+save_combine_op.cc, load_combine_op.cc and
+operators/distributed_ops/checkpoint_notify_op.cc: the reference emits
+these into programs so checkpointing runs THROUGH the executor (and, for
+PS jobs, tells every pserver to snapshot its state via RPC). Host file
+IO lowers to jax.experimental.io_callback (ordered — the save must
+happen-before a later load in program order); loads use the build-time
+shape/dtype the emitting layer records (static shapes under XLA).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..core.registry import register_op
+
+def _encode(name: str) -> str:
+    """Same filesystem-safe encoding as io.py's _encode_name, so files
+    written by the op path and the host path interoperate."""
+    import urllib.parse
+
+    return urllib.parse.quote(name, safe="")
+
+
+def _io_callback(fn, result, *args):
+    import jax
+    from jax.experimental import io_callback
+
+    return io_callback(fn, result, *args, ordered=True)
+
+
+@register_op("save", skip_infer_shape=True)
+def save_op(ins, attrs):
+    """reference: save_op.cc — write one variable to file_path."""
+    path = str(attrs["file_path"])
+    overwrite = bool(attrs.get("overwrite", True))
+
+    def host_save(arr):
+        if not overwrite and os.path.exists(path):
+            raise RuntimeError(f"save: '{path}' exists and overwrite=False")
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        np.save(path, np.asarray(arr))
+        return np.zeros((), np.int32)
+
+    import jax
+
+    token = _io_callback(host_save, jax.ShapeDtypeStruct((), np.int32),
+                         ins["X"][0])
+    return {"Token": token}
+
+
+@register_op("load", skip_infer_shape=True)
+def load_op(ins, attrs):
+    """reference: load_op.cc — read one variable from file_path. The
+    emitting layer records shape/dtype (attrs) for the static result."""
+    import jax
+
+    path = str(attrs["file_path"])
+    shape = tuple(int(d) for d in attrs["shape"])
+    dtype = np.dtype(str(attrs["dtype"]))
+
+    def host_load():
+        p = path if os.path.exists(path) else path + ".npy"
+        a = np.load(p)
+        if tuple(a.shape) != shape:
+            raise RuntimeError(
+                f"load: shape mismatch for '{path}': checkpoint "
+                f"{a.shape} vs program {shape}")
+        return np.asarray(a, dtype=dtype)
+
+    out = _io_callback(host_load, jax.ShapeDtypeStruct(shape, dtype))
+    return {"Out": out}
+
+
+@register_op("save_combine", skip_infer_shape=True)
+def save_combine_op(ins, attrs):
+    """reference: save_combine_op.cc — all X vars into ONE file (npz),
+    keyed by attrs var_names."""
+    import jax
+
+    path = str(attrs["file_path"])
+    names = [str(n) for n in attrs["var_names"]]
+    overwrite = bool(attrs.get("overwrite", True))
+
+    def host_save(*arrays):
+        if not overwrite and os.path.exists(path):
+            raise RuntimeError(f"save_combine: '{path}' exists")
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        np.savez(path, **{_encode(n): np.asarray(a)
+                          for n, a in zip(names, arrays)})
+        return np.zeros((), np.int32)
+
+    token = _io_callback(host_save, jax.ShapeDtypeStruct((), np.int32),
+                         *list(ins["X"]))
+    return {"Token": token}
+
+
+@register_op("load_combine", skip_infer_shape=True)
+def load_combine_op(ins, attrs):
+    """reference: load_combine_op.cc — one file into N output vars."""
+    import jax
+
+    path = str(attrs["file_path"])
+    names = [str(n) for n in attrs["var_names"]]
+    shapes = [tuple(int(d) for d in s) for s in attrs["shapes"]]
+    dtypes = [np.dtype(str(d)) for d in attrs["dtypes"]]
+
+    def host_load():
+        p = path if os.path.exists(path) else path + ".npz"
+        outs = []
+        with np.load(p) as z:
+            for n, sh, dt in zip(names, shapes, dtypes):
+                a = z[_encode(n)]
+                if tuple(a.shape) != sh:
+                    raise RuntimeError(
+                        f"load_combine: shape mismatch for '{n}': "
+                        f"checkpoint {a.shape} vs program {sh}")
+                outs.append(np.asarray(a, dtype=dt))
+        return tuple(outs)
+
+    outs = _io_callback(
+        host_load,
+        tuple(jax.ShapeDtypeStruct(sh, dt)
+              for sh, dt in zip(shapes, dtypes)))
+    return {"Out": list(outs)}
+
+
+@register_op("checkpoint_notify", skip_infer_shape=True)
+def checkpoint_notify_op(ins, attrs):
+    """reference: distributed_ops/checkpoint_notify_op.cc — tell every
+    pserver to snapshot (or restore: attrs load=True) its dense params,
+    optimizer accumulators, step counters and KV tables under dirname.
+    Blocks until every server acknowledges — the checkpoint is cluster-
+    consistent once the op returns."""
+    import jax
+
+    endpoints = attrs["endpoints"]
+    if isinstance(endpoints, str):
+        endpoints = [e for e in endpoints.split(",") if e]
+    dirname = str(attrs["dirname"])
+    method = "checkpoint_load" if attrs.get("load", False) else "checkpoint"
+
+    def host_notify():
+        from ..distributed.ps.rpc import RPCClient
+
+        # tag = server INDEX: stable across restarts (endpoints rebind)
+        for i, ep in enumerate(endpoints):
+            RPCClient.get(ep).call(method, f"{dirname}|{i}")
+        return np.zeros((), np.int32)
+
+    token = _io_callback(host_notify, jax.ShapeDtypeStruct((), np.int32))
+    return {"Token": token}

@@ -167,10 +167,13 @@ def pool3d(ins, attrs):
 def spectral_norm(ins, attrs):
     """reference: operators/spectral_norm_op.cc — weight / sigma, with
     sigma from `power_iters` rounds of power iteration on the weight
-    matricised over `dim`. U/V inputs hold the persistent iteration
-    vectors (treated read-only here: the functional update returns the
-    normalised weight; the reference mutates U/V in place, a state
-    convention the Layer owns)."""
+    matricised over `dim`. Matches the reference state + grad
+    conventions (ADVICE r3): UOut/VOut carry the advanced iteration
+    vectors (the reference mutates U/V in place — the executor threads
+    the outputs back through the same persistable vars), and u/v are
+    held CONSTANT for autodiff (spectral_norm_grad treats them as data,
+    so the power iteration sits under stop_gradient)."""
+    import jax
     import jax.numpy as jnp
 
     w = ins["Weight"][0]
@@ -185,11 +188,16 @@ def spectral_norm(ins, attrs):
     def norm(x):
         return x / (jnp.linalg.norm(x) + eps)
 
+    wm_c = jax.lax.stop_gradient(wm)
     for _ in range(max(iters, 0)):
-        v = norm(wm.T @ u)
-        u = norm(wm @ v)
-    sigma = u @ wm @ v
-    return {"Out": w / sigma}
+        v = norm(wm_c.T @ u)
+        u = norm(wm_c @ v)
+    u = jax.lax.stop_gradient(u)
+    v = jax.lax.stop_gradient(v)
+    sigma = u @ wm @ v        # grads flow through wm only (u,v constant)
+    return {"Out": w / sigma,
+            "UOut": u.astype(ins["U"][0].dtype).reshape(ins["U"][0].shape),
+            "VOut": v.astype(ins["V"][0].dtype).reshape(ins["V"][0].shape)}
 
 
 @register_op("affine_grid", non_diff_inputs=("OutputShape",))

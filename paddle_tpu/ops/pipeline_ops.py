@@ -83,7 +83,7 @@ def pipeline_forward(ins, attrs):
 
     def run_stage(k, e):
         for op in stages[k]:
-            run_op(op, e, step=step)
+            run_op(op, e, step=step, axis_coords=attrs.get('__axis_coords__'))
 
     def stage_body(k, buf, mb):
         """Run stage k for microbatch index mb; buf = incoming interface."""
@@ -214,7 +214,7 @@ def pipeline_1f1b(ins, attrs):
             for name, val in zip(boundaries[k - 1], x_iface):
                 e[name] = val
         for op in stages[k]:
-            run_op(op, e, step=step)
+            run_op(op, e, step=step, axis_coords=attrs.get('__axis_coords__'))
         if k == n - 1:
             return e[loss_name].astype(jnp.float32).reshape(())
         return tuple(e[nm] for nm in boundaries[k])

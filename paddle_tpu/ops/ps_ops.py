@@ -24,7 +24,8 @@ from ..core.executor import _PS_IO_TYPES
 from ..core.registry import register_op
 
 PS_IO_OPS = ("send", "recv", "send_barrier", "fetch_barrier",
-             "listen_and_serv")
+             "listen_and_serv", "save", "load", "save_combine",
+             "load_combine", "checkpoint_notify", "py_func")
 # the executor keeps its own copy (core cannot import ops without a
 # cycle); fail loudly if the two ever drift
 assert set(PS_IO_OPS) == set(_PS_IO_TYPES), \

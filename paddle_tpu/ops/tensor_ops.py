@@ -34,11 +34,16 @@ def _rng_key(attrs, axes=("dp", "sp")):
     step = attrs.get("__step__")
     if step is not None:
         key = jax.random.fold_in(key, step)
+    coords = attrs.get("__axis_coords__") or {}
     for ax in axes:
         try:
             key = jax.random.fold_in(key, jax.lax.axis_index(ax))
-        except Exception:  # not inside an SPMD region binding this axis
-            pass
+        except Exception:
+            # not inside an SPMD region binding this axis — the SPMD
+            # interpreting oracle runs non-collective ops per rank
+            # outside shard_map and passes the rank coordinate instead
+            if ax in coords:
+                key = jax.random.fold_in(key, int(coords[ax]))
     return key
 
 

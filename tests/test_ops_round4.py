@@ -376,3 +376,74 @@ class TestPyFunc:
         expect_gw = feed["x"].T @ np.full((4, 3), 2.0 / 12, np.float32)
         np.testing.assert_allclose(w1, w0 - 0.1 * expect_gw, rtol=1e-4,
                                    atol=1e-6)
+
+
+class TestDropoutMaskConsistency:
+    """Regression (found in round 4): the __vjp_grad__ re-trace must see
+    the same __step__/__axis_coords__ as the forward op, or the backward
+    dropout mask silently disagrees with the forward mask."""
+
+    def test_fwd_bwd_masks_agree(self):
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+        from paddle_tpu.core.backward import gradients
+
+        _fresh()
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.static_data("x", [4, 16])
+            x.stop_gradient = False
+            y = layers.dropout(x, dropout_prob=0.5)
+            loss = layers.reduce_sum(y)
+            g, = gradients([loss], [x])
+        exe = pt.Executor()
+        scope = pt.Scope()
+        exe.run(startup, scope=scope, use_compiled=False)
+        feed = {"x": np.ones((4, 16), np.float32)}
+        for uc in (False, True):
+            out = exe.run(main, feed=feed, fetch_list=[y, g], scope=scope,
+                          use_compiled=uc)
+            yv, gv = np.asarray(out[0]), np.asarray(out[1])
+            assert ((yv != 0) == (gv != 0)).all(), f"compiled={uc}"
+
+
+class TestBoundedScanTruncationGuard:
+    """ADVICE r3 (medium): a runtime trip count exceeding grad_max_iters
+    must surface, not silently truncate."""
+
+    def _run(self, n_val, bound):
+        import jax.numpy as jnp
+
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+
+        _fresh()
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            i = layers.static_data("i", [1])
+            n = layers.static_data("n", [1])
+
+            def cond(i_, n_):
+                return layers.less_than(i_, n_)
+
+            def body(i_, n_):
+                return [i_ + 1.0, n_]
+
+            out_i, _ = layers.while_loop(cond, body, [i, n],
+                                         grad_max_iters=bound)
+        exe = pt.Executor()
+        scope = pt.Scope()
+        exe.run(startup, scope=scope, use_compiled=False)
+        out = exe.run(main, feed={"i": np.zeros(1, np.float32),
+                                  "n": np.array([n_val], np.float32)},
+                      fetch_list=[out_i], scope=scope, use_compiled=False)
+        return float(np.asarray(out[0]).reshape(-1)[0])
+
+    def test_within_bound_ok(self):
+        assert self._run(3.0, 8) == 3.0
+
+    def test_exceeding_bound_raises(self):
+        from paddle_tpu.core.executor import ExecutionError
+
+        with pytest.raises(ExecutionError, match="truncated"):
+            self._run(20.0, 8)

@@ -1,0 +1,249 @@
+"""save/load program ops + checkpoint_notify + kill-restart (VERDICT r4 #4).
+
+Reference: save_op.cc / load_op.cc / save_combine_op.cc /
+load_combine_op.cc run inside programs via the executor;
+distributed_ops/checkpoint_notify_op.cc tells every pserver to snapshot.
+The decisive test: a 2-server KV-backed job checkpoints mid-run, DIES
+(servers shut down, trainer scope discarded), restarts from the
+checkpoint on NEW servers, and matches the uninterrupted run
+step-for-step."""
+
+import os
+
+import numpy as np
+import pytest
+
+
+def _fresh():
+    from paddle_tpu.core import ir, unique_name
+
+    ir._main_program, ir._startup_program = ir.Program(), ir.Program()
+    unique_name.switch()
+
+
+class TestSaveLoadOps:
+    def _build(self):
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+
+        _fresh()
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.static_data("x", [4, 6])
+            h = layers.fc(x, 8, param_attr=pt.ParamAttr(name="sl_w"),
+                          bias_attr=pt.ParamAttr(name="sl_b"))
+            loss = layers.mean(h * h)
+            pt.optimizer.SGDOptimizer(0.1).minimize(loss)
+        return main, startup, loss
+
+    @pytest.mark.parametrize("combine", [False, True])
+    def test_roundtrip_through_program_ops(self, tmp_path, combine):
+        import paddle_tpu as pt
+        from paddle_tpu import io
+
+        main, startup, loss = self._build()
+        exe = pt.Executor()
+        scope = pt.Scope()
+        exe.run(startup, scope=scope, use_compiled=False)
+        feed = {"x": np.random.RandomState(0).randn(4, 6).astype(
+            np.float32)}
+        exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+        w_trained = np.asarray(scope.find_var("sl_w")).copy()
+        fname = "all_params" if combine else None
+        # save THROUGH the executor -> save/save_combine ops
+        io.save_persistables(exe, str(tmp_path), main, filename=fname,
+                             scope=scope)
+        if combine:
+            assert os.path.exists(tmp_path / "all_params.npz")
+        # clobber, then load THROUGH the executor -> load/load_combine
+        scope.set("sl_w", np.zeros_like(w_trained))
+        io.load_persistables(exe, str(tmp_path), main, filename=fname,
+                             scope=scope)
+        np.testing.assert_array_equal(np.asarray(scope.find_var("sl_w")),
+                                      w_trained)
+
+    def test_op_path_interoperates_with_host_path(self, tmp_path):
+        """Files written by the ops must read back via the host-side
+        load_vars (and vice versa) — same encoding, same layout."""
+        import paddle_tpu as pt
+        from paddle_tpu import io
+
+        main, startup, loss = self._build()
+        exe = pt.Executor()
+        scope = pt.Scope()
+        exe.run(startup, scope=scope, use_compiled=False)
+        w = np.asarray(scope.find_var("sl_w")).copy()
+        io.save_persistables(exe, str(tmp_path), main, scope=scope)  # ops
+        scope.set("sl_w", np.zeros_like(w))
+        io.load_persistables(None, str(tmp_path), main, scope=scope)  # host
+        np.testing.assert_array_equal(np.asarray(scope.find_var("sl_w")), w)
+
+
+class TestKillRestart:
+    """The cluster-consistent checkpoint/resume flow."""
+
+    DIM = 8
+
+    def _servers(self, n=2):
+        from paddle_tpu.distributed.ps import kv_service
+
+        return [kv_service.KVServer("127.0.0.1:0") for _ in range(n)]
+
+    def _teardown(self, servers):
+        from paddle_tpu.distributed.ps import kv_service
+        from paddle_tpu.distributed.ps.rpc import RPCClient
+
+        for s in servers:
+            s.shutdown()
+        kv_service._client_cache.clear()
+        RPCClient.reset_pool()
+
+    def _build(self, eps):
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+
+        _fresh()
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            ids = layers.data("ids", [4], dtype="int64", stop_gradient=True)
+            label = layers.data("label", [1], dtype="int64",
+                                stop_gradient=True)
+            emb = layers.distributed_embedding(ids, "ck_tbl", self.DIM,
+                                               eps, seed=7, lr=0.1)
+            feat = layers.reduce_mean(emb, dim=1)
+            logits = layers.fc(
+                feat, 3, param_attr=pt.ParamAttr(
+                    name="ck_w", initializer=pt.initializer.Xavier(seed=5)),
+                bias_attr=pt.ParamAttr(name="ck_b"))
+            loss = layers.mean(
+                layers.softmax_with_cross_entropy(logits, label))
+            pt.optimizer.SGDOptimizer(0.2).minimize(loss)
+        return main, startup, loss
+
+    @staticmethod
+    def _feed(step):
+        rng = np.random.RandomState(400 + step)
+        return {"ids": rng.randint(0, 10 ** 9, (8, 4)).astype(np.int64),
+                "label": rng.randint(0, 3, (8, 1)).astype(np.int64)}
+
+    def _steps(self, exe, main, loss, scope, lo, hi):
+        out = []
+        for s in range(lo, hi):
+            r = exe.run(main, feed=self._feed(s), fetch_list=[loss],
+                        scope=scope, use_compiled=False)
+            out.append(float(np.asarray(r[0]).reshape(-1)[0]))
+        return out
+
+    def _notify(self, exe, eps, dirname, load=False):
+        """checkpoint_notify as a PROGRAM OP, reference style."""
+        import paddle_tpu as pt
+
+        prog = pt.Program()
+        prog.global_block().append_op(
+            "checkpoint_notify", {}, {"Token": ["@ckpt_token@"]},
+            {"endpoints": eps, "dirname": dirname, "load": load})
+        exe.run(prog, feed={}, fetch_list=[], scope=pt.Scope(),
+                use_compiled=False)
+
+    def test_kill_and_restart_matches_uninterrupted(self, tmp_path):
+        import paddle_tpu as pt
+        from paddle_tpu import io
+
+        # ---- run A: uninterrupted 6 steps -----------------------------
+        servers_a = self._servers()
+        eps_a = ",".join(s.endpoint for s in servers_a)
+        try:
+            main, startup, loss = self._build(eps_a)
+            exe = pt.Executor()
+            scope = pt.Scope()
+            exe.run(startup, scope=scope, use_compiled=False)
+            losses_a = self._steps(exe, main, loss, scope, 0, 6)
+        finally:
+            self._teardown(servers_a)
+
+        # ---- run B: 3 steps, checkpoint, DIE --------------------------
+        ckpt = str(tmp_path / "ckpt")
+        servers_b = self._servers()
+        eps_b = ",".join(s.endpoint for s in servers_b)
+        try:
+            main, startup, loss = self._build(eps_b)
+            exe = pt.Executor()
+            scope = pt.Scope()
+            exe.run(startup, scope=scope, use_compiled=False)
+            losses_b1 = self._steps(exe, main, loss, scope, 0, 3)
+            # cluster checkpoint: servers snapshot KV tables; trainer
+            # saves its persistables through save ops
+            self._notify(exe, eps_b, ckpt)
+            io.save_persistables(exe, ckpt, main, filename="trainer",
+                                 scope=scope)
+        finally:
+            self._teardown(servers_b)   # the "kill"
+        del scope, exe, main
+
+        # ---- run C: fresh servers + trainer, restore, resume ----------
+        servers_c = self._servers()
+        eps_c = ",".join(s.endpoint for s in servers_c)
+        try:
+            main, startup, loss = self._build(eps_c)
+            exe = pt.Executor()
+            scope = pt.Scope()
+            exe.run(startup, scope=scope, use_compiled=False)
+            self._notify(exe, eps_c, ckpt, load=True)
+            io.load_persistables(exe, ckpt, main, filename="trainer",
+                                 scope=scope)
+            losses_c = self._steps(exe, main, loss, scope, 3, 6)
+        finally:
+            self._teardown(servers_c)
+
+        np.testing.assert_allclose(losses_b1, losses_a[:3], rtol=1e-6)
+        np.testing.assert_allclose(losses_c, losses_a[3:], rtol=1e-6,
+                                   err_msg="resume diverged from the "
+                                           "uninterrupted run")
+
+    def test_pserver_dense_checkpoint_roundtrip(self, tmp_path):
+        """PServer (dense path) snapshot/restore: params + accumulators
+        + step counters survive."""
+        import paddle_tpu as pt
+        from paddle_tpu.distributed.ps.pserver import PServer
+        from paddle_tpu.distributed.ps.rpc import RPCClient
+
+        _fresh()
+        # minimal pserver program: SGD apply for one param
+        prog, startup = pt.Program(), pt.Program()
+        block = prog.global_block()
+        block.create_var(name="p0", shape=[4], dtype="float32",
+                         persistable=True)
+        sb = startup.global_block()
+        v = sb.create_var(name="p0", shape=[4], dtype="float32",
+                          persistable=True)
+        from paddle_tpu.initializer import Constant
+
+        Constant(1.0)(v, sb)
+        apply_op = pt.core.ir.OpDesc(
+            "sgd", {"Param": ["p0"], "Grad": ["p0@GRAD"],
+                    "LearningRate": ["lr0"]},
+            {"ParamOut": ["p0"]}, {})
+        lv = sb.create_var(name="lr0", shape=[1], dtype="float32",
+                           persistable=True)
+        Constant(0.5)(lv, sb)
+        block.create_var(name="lr0", shape=[1], dtype="float32",
+                         persistable=True)
+        srv = PServer("127.0.0.1:0", prog, startup, num_trainers=1,
+                      sync_mode=False,
+                      grad_to_param={"p0@GRAD": "p0"},
+                      grad_to_ops={"p0@GRAD": [apply_op]})
+        try:
+            cli = RPCClient.get(srv.endpoint)
+            cli.call("send_grad", "p0@GRAD",
+                     np.ones(4, np.float32), 0)
+            p_after, _ = cli.call("recv_param", "p0")
+            np.testing.assert_allclose(p_after, 0.5)
+            cli.call("checkpoint", str(tmp_path / "d") + "|0")
+            # wreck the state, then restore
+            srv.scope.set("p0", np.zeros(4, np.float32))
+            cli.call("checkpoint_load", str(tmp_path / "d") + "|0")
+            p_back, _ = cli.call("recv_param", "p0")
+            np.testing.assert_allclose(p_back, 0.5)
+        finally:
+            srv.shutdown()
+            RPCClient.reset_pool()

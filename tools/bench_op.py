@@ -34,7 +34,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
-from tools.perf import sync, time_chain
+from tools.perf import sync, time_chain, time_chain_device
 
 # The recorded suite: the hot ops of the BASELINE ladder at bench
 # geometry (ERNIE-large / BERT-base / ResNet-50 shapes).
@@ -151,9 +151,12 @@ def bench_case(case):
             out = fwd(x)
             if out.shape == x.shape:
                 return out.astype(x.dtype)
-            return (x + jnp.sum(out.astype(jnp.float32)) * 0).astype(x.dtype)
+            # 1e-20 (not 0): a *0 chain constant-folds under jit and
+            # the op would be DCE'd out of the timing loop entirely
+            return (x + jnp.sum(out.astype(jnp.float32)) * 1e-20).astype(
+                x.dtype)
 
-    ms = time_chain(step, ins[chain_slot])
+    ms = time_chain_device(step, ins[chain_slot])
     return {"op": case["op"],
             "inputs": case["inputs"],
             "dtype": case.get("dtype", "float32"),
@@ -169,6 +172,19 @@ def parse_shapes(spec):
     return ins
 
 
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "op_bench_baseline.json")
+
+
+def _case_key(case):
+    shapes = ";".join(f"{s}={'x'.join(map(str, d))}"
+                      for s, d in sorted(case["inputs"].items()))
+    attrs = json.dumps(case.get("attrs") or {}, sort_keys=True)
+    return (f"{case['op']}|{shapes}|{case.get('dtype', 'float32')}"
+            f"|{attrs}|{case.get('chain', '')}"
+            + ("|grad" if case.get("grad") else ""))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", help="JSON file with a list of cases")
@@ -177,6 +193,13 @@ def main():
     ap.add_argument("--dtype", default="float32")
     ap.add_argument("--attrs", default="{}", help="JSON attrs dict")
     ap.add_argument("--grad", action="store_true")
+    ap.add_argument("--record", action="store_true",
+                    help="write results as the regression baseline "
+                         f"({BASELINE_PATH})")
+    ap.add_argument("--check", action="store_true",
+                    help="FAIL (exit 1) if any recorded op regresses "
+                         ">10%% vs the baseline (VERDICT r4 #10)")
+    ap.add_argument("--tolerance", type=float, default=0.10)
     args = ap.parse_args()
     if args.op:
         cases = [{"op": args.op, "inputs": parse_shapes(args.shapes),
@@ -187,13 +210,38 @@ def main():
             cases = json.load(f)
     else:
         cases = BUILTIN_SUITE
+    results = {}
     for case in cases:
         try:
-            print(json.dumps(bench_case(case)), flush=True)
+            r = bench_case(case)
+            results[_case_key(case)] = r["ms"]
+            print(json.dumps(r), flush=True)
         except Exception as e:
             print(json.dumps({"op": case.get("op"),
                               "error": f"{type(e).__name__}: {e}"[:200]}),
                   flush=True)
+    if args.record:
+        with open(BASELINE_PATH, "w") as f:
+            json.dump(results, f, indent=1, sort_keys=True)
+        print(json.dumps({"recorded": len(results),
+                          "path": BASELINE_PATH}))
+    if args.check:
+        if not os.path.exists(BASELINE_PATH):
+            print(json.dumps({"check": "NO BASELINE — run --record "
+                                       "first"}))
+            sys.exit(2)
+        with open(BASELINE_PATH) as f:
+            base = json.load(f)
+        bad = []
+        for k, ms in results.items():
+            ref = base.get(k)
+            if ref and ms > ref * (1.0 + args.tolerance):
+                bad.append({"case": k, "baseline_ms": ref, "now_ms": ms,
+                            "regression": round(ms / ref - 1.0, 3)})
+        print(json.dumps({"check": "FAIL" if bad else "PASS",
+                          "regressions": bad}))
+        if bad:
+            sys.exit(1)
 
 
 if __name__ == "__main__":

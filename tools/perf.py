@@ -46,3 +46,42 @@ def time_chain(step, x0, *, n1=10, n2=40, repeats=2):
         slope = (t2 - t1) / (n2 - n1)
         best = min(best, slope)
     return best * 1e3
+
+
+def time_chain_device(step, x0, *, n1=8, n2=40, repeats=5):
+    """ms per step with the iteration loop INSIDE jit (lax.fori_loop):
+    Python-loop dispatch through the axon relay adds ~ms noise that
+    swamps sub-ms kernels (negative slopes). Fresh input per window —
+    the relay dedupes identical (fn, args) dispatches (reads as >100%
+    MFU). step must map x -> same-aval x."""
+    import functools
+
+    import jax
+    from jax import lax
+    import jax.numpy as jnp
+
+    @functools.lru_cache(maxsize=None)
+    def runner(n):
+        @jax.jit
+        def run(x):
+            return lax.fori_loop(0, n, lambda i, xx: step(xx), x)
+
+        return run
+
+    rng = np.random.RandomState(7)
+
+    def window(n):
+        x = jax.tree_util.tree_map(
+            lambda a: a * (1.0 + 0.001 * float(rng.rand())), x0)
+        sync(jax.tree_util.tree_leaves(x)[0])
+        t0 = time.perf_counter()
+        y = runner(n)(x)
+        sync(jax.tree_util.tree_leaves(y)[0])
+        return time.perf_counter() - t0
+
+    window(n1), window(n2)      # compile both
+    slopes = []
+    for _ in range(repeats):
+        t1, t2 = window(n1), window(n2)
+        slopes.append((t2 - t1) / (n2 - n1))
+    return float(np.median(slopes)) * 1e3
