@@ -29,14 +29,26 @@ from .registry import EMPTY_VAR
 from .scope import Scope, global_scope
 from .types import Place, default_place
 
-# ops whose lowerings do host IO (PS RPC, file save/load, py_func) —
-# they force the interpreting executor path: the axon TPU backend
-# rejects compiled host send/recv callbacks (io_callback/pure_callback
-# under jit), and the reference runs these through side programs anyway
+# ops whose lowerings do host IO (PS RPC, file save/load) — they force
+# the interpreting executor path: the axon TPU backend rejects compiled
+# host send/recv callbacks (io_callback/pure_callback under jit), and
+# the reference runs these through side programs anyway
 _PS_IO_TYPES = frozenset(
     ("send", "recv", "send_barrier", "fetch_barrier", "listen_and_serv",
      "save", "load", "save_combine", "load_combine", "checkpoint_notify",
      "py_func"))
+# of those, the types that compile FINE where host callbacks work
+# (pure_callback under jit on CPU) — only routed to the interpreter on
+# backends that reject compiled host callbacks (axon)
+_HOST_CALLBACK_OK_ON_CPU = frozenset(("py_func",))
+
+
+def _host_callback_types():
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return _PS_IO_TYPES - _HOST_CALLBACK_OK_ON_CPU
+    return _PS_IO_TYPES
 
 _MISSING = object()
 
@@ -259,7 +271,11 @@ class Executor:
         ps_key = (program.uid, program.version)
         has_ps = self._ps_programs.get(ps_key)
         if has_ps is None:
-            has_ps = any(op.type in _PS_IO_TYPES for op in block.ops)
+            io_types = _host_callback_types()
+            # scan ALL blocks: a py_func inside a cond/while sub-block
+            # would otherwise reach the compiled path and crash on axon
+            has_ps = any(op.type in io_types
+                         for blk in program.blocks for op in blk.ops)
             self._ps_programs[ps_key] = has_ps
         if use_compiled and has_ps:
             use_compiled = False

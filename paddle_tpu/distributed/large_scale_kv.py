@@ -130,8 +130,15 @@ class LargeScaleKV:
 
     def load(self, path: str):
         data = np.load(path if path.endswith(".npz") else path + ".npz")
+        by_shard: Dict[int, list] = {}
         for k, v in zip(data["ids"], data["rows"]):
-            self.shards[int(k) % len(self.shards)].table[int(k)] = v
+            by_shard.setdefault(int(k) % len(self.shards), []).append(
+                (int(k), v))
+        for s, items in by_shard.items():
+            shard = self.shards[s]
+            with shard.lock:       # a concurrent pull iterates the table
+                for k, v in items:
+                    shard.table[k] = v
 
 
 class SparseEmbedding:

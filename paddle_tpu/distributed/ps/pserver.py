@@ -321,7 +321,9 @@ class PServer:
             self._global_step = int(meta["global_step"])
             self._apply_count = {k: int(v)
                                  for k, v in meta["apply_count"].items()}
-        self.kv.load_all(dirname, tag)
+            # inside the lock, like save: a kv RPC between the dense
+            # restore and the table restore would see a torn state
+            self.kv.load_all(dirname, tag)
 
     def _grad_of(self, param_name):
         for g, p in self.grad_to_param.items():

@@ -633,11 +633,13 @@ def _jst_while(cond_fn, body_fn, snap, flag_positions=()):
             _suppress_capture -= 1
     else:
         pred0 = cond_fn(state)
-    if capturing and not isinstance(pred0, VarBase):
+    if capturing and flag_positions and not isinstance(pred0, VarBase):
         # break/continue flags start as Python False, so the rewritten
         # predicate `not brk and <test>` can look Python-valued on
         # iteration 0 and only turn into a tensor once a tensor-if sets
-        # a flag — probe ONE iteration to find out
+        # a flag — probe ONE iteration to find out. Gated on
+        # flag_positions: plain python-predicate loops must NOT pay an
+        # extra body execution (trace-time side effects would double)
         _suppress_capture += 1
         try:
             if _jst_truth(pred0):

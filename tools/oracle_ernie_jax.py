@@ -1,0 +1,257 @@
+"""Plain-JAX ERNIE-large oracle — framework-free MLM train step.
+
+Decides whether the framework's 35.8%-MFU north star is the chip's
+ceiling for this transformer geometry or overhead of the op-granular
+IR backward (each __vjp_grad__ re-traces its op; XLA must CSE the
+duplicates). This file uses NOTHING from paddle_tpu: raw jnp encoder,
+ONE jax.value_and_grad over the whole step, fused AdamW via tree_map,
+and optional per-layer jax.checkpoint with the save-dot-outputs policy
+(VERDICT r3's named untried lever).
+
+Variants:
+  --remat none   save-everything backward (XLA decides)
+  --remat dots   jax.checkpoint(policy=dots_with_no_batch_dims_saveable)
+                 per encoder layer — recompute elementwise, keep matmuls
+  --remat full   jax.checkpoint per layer, save nothing
+
+Methodology = tools/bench_models.py: device-resident feed, donated
+state, fetch-free windows closed by one loss fetch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+L, H, FF, HEADS, V = 24, 1024, 4096, 16, 30522
+MAXPOS, TYPES, K = 512, 2, 80
+DROP = 0.1
+
+
+def init_params(key):
+    ks = iter(jax.random.split(key, 8 + 16 * L))
+
+    def dense(i, o):
+        return {"w": jax.random.normal(next(ks), (i, o), jnp.float32) * 0.02,
+                "b": jnp.zeros((o,), jnp.float32)}
+
+    p = {"emb": jax.random.normal(next(ks), (V, H), jnp.float32) * 0.02,
+         "pos": jax.random.normal(next(ks), (MAXPOS, H), jnp.float32) * 0.02,
+         "typ": jax.random.normal(next(ks), (TYPES, H), jnp.float32) * 0.02,
+         "emb_ln": {"g": jnp.ones((H,)), "b": jnp.zeros((H,))},
+         "layers": [],
+         "head": dense(H, H),
+         "head_ln": {"g": jnp.ones((H,)), "b": jnp.zeros((H,))},
+         "head_bias": jnp.zeros((V,), jnp.float32)}
+    for _ in range(L):
+        p["layers"].append({
+            "qkv": dense(H, 3 * H), "proj": dense(H, H),
+            "ln1": {"g": jnp.ones((H,)), "b": jnp.zeros((H,))},
+            "fc1": dense(H, FF), "fc2": dense(FF, H),
+            "ln2": {"g": jnp.ones((H,)), "b": jnp.zeros((H,))}})
+    return p
+
+
+def layer_norm(x, ln):
+    xf = x.astype(jnp.float32)
+    m = xf.mean(-1, keepdims=True)
+    v = jnp.square(xf - m).mean(-1, keepdims=True)
+    return ((xf - m) * jax.lax.rsqrt(v + 1e-12) * ln["g"] + ln["b"]).astype(
+        x.dtype)
+
+
+def _splitmix(x):
+    x = (x ^ (x >> 16)) * jnp.uint32(0x7FEB352D)
+    x = (x ^ (x >> 15)) * jnp.uint32(0x846CA68B)
+    return x ^ (x >> 16)
+
+
+def dropout(x, seed, rate=DROP):
+    if rate <= 0:
+        return x
+    U = jnp.uint32
+    lin = jax.lax.iota(U, x.size).reshape(x.shape)
+    h = _splitmix(lin ^ (U(seed) * U(0x9E3779B9)))
+    keep = h >= U(int(rate * 4294967296.0))
+    return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
+
+
+def attention(x, lp, mask_bias, seed, chunk=128):
+    b, s, _ = x.shape
+    d = H // HEADS
+    qkv = (x @ lp["qkv"]["w"].astype(x.dtype)) + \
+        lp["qkv"]["b"].astype(x.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):
+        return t.reshape(b, s, HEADS, d).transpose(0, 2, 1, 3)
+
+    q, k, v = heads(q), heads(k), heads(v)
+    scale = 1.0 / np.sqrt(d)
+    n = s // chunk
+    qs = jnp.moveaxis(q.reshape(b, HEADS, n, chunk, d), 2, 0)
+    offs = jnp.arange(n, dtype=jnp.int32) * chunk
+
+    def body(args):
+        qc, off = args
+        sc = jnp.einsum("bhqd,bhkd->bhqk", qc, k,
+                        preferred_element_type=jnp.float32) * scale
+        sc = sc + mask_bias[:, None, None, :]
+        p = jax.nn.softmax(sc, axis=-1)
+        # attention-probs dropout, position-keyed (q offset folds in)
+        U = jnp.uint32
+        lin = jax.lax.iota(U, p.size).reshape(p.shape) + U(1) * off.astype(
+            jnp.uint32)
+        h = _splitmix(lin ^ (U(seed) * U(0x9E3779B9)))
+        keep = h >= U(int(DROP * 4294967296.0))
+        p = jnp.where(keep, p / (1.0 - DROP), 0.0)
+        return jnp.einsum("bhqk,bhkd->bhqd", p.astype(qc.dtype), v,
+                          preferred_element_type=jnp.float32).astype(
+            qc.dtype)
+
+    out = jax.lax.map(body, (qs, offs))
+    out = jnp.moveaxis(out, 0, 2).reshape(b, HEADS, s, d)
+    out = out.transpose(0, 2, 1, 3).reshape(b, s, H)
+    out = (out @ lp["proj"]["w"].astype(x.dtype)) + \
+        lp["proj"]["b"].astype(x.dtype)
+    return out
+
+
+def encoder_layer(x, lp, mask_bias, seed):
+    a = attention(x, lp, mask_bias, seed)
+    x = layer_norm(x + dropout(a, seed + 1), lp["ln1"])
+    hdn = jax.nn.gelu((x @ lp["fc1"]["w"].astype(x.dtype))
+                      + lp["fc1"]["b"].astype(x.dtype))
+    out = (hdn @ lp["fc2"]["w"].astype(x.dtype)) + \
+        lp["fc2"]["b"].astype(x.dtype)
+    return layer_norm(x + dropout(out, seed + 2), lp["ln2"])
+
+
+def forward(params, batch, step, remat):
+    ids, types, mask, mlm_pos, mlm_ids, mlm_w = batch
+    b, s = ids.shape
+    x = params["emb"][ids] + params["pos"][None, :s] + params["typ"][types]
+    x = layer_norm(x, params["emb_ln"]).astype(jnp.bfloat16)
+    x = dropout(x, step * 1000 + 7)
+    mask_bias = jnp.where(mask > 0, 0.0, -1e9).astype(jnp.float32)
+
+    def run_layer(x, i, lp):
+        f = functools.partial(encoder_layer, lp=lp, mask_bias=mask_bias,
+                              seed=step * 1000 + 13 * (i + 1))
+        if remat == "dots":
+            return jax.checkpoint(
+                f, policy=jax.checkpoint_policies
+                .dots_with_no_batch_dims_saveable)(x)
+        if remat == "full":
+            return jax.checkpoint(f)(x)
+        return f(x)
+
+    for i, lp in enumerate(params["layers"]):
+        x = run_layer(x, i, lp)
+    # MLM head on k gathered positions
+    sel = jnp.take_along_axis(x, mlm_pos[..., None], axis=1)   # [B,K,H]
+    hmid = jax.nn.gelu((sel @ params["head"]["w"].astype(sel.dtype))
+                       + params["head"]["b"].astype(sel.dtype))
+    hmid = layer_norm(hmid, params["head_ln"])
+    logits = (hmid.astype(jnp.float32) @ params["emb"].T.astype(
+        jnp.float32)) + params["head_bias"]
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, mlm_ids[..., None], axis=-1)[..., 0]
+    return jnp.sum(nll * mlm_w) / jnp.maximum(jnp.sum(mlm_w), 1.0)
+
+
+def make_step(remat, lr=1e-4):
+    def step_fn(state, batch):
+        params, m, v, t = state
+
+        def loss_fn(p):
+            return forward(p, batch, t, remat)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        t2 = t + 1
+        b1, b2, eps, wd = 0.9, 0.999, 1e-6, 0.01
+
+        def upd(p, mm, vv, g):
+            g = g.astype(jnp.float32)
+            mm2 = b1 * mm + (1 - b1) * g
+            vv2 = b2 * vv + (1 - b2) * g * g
+            p2 = p - lr * (mm2 / (jnp.sqrt(vv2) + eps) + wd * p)
+            return p2, mm2, vv2
+
+        flat_p, tree = jax.tree_util.tree_flatten(params)
+        flat_m = jax.tree_util.tree_leaves(m)
+        flat_v = jax.tree_util.tree_leaves(v)
+        flat_g = jax.tree_util.tree_leaves(grads)
+        outs = [upd(p, mm, vv, g) for p, mm, vv, g in
+                zip(flat_p, flat_m, flat_v, flat_g)]
+        new_p = jax.tree_util.tree_unflatten(tree, [o[0] for o in outs])
+        new_m = jax.tree_util.tree_unflatten(tree, [o[1] for o in outs])
+        new_v = jax.tree_util.tree_unflatten(tree, [o[2] for o in outs])
+        return (new_p, new_m, new_v, t2), loss
+
+    return jax.jit(step_fn, donate_argnums=(0,))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--seq", type=int, default=512)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--windows", type=int, default=3)
+    ap.add_argument("--remat", default="none",
+                    choices=["none", "dots", "full"])
+    args = ap.parse_args()
+
+    key = jax.random.PRNGKey(0)
+    params = init_params(key)
+    zeros = jax.tree_util.tree_map(
+        lambda p: jnp.zeros_like(p, jnp.float32), params)
+    state = (params, zeros,
+             jax.tree_util.tree_map(jnp.zeros_like, zeros),
+             jnp.zeros((), jnp.int32))
+
+    rng = np.random.RandomState(0)
+    b, s = args.batch, args.seq
+    batch = (
+        jnp.asarray(rng.randint(0, V, (b, s)).astype(np.int32)),
+        jnp.asarray(rng.randint(0, TYPES, (b, s)).astype(np.int32)),
+        jnp.asarray(np.ones((b, s), np.float32)),
+        jnp.asarray(rng.randint(0, s, (b, K)).astype(np.int32)),
+        jnp.asarray(rng.randint(0, V, (b, K)).astype(np.int32)),
+        jnp.asarray(np.ones((b, K), np.float32)),
+    )
+    step = make_step(args.remat)
+    t0 = time.perf_counter()
+    state, loss = step(state, batch)
+    print(f"compile {time.perf_counter() - t0:.1f}s "
+          f"loss={float(np.asarray(loss)):.4f}", flush=True)
+    state, loss = step(state, batch)
+    _ = float(np.asarray(loss))
+
+    best = float("inf")
+    for _ in range(args.windows):
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            state, loss = step(state, batch)
+        lv = float(np.asarray(loss))
+        best = min(best, (time.perf_counter() - t0) / args.steps)
+    per_layer = 4 * H * H + 2 * H * FF
+    tokens = b * s
+    flops = 6.0 * L * per_layer * tokens + 6.0 * H * V * b * K \
+        + 6.0 * 2 * L * b * s * s * H
+    mfu = flops / best / 197e12
+    print(json.dumps({"remat": args.remat,
+                      "ms_per_step": round(best * 1e3, 2),
+                      "tokens_per_sec": round(tokens / best, 1),
+                      "mfu": round(mfu, 4), "loss": round(lv, 4)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -84,4 +84,17 @@ def time_chain_device(step, x0, *, n1=8, n2=40, repeats=5):
     for _ in range(repeats):
         t1, t2 = window(n1), window(n2)
         slopes.append((t2 - t1) / (n2 - n1))
-    return float(np.median(slopes)) * 1e3
+    est = float(np.median(slopes))
+    if est * (n2 - n1) < 0.02:
+        # sub-ms kernel: the window difference is under ~20 ms and relay
+        # jitter dominates (negative slopes) — rescale the windows so
+        # the slope term is >= 20 ms and re-measure
+        n2b = int(min(max(0.02 / max(est, 1e-7), 200), 4000))
+        n1b = max(n2b // 5, 1)
+        window(n1b), window(n2b)
+        slopes = []
+        for _ in range(repeats):
+            t1, t2 = window(n1b), window(n2b)
+            slopes.append((t2 - t1) / (n2b - n1b))
+        est = float(np.median(slopes))
+    return est * 1e3
