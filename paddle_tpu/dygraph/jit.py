@@ -639,7 +639,10 @@ def _jst_while(cond_fn, body_fn, snap, flag_positions=()):
         # iteration 0 and only turn into a tensor once a tensor-if sets
         # a flag — probe ONE iteration to find out. Gated on
         # flag_positions: plain python-predicate loops must NOT pay an
-        # extra body execution (trace-time side effects would double)
+        # extra body execution (trace-time side effects would double).
+        # A non-bc loop whose python predicate would turn tensor after
+        # one iteration keeps the long-documented freeze semantics
+        # (same as rounds 1-3): python predicate => python loop
         _suppress_capture += 1
         try:
             if _jst_truth(pred0):

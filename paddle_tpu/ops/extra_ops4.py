@@ -222,8 +222,13 @@ def py_func(ins, attrs):
         return tuple(np.asarray(o).astype(d)
                      for o, d in zip(outs, dtypes))
 
-    outs = jax.pure_callback(host_fn, tuple(result_shape),
-                             *[x for x in ins.get("X", [])])
+    # io_callback(ordered), NOT pure_callback: the reference's py_func
+    # always executes (logging/debug hooks are common users); a pure
+    # callback with unused outputs is fair game for XLA DCE/caching
+    from jax.experimental import io_callback
+
+    outs = io_callback(host_fn, tuple(result_shape),
+                       *[x for x in ins.get("X", [])], ordered=True)
     return {"Out": list(outs)}
 
 

@@ -152,3 +152,23 @@ def fused_elemwise_activation(ins, attrs):
         if out is None:
             raise ValueError(f"unsupported functor_list {functors}")
     return {"Out": out, "IntermediateOut": inter}
+
+
+@register_op("fusion_seqpool_cvm_concat", non_diff_inputs=("CVM", "Lod"))
+def fusion_seqpool_cvm_concat(ins, attrs):
+    """reference: fused/fusion_seqpool_cvm_concat_op.cc — per-input
+    sequence pool, CVM transform of each pooled tensor, feature concat.
+    Composes the fusion_seqpool_concat and cvm lowerings (XLA fuses the
+    chain; the reference hand-fused it for CPU)."""
+    from .metrics_ops import cvm as cvm_op
+
+    import jax.numpy as jnp
+
+    pooled = fusion_seqpool_concat(
+        {"X": ins["X"], "Lod": ins.get("Lod", [None])}, attrs)["Out"]
+    n = len(ins["X"])
+    use_cvm = bool(attrs.get("use_cvm", True))
+    parts = jnp.split(pooled, n, axis=1)
+    outs = [cvm_op({"X": [p], "CVM": ins.get("CVM", [None])},
+                   {"use_cvm": use_cvm})["Y"] for p in parts]
+    return {"Out": jnp.concatenate(outs, axis=1)}

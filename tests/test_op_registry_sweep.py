@@ -784,7 +784,6 @@ ALLOWLIST = {
                            # tests/test_pipeline.py via the executor
     "distributed_lookup_table",       # tests/test_distributed_kv.py via
     "distributed_lookup_table_grad",  # layers.distributed_embedding
-    "fusion_seqpool_cvm_concat",      # thin compose of tested seqpool+cvm
 }
 
 
@@ -886,3 +885,22 @@ def test_roi_align_and_batch_size_like_random():
                         {"shape": [-1, 7], "mean": 0.0, "std": 1.0,
                          "seed": 3})["Out"])
     assert g.shape == (5, 7)
+
+
+def test_fusion_seqpool_cvm_concat():
+    x1 = np.abs(RNG.randn(3, 4, 5).astype(np.float32))
+    x2 = np.abs(RNG.randn(3, 4, 5).astype(np.float32))
+    out = np.asarray(_fwd("fusion_seqpool_cvm_concat",
+                          {"X": [x1, x2], "CVM": [None], "Lod": [None]},
+                          {"pooltype": "SUM", "use_cvm": True})["Out"])
+    p1, p2 = x1.sum(1), x2.sum(1)
+
+    def cvm_np(p):
+        show = np.maximum(p[:, :1], 1.0)
+        return np.concatenate(
+            [np.log(show),
+             np.log(np.maximum(p[:, 1:2], 0) + 1) - np.log(show),
+             p[:, 2:]], 1)
+
+    np.testing.assert_allclose(out, np.concatenate(
+        [cvm_np(p1), cvm_np(p2)], 1), rtol=1e-5)

@@ -243,14 +243,16 @@ def main():
             base = json.load(f)
         bad, info = [], []
         if not (args.op or args.config):
-            # full-suite check: a recorded case that failed to run (or
-            # was renamed) must FAIL, not silently drop out of the gate
+            # full-suite check: a CURRENT-suite case that failed to run
+            # must FAIL, not silently drop out of the gate. Keys only in
+            # the baseline (older suite versions, filtered --record
+            # additions) are ignored — they'd fail forever otherwise.
+            expected = {_case_key(c) for c in cases}
             for k in base:
-                if k not in results:
+                if k in expected and k not in results:
                     bad.append({"case": k, "baseline_ms": base[k],
                                 "now_ms": None,
-                                "regression": "MISSING (errored or "
-                                              "renamed)"})
+                                "regression": "MISSING (errored)"})
         for k, ms in results.items():
             ref = base.get(k)
             if not ref:
