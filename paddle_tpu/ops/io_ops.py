@@ -154,3 +154,22 @@ def checkpoint_notify_op(ins, attrs):
 
     token = _io_callback(host_notify, jax.ShapeDtypeStruct((), np.int32))
     return {"Token": token}
+
+
+@register_op("ref_by_trainer_id", non_diff_inputs=("TrainerId",))
+def ref_by_trainer_id(ins, attrs):
+    """reference: distributed_ops/ref_by_trainer_id_op.cc — select this
+    trainer's slice from a duplicable input list by TrainerId (the PS
+    transpiler uses it to route per-trainer split grads)."""
+    import jax.numpy as jnp
+
+    xs = ins["X"]
+    tid = ins["TrainerId"][0]
+    i = int(np.asarray(tid).reshape(-1)[0]) if not hasattr(
+        tid, "aval") else None
+    if i is not None:
+        return {"Out": xs[i % len(xs)]}
+    # traced id: stack + dynamic index (uniform shapes required)
+    stacked = jnp.stack([jnp.asarray(x) for x in xs])
+    return {"Out": stacked[jnp.asarray(tid, jnp.int32).reshape(()) %
+                           len(xs)]}

@@ -904,3 +904,11 @@ def test_fusion_seqpool_cvm_concat():
 
     np.testing.assert_allclose(out, np.concatenate(
         [cvm_np(p1), cvm_np(p2)], 1), rtol=1e-5)
+
+
+def test_ref_by_trainer_id():
+    a = np.ones((2, 2), np.float32)
+    b = 2 * a
+    out = _fwd("ref_by_trainer_id",
+               {"X": [a, b], "TrainerId": [np.array([1], np.int64)]}, {})
+    np.testing.assert_allclose(np.asarray(out["Out"]), b)
