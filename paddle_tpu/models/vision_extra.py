@@ -1,0 +1,84 @@
+"""VGG — the second half of the reference's image-classification book
+fixture (tests/book/test_image_classification.py:78 vgg16_bn_drop trains
+either VGG16 or ResNet on cifar-10; models/resnet.py covers the other).
+
+Same recipe: conv blocks of 3x3 conv+BN(+dropout between convs), 2x2
+max-pool per block, dropout + fc4096 + BN + fc4096 head.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import layers
+from ..core.ir import Program, program_guard
+from ..param_attr import ParamAttr
+
+
+def _conv_block(x, num_filter, groups, dropouts, name, is_test=False):
+    """reference: fluid.nets.img_conv_group (python/paddle/fluid/nets.py)
+    — conv3x3+BN+relu x groups with per-conv dropout, then 2x2 max pool."""
+    for i in range(groups):
+        x = layers.conv2d(x, num_filter, 3, padding=1, bias_attr=False,
+                          param_attr=ParamAttr(name=f"{name}_c{i}_w"))
+        x = layers.batch_norm(x, act="relu", is_test=is_test,
+                              param_attr=ParamAttr(name=f"{name}_c{i}_bns"),
+                              bias_attr=ParamAttr(name=f"{name}_c{i}_bnb"),
+                              moving_mean_name=f"{name}_c{i}_bnm",
+                              moving_variance_name=f"{name}_c{i}_bnv")
+        if dropouts[i] and not is_test:
+            x = layers.dropout(x, dropout_prob=dropouts[i])
+    return layers.pool2d(x, 2, "max", 2)
+
+
+def vgg16_backbone(img, is_test=False):
+    """vgg16_bn_drop (book fixture :78): 64x2, 128x2, 256x3, 512x3,
+    512x3 conv blocks -> dropout -> fc4096 -> BN+relu -> dropout ->
+    fc4096."""
+    x = _conv_block(img, 64, 2, [0.3, 0], "vgg_b1", is_test)
+    x = _conv_block(x, 128, 2, [0.4, 0], "vgg_b2", is_test)
+    x = _conv_block(x, 256, 3, [0.4, 0.4, 0], "vgg_b3", is_test)
+    x = _conv_block(x, 512, 3, [0.4, 0.4, 0], "vgg_b4", is_test)
+    x = _conv_block(x, 512, 3, [0.4, 0.4, 0], "vgg_b5", is_test)
+    if not is_test:
+        x = layers.dropout(x, dropout_prob=0.5)
+    fc1 = layers.fc(x, 4096, param_attr=ParamAttr(name="vgg_fc1_w"))
+    bn = layers.batch_norm(fc1, act="relu", is_test=is_test,
+                           param_attr=ParamAttr(name="vgg_fc1_bns"),
+                           bias_attr=ParamAttr(name="vgg_fc1_bnb"),
+                           moving_mean_name="vgg_fc1_bnm",
+                           moving_variance_name="vgg_fc1_bnv")
+    if not is_test:
+        bn = layers.dropout(bn, dropout_prob=0.5)
+    return layers.fc(bn, 4096, param_attr=ParamAttr(name="vgg_fc2_w"))
+
+
+def build_vgg_program(num_classes=10, image_shape=(3, 32, 32),
+                      batch_size=-1, lr=0.01, is_test=False,
+                      with_optimizer=True):
+    """cifar-10 classification step, the book fixture's training setup
+    (Adam in the reference's train(); SGD kept selectable via lr)."""
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        img = layers.static_data("pixel", [batch_size, *image_shape])
+        label = layers.static_data("label", [batch_size, 1], "int64")
+        feat = vgg16_backbone(img, is_test=is_test)
+        logits = layers.fc(feat, num_classes,
+                           param_attr=ParamAttr(name="vgg_out_w"))
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        acc = layers.accuracy(layers.softmax(logits), label)
+        if with_optimizer and not is_test:
+            from ..optimizer import AdamOptimizer
+
+            AdamOptimizer(lr).minimize(loss)
+    return main, startup, {"pixel": img, "label": label}, \
+        {"loss": loss, "acc": acc}
+
+
+def synthetic_batch(batch_size, image_shape=(3, 32, 32), num_classes=10,
+                    seed=0):
+    rng = np.random.RandomState(seed)
+    return {"pixel": rng.randn(batch_size, *image_shape).astype(np.float32),
+            "label": rng.randint(0, num_classes,
+                                 (batch_size, 1)).astype(np.int64)}

@@ -161,15 +161,19 @@ def ref_by_trainer_id(ins, attrs):
     """reference: distributed_ops/ref_by_trainer_id_op.cc — select this
     trainer's slice from a duplicable input list by TrainerId (the PS
     transpiler uses it to route per-trainer split grads)."""
-    import jax.numpy as jnp
-
     xs = ins["X"]
     tid = ins["TrainerId"][0]
-    i = int(np.asarray(tid).reshape(-1)[0]) if not hasattr(
-        tid, "aval") else None
-    if i is not None:
-        return {"Out": xs[i % len(xs)]}
-    # traced id: stack + dynamic index (uniform shapes required)
-    stacked = jnp.stack([jnp.asarray(x) for x in xs])
-    return {"Out": stacked[jnp.asarray(tid, jnp.int32).reshape(()) %
-                           len(xs)]}
+    try:
+        i = int(np.asarray(tid).reshape(-1)[0])
+    except Exception as e:   # traced id: list selection can't trace and
+        raise TypeError(      # split slices may have non-uniform shapes
+            "ref_by_trainer_id requires a concrete TrainerId (the "
+            "reference reads it from the trainer's env, not from "
+            "program dataflow)") from e
+    if not 0 <= i < len(xs):
+        # loud, like the reference's enforcement — a wrapped index
+        # would silently pick another trainer's slice
+        raise IndexError(
+            f"ref_by_trainer_id: TrainerId {i} out of range for "
+            f"{len(xs)} inputs")
+    return {"Out": xs[i]}
