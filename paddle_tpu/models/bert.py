@@ -260,6 +260,9 @@ def build_pretraining_program(cfg: BertConfig, seq_len: int = 128,
     Feeds: src_ids, sent_ids, pos_ids, input_mask [B,S];
            mask_labels [B,S] int64 (-0 where unmasked), mask_pos_weight [B,S]
            float 1.0 at masked positions; nsp_labels [B,1].
+    seq_len must fit the position table — an out-of-range position
+    gather would train on garbage rows (found as a NaN loss at
+    seq 2048 with the default 512-entry table).
     Fetches: loss (total), lm_loss, nsp_loss (0 when with_nsp=False).
 
     sequence_parallel=n (>1) builds the long-context SP variant: ring
@@ -280,6 +283,11 @@ def build_pretraining_program(cfg: BertConfig, seq_len: int = 128,
     (same trade the reference's GradientMergeOptimizer makes,
     optimizer.py:5025).
     """
+    if seq_len > cfg.max_position_embeddings:
+        raise ValueError(
+            f"seq_len {seq_len} exceeds max_position_embeddings "
+            f"{cfg.max_position_embeddings} — raise the config's table "
+            f"size for long-context runs")
     pp = int(pipeline_stages or 0)
     sp = int(sequence_parallel or 0)
     dp = int(data_parallel or 1)
