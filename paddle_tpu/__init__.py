@@ -14,6 +14,7 @@ Architecture (see SURVEY.md for the full blueprint):
 """
 
 from . import initializer, layers, optimizer, regularizer  # noqa: F401
+from . import clip  # noqa: F401
 from . import io  # noqa: F401
 from . import amp  # noqa: F401
 from . import contrib  # noqa: F401

@@ -1,0 +1,100 @@
+"""Gradient clipping (reference: python/paddle/fluid/clip.py —
+GradientClipByValue:186, GradientClipByNorm:261, and
+GradientClipByGlobalNorm:341; 2.0 re-exports them as nn.ClipGradBy*).
+
+Each class is a callable over params_grads, invoked by the Optimizer
+between backward() and apply_gradients() (optimizer/__init__.py), and
+dual-mode like every layer: ops append to the current program in static
+mode and execute eagerly under dygraph.guard.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+__all__ = ["GradientClipBase", "GradientClipByValue", "GradientClipByNorm",
+           "GradientClipByGlobalNorm", "ClipGradByValue", "ClipGradByNorm",
+           "ClipGradByGlobalNorm"]
+
+
+class GradientClipBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class GradientClipByValue(GradientClipBase):
+    """g <- clamp(g, min, max); min defaults to -max (reference
+    clip.py:186)."""
+
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def __call__(self, params_grads):
+        from . import layers
+
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, layers.clip(g, self.min, self.max)))
+        return out
+
+
+class GradientClipByNorm(GradientClipBase):
+    """Per-gradient L2 clip: g <- g * clip_norm / max(||g||, clip_norm)
+    (reference clip.py:261 — each grad clipped independently)."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        from . import layers
+
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            norm = layers.sqrt(layers.reduce_sum(layers.square(g)))
+            denom = layers.elementwise_max(
+                norm, layers.fill_constant([1], "float32", self.clip_norm))
+            out.append((p, g * (self.clip_norm / denom)))
+        return out
+
+
+class GradientClipByGlobalNorm(GradientClipBase):
+    """Joint clip: scale every grad by clip_norm / max(global_norm,
+    clip_norm) with global_norm = sqrt(sum_i ||g_i||^2) (reference
+    clip.py:341 — the transformer-training staple)."""
+
+    def __init__(self, clip_norm, group_name: str = "default_group"):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def __call__(self, params_grads):
+        from . import layers
+
+        sq_sums = [layers.reduce_sum(layers.square(g))
+                   for _, g in params_grads if g is not None]
+        if not sq_sums:
+            return list(params_grads)
+        total = sq_sums[0]
+        for s in sq_sums[1:]:
+            total = total + s
+        global_norm = layers.sqrt(total)
+        denom = layers.elementwise_max(
+            global_norm,
+            layers.fill_constant([1], "float32", self.clip_norm))
+        scale = self.clip_norm / denom
+        out = []
+        for p, g in params_grads:
+            out.append((p, g if g is None else g * scale))
+        return out
+
+
+# 2.0 names (python/paddle/nn/clip.py aliases)
+ClipGradByValue = GradientClipByValue
+ClipGradByNorm = GradientClipByNorm
+ClipGradByGlobalNorm = GradientClipByGlobalNorm

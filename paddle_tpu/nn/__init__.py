@@ -13,6 +13,8 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from ..clip import (ClipGradByGlobalNorm, ClipGradByNorm,  # noqa: F401
+                    ClipGradByValue)
 from ..dygraph.layers import Layer
 from ..initializer import Constant, Normal, Uniform, Xavier
 from . import functional
@@ -21,7 +23,7 @@ from . import functional as F
 __all__ = [
     "Layer", "Linear", "Conv2D", "Conv2DTranspose", "Embedding", "Dropout",
     "BatchNorm", "BatchNorm1D", "BatchNorm2D", "SyncBatchNorm", "LayerNorm",
-    "GroupNorm",
+    "GroupNorm", "ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm",
     "ReLU", "GELU", "Sigmoid", "Tanh", "Softmax", "LeakyReLU", "Hardswish",
     "Silu", "MaxPool2D", "AvgPool2D", "AdaptiveAvgPool2D", "AdaptiveMaxPool2D",
     "Flatten", "Pad2D", "Sequential", "LayerList", "ParameterList",
@@ -724,3 +726,412 @@ class TransformerEncoder(Layer):
         for layer in self.layers:
             x = layer(x, src_mask=src_mask)
         return x
+
+
+# -- round-4 surface batch: activations / misc / losses / cells / aliases
+# (reference: python/paddle/nn/__init__.py 2.0 export list) ------------------
+
+def _act_class(fn_name, **defaults):
+    """Layer class over a functional activation (reference
+    nn/layer/activation.py pattern)."""
+
+    class _Act(Layer):
+        def __init__(self, **kw):
+            super().__init__()
+            merged = dict(defaults)
+            merged.update({k: v for k, v in kw.items() if k != "name"})
+            self._kw = merged
+
+        def forward(self, x):
+            return getattr(F, fn_name)(x, **self._kw)
+
+    _Act.__name__ = fn_name
+    return _Act
+
+
+ELU = _act_class("elu")
+Hardshrink = _act_class("hardshrink")
+Hardsigmoid = _act_class("hardsigmoid")
+Hardtanh = _act_class("hardtanh")
+LogSigmoid = _act_class("log_sigmoid")
+ReLU6 = _act_class("relu6")
+SELU = _act_class("selu")
+Softplus = _act_class("softplus")
+Softshrink = _act_class("softshrink")
+Softsign = _act_class("softsign")
+Swish = _act_class("swish")
+Tanhshrink = _act_class("tanhshrink")
+ThresholdedReLU = _act_class("thresholded_relu")
+
+
+class LogSoftmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return F.log_softmax(x, axis=self._axis)
+
+
+class PReLU(Layer):
+    """reference: nn/layer/activation.py PReLU — learnable negative
+    slope ('all' one scalar, or per-channel)."""
+
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [num_parameters], attr=weight_attr,
+            default_initializer=Constant(init))
+        self._mode = "all" if num_parameters == 1 else "channel"
+
+    def forward(self, x):
+        return F.prelu(x, self.weight)
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor, name=None):
+        super().__init__()
+        self._r = int(upscale_factor)
+
+    def forward(self, x):
+        return F.pixel_shuffle(x, self._r)
+
+
+class CosineSimilarity(Layer):
+    def __init__(self, axis=1, eps=1e-8):
+        super().__init__()
+        self._axis, self._eps = axis, eps
+
+    def forward(self, x1, x2):
+        return F.cosine_similarity(x1, x2, axis=self._axis, eps=self._eps)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self._p, self._eps, self._keep = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self._p, self._eps, self._keep)
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0, name=None):
+        super().__init__()
+        self._kw = dict(size=size, alpha=alpha, beta=beta, k=k)
+
+    def forward(self, x):
+        return F.local_response_norm(x, **self._kw)
+
+
+class Dropout2D(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.dropout2d(x, self.p, training=self.training)
+
+
+class Dropout3D(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.dropout3d(x, self.p, training=self.training)
+
+
+class Bilinear(Layer):
+    """reference: nn/layer/common.py Bilinear over
+    bilinear_tensor_product."""
+
+    def __init__(self, in1_features, in2_features, out_features,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [out_features, in1_features, in2_features], attr=weight_attr)
+        self.bias = self.create_parameter([1, out_features], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x1, x2):
+        return F.bilinear(x1, x2, self.weight, self.bias)
+
+
+# -- losses -------------------------------------------------------------------
+
+class BCELoss(Layer):
+    """reference: nn/layer/loss.py BCELoss over bce_loss_op."""
+
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self._weight = weight
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        from ..nn.functional import _op
+
+        out = _op("bce_loss", {"X": input, "Label": label}, {})
+        if self._weight is not None:
+            out = out * self._weight
+        if self._reduction == "mean":
+            return _op("reduce_mean", {"X": out}, {"reduce_all": True})
+        if self._reduction == "sum":
+            return _op("reduce_sum", {"X": out}, {"reduce_all": True})
+        return out
+
+
+class MarginRankingLoss(Layer):
+    def __init__(self, margin=0.0, reduction="mean", name=None):
+        super().__init__()
+        self._margin, self._reduction = margin, reduction
+
+    def forward(self, input, other, label):
+        from ..nn.functional import _op
+
+        out = _op("margin_rank_loss", {"X1": input, "X2": other,
+                                       "Label": label},
+                  {"margin": float(self._margin)})
+        if self._reduction == "mean":
+            return _op("reduce_mean", {"X": out}, {"reduce_all": True})
+        if self._reduction == "sum":
+            return _op("reduce_sum", {"X": out}, {"reduce_all": True})
+        return out
+
+
+class CTCLoss(Layer):
+    """reference: nn/layer/loss.py CTCLoss over warpctc_op (here the
+    native XLA lattice via optax — ops/extra_ops2.py)."""
+
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self._blank, self._reduction = blank, reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths):
+        from ..nn.functional import _op
+
+        out = _op("warpctc",
+                  {"Logits": log_probs, "Label": labels,
+                   "LogitsLength": input_lengths,
+                   "LabelLength": label_lengths},
+                  {"blank": int(self._blank)}, out_slot="Loss")
+        if self._reduction == "mean":
+            # reference ctc_loss: mean of per-sample loss / label_length
+            ll = _op("cast", {"X": label_lengths},
+                     {"out_dtype": "float32"})
+            ll = _op("reshape2", {"X": ll}, {"shape": [-1, 1]})
+            flat = _op("reshape2", {"X": out}, {"shape": [-1, 1]})
+            return _op("reduce_mean", {"X": flat / ll},
+                       {"reduce_all": True})
+        if self._reduction == "sum":
+            return _op("reduce_sum", {"X": out}, {"reduce_all": True})
+        return out
+
+
+# -- RNN cells (reference: nn/layer/rnn.py) ----------------------------------
+
+class SimpleRNNCell(Layer):
+    """h' = act(x W^T + h U^T + b_ih + b_hh)."""
+
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.weight_ih = self.create_parameter([input_size, hidden_size],
+                                               attr=weight_ih_attr)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size],
+                                               attr=weight_hh_attr)
+        self.bias_ih = self.create_parameter([hidden_size], is_bias=True)
+        self.bias_hh = self.create_parameter([hidden_size], is_bias=True)
+        self._act = activation
+
+    def forward(self, inputs, states=None):
+        from ..nn.functional import _op
+
+        if states is None:
+            states = _op("fill_constant_batch_size_like",
+                         {"Input": inputs},
+                         {"shape": [-1, self.hidden_size], "value": 0.0,
+                          "dtype": str(inputs.dtype)})
+        pre = F.linear(inputs, self.weight_ih, self.bias_ih) + \
+            F.linear(states, self.weight_hh, self.bias_hh)
+        h = getattr(F, self._act)(pre)
+        return h, h
+
+
+class LSTMCell(Layer):
+    """One lstm_unit step (reference nn/layer/rnn.py LSTMCell; gate
+    order i,f,c,o per math/lstm_compute)."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.weight_ih = self.create_parameter(
+            [input_size, 4 * hidden_size], attr=weight_ih_attr)
+        self.weight_hh = self.create_parameter(
+            [hidden_size, 4 * hidden_size], attr=weight_hh_attr)
+        self.bias = self.create_parameter([4 * hidden_size], is_bias=True)
+
+    def forward(self, inputs, states=None):
+        from ..nn.functional import _op
+
+        if states is None:
+            z = _op("fill_constant_batch_size_like", {"Input": inputs},
+                    {"shape": [-1, self.hidden_size], "value": 0.0,
+                     "dtype": str(inputs.dtype)})
+            states = (z, z)
+        h_prev, c_prev = states
+        gates = F.linear(inputs, self.weight_ih, self.bias) + \
+            F.linear(h_prev, self.weight_hh)
+        from ..core.ir import in_dygraph_mode
+
+        if in_dygraph_mode():
+            from ..dygraph.tracer import trace_op
+
+            outs = trace_op("lstm_unit", {"X": gates, "C_prev": c_prev},
+                            {"forget_bias": 0.0})
+            h, c = outs["H"][0], outs["C"][0]
+        else:
+            from ..nn.functional import _static_op
+
+            h, c = _static_op("lstm_unit",
+                              {"X": [gates], "C_prev": [c_prev]},
+                              {"forget_bias": 0.0}, out_slots=("H", "C"))
+        return h, (h, c)
+
+
+class GRUCell(Layer):
+    """One gru_unit step (reference nn/layer/rnn.py GRUCell)."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.weight_ih = self.create_parameter(
+            [input_size, 3 * hidden_size], attr=weight_ih_attr)
+        self.weight_hh = self.create_parameter(
+            [hidden_size, 3 * hidden_size], attr=weight_hh_attr)
+        self.bias = self.create_parameter([3 * hidden_size], is_bias=True)
+
+    def forward(self, inputs, states=None):
+        from ..core.ir import in_dygraph_mode
+        from ..nn.functional import _op, _static_op
+
+        if states is None:
+            states = _op("fill_constant_batch_size_like",
+                         {"Input": inputs},
+                         {"shape": [-1, self.hidden_size], "value": 0.0,
+                          "dtype": str(inputs.dtype)})
+        xp = F.linear(inputs, self.weight_ih, self.bias)
+        if in_dygraph_mode():
+            from ..dygraph.tracer import trace_op
+
+            outs = trace_op("gru_unit",
+                            {"Input": [xp], "HiddenPrev": [states],
+                             "Weight": [self.weight_hh], "Bias": [None]},
+                            {})
+            h = outs["Hidden"][0]
+        else:
+            h = _static_op("gru_unit",
+                           {"Input": [xp], "HiddenPrev": [states],
+                            "Weight": [self.weight_hh]},
+                           {}, out_slots=("Hidden",))
+        return h, h
+
+
+# -- 2.0rc lowercase / naming aliases (reference exported both) --------------
+
+Conv2d = Conv2D
+ConvTranspose2d = Conv2DTranspose
+BatchNorm1d = BatchNorm1D
+BatchNorm2d = BatchNorm2D
+InstanceNorm2d = InstanceNorm2D
+MaxPool2d = MaxPool2D
+AvgPool2d = AvgPool2D
+AdaptiveAvgPool2d = AdaptiveAvgPool2D
+AdaptiveMaxPool2d = AdaptiveMaxPool2D
+Dropout2d = Dropout2D
+Dropout3d = Dropout3D
+
+
+class HSigmoidLoss(Layer):
+    """reference: nn/layer/loss.py HSigmoidLoss over
+    hierarchical_sigmoid_op."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        self.num_classes = num_classes
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size], attr=weight_attr)
+        self.bias = self.create_parameter([num_classes - 1, 1],
+                                          attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label):
+        from ..core.ir import in_dygraph_mode
+        from ..nn.functional import _op, _static_op
+
+        ins = {"X": [input], "W": [self.weight], "Bias": [self.bias],
+               "Label": [label]}
+        if in_dygraph_mode():
+            from ..dygraph.tracer import trace_op
+
+            ins = dict(ins, PathTable=[None], PathCode=[None])
+            return trace_op("hierarchical_sigmoid", ins,
+                            {"num_classes": self.num_classes})["Out"][0]
+        return _static_op("hierarchical_sigmoid", ins,
+                          {"num_classes": self.num_classes},
+                          out_slots=("Out",))
+
+
+class ZeroPad2d(Layer):
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__()
+        self._pad = Pad2D(padding, mode="constant", value=0.0,
+                          data_format=data_format)
+
+    def forward(self, x):
+        return self._pad(x)
+
+
+class ConstantPad2d(Layer):
+    def __init__(self, padding, value=0.0, data_format="NCHW", name=None):
+        super().__init__()
+        self._pad = Pad2D(padding, mode="constant", value=value,
+                          data_format=data_format)
+
+    def forward(self, x):
+        return self._pad(x)
+
+
+class UpsamplingNearest2d(Layer):
+    def __init__(self, size=None, scale_factor=None, name=None):
+        super().__init__()
+        self._up = Upsample(size=size, scale_factor=scale_factor,
+                            mode="nearest")
+
+    def forward(self, x):
+        return self._up(x)
+
+
+class UpsamplingBilinear2d(Layer):
+    def __init__(self, size=None, scale_factor=None, name=None):
+        super().__init__()
+        self._up = Upsample(size=size, scale_factor=scale_factor,
+                            mode="bilinear", align_corners=True)
+
+    def forward(self, x):
+        return self._up(x)
+
+
+__all__ += [
+    "ELU", "Hardshrink", "Hardsigmoid", "Hardtanh", "LogSigmoid",
+    "LogSoftmax", "PReLU", "ReLU6", "SELU", "Softplus", "Softshrink",
+    "Softsign", "Swish", "Tanhshrink", "ThresholdedReLU", "PixelShuffle",
+    "CosineSimilarity", "PairwiseDistance", "LocalResponseNorm",
+    "Dropout2D", "Dropout3D", "Bilinear", "BCELoss", "MarginRankingLoss",
+    "CTCLoss", "HSigmoidLoss", "SimpleRNNCell", "LSTMCell", "GRUCell",
+    "ZeroPad2d", "UpsamplingNearest2d", "UpsamplingBilinear2d",
+]

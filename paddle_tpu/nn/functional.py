@@ -212,8 +212,11 @@ def hardswish(x, name=None):
     return _op("hard_swish", {"X": x}, {})
 
 
-def hardsigmoid(x, name=None):
-    return _op("hard_sigmoid", {"X": x}, {})
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    # 2.0 reference slope is 1/6 (nn/functional/activation.py); the op
+    # default (0.2) is the fluid hard_sigmoid
+    return _op("hard_sigmoid", {"X": x}, {"slope": slope,
+                                          "offset": offset})
 
 
 def softmax(x, axis=-1, name=None):
@@ -499,3 +502,121 @@ def _normalize_impl(x, p, axis, epsilon):
     from .. import layers
 
     return layers.l2_normalize(x, axis=axis, epsilon=epsilon)
+
+
+# -- round-4 activation / misc functional batch (2.0 API surface:
+# python/paddle/nn/functional/activation.py etc.) ---------------------------
+
+def _unary_op(op_type, x, attrs=None, out_slot="Out"):
+    return _op(op_type, {"X": x}, attrs or {}, out_slot=out_slot)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return _unary_op("selu", x, {"scale": scale, "alpha": alpha})
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return _unary_op("hard_shrink", x, {"threshold": threshold})
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return _unary_op("brelu", x, {"t_min": float(min), "t_max": float(max)})
+
+
+def log_sigmoid(x, name=None):
+    return _unary_op("logsigmoid", x)
+
+
+def relu6(x, name=None):
+    return _unary_op("relu6", x, {"threshold": 6.0})
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return _unary_op("softplus", x, {"beta": beta, "threshold": threshold})
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return _unary_op("softshrink", x, {"lambda": threshold})
+
+
+def softsign(x, name=None):
+    return _unary_op("softsign", x)
+
+
+def tanhshrink(x, name=None):
+    return _unary_op("tanh_shrink", x)
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return _unary_op("thresholded_relu", x, {"threshold": threshold})
+
+
+def pixel_shuffle(x, upscale_factor, name=None):
+    return _unary_op("pixel_shuffle", x, {"upscale_factor": int(upscale_factor)})
+
+
+def local_response_norm(x, size=5, alpha=1e-4, beta=0.75, k=1.0, name=None):
+    return _unary_op("lrn", x, {"n": int(size), "alpha": alpha, "beta": beta,
+                             "k": k})
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    """reference: nn/functional/common.py cosine_similarity — composed
+    from reduction ops (XLA fuses the chain)."""
+    def rsum(v):
+        return _op("reduce_sum", {"X": v}, {"dim": [axis]})
+
+    dot = rsum(x1 * x2)
+    n1 = sqrt(rsum(square(x1)))
+    n2 = sqrt(rsum(square(x2)))
+    eps_t = _op("fill_constant", {}, {"shape": [1], "value": eps,
+                                      "dtype": str(x1.dtype)})
+    denom = _op("elementwise_max", {"X": n1 * n2, "Y": eps_t}, {})
+    return dot / denom
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False):
+    """reference: nn/layer/distance.py PairwiseDistance."""
+    d = x - y
+
+    def rsum(v):
+        return _op("reduce_sum", {"X": v},
+                   {"dim": [-1], "keep_dim": keepdim})
+
+    if p == 2.0:
+        return sqrt(rsum(square(d)) + epsilon)
+    ad = _op("abs", {"X": d}, {}) + epsilon
+    s = rsum(_op("pow", {"X": ad}, {"factor": float(p)}))
+    return _op("pow", {"X": s}, {"factor": 1.0 / float(p)})
+
+
+def dropout2d(x, p=0.5, training=True, name=None):
+    """Channel-wise dropout (reference nn/functional/common.py
+    dropout2d): one Bernoulli per (N, C), broadcast over HxW — built
+    from the dropout op on a [N, C, 1, 1] mask source."""
+    if not training or p == 0.0:
+        return x
+    ones = _op("fill_constant_batch_size_like", {"Input": x},
+               {"shape": [-1, int(x.shape[1]), 1, 1], "value": 1.0,
+                "dtype": str(x.dtype)})
+    mask = dropout(ones, p=p, training=True)
+    return x * mask
+
+
+def dropout3d(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    ones = _op("fill_constant_batch_size_like", {"Input": x},
+               {"shape": [-1, int(x.shape[1]), 1, 1, 1], "value": 1.0,
+                "dtype": str(x.dtype)})
+    mask = dropout(ones, p=p, training=True)
+    return x * mask
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """reference: nn/functional/common.py bilinear over
+    bilinear_tensor_product_op.cc."""
+    ins = {"X": x1, "Y": x2, "Weight": weight}
+    if bias is not None:
+        ins["Bias"] = bias
+    return _op("bilinear_tensor_product", ins, {})

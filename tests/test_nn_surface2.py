@@ -1,0 +1,231 @@
+"""Round-4 nn surface batch: gradient clipping, activation layers,
+cells, losses, misc layers (reference: python/paddle/nn 2.0 exports)."""
+
+import numpy as np
+import pytest
+
+
+class TestGradClip:
+    def _train_one(self, clip):
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.static_data("x", [4, 6])
+            w = layers.create_parameter([6, 1], "float32", name="gc_w")
+            loss = layers.mean(layers.matmul(x, w) * 100.0)  # big grads
+            opt = pt.optimizer.SGDOptimizer(1.0, grad_clip=clip)
+            opt.minimize(loss)
+        exe = pt.Executor()
+        scope = pt.Scope()
+        exe.run(startup, scope=scope, use_compiled=False)
+        w0 = np.asarray(scope.find_var("gc_w")).copy()
+        feed = {"x": np.random.RandomState(0).randn(4, 6).astype(
+            np.float32)}
+        exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+        w1 = np.asarray(scope.find_var("gc_w"))
+        g_applied = (w0 - w1) / 1.0            # lr 1.0 SGD
+        g_raw = feed["x"].mean(0).reshape(6, 1) * 100.0 / 1.0
+        return g_applied, g_raw
+
+    def test_by_global_norm(self):
+        from paddle_tpu.clip import GradientClipByGlobalNorm
+
+        g, raw = self._train_one(GradientClipByGlobalNorm(0.5))
+        raw_norm = np.linalg.norm(raw)
+        want = raw * (0.5 / max(raw_norm, 0.5))
+        np.testing.assert_allclose(g, want, rtol=1e-4)
+        assert np.linalg.norm(g) <= 0.5 * 1.001
+
+    def test_by_norm(self):
+        from paddle_tpu.clip import GradientClipByNorm
+
+        g, raw = self._train_one(GradientClipByNorm(1.0))
+        np.testing.assert_allclose(
+            g, raw / max(np.linalg.norm(raw), 1.0), rtol=1e-4)
+
+    def test_by_value(self):
+        from paddle_tpu.clip import GradientClipByValue
+
+        g, raw = self._train_one(GradientClipByValue(0.25))
+        np.testing.assert_allclose(g, np.clip(raw, -0.25, 0.25), rtol=1e-4)
+
+    def test_nn_aliases(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.clip import GradientClipByGlobalNorm
+
+        assert nn.ClipGradByGlobalNorm is GradientClipByGlobalNorm
+
+
+class TestActivationLayers:
+    CASES = [
+        ("ELU", {}, lambda v: np.where(v > 0, v, np.expm1(v))),
+        ("Hardtanh", {}, lambda v: np.clip(v, -1, 1)),
+        ("ReLU6", {}, lambda v: np.clip(v, 0, 6)),
+        ("SELU", {}, lambda v: np.where(
+            v > 0, 1.0507009873554805 * v,
+            1.0507009873554805 * 1.6732632423543772 * np.expm1(v))),
+        ("Softsign", {}, lambda v: v / (1 + np.abs(v))),
+        ("Tanhshrink", {}, lambda v: v - np.tanh(v)),
+        ("LogSigmoid", {}, lambda v: -np.log1p(np.exp(-v))),
+        ("Softshrink", {}, lambda v: np.where(
+            v > 0.5, v - 0.5, np.where(v < -0.5, v + 0.5, 0))),
+        ("Hardshrink", {}, lambda v: np.where(np.abs(v) > 0.5, v, 0)),
+        ("ThresholdedReLU", {}, lambda v: np.where(v > 1.0, v, 0)),
+        ("Hardsigmoid", {},
+         lambda v: np.clip(v / 6.0 + 0.5, 0, 1)),       # 2.0 slope 1/6
+    ]
+
+    @pytest.mark.parametrize("name,kw,ref", CASES)
+    def test_matches_numpy(self, name, kw, ref):
+        import paddle_tpu as pt
+        import paddle_tpu.nn as nn
+
+        with pt.dygraph.guard():
+            x = np.linspace(-3, 3, 24).reshape(4, 6).astype(np.float32)
+            layer = getattr(nn, name)(**kw)
+            got = np.asarray(layer(pt.to_tensor(x)))
+            np.testing.assert_allclose(got, ref(x.astype(np.float64)),
+                                       rtol=2e-5, atol=1e-6, err_msg=name)
+
+    def test_log_softmax_prelu(self):
+        import paddle_tpu as pt
+        import paddle_tpu.nn as nn
+
+        with pt.dygraph.guard():
+            x = np.random.RandomState(0).randn(3, 5).astype(np.float32)
+            ls = np.asarray(nn.LogSoftmax(axis=-1)(pt.to_tensor(x)))
+            ref = x - np.log(np.exp(x).sum(-1, keepdims=True))
+            np.testing.assert_allclose(ls, ref, rtol=2e-5, atol=1e-6)
+            pr = nn.PReLU(init=0.3)
+            got = np.asarray(pr(pt.to_tensor(x)))
+            np.testing.assert_allclose(got, np.where(x >= 0, x, 0.3 * x),
+                                       rtol=1e-5)
+
+
+class TestCellsAndLosses:
+    def test_lstm_cell_step(self):
+        import paddle_tpu as pt
+        import paddle_tpu.nn as nn
+
+        with pt.dygraph.guard():
+            cell = nn.LSTMCell(6, 4)
+            x = pt.to_tensor(np.random.RandomState(1).randn(3, 6).astype(
+                np.float32))
+            h, (h2, c) = cell(x)
+            assert tuple(h.shape) == (3, 4) and tuple(c.shape) == (3, 4)
+            h3, (h4, c2) = cell(x, (h2, c))     # second step with state
+            assert not np.allclose(np.asarray(h3), np.asarray(h))
+
+    def test_gru_and_simple_cells(self):
+        import paddle_tpu as pt
+        import paddle_tpu.nn as nn
+
+        with pt.dygraph.guard():
+            x = pt.to_tensor(np.random.RandomState(2).randn(3, 6).astype(
+                np.float32))
+            for cell in (nn.GRUCell(6, 4), nn.SimpleRNNCell(6, 4)):
+                h, st = cell(x)
+                assert tuple(h.shape) == (3, 4)
+
+    def test_bce_and_margin_losses(self):
+        import paddle_tpu as pt
+        import paddle_tpu.nn as nn
+
+        with pt.dygraph.guard():
+            rng = np.random.RandomState(3)
+            p = pt.to_tensor(rng.rand(4, 1).astype(np.float32) * 0.8 + 0.1)
+            y = pt.to_tensor((rng.rand(4, 1) > 0.5).astype(np.float32))
+            out = float(np.asarray(nn.BCELoss()(p, y)))
+            pn, yn = np.asarray(p), np.asarray(y)
+            want = float(np.mean(-(yn * np.log(pn)
+                                   + (1 - yn) * np.log(1 - pn))))
+            assert abs(out - want) < 1e-5
+            a = pt.to_tensor(rng.randn(4, 1).astype(np.float32))
+            b = pt.to_tensor(rng.randn(4, 1).astype(np.float32))
+            lab = pt.to_tensor(np.sign(rng.randn(4, 1)).astype(np.float32))
+            out = float(np.asarray(nn.MarginRankingLoss(0.1)(a, b, lab)))
+            want = float(np.mean(np.maximum(
+                0, -np.asarray(lab) * (np.asarray(a) - np.asarray(b))
+                + 0.1)))
+            assert abs(out - want) < 1e-5
+
+
+class TestMiscLayers:
+    def test_pixel_shuffle_and_pads(self):
+        import paddle_tpu as pt
+        import paddle_tpu.nn as nn
+
+        with pt.dygraph.guard():
+            x = pt.to_tensor(np.arange(16, dtype=np.float32).reshape(
+                1, 4, 2, 2))
+            y = np.asarray(nn.PixelShuffle(2)(x))
+            assert y.shape == (1, 1, 4, 4)
+            z = np.asarray(nn.ZeroPad2d(1)(pt.to_tensor(
+                np.ones((1, 1, 2, 2), np.float32))))
+            assert z.shape == (1, 1, 4, 4) and z[0, 0, 0, 0] == 0
+
+    def test_cosine_pairwise(self):
+        import paddle_tpu as pt
+        import paddle_tpu.nn as nn
+
+        with pt.dygraph.guard():
+            a = pt.to_tensor(np.eye(3, 4).astype(np.float32))
+            b = pt.to_tensor(np.eye(3, 4).astype(np.float32))
+            cs = np.asarray(nn.CosineSimilarity(axis=1)(a, b))
+            np.testing.assert_allclose(cs, np.ones(3), rtol=1e-5)
+            pd = np.asarray(nn.PairwiseDistance()(a, b))
+            np.testing.assert_allclose(pd, np.full(3, 1e-3), atol=1e-3)
+
+    def test_dropout2d_eval_identity(self):
+        import paddle_tpu as pt
+        import paddle_tpu.nn as nn
+
+        with pt.dygraph.guard():
+            d = nn.Dropout2D(0.9)
+            d.eval()
+            x = pt.to_tensor(np.ones((2, 3, 2, 2), np.float32))
+            np.testing.assert_array_equal(np.asarray(d(x)),
+                                          np.ones((2, 3, 2, 2)))
+
+
+def test_hsigmoid_loss_static_mode():
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    import paddle_tpu.nn as nn
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.static_data("x", [4, 8])
+        lab = layers.static_data("lab", [4, 1], "int64")
+        hs = nn.HSigmoidLoss(8, 6)
+        out = hs(x, lab)
+        loss = layers.mean(out)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    exe.run(startup, scope=scope, use_compiled=False)
+    rng = np.random.RandomState(0)
+    r = exe.run(main, feed={"x": rng.randn(4, 8).astype(np.float32),
+                            "lab": rng.randint(0, 6, (4, 1)).astype(
+                                np.int64)},
+                fetch_list=[loss], scope=scope)
+    assert np.isfinite(float(np.asarray(r[0]).reshape(-1)[0]))
+
+
+def test_ctc_loss_mean_weights_by_label_length():
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+
+    with pt.dygraph.guard():
+        rng = np.random.RandomState(4)
+        logp = pt.to_tensor(rng.randn(2, 6, 5).astype(np.float32))
+        labels = pt.to_tensor(np.array([[1, 2, 0], [1, 2, 3]], np.int64))
+        in_len = pt.to_tensor(np.array([6, 6], np.int64))
+        lab_len = pt.to_tensor(np.array([2, 3], np.int64))
+        mean_loss = float(np.asarray(nn.CTCLoss(reduction="mean")(
+            logp, labels, in_len, lab_len)))
+        none_loss = np.asarray(nn.CTCLoss(reduction="none")(
+            logp, labels, in_len, lab_len)).reshape(-1)
+        want = float(np.mean(none_loss / np.array([2.0, 3.0])))
+        assert abs(mean_loss - want) < 1e-5
