@@ -373,6 +373,82 @@ def _seed_spec(pl, pltpu):
     return pl.BlockSpec((1,), lambda *_: (0,), memory_space=pltpu.SMEM)
 
 
+def _fused_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref,
+                      lse_ref, *, scale, causal, rate=0.0, n_heads=1,
+                      sq_g=1, sk_g=1):
+    """Single-block forward: whole (Sq, Sk) row in VMEM → direct softmax,
+    no online-softmax scratch/bookkeeping (measured 2.85 ms/layer of pure
+    overhead vs this kernel on the ERNIE geometry — the m/l/acc scratch
+    machinery is dead weight when one k block covers the row)."""
+    from jax.experimental import pallas as pl
+
+    q = q_ref[0]                               # (sq, d) native dtype
+    k = k_ref[0]                               # (sk, d)
+    v = v_ref[0]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if bias_ref is not None:
+        s = s + bias_ref[0, 0].astype(jnp.float32)[None, :]
+    sq_n, sk_n = s.shape
+    if causal:
+        rows = (sk_n - sq_n) + jax.lax.broadcasted_iota(
+            jnp.int32, (sq_n, sk_n), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (sq_n, sk_n), 1)
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)                         # (sq, sk) f32
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    if rate > 0.0:
+        p = p * _keep_scale_tile(seed_ref[0], rate, pl.program_id(0),
+                                 n_heads, 0, 0, sq_n, sk_n, sq_g, sk_g)
+    ln = jnp.where(l == 0.0, 1.0, l)           # fully-masked rows → 0 out
+    acc = jax.lax.dot(p.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32)
+    o_ref[0] = (acc / ln).astype(o_ref.dtype)
+    lse_ref[0, 0] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, 0]
+
+
+def _fwd_pallas_fused(q, k, v, bias_kv, causal, scale, interpret,
+                      seed=None, rate=0.0):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bh = b * h
+    q3 = q.reshape(bh, sq, d)
+    k3 = k.reshape(bh, sk, d)
+    v3 = v.reshape(bh, sk, d)
+    seed_arr = jnp.asarray([0 if seed is None else seed], jnp.uint32)
+    in_specs = [
+        pl.BlockSpec((1, sq, d), lambda bi: (bi, 0, 0)),
+        pl.BlockSpec((1, sk, d), lambda bi: (bi, 0, 0)),
+        pl.BlockSpec((1, sk, d), lambda bi: (bi, 0, 0)),
+    ]
+    args = [q3, k3, v3]
+    kw = dict(scale=scale, causal=causal, rate=rate, n_heads=h,
+              sq_g=sq, sk_g=sk)
+    if bias_kv is not None:
+        in_specs.append(pl.BlockSpec(
+            (1, 1, sk), lambda bi, _h=h: (bi // _h, 0, 0)))
+        args.append(bias_kv.reshape(bias_kv.shape[0], 1, bias_kv.shape[1]))
+        kernel = functools.partial(_fused_fwd_kernel, **kw)
+    else:
+        def kernel(q, k, v, seed, o, lse):
+            _fused_fwd_kernel(q, k, v, None, seed, o, lse, **kw)
+    in_specs.append(_seed_spec(pl, pltpu))
+    args.append(seed_arr)
+    o3, lse = pl.pallas_call(
+        kernel, grid=(bh,),
+        in_specs=in_specs,
+        out_specs=[pl.BlockSpec((1, sq, d), lambda bi: (bi, 0, 0)),
+                   pl.BlockSpec((1, 1, sq), lambda bi: (bi, 0, 0))],
+        out_shape=[jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+                   jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32)],
+        interpret=interpret)(*args)
+    return o3.reshape(b, h, sq, d), lse.reshape(b, h, sq)
+
+
 def _fwd_pallas(q, k, v, bias_kv, causal, scale, interpret,
                 seed=None, rate=0.0):
     from jax.experimental import pallas as pl
@@ -380,6 +456,9 @@ def _fwd_pallas(q, k, v, bias_kv, causal, scale, interpret,
 
     b, h, sq, d = q.shape
     sk = k.shape[2]
+    if _fused_bwd_applies(sq, sk):
+        return _fwd_pallas_fused(q, k, v, bias_kv, causal, scale,
+                                 interpret, seed, rate)
     bq = _pick_block(sq, DEFAULT_BLOCK_Q)
     bk = _pick_block(sk, DEFAULT_BLOCK_K)
     bh = b * h
@@ -552,6 +631,137 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
+def _fused_bwd_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, bias_ref,
+                      seed_ref, dq_ref, dk_ref, dv_ref, dbias_ref, *,
+                      scale, causal, rate=0.0, n_heads=1, sq_g=1, sk_g=1):
+    """Single-block backward: the whole (Sq, Sk) tile of one (b, h) pair
+    lives in VMEM, so dq/dk/dv come out of ONE kernel with ONE scores
+    recompute — no lse two-pass, no f32 HBM accumulators, no O(S^2)
+    HBM traffic. This is the profile-driven fix for the north-star step:
+    the XLA chunked-recompute backward's scan carried full-size f32
+    dk/dv accumulators through HBM every chunk (~7.5 ms/layer measured;
+    tools/profile_ernie.py); at S<=512 everything fits on-chip."""
+    from jax.experimental import pallas as pl
+
+    q = q_ref[0]                              # (sq, d) native dtype
+    k = k_ref[0]                              # (sk, d)
+    v = v_ref[0]
+    do = do_ref[0]                            # (sq, d)
+    o = o_ref[0]
+    lse = lse_ref[0, 0][:, None]              # (sq, 1) f32
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)   # (sq, 1)
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if bias_ref is not None:
+        s = s + bias_ref[0, 0].astype(jnp.float32)[None, :]
+    sq_n, sk_n = s.shape
+    if causal:
+        rows = (sk_n - sq_n) + jax.lax.broadcasted_iota(
+            jnp.int32, (sq_n, sk_n), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (sq_n, sk_n), 1)
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    p = jnp.exp(s - lse)                      # (sq, sk) f32
+    if rate > 0.0:
+        mt = _keep_scale_tile(seed_ref[0], rate, pl.program_id(0), n_heads,
+                              0, 0, sq_n, sk_n, sq_g, sk_g)
+        pd_ = p * mt
+    else:
+        mt, pd_ = None, p
+    dv_ref[0] = jax.lax.dot_general(
+        pd_.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    if mt is not None:
+        dp = dp * mt
+    ds_nos = p * (dp - delta)                 # cotangent of post-bias logits
+    if dbias_ref is not None:
+        dbias_ref[0, 0] = jnp.sum(ds_nos, axis=0)
+    ds = (ds_nos * scale).astype(q.dtype)     # (sq, sk) bf16
+    dq_ref[0] = jax.lax.dot(ds, k,
+                            preferred_element_type=jnp.float32
+                            ).astype(dq_ref.dtype)
+    dk_ref[0] = jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dk_ref.dtype)
+
+
+# Fused single-block backward applies when one (Sq, Sk) f32 tile fits
+# comfortably in VMEM next to its ~4 same-size f32/bf16 intermediates
+# (v5e ~16 MB/core; 512x512 f32 = 1 MB).
+FUSED_BWD_MAX_SCORES_BYTES = 1 << 20
+
+
+def _fused_bwd_applies(sq, sk):
+    return (_pick_block(sq, DEFAULT_BLOCK_Q) == sq
+            and _pick_block(sk, DEFAULT_BLOCK_K) == sk
+            and 4 * sq * sk <= FUSED_BWD_MAX_SCORES_BYTES)
+
+
+def _bwd_pallas_fused(q, k, v, bias_kv, causal, scale, interpret, o, lse,
+                      do, seed=None, rate=0.0):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bh = b * h
+    q3, k3, v3 = (t.reshape(bh, t.shape[2], d) for t in (q, k, v))
+    do3 = do.reshape(bh, sq, d)
+    o3 = o.reshape(bh, sq, d)
+    lse3 = lse.reshape(bh, 1, sq)
+    seed_arr = jnp.asarray([0 if seed is None else seed], jnp.uint32)
+    has_bias = bias_kv is not None
+
+    in_specs = [
+        pl.BlockSpec((1, sq, d), lambda bi: (bi, 0, 0)),
+        pl.BlockSpec((1, sk, d), lambda bi: (bi, 0, 0)),
+        pl.BlockSpec((1, sk, d), lambda bi: (bi, 0, 0)),
+        pl.BlockSpec((1, sq, d), lambda bi: (bi, 0, 0)),
+        pl.BlockSpec((1, sq, d), lambda bi: (bi, 0, 0)),
+        pl.BlockSpec((1, 1, sq), lambda bi: (bi, 0, 0)),
+    ]
+    args = [q3, k3, v3, do3, o3, lse3]
+    kw = dict(scale=scale, causal=causal, rate=rate, n_heads=h,
+              sq_g=sq, sk_g=sk)
+    out_specs = [pl.BlockSpec((1, sq, d), lambda bi: (bi, 0, 0)),
+                 pl.BlockSpec((1, sk, d), lambda bi: (bi, 0, 0)),
+                 pl.BlockSpec((1, sk, d), lambda bi: (bi, 0, 0))]
+    out_shape = [jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+                 jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+                 jax.ShapeDtypeStruct((bh, sk, d), v.dtype)]
+    if has_bias:
+        bias3 = bias_kv.reshape(bias_kv.shape[0], 1, bias_kv.shape[1])
+        in_specs.append(pl.BlockSpec((1, 1, sk),
+                                     lambda bi, _h=h: (bi // _h, 0, 0)))
+        args.append(bias3)
+        in_specs.append(_seed_spec(pl, pltpu))
+        args.append(seed_arr)
+        out_specs.append(pl.BlockSpec((1, 1, sk), lambda bi: (bi, 0, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((bh, 1, sk), jnp.float32))
+        kernel = functools.partial(_fused_bwd_kernel, **kw)
+    else:
+        in_specs.append(_seed_spec(pl, pltpu))
+        args.append(seed_arr)
+
+        def kernel(q, k, v, do, o, lse, seed, dq, dk, dv):
+            _fused_bwd_kernel(q, k, v, do, o, lse, None, seed,
+                              dq, dk, dv, None, **kw)
+    outs = pl.pallas_call(
+        kernel, grid=(bh,), in_specs=in_specs, out_specs=out_specs,
+        out_shape=out_shape, interpret=interpret)(*args)
+    if has_bias:
+        dq3, dk3, dv3, dbias3 = outs
+        dbias = jnp.sum(dbias3.reshape(b, h, sk), axis=1)
+    else:
+        dq3, dk3, dv3 = outs
+        dbias = None
+    return (dq3.reshape(q.shape), dk3.reshape(k.shape),
+            dv3.reshape(v.shape), dbias)
+
+
 def _bwd_pallas(q, k, v, bias_kv, causal, scale, interpret, o, lse, do,
                 seed=None, rate=0.0):
     from jax.experimental import pallas as pl
@@ -559,6 +769,9 @@ def _bwd_pallas(q, k, v, bias_kv, causal, scale, interpret, o, lse, do,
 
     b, h, sq, d = q.shape
     sk = k.shape[2]
+    if _fused_bwd_applies(sq, sk):
+        return _bwd_pallas_fused(q, k, v, bias_kv, causal, scale,
+                                 interpret, o, lse, do, seed, rate)
     bq = _pick_block(sq, DEFAULT_BLOCK_Q)
     bk = _pick_block(sk, DEFAULT_BLOCK_K)
     bh = b * h
@@ -727,13 +940,22 @@ def _pad_head_dim(x, target):
     return jnp.pad(x, pad)
 
 
-# v5e measurements (tools/bench_attention.py, slope timing, d=64):
-#   s=512:  xla-recompute 3.5 ms f+b vs pallas 9.1 ms  -> xla wins 2.6x
-#   s=2048: xla-recompute 9.4 ms f+b vs pallas 15.3 ms -> xla wins 1.6x
+# v5e measurements (tools/bench_attention.py, slope timing, d=64, dropout
+# 0.1, grads taken wrt q AND k AND v — an earlier q-only grad let XLA DCE
+# the chunked path's dk/dv accumulator scan and under-measured its
+# backward 2.7x, mis-routing the ERNIE geometry until round 4):
+#   s=512  b34:  pallas(fused 1-block bwd) 2.95 ms f+b vs xla-rcmp 8.87
+#                -> pallas wins 3.0x (the xla scan drags f32 [B,H,S,D]
+#                   dk/dv accumulators through HBM every chunk)
+#   s=256  b48:  pallas 2.33 vs xla 2.59            -> pallas wins 1.1x
+#   s=128  b384: pallas 8.61 vs xla 4.85            -> XLA wins 1.8x
+#                (4608 tiny grid cells; per-cell overhead dominates)
 #   s=4096: xla FAILS TO COMPILE (the [B,H,S,S] f32 transient = 8.6 GB);
 #           pallas runs — its O(S) HBM footprint is the only option.
-# So dispatch on the transient scores-buffer size, not sequence length.
+# Dispatch: fused single-block kernels for sq >= FUSED_MIN_SEQ; the
+# scores-bytes threshold still forces pallas where XLA cannot compile.
 PALLAS_MIN_SCORES_BYTES = 2 << 30
+FUSED_MIN_SEQ = 256
 
 
 def _impl_choice(q, k):
@@ -743,7 +965,10 @@ def _impl_choice(q, k):
     if env in ("pallas", "xla"):
         return env
     b, h, sq, _ = q.shape
-    scores_bytes = 4.0 * b * h * sq * k.shape[2]
+    sk = k.shape[2]
+    if sq >= FUSED_MIN_SEQ and _fused_bwd_applies(sq, sk):
+        return "pallas"
+    scores_bytes = 4.0 * b * h * sq * sk
     return "pallas" if scores_bytes >= PALLAS_MIN_SCORES_BYTES else "xla"
 
 

@@ -24,6 +24,7 @@ from tools.perf import time_chain
 PEAK = 197e12
 GEOMS = {
     "ernie": (32, 16, 512, 64),
+    "ernie34": (34, 16, 512, 64),
     "bert": (384, 12, 128, 64),
     "long": (4, 16, 2048, 64),
     "xl": (8, 16, 4096, 64),
@@ -33,11 +34,21 @@ GEOMS = {
 def bench_impl(name, attn_fn, q, k, v, causal, fwd_flops, bwd_flops):
     fwd = jax.jit(lambda x: attn_fn(x, k, v).astype(x.dtype))
 
-    def loss(x):
-        return jnp.sum(attn_fn(x, k, v).astype(jnp.float32) ** 2) * 1e-6
+    # differentiate wrt q AND k AND v: an x-only grad lets XLA DCE the
+    # entire dk/dv computation (the accumulator scan in the chunked
+    # path) — exactly the under-measurement that mis-calibrated the
+    # round-3 dispatcher (bwd looked 2.7x cheaper than it runs
+    # in-program). Chain the three cotangents into one output.
+    def loss(x, kk, vv):
+        return jnp.sum(attn_fn(x, kk, vv).astype(jnp.float32) ** 2) * 1e-6
 
-    gf = jax.grad(loss)
-    bwd = jax.jit(lambda x: gf(x).astype(x.dtype))
+    gf = jax.grad(loss, argnums=(0, 1, 2))
+
+    def bwd_all(x):
+        dq, dk, dv = gf(x, k, v)
+        return (dq + dk + dv).astype(x.dtype)
+
+    bwd = jax.jit(bwd_all)
     try:
         ms_f = time_chain(fwd, q)
         ms_b = time_chain(bwd, q)
@@ -61,6 +72,8 @@ def main():
                     default=True)
     ap.add_argument("--sweep", action="store_true",
                     help="sweep flash block sizes")
+    ap.add_argument("--dropout", type=float, default=0.0,
+                    help="attention-probs dropout rate (bench recipe: 0.1)")
     args = ap.parse_args()
 
     b, h, s, d = GEOMS[args.geom]
@@ -87,25 +100,29 @@ def main():
                 fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_K = bq, bk
                 bench_impl(f"fl {bq}x{bk}",
                            lambda x, kk, vv: fa.flash_attention(
-                               x, kk, vv, bias, causal=causal),
+                               x, kk, vv, bias, causal=causal,
+                               dropout_rate=args.dropout),
                            q, k, v, causal, fwd_flops, bwd_flops)
         os.environ["PT_FLASH_IMPL"] = "auto"
         return
 
     scale = 1.0 / d ** 0.5
+    rate = args.dropout
     os.environ["PT_FLASH_IMPL"] = "pallas"
     bench_impl("pallas",
                lambda x, kk, vv: fa.flash_attention(x, kk, vv, bias,
-                                                    causal=causal),
+                                                    causal=causal,
+                                                    dropout_rate=rate),
                q, k, v, causal, fwd_flops, bwd_flops)
     os.environ["PT_FLASH_IMPL"] = "auto"
     bench_impl("xla-rcmp",
                lambda x, kk, vv: fa._xla_attention(
-                   x, kk, vv, bias, jnp.uint32(0), causal, scale),
+                   x, kk, vv, bias, jnp.uint32(0), causal, scale, rate),
                q, k, v, causal, fwd_flops, bwd_flops)
     bench_impl("xla-ref",
-               lambda x, kk, vv: fa.reference_attention(x, kk, vv, bias,
-                                                        causal=causal),
+               lambda x, kk, vv: fa.reference_attention(
+                   x, kk, vv, bias, causal=causal, dropout_rate=rate,
+                   dropout_seed=jnp.uint32(0)),
                q, k, v, causal, fwd_flops, bwd_flops)
 
     def xla_bf16(x, kk, vv):
