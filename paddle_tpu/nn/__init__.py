@@ -786,7 +786,7 @@ class PReLU(Layer):
         self._mode = "all" if num_parameters == 1 else "channel"
 
     def forward(self, x):
-        return F.prelu(x, self.weight)
+        return F.prelu(x, self.weight, mode=self._mode)
 
 
 class PixelShuffle(Layer):

@@ -204,8 +204,13 @@ def elu(x, alpha=1.0, name=None):
     return _op("elu", {"X": x}, {"alpha": alpha})
 
 
-def prelu(x, weight, name=None):
-    return _op("prelu", {"X": x, "Alpha": weight}, {})
+def prelu(x, weight, mode=None, name=None):
+    """mode: 'all' (scalar slope) or 'channel' (per-channel slope along
+    axis 1, reference prelu_op.cc channel mode). Default: inferred from
+    the weight size."""
+    if mode is None:
+        mode = "all" if int(np.prod(weight.shape)) == 1 else "channel"
+    return _op("prelu", {"X": x, "Alpha": weight}, {"mode": mode})
 
 
 def hardswish(x, name=None):

@@ -102,7 +102,6 @@ def _register_activations():
         "ceil": jnp.ceil,
         "round": jnp.round,
         "reciprocal": jnp.reciprocal,
-        "softplus": jax.nn.softplus,
         "softsign": jax.nn.soft_sign,
         "silu": jax.nn.silu,
         "swish": jax.nn.silu,
@@ -120,6 +119,23 @@ def _register_activations():
 
 
 _register_activations()
+
+
+@register_op("softplus")
+def softplus(ins, attrs):
+    """reference: operators/activation_op.cc Softplus — the 2.0 surface
+    adds beta/threshold: out = (1/beta) * log(1 + exp(beta*x)), switching
+    to the linear x above beta*x > threshold for numerical range (same
+    contract as paddle.nn.functional.softplus)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    beta = float(attrs.get("beta", 1.0) or 1.0)
+    threshold = float(attrs.get("threshold", 20.0) or 20.0)
+    bx = beta * x
+    return {"Out": jnp.where(bx > threshold, x,
+                             jax.nn.softplus(bx) / beta)}
 
 
 @register_op("gelu")

@@ -1,0 +1,236 @@
+"""Book-fixture model zoo, part 2 — the three remaining reference book
+models: fit_a_line (linear regression + inference export round trip,
+reference tests/book/test_fit_a_line.py), recommender_system (dual-tower
+embeddings + cos_sim regression, tests/book/test_recommender_system.py)
+and label_semantic_roles (embedding windows -> LSTM stack -> CRF,
+tests/book/test_label_semantic_roles.py)."""
+
+import numpy as np
+import pytest
+
+
+def _run_startup():
+    import paddle_tpu as pt
+
+    exe = pt.Executor()
+    scope = pt.Scope()
+    return exe, scope
+
+
+class TestFitALine:
+    """reference: tests/book/test_fit_a_line.py:25 — y_predict = fc(x, 1),
+    square_error_cost vs y, SGD, then save_inference_model /
+    load_inference_model and predict."""
+
+    def test_trains_and_roundtrips_inference(self, tmp_path):
+        import paddle_tpu as pt
+        from paddle_tpu import io, layers
+        from paddle_tpu.core.ir import Program, program_guard
+
+        rng = np.random.RandomState(0)
+        w_true = rng.uniform(-1, 1, size=(13, 1)).astype(np.float32)
+
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = layers.static_data("x", [-1, 13], "float32")
+            y = layers.static_data("y", [-1, 1], "float32")
+            y_pred = layers.fc(x, 1, param_attr="fal_w", bias_attr="fal_b")
+            loss = layers.mean(layers.square_error_cost(y_pred, y))
+            pt.optimizer.SGD(learning_rate=0.05).minimize(loss)
+
+        exe, scope = _run_startup()
+        exe.run(startup, scope=scope, use_compiled=False)
+        losses = []
+        for s in range(60):
+            xb = rng.uniform(-1, 1, size=(32, 13)).astype(np.float32)
+            yb = xb @ w_true + 0.01 * rng.randn(32, 1).astype(np.float32)
+            out = exe.run(main, feed={"x": xb, "y": yb},
+                          fetch_list=[loss], scope=scope)
+            losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+        assert losses[-1] < 0.05 * losses[0], (losses[0], losses[-1])
+
+        # inference export + reload (reference: save_inference_model
+        # io.py:1164 emits the pruned program; book test reloads and runs)
+        d = str(tmp_path / "fit_a_line_model")
+        io.save_inference_model(d, ["x"], [y_pred], exe, main_program=main,
+                                scope=scope)
+        scope2 = pt.Scope()
+        infer_prog, feed_names, fetch_names = io.load_inference_model(
+            d, exe, scope=scope2)
+        xq = rng.uniform(-1, 1, size=(8, 13)).astype(np.float32)
+        pred = exe.run(infer_prog, feed={feed_names[0]: xq},
+                       fetch_list=fetch_names, scope=scope2)
+        ref = exe.run(main, feed={"x": xq,
+                                  "y": np.zeros((8, 1), np.float32)},
+                      fetch_list=[y_pred], scope=scope)
+        np.testing.assert_allclose(np.asarray(pred[0]), np.asarray(ref[0]),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestRecommenderSystem:
+    """reference: tests/book/test_recommender_system.py:33 — user tower
+    (usr id/gender/age/job embeddings -> fc) x movie tower (movie id
+    embedding + mean-pooled category/title embeddings -> fc), 5 *
+    cos_sim as the predicted rating, square error vs the label."""
+
+    USR, GEN, AGE, JOB = 200, 2, 7, 21
+    MOV, CAT, TIT = 300, 19, 500
+
+    def _build(self):
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+        from paddle_tpu.core.ir import Program, program_guard
+        from paddle_tpu.param_attr import ParamAttr
+
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            feeds = {}
+            for name in ("usr", "gender", "age", "job", "mov"):
+                feeds[name] = layers.static_data(name, [-1, 1], "int64")
+            feeds["cat"] = layers.static_data("cat", [-1, 4], "int64")
+            feeds["tit"] = layers.static_data("tit", [-1, 6], "int64")
+            feeds["score"] = layers.static_data("score", [-1, 1], "float32")
+
+            def emb(var, vocab, name, dim=16):
+                e = layers.embedding(var, [vocab, dim],
+                                     param_attr=ParamAttr(name=f"rec_{name}"))
+                return layers.reshape(e, [0, int(np.prod(e.shape[1:]))]) \
+                    if len(e.shape) > 2 and int(e.shape[1]) == 1 else e
+
+            usr = layers.concat([
+                layers.fc(emb(feeds["usr"], self.USR, "usr"), 32),
+                layers.fc(emb(feeds["gender"], self.GEN, "gen"), 16),
+                layers.fc(emb(feeds["age"], self.AGE, "age"), 16),
+                layers.fc(emb(feeds["job"], self.JOB, "job"), 16)], axis=1)
+            usr_feat = layers.fc(usr, 32, act="tanh",
+                                 param_attr=ParamAttr(name="rec_usr_fc"))
+
+            mov_id = layers.fc(emb(feeds["mov"], self.MOV, "mov"), 32)
+            cat_e = layers.embedding(feeds["cat"], [self.CAT, 16],
+                                     param_attr=ParamAttr(name="rec_cat"))
+            cat_pooled = layers.reduce_mean(cat_e, dim=1)     # sequence_pool
+            tit_e = layers.embedding(feeds["tit"], [self.TIT, 16],
+                                     param_attr=ParamAttr(name="rec_tit"))
+            tit_pooled = layers.reduce_mean(tit_e, dim=1)
+            mov = layers.concat([mov_id, layers.fc(cat_pooled, 16),
+                                 layers.fc(tit_pooled, 16)], axis=1)
+            mov_feat = layers.fc(mov, 32, act="tanh",
+                                 param_attr=ParamAttr(name="rec_mov_fc"))
+
+            sim = layers.cos_sim(usr_feat, mov_feat)
+            pred = layers.scale(sim, scale=5.0)
+            loss = layers.mean(layers.square_error_cost(pred,
+                                                        feeds["score"]))
+            pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        return main, startup, loss
+
+    def _feed(self, rng, bs=32):
+        return {
+            "usr": rng.randint(0, self.USR, (bs, 1)).astype(np.int64),
+            "gender": rng.randint(0, self.GEN, (bs, 1)).astype(np.int64),
+            "age": rng.randint(0, self.AGE, (bs, 1)).astype(np.int64),
+            "job": rng.randint(0, self.JOB, (bs, 1)).astype(np.int64),
+            "mov": rng.randint(0, self.MOV, (bs, 1)).astype(np.int64),
+            "cat": rng.randint(0, self.CAT, (bs, 4)).astype(np.int64),
+            "tit": rng.randint(0, self.TIT, (bs, 6)).astype(np.int64),
+            "score": rng.randint(1, 6, (bs, 1)).astype(np.float32),
+        }
+
+    def test_trains(self):
+        import paddle_tpu as pt
+
+        main, startup, loss = self._build()
+        exe, scope = _run_startup()
+        exe.run(startup, scope=scope, use_compiled=False)
+        rng = np.random.RandomState(7)
+        fixed = [self._feed(rng) for _ in range(4)]  # memorisable stream
+        losses = []
+        for s in range(40):
+            out = exe.run(main, feed=fixed[s % 4], fetch_list=[loss],
+                          scope=scope)
+            losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+        assert all(np.isfinite(losses))
+        assert np.mean(losses[-4:]) < 0.5 * np.mean(losses[:4]), losses
+
+
+class TestLabelSemanticRoles:
+    """reference: tests/book/test_label_semantic_roles.py:37 — word +
+    context-window + predicate + mark embeddings -> fc -> stacked
+    bidirectional LSTM -> emission fc -> linear_chain_crf; decode with
+    crf_decoding sharing the trained transition parameter."""
+
+    VOCAB, PRED, MARK, TAGS = 400, 50, 2, 9
+    S = 12
+
+    def _build(self):
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+        from paddle_tpu.core.ir import Program, program_guard
+        from paddle_tpu.param_attr import ParamAttr
+
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            word = layers.static_data("word", [-1, self.S], "int64")
+            pred = layers.static_data("pred", [-1, self.S], "int64")
+            mark = layers.static_data("mark", [-1, self.S], "int64")
+            label = layers.static_data("label", [-1, self.S], "int64")
+            length = layers.static_data("length", [-1], "int64")
+
+            we = layers.embedding(word, [self.VOCAB, 32],
+                                  param_attr=ParamAttr(name="srl_wemb"))
+            pe = layers.embedding(pred, [self.PRED, 32],
+                                  param_attr=ParamAttr(name="srl_pemb"))
+            me = layers.embedding(mark, [self.MARK, 8],
+                                  param_attr=ParamAttr(name="srl_memb"))
+            x = layers.concat([we, pe, me], axis=2)
+            h = layers.fc(x, 64, num_flatten_dims=2, act="tanh",
+                          param_attr=ParamAttr(name="srl_fc0"))
+            fwd, _, _ = layers.lstm_unit_layer(
+                h, 32, seq_length=length,
+                param_attr=ParamAttr(name="srl_lf_wx"), name="srl_lf")
+            bwd, _, _ = layers.lstm_unit_layer(
+                h, 32, is_reverse=True, seq_length=length,
+                param_attr=ParamAttr(name="srl_lb_wx"), name="srl_lb")
+            feat = layers.concat([fwd, bwd], axis=2)
+            emission = layers.fc(feat, self.TAGS, num_flatten_dims=2,
+                                 param_attr=ParamAttr(name="srl_emit"))
+            crf_cost = layers.linear_chain_crf(
+                emission, label,
+                param_attr=ParamAttr(name="srl_crf_trans"), length=length)
+            loss = layers.mean(crf_cost)
+            decode = layers.crf_decoding(
+                emission, ParamAttr(name="srl_crf_trans"), length=length)
+            pt.optimizer.Adam(learning_rate=0.01).minimize(loss)
+        return main, startup, loss, decode
+
+    def _feed(self, rng, bs=16):
+        length = rng.randint(4, self.S + 1, (bs,)).astype(np.int64)
+        return {
+            "word": rng.randint(0, self.VOCAB, (bs, self.S)).astype(np.int64),
+            "pred": rng.randint(0, self.PRED, (bs, self.S)).astype(np.int64),
+            "mark": rng.randint(0, self.MARK, (bs, self.S)).astype(np.int64),
+            "label": rng.randint(0, self.TAGS, (bs, self.S)).astype(np.int64),
+            "length": length,
+        }
+
+    def test_trains_and_decodes(self):
+        import paddle_tpu as pt
+
+        main, startup, loss, decode = self._build()
+        exe, scope = _run_startup()
+        exe.run(startup, scope=scope, use_compiled=False)
+        rng = np.random.RandomState(3)
+        fixed = [self._feed(rng) for _ in range(2)]
+        losses = []
+        for s in range(50):
+            out = exe.run(main, feed=fixed[s % 2], fetch_list=[loss],
+                          scope=scope)
+            losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < 0.6 * losses[0], (losses[0], losses[-1])
+        # decode must emit valid tags, padded region ignored by length
+        path = exe.run(main, feed=fixed[0], fetch_list=[decode],
+                       scope=scope)
+        path = np.asarray(path[0])
+        assert path.shape == (16, self.S)
+        assert path.min() >= 0 and path.max() < self.TAGS

@@ -229,3 +229,37 @@ def test_ctc_loss_mean_weights_by_label_length():
             logp, labels, in_len, lab_len)).reshape(-1)
         want = float(np.mean(none_loss / np.array([2.0, 3.0])))
         assert abs(mean_loss - want) < 1e-5
+
+
+def test_prelu_channel_mode_applies_per_channel_slopes():
+    """Regression (round-4 review): PReLU(num_parameters=C) must broadcast
+    the (C,) slopes along axis 1, not the last axis (reference
+    prelu_op.cc 'channel' mode)."""
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+
+    with pt.dygraph.guard():
+        rng = np.random.RandomState(0)
+        x = pt.to_tensor(rng.randn(2, 3, 4, 5).astype(np.float32))
+        m = nn.PReLU(num_parameters=3, init=0.1)
+        y = np.asarray(m(x).numpy())
+        xa = np.asarray(x.numpy())
+        w = np.asarray(m.weight.numpy()).reshape(1, 3, 1, 1)
+        np.testing.assert_allclose(y, np.where(xa > 0, xa, xa * w),
+                                   rtol=1e-6)
+
+
+def test_softplus_beta_threshold_honored():
+    """Regression (round-4 review): F.softplus(beta, threshold) must not
+    silently ignore its attrs (out = log1p(exp(beta x))/beta, linear
+    above beta*x > threshold)."""
+    import paddle_tpu as pt
+    import paddle_tpu.nn.functional as F
+
+    with pt.dygraph.guard():
+        xs = np.linspace(-3, 3, 7).astype(np.float32)
+        got = np.asarray(F.softplus(pt.to_tensor(xs), beta=4.0).numpy())
+        want = (np.log1p(np.exp(4.0 * xs.astype(np.float64))) / 4.0)
+        np.testing.assert_allclose(got, want.astype(np.float32), rtol=1e-4)
+        big = pt.to_tensor(np.array([100.0], np.float32))
+        assert float(F.softplus(big).numpy()[0]) == 100.0
