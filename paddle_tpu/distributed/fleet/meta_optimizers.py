@@ -93,7 +93,7 @@ def rewrite_sync_batch_norm(program: Program, axis_name="dp"):
 # ---------------------------------------------------------------------------
 
 AMP_WHITE_LIST = {"matmul", "matmul_v2", "mul", "conv2d", "depthwise_conv2d",
-                  "bmm"}
+                  "bmm", "flash_attention", "ring_attention"}
 AMP_BLACK_LIST = {"softmax_with_cross_entropy", "cross_entropy", "layer_norm",
                   "batch_norm", "sync_batch_norm", "mean", "reduce_mean",
                   "softmax", "exp", "log"}

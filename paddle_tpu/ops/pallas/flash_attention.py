@@ -950,10 +950,15 @@ def _pad_head_dim(x, target):
 #   s=256  b48:  pallas 2.33 vs xla 2.59            -> pallas wins 1.1x
 #   s=128  b384: pallas 8.61 vs xla 4.85            -> XLA wins 1.8x
 #                (4608 tiny grid cells; per-cell overhead dominates)
+#   s=2048 b4:   pallas(2-pass online-softmax) 6.64 vs xla-rcmp 14.74
+#                -> pallas wins 2.2x (the old "xla wins 1.6x" was the
+#                   same q-only-grad DCE artifact)
 #   s=4096: xla FAILS TO COMPILE (the [B,H,S,S] f32 transient = 8.6 GB);
 #           pallas runs — its O(S) HBM footprint is the only option.
-# Dispatch: fused single-block kernels for sq >= FUSED_MIN_SEQ; the
-# scores-bytes threshold still forces pallas where XLA cannot compile.
+# Dispatch: pallas kernels (fused single-block where one tile covers
+# the row, 2-pass online-softmax above) for sq >= FUSED_MIN_SEQ; XLA
+# recompute only below it, where tiny grid cells lose. The scores-bytes
+# threshold still forces pallas where XLA cannot even compile.
 PALLAS_MIN_SCORES_BYTES = 2 << 30
 FUSED_MIN_SEQ = 256
 
@@ -966,7 +971,7 @@ def _impl_choice(q, k):
         return env
     b, h, sq, _ = q.shape
     sk = k.shape[2]
-    if sq >= FUSED_MIN_SEQ and _fused_bwd_applies(sq, sk):
+    if sq >= FUSED_MIN_SEQ:
         return "pallas"
     scores_bytes = 4.0 * b * h * sq * sk
     return "pallas" if scores_bytes >= PALLAS_MIN_SCORES_BYTES else "xla"
