@@ -247,13 +247,22 @@ def test_transformer_flash_matches_unfused(scope):
         sc = pt.Scope()
         rng = np.random.RandomState(0)
         exe.run(startup, scope=sc, use_compiled=False)
-        # identical params: re-seed deterministically by name
+        # identical params: re-seed deterministically by name (crc32 —
+        # hash() varies with PYTHONHASHSEED). NEVER touch structural
+        # non-trainable tables: the causal mask only exists in the
+        # UNFUSED program, so overwriting it would silently de-causal
+        # the reference side of the comparison.
+        import zlib
+
         for name in sorted(sc._vars):
+            if "causal_mask" in name or "pos_enc" in name:
+                continue
             v = sc.find_var(name)
             if hasattr(v, "shape") and getattr(v, "dtype", None) is not None:
                 arr = np.asarray(v)
                 if np.issubdtype(arr.dtype, np.floating) and arr.ndim >= 1:
-                    r = np.random.RandomState(abs(hash(name)) % (2**31))
+                    r = np.random.RandomState(
+                        zlib.crc32(name.encode()) % (2**31))
                     sc.set(name, (r.standard_normal(arr.shape) * 0.05
                                   ).astype(arr.dtype))
         batch = tfm.synthetic_batch(cfg, 3, 8, seed=5)

@@ -347,3 +347,47 @@ class TestFlashAttentionInProgram:
             losses[fused] = float(np.asarray(out[0]))
         assert np.isfinite(losses[True])
         np.testing.assert_allclose(losses[True], losses[False], rtol=1e-4)
+
+
+class TestHeadBlockedFusedKernels:
+    """The g-sliced single-block kernels (_fused_g) — g consecutive
+    (b,h) slices per grid cell for sequences below FUSED_MIN_SEQ."""
+
+    def test_g_path_selected_and_matches(self, interpret_mode):
+        import importlib
+
+        fa = importlib.import_module(
+            "paddle_tpu.ops.pallas.flash_attention")
+        B, H, S, D = 2, 4, 128, 64
+        assert fa._fused_g(S, S, H, B) == 4
+        q, k, v = (_rand(B, H, S, D, seed=i) for i in range(3))
+        bias = (np.random.RandomState(9).rand(B, S) > 0.2).astype(
+            np.float32)
+        bias_kv = jnp.asarray((bias - 1.0) * 10000.0)
+
+        def f(q, k, v, b):
+            return fa._flash(q, k, v, b, jnp.uint32(3), False,
+                             1.0 / np.sqrt(D), True, 0.1)
+
+        def ref(q, k, v, b):
+            return fa.reference_attention(
+                q, k, v, b, causal=False, scale=1.0 / np.sqrt(D),
+                dropout_rate=0.1, dropout_seed=jnp.uint32(3))
+
+        out, ref_out = f(q, k, v, bias_kv), ref(q, k, v, bias_kv)
+        np.testing.assert_allclose(out, ref_out, atol=5e-3)
+        do = _rand(B, H, S, D, seed=7)
+        _, vjp = jax.vjp(f, q, k, v, bias_kv)
+        _, vjp_r = jax.vjp(ref, q, k, v, bias_kv)
+        for g_, r_ in zip(vjp(do)[:4], vjp_r(do)[:4]):
+            np.testing.assert_allclose(g_, r_, atol=2e-2)
+
+    def test_g_requires_h_divisor(self):
+        import importlib
+
+        fa = importlib.import_module(
+            "paddle_tpu.ops.pallas.flash_attention")
+        assert fa._fused_g(128, 128, 12, 4) == 4   # 512//128 -> 4 | 12
+        assert fa._fused_g(128, 128, 7, 4) == 0    # no divisor <= 4 > 1
+        assert fa._fused_g(64, 64, 16, 4) == 8     # 512//64=8 | 16
+        assert fa._fused_g(256, 256, 16, 4) == 0   # plain fused regime

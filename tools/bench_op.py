@@ -215,6 +215,16 @@ def main():
     for case in cases:
         try:
             r = bench_case(case)
+            # slope timing through the relay can yield nonsense for
+            # sub-noise cases (a NEGATIVE dropout baseline was once
+            # recorded): never record a non-positive duration — it
+            # poisons every future --check ratio for that row
+            if r["ms"] <= 0:
+                print(json.dumps({"op": case.get("op"), "ms": r["ms"],
+                                  "skipped": "non-positive timing "
+                                  "(relay noise floor) — not recorded"}),
+                      flush=True)
+                continue
             results[_case_key(case)] = r["ms"]
             print(json.dumps(r), flush=True)
         except Exception as e:
