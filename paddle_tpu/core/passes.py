@@ -484,3 +484,114 @@ def fuse_bn_act_pass(program: Program) -> Program:
     block.ops = new_ops
     program._bump_version()
     return program
+
+
+# Ops safe to pack into a fusion_group: pure elementwise lowerings with
+# no sub-blocks, no collectives, no state. dropout IS included — the
+# group lowering threads __step__/__axis_coords__ through, preserving
+# per-step masks.
+_FUSION_GROUP_OPS = frozenset({
+    "relu", "relu6", "gelu", "tanh", "sigmoid", "exp", "log", "sqrt",
+    "rsqrt", "square", "abs", "floor", "ceil", "round", "reciprocal",
+    "softsign", "silu", "swish", "softplus", "logsigmoid", "sin", "cos",
+    "erf", "sign", "leaky_relu", "elu", "hard_swish", "hard_sigmoid",
+    "scale", "cast", "clip", "dropout",
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "elementwise_max", "elementwise_min",
+    "elementwise_pow",
+})
+
+
+@register_pass("fusion_group_pass")
+def fusion_group_pass(program: Program, min_size: int = 2) -> Program:
+    """Pack maximal runs of consecutive elementwise ops into single
+    fusion_group ops (reference: ir/fusion_group/ — there it NVRTC-
+    compiles a CUDA kernel per subgraph via platform/device_code.cc; on
+    the XLA substrate generic fusion is the compiler's job, so the win
+    is DISPATCH: the interpreting executor jits and launches one
+    composite instead of N ops, the per-op analog of the reference's
+    per-kernel launch overhead).
+
+    Grouping is order-preserving over block 0 (block op order is
+    topological): a run extends while the op is whitelisted, shares the
+    run's op_role, and touches no persistable vars. Outputs consumed
+    only inside the run become internal; everything else (consumed
+    later, or never — a potential fetch target) is exported."""
+    from .registry import EMPTY_VAR
+
+    block = program.global_block()
+    persistable = {v.name for v in block.vars.values() if v.persistable}
+
+    def groupable(op):
+        if op.type not in _FUSION_GROUP_OPS:
+            return False
+        names = [n for ns in list(op.inputs.values()) +
+                 list(op.outputs.values()) for n in ns]
+        return not any(n == EMPTY_VAR or n in persistable for n in names)
+
+    runs: List[List[OpDesc]] = []
+    cur: List[OpDesc] = []
+    cur_role = None
+    for op in block.ops:
+        role = int(op.attrs.get("op_role", 0))
+        if groupable(op) and (not cur or role == cur_role):
+            cur.append(op)
+            cur_role = role
+        else:
+            if len(cur) >= min_size:
+                runs.append(cur)
+            cur = [op] if groupable(op) else []
+            cur_role = role if cur else None
+    if len(cur) >= min_size:
+        runs.append(cur)
+    if not runs:
+        return program
+
+    replacements: Dict[int, OpDesc] = {}
+    dead = set()
+    for run in runs:
+        members = {id(op) for op in run}
+        produced: List[str] = []
+        produced_set = set()
+        ext_in: List[str] = []
+        for op in run:
+            for ns in op.inputs.values():
+                for n in ns:
+                    if n not in produced_set and n not in ext_in:
+                        ext_in.append(n)
+            for ns in op.outputs.values():
+                for n in ns:
+                    if n not in produced_set:
+                        produced.append(n)
+                        produced_set.add(n)
+        # Export EVERY produced var: the program carries no fetch ops, so
+        # an intermediate whose only op-consumers sit inside the run can
+        # still be somebody's fetch target (fetch_list / inference
+        # fetch_names are metadata the pass cannot see). The compiled
+        # executor DCEs unused outputs anyway; on the interp path the
+        # extra buffers are the price of fetch-by-name correctness.
+        ext_out = produced
+        if not ext_out:
+            continue
+        sub_ops = [{"type": op.type,
+                    "inputs": {s: list(ns) for s, ns in op.inputs.items()},
+                    "outputs": {s: list(ns) for s, ns in op.outputs.items()},
+                    "attrs": {k: v for k, v in op.attrs.items()
+                              if k != "op_role"}}
+                   for op in run]
+        replacements[id(run[0])] = OpDesc(
+            "fusion_group", {"X": ext_in}, {"Out": ext_out},
+            {"sub_ops": sub_ops, "ext_in_names": ext_in,
+             "ext_out_names": ext_out,
+             "op_role": int(run[0].attrs.get("op_role", 0))})
+        dead.update(members)
+
+    new_ops: List[OpDesc] = []
+    for op in block.ops:
+        if id(op) in replacements:
+            new_ops.append(replacements[id(op)])
+        elif id(op) not in dead:
+            new_ops.append(op)
+    block.ops = new_ops
+    program._bump_version()
+    return program

@@ -31,6 +31,10 @@ DEFAULT_PASSES = [
     # AFTER fc_fuse: this one would otherwise grab the (bias-add, act)
     # pair that fc_fuse wants
     "fuse_elewise_add_act_pass",
+    # LAST: sweep the remaining elementwise runs into single composite
+    # ops (reference ir/fusion_group/ analog) — fewer interp dispatches,
+    # identical XLA trace under jit
+    "fusion_group_pass",
 ]
 
 

@@ -172,3 +172,37 @@ def fusion_seqpool_cvm_concat(ins, attrs):
     outs = [cvm_op({"X": [p], "CVM": ins.get("CVM", [None])},
                    {"use_cvm": use_cvm})["Y"] for p in parts]
     return {"Out": jnp.concatenate(outs, axis=1)}
+
+
+@register_op("fusion_group", skip_infer_shape=True)
+def fusion_group(ins, attrs):
+    """Composite elementwise-chain op (reference: ir/fusion_group/ +
+    fusion_group_op — runtime CUDA codegen for elementwise subgraphs).
+    TPU redesign: the pass packs the chain's OpDescs into `sub_ops` and
+    this lowering replays them through their registered forwards — ONE
+    dispatch (and one jit-cache entry) on the interpreting executor,
+    where per-op dispatch through the axon relay is the analog of the
+    reference's per-kernel launch overhead. Under the compiling executor
+    the trace is identical to the unfused chain, so XLA's fusion
+    decisions are unchanged. Runtime attrs (__step__/__axis_coords__)
+    are threaded into every sub-op so stochastic members (dropout) keep
+    per-step/per-rank mask semantics."""
+    from ..core import registry as _registry
+
+    env = dict(zip(list(attrs["ext_in_names"]), list(ins["X"])))
+    for sub in attrs["sub_ops"]:
+        sub_attrs = dict(sub["attrs"])
+        for k in ("__step__", "__axis_coords__"):
+            if k in attrs:
+                sub_attrs[k] = attrs[k]
+        sub_ins = {slot: [env[n] for n in names]
+                   for slot, names in sub["inputs"].items()}
+        outs = _registry.normalize_outputs(
+            _registry.get(sub["type"]).forward(sub_ins, sub_attrs))
+        for slot, names in sub["outputs"].items():
+            vals = outs.get(slot)
+            if vals is None:
+                continue
+            for n, v in zip(names, vals):
+                env[n] = v
+    return {"Out": [env[n] for n in attrs["ext_out_names"]]}

@@ -556,7 +556,7 @@ def _fwd_pallas(q, k, v, bias_kv, causal, scale, interpret,
 
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    g = _fused_g(sq, sk, h, b)
+    g = _fused_g(sq, sk, h)
     if g:
         return _fwd_pallas_fused_g(q, k, v, bias_kv, causal, scale,
                                    interpret, g, seed, rate)
@@ -900,7 +900,7 @@ def _fused_bwd_kernel_g(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
         preferred_element_type=jnp.float32).astype(dk_ref.dtype)
 
 
-def _fused_g(sq, sk, h, b):
+def _fused_g(sq, sk, h):
     """Head-block size for the g-sliced fused kernels: pack g consecutive
     (b,h) slices so g*sq ~ 512 rows per cell. g must divide h so a cell
     never spans two batch rows (the bias/dbias blocks are per-batch).
@@ -995,7 +995,7 @@ def _bwd_pallas(q, k, v, bias_kv, causal, scale, interpret, o, lse, do,
 
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    g = _fused_g(sq, sk, h, b)
+    g = _fused_g(sq, sk, h)
     if g:
         return _bwd_pallas_fused_g(q, k, v, bias_kv, causal, scale,
                                    interpret, g, o, lse, do, seed, rate)

@@ -237,3 +237,119 @@ class TestPasses:
         assert "fc" in types and "elementwise_add" not in types
         got, = exe.run(main, feed={"x": xv}, fetch_list=[y], scope=scope)
         np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+class TestFusionGroupPass:
+    """fusion_group_pass packs elementwise runs into one composite op
+    (reference: ir/fusion_group/ NVRTC subgraph codegen — here the win
+    is one interp dispatch / jit-cache entry per run)."""
+
+    def _build(self, with_dropout):
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [16])
+            y = layers.data("y", [16])
+            h = layers.elementwise_add(layers.tanh(x), layers.sigmoid(y))
+            h = layers.scale(h, scale=1.7, bias=0.3)
+            if with_dropout:
+                h = layers.dropout(h, 0.4,
+                                   dropout_implementation="upscale_in_train")
+            out = layers.relu(h)
+        return main, startup, out
+
+    def test_pass_groups_and_matches(self, scope):
+        import paddle_tpu as pt
+        from paddle_tpu.core.passes import apply_passes
+
+        main, startup, out = self._build(with_dropout=False)
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        feed = {"x": np.random.RandomState(0).randn(4, 16).astype(np.float32),
+                "y": np.random.RandomState(1).randn(4, 16).astype(np.float32)}
+        want, = exe.run(main, feed=feed, fetch_list=[out], scope=scope)
+        apply_passes(main, ["fusion_group_pass"])
+        types = [o.type for o in main.global_block().ops]
+        assert types.count("fusion_group") == 1, types
+        assert not set(types) & {"tanh", "sigmoid", "elementwise_add",
+                                 "scale", "relu"}, types
+        for use_compiled in (False, True):
+            got, = exe.run(main, feed=feed, fetch_list=[out], scope=scope,
+                           use_compiled=use_compiled)
+            np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_dropout_mask_survives_grouping(self, scope):
+        """The composite threads __step__ into sub-ops: the grouped
+        dropout must draw the SAME per-step mask as the ungrouped op."""
+        import paddle_tpu as pt
+        from paddle_tpu.core.passes import apply_passes
+
+        main, startup, out = self._build(with_dropout=True)
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        feed = {"x": np.random.RandomState(0).randn(4, 16).astype(np.float32),
+                "y": np.random.RandomState(1).randn(4, 16).astype(np.float32)}
+        base = [np.asarray(exe.run(main, feed=feed, fetch_list=[out],
+                                   scope=scope)[0]) for _ in range(2)]
+        # fresh scope -> same step counter sequence for the fused run
+        scope2 = pt.Scope()
+        exe.run(startup, scope=scope2, use_compiled=False)
+        apply_passes(main, ["fusion_group_pass"])
+        assert "fusion_group" in [o.type for o in main.global_block().ops]
+        fused = [np.asarray(exe.run(main, feed=feed, fetch_list=[out],
+                                    scope=scope2)[0]) for _ in range(2)]
+        for b, f in zip(base, fused):
+            np.testing.assert_allclose(f, b, atol=1e-6)
+        assert not np.allclose(fused[0], fused[1])  # step advances mask
+
+    def test_grads_flow_through_group(self, scope):
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+        from paddle_tpu.core.passes import apply_passes
+
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [8])
+            w = layers.create_parameter([8], "float32", name="fgw")
+            h = layers.sigmoid(layers.elementwise_mul(x, w))
+            h = layers.scale(h, scale=2.0)
+            loss = layers.mean(h)
+            apply_passes(main, ["fusion_group_pass"])
+            assert "fusion_group" in [o.type for o in main.global_block().ops]
+            pt.optimizer.SGD(learning_rate=0.5).minimize(loss)
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        feed = {"x": np.random.RandomState(2).randn(4, 8).astype(np.float32)}
+        l0 = float(np.asarray(exe.run(main, feed=feed, fetch_list=[loss],
+                                      scope=scope)[0]))
+        for _ in range(10):
+            lv = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)[0]
+        assert float(np.asarray(lv)) < l0  # params moved: grads flowed
+
+    def test_intermediate_stays_fetchable(self, scope):
+        """Regression (round-4 review): a var consumed only INSIDE the
+        grouped run can still be a fetch target — fetch_list names are
+        metadata the pass cannot see, so every produced var must stay
+        materialized."""
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+        from paddle_tpu.core.passes import apply_passes
+
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [8])
+            h = layers.scale(layers.tanh(x), scale=2.0)   # mid-run var
+            out = layers.relu(h)
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        feed = {"x": np.random.RandomState(3).randn(2, 8).astype(np.float32)}
+        want_h, want_out = exe.run(main, feed=feed, fetch_list=[h, out],
+                                   scope=scope)
+        apply_passes(main, ["fusion_group_pass"])
+        assert "fusion_group" in [o.type for o in main.global_block().ops]
+        got_h, got_out = exe.run(main, feed=feed, fetch_list=[h, out],
+                                 scope=scope)
+        np.testing.assert_allclose(got_h, want_h, atol=1e-6)
+        np.testing.assert_allclose(got_out, want_out, atol=1e-6)

@@ -359,7 +359,7 @@ class TestHeadBlockedFusedKernels:
         fa = importlib.import_module(
             "paddle_tpu.ops.pallas.flash_attention")
         B, H, S, D = 2, 4, 128, 64
-        assert fa._fused_g(S, S, H, B) == 4
+        assert fa._fused_g(S, S, H) == 4
         q, k, v = (_rand(B, H, S, D, seed=i) for i in range(3))
         bias = (np.random.RandomState(9).rand(B, S) > 0.2).astype(
             np.float32)
@@ -387,7 +387,7 @@ class TestHeadBlockedFusedKernels:
 
         fa = importlib.import_module(
             "paddle_tpu.ops.pallas.flash_attention")
-        assert fa._fused_g(128, 128, 12, 4) == 4   # 512//128 -> 4 | 12
-        assert fa._fused_g(128, 128, 7, 4) == 0    # no divisor <= 4 > 1
-        assert fa._fused_g(64, 64, 16, 4) == 8     # 512//64=8 | 16
-        assert fa._fused_g(256, 256, 16, 4) == 0   # plain fused regime
+        assert fa._fused_g(128, 128, 12) == 4   # 512//128 -> 4 | 12
+        assert fa._fused_g(128, 128, 7) == 0    # no divisor <= 4 > 1
+        assert fa._fused_g(64, 64, 16) == 8     # 512//64=8 | 16
+        assert fa._fused_g(256, 256, 16) == 0   # plain fused regime

@@ -215,16 +215,6 @@ def main():
     for case in cases:
         try:
             r = bench_case(case)
-            # slope timing through the relay can yield nonsense for
-            # sub-noise cases (a NEGATIVE dropout baseline was once
-            # recorded): never record a non-positive duration — it
-            # poisons every future --check ratio for that row
-            if r["ms"] <= 0:
-                print(json.dumps({"op": case.get("op"), "ms": r["ms"],
-                                  "skipped": "non-positive timing "
-                                  "(relay noise floor) — not recorded"}),
-                      flush=True)
-                continue
             results[_case_key(case)] = r["ms"]
             print(json.dumps(r), flush=True)
         except Exception as e:
@@ -232,7 +222,18 @@ def main():
                               "error": f"{type(e).__name__}: {e}"[:200]}),
                   flush=True)
     if args.record:
-        merged = dict(results)
+        # slope timing through the relay can yield nonsense for
+        # sub-noise cases (a NEGATIVE dropout baseline was once
+        # recorded): never BASELINE a non-positive duration — it
+        # poisons every future --check ratio for that row. The row still
+        # appears in --check runs (informational), so a missing-key
+        # hard-fail never triggers for noise.
+        dropped = {k: v for k, v in results.items() if v <= 0}
+        for k in dropped:
+            print(json.dumps({"case": k, "ms": dropped[k],
+                              "skipped": "non-positive timing (relay "
+                              "noise floor) — not recorded"}), flush=True)
+        merged = {k: v for k, v in results.items() if v > 0}
         if (args.op or args.config) and os.path.exists(BASELINE_PATH):
             # a filtered run must MERGE — overwriting would wipe the
             # rest of the recorded suite and the gate would go vacuous
