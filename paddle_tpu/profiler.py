@@ -180,3 +180,101 @@ def trace(log_dir: str):
         yield
     finally:
         stop_trace()
+
+
+# -- per-op device attribution ---------------------------------------------
+#
+# The jax profiler's trace works through the axon relay (discovered round
+# 4 — it is what located the 183 ms attention backward), so the framework
+# exposes it as a first-class tool: run a program a few steps under the
+# trace and attribute EXCLUSIVE device time to the framework source line
+# (= the op lowering) each XLA fusion came from. Reference analog: the
+# profiler's per-op device tables + tools/timeline.py.
+
+def _device_events(log_dir: str):
+    import glob
+    import gzip
+    import json as _json
+
+    paths = sorted(glob.glob(
+        f"{log_dir}/plugins/profile/*/*.trace.json.gz"))
+    if not paths:
+        raise RuntimeError(
+            f"device_profile: no trace file under {log_dir} — the jax "
+            f"profiler produced no dump (trace layout change, or "
+            f"start_trace failed)")
+    doc = _json.load(gzip.open(paths[-1]))
+    ev = doc.get("traceEvents", [])
+    dev_pids = {e["pid"] for e in ev
+                if e.get("ph") == "M" and e.get("name") == "process_name"
+                and "/device:" in str(e["args"].get("name"))}
+    return [e for e in ev if e.get("ph") == "X" and e["pid"] in dev_pids]
+
+
+def _exclusive_times(events):
+    """Per-event exclusive duration: XLA while/fusion events nest, so a
+    parent's time minus its children's is what IT cost."""
+    import collections as _c
+
+    by_tid = _c.defaultdict(list)
+    for e in events:
+        if "dur" in e:
+            # tids are process-scoped: key by (pid, tid) or a
+            # multi-device trace would interleave devices' timelines
+            # into one nesting stack (negative exclusive times)
+            by_tid[(e.get("pid"), e.get("tid"))].append(e)
+    excl = {}
+    for evs in by_tid.values():
+        evs.sort(key=lambda e: (e["ts"], -e["dur"]))
+        stack = []
+        for e in evs:
+            while stack and stack[-1]["ts"] + stack[-1]["dur"] <= e["ts"]:
+                stack.pop()
+            if stack:
+                p = stack[-1]
+                excl[id(p)] = excl.get(id(p), p["dur"]) - e["dur"]
+            stack.append(e)
+    return excl
+
+
+def device_profile(run_step, steps: int = 3, log_dir: Optional[str] = None):
+    """Profile `run_step()` (any callable that executes one device step —
+    typically a closure over Executor.run) and return rows attributing
+    exclusive device time to framework source locations.
+
+    Returns {"ms_per_step": float, "rows": [(source, ms_per_step), ...]}
+    sorted by cost. Source is the op lowering's file:line carried by XLA
+    metadata; synthetic events (dispatch wrappers) aggregate under their
+    event name."""
+    import re
+    import shutil
+    import tempfile
+
+    import collections as _c
+
+    cleanup = log_dir is None
+    log_dir = log_dir or tempfile.mkdtemp(prefix="pt_device_profile_")
+    try:
+        with trace(log_dir):
+            for _ in range(steps):
+                run_step()
+        events = _device_events(log_dir)
+    finally:
+        if cleanup:
+            shutil.rmtree(log_dir, ignore_errors=True)
+    excl = _exclusive_times(events)
+    by_src = _c.defaultdict(float)
+    total = 0.0
+    for e in events:
+        a = e.get("args") or {}
+        name = a.get("long_name") or e.get("name", "")
+        if name.startswith("jit_") or re.fullmatch(r"\d+",
+                                                   e.get("name", "")):
+            continue  # whole-module / step envelope events
+        d = excl.get(id(e), e.get("dur", 0))
+        src = a.get("source") or e.get("name", "?")[:60]
+        by_src[src] += d
+        total += d
+    rows = sorted(((k, v / 1e3 / steps) for k, v in by_src.items()),
+                  key=lambda kv: -kv[1])
+    return {"ms_per_step": total / 1e3 / steps, "rows": rows}

@@ -90,3 +90,47 @@ class TestMonitor:
         stat_add("test_stat", 7)
         assert stat_get("test_stat") == 12
         assert StatRegistry.instance().stats()["test_stat"] == 12
+
+
+def test_device_profile_attributes_to_source():
+    """profiler.device_profile (reference: per-op device tables +
+    tools/timeline.py; device side via the jax profiler instead of
+    CUPTI) must attribute exclusive device time to op-lowering source
+    lines. Runs in a subprocess with JAX_PLATFORMS set BEFORE the
+    interpreter starts: with the axon PJRT plugin registered and the
+    platform switched post-import (this suite's conftest), the XLA
+    device tracer never hooks the CPU backend and the trace carries
+    only python host events."""
+    import os
+    import subprocess
+    import sys
+
+    child = r'''
+import numpy as np
+import paddle_tpu as pt
+from paddle_tpu import layers, profiler
+
+main, startup = pt.Program(), pt.Program()
+with pt.program_guard(main, startup):
+    x = layers.data("x", [512])
+    h = layers.fc(x, 512, act="relu")
+    out = layers.reduce_mean(layers.fc(h, 512))
+exe = pt.Executor(pt.CPUPlace())
+scope = pt.Scope()
+exe.run(startup, scope=scope, use_compiled=False)
+feed = {"x": np.random.RandomState(0).randn(256, 512).astype(np.float32)}
+exe.run(main, feed=feed, fetch_list=[out], scope=scope)
+prof = profiler.device_profile(
+    lambda: exe.run(main, feed=feed, fetch_list=[out], scope=scope),
+    steps=2)
+assert prof["ms_per_step"] > 0, prof
+assert any("math_ops" in src for src, _ in prof["rows"]), prof["rows"]
+print("DEVICE_PROFILE_OK")
+'''
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([sys.executable, "-c", child], env=env,
+                       capture_output=True, text=True, timeout=240)
+    assert "DEVICE_PROFILE_OK" in r.stdout, (r.stdout[-500:],
+                                             r.stderr[-1500:])

@@ -1,104 +1,55 @@
 """Per-fusion device-time profile of the north-star ERNIE step.
 
-Runs a few bench-identical steps under jax.profiler.trace and aggregates
-the TPU plane's XEvents by HLO op, bucketed into forward / backward /
-optimizer / other via the op_name metadata XLA carries from jaxprs
-(jit(fn)/... paths name the originating framework op). Output: top-N
-table + bucket totals — the measured answer to "where do the backward's
-extra milliseconds live".
+Thin driver over paddle_tpu.profiler.device_profile (the jax-profiler
+trace works through the axon relay): builds the bench-identical program,
+runs a few steps under the trace, and prints exclusive device time per
+framework source line. This is the tool that located the 183 ms
+attention backward in the 480 ms round-4 step.
 
-Usage: python tools/profile_ernie.py [--steps 4] [--top 40] [--batch 34]
+Usage: python tools/profile_ernie.py [--steps 4] [--top 25] [--batch 34]
 """
 
 from __future__ import annotations
 
 import argparse
-import collections
-import glob
-import gzip
-import json
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def run_and_trace(steps, batch, outdir="/tmp/ernie_prof"):
-    import jax
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--top", type=int, default=25)
+    ap.add_argument("--batch", type=int, default=34)
+    args = ap.parse_args()
+
     import jax.numpy as jnp
 
     import paddle_tpu as pt
+    from paddle_tpu import profiler
     from paddle_tpu.models import bert
     from tools.ablate_ernie import build
 
-    cfg, main, startup, loss_v = build()
+    cfg, mainp, startup, loss_v = build()
     exe = pt.Executor()
     scope = pt.Scope()
     exe.run(startup, scope=scope, use_compiled=False)
     feed = {k: jnp.asarray(v) for k, v in bert.synthetic_pretraining_batch(
-        cfg, batch, 512, seed=0, max_predictions_per_seq=80).items()}
+        cfg, args.batch, 512, seed=0,
+        max_predictions_per_seq=80).items()}
     # warm both cache entries (fetch / no-fetch)
-    exe.run(main, feed=feed, fetch_list=[loss_v], scope=scope)
-    exe.run(main, feed=feed, fetch_list=[], scope=scope)
-    with jax.profiler.trace(outdir):
-        for _ in range(steps):
-            exe.run(main, feed=feed, fetch_list=[], scope=scope)
-        out = exe.run(main, feed=feed, fetch_list=[loss_v], scope=scope)
-    return outdir, float(out[0])
+    exe.run(mainp, feed=feed, fetch_list=[loss_v], scope=scope)
+    exe.run(mainp, feed=feed, fetch_list=[], scope=scope)
 
-
-def load_device_events(outdir):
-    paths = sorted(glob.glob(f"{outdir}/plugins/profile/*/*.trace.json.gz"))
-    d = json.load(gzip.open(paths[-1]))
-    ev = d.get("traceEvents", [])
-    dev_pids = {e["pid"] for e in ev
-                if e.get("ph") == "M" and e.get("name") == "process_name"
-                and "TPU" in str(e["args"].get("name"))}
-    return [e for e in ev if e.get("ph") == "X" and e["pid"] in dev_pids]
-
-
-def bucket_of(opname):
-    # jaxpr op_name paths carry the framework op lineage; the executor's
-    # backward ops re-trace via __vjp_grad__, optimizer ops are adamw/...
-    s = opname or ""
-    low = s.lower()
-    if "transpose(" in low or "vjp" in low or "_grad" in low:
-        return "backward"
-    if any(t in low for t in ("adamw", "adam/", "momentum", "sgd",
-                              "global_norm", "clip")):
-        return "optimizer"
-    return "fwd_or_other"
-
-
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--steps", type=int, default=4)
-    ap.add_argument("--top", type=int, default=40)
-    ap.add_argument("--batch", type=int, default=34)
-    args = ap.parse_args()
-
-    outdir, loss = run_and_trace(args.steps, args.batch)
-    events = load_device_events(outdir)
-    total_us = sum(e.get("dur", 0) for e in events)
-    print(f"{len(events)} device events, {total_us/1e3:.1f} ms total "
-          f"over {args.steps} steps -> {total_us/1e3/args.steps:.1f} ms/step"
-          f"  (loss {loss:.4f})")
-
-    by_name = collections.defaultdict(lambda: [0, 0, ""])
-    for e in events:
-        a = e.get("args") or {}
-        key = a.get("long_name") or e.get("name", "?")
-        src = a.get("source") or ""
-        by_name[key][0] += e.get("dur", 0)
-        by_name[key][1] += 1
-        if src:
-            by_name[key][2] = src
-    rows = sorted(by_name.items(), key=lambda kv: -kv[1][0])
-    print(f"\n{'us/step':>9} {'n':>4}  name")
-    for k, (dur, n, src) in rows[:args.top]:
-        print(f"{dur/args.steps:>9.0f} {n:>4}  {k[:140]}")
-        if src:
-            print(f"{'':>15}{src[:120]}")
+    prof = profiler.device_profile(
+        lambda: exe.run(mainp, feed=feed, fetch_list=[], scope=scope),
+        steps=args.steps)
+    print(f"exclusive device total {prof['ms_per_step']:.1f} ms/step "
+          f"over {args.steps} steps")
+    for src, ms in prof["rows"][:args.top]:
+        print(f"{ms:8.2f} ms  {src[:100]}")
 
 
 if __name__ == "__main__":
