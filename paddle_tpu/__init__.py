@@ -49,6 +49,15 @@ from . import dygraph  # noqa: F401
 from .dygraph import jit  # noqa: F401
 from .tensor import to_tensor  # noqa: F401
 
+
+def summary(net, input_size, dtypes=None):
+    """paddle.summary — per-layer table for a dygraph Layer
+    (reference: hapi/model_summary.py)."""
+    from .hapi import summary as _summary
+
+    return _summary(net, input_size, dtypes=dtypes)
+
+
 __version__ = "0.1.0"
 
 # fluid-compat namespace: `import paddle_tpu.fluid as fluid` style usage is

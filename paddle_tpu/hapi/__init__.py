@@ -3,3 +3,4 @@
 from .callbacks import (Callback, EarlyStopping, ModelCheckpoint,  # noqa: F401
                         ProgBarLogger)
 from .model import Model  # noqa: F401
+from .model_summary import summary  # noqa: F401

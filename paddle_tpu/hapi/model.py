@@ -53,6 +53,20 @@ class Model:
     def parameters(self):
         return self.network.parameters()
 
+    def summary(self, input_size=None, dtype=None):
+        """Per-layer table (reference: hapi/model.py Model.summary) —
+        input_size defaults to the shapes of the Model's input specs."""
+        from .model_summary import summary as _summary
+
+        if input_size is None:
+            shapes = [tuple(getattr(i, "shape", ())) for i in self._inputs]
+            if not shapes or not all(shapes):
+                raise ValueError(
+                    "summary needs input_size (the Model was built "
+                    "without input specs carrying shapes)")
+            input_size = shapes
+        return _summary(self.network, input_size, dtypes=dtype)
+
     # -- one-batch ops --------------------------------------------------------
     def _compute_loss(self, outputs, labels):
         return self._loss(*_as_list(outputs), *_as_list(labels))

@@ -119,3 +119,41 @@ def test_early_stopping_stops():
     model.fit(ds, eval_data=ds, batch_size=16, epochs=50, verbose=0,
               callbacks=[es])
     assert model.stop_training
+
+
+def test_summary_reports_layerwise_params():
+    """reference: hapi/model_summary.py — summary walks the Layer tree
+    with forward hooks and returns the param totals."""
+    import paddle_tpu as pt
+    from paddle_tpu import nn
+
+    with pt.dygraph.guard():
+        net = nn.Sequential(nn.Linear(32, 16), nn.ReLU(), nn.Linear(16, 4))
+        info = pt.summary(net, (1, 32))
+        assert info["total_params"] == 32 * 16 + 16 + 16 * 4 + 4
+        assert info["trainable_params"] == info["total_params"]
+        m = pt.hapi.Model(net)
+        assert m.summary(input_size=(1, 32)) == info
+        # frozen params drop out of trainable
+        for p in net[0].parameters():
+            p.stop_gradient = True
+        info2 = pt.summary(net, (1, 32))
+        assert info2["total_params"] == info["total_params"]
+        assert info2["trainable_params"] == 16 * 4 + 4
+
+
+def test_summary_preserves_training_mode():
+    """Regression (round-4 review): summary must not flip a training
+    net into eval as a side effect."""
+    import paddle_tpu as pt
+    from paddle_tpu import nn
+
+    with pt.dygraph.guard():
+        net = nn.Sequential(nn.Linear(8, 8), nn.Dropout(0.5))
+        net.train()
+        pt.summary(net, (1, 8))
+        assert all(lyr.training for lyr in net.sublayers(include_self=True))
+        import pytest
+
+        with pytest.raises(ValueError, match="dtypes length"):
+            pt.summary(net, [(1, 8), (1, 8)], dtypes=["float32"])
