@@ -50,19 +50,14 @@ def summary(net, input_size, dtypes=None):
 
     def make_hook(name, layer):
         def hook(lyr, ins, out):
-            n_params = 0
-            trainable = 0
-            for p in layer.parameters(include_sublayers=False):
-                n = int(np.prod(p.shape)) if p.shape else 1
-                n_params += n
-                if not getattr(p, "stop_gradient", False):
-                    trainable += n
+            n_params = sum(
+                int(np.prod(p.shape)) if p.shape else 1
+                for p in layer.parameters(include_sublayers=False))
             rows.append({
                 "name": f"{type(layer).__name__}-{name}" if name
                         else type(layer).__name__,
                 "output_shape": _shape_of(out),
                 "params": n_params,
-                "trainable": trainable,
             })
 
         return hook

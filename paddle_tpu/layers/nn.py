@@ -185,9 +185,19 @@ def pool2d(input: Variable, pool_size=2, pool_type: str = "max", pool_stride=Non
 
 
 def adaptive_pool2d(input, pool_size, pool_type="avg", name=None):
-    if tuple(_pair(pool_size)) != (1, 1):
-        raise NotImplementedError("adaptive_pool2d only supports output 1x1")
-    return pool2d(input, pool_type=pool_type, global_pooling=True, name=name)
+    """reference: layers/nn.py adaptive_pool2d — pool_size is the OUTPUT
+    size; the pool2d op implements the reference floor/ceil cell bounds
+    for any output (1x1 lowers to a global reduction)."""
+    size = tuple(_pair(pool_size))
+    if size == (1, 1):
+        return pool2d(input, pool_type=pool_type, global_pooling=True,
+                      name=name)
+    helper = LayerHelper("adaptive_pool2d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("pool2d", {"X": [input]}, {"Out": [out]},
+                     {"ksize": list(size), "pooling_type": pool_type,
+                      "adaptive": True})
+    return out
 
 
 def batch_norm(input: Variable, act: Optional[str] = None, is_test: bool = False,

@@ -98,6 +98,29 @@ def pool2d(ins, attrs):
         out = (jnp.max(x, axis=axis, keepdims=True) if ptype == "max"
                else jnp.mean(x, axis=axis, keepdims=True))
         return {"Out": out}
+    if attrs.get("adaptive", False):
+        # adaptive semantics: ksize IS the OUTPUT size; cell (i, j)
+        # reduces x[floor(i*H/oh):ceil((i+1)*H/oh), ...] (reference
+        # pool_op.cc AdaptStartIndex/AdaptEndIndex) — NOT a fixed
+        # window, and well-defined even when output > input
+        oh, ow = tuple(attrs["ksize"])
+        H, W = int(x.shape[2]), int(x.shape[3])
+        red_axes = (lambda w, ax: jnp.max(w, axis=ax)) if ptype == "max" \
+            else (lambda w, ax: jnp.mean(w, axis=ax))
+        if H % oh == 0 and W % ow == 0:
+            # divisible: one reshape + one fused reduction (same trick
+            # as the spp op) instead of oh*ow slices
+            n, c = x.shape[0], x.shape[1]
+            w = x.reshape(n, c, oh, H // oh, ow, W // ow)
+            return {"Out": red_axes(w, (3, 5))}
+        rows = []
+        for i in range(oh):
+            h0, h1 = (i * H) // oh, -(-((i + 1) * H) // oh)
+            cols = [red_axes(
+                x[:, :, h0:h1, (j * W) // ow:-(-((j + 1) * W) // ow)],
+                (2, 3)) for j in range(ow)]
+            rows.append(jnp.stack(cols, axis=-1))
+        return {"Out": jnp.stack(rows, axis=-2)}
     ksize = tuple(attrs.get("ksize", [2, 2]))
     strides = tuple(attrs.get("strides", ksize))
     pad = _conv_padding(attrs)
@@ -139,6 +162,29 @@ def pool3d(ins, attrs):
         out = (jnp.max(x, axis=axis, keepdims=True) if ptype == "max"
                else jnp.mean(x, axis=axis, keepdims=True))
         return {"Out": out}
+    if attrs.get("adaptive", False):
+        # see pool2d: ksize is the OUTPUT size (adaptive cell bounds)
+        od, oh, ow = tuple(attrs["ksize"])
+        D, H, W = (int(s) for s in x.shape[2:])
+        red_axes = (lambda w, ax: jnp.max(w, axis=ax)) if ptype == "max" \
+            else (lambda w, ax: jnp.mean(w, axis=ax))
+        if D % od == 0 and H % oh == 0 and W % ow == 0:
+            n, c = x.shape[0], x.shape[1]
+            w = x.reshape(n, c, od, D // od, oh, H // oh, ow, W // ow)
+            return {"Out": red_axes(w, (3, 5, 7))}
+        planes = []
+        for d in range(od):
+            d0, d1 = (d * D) // od, -(-((d + 1) * D) // od)
+            rows = []
+            for i in range(oh):
+                h0, h1 = (i * H) // oh, -(-((i + 1) * H) // oh)
+                cols = [red_axes(
+                    x[:, :, d0:d1, h0:h1,
+                      (j * W) // ow:-(-((j + 1) * W) // ow)],
+                    (2, 3, 4)) for j in range(ow)]
+                rows.append(jnp.stack(cols, axis=-1))
+            planes.append(jnp.stack(rows, axis=-2))
+        return {"Out": jnp.stack(planes, axis=-3)}
     ksize = tuple(attrs.get("ksize", [2, 2, 2]))
     strides = tuple(attrs.get("strides", ksize))
     pad = _conv_padding(attrs, spatial_rank=3)

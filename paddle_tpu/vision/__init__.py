@@ -1,4 +1,4 @@
 """paddle.vision parity: transforms + datasets (reference:
 python/paddle/vision/)."""
 
-from . import datasets, transforms  # noqa: F401
+from . import datasets, models, transforms  # noqa: F401

@@ -1,0 +1,100 @@
+"""paddle.vision.models zoo (reference: python/paddle/vision/models/) —
+construction, forward shapes, head/pool switches, and one training step."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def _x(b=2, c=3, hw=32, seed=0):
+    return pt.to_tensor(np.random.RandomState(seed).randn(
+        b, c, hw, hw).astype(np.float32))
+
+
+class TestVisionModels:
+    def test_lenet_shapes_and_headless(self):
+        from paddle_tpu.vision import models as M
+
+        with pt.dygraph.guard():
+            x = pt.to_tensor(np.random.RandomState(0).randn(
+                2, 1, 28, 28).astype(np.float32))
+            assert tuple(M.LeNet()(x).shape) == (2, 10)
+            feat = M.LeNet(num_classes=0)(x)
+            assert tuple(feat.shape) == (2, 16, 5, 5)
+
+    @pytest.mark.parametrize("ctor,classes", [
+        ("resnet18", 7), ("resnet50", 5), ("vgg11", 4)])
+    def test_backbones_forward(self, ctor, classes):
+        from paddle_tpu.vision import models as M
+
+        with pt.dygraph.guard():
+            net = getattr(M, ctor)(num_classes=classes)
+            out = net(_x())
+            assert tuple(out.shape) == (2, classes)
+
+    def test_mobilenets_forward(self):
+        from paddle_tpu.vision import models as M
+
+        with pt.dygraph.guard():
+            assert tuple(M.mobilenet_v1(scale=0.25, num_classes=3)(
+                _x()).shape) == (2, 3)
+            assert tuple(M.mobilenet_v2(scale=0.25, num_classes=3)(
+                _x()).shape) == (2, 3)
+
+    def test_pretrained_raises(self):
+        from paddle_tpu.vision import models as M
+
+        with pytest.raises(ValueError, match="pretrained"):
+            M.resnet18(pretrained=True)
+
+    def test_resnet18_trains_a_step(self):
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.vision import models as M
+
+        with pt.dygraph.guard():
+            net = M.resnet18(num_classes=4)
+            opt = pt.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=net.parameters())
+            x = _x(b=4)
+            y = pt.to_tensor(np.array([[0], [1], [2], [3]], np.int64))
+            losses = []
+            for _ in range(3):
+                loss = F.cross_entropy(net(x), y)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                losses.append(float(np.asarray(loss.numpy())))
+            assert all(np.isfinite(losses))
+            assert losses[-1] < losses[0]
+
+
+class TestAdaptivePoolSemantics:
+    """Regression: adaptive pool's ksize is the OUTPUT size with
+    reference cell bounds floor(i*H/oh):ceil((i+1)*H/oh) — previously it
+    was treated as a fixed window (wrong off the divisible case, empty
+    output when output > input, as VGG at 32x32 exposed)."""
+
+    def test_non_divisible_and_upsample(self):
+        import paddle_tpu.nn.functional as F
+
+        with pt.dygraph.guard():
+            xa = np.arange(2 * 3 * 6 * 6, dtype=np.float32).reshape(
+                2, 3, 6, 6)
+            x = pt.to_tensor(xa)
+            y = np.asarray(F.adaptive_avg_pool2d(x, (4, 4)).numpy())
+            for i in range(4):
+                h0, h1 = (i * 6) // 4, -(-((i + 1) * 6) // 4)
+                for j in range(4):
+                    w0, w1 = (j * 6) // 4, -(-((j + 1) * 6) // 4)
+                    np.testing.assert_allclose(
+                        y[:, :, i, j], xa[:, :, h0:h1, w0:w1].mean((2, 3)),
+                        rtol=1e-6)
+            ym = np.asarray(F.adaptive_max_pool2d(x, (4, 4)).numpy())
+            assert ym[0, 0, 0, 0] == xa[0, 0, :2, :2].max()
+            small = pt.to_tensor(np.random.RandomState(1).randn(
+                1, 2, 1, 1).astype(np.float32))
+            up = np.asarray(F.adaptive_avg_pool2d(small, (7, 7)).numpy())
+            np.testing.assert_allclose(
+                up, np.broadcast_to(np.asarray(small.numpy()),
+                                    (1, 2, 7, 7)), rtol=1e-6)
