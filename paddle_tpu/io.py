@@ -363,3 +363,11 @@ def load_inference_model(dirname: str, executor=None,
     load_vars(executor, dirname, program, predicate=is_persistable,
               filename=params_filename, scope=scope)
     return program, doc["feed_names"], doc["fetch_names"]
+
+
+# -- paddle.io 2.0 dataset/loader namespace (reference: python/paddle/io)
+# The implementations live in reader.py (multiprocess workers,
+# shared-memory transport); paddle.io re-exports them.
+from .reader import (BatchSampler, ComposeDataset, DataLoader,  # noqa: E402,F401
+                     Dataset, IterableDataset, RandomSampler, Sampler,
+                     TensorDataset)
