@@ -7,4 +7,5 @@ over jax.distributed + mesh sharding instead of NCCL/gRPC stacks.
 from . import fleet  # noqa: F401
 from .collective import (ReduceOp, all_gather, all_reduce, barrier,  # noqa: F401
                          broadcast, get_rank, get_world_size, reduce, scatter)
-from .parallel import init_parallel_env  # noqa: F401
+from .parallel import (ParallelEnv, init_parallel_env,  # noqa: F401
+                       spawn)

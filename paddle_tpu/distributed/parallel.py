@@ -44,3 +44,122 @@ def get_world_size() -> int:
     import jax
 
     return jax.process_count()
+
+
+class ParallelEnv:
+    """Env-var accessor for the distributed context (reference:
+    fluid/dygraph/parallel.py ParallelEnv — rank/world_size/endpoints
+    from the PADDLE_* env the launcher sets)."""
+
+    @property
+    def rank(self) -> int:
+        return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+    # reference alias
+    local_rank = rank
+
+    @property
+    def world_size(self) -> int:
+        return int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+
+    nranks = world_size
+
+    @property
+    def device_id(self) -> int:
+        # reference semantics: first entry of a possibly comma-separated
+        # selected-devices list
+        raw = os.environ.get("FLAGS_selected_gpus",
+                             os.environ.get("PADDLE_LOCAL_DEVICE_ID", "0"))
+        first = raw.split(",")[0].strip()
+        return int(first) if first else 0
+
+    @property
+    def current_endpoint(self) -> str:
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else []
+
+
+def _spawn_target(func, rank, nprocs, coordinator, env_overrides, args):
+    os.environ.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(nprocs),
+        "PADDLE_COORDINATOR_ADDR": coordinator,
+        "JAX_COORDINATOR_ADDRESS": coordinator,
+    })
+    os.environ.update(env_overrides)
+    func(*args)
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Launch `func` in nprocs fresh processes with the PADDLE_* cluster
+    env set per rank (reference: distributed/spawn.py — there it
+    assigns one GPU per process; here each process is one jax host
+    joining the coordination service, so `func` typically starts with
+    init_parallel_env()).
+
+    Uses the 'spawn' start method: children must re-import jax cleanly —
+    forking a process with an initialised backend deadlocks."""
+    import multiprocessing as mp
+    import socket
+
+    if nprocs <= 0:
+        nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    coordinator = options.pop("coordinator", None)
+    if coordinator is None:
+        # probe-then-release has an inherent TOCTOU window (another
+        # process can grab the port before rank 0's coordination
+        # service binds it) — fine for a single launcher per host;
+        # concurrent launchers should pass coordinator= explicitly
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            coordinator = f"127.0.0.1:{s.getsockname()[1]}"
+    env_overrides = {str(k): str(v)
+                     for k, v in options.pop("env", {}).items()}
+    if options:
+        raise TypeError(f"spawn: unknown options {sorted(options)}")
+
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_spawn_target,
+                        args=(func, rank, nprocs, coordinator,
+                              env_overrides, tuple(args)),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if not join:
+        return procs
+    # first failure terminates the survivors (reference mp.spawn
+    # semantics): a crashed rank leaves its peers blocked in the
+    # collective rendezvous, so a plain sequential join would hang
+    import time
+
+    failed = []
+    try:
+        while True:
+            alive = False
+            for rank, p in enumerate(procs):
+                if p.is_alive():
+                    alive = True
+                elif p.exitcode not in (0, None) and \
+                        (rank, p.exitcode) not in failed:
+                    failed.append((rank, p.exitcode))
+            if failed or not alive:
+                break
+            time.sleep(0.1)
+    finally:
+        if failed:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+        for p in procs:
+            p.join()
+    if failed:
+        raise RuntimeError(
+            f"spawn: {len(failed)} of {nprocs} processes failed "
+            f"(rank, exitcode): {failed}; surviving ranks terminated")
+    return procs

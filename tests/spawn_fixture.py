@@ -1,0 +1,44 @@
+"""Child entry for test_distributed spawn tests (module-level so the
+'spawn' start method can pickle it)."""
+
+import json
+import os
+
+
+def write_env_info(out_dir):
+    # sitecustomize pins JAX_PLATFORMS=axon; the env var alone is not
+    # enough in a fresh interpreter — force the CPU platform via config
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu.distributed as dist
+
+    env = dist.ParallelEnv()
+    initialized = dist.init_parallel_env()
+    import jax
+
+    info = {"rank": env.rank, "world_size": env.world_size,
+            "initialized": initialized,
+            "process_index": jax.process_index(),
+            "process_count": jax.process_count()}
+    with open(os.path.join(out_dir, f"rank{env.rank}.json"), "w") as f:
+        json.dump(info, f)
+    # barrier before exit: rank 0 hosts the coordination service — if it
+    # returns first the service dies under the still-joining peers
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices("spawn_fixture_done")
+
+
+def crash_on_rank1(out_dir):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu.distributed as dist
+
+    if dist.ParallelEnv().rank == 1:
+        raise RuntimeError("boom")  # peers are left blocked in rendezvous
+    dist.init_parallel_env()  # blocks waiting for the crashed peer

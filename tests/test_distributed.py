@@ -4,6 +4,8 @@ Mirrors the reference's dist-test strategy (test_dist_base.py:1007 loss
 parity 1→N workers) — here single-process over mesh slices (SURVEY.md §4).
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -98,3 +100,57 @@ def test_graft_dryrun_multichip():
     import __graft_entry__ as graft
 
     graft.dryrun_multichip(8)
+
+
+@pytest.mark.skipif(os.environ.get("PT_SKIP_MULTIPROC") == "1",
+                    reason="multiprocess rendezvous disabled")
+def test_spawn_launches_cluster(tmp_path):
+    """distributed.spawn (reference: distributed/spawn.py) must start
+    nprocs fresh processes with the per-rank PADDLE_* env and a shared
+    coordination service that jax.distributed joins."""
+    import json
+
+    import paddle_tpu.distributed as dist
+    from tests.spawn_fixture import write_env_info
+
+    dist.spawn(write_env_info, args=(str(tmp_path),), nprocs=2)
+    infos = []
+    for r in range(2):
+        with open(tmp_path / f"rank{r}.json") as f:
+            infos.append(json.load(f))
+    assert [i["rank"] for i in infos] == [0, 1]
+    assert all(i["world_size"] == 2 for i in infos)
+    assert all(i["initialized"] for i in infos)
+    assert all(i["process_count"] == 2 for i in infos)
+    assert sorted(i["process_index"] for i in infos) == [0, 1]
+
+
+def test_parallel_env_reads_cluster_vars(monkeypatch):
+    import paddle_tpu.distributed as dist
+
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "3")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "8")
+    monkeypatch.setenv("PADDLE_TRAINER_ENDPOINTS",
+                       "10.0.0.1:6170,10.0.0.2:6170")
+    monkeypatch.setenv("PADDLE_CURRENT_ENDPOINT", "10.0.0.2:6170")
+    env = dist.ParallelEnv()
+    assert env.rank == 3 and env.world_size == 8
+    assert env.trainer_endpoints == ["10.0.0.1:6170", "10.0.0.2:6170"]
+    assert env.current_endpoint == "10.0.0.2:6170"
+
+
+@pytest.mark.skipif(os.environ.get("PT_SKIP_MULTIPROC") == "1",
+                    reason="multiprocess rendezvous disabled")
+def test_spawn_terminates_survivors_on_failure(tmp_path):
+    """A crashed rank must not hang the launcher: the surviving rank
+    (blocked in the collective rendezvous) is terminated and spawn
+    raises promptly (reference mp.spawn semantics)."""
+    import time
+
+    import paddle_tpu.distributed as dist
+    from tests.spawn_fixture import crash_on_rank1
+
+    t0 = time.time()
+    with pytest.raises(RuntimeError, match="1 of 2 processes failed"):
+        dist.spawn(crash_on_rank1, args=(str(tmp_path),), nprocs=2)
+    assert time.time() - t0 < 60  # far below the rendezvous timeout
