@@ -34,15 +34,12 @@ def main(argv=None):
         runpy.run_path(args.script, run_name="__main__")
         return 0
 
+    from .parallel import cluster_env
+
     procs = []
     for rank in range(args.nproc):
         env = dict(os.environ)
-        env.update({
-            "PADDLE_TRAINER_ID": str(rank),
-            "PADDLE_TRAINERS_NUM": str(args.nproc),
-            "PADDLE_COORDINATOR_ADDR": args.coordinator,
-            "JAX_COORDINATOR_ADDRESS": args.coordinator,
-        })
+        env.update(cluster_env(rank, args.nproc, args.coordinator))
         procs.append(subprocess.Popen(
             [sys.executable, args.script] + args.script_args, env=env))
     rc = 0

@@ -83,13 +83,26 @@ class ParallelEnv:
         return eps.split(",") if eps else []
 
 
-def _spawn_target(func, rank, nprocs, coordinator, env_overrides, args):
-    os.environ.update({
+def cluster_env(rank, nprocs, coordinator):
+    """Per-rank cluster env with the reference launcher's variable names
+    (shared by spawn and the launch CLI so they cannot drift). Trainer
+    endpoints are synthesized from the coordinator address — under
+    jax.distributed the coordination service is the only real endpoint,
+    but reference-ported code expects the list to be populated."""
+    host, port = coordinator.rsplit(":", 1)
+    endpoints = [f"{host}:{int(port) + 1 + r}" for r in range(nprocs)]
+    return {
         "PADDLE_TRAINER_ID": str(rank),
         "PADDLE_TRAINERS_NUM": str(nprocs),
         "PADDLE_COORDINATOR_ADDR": coordinator,
         "JAX_COORDINATOR_ADDRESS": coordinator,
-    })
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+        "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+    }
+
+
+def _spawn_target(func, rank, nprocs, coordinator, env_overrides, args):
+    os.environ.update(cluster_env(rank, nprocs, coordinator))
     os.environ.update(env_overrides)
     func(*args)
 

@@ -115,6 +115,35 @@ class MSRA(Initializer):
             Normal(0.0, std, self.seed)(var, block)
 
 
+class Bilinear(Initializer):
+    """reference: initializer.py BilinearInitializer — fills transposed-
+    conv weights [C_out, C_in, H, W] with the bilinear upsampling kernel
+    (every channel pair gets the same separable (1-|x/f-c|) kernel)."""
+
+    def __call__(self, var, block):
+        shape = var.shape
+        if len(shape) != 4:
+            raise ValueError(
+                f"Bilinear initializer needs a 4-D conv weight, got "
+                f"shape {tuple(shape)}")
+        h, w = int(shape[2]), int(shape[3])
+        f = math.ceil(w / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+        kernel = ((1 - np.abs(xx / f - c)) * (1 - np.abs(yy / f - c))
+                  ).astype(np.dtype(var.dtype))
+        # serialize only the [H, W] kernel and broadcast in-graph: a
+        # 256x256x16x16 weight would otherwise flatten 16.7M floats
+        # into the op attrs
+        tmp = block.create_var(
+            name=f"{var.name}@bilinear_kernel",
+            shape=(1, 1, h, w), dtype=str(kernel.dtype))
+        NumpyArrayInitializer(kernel.reshape(1, 1, h, w))(tmp, block)
+        block.append_op("broadcast_to", {"X": [tmp.name]},
+                        {"Out": [var.name]},
+                        {"shape": [int(d) for d in shape]})
+
+
 class NumpyArrayInitializer(Initializer):
     def __init__(self, value: np.ndarray):
         self.value = np.asarray(value)
@@ -133,6 +162,7 @@ NormalInitializer = Normal
 TruncatedNormalInitializer = TruncatedNormal
 XavierInitializer = Xavier
 MSRAInitializer = MSRA
+BilinearInitializer = Bilinear
 KaimingUniform = MSRA
 
 

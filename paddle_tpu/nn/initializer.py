@@ -1,0 +1,40 @@
+"""paddle.nn.initializer — the 2.0 initializer namespace (reference:
+python/paddle/nn/initializer/__init__.py DEFINE_ALIAS layer over the
+fluid initializers)."""
+
+from __future__ import annotations
+
+from ..initializer import (Bilinear, Constant, Normal,  # noqa: F401
+                           NumpyArrayInitializer, TruncatedNormal, Uniform,
+                           Xavier, MSRA)
+
+Assign = NumpyArrayInitializer
+
+
+class XavierNormal(Xavier):
+    """reference: nn/initializer/xavier.py XavierNormal."""
+
+    def __init__(self, fan_in=None, fan_out=None, name=None):
+        super().__init__(uniform=False, fan_in=fan_in, fan_out=fan_out)
+
+
+class XavierUniform(Xavier):
+    def __init__(self, fan_in=None, fan_out=None, name=None):
+        super().__init__(uniform=True, fan_in=fan_in, fan_out=fan_out)
+
+
+class KaimingNormal(MSRA):
+    """reference: nn/initializer/kaiming.py KaimingNormal."""
+
+    def __init__(self, fan_in=None, name=None):
+        super().__init__(uniform=False, fan_in=fan_in)
+
+
+class KaimingUniform(MSRA):
+    def __init__(self, fan_in=None, name=None):
+        super().__init__(uniform=True, fan_in=fan_in)
+
+
+__all__ = ["Assign", "Bilinear", "Constant", "KaimingNormal",
+           "KaimingUniform", "Normal", "NumpyArrayInitializer",
+           "TruncatedNormal", "Uniform", "XavierNormal", "XavierUniform"]

@@ -62,3 +62,36 @@ def scope_guard(scope):
     from ..core.scope import scope_guard as _sg
 
     return _sg(scope)
+
+
+class InputSpec:
+    """paddle.static.InputSpec (reference: python/paddle/static/input.py)
+    — a shape/dtype/name signature for to_static / jit.save / hapi
+    Model inputs. -1 (or None) marks a dynamic dim."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(-1 if d is None else int(d) for d in shape)
+        self.dtype = str(dtype)
+        self.name = name
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tuple(tensor.shape), str(tensor.dtype),
+                   name or getattr(tensor, "name", None))
+
+    @classmethod
+    def from_numpy(cls, ndarray, name=None):
+        return cls(ndarray.shape, str(ndarray.dtype), name)
+
+    def batch(self, batch_size):
+        """Prepend a batch dim (reference semantics)."""
+        return InputSpec((batch_size,) + self.shape, self.dtype, self.name)
+
+    def unbatch(self):
+        if not self.shape:
+            raise ValueError("unbatch: spec has no dims")
+        return InputSpec(self.shape[1:], self.dtype, self.name)
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype!r}, "
+                f"name={self.name!r})")

@@ -22,7 +22,9 @@ def write_env_info(out_dir):
     info = {"rank": env.rank, "world_size": env.world_size,
             "initialized": initialized,
             "process_index": jax.process_index(),
-            "process_count": jax.process_count()}
+            "process_count": jax.process_count(),
+            "endpoints": env.trainer_endpoints,
+            "current_endpoint": env.current_endpoint}
     with open(os.path.join(out_dir, f"rank{env.rank}.json"), "w") as f:
         json.dump(info, f)
     # barrier before exit: rank 0 hosts the coordination service — if it

@@ -123,6 +123,9 @@ def test_spawn_launches_cluster(tmp_path):
     assert all(i["initialized"] for i in infos)
     assert all(i["process_count"] == 2 for i in infos)
     assert sorted(i["process_index"] for i in infos) == [0, 1]
+    for i in infos:
+        assert len(i["endpoints"]) == 2
+        assert i["current_endpoint"] == i["endpoints"][i["rank"]]
 
 
 def test_parallel_env_reads_cluster_vars(monkeypatch):

@@ -263,3 +263,50 @@ def test_softplus_beta_threshold_honored():
         np.testing.assert_allclose(got, want.astype(np.float32), rtol=1e-4)
         big = pt.to_tensor(np.array([100.0], np.float32))
         assert float(F.softplus(big).numpy()[0]) == 100.0
+
+
+def test_nn_initializer_namespace_and_bilinear():
+    """paddle.nn.initializer 2.0 namespace (reference DEFINE_ALIAS layer)
+    + BilinearInitializer upsampling kernel."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.nn import initializer as I
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        for i, init in enumerate([I.XavierNormal(), I.XavierUniform(),
+                                  I.KaimingNormal(), I.KaimingUniform(),
+                                  I.Assign(np.full((3, 4), 2.0,
+                                                   np.float32))]):
+            layers.create_parameter([3, 4], "float32", name=f"ini_p{i}",
+                                    default_initializer=init)
+        layers.create_parameter([2, 2, 4, 4], "float32", name="ini_bil",
+                                default_initializer=I.Bilinear())
+    exe = pt.Executor(pt.CPUPlace())
+    sc = pt.Scope()
+    exe.run(startup, scope=sc, use_compiled=False)
+    assert np.allclose(np.asarray(sc.find_var("ini_p4")), 2.0)
+    bw = np.asarray(sc.find_var("ini_bil"))
+    # all channel pairs share the separable bilinear kernel; centre
+    # (indices 1/2 of a 4-wide kernel with f=2, c=0.75) peaks at 0.75^2
+    np.testing.assert_allclose(bw[0, 0], bw[1, 1], rtol=1e-6)
+    assert abs(bw[0, 0, 1, 1] - 0.5625) < 1e-6
+    assert bw.min() >= 0.0 and bw.max() <= 1.0
+
+
+def test_static_input_spec():
+    """paddle.static.InputSpec (reference static/input.py)."""
+    import paddle_tpu as pt
+    from paddle_tpu.static import InputSpec
+
+    s = InputSpec([None, 784], "float32", "x")
+    assert s.shape == (-1, 784) and s.dtype == "float32"
+    assert s.batch(8).shape == (8, -1, 784)
+    assert s.unbatch().shape == (784,)
+    arr = np.zeros((4, 3), np.float32)
+    s2 = InputSpec.from_numpy(arr, name="a")
+    assert s2.shape == (4, 3) and s2.name == "a"
+    with pt.dygraph.guard():
+        t = pt.to_tensor(arr)
+        s3 = InputSpec.from_tensor(t)
+        assert s3.shape == (4, 3)
