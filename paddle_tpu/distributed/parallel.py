@@ -89,7 +89,10 @@ def cluster_env(rank, nprocs, coordinator):
     endpoints are synthesized from the coordinator address — under
     jax.distributed the coordination service is the only real endpoint,
     but reference-ported code expects the list to be populated."""
-    host, port = coordinator.rsplit(":", 1)
+    host, sep, port = coordinator.rpartition(":")
+    if not sep or not port.isdigit():
+        raise ValueError(
+            f"coordinator must be host:port, got {coordinator!r}")
     endpoints = [f"{host}:{int(port) + 1 + r}" for r in range(nprocs)]
     return {
         "PADDLE_TRAINER_ID": str(rank),

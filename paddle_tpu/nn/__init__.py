@@ -19,6 +19,7 @@ from ..dygraph.layers import Layer
 from ..initializer import Constant, Normal, Uniform, Xavier
 from . import functional
 from . import functional as F
+from . import initializer
 
 __all__ = [
     "Layer", "Linear", "Conv2D", "Conv2DTranspose", "Embedding", "Dropout",

@@ -4,11 +4,24 @@ fluid initializers)."""
 
 from __future__ import annotations
 
-from ..initializer import (Bilinear, Constant, Normal,  # noqa: F401
-                           NumpyArrayInitializer, TruncatedNormal, Uniform,
-                           Xavier, MSRA)
+from ..initializer import (Bilinear, Constant,  # noqa: F401
+                           NumpyArrayInitializer, Uniform, Xavier, MSRA)
+from ..initializer import Normal as _FluidNormal
+from ..initializer import TruncatedNormal as _FluidTruncatedNormal
 
 Assign = NumpyArrayInitializer
+
+
+class Normal(_FluidNormal):
+    """2.0 signature (reference nn/initializer/normal.py): mean/std."""
+
+    def __init__(self, mean=0.0, std=1.0, name=None):
+        super().__init__(loc=mean, scale=std)
+
+
+class TruncatedNormal(_FluidTruncatedNormal):
+    def __init__(self, mean=0.0, std=1.0, name=None):
+        super().__init__(loc=mean, scale=std)
 
 
 class XavierNormal(Xavier):

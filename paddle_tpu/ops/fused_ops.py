@@ -206,3 +206,32 @@ def fusion_group(ins, attrs):
             for n, v in zip(names, vals):
                 env[n] = v
     return {"Out": [env[n] for n in attrs["ext_out_names"]]}
+
+
+@register_op("fusion_squared_mat_sub")
+def fusion_squared_mat_sub(ins, attrs):
+    """reference: fused/fusion_squared_mat_sub_op.cc —
+    ((X@Y)^2 - (X^2)@(Y^2)) * scalar, with the squared intermediates
+    exposed (AsIntermediate outputs)."""
+    x, y = ins["X"][0], ins["Y"][0]
+    scalar = float(attrs.get("scalar", 1.0))
+    sx = jnp.square(x)
+    sy = jnp.square(y)
+    sxy = jnp.square(jnp.matmul(x, y))
+    return {"SquaredX": sx, "SquaredY": sy, "SquaredXY": sxy,
+            "Out": (sxy - jnp.matmul(sx, sy)) * scalar}
+
+
+@register_op("fusion_repeated_fc_relu")
+def fusion_repeated_fc_relu(ins, attrs):
+    """reference: fused/fusion_repeated_fc_relu_op.cc — a chain of
+    relu(x @ W_i + b_i); every per-stage relu output is exposed
+    (ReluOut, AsIntermediate)."""
+    import jax
+
+    x = ins["X"][0]
+    relu_outs = []
+    for w, b in zip(ins["W"], ins["Bias"]):
+        x = jax.nn.relu(jnp.matmul(x, w) + b)
+        relu_outs.append(x)
+    return {"ReluOut": relu_outs[:-1], "Out": relu_outs[-1]}

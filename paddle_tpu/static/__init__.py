@@ -84,13 +84,17 @@ class InputSpec:
         return cls(ndarray.shape, str(ndarray.dtype), name)
 
     def batch(self, batch_size):
-        """Prepend a batch dim (reference semantics)."""
-        return InputSpec((batch_size,) + self.shape, self.dtype, self.name)
+        """Prepend a batch dim IN PLACE and return self (reference
+        static/input.py mutates the spec — ported code calls this as a
+        statement)."""
+        self.shape = (int(batch_size),) + self.shape
+        return self
 
     def unbatch(self):
         if not self.shape:
             raise ValueError("unbatch: spec has no dims")
-        return InputSpec(self.shape[1:], self.dtype, self.name)
+        self.shape = self.shape[1:]
+        return self
 
     def __repr__(self):
         return (f"InputSpec(shape={self.shape}, dtype={self.dtype!r}, "

@@ -301,8 +301,12 @@ def test_static_input_spec():
 
     s = InputSpec([None, 784], "float32", "x")
     assert s.shape == (-1, 784) and s.dtype == "float32"
-    assert s.batch(8).shape == (8, -1, 784)
-    assert s.unbatch().shape == (784,)
+    # batch/unbatch mutate in place and return self (reference
+    # static/input.py semantics — ported code calls them as statements)
+    s.batch(8)
+    assert s.shape == (8, -1, 784)
+    s.unbatch()
+    assert s.shape == (-1, 784)
     arr = np.zeros((4, 3), np.float32)
     s2 = InputSpec.from_numpy(arr, name="a")
     assert s2.shape == (4, 3) and s2.name == "a"

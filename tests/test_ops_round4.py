@@ -447,3 +447,40 @@ class TestBoundedScanTruncationGuard:
 
         with pytest.raises(ExecutionError, match="truncated"):
             self._run(20.0, 8)
+
+
+class TestFusedFamilyTail:
+    """fusion_squared_mat_sub + fusion_repeated_fc_relu (reference
+    fused/ kernels — thin compositions here, XLA fuses the chain)."""
+
+    def test_fusion_squared_mat_sub(self):
+        from paddle_tpu.core.registry import get
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(4, 5).astype(np.float32)
+        y = rng.randn(5, 6).astype(np.float32)
+        out = get("fusion_squared_mat_sub").forward(
+            {"X": [x], "Y": [y]}, {"scalar": 0.5})
+        want = ((x @ y) ** 2 - (x ** 2) @ (y ** 2)) * 0.5
+        np.testing.assert_allclose(np.asarray(out["Out"]), want,
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(out["SquaredX"]), x ** 2,
+                                   rtol=1e-6)
+
+    def test_fusion_repeated_fc_relu(self):
+        from paddle_tpu.core.registry import get
+
+        rng = np.random.RandomState(1)
+        x = rng.randn(3, 4).astype(np.float32)
+        ws = [rng.randn(4, 5).astype(np.float32),
+              rng.randn(5, 2).astype(np.float32)]
+        bs = [rng.randn(5).astype(np.float32),
+              rng.randn(2).astype(np.float32)]
+        out = get("fusion_repeated_fc_relu").forward(
+            {"X": [x], "W": ws, "Bias": bs}, {})
+        h = np.maximum(x @ ws[0] + bs[0], 0)
+        want = np.maximum(h @ ws[1] + bs[1], 0)
+        np.testing.assert_allclose(np.asarray(out["Out"]), want,
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(out["ReluOut"][0]), h,
+                                   rtol=1e-5, atol=1e-6)
