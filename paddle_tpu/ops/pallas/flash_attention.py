@@ -557,6 +557,14 @@ def _fwd_pallas(q, k, v, bias_kv, causal, scale, interpret,
     b, h, sq, d = q.shape
     sk = k.shape[2]
     g = _fused_g(sq, sk, h)
+    if not g and sq == sk and _fused_bwd_applies(sq, sk):
+        # FORWARD-only head-blocking in the single-block regime: with
+        # one (b,h) slice per cell the fwd (2 matmuls) is grid-overhead
+        # bound — ~1024 rows per cell fixed it (ERNIE step 336.8 ->
+        # 325.3 ms at g=2/S=512; bwd measured neutral and keeps g=1,
+        # its 5-matmul cells are already compute-filled). sq == sk keeps
+        # the per-cell k/v tiles bounded by the same row target.
+        g = _largest_divisor_leq(h, max(1, 1024 // sq))
     if g:
         return _fwd_pallas_fused_g(q, k, v, bias_kv, causal, scale,
                                    interpret, g, seed, rate)
@@ -900,6 +908,15 @@ def _fused_bwd_kernel_g(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
         preferred_element_type=jnp.float32).astype(dk_ref.dtype)
 
 
+def _largest_divisor_leq(h, want):
+    """Largest g in (1, want] dividing h (0 if none) — the head-block
+    size search shared by _fused_g and the fwd-only blocking."""
+    for g in range(min(want, h), 1, -1):
+        if h % g == 0:
+            return g
+    return 0
+
+
 def _fused_g(sq, sk, h):
     """Head-block size for the g-sliced fused kernels: pack g consecutive
     (b,h) slices so g*sq ~ 512 rows per cell. g must divide h so a cell
@@ -907,11 +924,7 @@ def _fused_g(sq, sk, h):
     Returns 0 when blocking is not applicable/beneficial."""
     if sq != sk or sq >= FUSED_MIN_SEQ or sq < 8:
         return 0
-    want = max(1, 512 // sq)
-    for g in range(min(want, h), 1, -1):
-        if h % g == 0:
-            return g
-    return 0
+    return _largest_divisor_leq(h, max(1, 512 // sq))
 
 
 # Fused single-block backward applies when one (Sq, Sk) f32 tile fits
