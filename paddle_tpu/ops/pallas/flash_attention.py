@@ -560,11 +560,12 @@ def _fwd_pallas(q, k, v, bias_kv, causal, scale, interpret,
     if not g and sq == sk and _fused_bwd_applies(sq, sk):
         # FORWARD-only head-blocking in the single-block regime: with
         # one (b,h) slice per cell the fwd (2 matmuls) is grid-overhead
-        # bound — ~1024 rows per cell fixed it (ERNIE step 336.8 ->
-        # 325.3 ms at g=2/S=512; bwd measured neutral and keeps g=1,
-        # its 5-matmul cells are already compute-filled). sq == sk keeps
-        # the per-cell k/v tiles bounded by the same row target.
-        g = _largest_divisor_leq(h, max(1, 1024 // sq))
+        # bound — bigger cells fixed it (ERNIE step 336.8 -> 325.3 ms at
+        # g=2/S=512, 324.7 at g=4; bwd measured neutral at g=2 and keeps
+        # g=1, its 5-matmul cells are already compute-filled). sq == sk
+        # keeps the per-cell k/v tiles bounded by the same row target;
+        # 4 x (S,S) f32 scores = 4 MB VMEM at S=512.
+        g = _largest_divisor_leq(h, max(1, 2048 // sq))
     if g:
         return _fwd_pallas_fused_g(q, k, v, bias_kv, causal, scale,
                                    interpret, g, seed, rate)
