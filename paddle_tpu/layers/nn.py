@@ -810,6 +810,10 @@ def flash_attention(q, k, v, bias=None, causal=False, scale=None,
     with a per-step position-keyed mask (recomputed in the backward)."""
     helper = LayerHelper("flash_attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype)
+    # saved log-sum-exp residual: lets the grad op run the bwd kernels
+    # from the saved forward instead of re-executing the fwd kernel
+    lse = helper.create_variable_for_type_inference("float32")
+    lse.stop_gradient = True
     inputs = {"Q": [q], "K": [k], "V": [v]}
     if bias is not None:
         inputs["Bias"] = [bias]
@@ -817,7 +821,8 @@ def flash_attention(q, k, v, bias=None, causal=False, scale=None,
     if scale is not None:
         attrs["scale"] = float(scale)
     _attn_dropout_attrs(attrs, dropout_rate, is_test, seed)
-    helper.append_op("flash_attention", inputs, {"Out": [out]}, attrs)
+    helper.append_op("flash_attention", inputs,
+                     {"Out": [out], "Lse": [lse]}, attrs)
     return out
 
 

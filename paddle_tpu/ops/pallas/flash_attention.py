@@ -1126,18 +1126,21 @@ def _bwd_pallas(q, k, v, bias_kv, causal, scale, interpret, o, lse, do,
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
 def _flash(q, k, v, bias_kv, seed, causal, scale, interpret, rate=0.0):
-    o, _ = _fwd_pallas(q, k, v, bias_kv, causal, scale, interpret,
+    """(out, lse). lse is an auxiliary output for the program-level saved-
+    residual backward (flash_attention_grad op); its cotangent is
+    DISCARDED by the custom vjp — do not build losses on lse."""
+    return _fwd_pallas(q, k, v, bias_kv, causal, scale, interpret,
                        seed, rate)
-    return o
 
 
 def _flash_fwd(q, k, v, bias_kv, seed, causal, scale, interpret, rate):
     o, lse = _fwd_pallas(q, k, v, bias_kv, causal, scale, interpret,
                          seed, rate)
-    return o, (q, k, v, bias_kv, seed, o, lse)
+    return (o, lse), (q, k, v, bias_kv, seed, o, lse)
 
 
-def _flash_bwd(causal, scale, interpret, rate, res, do):
+def _flash_bwd(causal, scale, interpret, rate, res, cts):
+    do, _dlse = cts          # lse is auxiliary; its cotangent is discarded
     q, k, v, bias_kv, seed, o, lse = res
     dq, dk, dv, dbias = _bwd_pallas(q, k, v, bias_kv, causal, scale,
                                     interpret, o, lse, do, seed, rate)
@@ -1228,6 +1231,45 @@ def _impl_choice(q, k):
     return "pallas" if scores_bytes >= PALLAS_MIN_SCORES_BYTES else "xla"
 
 
+def _dispatch_plan(q, k, bias):
+    """The implementation flash_attention() will take for these shapes:
+    ('pallas'|'pallas_interpret'|'xla'|'reference'|'reference_general',
+    bias_kv). bias_kv is the [B,Sk] key-bias normal form (None when bias
+    is None, or on the reference_general route which keeps the raw bias).
+    Shared by the forward, the op layer and the flash_attention_grad
+    lowering so the grad op's route always matches its forward's."""
+    from . import kernel_mode
+
+    bias_kv = None
+    if bias is not None:
+        b, sk = q.shape[0], k.shape[2]
+        bias_kv = jnp.broadcast_to(bias, (b, 1, 1, sk)).reshape(b, sk) \
+            if bias.ndim == 4 and bias.shape[1] == 1 and bias.shape[2] == 1 \
+            else (bias if bias.ndim == 2 else None)
+        if bias_kv is None:
+            return "reference_general", None
+    mode = kernel_mode()
+    if mode == "off":
+        return "reference", bias_kv
+    if mode == "tpu" and _impl_choice(q, k) == "xla":
+        return "xla", bias_kv
+    if not _supported(q, k, bias_kv):
+        import os
+        import warnings
+
+        if os.environ.get("PT_FLASH_IMPL", "").lower() == "pallas":
+            warnings.warn(
+                f"PT_FLASH_IMPL=pallas requested but shape "
+                f"q={tuple(q.shape)} k={tuple(k.shape)} fails the kernel's "
+                f"tiling constraints — falling back to the "
+                f"{'XLA recompute' if mode == 'tpu' else 'reference'} path",
+                stacklevel=3)
+        # pallas tiling unsupported: prefer the O(S)-residual XLA
+        # recompute path on TPU over the probs-saving reference path
+        return ("xla", bias_kv) if mode == "tpu" else ("reference", bias_kv)
+    return ("pallas_interpret" if mode == "interpret" else "pallas"), bias_kv
+
+
 def flash_attention(q, k, v, bias=None, causal=False, scale=None,
                     dropout_rate=0.0, dropout_seed=None):
     """softmax(q k^T * scale + bias) v, O(S)-memory in the backward.
@@ -1241,66 +1283,85 @@ def flash_attention(q, k, v, bias=None, causal=False, scale=None,
 
     Two fused implementations (both save only q/k/v/bias for backward):
       * 'xla' — plain XLA attention + recompute-backward custom_vjp;
-        fastest at moderate sequence lengths (softmax fuses into the
-        MXU matmuls, no kernel-launch granularity).
-      * 'pallas' — blockwise online-softmax kernels; never materialises
-        the [S,S] scores in HBM, wins when the transient scores buffer
-        would blow HBM.
-    Dispatch on the scores-buffer size (PALLAS_MIN_SCORES_BYTES);
-    override with PT_FLASH_IMPL=pallas|xla.
+        fastest below FUSED_MIN_SEQ=256 where tiny grid cells lose.
+      * 'pallas' — fused single-block / blockwise online-softmax kernels;
+        never materialises the [S,S] scores in HBM. Auto-routed for all
+        sq >= FUSED_MIN_SEQ; the scores-bytes threshold
+        (PALLAS_MIN_SCORES_BYTES) additionally forces pallas where XLA
+        cannot even compile (e.g. s=4096).
+    Override with PT_FLASH_IMPL=pallas|xla.
     """
-    from . import kernel_mode
+    out, _ = flash_attention_fwd_lse(q, k, v, bias, causal, scale,
+                                     dropout_rate, dropout_seed)
+    return out
 
+
+def flash_attention_fwd_lse(q, k, v, bias=None, causal=False, scale=None,
+                            dropout_rate=0.0, dropout_seed=None):
+    """flash_attention returning (out, lse).
+
+    lse [B,H,Sq] f32 is the log-sum-exp residual the saved-residual
+    program backward (flash_attention_grad op) needs; it is only
+    meaningful on the pallas routes — the xla/reference recompute paths
+    return zeros (their program backward re-traces the forward, whose
+    standard-HLO duplicate XLA CSEs away; only pallas custom-calls are
+    never CSE'd, which is why the saved-lse path exists)."""
     d = q.shape[-1]
     scale = float(scale) if scale is not None else 1.0 / float(np.sqrt(d))
     rate = float(dropout_rate or 0.0)
     seed = jnp.asarray(0 if dropout_seed is None else dropout_seed,
                        jnp.uint32)
+    route, bias_kv = _dispatch_plan(q, k, bias)
+    if route == "reference_general":
+        out = reference_attention(q, k, v, bias, causal, scale, rate, seed)
+    elif route == "reference":
+        out = reference_attention(q, k, v, bias_kv, causal, scale, rate,
+                                  seed)
+    elif route == "xla":
+        out = _xla_attention(q, k, v, bias_kv, seed, causal, scale, rate)
+    else:
+        # pad head dim only when it breaks sublane tiling (block covers
+        # the whole d, so any multiple of 8 is legal; zero pads don't
+        # change scores and padded v columns are sliced off)
+        dpad = d if d % 8 == 0 else int(np.ceil(d / 8) * 8)
+        qp, kp, vp = (_pad_head_dim(t, dpad) for t in (q, k, v))
+        if rate > 0.0:
+            _warn_lattice_wrap(q.shape[2], k.shape[2])
+        out, lse = _flash(qp, kp, vp, bias_kv, seed, causal, scale,
+                          route == "pallas_interpret", rate)
+        return out[..., :d], lse
+    b, h, sq = q.shape[0], q.shape[1], q.shape[2]
+    return out, jnp.zeros((b, h, sq), jnp.float32)
 
-    bias_kv = None
-    if bias is not None:
-        b, sk = q.shape[0], k.shape[2]
-        bias_kv = jnp.broadcast_to(bias, (b, 1, 1, sk)).reshape(b, sk) \
-            if bias.ndim == 4 and bias.shape[1] == 1 and bias.shape[2] == 1 \
-            else (bias if bias.ndim == 2 else None)
-        if bias_kv is None:
-            # general bias → reference path
-            return reference_attention(q, k, v, bias, causal, scale,
-                                       rate, seed)
 
-    mode = kernel_mode()
-    if mode == "off":
-        return reference_attention(q, k, v, bias_kv, causal, scale,
-                                   rate, seed)
-    if mode == "tpu" and _impl_choice(q, k) == "xla":
-        return _xla_attention(q, k, v, bias_kv, seed, causal, scale, rate)
-    if not _supported(q, k, bias_kv):
-        import os
-        import warnings
+def flash_attention_bwd(q, k, v, bias, out, lse, dout, causal=False,
+                        scale=None, dropout_rate=0.0, dropout_seed=None):
+    """Backward from the SAVED forward (out, lse): runs only the bwd
+    kernels — no forward re-execution (the vjp path re-runs the fwd
+    pallas custom-call, which XLA cannot CSE with the forward op's;
+    measured ~0.8 ms/layer of pure duplicate work on ERNIE-large).
 
-        if os.environ.get("PT_FLASH_IMPL", "").lower() == "pallas":
-            warnings.warn(
-                f"PT_FLASH_IMPL=pallas requested but shape "
-                f"q={tuple(q.shape)} k={tuple(k.shape)} fails the kernel's "
-                f"tiling constraints — falling back to the "
-                f"{'XLA recompute' if mode == 'tpu' else 'reference'} path",
-                stacklevel=2)
-        # pallas tiling unsupported: prefer the O(S)-residual XLA
-        # recompute path on TPU over the probs-saving reference path
-        if mode == "tpu":
-            return _xla_attention(q, k, v, bias_kv, seed, causal, scale,
-                                  rate)
-        return reference_attention(q, k, v, bias_kv, causal, scale,
-                                   rate, seed)
-
-    # pad head dim only when it breaks sublane tiling (block covers the
-    # whole d, so any multiple of 8 is legal; zero pads don't change
-    # scores and padded v columns are sliced off)
+    Only valid on the pallas routes — callers must check
+    _dispatch_plan(q, k, bias)[0].startswith('pallas') first.
+    Returns (dq, dk, dv, dbias_kv); dbias_kv is [B,Sk] (the key-bias
+    normal form) or None when bias is None."""
+    d = q.shape[-1]
+    scale = float(scale) if scale is not None else 1.0 / float(np.sqrt(d))
+    rate = float(dropout_rate or 0.0)
+    seed = jnp.asarray(0 if dropout_seed is None else dropout_seed,
+                       jnp.uint32)
+    route, bias_kv = _dispatch_plan(q, k, bias)
+    if not route.startswith("pallas"):
+        raise ValueError(
+            f"flash_attention_bwd called on the '{route}' route — the "
+            f"saved-lse backward only exists for the pallas kernels")
     dpad = d if d % 8 == 0 else int(np.ceil(d / 8) * 8)
-    qp, kp, vp = (_pad_head_dim(t, dpad) for t in (q, k, v))
-    if rate > 0.0:
-        _warn_lattice_wrap(q.shape[2], k.shape[2])
-    out = _flash(qp, kp, vp, bias_kv, seed, causal, scale,
-                 mode == "interpret", rate)
-    return out[..., :d]
+    qp, kp, vp, op_, dop = (_pad_head_dim(t, dpad)
+                            for t in (q, k, v, out, dout))
+    dq, dk, dv, dbias = _bwd_pallas(qp, kp, vp, bias_kv, causal, scale,
+                                    route == "pallas_interpret", op_, lse,
+                                    dop, seed, rate)
+    if dbias is not None and bias_kv is not None:
+        dbias = dbias.astype(bias_kv.dtype)
+    return dq[..., :d], dk[..., :d], dv[..., :d], dbias
 

@@ -366,8 +366,9 @@ class TestHeadBlockedFusedKernels:
         bias_kv = jnp.asarray((bias - 1.0) * 10000.0)
 
         def f(q, k, v, b):
-            return fa._flash(q, k, v, b, jnp.uint32(3), False,
-                             1.0 / np.sqrt(D), True, 0.1)
+            out, _lse = fa._flash(q, k, v, b, jnp.uint32(3), False,
+                                  1.0 / np.sqrt(D), True, 0.1)
+            return out
 
         def ref(q, k, v, b):
             return fa.reference_attention(
@@ -391,3 +392,119 @@ class TestHeadBlockedFusedKernels:
         assert fa._fused_g(128, 128, 7) == 0    # no divisor <= 4 > 1
         assert fa._fused_g(64, 64, 16) == 8     # 512//64=8 | 16
         assert fa._fused_g(256, 256, 16) == 0   # plain fused regime
+
+
+class TestSavedResidualGrad:
+    """Round 5: the flash_attention_grad op consumes the SAVED forward
+    (Out, Lse) — the program backward must contain it (not the generic
+    __vjp_grad__ that re-runs the fwd kernel) and its grads must match
+    the reference attention's."""
+
+    def _build(self, rate):
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+        from paddle_tpu.core.ir import Program, program_guard
+
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            q = layers.static_data("q", [2, 4, 256, 64], "float32")
+            k = layers.static_data("k", [2, 4, 256, 64], "float32")
+            v = layers.static_data("v", [2, 4, 256, 64], "float32")
+            bias = layers.static_data("bias", [2, 1, 1, 256], "float32")
+            for t in (q, k, v):
+                t.stop_gradient = False
+            out = layers.flash_attention(q, k, v, bias=bias,
+                                         dropout_rate=rate, seed=11)
+            loss = layers.reduce_sum(out * out)
+            from paddle_tpu.core.backward import gradients
+
+            gq, gk, gv = gradients([loss], [q, k, v])
+        return main, startup, loss, (gq, gk, gv)
+
+    def test_grad_op_emitted_and_matches_reference(self, interpret_mode,
+                                                   scope):
+        import paddle_tpu as pt
+        from paddle_tpu.ops.pallas.flash_attention import (
+            reference_attention)
+
+        main, startup, loss, grads = self._build(rate=0.1)
+        ops = main.global_block().ops
+        assert any(op.type == "flash_attention_grad" for op in ops)
+        assert not any(op.type == "__vjp_grad__" and
+                       op.attrs.get("fwd_type") == "flash_attention"
+                       for op in ops)
+
+        rng = np.random.RandomState(0)
+        feed = {n: rng.randn(2, 4, 256, 64).astype(np.float32) * 0.3
+                for n in ("q", "k", "v")}
+        feed["bias"] = np.where(rng.rand(2, 1, 1, 256) < 0.2, -10000.0,
+                                0.0).astype(np.float32)
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        got = exe.run(main, feed=feed, fetch_list=[loss, *grads],
+                      scope=scope)
+
+        # reference oracle with the same position-keyed dropout mask: seed
+        # attr 11 + the ACTUAL __step__ the main run used (the scope's
+        # counter post-run minus one — startup bumped it too)
+        from paddle_tpu.ops.attention_ops import _attn_dropout
+
+        step_used = int(scope.find_var("@STEP_COUNTER@")) - 1
+        rate, seed = _attn_dropout({"dropout_prob": 0.1, "seed": 11,
+                                    "__step__": np.int32(step_used)})
+        qj, kj, vj = (jnp.asarray(feed[n]) for n in ("q", "k", "v"))
+        bias_kv = jnp.asarray(feed["bias"]).reshape(2, 256)
+
+        def f(q_, k_, v_):
+            o = reference_attention(q_, k_, v_, bias_kv,
+                                    causal=False, scale=1.0 / np.sqrt(64),
+                                    dropout_rate=rate, dropout_seed=seed)
+            return jnp.sum(o * o)
+
+        ref_loss, ref_grads = jax.value_and_grad(f, argnums=(0, 1, 2))(
+            qj, kj, vj)
+        np.testing.assert_allclose(got[0], ref_loss, rtol=2e-4)
+        for g_, r_ in zip(got[1:], ref_grads):
+            np.testing.assert_allclose(g_, r_, atol=5e-3, rtol=1e-3)
+
+    def test_fallback_without_lse_output(self, interpret_mode, scope):
+        """Descs built without the Lse output (pre-round-5 programs, the
+        inference fuse pass) must fall back to the generic vjp grad."""
+        import paddle_tpu as pt
+        from paddle_tpu.core.backward import gradients
+        from paddle_tpu import layers
+        from paddle_tpu.core.ir import Program, program_guard
+
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            q = layers.static_data("q", [1, 2, 128, 64], "float32")
+            q.stop_gradient = False
+            k = layers.static_data("k", [1, 2, 128, 64], "float32")
+            v = layers.static_data("v", [1, 2, 128, 64], "float32")
+            out = layers.flash_attention(q, k, v)
+            # strip the Lse output as an old serialised desc would be
+            op = [o for o in main.global_block().ops
+                  if o.type == "flash_attention"][0]
+            op.outputs.pop("Lse")
+            loss = layers.reduce_sum(out * out)
+            (gq,) = gradients([loss], [q])
+        types = [op.type for op in main.global_block().ops]
+        assert "flash_attention_grad" not in types
+        assert "__vjp_grad__" in types
+        rng = np.random.RandomState(1)
+        feed = {n: rng.randn(1, 2, 128, 64).astype(np.float32) * 0.3
+                for n in ("q", "k", "v")}
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        got = exe.run(main, feed=feed, fetch_list=[loss, gq], scope=scope)
+        assert np.isfinite(np.asarray(got[0]))
+        assert np.isfinite(np.asarray(got[1])).all()
+
+    def test_grad_op_tagged_backward_and_stripped_by_clone(self,
+                                                           interpret_mode):
+        """The maker must not inherit the forward's op_role: the grad op
+        has to be OpRole.Backward so clone(for_test=True) strips it."""
+        main, _startup, _loss, _grads = self._build(rate=0.0)
+        test_prog = main.clone(for_test=True)
+        types = [o.type for o in test_prog.global_block().ops]
+        assert "flash_attention_grad" not in types

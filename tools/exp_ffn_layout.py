@@ -1,0 +1,90 @@
+"""FFN weight-layout experiment: does storing W1 transposed ([4096,1024])
+make the dW dot take the fast [P-large, N-small] form end-to-end?
+
+Measures the full FFN block (x -> gelu(x@W1+b1)@W2+b2) fwd+bwd under
+jax.grad for the four storage layout combos, plus the attention projection
+block. ERNIE-large geometry, bf16.
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bench_matmul_shapes import slope_time
+
+B, S, H, I = 34, 512, 1024, 4096
+M = B * S
+dt = jnp.bfloat16
+key = jax.random.PRNGKey(0)
+
+# FLOPs: fwd 2 matmuls + bwd 4 matmuls = 3x fwd
+FWD_FLOPS = 2.0 * M * H * I * 2
+TOT_FLOPS = 3 * FWD_FLOPS
+PEAK = 197.0
+
+
+def run(name, w1T, w2T):
+    w1 = jax.random.normal(key, (I, H) if w1T else (H, I), dt) * 0.02
+    w2 = jax.random.normal(key, (H, I) if w2T else (I, H), dt) * 0.02
+    b1 = jnp.zeros((I,), dt)
+    b2 = jnp.zeros((H,), dt)
+
+    def ffn(x, w1, w2):
+        h1 = (x @ w1.T if w1T else x @ w1) + b1
+        h1 = jax.nn.gelu(h1, approximate=True)
+        h2 = (h1 @ w2.T if w2T else h1 @ w2) + b2
+        h2f = h2.astype(jnp.float32)
+        return jnp.sum(h2f * h2f) * 1e-6
+
+    grad = jax.grad(ffn, argnums=(0, 1, 2))
+
+    def step(x):
+        dx, dw1, dw2 = grad(x, w1, w2)
+        return x * (1 + 1e-20 * (jnp.mean(dx) + jnp.mean(dw1).astype(x.dtype)
+                                 + jnp.mean(dw2).astype(x.dtype)))
+
+    x0 = jax.random.normal(key, (M, H), dt)
+    ms = slope_time(step, x0)
+    tf = TOT_FLOPS / (ms * 1e-3) / 1e12
+    print(json.dumps({"case": name, "ms": round(ms, 3),
+                      "pct_peak": round(100 * tf / PEAK, 1)}), flush=True)
+    return ms
+
+
+def main():
+    base = run("ffn_base(w1[H,I],w2[I,H])", False, False)
+    run("ffn_w1T([I,H])", True, False)
+    run("ffn_w2T([H,I])", False, True)
+    run("ffn_bothT", True, True)
+
+    # proj block: 4x [M,1024]x[1024,1024] fwd+bwd (attention projections)
+    for tag, wT in (("proj_base", False), ("proj_T", True)):
+        w = jax.random.normal(key, (H, H), dt) * 0.02
+
+        def proj(x, w):
+            y = x @ w.T if wT else x @ w
+            yf = y.astype(jnp.float32)
+            return jnp.sum(yf * yf) * 1e-6
+
+        grad = jax.grad(proj, argnums=(0, 1))
+
+        def step(x):
+            dx, dw = grad(x, w)
+            return x * (1 + 1e-20 * (jnp.mean(dx)
+                                     + jnp.mean(dw).astype(x.dtype)))
+
+        x0 = jax.random.normal(key, (M, H), dt)
+        ms = slope_time(step, x0)
+        fl = 3 * 2.0 * M * H * H
+        print(json.dumps({"case": tag, "ms": round(ms, 3),
+                          "pct_peak": round(
+                              100 * fl / (ms * 1e-3) / 1e12 / PEAK, 1)}),
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()
