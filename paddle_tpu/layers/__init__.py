@@ -6,6 +6,6 @@ from .nn import (_elementwise_binary, _compare, _getitem, _to_var,  # noqa: F401
 from .learning_rate_scheduler import (  # noqa: F401
     cosine_decay, exponential_decay, inverse_time_decay, linear_lr_warmup,
     natural_exp_decay, noam_decay, piecewise_decay, polynomial_decay)
-from .control_flow import (DynamicRNN, array_to_lod_tensor, cond,  # noqa: F401
-                           lod_rank_table, lod_tensor_to_array, shrink_memory,
-                           static_loop, while_loop)
+from .control_flow import (DynamicRNN, IfElse, array_to_lod_tensor,  # noqa: F401
+                           cond, lod_rank_table, lod_tensor_to_array,
+                           shrink_memory, static_loop, while_loop)

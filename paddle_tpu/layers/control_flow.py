@@ -478,3 +478,95 @@ def shrink_memory(x, i, table, name=None):
                      {"X": [x], "RankTable": [items, index], "I": [i]},
                      {"Out": [out]}, {})
     return out
+
+
+class IfElse:
+    """Row-wise two-branch control flow over split/merge_lod_tensor
+    (reference: python/paddle/fluid/layers/control_flow.py IfElse, built
+    on split_lod_tensor_op.cc / merge_lod_tensor_op.cc).
+
+    cond is a [B,1] boolean tensor. `ie.input(x)` inside a branch block
+    returns that branch's row subset of x; `ie.output(...)` registers
+    branch results; calling `ie()` merges true/false outputs row-wise.
+
+    TPU re-design note: the reference COMPACTS each branch's rows; here
+    both branch tensors keep the full [B, ...] shape with the other
+    branch's rows zeroed (split_lod_tensor docstring) — merge picks
+    row-wise, so results match the reference for row-local branch
+    bodies. Branch code that mixes rows (batch norms/reductions) would
+    see the zero rows; use layers.cond for whole-batch branching.
+
+    ::
+
+        ie = layers.IfElse(mask)
+        with ie.true_block():
+            ie.output(ie.input(x) * 2.0)
+        with ie.false_block():
+            ie.output(ie.input(x) - 1.0)
+        out, = ie()
+    """
+
+    def __init__(self, cond, name=None):
+        self.cond = cond
+        self.helper = LayerHelper("if_else", name=name)
+        self._in_true = None
+        self._true_outs = []
+        self._false_outs = []
+        self._splits = {}
+
+    def _block(self, branch):
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            if self._in_true is not None:
+                raise RuntimeError("IfElse blocks cannot nest")
+            self._in_true = branch
+            try:
+                yield
+            finally:
+                self._in_true = None
+
+        return guard()
+
+    def true_block(self):
+        return self._block(True)
+
+    def false_block(self):
+        return self._block(False)
+
+    def input(self, x):
+        if self._in_true is None:
+            raise RuntimeError("IfElse.input() must run inside "
+                               "true_block()/false_block()")
+        if x.name not in self._splits:
+            t = self.helper.create_variable_for_type_inference(x.dtype)
+            f = self.helper.create_variable_for_type_inference(x.dtype)
+            self.helper.append_op(
+                "split_lod_tensor", {"X": [x], "Mask": [self.cond]},
+                {"OutTrue": [t], "OutFalse": [f]}, {})
+            self._splits[x.name] = (t, f)
+        t, f = self._splits[x.name]
+        return t if self._in_true else f
+
+    def output(self, *outs):
+        if self._in_true is None:
+            raise RuntimeError("IfElse.output() must run inside "
+                               "true_block()/false_block()")
+        (self._true_outs if self._in_true else self._false_outs).extend(outs)
+
+    def __call__(self):
+        if len(self._true_outs) != len(self._false_outs):
+            raise ValueError(
+                f"IfElse branches registered different output counts "
+                f"(true {len(self._true_outs)}, false "
+                f"{len(self._false_outs)})")
+        merged = []
+        for t, f in zip(self._true_outs, self._false_outs):
+            out = self.helper.create_variable_for_type_inference(t.dtype)
+            self.helper.append_op(
+                "merge_lod_tensor",
+                {"InTrue": [t], "InFalse": [f], "Mask": [self.cond]},
+                {"Out": [out]}, {})
+            merged.append(out)
+        return merged

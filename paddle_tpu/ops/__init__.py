@@ -58,6 +58,8 @@ from . import extra_ops3  # noqa: F401
 from . import extra_ops4  # noqa: F401
 from . import io_ops  # noqa: F401
 from . import fused_ops  # noqa: F401
+from . import fused_rnn_ops  # noqa: F401
+from . import contrib_ops  # noqa: F401
 from . import interp_ops  # noqa: F401
 from . import linalg_ops  # noqa: F401
 from . import metrics_ops  # noqa: F401

@@ -1,0 +1,328 @@
+"""Contrib/CTR niche ops — tree_conv, var_conv_2d, pyramid_hash,
+rank_attention.
+
+Capability mirror of paddle/fluid/operators/{tree_conv_op.cc,
+var_conv_2d_op.cc, pyramid_hash_op.cc, rank_attention_op.cc}. These are
+the reference's text/CTR contrib kernels; the TPU re-design keeps their
+math but swaps data-dependent LoD walks for static-shape masks (the
+repo-wide convention, sequence_ops.py) and C++ pointer loops for
+vectorised gathers.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.registry import register_op
+
+
+# ---------------------------------------------------------------------------
+# tree_conv — Tree-Based Convolution (TBCNN, arXiv:1409.5718)
+# ---------------------------------------------------------------------------
+
+@register_op("tree_conv", non_diff_inputs=("EdgeSet",))
+def tree_conv(ins, attrs):
+    """Tree-based convolution (tree_conv_op.cc:1, math/tree2col.cc:85).
+
+    NodesVector [B,N,F] node features; EdgeSet [B,E,2] int32 1-based
+    parent->child edges, the list terminated by the first (0,0) row
+    (construct_tree:101 breaks there); Filter [F,3,out_size,channels];
+    attr max_depth.
+
+    Per root u the patch collects u itself (eta weights of
+    TreeNode(u,1,1,0): eta_t=1, eta_l=eta_r=0) and descendants at depth
+    1..max_depth-1, each weighted by the continuous-binary-tree etas
+    (tree2col.h:35-52):
+        eta_t = (md - depth)/md
+        eta_l = (1-eta_t) * (index-1)/(pclen-1)   [0.5 when pclen==1]
+        eta_r = (1-eta_t) * (1-eta_l)
+    patch[u] = sum_v [f(v)*eta_l, f(v)*eta_r, f(v)*eta_t] interleaved
+    feature-major (col = i*3+j, tree2col.cc:124), then Out = patch @
+    Filter.reshape(F*3, out*channels), rows past the node count zero."""
+    import jax.numpy as jnp
+
+    nodes = ins["NodesVector"][0]                 # [B, N, F]
+    edges = ins["EdgeSet"][0].astype(jnp.int32)   # [B, E, 2]
+    filt = ins["Filter"][0]                       # [F, 3, out, ch]
+    md = float(int(attrs.get("max_depth", 2)))
+    b, n, f = nodes.shape
+    e = edges.shape[1]
+    fo, three, out_sz, ch = filt.shape
+
+    u, v = edges[..., 0], edges[..., 1]           # [B, E]
+    # rows valid until the first (0,0) pair, exclusive
+    invalid = (u == 0) & (v == 0)
+    valid = jnp.cumsum(invalid.astype(jnp.int32), axis=1) == 0  # [B, E]
+
+    # child rank among earlier same-parent edges (1-based, tree2col.cc
+    # pushes TreeNode(v, i+1, sz, ...)) and parent child-count
+    same_parent = (u[:, None, :] == u[:, :, None]) \
+        & valid[:, None, :] & valid[:, :, None]   # [B, E(e), E(e')]
+    earlier = np.tril(np.ones((e, e), np.bool_), -1)[None]
+    rank = jnp.sum(same_parent & earlier, axis=2) + 1          # [B, E]
+    pclen = jnp.sum(same_parent, axis=2)                       # [B, E]
+
+    # adjacency over 1-based node ids (row 0 = padding)
+    adj = jnp.zeros((b, n + 1, n + 1), jnp.float32)
+    bidx = jnp.broadcast_to(jnp.arange(b)[:, None], (b, e))
+    adj = adj.at[bidx, u, v].add(valid.astype(jnp.float32))
+    adj = adj.at[:, 0, :].set(0.0).at[:, :, 0].set(0.0)
+
+    # per-node (index, pclen) via its incoming edge (trees: unique)
+    node_rank = jnp.ones((b, n + 1), jnp.float32)
+    node_pclen = jnp.ones((b, n + 1), jnp.float32)
+    node_rank = node_rank.at[bidx, v].set(
+        jnp.where(valid, rank.astype(jnp.float32), 1.0))
+    node_pclen = node_pclen.at[bidx, v].set(
+        jnp.where(valid, pclen.astype(jnp.float32), 1.0))
+
+    # depth(u->v): first power of adj reaching v (1..md-1)
+    depth = jnp.zeros((b, n + 1, n + 1), jnp.float32)
+    reach = jnp.eye(n + 1, dtype=jnp.float32)[None]
+    cur = jnp.broadcast_to(reach, (b, n + 1, n + 1))
+    for d in range(1, int(md)):
+        cur = (cur @ adj > 0).astype(jnp.float32)
+        depth = jnp.where((depth == 0) & (cur > 0), float(d), depth)
+
+    in_patch = depth > 0                                       # [B, U, V]
+    eta_t = jnp.where(in_patch, (md - depth) / md, 0.0)
+    frac = jnp.where(node_pclen[:, None, :] == 1.0, 0.5,
+                     (node_rank[:, None, :] - 1.0)
+                     / jnp.maximum(node_pclen[:, None, :] - 1.0, 1e-12))
+    eta_l = jnp.where(in_patch, (1.0 - eta_t) * frac, 0.0)
+    eta_r = jnp.where(in_patch, (1.0 - eta_t) * (1.0 - eta_l), 0.0)
+    # the root itself: eta_t=1, eta_l=eta_r=0 — but only for real roots
+    # (nodes that exist: appear in a valid edge)
+    exists = jnp.zeros((b, n + 1), jnp.bool_)
+    exists = exists.at[bidx, u].set(valid, mode="drop")
+    exists = exists.at[bidx, v].set(valid, mode="drop") | exists
+    eye = jnp.eye(n + 1, dtype=jnp.float32)[None]
+    eta_t = eta_t + eye * exists[:, None, :].astype(jnp.float32)
+
+    w3 = jnp.stack([eta_l, eta_r, eta_t], axis=-1)             # [B,U,V,3]
+    feats = jnp.concatenate(
+        [jnp.zeros((b, 1, f), nodes.dtype), nodes], axis=1)    # [B,N+1,F]
+    patch = jnp.einsum("buvj,bvf->bufj", w3,
+                       feats.astype(jnp.float32))              # [B,U,F,3]
+    patch = patch.reshape(b, n + 1, f * 3)[:, 1:]              # [B,N,3F]
+    w2 = filt.reshape(f * 3, out_sz * ch).astype(jnp.float32)
+    out = patch @ w2
+    return {"Out": out.reshape(b, n, out_sz, ch).astype(nodes.dtype)}
+
+
+# ---------------------------------------------------------------------------
+# var_conv_2d — per-sequence variable-size 2-D conv
+# ---------------------------------------------------------------------------
+
+@register_op("var_conv_2d", non_diff_inputs=("RowLength", "ColLength"))
+def var_conv_2d(ins, attrs):
+    """Variable-size 2-D convolution (var_conv_2d_op.cc:1): every batch
+    row is its own H_i x W_i image. Reference carries the sizes in
+    ROW/COLUMN LoD inputs over a flat buffer; the static-shape re-design
+    pads to [B, Cin, Hmax, Wmax] with RowLength/ColLength [B] ints,
+    convolves densely (same MXU conv as conv2d) and zeroes output
+    positions outside ceil(H_i/stride) x ceil(W_i/stride) — the exact
+    per-image output extents (var_conv_2d_op.h ComputeVar2DOutputSize).
+    W [out_ch, in_ch*kh*kw] as the reference stores it."""
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    x = ins["X"][0]                               # [B, Cin, H, W]
+    w = ins["W"][0]
+    kh = int(attrs.get("kernel_h", 3))
+    kw = int(attrs.get("kernel_w", 3))
+    sh = int(attrs.get("stride_h", 1))
+    sw = int(attrs.get("stride_w", 1))
+    out_ch = int(attrs.get("output_channel", w.shape[0]))
+    b, cin, h, wd = x.shape
+    filt = w.reshape(out_ch, cin, kh, kw)
+    rl = _opt_len(ins, "RowLength", b, h)
+    cl = _opt_len(ins, "ColLength", b, wd)
+    # zero beyond each image's extent FIRST: boundary windows of valid
+    # outputs must see zeros there (the reference convolves the bare
+    # H_i x W_i image), and padded buffers are not guaranteed zero
+    in_mask = ((jnp.arange(h)[None, :, None] < rl[:, None, None])
+               & (jnp.arange(wd)[None, None, :] < cl[:, None, None]))
+    x = jnp.where(in_mask[:, None], x, 0.0).astype(x.dtype)
+    out = lax.conv_general_dilated(
+        x, filt, window_strides=(sh, sw),
+        padding=[((kh - 1) // 2, kh // 2), ((kw - 1) // 2, kw // 2)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    oh = (rl + sh - 1) // sh
+    ow = (cl + sw - 1) // sw
+    hmask = jnp.arange(out.shape[2])[None, :] < oh[:, None]
+    wmask = jnp.arange(out.shape[3])[None, :] < ow[:, None]
+    mask = (hmask[:, None, :, None] & wmask[:, None, None, :])
+    return {"Out": jnp.where(mask, out, 0.0).astype(x.dtype)}
+
+
+def _opt_len(ins, key, b, full):
+    import jax.numpy as jnp
+
+    if ins.get(key) and ins[key][0] is not None:
+        return ins[key][0].reshape(-1).astype(jnp.int32)
+    return jnp.full((b,), full, jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# pyramid_hash — hashed n-gram embeddings
+# ---------------------------------------------------------------------------
+
+def _xxh32_words(words, nwords, seed):
+    """XXH32 over a stream of uint32 words (= the reference hashing the
+    token ids' float bytes, pyramid_hash_op.cc:160 `XXH32(hash_id,
+    len*sizeof(float), seed)`). words [..., nwords] uint32 -> [...]
+    uint32. Bit-exact word-at-a-time XXH32 (4-byte lanes)."""
+    import jax.numpy as jnp
+
+    U = jnp.uint32
+    P1, P2, P3, P4, P5 = (U(2654435761), U(2246822519), U(3266489917),
+                          U(668265263), U(374761393))
+
+    def rotl(x, r):
+        return (x << U(r)) | (x >> U(32 - r))
+
+    seed = jnp.asarray(seed, U)
+    ln = U(nwords * 4)
+    if nwords >= 4:
+        v1 = seed + P1 + P2
+        v2 = seed + P2
+        v3 = seed + U(0)
+        v4 = seed - P1
+        i = 0
+        while i + 4 <= nwords:
+            v1 = rotl(v1 + words[..., i] * P2, 13) * P1
+            v2 = rotl(v2 + words[..., i + 1] * P2, 13) * P1
+            v3 = rotl(v3 + words[..., i + 2] * P2, 13) * P1
+            v4 = rotl(v4 + words[..., i + 3] * P2, 13) * P1
+            i += 4
+        h = rotl(v1, 1) + rotl(v2, 7) + rotl(v3, 12) + rotl(v4, 18)
+    else:
+        h = seed + P5
+        i = 0
+    h = h + ln
+    while i < nwords:
+        h = rotl(h + words[..., i] * P3, 17) * P4
+        i += 1
+    h = (h ^ (h >> U(15))) * P2
+    h = (h ^ (h >> U(13))) * P3
+    return h ^ (h >> U(16))
+
+
+@register_op("pyramid_hash",
+             non_diff_inputs=("X", "Length", "WhiteList", "BlackList"))
+def pyramid_hash(ins, attrs):
+    """PyramidHash n-gram embedding (pyramid_hash_op.cc:1).
+
+    X [B,S] float token ids (the reference hashes the float BYTES —
+    bit-exact XXH32 here), Length [B] optional; W [space_len+rand_len,1].
+    For each n-gram length l in [2, pyramid_layer] and each start p, the
+    embedding row is num_emb values assembled rand_len at a time from W
+    at offsets XXH32(gram, seed=j+2*rand_len... ) % space_len
+    (hash_embedding_ff:158). Out [B, num_slots, num_emb] where
+    num_slots = sum_l (S-l+1), invalid grams (crossing the row's length)
+    zeroed; Mask [B, num_slots] marks the valid ones — the dense form of
+    the reference's LoD output. use_filter with white/black lists and
+    training-time drop are not supported (CPU-pslib specifics)."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0].astype(jnp.float32)
+    b, s = x.shape
+    num_emb = int(attrs["num_emb"])
+    space_len = int(attrs["space_len"])
+    rand_len = int(attrs["rand_len"])
+    layers = int(attrs.get("pyramid_layer", 2))
+    if int(attrs.get("white_list_len", 0)) or \
+            int(attrs.get("black_list_len", 0)):
+        raise NotImplementedError("pyramid_hash: white/black lists")
+    w = ins["W"][0].reshape(-1)
+    length = _opt_len(ins, "Length", b, s)
+    words = jax_bitcast(x)
+
+    outs, masks = [], []
+    for l in range(2, layers + 1):
+        npos = s - l + 1
+        if npos <= 0:
+            continue
+        # [B, npos, l] gram word windows
+        gram = jnp.stack([words[:, p:p + npos] for p in range(l)], axis=-1)
+        valid = (jnp.arange(npos)[None, :] + l) <= length[:, None]
+        embs = []
+        # the reference's sliding pos1/pos2/pos3 window
+        # (hash_embedding_ff:160-176) resolves to chunk ji hashing with
+        # seed ji*rand_len
+        nchunks = num_emb // rand_len
+        for ji in range(nchunks):
+            pos = (_xxh32_words(gram, l, ji * rand_len)
+                   % np.uint32(space_len)).astype(jnp.int32)
+            idx = pos[..., None] + jnp.arange(rand_len)
+            embs.append(w[idx])
+        emb = jnp.concatenate(embs, axis=-1)          # [B, npos, num_emb]
+        outs.append(jnp.where(valid[..., None], emb, 0.0))
+        masks.append(valid)
+    if not outs:
+        # no n-gram fits (S < 2): the empty-slot output, not an error
+        return {"Out": jnp.zeros((b, 0, num_emb), ins["W"][0].dtype),
+                "DropPos": jnp.zeros((b, 0), jnp.int32)}
+    out = jnp.concatenate(outs, axis=1)
+    mask = jnp.concatenate(masks, axis=1)
+    return {"Out": out.astype(ins["W"][0].dtype),
+            "DropPos": mask.astype(jnp.int32)}
+
+
+def jax_bitcast(x):
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    return lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
+
+
+# ---------------------------------------------------------------------------
+# rank_attention — CTR rank-aware attention
+# ---------------------------------------------------------------------------
+
+@register_op("rank_attention", non_diff_inputs=("RankOffset",))
+def rank_attention(ins, attrs):
+    """Rank attention (rank_attention_op.cc:1, rank_attention.cu.h:29).
+
+    X [N,D]; RankOffset [N, 1+2*max_rank] int: col 0 = this instance's
+    rank (1-based, 0 invalid), then per k the pair (rank tag of the
+    k-th related instance, its row index into X).
+    RankParam [max_rank*max_rank*D, P] organised in (lower, faster)
+    blocks of D rows each.
+    input_help[i, k*D:(k+1)*D] = X[index_k] when the pair is valid
+    (expand_input_by_rank_kernel:33), param_help[i, k*D+d, :] =
+    RankParam[(lower*max_rank+faster)*D + d... ] with lower = rank_i-1,
+    faster = rank_k-1 (expand_rank_attention_param_kernel:66), and
+    Out[i] = input_help[i] @ param_help[i]  -> [N, P].
+    Outputs InputHelp, Out, InsRank mirror the reference's."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]                               # [N, D]
+    ro = ins["RankOffset"][0].astype(jnp.int32)   # [N, 1+2K]
+    param = ins["RankParam"][0]                   # [K*K*D, P]
+    max_rank = int(attrs.get("MaxRank", 3))
+    n, d = x.shape
+    p = param.shape[1]
+    k = max_rank
+
+    ins_rank = ro[:, 0]                           # [N] 1-based, 0 invalid
+    tags = ro[:, 1::2][:, :k]                     # [N, K] faster ranks
+    idxs = ro[:, 2::2][:, :k]                     # [N, K] row indices
+    pair_ok = (ins_rank[:, None] >= 1) & (tags >= 1)
+
+    gathered = x[jnp.clip(idxs, 0, n - 1)]        # [N, K, D]
+    input_help = jnp.where(pair_ok[..., None], gathered, 0.0)
+
+    lower = jnp.clip(ins_rank - 1, 0, k - 1)      # [N]
+    faster = jnp.clip(tags - 1, 0, k - 1)         # [N, K]
+    block = lower[:, None] * k + faster           # [N, K]
+    pb = param.reshape(k * k, d, p)
+    param_help = jnp.where(pair_ok[..., None, None],
+                           pb[block], 0.0)        # [N, K, D, P]
+
+    out = jnp.einsum("nkd,nkdp->np", input_help.astype(jnp.float32),
+                     param_help.astype(jnp.float32))
+    return {"Out": out.astype(x.dtype),
+            "InputHelp": input_help.reshape(n, k * d).astype(x.dtype),
+            "InsRank": ins_rank.astype(x.dtype)}

@@ -330,3 +330,39 @@ def lod_rank_table(ins, attrs):
     ln = ins["X"][0].reshape(-1).astype(jnp.int32)
     order = jnp.argsort(-ln, stable=True)
     return {"Items": ln[order], "Index": order.astype(jnp.int32)}
+
+
+@register_op("split_lod_tensor", non_diff_inputs=("Mask",))
+def split_lod_tensor(ins, attrs):
+    """Route rows of X by a boolean Mask (reference:
+    split_lod_tensor_op.cc — the IfElse building block that compacts
+    true/false rows into two LoD tensors). Static-shape re-design: both
+    outputs keep X's full shape with the non-selected rows ZEROED
+    instead of compacted — the merge_lod_tensor recombination (and thus
+    IfElse semantics) is exactly preserved, while XLA keeps static
+    shapes. Branch bodies that mix rows (e.g. batch reductions) see the
+    zero rows; layers/control_flow.py IfElse documents this contract.
+    Mask [B,1] (or [B]) bool/float over the leading axis."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    mask = ins["Mask"][0].reshape(-1).astype(bool)
+    m = mask.reshape((-1,) + (1,) * (x.ndim - 1))
+    zero = jnp.zeros((), x.dtype)
+    return {"OutTrue": jnp.where(m, x, zero),
+            "OutFalse": jnp.where(m, zero, x)}
+
+
+@register_op("merge_lod_tensor", non_diff_inputs=("Mask",))
+def merge_lod_tensor(ins, attrs):
+    """Merge per-branch rows back by Mask (reference:
+    merge_lod_tensor_op.cc): Out[i] = InTrue[i] if Mask[i] else
+    InFalse[i]. With the zero-padded split above this is the exact
+    inverse of split_lod_tensor, and composing split -> branch ->
+    merge reproduces the reference IfElse row-for-row."""
+    import jax.numpy as jnp
+
+    t, f = ins["InTrue"][0], ins["InFalse"][0]
+    mask = ins["Mask"][0].reshape(-1).astype(bool)
+    m = mask.reshape((-1,) + (1,) * (t.ndim - 1))
+    return {"Out": jnp.where(m, t, f.astype(t.dtype))}

@@ -1,0 +1,287 @@
+"""CPU-fused RNN op family — the reference's x86 fusion ops, TPU-style.
+
+Capability mirror of paddle/fluid/operators/fused/{fusion_lstm_op.cc,
+fusion_gru_op.cc, fusion_seqconv_eltadd_relu_op.cc,
+fusion_seqexpand_concat_fc_op.cc} and operators/attention_lstm_op.cc.
+The reference fuses the x-projection GEMM with a jit-kernel recurrence
+over LoD batches; here sequences are padded-dense [B, S, D] with a
+SequenceLength mask (the repo-wide LoD re-design, see sequence_ops.py)
+and the recurrence is one lax.scan — the projection GEMM lands on the
+MXU as a single [B*S, 4H] matmul exactly like the reference's fused
+pre-compute.
+
+Gate orders follow the reference's jit kernels (operators/jit/refer/
+refer.h): fusion_lstm gates = [c-tilde, i, f, o] (LSTMCtHt:172),
+fusion_gru gates = [u, r, s] with ht = u*cand + (1-u)*ht_1
+(GRUHtPart2:256); attention_lstm's LSTM weights = [f, i, o, c-tilde]
+(attention_lstm_op.cc:405).
+"""
+
+from __future__ import annotations
+
+from ..core.registry import register_op
+
+
+def _act(name):
+    import jax
+
+    return {
+        "sigmoid": jax.nn.sigmoid,
+        "tanh": jax.numpy.tanh,
+        "relu": jax.nn.relu,
+        "identity": lambda x: x,
+    }[name or "identity"]
+
+
+def _seq_len(ins, key="SequenceLength"):
+    if ins.get(key) and ins[key][0] is not None:
+        return ins[key][0].reshape(-1)
+    return None
+
+
+@register_op("fusion_lstm", non_diff_inputs=("SequenceLength",))
+def fusion_lstm(ins, attrs):
+    """Fused x-projection + LSTM recurrence
+    (fused/fusion_lstm_op.cc:1; jit gate order c,i,f,o per
+    jit/refer/refer.h:172 LSTMCtHt).
+
+    Inputs: X [B,S,M]; WeightX [M,4H]; WeightH [H,4H]; Bias [4H];
+    optional H0/C0 [B,H]; optional SequenceLength [B].
+    Outputs: XX [B,S,4H] (the fused pre-projection, exposed like the
+    reference's), Hidden [B,S,H], Cell [B,S,H].
+    Attrs: is_reverse, gate/cell/candidate_activation; use_peepholes
+    is rejected (the reference's peephole bias layout is x86-jit
+    specific and unused by the Python API)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    if bool(attrs.get("use_peepholes", False)):
+        raise NotImplementedError("fusion_lstm: use_peepholes=True")
+    x = ins["X"][0]
+    wx, wh = ins["WeightX"][0], ins["WeightH"][0]
+    bias = ins["Bias"][0] if ins.get("Bias") and ins["Bias"][0] is not None \
+        else None
+    b, s, m = x.shape
+    h_size = wh.shape[0]
+    act_gate = _act(attrs.get("gate_activation", "sigmoid"))
+    act_cell = _act(attrs.get("cell_activation", "tanh"))
+    act_cand = _act(attrs.get("candidate_activation", "tanh"))
+    h0 = ins["H0"][0] if ins.get("H0") and ins["H0"][0] is not None else \
+        jnp.zeros((b, h_size), x.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") and ins["C0"][0] is not None else \
+        jnp.zeros((b, h_size), x.dtype)
+    seq_len = _seq_len(ins)
+    reverse = bool(attrs.get("is_reverse", False))
+
+    xx = jnp.einsum("bsm,mh->bsh", x, wx)
+    if bias is not None:
+        xx = xx + bias.reshape(-1)
+    xs = jnp.swapaxes(xx, 0, 1)                     # [S, B, 4H]
+    if reverse:
+        xs = xs[::-1]
+
+    def step(carry, inp):
+        h, c = carry
+        xp, t = inp
+        gates = xp + h @ wh
+        cand, i, f, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = act_gate(i), act_gate(f), act_gate(o)
+        c_new = act_cand(cand) * i + f * c
+        h_new = o * act_cell(c_new)
+        if seq_len is not None:
+            tt = (s - 1 - t) if reverse else t
+            alive = (tt < seq_len)[:, None]
+            h_new = jnp.where(alive, h_new, h)
+            c_new = jnp.where(alive, c_new, c)
+            # emitted outputs follow the repo-wide padded contract:
+            # zeros past each row's length (the carry keeps the state)
+            return (h_new, c_new), (jnp.where(alive, h_new, 0.0),
+                                    jnp.where(alive, c_new, 0.0))
+        return (h_new, c_new), (h_new, c_new)
+
+    (_, _), (hs, cs) = lax.scan(step, (h0, c0), (xs, jnp.arange(s)))
+    if reverse:
+        hs, cs = hs[::-1], cs[::-1]
+    return {"XX": xx, "Hidden": jnp.swapaxes(hs, 0, 1),
+            "Cell": jnp.swapaxes(cs, 0, 1)}
+
+
+@register_op("fusion_gru", non_diff_inputs=("SequenceLength",))
+def fusion_gru(ins, attrs):
+    """Fused x-projection + GRU recurrence (fused/fusion_gru_op.cc:1).
+
+    Inputs: X [B,S,M]; WeightX [M,3H]; WeightH [H,3H] (layout
+    {W_update, W_reset; W_state} per jit/refer/refer.h:244); Bias [3H];
+    optional H0 [B,H], SequenceLength [B].
+    Outputs: XX [B,S,3H], Hidden [B,S,H].
+    origin_mode=False (default): ht = u*cand + (1-u)*ht_1
+    (GRUHtPart2:266); True flips to u*ht_1 + (1-u)*cand (the gru_op
+    compatibility mode)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    x = ins["X"][0]
+    wx, wh = ins["WeightX"][0], ins["WeightH"][0]
+    bias = ins["Bias"][0] if ins.get("Bias") and ins["Bias"][0] is not None \
+        else None
+    b, s, m = x.shape
+    h_size = wh.shape[0]
+    act_gate = _act(attrs.get("gate_activation", "sigmoid"))
+    act_cand = _act(attrs.get("activation", "tanh"))
+    origin = bool(attrs.get("origin_mode", False))
+    h0 = ins["H0"][0] if ins.get("H0") and ins["H0"][0] is not None else \
+        jnp.zeros((b, h_size), x.dtype)
+    seq_len = _seq_len(ins)
+    reverse = bool(attrs.get("is_reverse", False))
+
+    xx = jnp.einsum("bsm,mh->bsh", x, wx)
+    if bias is not None:
+        xx = xx + bias.reshape(-1)
+    xs = jnp.swapaxes(xx, 0, 1)
+    if reverse:
+        xs = xs[::-1]
+    wh_ur = wh[:, :2 * h_size]
+    wh_c = wh[:, 2 * h_size:]
+
+    def step(carry, inp):
+        h = carry
+        xp, t = inp
+        ur = act_gate(xp[:, :2 * h_size] + h @ wh_ur)
+        u, r = jnp.split(ur, 2, axis=-1)
+        cand = act_cand(xp[:, 2 * h_size:] + (r * h) @ wh_c)
+        h_new = (u * h + (1.0 - u) * cand) if origin \
+            else (u * cand + (1.0 - u) * h)
+        if seq_len is not None:
+            tt = (s - 1 - t) if reverse else t
+            alive = (tt < seq_len)[:, None]
+            h_new = jnp.where(alive, h_new, h)
+            return h_new, jnp.where(alive, h_new, 0.0)
+        return h_new, h_new
+
+    _, hs = lax.scan(step, h0, (xs, jnp.arange(s)))
+    if reverse:
+        hs = hs[::-1]
+    return {"XX": xx, "Hidden": jnp.swapaxes(hs, 0, 1)}
+
+
+@register_op("attention_lstm", non_diff_inputs=("SequenceLength",))
+def attention_lstm(ins, attrs):
+    """Attention LSTM (operators/attention_lstm_op.cc:1): at every step
+    an attention pool over the WHOLE sequence (keyed on the previous
+    cell state) builds the LSTM input.
+
+    Inputs: X [B,S,M]; C0 [B,D]; optional H0 [B,D];
+    AttentionWeight [M+D,1]; optional AttentionBias [1];
+    optional AttentionScalar [1], AttentionScalarBias [1];
+    LSTMWeight [D+M,4D] (rows [0:D] hidden part, [D:] x part — the
+    reference multiplies h first, attention_lstm_op.cc:405);
+    LSTMBias [4D]; optional SequenceLength [B].
+    Gate layout: [f, i, o, c-tilde] (attention_lstm_op.cc:407).
+    Outputs: Hidden [B,S,D], Cell [B,S,D] (zeros past each length)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    x = ins["X"][0]
+    b, s, m = x.shape
+    c0 = ins["C0"][0]
+    d = c0.shape[-1]
+    h0 = ins["H0"][0] if ins.get("H0") and ins["H0"][0] is not None else \
+        jnp.zeros((b, d), x.dtype)
+    atten_w = ins["AttentionWeight"][0].reshape(m + d, 1)
+    atten_b = ins["AttentionBias"][0].reshape(()) \
+        if ins.get("AttentionBias") and ins["AttentionBias"][0] is not None \
+        else None
+    scalar = ins["AttentionScalar"][0].reshape(()) \
+        if ins.get("AttentionScalar") and ins["AttentionScalar"][0] is not None \
+        else None
+    scalar_b = ins["AttentionScalarBias"][0].reshape(()) \
+        if ins.get("AttentionScalarBias") and \
+        ins["AttentionScalarBias"][0] is not None else None
+    lstm_w = ins["LSTMWeight"][0]
+    lstm_b = ins["LSTMBias"][0].reshape(-1)
+    act_gate = _act(attrs.get("gate_activation", "sigmoid"))
+    act_cell = _act(attrs.get("cell_activation", "tanh"))
+    act_cand = _act(attrs.get("candidate_activation", "tanh"))
+    seq_len = _seq_len(ins)
+    if seq_len is None:
+        seq_len = jnp.full((b,), s, jnp.int32)
+    pos_ok = jnp.arange(s)[None, :] < seq_len[:, None]      # [B,S]
+
+    # x part of the attention fc, shared across steps (the reference
+    # pre-computes atted_x for the whole batch, :369)
+    atted_x = jnp.einsum("bsm,mo->bs", x, atten_w[:m])
+    if atten_b is not None:
+        atted_x = atted_x + atten_b
+    w_h, w_x = lstm_w[:d], lstm_w[d:]
+
+    def step(carry, t):
+        h, c = carry
+        cell_bias = c @ atten_w[m:].reshape(d)              # [B]
+        fc = jnp.maximum(atted_x + cell_bias[:, None], 0.0)
+        if scalar is not None:
+            fc = jnp.maximum(fc * scalar + (scalar_b
+                                            if scalar_b is not None
+                                            else 0.0), 0.0)
+        # -1e30 (not -inf) and a clamped denominator: an all-masked
+        # (zero-length) row would otherwise produce exp(-inf+inf)=NaN
+        # whose 0*NaN poisons the whole batch's gradients through where
+        fc = jnp.where(pos_ok, fc, -1e30)
+        wgt = jnp.exp(fc - jnp.max(fc, axis=1, keepdims=True))
+        wgt = jnp.where(pos_ok, wgt, 0.0)
+        wgt = wgt / jnp.maximum(jnp.sum(wgt, axis=1, keepdims=True), 1e-30)
+        lstm_x = jnp.einsum("bs,bsm->bm", wgt.astype(x.dtype), x)
+        gates = lstm_x @ w_x + h @ w_h + lstm_b
+        f = act_gate(gates[:, :d])
+        i = act_gate(gates[:, d:2 * d])
+        o = act_gate(gates[:, 2 * d:3 * d])
+        cand = act_cand(gates[:, 3 * d:])
+        c_new = f * c + i * cand
+        h_new = o * act_cell(c_new)
+        alive = (t < seq_len)[:, None]
+        h_new = jnp.where(alive, h_new, h)
+        c_new = jnp.where(alive, c_new, c)
+        return (h_new, c_new), (jnp.where(alive, h_new, 0.0),
+                                jnp.where(alive, c_new, 0.0))
+
+    (_, _), (hs, cs) = lax.scan(step, (h0, c0), jnp.arange(s))
+    return {"Hidden": jnp.swapaxes(hs, 0, 1),
+            "Cell": jnp.swapaxes(cs, 0, 1)}
+
+
+@register_op("fusion_seqconv_eltadd_relu")
+def fusion_seqconv_eltadd_relu(ins, attrs):
+    """relu(sequence_conv(X) + Bias)
+    (fused/fusion_seqconv_eltadd_relu_op.cc:1). Same padded context
+    window as sequence_conv (sequence_ops.py) with the bias-add and
+    relu fused behind it."""
+    import jax.numpy as jnp
+
+    from .sequence_ops import sequence_conv
+
+    out = sequence_conv({"X": ins["X"], "Filter": ins["Filter"]},
+                        attrs)["Out"]
+    bias = ins["Bias"][0].reshape(-1)
+    return {"Out": jnp.maximum(out + bias, 0.0)}
+
+
+@register_op("fusion_seqexpand_concat_fc")
+def fusion_seqexpand_concat_fc(ins, attrs):
+    """fc(concat(X0, expand(X1..Xn)), act)
+    (fused/fusion_seqexpand_concat_fc_op.cc:1): X0 [B,S,D0] is the
+    sequence; every other Xi [B,Di] is one row per sequence, broadcast
+    over the time axis; FCWeight [sum(Di),H], FCBias [H]."""
+    import jax.numpy as jnp
+
+    xs = ins["X"]
+    x0 = xs[0]
+    b, s, _ = x0.shape
+    parts = [x0]
+    for xi in xs[1:]:
+        parts.append(jnp.broadcast_to(xi[:, None, :],
+                                      (b, s, xi.shape[-1])).astype(x0.dtype))
+    cat = jnp.concatenate(parts, axis=-1)
+    w = ins["FCWeight"][0]
+    out = jnp.einsum("bsd,dh->bsh", cat, w)
+    if ins.get("FCBias") and ins["FCBias"][0] is not None:
+        out = out + ins["FCBias"][0].reshape(-1)
+    return {"Out": _act(attrs.get("fc_activation", "identity"))(out)}

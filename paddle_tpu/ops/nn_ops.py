@@ -75,7 +75,13 @@ def conv2d_transpose(ins, attrs):
         kh, kw = w.shape[2], w.shape[3]
         padding = [(kh - 1 - padding[0][0], kh - 1 - padding[0][1]),
                    (kw - 1 - padding[1][0], kw - 1 - padding[1][1])]
-    w_t = w.transpose(1, 0, 2, 3)[:, :, ::-1, ::-1]
+    in_c, out_pg, kh_, kw_ = w.shape
+    # paddle stores [in_c, out_c/groups, kh, kw]; the equivalent forward
+    # conv needs [out_c, in_c/groups, kh, kw] with in/out swapped WITHIN
+    # each group (plain transpose(1,0) only handles groups == 1)
+    w_g = w.reshape(groups, in_c // groups, out_pg, kh_, kw_)
+    w_t = w_g.transpose(0, 2, 1, 3, 4).reshape(
+        groups * out_pg, in_c // groups, kh_, kw_)[:, :, ::-1, ::-1]
     out = lax.conv_general_dilated(
         x, w_t, window_strides=(1, 1), padding=padding,
         lhs_dilation=strides, rhs_dilation=dilations,
@@ -540,3 +546,15 @@ def label_smooth(ins, attrs):
     eps = attrs.get("epsilon", 0.1)
     k = x.shape[-1]
     return {"Out": x * (1.0 - eps) + eps / k}
+
+
+@register_op("depthwise_conv2d_transpose")
+def depthwise_conv2d_transpose(ins, attrs):
+    """Depthwise transposed conv (reference: conv_transpose_op.cc:581
+    REGISTER_OPERATOR(depthwise_conv2d_transpose, ...) — same kernel as
+    conv2d_transpose with groups == input channels)."""
+    x = ins["Input"][0]
+    attrs = dict(attrs)
+    attrs["groups"] = x.shape[1]
+    return {"Output": conv2d_transpose(
+        {"Input": ins["Input"], "Filter": ins["Filter"]}, attrs)["Output"]}

@@ -209,7 +209,10 @@ def roi_align(ins, attrs):
 @register_op("max_pool2d_with_index")
 def max_pool2d_with_index(ins, attrs):
     """Max pool returning flat spatial argmax indices
-    (operators/pool_with_index_op.cc)."""
+    (operators/pool_with_index_op.cc). Out comes from a plain (and
+    transposable) max window; the index from stacked strided window
+    slices + first-match argmax (the tuple-reducer reduce_window cannot
+    be linearized by jax, which broke the generic vjp grad)."""
     import jax.lax as lax
     import jax.numpy as jnp
 
@@ -218,21 +221,29 @@ def max_pool2d_with_index(ins, attrs):
     st = [int(v) for v in attrs.get("strides", ks)]
     pd = [int(v) for v in attrs.get("paddings", [0, 0])]
     n, c, h, w = x.shape
-    flat_idx = jnp.broadcast_to(
-        (jnp.arange(h)[:, None] * w + jnp.arange(w)[None, :])
-        .astype(jnp.float32), x.shape)
     neg = jnp.finfo(x.dtype).min
-
-    def reducer(a, b):
-        av, ai = a
-        bv, bi = b
-        pick = bv > av
-        return jnp.where(pick, bv, av), jnp.where(pick, bi, ai)
-
-    out, idx = lax.reduce_window(
-        (x, flat_idx), (neg, jnp.float32(-1.0)), reducer,
-        (1, 1, ks[0], ks[1]), (1, 1, st[0], st[1]),
+    # -inf init: jax only recognises (and can differentiate) the max-pool
+    # monoid with the identity element, not finfo.min
+    out = lax.reduce_window(
+        x, -jnp.inf, lax.max, (1, 1, ks[0], ks[1]), (1, 1, st[0], st[1]),
         [(0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])])
+    oh, ow = out.shape[2], out.shape[3]
+    xp = jnp.pad(x, [(0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])],
+                 constant_values=neg)
+    xs = lax.stop_gradient(xp)
+    outs = lax.stop_gradient(out)
+    vals, flats = [], []
+    for ki in range(ks[0]):
+        for kj in range(ks[1]):
+            vals.append(xs[:, :, ki:ki + oh * st[0]:st[0],
+                           kj:kj + ow * st[1]:st[1]])
+            ii = (jnp.arange(oh) * st[0] + ki - pd[0])[:, None]
+            jj = (jnp.arange(ow) * st[1] + kj - pd[1])[None, :]
+            flats.append(ii * w + jj)
+    stack = jnp.stack(vals)                       # [K, N, C, oh, ow]
+    first = jnp.argmax(stack == outs[None], axis=0)
+    flat = jnp.stack([jnp.broadcast_to(f, (oh, ow)) for f in flats])
+    idx = flat[first, jnp.arange(oh)[:, None], jnp.arange(ow)[None, :]]
     return {"Out": out, "Mask": idx.astype(jnp.int32)}
 
 
@@ -258,3 +269,48 @@ def unpool(ins, attrs):
     flat = flat.at[jnp.arange(n)[:, None, None],
                    jnp.arange(c)[None, :, None], ii].set(vv)
     return {"Out": flat.reshape(n, c, oh, ow)}
+
+
+@register_op("max_pool3d_with_index")
+def max_pool3d_with_index(ins, attrs):
+    """3-D max pool returning flat spatial argmax indices
+    (operators/pool_with_index_op.cc:1 — MaxPool3dWithIndex; the Mask is
+    the flat d*H*W + h*W + w offset inside the input volume, NCDHW).
+    Same argmax construction as max_pool2d_with_index above."""
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    ks = [int(v) for v in attrs["ksize"]]
+    st = [int(v) for v in attrs.get("strides", ks)]
+    pd = [int(v) for v in attrs.get("paddings", [0, 0, 0])]
+    n, c, d, h, w = x.shape
+    neg = jnp.finfo(x.dtype).min
+    # -inf init: jax only recognises (and can differentiate) the max-pool
+    # monoid with the identity element, not finfo.min
+    out = lax.reduce_window(
+        x, -jnp.inf, lax.max, (1, 1, ks[0], ks[1], ks[2]),
+        (1, 1, st[0], st[1], st[2]),
+        [(0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1]), (pd[2], pd[2])])
+    od, oh, ow = out.shape[2], out.shape[3], out.shape[4]
+    xp = jnp.pad(x, [(0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1]),
+                     (pd[2], pd[2])], constant_values=neg)
+    xs = lax.stop_gradient(xp)
+    outs = lax.stop_gradient(out)
+    vals, flats = [], []
+    for ki in range(ks[0]):
+        for kj in range(ks[1]):
+            for kk in range(ks[2]):
+                vals.append(xs[:, :, ki:ki + od * st[0]:st[0],
+                               kj:kj + oh * st[1]:st[1],
+                               kk:kk + ow * st[2]:st[2]])
+                ii = (jnp.arange(od) * st[0] + ki - pd[0])[:, None, None]
+                jj = (jnp.arange(oh) * st[1] + kj - pd[1])[None, :, None]
+                kx = (jnp.arange(ow) * st[2] + kk - pd[2])[None, None, :]
+                flats.append(ii * (h * w) + jj * w + kx)
+    stack = jnp.stack(vals)                   # [K, N, C, od, oh, ow]
+    first = jnp.argmax(stack == outs[None], axis=0)
+    flat = jnp.stack([jnp.broadcast_to(f, (od, oh, ow)) for f in flats])
+    idx = flat[first, jnp.arange(od)[:, None, None],
+               jnp.arange(oh)[None, :, None], jnp.arange(ow)[None, None, :]]
+    return {"Out": out, "Mask": idx.astype(jnp.int32)}
