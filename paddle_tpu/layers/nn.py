@@ -802,12 +802,16 @@ def _attn_dropout_attrs(attrs, dropout_rate, is_test, seed):
 
 
 def flash_attention(q, k, v, bias=None, causal=False, scale=None,
-                    dropout_rate=0.0, is_test=False, seed=None, name=None):
+                    dropout_rate=0.0, is_test=False, seed=None, name=None,
+                    num_heads=None):
     """Fused attention: softmax(q k^T * scale + bias) v via the Pallas
     flash-attention kernel (ops/attention_ops.py). q [B,H,Sq,D];
-    k,v [B,H,Sk,D]; bias optional, broadcastable to [B,1,1,Sk].
-    dropout_rate>0 (and not is_test) applies attention-probs dropout
-    with a per-step position-keyed mask (recomputed in the backward)."""
+    k,v [B,H,Sk,D] — or PACKED [B,S,n*hd] 3-D with num_heads set,
+    feeding the projection outputs straight to the kernels with zero
+    head transposes in the program; bias optional, broadcastable to
+    [B,1,1,Sk]. dropout_rate>0 (and not is_test) applies attention-probs
+    dropout with a per-step position-keyed mask (recomputed in the
+    backward)."""
     helper = LayerHelper("flash_attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype)
     # saved log-sum-exp residual: lets the grad op run the bwd kernels
@@ -818,6 +822,14 @@ def flash_attention(q, k, v, bias=None, causal=False, scale=None,
     if bias is not None:
         inputs["Bias"] = [bias]
     attrs = {"causal": causal}
+    if len(q.shape or ()) == 3:
+        if not num_heads:
+            raise ValueError("packed (3-D) flash_attention needs num_heads")
+        attrs["num_heads"] = int(num_heads)
+        # head_dim is the sharding-INVARIANT key: under tensor-parallel
+        # sharding the lowering sees the LOCAL column count and derives
+        # the local head count as htot_local // head_dim
+        attrs["head_dim"] = int(q.shape[-1]) // int(num_heads)
     if scale is not None:
         attrs["scale"] = float(scale)
     _attn_dropout_attrs(attrs, dropout_rate, is_test, seed)

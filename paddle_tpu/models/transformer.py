@@ -41,13 +41,13 @@ class TransformerConfig:
     dropout: float = 0.1
     label_smooth_eps: float = 0.1
     weight_sharing: bool = True  # tgt embedding == output projection
-    # Fused flash attention is available but OFF by default for this
-    # model: at s=256 the unfused path's saved-probs backward (no scores
-    # recompute; the bf16 [B,H,S,S] probs are only ~100 MB here) measured
-    # FASTER end-to-end than the fused recompute kernel — 168.2 vs
-    # 180.3 ms/step on the WMT bench geometry (b48, v5e). Flip on for
-    # long sequences where saving probs stops being affordable.
-    use_flash_attention: bool = False
+    # Fused PACKED flash attention ON by default (round 5): with the
+    # projections feeding the kernels in [B,S,d] layout (zero head
+    # transposes) the WMT bench geometry (b48 s256, v5e) measures
+    # 152.1 ms/step vs 168.2 on the round-4 saved-probs path — the old
+    # "unfused wins at s=256" call (168.2 vs 180.3) was paying 4 head
+    # transposes per layer that the packed kernels don't.
+    use_flash_attention: bool = True
 
     def __post_init__(self):
         if self.weight_sharing and self.src_vocab_size != self.tgt_vocab_size:
@@ -129,25 +129,29 @@ def _mha(q_in, kv_in, attn_bias, cfg, name, is_test=False, causal=False):
     k = _dense(kv_in, d, f"{name}_k", cfg, tp_spec=(None, "mp"))
     v = _dense(kv_in, d, f"{name}_v", cfg, tp_spec=(None, "mp"))
 
+    if cfg.use_flash_attention:
+        # PACKED layout: projections feed the kernels as [B,S,d] with no
+        # head transposes (self-attention; cross-attention sq != sk
+        # transposes inside the lowering — same graph as before)
+        ctx = layers.flash_attention(
+            q, k, v, bias=attn_bias, causal=causal, scale=hd ** -0.5,
+            num_heads=n, dropout_rate=cfg.dropout, is_test=is_test)
+        return _dense(ctx, d, f"{name}_o", cfg, tp_spec=("mp", None))
+
     def split_heads(t):
         t = layers.reshape(t, [0, 0, n, hd])
         return layers.transpose(t, [0, 2, 1, 3])  # [B,n,S,hd]
 
     q, k, v = split_heads(q), split_heads(k), split_heads(v)
-    if cfg.use_flash_attention:
-        ctx = layers.flash_attention(
-            q, k, v, bias=attn_bias, causal=causal, scale=hd ** -0.5,
-            dropout_rate=cfg.dropout, is_test=is_test)
-    else:
-        scores = layers.matmul(q, k, transpose_y=True, alpha=hd ** -0.5)
-        if causal:
-            scores = scores + _causal_bias(int(q.shape[2]))
-        if attn_bias is not None:
-            scores = scores + attn_bias
-        probs = layers.softmax(scores)
-        probs = layers.dropout(probs, cfg.dropout, is_test=is_test,
-                               dropout_implementation="upscale_in_train")
-        ctx = layers.matmul(probs, v)
+    scores = layers.matmul(q, k, transpose_y=True, alpha=hd ** -0.5)
+    if causal:
+        scores = scores + _causal_bias(int(q.shape[2]))
+    if attn_bias is not None:
+        scores = scores + attn_bias
+    probs = layers.softmax(scores)
+    probs = layers.dropout(probs, cfg.dropout, is_test=is_test,
+                           dropout_implementation="upscale_in_train")
+    ctx = layers.matmul(probs, v)
     ctx = layers.transpose(ctx, [0, 2, 1, 3])
     ctx = layers.reshape(ctx, [0, 0, d])
     return _dense(ctx, d, f"{name}_o", cfg, tp_spec=("mp", None))

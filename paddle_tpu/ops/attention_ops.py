@@ -60,8 +60,22 @@ def flash_attention_op(ins, attrs):
     out, lse = flash_attention_fwd_lse(
         q, k, v, bias=bias, causal=bool(attrs.get("causal", False)),
         scale=attrs.get("scale", None),
-        dropout_rate=rate, dropout_seed=seed)
+        dropout_rate=rate, dropout_seed=seed,
+        num_heads=_local_heads(q, attrs))
     return {"Out": out, "Lse": lse}
+
+
+def _local_heads(q, attrs):
+    """Packed-layout head count for THIS shard: prefer the
+    sharding-invariant head_dim attr (q's columns may be a
+    tensor-parallel shard of the global width), fall back to the
+    num_heads attr for descs without it."""
+    if q.ndim != 3:
+        return None
+    hd = attrs.get("head_dim")
+    if hd:
+        return int(q.shape[-1]) // int(hd)
+    return attrs.get("num_heads", None)
 
 
 @register_grad_maker("flash_attention")
@@ -109,7 +123,8 @@ def flash_attention_grad_op(ins, attrs):
     import jax
 
     from .pallas.flash_attention import (_dispatch_plan, flash_attention,
-                                         flash_attention_bwd)
+                                         flash_attention_bwd,
+                                         packed_saved_bwd_route)
 
     q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
     bias = ins["Bias"][0] if ins.get("Bias") else None
@@ -117,11 +132,18 @@ def flash_attention_grad_op(ins, attrs):
     rate, seed = _attn_dropout(attrs)
     causal = bool(attrs.get("causal", False))
     scale = attrs.get("scale", None)
-    route, _ = _dispatch_plan(q, k, bias)
-    if route.startswith("pallas"):
+    num_heads = _local_heads(q, attrs)
+    if q.ndim == 3:
+        # ONE dispatch authority shared with flash_attention_bwd:
+        # 'packed'/'bnsd' routes have saved (out, lse); 'vjp' recomputes
+        direct = packed_saved_bwd_route(q, k, bias,
+                                        int(num_heads)) != "vjp"
+    else:
+        direct = _dispatch_plan(q, k, bias)[0].startswith("pallas")
+    if direct:
         dq, dk, dv, dbias_kv = flash_attention_bwd(
             q, k, v, bias, out, lse, do, causal=causal, scale=scale,
-            dropout_rate=rate, dropout_seed=seed)
+            dropout_rate=rate, dropout_seed=seed, num_heads=num_heads)
     else:
         args = (q, k, v) + ((bias,) if bias is not None else ())
 
@@ -129,7 +151,7 @@ def flash_attention_grad_op(ins, attrs):
             b_ = a[3] if len(a) > 3 else None
             return flash_attention(a[0], a[1], a[2], bias=b_, causal=causal,
                                    scale=scale, dropout_rate=rate,
-                                   dropout_seed=seed)
+                                   dropout_seed=seed, num_heads=num_heads)
 
         _, vjp = jax.vjp(f, *args)
         got = vjp(do.astype(out.dtype).reshape(out.shape))

@@ -53,6 +53,11 @@ def tree_conv(ins, attrs):
     # rows valid until the first (0,0) pair, exclusive
     invalid = (u == 0) & (v == 0)
     valid = jnp.cumsum(invalid.astype(jnp.int32), axis=1) == 0  # [B, E]
+    # re-point post-terminator rows (garbage per the reference, which
+    # breaks at the terminator) at the padding slot 0 so their scatter
+    # writes cannot touch real nodes
+    u = jnp.where(valid, u, 0)
+    v = jnp.where(valid, v, 0)
 
     # child rank among earlier same-parent edges (1-based, tree2col.cc
     # pushes TreeNode(v, i+1, sz, ...)) and parent child-count
@@ -94,8 +99,11 @@ def tree_conv(ins, attrs):
     # the root itself: eta_t=1, eta_l=eta_r=0 — but only for real roots
     # (nodes that exist: appear in a valid edge)
     exists = jnp.zeros((b, n + 1), jnp.bool_)
-    exists = exists.at[bidx, u].set(valid, mode="drop")
-    exists = exists.at[bidx, v].set(valid, mode="drop") | exists
+    # .max, not .set: duplicate indices (a parent with several children)
+    # would otherwise resolve in undefined order
+    exists = exists.at[bidx, u].max(valid, mode="drop")
+    exists = exists.at[bidx, v].max(valid, mode="drop")
+    exists = exists.at[:, 0].set(False)
     eye = jnp.eye(n + 1, dtype=jnp.float32)[None]
     eta_t = eta_t + eye * exists[:, None, :].astype(jnp.float32)
 
@@ -231,6 +239,11 @@ def pyramid_hash(ins, attrs):
     num_emb = int(attrs["num_emb"])
     space_len = int(attrs["space_len"])
     rand_len = int(attrs["rand_len"])
+    if num_emb % rand_len:
+        raise ValueError(
+            f"pyramid_hash: num_emb ({num_emb}) must be a multiple of "
+            f"rand_len ({rand_len}) — the reference enforces the same "
+            f"(pyramid_hash_op.cc:132)")
     layers = int(attrs.get("pyramid_layer", 2))
     if int(attrs.get("white_list_len", 0)) or \
             int(attrs.get("black_list_len", 0)):

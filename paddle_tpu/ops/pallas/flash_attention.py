@@ -17,6 +17,7 @@ constraints or no TPU/interpreter backend is selected (kernel_mode()).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -909,6 +910,250 @@ def _fused_bwd_kernel_g(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
         preferred_element_type=jnp.float32).astype(dk_ref.dtype)
 
 
+# ---------------------------------------------------------------------------
+# packed-layout fused kernels: q/k/v in the projection's native [B,S,n*hd]
+# ---------------------------------------------------------------------------
+#
+# The model's 4 head transposes per layer ([B,S,n,hd]<->[B,n,S,hd] around
+# q/k/v and ctx) cost ~13.9 ms of the ERNIE step. These kernels read the
+# projection outputs DIRECTLY: the grid cell is (batch, block of g heads),
+# the block a [sq, g*hd] column slice, and the per-head "transpose" is a
+# static column slice inside VMEM. Measured (tools/exp_packed_attn.py,
+# b34/h16/s512/d64 + dropout): fwd 0.80 ms/layer (g=16) vs 1.00 for
+# kernel+transposes; bwd 1.48 (g=8) vs 1.81. g=16 bwd exceeds VMEM
+# (9 io blocks x 1 MB double-buffered + f32 temporaries).
+
+# VMEM budgets as block ELEMENTS (cols x sq), measured at s=512/h=16:
+# fwd g=16 (1024-col blocks) best; bwd g=16 exceeds VMEM, g=8 best.
+PACKED_FWD_ELEMS = 1024 * 512
+PACKED_BWD_ELEMS = 512 * 512
+
+
+def _packed_g(h, hd, sq, limit_elems):
+    """Largest g dividing h whose [sq, g*hd] block is Mosaic-legal
+    ((g*hd) % 128 == 0 or whole-width; lse block needs g % 8 == 0 or
+    whole-h) and fits the VMEM element budget; 0 if none."""
+    for g in range(h, 0, -1):
+        if h % g:
+            continue
+        if (g * hd) % 128 and g != h:
+            continue
+        if g % 8 and g != h:
+            continue
+        if g * hd * sq <= limit_elems:
+            return g
+    return 0
+
+
+def _packed_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref,
+                       lse_ref, *, scale, causal, g, npg, hd, rate,
+                       n_heads, sq_g, sk_g):
+    from jax.experimental import pallas as pl
+
+    c = pl.program_id(0)
+    bidx0 = (c // npg) * n_heads + (c % npg) * g
+    for i in range(g):
+        sl = slice(i * hd, (i + 1) * hd)
+        q = q_ref[0, :, sl]                    # (sq, hd)
+        k = k_ref[0, :, sl]
+        v = v_ref[0, :, sl]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if bias_ref is not None:
+            s = s + bias_ref[0, 0].astype(jnp.float32)[None, :]
+        sq_n, sk_n = s.shape
+        if causal:
+            rows = (sk_n - sq_n) + jax.lax.broadcasted_iota(
+                jnp.int32, (sq_n, sk_n), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (sq_n, sk_n), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        if rate > 0.0:
+            p = p * _keep_scale_tile(seed_ref[0], rate, bidx0 + i,
+                                     n_heads, 0, 0, sq_n, sk_n,
+                                     sq_g, sk_g)
+        ln = jnp.where(l == 0.0, 1.0, l)
+        acc = jax.lax.dot(p.astype(v.dtype), v,
+                          preferred_element_type=jnp.float32)
+        o_ref[0, :, sl] = (acc / ln).astype(o_ref.dtype)
+        lse_ref[0, i, :] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, 0]
+
+
+def _packed_bwd_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+                       bias_ref, seed_ref, dq_ref, dk_ref, dv_ref,
+                       dbias_ref, *, scale, causal, g, npg, hd, rate,
+                       n_heads, sq_g, sk_g):
+    from jax.experimental import pallas as pl
+
+    c = pl.program_id(0)
+    bidx0 = (c // npg) * n_heads + (c % npg) * g
+    db_acc = None
+    for i in range(g):
+        sl = slice(i * hd, (i + 1) * hd)
+        q = q_ref[0, :, sl]
+        k = k_ref[0, :, sl]
+        v = v_ref[0, :, sl]
+        do = do_ref[0, :, sl]
+        o = o_ref[0, :, sl]
+        lse = lse_ref[0, i, :][:, None]
+        delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                        axis=-1, keepdims=True)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if bias_ref is not None:
+            s = s + bias_ref[0, 0].astype(jnp.float32)[None, :]
+        sq_n, sk_n = s.shape
+        if causal:
+            rows = (sk_n - sq_n) + jax.lax.broadcasted_iota(
+                jnp.int32, (sq_n, sk_n), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (sq_n, sk_n), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        if rate > 0.0:
+            mt = _keep_scale_tile(seed_ref[0], rate, bidx0 + i, n_heads,
+                                  0, 0, sq_n, sk_n, sq_g, sk_g)
+            pd_ = p * mt
+        else:
+            mt, pd_ = None, p
+        dv_ref[0, :, sl] = jax.lax.dot_general(
+            pd_.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        if mt is not None:
+            dp = dp * mt
+        ds_nos = p * (dp - delta)
+        if dbias_ref is not None:
+            db_acc = jnp.sum(ds_nos, axis=0) if db_acc is None \
+                else db_acc + jnp.sum(ds_nos, axis=0)
+        ds = (ds_nos * scale).astype(q.dtype)
+        dq_ref[0, :, sl] = jax.lax.dot(
+            ds, k, preferred_element_type=jnp.float32).astype(dq_ref.dtype)
+        dk_ref[0, :, sl] = jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dk_ref.dtype)
+    if dbias_ref is not None:
+        dbias_ref[0, 0] = db_acc
+
+
+def _fwd_pallas_packed(q3, k3, v3, bias_kv, causal, scale, interpret,
+                       seed, rate, n_heads):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, sq, htot = q3.shape
+    hd = htot // n_heads
+    g = _packed_g(n_heads, hd, sq, PACKED_FWD_ELEMS)
+    npg = n_heads // g
+    seed_arr = jnp.asarray([0 if seed is None else seed], jnp.uint32)
+    cspec = pl.BlockSpec((1, sq, g * hd),
+                         lambda c, _n=npg: (c // _n, 0, c % _n))
+    in_specs = [cspec, cspec, cspec]
+    args = [q3, k3, v3]
+    kw = dict(scale=scale, causal=causal, g=g, npg=npg, hd=hd, rate=rate,
+              n_heads=n_heads, sq_g=sq, sk_g=sq)
+    if bias_kv is not None:
+        in_specs.append(pl.BlockSpec((1, 1, sq),
+                                     lambda c, _n=npg: (c // _n, 0, 0)))
+        args.append(bias_kv.reshape(b, 1, sq))
+        kernel = functools.partial(_packed_fwd_kernel, **kw)
+    else:
+        def kernel(q, k, v, seed_r, o, lse):
+            _packed_fwd_kernel(q, k, v, None, seed_r, o, lse, **kw)
+    in_specs.append(_seed_spec(pl, pltpu))
+    args.append(seed_arr)
+    o3, lse = pl.pallas_call(
+        kernel, grid=(b * npg,), in_specs=in_specs,
+        out_specs=[cspec,
+                   pl.BlockSpec((1, g, sq),
+                                lambda c, _n=npg: (c // _n, c % _n, 0))],
+        out_shape=[jax.ShapeDtypeStruct((b, sq, htot), q3.dtype),
+                   jax.ShapeDtypeStruct((b, n_heads, sq), jnp.float32)],
+        interpret=interpret)(*args)
+    return o3, lse
+
+
+def _bwd_pallas_packed(q3, k3, v3, bias_kv, causal, scale, interpret,
+                       o3, lse, do3, seed, rate, n_heads):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, sq, htot = q3.shape
+    hd = htot // n_heads
+    g = _packed_g(n_heads, hd, sq, PACKED_BWD_ELEMS)
+    npg = n_heads // g
+    seed_arr = jnp.asarray([0 if seed is None else seed], jnp.uint32)
+    cspec = pl.BlockSpec((1, sq, g * hd),
+                         lambda c, _n=npg: (c // _n, 0, c % _n))
+    in_specs = [cspec] * 5 + [
+        pl.BlockSpec((1, g, sq), lambda c, _n=npg: (c // _n, c % _n, 0))]
+    args = [q3, k3, v3, do3, o3, lse]
+    kw = dict(scale=scale, causal=causal, g=g, npg=npg, hd=hd, rate=rate,
+              n_heads=n_heads, sq_g=sq, sk_g=sq)
+    out_specs = [cspec, cspec, cspec]
+    out_shape = [jax.ShapeDtypeStruct((b, sq, htot), q3.dtype)] * 3
+    has_bias = bias_kv is not None
+    if has_bias:
+        in_specs.append(pl.BlockSpec((1, 1, sq),
+                                     lambda c, _n=npg: (c // _n, 0, 0)))
+        args.append(bias_kv.reshape(b, 1, sq))
+        in_specs.append(_seed_spec(pl, pltpu))
+        args.append(seed_arr)
+        out_specs.append(pl.BlockSpec((1, 1, sq), lambda c: (c, 0, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((b * npg, 1, sq),
+                                              jnp.float32))
+        kernel = functools.partial(_packed_bwd_kernel, **kw)
+    else:
+        in_specs.append(_seed_spec(pl, pltpu))
+        args.append(seed_arr)
+
+        def kernel(q, k, v, do, o, l, seed_r, dq, dk, dv):
+            _packed_bwd_kernel(q, k, v, do, o, l, None, seed_r,
+                               dq, dk, dv, None, **kw)
+    outs = pl.pallas_call(
+        kernel, grid=(b * npg,), in_specs=in_specs, out_specs=out_specs,
+        out_shape=out_shape, interpret=interpret)(*args)
+    if has_bias:
+        dq3, dk3, dv3, dbias3 = outs
+        dbias = jnp.sum(dbias3.reshape(b, npg, sq), axis=1)
+    else:
+        dq3, dk3, dv3 = outs
+        dbias = None
+    return dq3, dk3, dv3, dbias
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_packed(q, k, v, bias_kv, seed, causal, scale, interpret, rate,
+                  n_heads):
+    """Packed-layout twin of _flash: (out, lse) over [B,S,n*hd] inputs.
+    lse's cotangent is discarded (auxiliary output)."""
+    return _fwd_pallas_packed(q, k, v, bias_kv, causal, scale, interpret,
+                              seed, rate, n_heads)
+
+
+def _flash_packed_fwd(q, k, v, bias_kv, seed, causal, scale, interpret,
+                      rate, n_heads):
+    o, lse = _fwd_pallas_packed(q, k, v, bias_kv, causal, scale,
+                                interpret, seed, rate, n_heads)
+    return (o, lse), (q, k, v, bias_kv, seed, o, lse)
+
+
+def _flash_packed_bwd(causal, scale, interpret, rate, n_heads, res, cts):
+    do, _dlse = cts
+    q, k, v, bias_kv, seed, o, lse = res
+    dq, dk, dv, dbias = _bwd_pallas_packed(q, k, v, bias_kv, causal,
+                                           scale, interpret, o, lse, do,
+                                           seed, rate, n_heads)
+    if dbias is not None:
+        dbias = dbias.astype(bias_kv.dtype)
+    return dq, dk, dv, dbias, None
+
+
+_flash_packed.defvjp(_flash_packed_fwd, _flash_packed_bwd)
+
+
 def _largest_divisor_leq(h, want):
     """Largest g in (1, want] dividing h (0 if none) — the head-block
     size search shared by _fused_g and the fwd-only blocking."""
@@ -1270,11 +1515,80 @@ def _dispatch_plan(q, k, bias):
     return ("pallas_interpret" if mode == "interpret" else "pallas"), bias_kv
 
 
+def _packed_proxies(q, k, n_heads):
+    """4-D shape proxies for the packed [B,S,n*hd] arrays, for the
+    shape-only dispatch helpers (_impl_choice/_supported). k gets its
+    OWN sequence length — cross-attention has sq != sk."""
+    import types
+
+    b, sq, htot = q.shape
+    sk = k.shape[1]
+    hd = htot // n_heads
+    return (types.SimpleNamespace(shape=(b, n_heads, sq, hd), ndim=4),
+            types.SimpleNamespace(shape=(b, n_heads, sk, hd), ndim=4))
+
+
+def _packed_fast_applies(q, k, bias, n_heads):
+    """Whether the packed [B,S,n*hd] inputs can run the packed fused
+    kernels directly: the pallas route at a fused-single-block geometry
+    with lane-aligned head blocks. Shared by the forward and the grad
+    op so their dispatch always agrees."""
+    b, sq, htot = q.shape
+    sk = k.shape[1]
+    if htot % n_heads:
+        return False, None, None
+    hd = htot // n_heads
+    qp, kp = _packed_proxies(q, k, n_heads)
+    route, bias_kv = _dispatch_plan(qp, kp, bias)
+    if route == "xla" and os.environ.get(
+            "PT_FLASH_IMPL", "auto").lower() != "xla":
+        # the packed kernels OVERRIDE the bnsd FUSED_MIN_SEQ=256 routing:
+        # without head transposes the round-4 "XLA wins below 256"
+        # measurement flips — BERT-base (s=128 b384) measured 219.3
+        # ms/step on the packed kernels vs 250.7 on the XLA route
+        # (62.1% vs 54.3% MFU). PT_FLASH_IMPL=xla still forces XLA.
+        from . import kernel_mode
+
+        if kernel_mode() == "tpu" and _supported(qp, kp, bias_kv):
+            route = "pallas"
+    ok = (route.startswith("pallas") and sq == sk and hd % 8 == 0
+          and (n_heads * hd) % 128 == 0
+          and _fused_bwd_applies(sq, sk)
+          and _packed_g(n_heads, hd, sq, PACKED_FWD_ELEMS)
+          and _packed_g(n_heads, hd, sq, PACKED_BWD_ELEMS))
+    return bool(ok), route, bias_kv
+
+
+def packed_saved_bwd_route(q, k, bias, n_heads):
+    """The grad op's single dispatch question for packed inputs:
+    'packed' (packed kernels directly), 'bnsd' (transpose + saved-lse
+    bnsd pallas backward) or 'vjp' (recompute route — XLA CSEs the
+    re-traced standard-HLO forward). Centralised so the grad op and
+    flash_attention_bwd can never disagree."""
+    ok, _, _ = _packed_fast_applies(q, k, bias, n_heads)
+    if ok:
+        return "packed"
+    qp, kp = _packed_proxies(q, k, n_heads)
+    route, _ = _dispatch_plan(qp, kp, bias)
+    return "bnsd" if route.startswith("pallas") else "vjp"
+
+
+def _packed_to_bnsd(x, n_heads):
+    b, s, htot = x.shape
+    return jnp.swapaxes(x.reshape(b, s, n_heads, htot // n_heads), 1, 2)
+
+
+def _bnsd_to_packed(x4):
+    b, n, s, hd = x4.shape
+    return jnp.swapaxes(x4, 1, 2).reshape(b, s, n * hd)
+
+
 def flash_attention(q, k, v, bias=None, causal=False, scale=None,
-                    dropout_rate=0.0, dropout_seed=None):
+                    dropout_rate=0.0, dropout_seed=None, num_heads=None):
     """softmax(q k^T * scale + bias) v, O(S)-memory in the backward.
 
-    q [B,H,Sq,D]; k,v [B,H,Sk,D]; bias None or broadcastable to
+    q [B,H,Sq,D]; k,v [B,H,Sk,D] — or packed [B,S,n*hd] with num_heads
+    (see flash_attention_fwd_lse); bias None or broadcastable to
     [B,1,1,Sk] (key padding mask) or exactly [B,Sk].
     dropout_rate>0 applies attention-probs dropout (reference recipe's
     attention_probs_dropout_prob, upscale_in_train) via the position-keyed
@@ -1292,12 +1606,14 @@ def flash_attention(q, k, v, bias=None, causal=False, scale=None,
     Override with PT_FLASH_IMPL=pallas|xla.
     """
     out, _ = flash_attention_fwd_lse(q, k, v, bias, causal, scale,
-                                     dropout_rate, dropout_seed)
+                                     dropout_rate, dropout_seed,
+                                     num_heads=num_heads)
     return out
 
 
 def flash_attention_fwd_lse(q, k, v, bias=None, causal=False, scale=None,
-                            dropout_rate=0.0, dropout_seed=None):
+                            dropout_rate=0.0, dropout_seed=None,
+                            num_heads=None):
     """flash_attention returning (out, lse).
 
     lse [B,H,Sq] f32 is the log-sum-exp residual the saved-residual
@@ -1305,7 +1621,18 @@ def flash_attention_fwd_lse(q, k, v, bias=None, causal=False, scale=None,
     meaningful on the pallas routes — the xla/reference recompute paths
     return zeros (their program backward re-traces the forward, whose
     standard-HLO duplicate XLA CSEs away; only pallas custom-calls are
-    never CSE'd, which is why the saved-lse path exists)."""
+    never CSE'd, which is why the saved-lse path exists).
+
+    3-D q/k/v [B,S,n*hd] (num_heads required) select the PACKED layout:
+    the projection outputs feed the kernels directly and ctx comes back
+    [B,S,n*hd] — no head transposes in the program (~13.9 ms/step of
+    the round-4 ERNIE profile). Shapes outside the packed fused regime
+    transpose internally and take the standard dispatch."""
+    if q.ndim == 3:
+        if not num_heads:
+            raise ValueError("packed flash attention needs num_heads")
+        return _packed_fwd_lse(q, k, v, bias, causal, scale,
+                               dropout_rate, dropout_seed, int(num_heads))
     d = q.shape[-1]
     scale = float(scale) if scale is not None else 1.0 / float(np.sqrt(d))
     rate = float(dropout_rate or 0.0)
@@ -1334,17 +1661,75 @@ def flash_attention_fwd_lse(q, k, v, bias=None, causal=False, scale=None,
     return out, jnp.zeros((b, h, sq), jnp.float32)
 
 
+def _packed_fwd_lse(q, k, v, bias, causal, scale, dropout_rate,
+                    dropout_seed, n_heads):
+    b, sq, htot = q.shape
+    hd = htot // n_heads
+    scale = float(scale) if scale is not None else 1.0 / float(np.sqrt(hd))
+    rate = float(dropout_rate or 0.0)
+    seed = jnp.asarray(0 if dropout_seed is None else dropout_seed,
+                       jnp.uint32)
+    ok, route, bias_kv = _packed_fast_applies(q, k, bias, n_heads)
+    if ok:
+        if rate > 0.0:
+            _warn_lattice_wrap(sq, sq)
+        return _flash_packed(q, k, v, bias_kv, seed, causal, scale,
+                             route == "pallas_interpret", rate, n_heads)
+    out4, lse = flash_attention_fwd_lse(
+        _packed_to_bnsd(q, n_heads), _packed_to_bnsd(k, n_heads),
+        _packed_to_bnsd(v, n_heads), bias, causal, scale, dropout_rate,
+        dropout_seed)
+    return _bnsd_to_packed(out4), lse
+
+
 def flash_attention_bwd(q, k, v, bias, out, lse, dout, causal=False,
-                        scale=None, dropout_rate=0.0, dropout_seed=None):
+                        scale=None, dropout_rate=0.0, dropout_seed=None,
+                        num_heads=None):
     """Backward from the SAVED forward (out, lse): runs only the bwd
     kernels — no forward re-execution (the vjp path re-runs the fwd
     pallas custom-call, which XLA cannot CSE with the forward op's;
     measured ~0.8 ms/layer of pure duplicate work on ERNIE-large).
 
     Only valid on the pallas routes — callers must check
-    _dispatch_plan(q, k, bias)[0].startswith('pallas') first.
+    _dispatch_plan(q, k, bias)[0].startswith('pallas') (or, packed,
+    _packed_fast_applies) first.
     Returns (dq, dk, dv, dbias_kv); dbias_kv is [B,Sk] (the key-bias
     normal form) or None when bias is None."""
+    if q.ndim == 3:
+        n = int(num_heads)
+        kind = packed_saved_bwd_route(q, k, bias, n)
+        if kind == "vjp":
+            raise ValueError(
+                "flash_attention_bwd(packed) on a non-pallas route "
+                "— the grad op should have taken the vjp fallback")
+        if kind == "bnsd":
+            # packed model at a non-packed geometry (e.g. long context
+            # s >= 2048, or cross-attention sq != sk): the forward
+            # transposed internally to the bnsd pallas path and its
+            # (out, lse) ARE saved — transpose and run the
+            # saved-residual bnsd backward (the vjp fallback would
+            # re-run the non-CSE-able fwd kernel)
+            dq4, dk4, dv4, dbias = flash_attention_bwd(
+                _packed_to_bnsd(q, n), _packed_to_bnsd(k, n),
+                _packed_to_bnsd(v, n), bias, _packed_to_bnsd(out, n),
+                lse, _packed_to_bnsd(dout, n), causal=causal,
+                scale=scale, dropout_rate=dropout_rate,
+                dropout_seed=dropout_seed)
+            return (_bnsd_to_packed(dq4), _bnsd_to_packed(dk4),
+                    _bnsd_to_packed(dv4), dbias)
+        _, route, bias_kv = _packed_fast_applies(q, k, bias, n)
+        hd = q.shape[-1] // n
+        scale = float(scale) if scale is not None \
+            else 1.0 / float(np.sqrt(hd))
+        seed = jnp.asarray(0 if dropout_seed is None else dropout_seed,
+                           jnp.uint32)
+        dq, dk, dv, dbias = _bwd_pallas_packed(
+            q, k, v, bias_kv, causal, scale,
+            route == "pallas_interpret", out, lse, dout, seed,
+            float(dropout_rate or 0.0), n)
+        if dbias is not None and bias_kv is not None:
+            dbias = dbias.astype(bias_kv.dtype)
+        return dq, dk, dv, dbias
     d = q.shape[-1]
     scale = float(scale) if scale is not None else 1.0 / float(np.sqrt(d))
     rate = float(dropout_rate or 0.0)

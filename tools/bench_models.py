@@ -159,6 +159,31 @@ def bench_resnet50(steps=20, batch=None, amp=True):
     }
 
 
+def bench_long_context(steps=8, batch=None, seq=2048, max_preds=None):
+    """Long-context single-chip rows (round-4/5 table in BASELINE.md):
+    ERNIE-large geometry with the position table extended to seq.
+    seq=2048 b4 / seq=4096 b2; bf16, attention dropout on."""
+    import dataclasses
+
+    from paddle_tpu.models import bert
+
+    batch = batch or int(os.environ.get(
+        "PT_BENCH_BATCH", "4" if seq <= 2048 else "2"))
+    max_preds = max_preds or max(80, seq * 15 // 100)
+
+    def cfg_fn():
+        cfg = bert.ernie_large()
+        return dataclasses.replace(cfg, max_position_embeddings=seq)
+
+    return bench_bert_like(
+        cfg_fn, seq=seq, batch=batch, max_preds=max_preds, steps=steps,
+        metric_name=f"ernie_large_s{seq}_tokens_per_sec_per_chip")
+
+
+def bench_long_context_4096(steps=8, batch=None):
+    return bench_long_context(steps=steps, batch=batch, seq=4096)
+
+
 def bench_mnist(steps=200, batch=None):
     """Ladder config 1: LeNet MNIST smoke (reference fixture:
     tests/book/test_recognize_digits.py). Tiny model — dispatch-bound,
@@ -233,6 +258,8 @@ WORKLOADS = {
     "bert_base": bench_bert_base,
     "resnet50": bench_resnet50,
     "transformer_big": bench_transformer_big,
+    "long2048": bench_long_context,
+    "long4096": bench_long_context_4096,
 }
 
 

@@ -1,0 +1,288 @@
+"""Prototype: packed-layout fused attention kernels.
+
+The model pays ~13.9 ms/step of [B,S,n,hd]<->[B,n,S,hd] transposes around
+attention. These kernels read q/k/v in the projection's native [B,S,n*hd]
+layout (block = g consecutive head columns; the "transpose" is a static
+column slice inside the kernel) and write ctx back in the same layout.
+
+Compares at the ERNIE geometry: packed fwd/bwd vs transpose + current
+g-blocked kernels.
+"""
+
+from __future__ import annotations
+
+import functools
+import importlib
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from bench_matmul_shapes import slope_time
+
+fa = importlib.import_module('paddle_tpu.ops.pallas.flash_attention')
+
+B, H, S, D = 34, 16, 512, 64
+dt = jnp.bfloat16
+key = jax.random.PRNGKey(0)
+
+
+def _packed_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref,
+                       lse_ref, *, scale, g, npg, hd, rate, n_heads,
+                       sq_g, sk_g):
+    c = pl.program_id(0)
+    bidx0 = (c // npg) * n_heads + (c % npg) * g
+    for i in range(g):
+        sl = slice(i * hd, (i + 1) * hd)
+        q = q_ref[0, :, sl]                    # (sq, hd)
+        k = k_ref[0, :, sl]
+        v = v_ref[0, :, sl]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if bias_ref is not None:
+            s = s + bias_ref[0, 0].astype(jnp.float32)[None, :]
+        sq_n, sk_n = s.shape
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        if rate > 0.0:
+            p = p * fa._keep_scale_tile(seed_ref[0], rate, bidx0 + i,
+                                        n_heads, 0, 0, sq_n, sk_n,
+                                        sq_g, sk_g)
+        ln = jnp.where(l == 0.0, 1.0, l)
+        acc = jax.lax.dot(p.astype(v.dtype), v,
+                          preferred_element_type=jnp.float32)
+        o_ref[0, :, sl] = (acc / ln).astype(o_ref.dtype)
+        lse_ref[0, i, :] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, 0]
+
+
+def packed_fwd(q3, k3, v3, bias_kv, seed, scale, g=8, rate=0.1,
+               interpret=False, n_heads=H, hd=D):
+    b, sq, _htot = q3.shape
+    npg = n_heads // g
+    seed_arr = jnp.asarray([seed], jnp.uint32)
+    cspec = pl.BlockSpec((1, sq, g * hd), lambda c: (c // npg, 0, c % npg))
+    specs = [cspec, cspec, cspec]
+    args = [q3, k3, v3]
+    kw = dict(scale=scale, g=g, npg=npg, hd=hd, rate=rate,
+              n_heads=n_heads, sq_g=sq, sk_g=sq)
+    if bias_kv is not None:
+        specs.append(pl.BlockSpec((1, 1, sq), lambda c: (c // npg, 0, 0)))
+        args.append(bias_kv.reshape(b, 1, sq))
+        kernel = functools.partial(_packed_fwd_kernel, **kw)
+    else:
+        def kernel(q, k, v, seed_r, o, lse):
+            _packed_fwd_kernel(q, k, v, None, seed_r, o, lse, **kw)
+    specs.append(pl.BlockSpec((1,), lambda c: (0,),
+                              memory_space=pltpu.SMEM))
+    args.append(seed_arr)
+    out_shape = [jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+                 jax.ShapeDtypeStruct((b, n_heads, sq), jnp.float32)]
+    out_specs = [
+        cspec,
+        pl.BlockSpec((1, g, sq), lambda c: (c // npg, c % npg, 0)),
+    ]
+    return pl.pallas_call(
+        kernel, grid=(b * npg,), in_specs=specs, out_specs=out_specs,
+        out_shape=out_shape, interpret=interpret)(*args)
+
+
+def _packed_bwd_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+                       bias_ref, seed_ref, dq_ref, dk_ref, dv_ref,
+                       dbias_ref, *, scale, g, npg, hd, rate, n_heads,
+                       sq_g, sk_g):
+    c = pl.program_id(0)
+    bidx0 = (c // npg) * n_heads + (c % npg) * g
+    db_acc = None
+    for i in range(g):
+        sl = slice(i * hd, (i + 1) * hd)
+        q = q_ref[0, :, sl]
+        k = k_ref[0, :, sl]
+        v = v_ref[0, :, sl]
+        do = do_ref[0, :, sl]
+        o = o_ref[0, :, sl]
+        lse = lse_ref[0, i, :][:, None]
+        delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                        axis=-1, keepdims=True)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if bias_ref is not None:
+            s = s + bias_ref[0, 0].astype(jnp.float32)[None, :]
+        sq_n, sk_n = s.shape
+        p = jnp.exp(s - lse)
+        if rate > 0.0:
+            mt = fa._keep_scale_tile(seed_ref[0], rate, bidx0 + i,
+                                     n_heads, 0, 0, sq_n, sk_n,
+                                     sq_g, sk_g)
+            pd_ = p * mt
+        else:
+            mt, pd_ = None, p
+        dv_ref[0, :, sl] = jax.lax.dot_general(
+            pd_.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        if mt is not None:
+            dp = dp * mt
+        ds_nos = p * (dp - delta)
+        if dbias_ref is not None:
+            db_acc = jnp.sum(ds_nos, axis=0) if db_acc is None \
+                else db_acc + jnp.sum(ds_nos, axis=0)
+        ds = (ds_nos * scale).astype(q.dtype)
+        dq_ref[0, :, sl] = jax.lax.dot(
+            ds, k, preferred_element_type=jnp.float32).astype(dq_ref.dtype)
+        dk_ref[0, :, sl] = jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dk_ref.dtype)
+    if dbias_ref is not None:
+        dbias_ref[0, 0] = db_acc
+
+
+def packed_bwd(q3, k3, v3, do3, o3, lse, bias_kv, seed, scale, g=8,
+               rate=0.1, interpret=False, n_heads=H, hd=D):
+    b, sq, _htot = q3.shape
+    npg = n_heads // g
+    seed_arr = jnp.asarray([seed], jnp.uint32)
+    cspec = pl.BlockSpec((1, sq, g * hd), lambda c: (c // npg, 0, c % npg))
+    specs = [cspec] * 5 + [
+        pl.BlockSpec((1, g, sq), lambda c: (c // npg, c % npg, 0))]
+    args = [q3, k3, v3, do3, o3, lse]
+    kw = dict(scale=scale, g=g, npg=npg, hd=hd, rate=rate, n_heads=n_heads,
+              sq_g=sq, sk_g=sq)
+    out_specs = [cspec, cspec, cspec]
+    out_shape = [jax.ShapeDtypeStruct(q3.shape, dt)] * 3
+    if bias_kv is not None:
+        specs.append(pl.BlockSpec((1, 1, sq), lambda c: (c // npg, 0, 0)))
+        args.append(bias_kv.reshape(b, 1, sq))
+        specs.append(pl.BlockSpec((1,), lambda c: (0,),
+                                  memory_space=pltpu.SMEM))
+        args.append(seed_arr)
+        out_specs.append(pl.BlockSpec((1, 1, sq), lambda c: (c, 0, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((b * npg, 1, sq),
+                                              jnp.float32))
+        kernel = functools.partial(_packed_bwd_kernel, **kw)
+    else:
+        specs.append(pl.BlockSpec((1,), lambda c: (0,),
+                                  memory_space=pltpu.SMEM))
+        args.append(seed_arr)
+
+        def kernel(q, k, v, do, o, l, seed_r, dq, dk, dv):
+            _packed_bwd_kernel(q, k, v, do, o, l, None, seed_r,
+                               dq, dk, dv, None, **kw)
+    return pl.pallas_call(
+        kernel, grid=(b * npg,), in_specs=specs, out_specs=out_specs,
+        out_shape=out_shape, interpret=interpret)(*args)
+
+
+def to_bnsd(x3):
+    b, s, _ = x3.shape
+    return jnp.transpose(x3.reshape(b, s, H, D), (0, 2, 1, 3))
+
+
+def from_bnsd(x4):
+    b, n, s, d = x4.shape
+    return jnp.transpose(x4, (0, 2, 1, 3)).reshape(b, s, n * d)
+
+
+def main():
+    q3, k3, v3 = (jax.random.normal(jax.random.PRNGKey(i), (B, S, H * D),
+                                    dt) * 0.3 for i in range(3))
+    do3 = jax.random.normal(jax.random.PRNGKey(9), (B, S, H * D), dt)
+    bias_kv = jnp.where(
+        jax.random.uniform(jax.random.PRNGKey(3), (B, S)) < 0.15,
+        jnp.float32(-10000.0), jnp.float32(0.0))
+    scale = 1.0 / np.sqrt(D)
+    rate = 0.1
+
+    # -- correctness vs current kernels on a slice --------------------------
+    qs, ks, vs, dos = (t[:2] for t in (q3, k3, v3, do3))
+    bs = bias_kv[:2]
+    o_p, lse_p = packed_fwd(qs, ks, vs, bs, 7, scale, g=8, rate=rate)
+    o_r, lse_r = fa._fwd_pallas(to_bnsd(qs), to_bnsd(ks), to_bnsd(vs), bs,
+                                False, scale, False, jnp.uint32(7), rate)
+    print("fwd maxdiff", float(jnp.max(jnp.abs(
+        o_p.astype(jnp.float32) - from_bnsd(o_r).astype(jnp.float32)))),
+        "lse maxdiff", float(jnp.max(jnp.abs(lse_p - lse_r))))
+
+    dq_p, dk_p, dv_p, db_p = packed_bwd(qs, ks, vs, dos, o_p, lse_p, bs,
+                                        7, scale, g=8, rate=rate)
+    db_p = jnp.sum(db_p.reshape(2, H // 8, S), axis=1)
+    dq_r, dk_r, dv_r, db_r = fa._bwd_pallas(
+        to_bnsd(qs), to_bnsd(ks), to_bnsd(vs), bs, False, scale, False,
+        to_bnsd(o_p), lse_p, to_bnsd(dos), jnp.uint32(7), rate)
+    for name, a, b_ in (("dq", dq_p, dq_r), ("dk", dk_p, dk_r),
+                        ("dv", dv_p, dv_r)):
+        print(name, "maxdiff", float(jnp.max(jnp.abs(
+            a.astype(jnp.float32) - from_bnsd(b_).astype(jnp.float32)))))
+    print("dbias maxdiff", float(jnp.max(jnp.abs(db_p - db_r))))
+
+    # -- timing -------------------------------------------------------------
+    for g in (2, 4, 8, 16):
+        if (g * D) % 128:
+            continue
+
+        def fwd_step(x, g=g):
+            o, lse = packed_fwd(x, k3, v3, bias_kv, 7, scale, g=g,
+                                rate=rate)
+            return x * (1 + 1e-20 * jnp.mean(o).astype(x.dtype))
+
+        try:
+            ms = slope_time(fwd_step, q3)
+            print(json.dumps({"case": f"packed_fwd_g{g}",
+                              "ms_per_layer": round(ms, 4)}), flush=True)
+        except Exception as ex:
+            print(f"packed_fwd_g{g} FAILED {str(ex)[:100]}", flush=True)
+
+    def cur_fwd(x):
+        o, lse = fa._fwd_pallas(to_bnsd(x), to_bnsd(k3), to_bnsd(v3),
+                                bias_kv, False, scale, False,
+                                jnp.uint32(7), rate)
+        return x * (1 + 1e-20 * jnp.mean(from_bnsd(o)).astype(x.dtype))
+
+    ms = slope_time(cur_fwd, q3)
+    print(json.dumps({"case": "current_fwd+4transposes",
+                      "ms_per_layer": round(ms, 4)}), flush=True)
+
+    o_full, lse_full = packed_fwd(q3, k3, v3, bias_kv, 7, scale, g=8,
+                                  rate=rate)
+    for g in (2, 4, 8, 16):
+        if (g * D) % 128:
+            continue
+
+        def bwd_step(x, g=g):
+            dq, dk, dv, db = packed_bwd(x, k3, v3, do3, o_full, lse_full,
+                                        bias_kv, 7, scale, g=g, rate=rate)
+            return x * (1 + 1e-20 * (jnp.mean(dq) + jnp.mean(dk)
+                                     + jnp.mean(dv)).astype(x.dtype))
+
+        try:
+            ms = slope_time(bwd_step, q3)
+            print(json.dumps({"case": f"packed_bwd_g{g}",
+                              "ms_per_layer": round(ms, 4)}), flush=True)
+        except Exception as ex:
+            print(f"packed_bwd_g{g} FAILED {str(ex)[:100]}", flush=True)
+
+    def cur_bwd(x):
+        dq, dk, dv, db = fa._bwd_pallas(
+            to_bnsd(x), to_bnsd(k3), to_bnsd(v3), bias_kv, False, scale,
+            False, to_bnsd(o_full), lse_full, to_bnsd(do3),
+            jnp.uint32(7), rate)
+        return x * (1 + 1e-20 * (jnp.mean(from_bnsd(dq))
+                                 + jnp.mean(from_bnsd(dk))
+                                 + jnp.mean(from_bnsd(dv))).astype(x.dtype))
+
+    ms = slope_time(cur_bwd, q3)
+    print(json.dumps({"case": "current_bwd+7transposes",
+                      "ms_per_layer": round(ms, 4)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
