@@ -91,6 +91,12 @@ def _resolve_inputs(op: OpDesc, env: Dict[str, Any]) -> Dict[str, List[Any]]:
     return ins
 
 
+# The execution-coverage record lives in the registry (every lowering
+# invocation records itself, whatever the call path); re-exported here for
+# the callers that think in executor terms.
+from .registry import EXECUTED_OP_TYPES  # noqa: F401
+
+
 def run_op(op: OpDesc, env: Dict[str, Any], step=None, axis_coords=None):
     """Execute one op's lowering against env (shared by both executors).
 

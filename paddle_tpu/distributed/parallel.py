@@ -93,7 +93,12 @@ def cluster_env(rank, nprocs, coordinator):
     if not sep or not port.isdigit():
         raise ValueError(
             f"coordinator must be host:port, got {coordinator!r}")
-    endpoints = [f"{host}:{int(port) + 1 + r}" for r in range(nprocs)]
+    # synthesized ports are COSMETIC (nothing binds them; jax.distributed
+    # uses only the coordinator) — keep them in the valid range so a
+    # coordinator near 65535 with many ranks cannot produce port > 65535
+    base = int(port)
+    endpoints = [f"{host}:{(base + 1 + r - 1024) % 64511 + 1024}"
+                 for r in range(nprocs)]
     return {
         "PADDLE_TRAINER_ID": str(rank),
         "PADDLE_TRAINERS_NUM": str(nprocs),
@@ -123,7 +128,26 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
     import socket
 
     if nprocs <= 0:
-        nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        env_n = os.environ.get("PADDLE_TRAINERS_NUM")
+        if env_n:
+            nprocs = int(env_n)
+        else:
+            # reference distributed/spawn.py defaults to all visible
+            # devices; mirror that (ADVICE r4). The probe runs in a
+            # SUBPROCESS: jax.local_device_count() in the launcher would
+            # initialise the backend and take exclusive ownership of the
+            # chips before any rank starts
+            import subprocess
+            import sys as _sys
+
+            try:
+                out = subprocess.run(
+                    [_sys.executable, "-c",
+                     "import jax; print(jax.local_device_count())"],
+                    capture_output=True, timeout=60, text=True)
+                nprocs = max(1, int(out.stdout.strip().splitlines()[-1]))
+            except Exception:
+                nprocs = 1
     coordinator = options.pop("coordinator", None)
     if coordinator is None:
         # probe-then-release has an inherent TOCTOU window (another

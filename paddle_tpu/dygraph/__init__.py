@@ -131,3 +131,20 @@ def load_dygraph(model_path: str):
         else:
             opt = state
     return params, opt
+
+# fluid.dygraph layer-class surface: the reference re-exports its nn
+# Layer classes under fluid.dygraph (python/paddle/fluid/dygraph/nn.py).
+# Lazy (__getattr__) because paddle_tpu.nn itself imports dygraph.Layer.
+_NN_ALIASES = ("BatchNorm", "Conv2D", "Conv2DTranspose", "Dropout",
+               "Embedding", "Flatten", "GroupNorm", "GRUCell",
+               "LayerList", "LayerNorm", "Linear", "LSTMCell",
+               "ParameterList", "Sequential")
+
+
+def __getattr__(name):
+    if name in _NN_ALIASES:
+        from .. import nn as _nn
+
+        return getattr(_nn, name)
+    raise AttributeError(f"module 'paddle_tpu.dygraph' has no attribute "
+                         f"{name!r}")

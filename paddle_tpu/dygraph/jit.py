@@ -926,27 +926,21 @@ class ConcreteProgram:
         self.param_values = dict(cap.param_values)
         self.closure_ops = cap.closure_ops
         self.treedef = treedef
-        block = self.program.global_block()
-        param_names = list(self.param_values)
-        feed_names = self.feed_names
-        fetch = list(fetch_names)
-
-        def static_call(*arrs):
-            from ..core.executor import run_block
-
-            env = dict(zip(param_names + feed_names, arrs))
-            run_block(block, env)
-            return tuple(env[n] for n in fetch)
-
-        self._jitted = jax.jit(static_call)
-        self.param_names = param_names
+        self.param_names = list(self.param_values)
 
     def __call__(self, arg_vbs: List[VarBase]):
         from .tracer import trace_op
 
-        all_vbs = [self.param_values[n] for n in self.param_names] + arg_vbs
-        outs = trace_op("__jax_fn__", {"X": all_vbs},
-                        {"fn": self._jitted})["Out"]
+        # one run_program op on the tape (reference: run_program_op.cc
+        # via partial_program.py) — the captured program executes as a
+        # single jitted call; its generic vjp IS the backward program
+        param_vbs = [self.param_values[n] for n in self.param_names]
+        outs = trace_op("run_program",
+                        {"X": arg_vbs, "Params": param_vbs},
+                        {"program": self.program,
+                         "feed_names": self.feed_names,
+                         "param_names": self.param_names,
+                         "fetch_names": self.fetch_names})["Out"]
         return self.treedef(outs)
 
 

@@ -9,3 +9,20 @@ from .learning_rate_scheduler import (  # noqa: F401
 from .control_flow import (DynamicRNN, IfElse, array_to_lod_tensor,  # noqa: F401
                            cond, lod_rank_table, lod_tensor_to_array,
                            shrink_memory, static_loop, while_loop)
+
+from . import generated as _generated  # noqa: E402
+from .generated import *  # noqa: F401,F403,E402
+
+
+_NN_CLASS_ALIASES = ("GRUCell", "LSTMCell")
+
+
+def __getattr__(name):
+    # fluid.layers re-exports the RNN cell classes (reference
+    # fluid/layers/rnn.py); lazy since nn imports layers
+    if name in _NN_CLASS_ALIASES:
+        from .. import nn as _nn
+
+        return getattr(_nn, name)
+    raise AttributeError(f"module 'paddle_tpu.layers' has no attribute "
+                         f"{name!r}")

@@ -1,0 +1,608 @@
+"""Table-generated fluid.layers functions over registered ops.
+
+Capability mirror of the reference's layer_function_generator
+(python/paddle/fluid/layers/layer_function_generator.py): most of
+fluid.layers' 156-function surface is mechanical op wrapping, which the
+reference generates from OpProto. Here the table maps each layer name to
+its op's input slots / primary output (same slot names as the
+reference's op protos); multi-output ops create all outputs and return
+the primary, exactly like the generated reference layers.
+
+Compositions (has_inf, smooth_l1, dice_loss, mean_iou, case, ...) that
+the reference writes by hand over other layers are written by hand over
+other layers here too.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..layer_helper import LayerHelper
+
+__all__ = []
+
+
+def _register(name, fn):
+    fn.__name__ = name
+    globals()[name] = fn
+    __all__.append(name)
+    return fn
+
+
+def generate_layer_fn(op_type, in_slots, out_slots, primary=None, doc=""):
+    """A fluid-layers-style function for `op_type`: positional args map
+    to `in_slots`, keyword args become op attrs, returns the primary
+    output var (reference: layer_function_generator.generate_layer_fn)."""
+    primary = primary or out_slots[0]
+
+    def fn(*args, name=None, **attrs):
+        helper = LayerHelper(op_type, name=name)
+        if len(args) > len(in_slots):
+            raise TypeError(f"{op_type}() takes at most {len(in_slots)} "
+                            f"positional args ({in_slots})")
+        dtype = None
+        inputs = {}
+        for slot, arg in zip(in_slots, args):
+            if arg is None:
+                continue
+            inputs[slot] = list(arg) if isinstance(arg, (list, tuple)) \
+                else [arg]
+            if dtype is None:
+                v = inputs[slot][0]
+                dtype = getattr(v, "dtype", None)
+        outs = {s: [helper.create_variable_for_type_inference(
+            attrs.get("dtype", dtype or "float32"))] for s in out_slots}
+        helper.append_op(op_type, inputs, outs, attrs)
+        return outs[primary][0]
+
+    fn.__doc__ = doc or (f"Generated wrapper over the `{op_type}` op "
+                         f"(inputs {in_slots} -> {primary}).")
+    return fn
+
+
+# --- (name, op_type, input slots, output slots[, primary]) ---------------
+_TABLE = [
+    # unary activations / elementwise
+    ("brelu", "brelu", ["X"], ["Out"]),
+    ("hard_shrink", "hard_shrink", ["X"], ["Out"]),
+    ("hard_sigmoid", "hard_sigmoid", ["X"], ["Out"]),
+    ("hard_swish", "hard_swish", ["X"], ["Out"]),
+    ("mish", "mish", ["X"], ["Out"]),
+    ("stanh", "stanh", ["X"], ["Out"]),
+    ("logical_not", "logical_not", ["X"], ["Out"]),
+    ("isfinite", "isfinite", ["X"], ["Out"]),
+    ("reverse", "reverse", ["X"], ["Out"]),
+    ("clip_by_norm", "clip_by_norm", ["X"], ["Out"]),
+    ("is_empty", "is_empty", ["X"], ["Out"]),
+    ("reduce_all", "reduce_all", ["X"], ["Out"]),
+    ("reduce_any", "reduce_any", ["X"], ["Out"]),
+    # binary / comparison / logical
+    ("logical_and", "logical_and", ["X", "Y"], ["Out"]),
+    ("logical_or", "logical_or", ["X", "Y"], ["Out"]),
+    ("logical_xor", "logical_xor", ["X", "Y"], ["Out"]),
+    ("less_equal", "less_equal", ["X", "Y"], ["Out"]),
+    ("greater_equal", "greater_equal", ["X", "Y"], ["Out"]),
+    ("elementwise_floordiv", "elementwise_floordiv", ["X", "Y"], ["Out"]),
+    # gather/scatter family
+    ("gather_nd", "gather_nd", ["X", "Index"], ["Out"]),
+    ("scatter", "scatter", ["X", "Ids", "Updates"], ["Out"]),
+    ("scatter_nd", "scatter_nd", ["Index", "Updates"], ["Out"]),
+    ("scatter_nd_add", "scatter_nd_add", ["X", "Index", "Updates"],
+     ["Out"]),
+    ("multiplex", "multiplex", ["X", "Ids"], ["Out"]),
+    ("gather_tree", "gather_tree", ["Ids", "Parents"], ["Out"]),
+    # shapes / tensor utilities
+    ("shape", "shape", ["Input"], ["Out"]),
+    ("size", "size", ["Input"], ["Out"]),
+    ("diag", "diag", ["Diagonal"], ["Out"]),
+    ("strided_slice", "strided_slice", ["Input"], ["Out"]),
+    ("crop", "crop", ["X", "Y"], ["Out"]),
+    ("crop_tensor", "crop_tensor", ["X", "Shape", "Offsets"], ["Out"]),
+    ("pad_constant_like", "pad_constant_like", ["X", "Y"], ["Out"]),
+    ("expand_as", "expand_as", ["X", "target_tensor"], ["Out"]),
+    ("space_to_depth", "space_to_depth", ["X"], ["Out"]),
+    ("shard_index", "shard_index", ["X"], ["Out"]),
+    ("shuffle_channel", "shuffle_channel", ["X"], ["Out"]),
+    ("temporal_shift", "temporal_shift", ["X"], ["Out"]),
+    ("hash", "hash", ["X"], ["Out"]),
+    ("im2sequence", "im2sequence", ["X"], ["Out"]),
+    ("sampling_id", "sampling_id", ["X"], ["Out"]),
+    ("add_position_encoding", "add_position_encoding", ["X"], ["Out"]),
+    ("get_tensor_from_selected_rows", "get_tensor_from_selected_rows",
+     ["X"], ["Out"]),
+    ("merge_selected_rows", "merge_selected_rows", ["X"], ["Out"]),
+    ("lod_reset", "lod_reset", ["X", "Y"], ["Out"]),
+    # random creators
+    ("uniform_random", "uniform_random", [], ["Out"]),
+    ("gaussian_random", "gaussian_random", [], ["Out"]),
+    ("fill_constant_batch_size_like", "fill_constant_batch_size_like",
+     ["Input"], ["Out"]),
+    ("gaussian_random_batch_size_like",
+     "gaussian_random_batch_size_like", ["Input"], ["Out"]),
+    ("uniform_random_batch_size_like",
+     "uniform_random_batch_size_like", ["Input"], ["Out"]),
+    # norm / vision / conv
+    ("pad2d", "pad2d", ["X"], ["Out"]),
+    ("lrn", "lrn", ["X"], ["Out", "MidOut"], "Out"),
+    ("data_norm", "data_norm",
+     ["X", "BatchSize", "BatchSum", "BatchSquareSum"],
+     ["Y", "Means", "Scales"], "Y"),
+    ("grid_sampler", "grid_sampler", ["X", "Grid"], ["Output"]),
+    ("roi_align", "roi_align", ["X", "ROIs"], ["Out"]),
+    ("roi_pool", "roi_pool", ["X", "ROIs", "RoisNum"],
+     ["Out", "Argmax"], "Out"),
+    ("affine_channel", "affine_channel", ["X", "Scale", "Bias"], ["Out"]),
+    ("affine_grid", "affine_grid", ["Theta", "OutputShape"], ["Output"]),
+    ("row_conv", "row_conv", ["X", "Filter"], ["Out"]),
+    ("conv3d", "conv3d", ["Input", "Filter"], ["Output"]),
+    ("conv3d_transpose", "conv3d_transpose", ["Input", "Filter"],
+     ["Output"]),
+    ("pool3d", "pool3d", ["X"], ["Out"]),
+    ("maxout", "maxout", ["X"], ["Out"]),
+    # losses
+    ("rank_loss", "rank_loss", ["Label", "Left", "Right"], ["Out"]),
+    ("margin_rank_loss", "margin_rank_loss", ["Label", "X1", "X2"],
+     ["Out", "Activated"], "Out"),
+    ("huber_loss", "huber_loss", ["X", "Y"], ["Out", "Residual"], "Out"),
+    ("kldiv_loss", "kldiv_loss", ["X", "Target"], ["Loss"]),
+    ("log_loss", "log_loss", ["Predicted", "Labels"], ["Loss"]),
+    ("bpr_loss", "bpr_loss", ["X", "Label"], ["Y"]),
+    ("sigmoid_focal_loss", "sigmoid_focal_loss", ["X", "Label", "FgNum"],
+     ["Out"]),
+    ("teacher_student_sigmoid_loss", "teacher_student_sigmoid_loss",
+     ["X", "Label"], ["Y"]),
+    ("center_loss", "center_loss",
+     ["X", "Label", "Centers", "CenterUpdateRate"],
+     ["Loss", "SampleCenterDiff", "CentersOut"], "Loss"),
+    # RNN / misc op zoo
+    ("lstm", "lstm",
+     ["Input", "WeightX", "WeightH", "Bias", "H0", "C0", "SequenceLength"],
+     ["Out", "LastH", "LastC"], "Out"),
+    ("gru_unit", "gru_unit", ["Input", "HiddenPrev", "Weight", "Bias"],
+     ["Hidden", "ResetHiddenPrev", "Gate"], "Hidden"),
+    ("lstm_unit", "lstm_unit", ["X", "C_prev"], ["H", "C"], "H"),
+    ("nce", "nce", ["Input", "Label", "Weight", "Bias"],
+     ["Cost", "SampleLogits", "SampleLabels"], "Cost"),
+    ("warpctc", "warpctc",
+     ["Logits", "Label", "LogitsLength", "LabelLength"],
+     ["Loss", "WarpCTCGrad"], "Loss"),
+    ("bilinear_tensor_product", "bilinear_tensor_product",
+     ["X", "Y", "Weight", "Bias"], ["Out"]),
+    ("filter_by_instag", "filter_by_instag",
+     ["Ins", "Ins_tag", "Filter_tag"],
+     ["Out", "LossWeight", "IndexMap", "Count"], "Out"),
+    ("chunk_eval", "chunk_eval", ["Inference", "Label", "SeqLength"],
+     ["Precision", "Recall", "F1-Score", "NumInferChunks",
+      "NumLabelChunks", "NumCorrectChunks"], "Precision"),
+    ("beam_search", "beam_search", ["pre_ids", "pre_scores", "scores"],
+     ["selected_ids", "selected_scores", "parent_idx"], "selected_ids"),
+    ("beam_search_decode", "beam_search_decode",
+     ["Ids", "Scores", "ParentIdx"],
+     ["SentenceIds", "SentenceScores"], "SentenceIds"),
+    ("tensor_array_to_tensor", "tensor_array_to_tensor", ["X"],
+     ["Out", "OutIndex"], "Out"),
+    ("array_read", "array_read", ["X", "I"], ["Out"]),
+    # sequence family (padded-dense + Lod/Length companions, the
+    # repo-wide LoD re-design — sequence_ops.py)
+    ("sequence_concat", "sequence_concat", ["X", "Lod"],
+     ["Out", "OutLod"], "Out"),
+    ("sequence_conv", "sequence_conv", ["X", "Filter"], ["Out"]),
+    ("sequence_enumerate", "sequence_enumerate", ["X"], ["Out"]),
+    ("sequence_expand", "sequence_expand", ["X", "RefLod"], ["Out"]),
+    ("sequence_expand_as", "sequence_expand_as", ["X", "Y", "YLength"],
+     ["Out", "OutLength"], "Out"),
+    ("sequence_pad", "sequence_pad", ["X", "Lod", "PadValue"],
+     ["Out", "Length"], "Out"),
+    ("sequence_reshape", "sequence_reshape", ["X"], ["Out"]),
+    ("sequence_reverse", "sequence_reverse", ["X", "Lod"], ["Y"]),
+    ("sequence_scatter", "sequence_scatter", ["X", "Ids", "Updates"],
+     ["Out"]),
+    ("sequence_slice", "sequence_slice", ["X", "Offset", "Length"],
+     ["Out", "OutLength"], "Out"),
+    ("sequence_softmax", "sequence_softmax", ["X", "Lod"], ["Out"]),
+    ("sequence_unpad", "sequence_unpad", ["X", "Length"], ["Out"]),
+    ("unfold", "unfold", ["X"], ["Y"]),
+    ("unbind", "unbind", ["X"], ["Out"]),
+    ("load", "load", [], ["Out"]),
+    ("lod_append", "lod_reset", ["X", "Y"], ["Out"]),
+    ("inplace_abn", "inplace_abn",
+     ["X", "Scale", "Bias", "Mean", "Variance"],
+     ["Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"], "Y"),
+]
+
+for _row in _TABLE:
+    _name, _op = _row[0], _row[1]
+    _register(_name, generate_layer_fn(_op, _row[2], _row[3],
+                                       _row[4] if len(_row) > 4 else None))
+
+
+def _aw(x, i, array, name=None):
+    helper = LayerHelper("array_write", name=name)
+    out = helper.create_variable_for_type_inference(array.dtype)
+    helper.append_op("array_write", {"X": [array], "I": [i], "V": [x]},
+                     {"Out": [out]}, {})
+    return out
+
+
+_register("array_write", _aw)
+
+
+# --- cross-namespace aliases (same callable, fluid.layers name) ----------
+
+def _install_aliases():
+    from .. import tensor as _tensor
+    from ..nn import functional as _F
+
+    for name in ("argsort", "cumsum", "eye", "linspace", "pow", "argmin",
+                 "triu", "unique", "unbind", "unstack", "gather_tree"):
+        if name not in globals() and hasattr(_tensor, name):
+            _register(name, getattr(_tensor, name))
+    for name in ("elu", "relu6", "selu", "softshrink", "thresholded_relu",
+                 "pixel_shuffle", "mse_loss", "group_norm", "pad"):
+        if name not in globals() and hasattr(_F, name):
+            _register(name, getattr(_F, name))
+
+
+_install_aliases()
+
+
+# --- hand compositions (the reference writes these over layers too) ------
+
+def _compose():
+    from .. import layers as L
+
+    def sums(input, out=None, name=None):
+        helper = LayerHelper("sum", name=name)
+        res = out or helper.create_variable_for_type_inference(
+            input[0].dtype)
+        helper.append_op("sum", {"X": list(input)}, {"Out": [res]}, {})
+        return res
+
+    _register("sums", sums)
+    _register("sum", sums)
+
+    def has_nan(x, name=None):
+        return globals()["reduce_any"](L.not_equal(x, x))
+
+    def has_inf(x, name=None):
+        # inf = non-finite that is not nan
+        bad = L.logical_not(globals()["isfinite"](x))
+        notnan = L.equal(x, x)
+        return globals()["reduce_any"](L.logical_and(bad, notnan))
+
+    _register("has_nan", has_nan)
+    _register("has_inf", has_inf)
+
+    def rank(input, name=None):
+        return L.fill_constant([1], "int32", len(input.shape or ()))
+
+    _register("rank", rank)
+
+    def range_(start, end, step, dtype="int64", name=None):
+        def as_var(v):
+            return v if hasattr(v, "block") else \
+                L.fill_constant([1], dtype, float(v))
+
+        helper = LayerHelper("range", name=name)
+        out = helper.create_variable_for_type_inference(dtype)
+        helper.append_op("range", {"Start": [as_var(start)],
+                                   "End": [as_var(end)],
+                                   "Step": [as_var(step)]},
+                         {"Out": [out]}, {"dtype": dtype})
+        return out
+
+    _register("range", range_)
+
+    def sequence_first_step(input, length=None, name=None):
+        return L.sequence_pool(input, "first", length=length)
+
+    def sequence_last_step(input, length=None, name=None):
+        return L.sequence_pool(input, "last", length=length)
+
+    _register("sequence_first_step", sequence_first_step)
+    _register("sequence_last_step", sequence_last_step)
+
+    def dice_loss(input, label, epsilon=1e-5, name=None):
+        """reference: fluid/layers/nn.py dice_loss — composed over
+        one-hot/reduce ops exactly like the reference's python body."""
+        label = L.squeeze(label, [-1])
+        label = L.one_hot(label, depth=input.shape[-1])
+        reduce_dims = list(range(1, len(input.shape)))
+        inse = L.reduce_sum(input * label, dim=reduce_dims)
+        dice_denominator = L.reduce_sum(input, dim=reduce_dims) + \
+            L.reduce_sum(label, dim=reduce_dims)
+        dice_score = 1 - inse * 2 / (dice_denominator + epsilon)
+        return L.reduce_mean(dice_score)
+
+    _register("dice_loss", dice_loss)
+
+    def smooth_l1(x, y, inside_weight=None, outside_weight=None,
+                  sigma=1.0, name=None):
+        """reference: operators/smooth_l1_loss_op.cc semantics composed
+        from elementwise ops (per-row summed smooth-L1)."""
+        sigma2 = float(sigma) * float(sigma)
+        d = x - y
+        if inside_weight is not None:
+            d = d * inside_weight
+        ad = L.abs(d)
+        flag = L.cast(L.less_than(ad, L.fill_constant(
+            [1], x.dtype, 1.0 / sigma2)), x.dtype)
+        val = flag * 0.5 * sigma2 * d * d + \
+            (1.0 - flag) * (ad - 0.5 / sigma2)
+        if outside_weight is not None:
+            val = val * outside_weight
+        return L.reduce_sum(val, dim=[1], keep_dim=True)
+
+    _register("smooth_l1", smooth_l1)
+
+    def mean_iou(input, label, num_classes, name=None):
+        """reference: operators/mean_iou_op.cc — per-class IoU from
+        one-hot intersection/union counts; returns (mean_iou,
+        out_wrong, out_correct)."""
+        pred = L.reshape(input, [-1])
+        lab = L.reshape(label, [-1])
+        po = L.one_hot(pred, depth=num_classes)
+        lo = L.one_hot(lab, depth=num_classes)
+        inter = L.reduce_sum(po * lo, dim=[0])
+        union = L.reduce_sum(po, dim=[0]) + L.reduce_sum(lo, dim=[0]) \
+            - inter
+        valid = L.cast(L.greater_than(
+            union, L.fill_constant([1], union.dtype, 0.0)), union.dtype)
+        iou = inter / (union + 1e-9)
+        miou = L.reduce_sum(iou) / (L.reduce_sum(valid) + 1e-9)
+        wrong = L.cast(L.reduce_sum(po, dim=[0]) - inter, "int32")
+        correct = L.cast(inter, "int32")
+        return miou, wrong, correct
+
+    _register("mean_iou", mean_iou)
+
+    def case(pred_fn_pairs, default=None, name=None):
+        """reference: fluid/layers/control_flow.py case() — nested
+        cond over the ordered (pred, fn) pairs."""
+        from .control_flow import cond as _cond
+
+        def build(pairs):
+            (pred, fn) = pairs[0]
+            if len(pairs) == 1:
+                if default is None:
+                    return _cond(pred, fn, fn)
+                return _cond(pred, fn, default)
+            return _cond(pred, fn, lambda: build(pairs[1:]))
+
+        return build(list(pred_fn_pairs))
+
+    _register("case", case)
+
+    def switch_case(branch_index, branch_fns, default=None, name=None):
+        """reference: control_flow.py switch_case() — dispatch on an
+        int32 scalar via chained equals."""
+        items = sorted(branch_fns.items()) if isinstance(branch_fns, dict) \
+            else list(branch_fns)
+        pairs = [(L.equal(branch_index,
+                          L.fill_constant([1], "int64", float(i))), fn)
+                 for i, fn in items]
+        return case(pairs, default=default)
+
+    _register("switch_case", switch_case)
+
+    def create_array(dtype, initialized_list=None):
+        """Modernised LoDTensorArray creator: a stacked buffer var
+        (control-flow ops array_read/array_write operate on it)."""
+        return L.fill_constant([0], dtype, 0.0)
+
+    _register("create_array", create_array)
+
+    def array_length(array, name=None):
+        return L.slice(globals()["shape"](array), [0], [0], [1])
+
+    _register("array_length", array_length)
+
+    def resize_nearest(input, out_shape=None, scale=None, name=None,
+                       **kw):
+        attrs = {"interp_method": "nearest"}
+        if out_shape is not None:
+            attrs["out_h"], attrs["out_w"] = int(out_shape[0]), \
+                int(out_shape[1])
+        if scale is not None:
+            attrs["scale"] = float(scale)
+        helper = LayerHelper("nearest_interp", name=name)
+        out = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op("nearest_interp", {"X": [input]}, {"Out": [out]},
+                         attrs)
+        return out
+
+    def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                        **kw):
+        attrs = {"interp_method": "bilinear"}
+        if out_shape is not None:
+            attrs["out_h"], attrs["out_w"] = int(out_shape[0]), \
+                int(out_shape[1])
+        if scale is not None:
+            attrs["scale"] = float(scale)
+        helper = LayerHelper("bilinear_interp", name=name)
+        out = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op("bilinear_interp", {"X": [input]}, {"Out": [out]},
+                         attrs)
+        return out
+
+    def image_resize(input, out_shape=None, scale=None, name=None,
+                     resample="BILINEAR", **kw):
+        if resample.upper().startswith("NEAREST"):
+            return resize_nearest(input, out_shape, scale, name)
+        return resize_bilinear(input, out_shape, scale, name)
+
+    _register("resize_bilinear", resize_bilinear)
+    _register("resize_nearest", resize_nearest)
+    _register("image_resize", image_resize)
+
+    def prelu(x, mode="all", param_attr=None, name=None):
+        """reference: fluid/layers/nn.py prelu — learnable alpha with
+        'all'/'channel'/'element' granularity."""
+        helper = LayerHelper("prelu", name=name)
+        if mode == "all":
+            shape = [1]
+        elif mode == "channel":
+            shape = [int(x.shape[1])]
+        else:
+            shape = [int(d) for d in x.shape[1:]]
+        alpha = helper.create_parameter(param_attr, shape, x.dtype)
+        out = helper.create_variable_for_type_inference(x.dtype)
+        helper.append_op("prelu", {"X": [x], "Alpha": [alpha]},
+                         {"Out": [out]}, {"mode": mode})
+        return out
+
+    if "prelu" not in globals():
+        _register("prelu", prelu)
+
+    def soft_relu(x, threshold=40.0, name=None):
+        """reference: ops.py soft_relu — log(1 + exp(clip(x, -t, t)))."""
+        return L.log(1.0 + L.exp(L.clip(x, -float(threshold),
+                                        float(threshold))))
+
+    _register("soft_relu", soft_relu)
+
+    def create_tensor(dtype, name=None, persistable=False):
+        from ..core.ir import default_main_program
+
+        return default_main_program().global_block().create_var(
+            name=name, dtype=dtype, persistable=persistable)
+
+    _register("create_tensor", create_tensor)
+
+    def autoincreased_step_counter(counter_name=None, begin=1, step=1,
+                                   name=None):
+        """reference: layers/tensor.py — a persistable int64 counter
+        incremented every step."""
+        var = L.create_global_var([1], float(begin - step), "int64",
+                                  persistable=True,
+                                  name=counter_name or "@@step_counter@@")
+        helper = LayerHelper("increment")
+        helper.append_op("increment", {"X": [var]}, {"Out": [var]},
+                         {"step": float(step)})
+        return var
+
+    _register("autoincreased_step_counter", autoincreased_step_counter)
+
+    def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+        """reference: layers/loss.py npair_loss — composed identically
+        (similarity matrix CE + L2 regulariser)."""
+        lab = L.reshape(labels, [-1, 1])
+        same = L.cast(L.equal(lab, L.transpose(lab, [1, 0])), "float32")
+        w = same / L.reduce_sum(same, dim=[1], keep_dim=True)
+        sim = L.matmul(anchor, positive, transpose_y=True)
+        logp = sim - L.log(L.reduce_sum(L.exp(sim), dim=[1],
+                                        keep_dim=True))
+        ce = L.reduce_mean(-L.reduce_sum(w * logp, dim=[1]))
+        reg = L.reduce_mean(L.reduce_sum(anchor * anchor, dim=[1])
+                            + L.reduce_sum(positive * positive, dim=[1]))             * (l2_reg * 0.25)
+        return ce + reg
+
+    _register("npair_loss", npair_loss)
+
+    def fsp_matrix(x, y):
+        """reference: layers/nn.py fsp_matrix — flow-of-solution-
+        procedure Gram matrix between two feature maps."""
+        b = x.shape[0]
+        cx, cy = x.shape[1], y.shape[1]
+        xf = L.reshape(x, [b, cx, -1])
+        yf = L.reshape(y, [b, cy, -1])
+        hw = int(np.prod(x.shape[2:]))
+        return L.matmul(xf, L.transpose(yf, [0, 2, 1])) * (1.0 / hw)
+
+    _register("fsp_matrix", fsp_matrix)
+
+    def image_resize_short(input, out_short_len, resample="BILINEAR"):
+        h, w = int(input.shape[2]), int(input.shape[3])
+        short = min(h, w)
+        oh = int(round(h * out_short_len / short))
+        ow = int(round(w * out_short_len / short))
+        return image_resize(input, out_shape=[oh, ow], resample=resample)
+
+    _register("image_resize_short", image_resize_short)
+
+    def _multi_out(op_type, in_map, out_slots, n_return):
+        def fn(x, name=None, **attrs):
+            helper = LayerHelper(op_type, name=name)
+            outs = {s: [helper.create_variable_for_type_inference(
+                x.dtype if i == 0 else "int64")]
+                for i, s in enumerate(out_slots)}
+            helper.append_op(op_type, {in_map: [x]}, outs, attrs)
+            vals = [outs[s][0] for s in out_slots]
+            return tuple(vals[:n_return]) if n_return > 1 else vals[0]
+
+        return fn
+
+    _register("unstack", _multi_out("unstack", "X", ["Y"], 1))
+    _register("unique", _multi_out("unique", "X",
+                                   ["Out", "Index", "Count"], 2))
+    _register("unique_with_counts", _multi_out(
+        "unique_with_counts", "X", ["Out", "Index", "Count"], 3))
+
+    def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                     bias_attr=None, use_peepholes=False,
+                     is_reverse=False, gate_activation="sigmoid",
+                     cell_activation="tanh", candidate_activation="tanh",
+                     dtype="float32", name=None, sequence_length=None):
+        """reference: layers/nn.py dynamic_lstm — input is the
+        PRE-PROJECTED [B,S,4H] gates; this creates WeightH/Bias and
+        runs the lstm op with the projection folded (WeightX absent)."""
+        h = size // 4
+        helper = LayerHelper("dynamic_lstm", name=name)
+        wh = helper.create_parameter(param_attr, [h, 4 * h], dtype)
+        b = helper.create_parameter(bias_attr, [4 * h], dtype,
+                                    is_bias=True)
+        outs = {s: [helper.create_variable_for_type_inference(dtype)]
+                for s in ("Out", "LastH", "LastC")}
+        ins = {"Input": [input], "WeightH": [wh], "Bias": [b]}
+        if h_0 is not None:
+            ins["H0"] = [h_0]
+        if c_0 is not None:
+            ins["C0"] = [c_0]
+        if sequence_length is not None:
+            ins["SequenceLength"] = [sequence_length]
+        helper.append_op("lstm", ins, outs, {"is_reverse": is_reverse})
+        return outs["Out"][0], outs["LastC"][0]
+
+    _register("dynamic_lstm", dynamic_lstm)
+
+    def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                    is_reverse=False, h_0=None, dtype="float32",
+                    name=None, sequence_length=None, **kw):
+        """reference: layers/nn.py dynamic_gru — input pre-projected
+        [B,S,3H]; creates WeightH/Bias, runs the gru op."""
+        helper = LayerHelper("dynamic_gru", name=name)
+        wh = helper.create_parameter(param_attr, [size, 3 * size], dtype)
+        b = helper.create_parameter(bias_attr, [3 * size], dtype,
+                                    is_bias=True)
+        outs = {s: [helper.create_variable_for_type_inference(dtype)]
+                for s in ("Out", "LastH")}
+        ins = {"Input": [input], "WeightH": [wh], "Bias": [b]}
+        if h_0 is not None:
+            ins["H0"] = [h_0]
+        if sequence_length is not None:
+            ins["SequenceLength"] = [sequence_length]
+        helper.append_op("gru", ins, outs, {"is_reverse": is_reverse})
+        return outs["Out"][0]
+
+    _register("dynamic_gru", dynamic_gru)
+
+    def dynamic_lstmp(input, size, proj_size, param_attr=None,
+                      bias_attr=None, dtype="float32", name=None, **kw):
+        """reference: layers/nn.py dynamic_lstmp over the lstmp op."""
+        h = size // 4
+        helper = LayerHelper("dynamic_lstmp", name=name)
+        w = helper.create_parameter(param_attr, [proj_size, 4 * h], dtype)
+        pw = helper.create_parameter(None, [h, proj_size], dtype)
+        b = helper.create_parameter(bias_attr, [4 * h], dtype,
+                                    is_bias=True)
+        outs = {s: [helper.create_variable_for_type_inference(dtype)]
+                for s in ("Projection", "Cell")}
+        helper.append_op("lstmp", {"Input": [input], "Weight": [w],
+                                   "ProjWeight": [pw], "Bias": [b]},
+                         outs, {})
+        return outs["Projection"][0], outs["Cell"][0]
+
+    _register("dynamic_lstmp", dynamic_lstmp)
+
+
+_compose()

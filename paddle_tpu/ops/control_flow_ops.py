@@ -366,3 +366,61 @@ def merge_lod_tensor(ins, attrs):
     mask = ins["Mask"][0].reshape(-1).astype(bool)
     m = mask.reshape((-1,) + (1,) * (t.ndim - 1))
     return {"Out": jnp.where(m, t, f.astype(t.dtype))}
+
+
+@register_op("run_program", skip_infer_shape=True)
+def run_program(ins, attrs):
+    """Execute a captured sub-Program as ONE op (reference:
+    operators/run_program_op.cc — the dygraph<->static bridge backing
+    partial_program.py PartialProgramLayer).
+
+    Inputs: X = the sub-program's feed tensors (attr feed_names order),
+    Params = its parameters (attr param_names order). Outputs: Out =
+    attr fetch_names. The attrs carry the Program object itself (the
+    same block-carrying convention as the cond/while ops), so the op is
+    a real program-as-an-op re-entry point: the generic vjp grad op
+    re-traces the block, which IS the sub-program's backward — grads
+    flow to Params and X exactly like the reference's grad block.
+
+    The block execution is jitted once per Program (cached on the
+    Program object) so eager dygraph pays one dispatch per call, not
+    one per contained op — the to_static speedup the reference gets
+    from executor caching."""
+    import jax
+
+    from .. import core as _core  # noqa: F401  (executor import cycle)
+    from ..core.executor import run_block
+
+    prog = attrs["program"]
+    feed_names = list(attrs.get("feed_names", ()))
+    param_names = list(attrs.get("param_names", ()))
+    fetch_names = list(attrs.get("fetch_names", ()))
+    env = {}
+    for n, v in zip(param_names, ins.get("Params", []) or []):
+        env[n] = v
+    for n, v in zip(feed_names, ins.get("X", []) or []):
+        env[n] = v
+    step = attrs.get("__step__")
+
+    import jax.core as jcore
+
+    tracing = any(isinstance(v, jcore.Tracer) for v in env.values())
+    if tracing:
+        # already under an outer jit/vjp trace: run inline
+        run_block(prog.global_block(), env, step=step)
+        return {"Out": [env[n] for n in fetch_names]}
+    fn = getattr(prog, "_run_program_jit", None)
+    if fn is None:
+        block = prog.global_block()
+
+        def call(e, step_arr):
+            ee = dict(e)
+            run_block(block, ee, step=step_arr)
+            return [ee[n] for n in fetch_names]
+
+        fn = jax.jit(call)
+        prog._run_program_jit = fn
+    import jax.numpy as jnp
+
+    outs = fn(env, jnp.asarray(0 if step is None else step, jnp.int32))
+    return {"Out": list(outs)}

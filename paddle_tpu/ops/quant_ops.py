@@ -95,3 +95,48 @@ for _t in ("fake_quantize_dequantize_abs_max",
            "fake_channel_wise_quantize_dequantize_abs_max",
            "fake_quantize_dequantize_moving_average_abs_max"):
     register_grad_maker(_t)(_ste_grad)
+
+
+# ---------------------------------------------------------------------------
+# int8 deployment engine (round 5): the reference's quant story ends in a
+# deployable int8 predictor (post_training_quantization.py -> freeze ->
+# engine); these ops are that engine's TPU form. v5e executes int8 dots
+# natively (2x the bf16 TOPS), so the int8 path is real compute, not
+# simulation.
+# ---------------------------------------------------------------------------
+
+@register_op("dequantize_weight", non_diff_inputs=("X", "Scale"))
+def dequantize_weight(ins, attrs):
+    """fp = int8_weight * per-channel scale (weight-only int8 storage:
+    the weight lives in HBM as int8 — half the bytes — and XLA fuses the
+    dequant into the consuming matmul/conv read). Attr `axis` is the
+    channel axis of Scale."""
+    import jax.numpy as jnp
+
+    x = ins["X"][0]
+    scale = ins["Scale"][0]
+    axis = int(attrs.get("axis", -1))
+    shape = [1] * x.ndim
+    if scale.ndim:
+        shape[axis] = scale.reshape(-1).shape[0]
+    return {"Out": x.astype(jnp.float32) * scale.reshape(shape)}
+
+
+@register_op("int8_matmul", non_diff_inputs=("Y", "YScale"))
+def int8_matmul(ins, attrs):
+    """Native int8 GEMM: activation statically quantized by the
+    calibrated abs-max (attr act_scale, PTQ), weight already int8
+    per-output-channel; int32 accumulation on the MXU, dequantized
+    epilogue. Out = (clip(round(x/sx))_i8 @ w_i8) * sx * sy[col]."""
+    import jax
+    import jax.numpy as jnp
+
+    x, w = ins["X"][0], ins["Y"][0]
+    sy = ins["YScale"][0].reshape(-1)          # per output column
+    sx = float(attrs["act_scale"]) / 127.0
+    xq = jnp.clip(jnp.round(x.astype(jnp.float32) / sx), -127,
+                  127).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        xq, w, (((xq.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    return {"Out": acc.astype(jnp.float32) * sx * sy}

@@ -26,7 +26,12 @@ def lstm(ins, attrs):
     from jax import lax
 
     x = ins["Input"][0]
-    wx, wh = ins["WeightX"][0], ins["WeightH"][0]
+    # WeightX optional: absent means the input is already the projected
+    # [B,S,4H] gates (the reference dynamic_lstm contract, which feeds
+    # an fc output and multiplies only the recurrent weight)
+    wx = ins["WeightX"][0] if ins.get("WeightX") and \
+        ins["WeightX"][0] is not None else None
+    wh = ins["WeightH"][0]
     bias = ins["Bias"][0] if ins.get("Bias") and ins["Bias"][0] is not None \
         else None
     b, s, d = x.shape
@@ -43,7 +48,8 @@ def lstm(ins, attrs):
     xs = jnp.swapaxes(x, 0, 1)                      # [S, B, D]
     if reverse:
         xs = xs[::-1]
-    x_proj = jnp.einsum("sbd,dh->sbh", xs, wx)      # [S, B, 4H]
+    x_proj = xs if wx is None else \
+        jnp.einsum("sbd,dh->sbh", xs, wx)           # [S, B, 4H]
     if bias is not None:
         x_proj = x_proj + bias
 
@@ -80,7 +86,9 @@ def gru(ins, attrs):
     from jax import lax
 
     x = ins["Input"][0]
-    wx, wh = ins["WeightX"][0], ins["WeightH"][0]
+    wx = ins["WeightX"][0] if ins.get("WeightX") and \
+        ins["WeightX"][0] is not None else None      # None: pre-projected
+    wh = ins["WeightH"][0]
     bias = ins["Bias"][0] if ins.get("Bias") and ins["Bias"][0] is not None \
         else None
     b, s, d = x.shape
@@ -95,7 +103,7 @@ def gru(ins, attrs):
     xs = jnp.swapaxes(x, 0, 1)
     if reverse:
         xs = xs[::-1]
-    x_proj = jnp.einsum("sbd,dh->sbh", xs, wx)
+    x_proj = xs if wx is None else jnp.einsum("sbd,dh->sbh", xs, wx)
     if bias is not None:
         x_proj = x_proj + bias
 

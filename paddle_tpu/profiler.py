@@ -232,7 +232,15 @@ def _exclusive_times(events):
                 stack.pop()
             if stack:
                 p = stack[-1]
-                excl[id(p)] = excl.get(id(p), p["dur"]) - e["dur"]
+                # only subtract PROPERLY CONTAINED children: a partially
+                # overlapping (non-nested) event would otherwise be
+                # deducted from the wrong parent, silently skewing the
+                # attribution — malformed traces degrade to inclusive
+                # times instead (ADVICE r4)
+                if e["ts"] + e["dur"] <= p["ts"] + p["dur"]:
+                    excl[id(p)] = excl.get(id(p), p["dur"]) - e["dur"]
+                else:
+                    continue
             stack.append(e)
     return excl
 

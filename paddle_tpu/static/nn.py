@@ -5,3 +5,10 @@ from ..layers import (batch_norm, conv2d, conv2d_transpose,  # noqa: F401
                       embedding, fc, layer_norm, pool2d)
 from ..layers.control_flow import (cond, static_loop,  # noqa: F401
                                    while_loop)
+
+# static.nn op-layer surface (reference: python/paddle/static/nn/__init__.py
+# re-exports the fluid layer functions)
+from ..layers import (bilinear_tensor_product, conv3d,  # noqa: F401,E402
+                      conv3d_transpose, crf_decoding, data_norm,
+                      group_norm, instance_norm, nce, prelu, row_conv,
+                      spectral_norm, create_parameter, case, switch_case)

@@ -1,0 +1,138 @@
+"""Round-5 generated fluid.layers surface (layers/generated.py — the
+layer_function_generator mirror) + namespace aliases."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _run(build, feeds, fetch_n=1):
+    from paddle_tpu.core import ir, unique_name
+
+    ir._main_program, ir._startup_program = ir.Program(), ir.Program()
+    unique_name.switch()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        outs = build()
+    outs = outs if isinstance(outs, (list, tuple)) else [outs]
+    exe = pt.Executor()
+    sc = pt.Scope()
+    exe.run(startup, scope=sc, use_compiled=False)
+    got = exe.run(main, feed=feeds, fetch_list=list(outs), scope=sc)
+    return [np.asarray(g) for g in got]
+
+
+class TestGeneratedTable:
+    def test_unary_binary_family(self):
+        x = np.array([[-2.0, -0.5, 0.5, 2.0]], np.float32)
+        y = np.array([[1.0, -0.5, 0.25, 3.0]], np.float32)
+
+        def build():
+            xv = layers.static_data("x", [1, 4])
+            yv = layers.static_data("y", [1, 4])
+            return [layers.brelu(xv, t_min=-1.0, t_max=1.0),
+                    layers.hard_shrink(xv, threshold=0.6),
+                    layers.logical_or(layers.less_equal(xv, yv),
+                                      layers.greater_equal(xv, yv)),
+                    layers.elementwise_floordiv(
+                        layers.cast(xv, "int64") + 4,
+                        layers.cast(yv, "int64") * 0 + 2)]
+
+        b, h, lo, fd = _run(build, {"x": x, "y": y})
+        np.testing.assert_allclose(b, np.clip(x, -1, 1))
+        np.testing.assert_allclose(h, np.where(np.abs(x) > 0.6, x, 0))
+        assert lo.dtype == np.bool_ and lo.all()
+        np.testing.assert_array_equal(fd, (x.astype(np.int64) + 4) // 2)
+
+    def test_gather_scatter_shape(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+
+        def build():
+            xv = layers.static_data("x", [3, 4])
+            idx = layers.cast(layers.fill_constant([2, 1], "int64", 1.0),
+                              "int64")
+            return [layers.gather_nd(xv, idx), layers.shape(xv),
+                    layers.size(xv)]
+
+        g, sh, sz = _run(build, {"x": x})
+        np.testing.assert_allclose(g, np.stack([x[1], x[1]]))
+        np.testing.assert_array_equal(sh, [3, 4])
+        assert int(sz) == 12
+
+    def test_compositions(self):
+        x = np.array([[1.0, np.inf], [np.nan, 2.0]], np.float32)
+
+        def build():
+            xv = layers.static_data("x", [2, 2])
+            fin = layers.static_data("f", [2, 2])
+            return [layers.has_nan(xv), layers.has_inf(xv),
+                    layers.has_nan(fin), layers.has_inf(fin),
+                    layers.smooth_l1(fin, fin * 0.5)]
+
+        hn, hi, fn_, fi, sl1 = _run(
+            build, {"x": x, "f": np.ones((2, 2), np.float32)})
+        assert bool(hn) and bool(hi)
+        assert not bool(fn_) and not bool(fi)
+        # smooth_l1 of d=0.5: 0.5*0.25 = 0.125 per element, 2 per row
+        np.testing.assert_allclose(sl1, [[0.25], [0.25]], atol=1e-6)
+
+    def test_losses_and_rnn_wrappers(self):
+        B, S, H4 = 2, 3, 8
+
+        def build():
+            pre = layers.static_data("pre", [B, S, H4])
+            out, last_c = layers.dynamic_lstm(pre, H4)
+            gout = layers.dynamic_gru(
+                layers.static_data("pre3", [B, S, 6]), 2)
+            hub = layers.huber_loss(
+                layers.static_data("a", [2, 2]),
+                layers.static_data("b", [2, 2]), delta=1.0)
+            return [out, gout, hub]
+
+        rng = np.random.RandomState(0)
+        o, g, h = _run(build, {
+            "pre": rng.randn(B, S, H4).astype(np.float32),
+            "pre3": rng.randn(B, S, 6).astype(np.float32),
+            "a": rng.randn(2, 2).astype(np.float32),
+            "b": rng.randn(2, 2).astype(np.float32)})
+        assert o.shape == (B, S, 2) and g.shape == (B, S, 2)
+        assert np.isfinite(h).all()
+
+    def test_multi_output_unique(self):
+        x = np.array([3, 1, 3, 2, 1], np.int64)
+
+        def build():
+            xv = layers.static_data("x", [5], "int64")
+            out, idx = layers.unique(xv)
+            return [out, idx]
+
+        out, idx = _run(build, {"x": x})
+        assert set(out[:3].tolist()) >= {1, 2, 3} or len(out) >= 3
+
+    def test_case_switch_case(self):
+        def build():
+            one = layers.fill_constant([1], "float32", 1.0)
+            p1 = layers.less_than(one, one)           # False
+            p2 = layers.less_than(one, one * 2)       # True
+            r = layers.case([(p1, lambda: one * 10),
+                             (p2, lambda: one * 20)],
+                            default=lambda: one * 30)
+            idx = layers.cast(layers.fill_constant([1], "int64", 1.0),
+                              "int64")
+            s = layers.switch_case(idx, {0: lambda: one * 5,
+                                         1: lambda: one * 7},
+                                   default=lambda: one * 9)
+            return [r, s]
+
+        r, s = _run(build, {})
+        assert float(r) == 20.0 and float(s) == 7.0
+
+    def test_namespace_aliases(self):
+        import paddle_tpu.dygraph as D
+        import paddle_tpu.static.nn as SN
+
+        assert D.BatchNorm is not None and D.Linear is not None
+        assert callable(SN.conv3d) and callable(SN.case)
+        assert callable(layers.GRUCell) and callable(layers.LSTMCell)

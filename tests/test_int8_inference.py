@@ -1,0 +1,149 @@
+"""Quantized inference END-TO-END (VERDICT r5 #7): train a small
+classifier, PTQ-calibrate, convert to the int8 engine
+(contrib/slim.convert_to_int8_program) and RUN it through
+AnalysisPredictor — top-1 parity against the fp predictor."""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.contrib import slim
+from paddle_tpu.inference.predictor import AnalysisConfig, AnalysisPredictor
+
+
+def _build_and_train(scope, steps=60):
+    from paddle_tpu.core import ir, unique_name
+
+    ir._main_program, ir._startup_program = ir.Program(), ir.Program()
+    unique_name.switch()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.static_data("x", [-1, 16], "float32")
+        y = layers.static_data("y", [-1, 1], "int64")
+        h = layers.fc(x, 32, act="relu")
+        logits = layers.fc(h, 4)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+        pt.optimizer.AdamOptimizer(0.01).minimize(loss)
+    # pure-inference program (no loss ops): rebuild x->logits with the
+    # SAME parameter names (fresh unique_name context, same call order)
+    ir._main_program, ir._startup_program = ir.Program(), ir.Program()
+    unique_name.switch()
+    infer, _istart = pt.Program(), pt.Program()
+    with pt.program_guard(infer, _istart):
+        xi = layers.static_data("x", [-1, 16], "float32")
+        hi = layers.fc(xi, 32, act="relu")
+        ilogits = layers.fc(hi, 4)
+    exe = pt.Executor()
+    exe.run(startup, scope=scope, use_compiled=False)
+    rng = np.random.RandomState(0)
+    centers = rng.randn(4, 16).astype(np.float32) * 2.0
+    def batch(n, seed):
+        r = np.random.RandomState(seed)
+        lab = r.randint(0, 4, (n, 1))
+        return {"x": (centers[lab[:, 0]]
+                      + r.randn(n, 16).astype(np.float32) * 0.5),
+                "y": lab.astype(np.int64)}
+    for i in range(steps):
+        exe.run(main, feed=batch(64, i), fetch_list=[loss], scope=scope)
+    return infer, ilogits, batch
+
+
+def test_int8_predictor_top1_parity(scope):
+    infer, logits, batch = _build_and_train(scope)
+    feeds = ["x"]
+    fetches = [logits.name]
+
+    # fp32 reference predictor
+    fp_pred = AnalysisPredictor(AnalysisConfig(), program=infer,
+                                feed_names=feeds, fetch_names=fetches,
+                                scope=scope)
+    test = batch(256, 999)
+    fp_logits, = fp_pred.run({"x": test["x"]})
+    fp_top1 = np.argmax(fp_logits, axis=1)
+    fp_acc = float(np.mean(fp_top1 == test["y"][:, 0]))
+    assert fp_acc > 0.9, f"fp model underfit: {fp_acc}"
+
+    # PTQ calibration for activation scales
+    exe = pt.Executor()
+    ptq = slim.PostTrainingQuantization(
+        exe, infer.clone(for_test=True), feeds, scope,
+        [batch(64, 7), batch(64, 8)])
+    ptq.quantize()
+    assert ptq.calibrated_scales
+
+    # convert a CLEAN copy of the inference program to the int8 engine
+    import copy
+
+    int8_scope = pt.Scope()
+    int8_scope._vars = {k: np.copy(v) for k, v in scope.items()}
+    int8_prog = slim.convert_to_int8_program(
+        infer.clone(for_test=True), int8_scope, ptq.calibrated_scales)
+    types = [op.type for op in int8_prog.global_block().ops]
+    assert "int8_matmul" in types, types
+    for name, val in int8_scope.items():
+        if name.endswith("@int8_scale"):
+            base = name[:-len("@int8_scale")]
+            assert np.asarray(int8_scope.find_var(base)).dtype == np.int8
+
+    q_pred = AnalysisPredictor(AnalysisConfig(), program=int8_prog,
+                               feed_names=feeds, fetch_names=fetches,
+                               scope=int8_scope)
+    q_logits, = q_pred.run({"x": test["x"]})
+    q_top1 = np.argmax(q_logits, axis=1)
+    agree = float(np.mean(q_top1 == fp_top1))
+    assert agree >= 0.97, f"int8 top-1 agreement {agree}"
+    q_acc = float(np.mean(q_top1 == test["y"][:, 0]))
+    assert q_acc > 0.85, q_acc
+
+
+def test_weight_only_path(scope):
+    """Without activation scales every op takes the weight-only
+    dequantize_weight route and still matches closely."""
+    infer, logits, batch = _build_and_train(scope, steps=30)
+    fp = AnalysisPredictor(AnalysisConfig(), program=infer,
+                           feed_names=["x"], fetch_names=[logits.name],
+                           scope=scope)
+    test = batch(128, 555)
+    fp_logits, = fp.run({"x": test["x"]})
+
+    int8_scope = pt.Scope()
+    int8_scope._vars = {k: np.copy(v) for k, v in scope.items()}
+    prog = slim.convert_to_int8_program(infer.clone(for_test=True),
+                                        int8_scope, act_scales=None)
+    types = [op.type for op in prog.global_block().ops]
+    assert "dequantize_weight" in types and "int8_matmul" not in types
+    q = AnalysisPredictor(AnalysisConfig(), program=prog,
+                          feed_names=["x"], fetch_names=[logits.name],
+                          scope=int8_scope)
+    q_logits, = q.run({"x": test["x"]})
+    agree = np.mean(np.argmax(q_logits, 1) == np.argmax(fp_logits, 1))
+    assert agree >= 0.98, agree
+
+
+def test_weight_tied_param_stays_fp(scope):
+    """A parameter read by BOTH a quantizable matmul and a non-quantized
+    consumer (weight tying, e.g. an embedding doubling as the output
+    projection) must NOT be overwritten with int8 in the scope."""
+    from paddle_tpu.core import ir, unique_name
+
+    ir._main_program, ir._startup_program = ir.Program(), ir.Program()
+    unique_name.switch()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        ids = layers.static_data("ids", [-1, 3], "int64")
+        emb = layers.embedding(ids, [50, 8],
+                               param_attr=pt.ParamAttr(name="tied_w"))
+        pooled = layers.reduce_mean(emb, dim=[1])          # [B, 8]
+        w = main.global_block().var("tied_w")              # [50, 8]
+        logits = layers.matmul(pooled, w, transpose_y=True)
+    exe = pt.Executor()
+    exe.run(startup, scope=scope, use_compiled=False)
+    feed = {"ids": np.random.RandomState(0).randint(0, 50, (4, 3))
+            .astype(np.int64)}
+    ref, = exe.run(main, feed=feed, fetch_list=[logits], scope=scope)
+
+    prog = slim.convert_to_int8_program(main, scope, act_scales=None)
+    assert np.asarray(scope.find_var("tied_w")).dtype == np.float32
+    got, = exe.run(prog, feed=feed, fetch_list=[logits], scope=scope)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-5)
