@@ -1,0 +1,163 @@
+"""Execute every op the round-5 EXECUTION coverage gate found running
+nowhere (they had only textual mentions before). Each case runs through
+executor.run_op — the REAL executor path (slot resolution, attr
+injection, output binding) — feeding the registry-wide gate
+(tests/conftest.py sessionfinish)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu  # noqa: F401  (registers ops)
+from paddle_tpu.core import registry
+from paddle_tpu.core.executor import run_op
+from paddle_tpu.core.ir import OpDesc
+
+_OUT_SLOTS = {
+    "norm": ("Out", "Norm"), "fused_layer_norm": ("Y", "Mean", "Variance"),
+    "beam_search_decode": ("SentenceIds", "SentenceScores"),
+    "unstack": ("Y",),
+}
+
+
+def _fwd(op, ins, attrs=None, n_out=1):
+    """Build an OpDesc + env and execute through run_op (the executors'
+    shared entry), returning {slot: value-or-list}."""
+    import jax.numpy as jnp
+
+    env = {}
+    in_names = {}
+    for slot, vals in ins.items():
+        names = []
+        for i, v in enumerate(vals):
+            nm = f"in_{slot}_{i}"
+            env[nm] = None if v is None else jnp.asarray(v)
+            names.append(nm)
+        in_names[slot] = names
+    out_names = {s: [f"out_{s}_{j}" for j in range(n_out)]
+                 for s in _OUT_SLOTS.get(op, ("Out",))}
+    desc = OpDesc(op, in_names, out_names, dict(attrs or {}))
+    run_op(desc, env, step=np.int32(0))
+    res = {}
+    for s, names in out_names.items():
+        vals = [env.get(nm) for nm in names]
+        res[s] = vals if len(vals) > 1 else vals[0]
+    return res
+
+
+X = np.linspace(-2.0, 2.0, 12).reshape(3, 4).astype(np.float32)
+POS = np.abs(X) + 0.5
+
+
+UNARY = {
+    "ceil": (X, {}, np.ceil),
+    "cos": (X, {}, np.cos),
+    "sin": (X, {}, np.sin),
+    "erf": (X, {}, None),
+    "round": (X, {}, np.round),
+    "sign": (X, {}, np.sign),
+    "log1p": (POS, {}, np.log1p),
+    "log2": (POS, {}, np.log2),
+    "leaky_relu": (X, {"alpha": 0.1},
+                   lambda x: np.where(x > 0, x, 0.1 * x)),
+    "flip": (X, {"axis": [1]}, lambda x: x[:, ::-1]),
+    "transpose": (X, {"axis": [1, 0]}, lambda x: x.T),
+    "reshape": (X, {"shape": [4, 3]}, lambda x: x.reshape(4, 3)),
+    "tile": (X, {"repeat_times": [2, 1]}, lambda x: np.tile(x, (2, 1))),
+    "pad": (X, {"paddings": [1, 1, 0, 0], "pad_value": 0.0},
+            lambda x: np.pad(x, [(1, 1), (0, 0)])),
+    "reduce_all": ((X > -10), {"reduce_all": True}, None),
+    "allreduce": (X, {}, lambda x: x),      # degrades to identity 1-rank
+    "print": (X, {"message": "gate-smoke"}, lambda x: x),
+    "select_output": (X, {"branch_num": 2}, None),
+}
+
+
+@pytest.mark.parametrize("op", sorted(UNARY))
+def test_unary_family(op):
+    x, attrs, ref = UNARY[op]
+    ins = {"X": [x]}
+    if op == "select_output":
+        ins["Mask"] = [np.zeros((1,), np.int32)]
+    out = _fwd(op, ins, attrs,
+               n_out=2 if op == "select_output" else 1)
+    key = "Out"
+    val = out[key][0] if isinstance(out[key], list) else out[key]
+    if ref is not None:
+        np.testing.assert_allclose(np.asarray(val, np.float64),
+                                   ref(x.astype(np.float64)), rtol=1e-5,
+                                   atol=1e-6)
+    else:
+        assert np.asarray(val).size
+
+
+def test_binary_and_misc():
+    np.testing.assert_allclose(
+        np.asarray(_fwd("maximum", {"X": [X], "Y": [-X]})["Out"]),
+        np.maximum(X, -X))
+    np.testing.assert_allclose(
+        np.asarray(_fwd("minus", {"X": [X], "Y": [X * 0.5]})["Out"]),
+        X * 0.5, rtol=1e-6)
+    out = _fwd("norm", {"X": [POS]}, {"axis": 1, "epsilon": 1e-10})
+    np.testing.assert_allclose(
+        np.asarray(out["Out"]),
+        POS / np.linalg.norm(POS, axis=1, keepdims=True), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(_fwd("diag", {"Diagonal": [np.arange(3.0)
+                                              .astype(np.float32)]})["Out"]),
+        np.diag(np.arange(3.0)))
+    np.testing.assert_allclose(
+        np.asarray(_fwd("linspace", {"Start": [np.float32(0.0)],
+                                     "Stop": [np.float32(1.0)]},
+                        {"num": 5})["Out"]),
+        np.linspace(0, 1, 5), rtol=1e-6)
+    assert int(np.asarray(_fwd("rank", {"Input": [X]})["Out"])) == 2
+    assert np.asarray(_fwd("seed", {}, {"seed": 7})["Out"])[0] == 7
+    got = _fwd("scatter", {"X": [np.zeros((4, 2), np.float32)],
+                           "Ids": [np.array([1, 3], np.int64)],
+                           "Updates": [np.ones((2, 2), np.float32)]})
+    np.testing.assert_allclose(np.asarray(got["Out"]).sum(), 4.0)
+
+
+def test_fused_layer_norm_runs():
+    out = _fwd("fused_layer_norm",
+               {"X": [X], "Scale": [np.ones(4, np.float32)],
+                "Bias": [np.zeros(4, np.float32)]},
+               {"begin_norm_axis": 1, "epsilon": 1e-5})
+    y = np.asarray(out["Y"], np.float64)
+    np.testing.assert_allclose(y.mean(axis=1), 0.0, atol=1e-5)
+
+
+def test_beam_search_decode_runs():
+    # 2 steps, beam 2, batch 1: lanes [0,1] then parents [1,0]
+    ids = np.array([[[0, 1]], [[2, 3]]], np.int64)       # [T, B, K]
+    parents = np.array([[[0, 1]], [[1, 0]]], np.int64)
+    scores = np.zeros_like(ids, np.float32)
+    out = _fwd("beam_search_decode",
+               {"Ids": [ids], "ParentIdx": [parents], "Scores": [scores]},
+               {"beam_size": 2, "end_id": 99})
+    assert np.asarray(out["SentenceIds"]).size
+
+
+def test_while_op_runs():
+    """The legacy `while` op (reference while_op.cc form: carried vars +
+    a condition var the sub-block rewrites) executed directly."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as pt
+    from paddle_tpu.core.ir import Program
+
+    sub = Program().global_block()
+    sub.create_var(name="i", stop_gradient=True)
+    sub.create_var(name="cond", stop_gradient=True)
+    sub.create_var(name="lim", stop_gradient=True)
+    sub.append_op("increment", {"X": ["i"]}, {"Out": ["i"]},
+                  {"step": 1.0})
+    sub.append_op("less_than", {"X": ["i"], "Y": ["lim"]},
+                  {"Out": ["cond"]}, {})
+    out = _fwd("while",
+               {"X": [jnp.zeros((), jnp.int32),
+                      jnp.asarray(True),
+                      jnp.asarray(10, jnp.int32)]},
+               {"sub_block": sub, "carry_names": ["i", "cond", "lim"],
+                "cond_name": "cond"}, n_out=3)
+    assert int(np.asarray(out["Out"][0])) == 10

@@ -1,0 +1,148 @@
+"""Bounded ResNet experiment (VERDICT r5 #9): can a Pallas conv with a
+fused BN/ReLU epilogue beat XLA's conv on the dominant ResNet-50 shape?
+
+Shape s3_c2 (3x3 @14x14, 256ch, count 6 in the net; fwd roofline 29% of
+peak per tools/bench_conv.py) in NHWC, batch 256. The kernel processes
+bn images per grid cell, accumulating 9 shifted [rows,C]x[C,Co] dots
+(no halo DMA: the input is padded once in HBM), then applies
+scale/shift/relu in the epilogue — the fused_bn_activation analog.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+
+import sys, os
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from bench_matmul_shapes import slope_time
+
+PEAK = 197.0
+N, H, W, C, CO = 256, 14, 14, 256, 256
+dt = jnp.bfloat16
+
+
+def _conv_kernel(x_ref, w_ref, scale_ref, shift_ref, o_ref, *, bn, hh, ww):
+    acc = None
+    for ky in range(3):
+        for kx in range(3):
+            xs = x_ref[:, ky:ky + hh, kx:kx + ww, :]       # (bn,H,W,C)
+            xm = xs.reshape(bn * hh * ww, C)
+            d = jnp.dot(xm, w_ref[ky, kx],
+                        preferred_element_type=jnp.float32)
+            acc = d if acc is None else acc + d
+    acc = acc * scale_ref[...].astype(jnp.float32) \
+        + shift_ref[...].astype(jnp.float32)
+    acc = jnp.maximum(acc, 0.0)
+    o_ref[...] = acc.reshape(bn, hh, ww, CO).astype(o_ref.dtype)
+
+
+def pallas_conv_bn_relu(xp, w, scale, shift, bn=8):
+    n = xp.shape[0]
+    grid = (n // bn,)
+    return pl.pallas_call(
+        functools.partial(_conv_kernel, bn=bn, hh=H, ww=W),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bn, H + 2, W + 2, C), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((3, 3, C, CO), lambda i: (0, 0, 0, 0)),
+            pl.BlockSpec((CO,), lambda i: (0,)),
+            pl.BlockSpec((CO,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((bn, H, W, CO), lambda i: (i, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, H, W, CO), xp.dtype),
+    )(xp, w, scale, shift)
+
+
+def scalar_slope_time(make_step, n1=8, n2=40, repeats=5):
+    """Slope timing with a SCALAR data-dependence carry: the chain
+    perturbs only the 1.2 MB weight, not the 25.7 MB activation — the
+    full-elementwise-pass artifact BASELINE round 5a diagnosed."""
+    import functools
+    import time
+
+    @functools.lru_cache(maxsize=None)
+    def runner(n):
+        @jax.jit
+        def run(s):
+            return lax.fori_loop(0, n, lambda i, ss: make_step(ss), s)
+
+        return run
+
+    def window(n):
+        s0 = jnp.float32(np.random.rand() * 1e-6)
+        np.asarray(runner(n)(s0))
+        t0 = time.perf_counter()
+        np.asarray(runner(n)(s0 + 1e-9))
+        return time.perf_counter() - t0
+
+    window(n1), window(n2)
+    slopes = []
+    for _ in range(repeats):
+        slopes.append((window(n2) - window(n1)) / (n2 - n1))
+    return float(np.median(slopes)) * 1e3
+
+
+def main():
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (N, H, W, C), dt) * 0.5
+    w = jax.random.normal(key, (3, 3, C, CO), dt) * 0.05
+    scale = jax.random.normal(key, (CO,), jnp.float32) * 0.1 + 1.0
+    shift = jax.random.normal(key, (CO,), jnp.float32) * 0.1
+
+    def xla_ref(xx):
+        y = lax.conv_general_dilated(
+            xx, w, (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        y = jnp.maximum(y.astype(jnp.float32) * scale + shift, 0.0)
+        return y.astype(dt)
+
+    xp = jnp.pad(x, [(0, 0), (1, 1), (1, 1), (0, 0)])
+    ref = xla_ref(x[:4])
+    got = pallas_conv_bn_relu(jnp.pad(x[:4], [(0, 0), (1, 1), (1, 1),
+                                              (0, 0)]), w, scale, shift,
+                              bn=4)
+    print("maxdiff", float(jnp.max(jnp.abs(
+        ref.astype(jnp.float32) - got.astype(jnp.float32)))))
+
+    flops = 2.0 * N * H * W * CO * 9 * C
+    for bn in (4, 8, 16):
+        def step(s, bn=bn):
+            # 1.2 MB weight perturbation only (not the 25.7 MB input)
+            wp = (w.astype(jnp.float32) * (1 + s * 1e-20)).astype(dt)
+            y = pallas_conv_bn_relu(xp, wp, scale, shift, bn=bn)
+            return s + jnp.mean(y).astype(jnp.float32) * 1e-20
+
+        try:
+            ms = scalar_slope_time(step)
+            print(json.dumps({"case": f"pallas_conv_bn{bn}",
+                              "ms": round(ms, 4),
+                              "pct_peak": round(
+                                  100 * flops / (ms * 1e-3) / 1e12 / PEAK,
+                                  1)}), flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(f"pallas_conv_bn{bn} FAILED {str(e)[:110]}", flush=True)
+
+    def xla_step(s):
+        wp = (w.astype(jnp.float32) * (1 + s * 1e-20)).astype(dt)
+        y = lax.conv_general_dilated(
+            x, wp, (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        y = jnp.maximum(y.astype(jnp.float32) * scale + shift, 0.0)
+        return s + jnp.mean(y) * 1e-20
+
+    ms = scalar_slope_time(xla_step)
+    print(json.dumps({"case": "xla_conv_bn_relu", "ms": round(ms, 4),
+                      "pct_peak": round(
+                          100 * flops / (ms * 1e-3) / 1e12 / PEAK, 1)}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
