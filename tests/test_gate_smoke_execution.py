@@ -15,7 +15,6 @@ from paddle_tpu.core.ir import OpDesc
 _OUT_SLOTS = {
     "norm": ("Out", "Norm"), "fused_layer_norm": ("Y", "Mean", "Variance"),
     "beam_search_decode": ("SentenceIds", "SentenceScores"),
-    "unstack": ("Y",),
 }
 
 

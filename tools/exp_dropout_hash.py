@@ -1,0 +1,107 @@
+"""Probe: cost of the in-kernel attention-dropout mask, and a paired
+16-bit variant (one splitmix per TWO lattice positions, hi/lo 16-bit
+thresholds — same iid Bernoulli, rate quantised to 1/65536).
+
+Measures the packed fwd+bwd kernels at the ERNIE geometry with
+(a) rate 0, (b) current per-position mask, (c) paired mask, by
+monkeypatching _keep_scale_tile. Decision rule: integrate only if (c)
+beats (b) by >2% on fwd+bwd.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from bench_matmul_shapes import slope_time
+
+fa = importlib.import_module('paddle_tpu.ops.pallas.flash_attention')
+
+B, H, S, D = 34, 16, 512, 64
+dt = jnp.bfloat16
+
+
+def paired_keep_scale_tile(seed, rate, bidx, n_heads, q0, k0, bq, bk,
+                           sq_g, sk_g):
+    """One splitmix per ki-PAIR; each position reads a 16-bit half."""
+    U = jnp.uint32
+    seed2 = fa._bh_seed(seed, jnp.asarray(bidx, U))
+    qi = jnp.asarray(q0, U) + jax.lax.broadcasted_iota(U, (bq, bk // 2), 0)
+    kp = (jnp.asarray(k0, U) >> U(1)) + jax.lax.broadcasted_iota(
+        U, (bq, bk // 2), 1)
+    lin2 = qi * U(sk_g // 2) + kp
+    x = fa._splitmix(lin2 ^ (seed2 * U(0x9E3779B9)))
+    lo = x & U(0xFFFF)
+    hi = x >> U(16)
+    thresh = U(min(int(round(float(rate) * 65536.0)), 65535))
+    keep = jnp.float32(1.0 / (1.0 - rate))
+    m_lo = jnp.where(lo >= thresh, keep, 0.0)
+    m_hi = jnp.where(hi >= thresh, keep, 0.0)
+    return jnp.stack([m_lo, m_hi], axis=-1).reshape(bq, bk)
+
+
+def bench(tag, rate, patched):
+    orig = fa._keep_scale_tile
+    if patched:
+        fa._keep_scale_tile = paired_keep_scale_tile
+    try:
+        key = jax.random.PRNGKey(0)
+        q3, k3, v3 = (jax.random.normal(jax.random.PRNGKey(i),
+                                        (B, S, H * D), dt) * 0.3
+                      for i in range(3))
+        do3 = jax.random.normal(jax.random.PRNGKey(9), (B, S, H * D), dt)
+        bias_kv = jnp.where(
+            jax.random.uniform(jax.random.PRNGKey(3), (B, S)) < 0.15,
+            jnp.float32(-10000.0), jnp.float32(0.0))
+        scale = 1.0 / np.sqrt(D)
+
+        def fwd_step(x):
+            o, lse = fa._fwd_pallas_packed(x, k3, v3, bias_kv, False,
+                                           scale, False, jnp.uint32(7),
+                                           rate, H)
+            return x * (1 + 1e-20 * jnp.mean(o).astype(x.dtype))
+
+        ms_f = slope_time(fwd_step, q3)
+        o_full, lse_full = fa._fwd_pallas_packed(
+            q3, k3, v3, bias_kv, False, scale, False, jnp.uint32(7),
+            rate, H)
+
+        def bwd_step(x):
+            dq, dk, dv, db = fa._bwd_pallas_packed(
+                x, k3, v3, bias_kv, False, scale, False, o_full,
+                lse_full, do3, jnp.uint32(7), rate, H)
+            return x * (1 + 1e-20 * (jnp.mean(dq) + jnp.mean(dk)
+                                     + jnp.mean(dv)).astype(x.dtype))
+
+        ms_b = slope_time(bwd_step, q3)
+        print(json.dumps({"case": tag, "fwd_ms": round(ms_f, 4),
+                          "bwd_ms": round(ms_b, 4),
+                          "fb_ms": round(ms_f + ms_b, 4)}), flush=True)
+    finally:
+        fa._keep_scale_tile = orig
+
+
+def main():
+    # mask statistics sanity for the paired variant
+    m = paired_keep_scale_tile(jnp.uint32(3), 0.25, 5, 16, 0, 0,
+                               256, 256, 512, 512)
+    keep = float(jnp.mean(m > 0))
+    print("paired keep_frac", round(keep, 4), "(want ~0.75)")
+    assert abs(keep - 0.75) < 0.02
+
+    bench("rate0", 0.0, False)
+    bench("current_rate.1", 0.1, False)
+    bench("paired_rate.1", 0.1, True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,71 @@
+"""s4096 probe: do bigger q/k blocks lift the 2-pass blockwise kernels?
+(BASELINE round 5d: they run at ~15-18% of nominal peak at bq=bk=512.)
+Monkeypatches DEFAULT_BLOCK_Q/K and slope-times fwd and bwd at the xl
+geometry (8,16,4096,64) with dropout 0.1 + bias."""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from bench_matmul_shapes import slope_time
+
+fa = importlib.import_module('paddle_tpu.ops.pallas.flash_attention')
+
+B, H, S, D = 8, 16, 4096, 64
+dt = jnp.bfloat16
+
+
+def bench(tag, bq, bk):
+    fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_K = bq, bk
+    q, k, v = (jax.random.normal(jax.random.PRNGKey(i), (B, H, S, D),
+                                 dt) * 0.3 for i in range(3))
+    do = jax.random.normal(jax.random.PRNGKey(9), (B, H, S, D), dt)
+    bias_kv = jnp.where(
+        jax.random.uniform(jax.random.PRNGKey(3), (B, S)) < 0.15,
+        jnp.float32(-10000.0), jnp.float32(0.0))
+    scale = 1.0 / np.sqrt(D)
+
+    try:
+        def fwd_step(x):
+            o, lse = fa._fwd_pallas(x, k, v, bias_kv, False, scale,
+                                    False, jnp.uint32(7), 0.1)
+            return x * (1 + 1e-20 * jnp.mean(o).astype(x.dtype))
+
+        ms_f = slope_time(fwd_step, q)
+        o, lse = fa._fwd_pallas(q, k, v, bias_kv, False, scale, False,
+                                jnp.uint32(7), 0.1)
+
+        def bwd_step(x):
+            dq, dk, dv, db = fa._bwd_pallas(x, k, v, bias_kv, False,
+                                            scale, False, o, lse, do,
+                                            jnp.uint32(7), 0.1)
+            return x * (1 + 1e-20 * (jnp.mean(dq) + jnp.mean(dk)
+                                     + jnp.mean(dv)).astype(x.dtype))
+
+        ms_b = slope_time(bwd_step, q)
+        print(json.dumps({"case": tag, "fwd_ms": round(ms_f, 3),
+                          "bwd_ms": round(ms_b, 3)}), flush=True)
+    except Exception as e:  # noqa: BLE001
+        print(f"{tag} FAILED {str(e)[:100]}", flush=True)
+
+
+def main():
+    bench("bq512_bk512(current)", 512, 512)
+    bench("bq1024_bk512", 1024, 512)
+    bench("bq512_bk1024", 512, 1024)
+    bench("bq1024_bk1024", 1024, 1024)
+    bench("bq2048_bk512", 2048, 512)
+
+
+if __name__ == "__main__":
+    main()
