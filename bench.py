@@ -24,9 +24,12 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
 def main():
-    from tools.bench_models import bench_ernie_large
+    from tools.bench_models import bench_ernie_large, finalize_bench_result
 
-    out = bench_ernie_large(steps=20)
+    # finalize_bench_result merges telemetry.bench_extra() — compiles /
+    # cache_hits / donation_copies — into `extra`, so every BENCH_r*.json
+    # records the run's compile accounting alongside the throughput
+    out = finalize_bench_result(bench_ernie_large(steps=20))
     print(json.dumps(out))
 
 

@@ -1,6 +1,6 @@
 """Core: IR, registry, executors, autodiff, scope, compiler."""
 
-from . import ir, registry, types, unique_name  # noqa: F401
+from . import ir, registry, telemetry, types, unique_name  # noqa: F401
 from .backward import append_backward, gradients  # noqa: F401
 from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: F401
 from .executor import ExecutionError, Executor, run_startup  # noqa: F401

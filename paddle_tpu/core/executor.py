@@ -19,11 +19,12 @@ ParallelExecutor (parallel_executor.cc:461), re-designed for XLA:
 from __future__ import annotations
 
 import collections
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
-from . import registry
+from . import registry, telemetry
 from .ir import Block, OpDesc, Program, Variable, default_main_program
 from .registry import EMPTY_VAR
 from .scope import Scope, global_scope
@@ -205,6 +206,29 @@ def _collect_collective_ops(ops, _seen=None) -> List[OpDesc]:
     return out
 
 
+# component names of the compile-cache key built in _run_compiled, in
+# key order — the recompile-cause diagnostic names these in events
+_KEY_COMPONENTS = ("program", "program_version", "scope", "feed_names",
+                   "fetch_names", "mesh", "dp_divisibility")
+
+
+def _recompile_cause(key: tuple, cached_keys) -> str:
+    """Name WHY the compile cache missed: diff the missed key against the
+    nearest cached key (most matching components) and return the changed
+    component names. Turns 'the step was mysteriously slow' into
+    'recompile: feed_names changed' in the telemetry log."""
+    if not cached_keys:
+        return "first_compile"
+    best, best_n = None, -1
+    for k in cached_keys:
+        n = sum(1 for a, b in zip(k, key) if a == b)
+        if n > best_n:
+            best, best_n = k, n
+    changed = [comp for comp, a, b in
+               zip(_KEY_COMPONENTS, best, key) if a != b]
+    return ",".join(changed) if changed else "unknown"
+
+
 class _CompiledEntry:
     __slots__ = ("jitted", "state_names", "ro_names", "fetch_names", "has_state_out")
 
@@ -263,6 +287,14 @@ class Executor:
         fetch_names = [f.name if isinstance(f, Variable) else str(f)
                        for f in (fetch_list or [])]
 
+        # host→device feed traffic (bytes that actually cross: values
+        # still host-side; jax arrays are already device-resident)
+        feed_host_bytes = sum(v.nbytes for v in feed.values()
+                              if isinstance(v, np.ndarray))
+        if feed_host_bytes:
+            telemetry.counter_add("executor.feed_host_bytes",
+                                  int(feed_host_bytes))
+
         block = program.global_block()
         # cast feeds to declared dtypes
         for name in list(feed):
@@ -285,15 +317,26 @@ class Executor:
             self._ps_programs[ps_key] = has_ps
         if use_compiled and has_ps:
             use_compiled = False
+            telemetry.counter_add("executor.ps_io_detours", 1,
+                                  program=program.uid)
 
+        telemetry.counter_add("executor.runs_compiled" if use_compiled
+                              else "executor.runs_interpreted", 1)
         if use_compiled:
             fetched = self._run_compiled(program, block, feed, fetch_names, scope,
                                          mesh, in_shardings)
         else:
-            fetched = self._run_interpreted(program, block, feed, fetch_names,
-                                            scope, mesh)
+            with telemetry.timer("executor.interpret_ms"):
+                fetched = self._run_interpreted(program, block, feed,
+                                                fetch_names, scope, mesh)
         if return_numpy:
             fetched = [np.asarray(v) for v in fetched]
+            # device→host fetch traffic (the ~100 ms-sync direction on the
+            # relayed chip — worth seeing per run)
+            fetch_bytes = sum(v.nbytes for v in fetched)
+            if fetch_bytes:
+                telemetry.counter_add("executor.fetch_host_bytes",
+                                      int(fetch_bytes))
         return fetched
 
     # -- interpreting path ---------------------------------------------------
@@ -587,11 +630,21 @@ class Executor:
         key = (program.uid, program.version, scope.uid, feed_names,
                tuple(fetch_names), mesh_key, tuple(sorted(dp_ok.items())))
         entry = self._cache.get(key)
+        compile_cause = None
+        t_compile = None
         if entry is None:
+            # recompile-cause diagnostic: name the key component that
+            # changed vs the nearest cached entry BEFORE inserting, so a
+            # silent retrace shows up as e.g. cause="dp_divisibility"
+            compile_cause = _recompile_cause(key, self._cache)
+            telemetry.counter_add("executor.cache_misses", 1)
+            t_compile = time.perf_counter()
             with _prof.RecordEvent("executor::compile"):
                 entry = self._compile(program, block, feed_names, fetch_names,
                                       scope, mesh, in_shardings, dp_ok)
             self._cache[key] = entry
+        else:
+            telemetry.counter_add("executor.cache_hits", 1)
 
         state = {}
         seen_bufs: Dict[int, str] = {}
@@ -612,7 +665,7 @@ class Executor:
                 getattr(v, "unsafe_buffer_pointer", None)
             if ptr is not None:
                 try:
-                    key = ptr()
+                    bkey = ptr()
                 except Exception as e:
                     # latch ONLY the backend-wide unsupported case; a
                     # per-array failure (deleted/sharded array) must not
@@ -620,23 +673,54 @@ class Executor:
                     msg = str(e).lower()
                     if "unimplemented" in msg or "unsupported" in msg:
                         Executor._buf_ptr_unsupported = True
-                    key = id(v)
+                        telemetry.counter_add(
+                            "executor.buf_ptr_unsupported", 1)
+                        telemetry.event(
+                            "fallback", "executor.unsafe_buffer_pointer",
+                            None, {"var": n, "error": str(e)[:200]})
+                    bkey = id(v)
             else:
-                key = id(v)
-            if key in seen_bufs:
+                bkey = id(v)
+            if bkey in seen_bufs:
                 import jax.numpy as jnp
 
                 v = jnp.copy(v)
+                telemetry.counter_add("executor.donation_copies", 1,
+                                      var=n, aliases=seen_bufs[bkey])
             else:
-                seen_bufs[key] = n
+                seen_bufs[bkey] = n
             state[n] = v
         ro = {n: scope.find_var(n) for n in entry.ro_names}
         step = scope.find_var("@STEP_COUNTER@")
         if step is None:
             step = _as_device_array(0, np.int32)
 
+        t_run = time.perf_counter()
         with _prof.RecordEvent("executor::run"):
             fetches, new_state, new_step = entry.jitted(state, ro, feed, step)
+        if compile_cause is not None:
+            # jax.jit compiles lazily — the first execution carries the
+            # trace + XLA compile, so compile wall time is measured through
+            # it (and excluded from the run_ms step-time histogram)
+            compile_ms = (time.perf_counter() - t_compile) * 1e3
+            telemetry.counter_add("executor.compiles", 1)
+            telemetry.counter_add("executor.compile_ms",
+                                  round(compile_ms, 3))
+            telemetry.gauge_set("executor.cache_size", len(self._cache))
+            telemetry.event(
+                "compile", "executor", round(compile_ms, 3),
+                {"cause": compile_cause, "cache_size": len(self._cache),
+                 "program": program.uid, "program_version": program.version,
+                 "feed_names": list(feed_names),
+                 "fetch_names": list(fetch_names),
+                 "mesh": None if mesh_key is None else list(mesh_key[0]),
+                 "dp_divisibility": sorted(dp_ok.items())})
+        else:
+            # host-side dispatch wall time (device dispatch is async —
+            # these are the step-time percentiles in the run log)
+            telemetry.observe("executor.run_ms",
+                              (time.perf_counter() - t_run) * 1e3,
+                              kind="timer")
         from .flags import flag as _flag
 
         if _flag("check_nan_inf"):

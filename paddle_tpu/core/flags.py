@@ -98,3 +98,13 @@ define_flag("infer_shape_debug", False,
             "warn (with op type + error) when build-time shape inference "
             "fails instead of silently skipping — surfaces op-lowering bugs "
             "at program-build time rather than at jit time")
+define_flag("telemetry_path", "",
+            "path of the structured-telemetry JSONL run log (core/"
+            "telemetry.py); empty disables the sink. The PT_TELEMETRY_LOG "
+            "env var is an alias with lower precedence. Render with "
+            "tools/perf_report.py")
+define_flag("profiler_max_events", 1_000_000,
+            "ring-buffer bound on the profiler's host-span store — long "
+            "runs overwrite the oldest spans instead of growing host "
+            "memory without limit; drops are counted in the "
+            "profiler.events_dropped telemetry counter")

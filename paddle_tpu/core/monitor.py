@@ -1,22 +1,23 @@
 """Global stat registry — counters/gauges for observability.
 
 Capability mirror of platform/monitor.h (StatRegistry:77, STAT_ADD:130 —
-the reference tracks e.g. STAT_GPU_MEM per device). Stats here also
-surface the native runtime's counters (native/data_feed.cc mem/records).
+the reference tracks e.g. STAT_GPU_MEM per device). Since the telemetry
+PR this is a thin compatibility shim: the backing store is
+``core.telemetry``'s unified counter table, so STAT_ADD-style stats also
+land in JSONL run logs and ``tools/perf_report.py`` summaries. Stats
+still surface the native runtime's counters (native/data_feed.cc
+mem/records) in ``stats()``.
 """
 
 from __future__ import annotations
 
-import threading
 from typing import Dict
+
+from . import telemetry
 
 
 class StatRegistry:
     _instance = None
-
-    def __init__(self):
-        self._stats: Dict[str, int] = {}
-        self._lock = threading.Lock()
 
     @classmethod
     def instance(cls) -> "StatRegistry":
@@ -25,20 +26,19 @@ class StatRegistry:
         return cls._instance
 
     def add(self, name: str, delta: int) -> int:
-        with self._lock:
-            self._stats[name] = self._stats.get(name, 0) + int(delta)
-            return self._stats[name]
+        return int(telemetry.counter_add(name, int(delta)))
 
     def set(self, name: str, value: int):
-        with self._lock:
-            self._stats[name] = int(value)
+        telemetry.counter_set(name, int(value))
 
     def get(self, name: str) -> int:
-        with self._lock:
-            return self._stats.get(name, 0)
+        return int(telemetry.counter_get(name))
 
     def stats(self) -> Dict[str, int]:
-        out = dict(self._stats)
+        # counters() snapshots under the registry lock (the seed's version
+        # read its dict lock-free — a concurrent add could observe a
+        # mid-resize dict)
+        out = telemetry.counters()
         # live native-runtime stats (reference: STAT_GPU_MEM analog)
         try:
             from .. import native

@@ -1,0 +1,329 @@
+"""Structured runtime telemetry — the unified observability registry.
+
+Capability mirror of the reference's monitoring tier, extended into one
+subsystem:
+
+* counters/gauges (platform/monitor.h StatRegistry:77, STAT_ADD:130) —
+  absorbed here; ``core.monitor`` keeps ``stat_add``/``stat_get`` as thin
+  aliases over this registry;
+* histograms/timers for step-time and RPC-latency percentiles (the
+  reference reads these off the profiler's summary tables instead);
+* a thread-safe JSONL event sink — the persistent per-run record the
+  reference gets from CUPTI dumps + tools/timeline.py. Enabled via
+  ``FLAGS_telemetry_path`` (or the ``PT_TELEMETRY_LOG`` env var); every
+  line is one record of the fixed schema below. ``tools/perf_report.py``
+  renders a run log back into tables.
+
+JSONL schema (one object per line)::
+
+    {"ts": <unix seconds>, "kind": <str>, "name": <str>,
+     "value": <number|null>, "attrs": {<str>: <json>}}
+
+kinds emitted by the framework: ``counter`` (value = new cumulative,
+attrs.delta = increment), ``gauge``, ``timer``/``hist`` (value = sample,
+ms for timers), ``compile`` (value = wall ms, attrs.cause = recompile
+cause), ``step`` (hapi per-step metrics), ``metric`` (bench results),
+``fallback`` (degraded-path latches), ``snapshot`` (full registry dump at
+flush/exit), ``profiler_summary`` (one line per profiler.summarize row).
+
+In-memory aggregation (counters/gauges/histograms) is ALWAYS on — it is
+a few dict updates per executor run, invisible next to a device step.
+JSONL records are written only when a sink path is configured.
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextlib
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from . import flags as _flags
+
+SCHEMA_FIELDS = ("ts", "kind", "name", "value", "attrs")
+
+_HIST_SAMPLE_CAP = 8192  # per-histogram retained samples (sliding ring)
+
+
+class _Hist:
+    """Running histogram: exact count/sum/min/max + a bounded sample ring
+    for percentile estimates (recent-window semantics once full)."""
+
+    __slots__ = ("count", "total", "vmin", "vmax", "samples", "_next")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.vmin = float("inf")
+        self.vmax = float("-inf")
+        self.samples = []
+        self._next = 0
+
+    def observe(self, v: float):
+        v = float(v)
+        self.count += 1
+        self.total += v
+        self.vmin = min(self.vmin, v)
+        self.vmax = max(self.vmax, v)
+        if len(self.samples) < _HIST_SAMPLE_CAP:
+            self.samples.append(v)
+        else:
+            self.samples[self._next] = v
+            self._next = (self._next + 1) % _HIST_SAMPLE_CAP
+
+    def summary(self) -> Dict[str, float]:
+        s = sorted(self.samples)
+
+        def pct(q):
+            if not s:
+                return 0.0
+            return s[min(len(s) - 1, int(q * (len(s) - 1) + 0.5))]
+
+        return {"count": self.count, "total": round(self.total, 3),
+                "min": round(self.vmin, 3) if self.count else 0.0,
+                "max": round(self.vmax, 3) if self.count else 0.0,
+                "avg": round(self.total / self.count, 3) if self.count else 0.0,
+                "p50": round(pct(0.50), 3), "p90": round(pct(0.90), 3),
+                "p99": round(pct(0.99), 3)}
+
+
+class TelemetryRegistry:
+    _instance: Optional["TelemetryRegistry"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._counters: Dict[str, Any] = {}
+        self._gauges: Dict[str, Any] = {}
+        self._hists: Dict[str, _Hist] = {}
+        self._file = None
+        self._path: Optional[str] = None
+        self._sink_warned = False
+
+    @classmethod
+    def instance(cls) -> "TelemetryRegistry":
+        if cls._instance is None:
+            with cls._instance_lock:
+                if cls._instance is None:
+                    cls._instance = cls()
+        return cls._instance
+
+    # -- sink ----------------------------------------------------------------
+    def _resolve_path(self) -> Optional[str]:
+        path = _flags.flag("telemetry_path")
+        if not path:
+            path = os.environ.get("PT_TELEMETRY_LOG", "")
+        return path or None
+
+    def _sink(self):
+        """Current sink file (called under self._lock); follows flag/env
+        changes so set_flags({'FLAGS_telemetry_path': ...}) takes effect
+        mid-run and '' closes the sink."""
+        path = self._resolve_path()
+        if path != self._path:
+            if self._file is not None:
+                try:
+                    self._file.close()
+                except OSError:
+                    pass
+                self._file = None
+            self._path = path
+            if path:
+                try:
+                    self._file = open(path, "a", buffering=1)
+                except OSError as e:
+                    if not self._sink_warned:
+                        self._sink_warned = True
+                        print(f"[telemetry] cannot open sink {path!r}: {e}",
+                              file=sys.stderr)
+                    self._path = None
+        return self._file
+
+    def enabled(self) -> bool:
+        return self._resolve_path() is not None
+
+    def configure(self, path: Optional[str]):
+        """Point the JSONL sink at `path` (None/'' disables). Equivalent to
+        set_flags({'FLAGS_telemetry_path': path}) — the flag wins over the
+        PT_TELEMETRY_LOG env var."""
+        _flags.set_flags({"telemetry_path": path or ""})
+        with self._lock:
+            self._sink()
+
+    def emit(self, kind: str, name: str, value=None,
+             attrs: Optional[Dict[str, Any]] = None):
+        """Append one schema record to the sink (no-op when disabled)."""
+        with self._lock:
+            f = self._sink()
+            if f is None:
+                return
+            rec = {"ts": time.time(), "kind": kind, "name": name,
+                   "value": value, "attrs": attrs or {}}
+            try:
+                f.write(json.dumps(rec, default=str) + "\n")
+            except (OSError, ValueError, TypeError):
+                pass
+
+    # -- metrics -------------------------------------------------------------
+    def counter_add(self, name: str, delta=1, **attrs):
+        with self._lock:
+            val = self._counters.get(name, 0) + delta
+            self._counters[name] = val
+        self.emit("counter", name, val, {"delta": delta, **attrs})
+        return val
+
+    def counter_set(self, name: str, value, **attrs):
+        with self._lock:
+            self._counters[name] = value
+        self.emit("counter", name, value, {"set": True, **attrs})
+
+    def counter_get(self, name: str):
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def gauge_set(self, name: str, value, **attrs):
+        with self._lock:
+            self._gauges[name] = value
+        self.emit("gauge", name, value, attrs)
+
+    def observe(self, name: str, value, kind: str = "hist", **attrs):
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = _Hist()
+            h.observe(value)
+        self.emit(kind, name, round(float(value), 4), attrs)
+
+    @contextlib.contextmanager
+    def timer(self, name: str, **attrs):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(name, (time.perf_counter() - t0) * 1e3,
+                         kind="timer", **attrs)
+
+    # -- snapshots -----------------------------------------------------------
+    def counters(self) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._counters)
+
+    def gauges(self) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._gauges)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"counters": dict(self._counters),
+                    "gauges": dict(self._gauges),
+                    "hists": {n: h.summary()
+                              for n, h in self._hists.items()}}
+
+    def reset(self):
+        """Clear all in-memory aggregates (tests). Leaves the sink alone."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+
+    def flush(self):
+        """Persist a full registry snapshot + the profiler's summary table
+        into the sink — called atexit so every run log ends with final
+        counter values and the host-span rollup perf_report can render."""
+        if not self.enabled():
+            return
+        # gather the profiler summary BEFORE emitting: emit takes this
+        # registry's lock per record and profiler.summarize takes the
+        # profiler's — never hold both at once (profiler's ring-buffer
+        # drop accounting calls back into counter_add)
+        prof_rows = {}
+        try:
+            from .. import profiler as _prof
+
+            prof_rows = _prof.summarize()
+        except Exception:
+            pass
+        self.emit("snapshot", "telemetry", None, self.snapshot())
+        for name, row in prof_rows.items():
+            self.emit("profiler_summary", name, row.get("total_us"),
+                      {k: v for k, v in row.items() if k != "total_us"})
+
+
+# -- module-level convenience API (the surface everything instruments
+#    against; mirrors monitor.h's free-function STAT_ADD style) -------------
+
+def _reg() -> TelemetryRegistry:
+    return TelemetryRegistry.instance()
+
+
+def counter_add(name: str, delta=1, **attrs):
+    return _reg().counter_add(name, delta, **attrs)
+
+
+def counter_set(name: str, value, **attrs):
+    return _reg().counter_set(name, value, **attrs)
+
+
+def counter_get(name: str):
+    return _reg().counter_get(name)
+
+
+def gauge_set(name: str, value, **attrs):
+    return _reg().gauge_set(name, value, **attrs)
+
+
+def observe(name: str, value, kind: str = "hist", **attrs):
+    return _reg().observe(name, value, kind=kind, **attrs)
+
+
+def timer(name: str, **attrs):
+    return _reg().timer(name, **attrs)
+
+
+def event(kind: str, name: str, value=None, attrs=None):
+    return _reg().emit(kind, name, value, attrs)
+
+
+def counters() -> Dict[str, Any]:
+    return _reg().counters()
+
+
+def gauges() -> Dict[str, Any]:
+    return _reg().gauges()
+
+
+def snapshot() -> Dict[str, Any]:
+    return _reg().snapshot()
+
+
+def enabled() -> bool:
+    return _reg().enabled()
+
+
+def configure(path: Optional[str]):
+    return _reg().configure(path)
+
+
+def reset():
+    return _reg().reset()
+
+
+def flush():
+    return _reg().flush()
+
+
+def bench_extra() -> Dict[str, Any]:
+    """Key counters for BENCH json `extra` — every BENCH_r*.json carries
+    compile/cache/donation accounting from here on (bench.py merges it)."""
+    c = counters()
+    return {"telemetry_compiles": int(c.get("executor.compiles", 0)),
+            "telemetry_cache_hits": int(c.get("executor.cache_hits", 0)),
+            "telemetry_donation_copies":
+                int(c.get("executor.donation_copies", 0))}
+
+
+atexit.register(flush)

@@ -17,9 +17,12 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
 from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
+
+from ...core import telemetry
 
 _HDR = struct.Struct("<IIHHI")  # method_len, name_len, dtype_code, ndim, aux
 _DTYPES = ["float32", "float64", "int32", "int64", "uint8", "bool",
@@ -195,14 +198,25 @@ class RPCClient:
             cls._pool.clear()
 
     def call(self, method: str, name: str = "", arr=None, aux: int = 0):
+        a = None if arr is None else np.asarray(arr)
+        t0 = time.perf_counter()
         with self._lock:
-            _send_msg(self._sock, method, name,
-                      None if arr is None else np.asarray(arr), aux)
+            _send_msg(self._sock, method, name, a, aux)
             status, err, out, oaux = _recv_msg(self._sock)
-            if status == "__err__":
-                raise RuntimeError(
-                    f"PS RPC '{method}' failed on {self.endpoint}: {err}")
-            return out, oaux
+        # transport accounting (reference analog: the gRPC/BRPC client
+        # metrics) — call count, payload bytes each way, latency histogram
+        telemetry.counter_add("ps.rpc_calls", 1, method=method)
+        if a is not None:
+            telemetry.counter_add("ps.rpc_send_bytes", int(a.nbytes))
+        if out is not None:
+            telemetry.counter_add("ps.rpc_recv_bytes", int(out.nbytes))
+        telemetry.observe("ps.rpc_ms", (time.perf_counter() - t0) * 1e3,
+                          kind="timer", method=method)
+        if status == "__err__":
+            telemetry.counter_add("ps.rpc_errors", 1, method=method)
+            raise RuntimeError(
+                f"PS RPC '{method}' failed on {self.endpoint}: {err}")
+        return out, oaux
 
     def stop_server(self):
         try:

@@ -10,7 +10,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
-           "CallbackList"]
+           "CallbackList", "TelemetryLogger"]
 
 
 class Callback:
@@ -118,6 +118,63 @@ class ProgBarLogger(Callback):
             return f"{float(np.asarray(v).reshape(-1)[0]):.4f}"
         except (TypeError, ValueError):
             return str(a)
+
+
+class TelemetryLogger(Callback):
+    """Stream step-level training metrics into ``core.telemetry``: per-step
+    wall time (the ``hapi.step_ms`` histogram → step-time percentiles in
+    ``tools/perf_report.py``), steps/s throughput, and the scalar logs
+    (loss/metrics) as JSONL ``step`` events when a run log is enabled
+    (``FLAGS_telemetry_path`` / ``PT_TELEMETRY_LOG``). ``Model.fit``
+    attaches one automatically whenever the sink is enabled."""
+
+    def __init__(self, every: int = 1):
+        super().__init__()
+        self.every = max(1, int(every))
+        self._t0 = None
+        self._epoch = 0
+
+    @staticmethod
+    def _scalars(logs):
+        out = {}
+        for k, v in (logs or {}).items():
+            try:
+                out[k] = float(np.asarray(v).reshape(-1)[0])
+            except (TypeError, ValueError):
+                pass
+        return out
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+
+    def on_train_batch_begin(self, step, logs=None):
+        self._t0 = time.perf_counter()
+
+    def on_train_batch_end(self, step, logs=None):
+        from ..core import telemetry
+
+        if self._t0 is None:
+            return
+        ms = (time.perf_counter() - self._t0) * 1e3
+        self._t0 = None
+        telemetry.observe("hapi.step_ms", ms, kind="timer")
+        telemetry.counter_add("hapi.train_steps", 1)
+        if step % self.every:
+            return
+        attrs = {"epoch": self._epoch, "step": int(step),
+                 "ms": round(ms, 3)}
+        if ms > 0:
+            attrs["steps_per_s"] = round(1e3 / ms, 3)
+        attrs.update(self._scalars(logs))
+        telemetry.event("step", "train", attrs.get("loss"), attrs)
+
+    def on_eval_end(self, logs=None):
+        from ..core import telemetry
+
+        attrs = self._scalars(logs)
+        telemetry.counter_add("hapi.evals", 1)
+        telemetry.event("step", "eval",
+                        attrs.get("eval_loss", attrs.get("loss")), attrs)
 
 
 class ModelCheckpoint(Callback):

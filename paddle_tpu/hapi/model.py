@@ -18,7 +18,7 @@ from .. import dygraph
 from ..dygraph import to_variable
 from ..metric import Metric
 from ..reader import DataLoader, Dataset
-from .callbacks import Callback, CallbackList, ProgBarLogger
+from .callbacks import Callback, CallbackList, ProgBarLogger, TelemetryLogger
 
 __all__ = ["Model"]
 
@@ -140,6 +140,11 @@ class Model:
             from .callbacks import ModelCheckpoint
 
             cbks.append(ModelCheckpoint(save_freq, save_dir))
+        from ..core import telemetry
+
+        if telemetry.enabled() and \
+                not any(isinstance(c, TelemetryLogger) for c in cbks):
+            cbks.append(TelemetryLogger())
         steps = len(loader) if hasattr(loader, "__len__") else None
         cb = CallbackList(cbks, model=self,
                           params={"epochs": epochs, "steps": steps,

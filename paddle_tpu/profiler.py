@@ -18,15 +18,23 @@ per-step (compile + run) in the compiled path.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import json
 import threading
 import time
 from typing import Dict, List, Optional
 
+from .core import flags as _flags
+from .core import telemetry as _telemetry
+
 _lock = threading.Lock()
 _enabled = False
-_events: List[dict] = []          # {name, ts, dur, tid}
+# {name, ts, dur, tid} — bounded ring: FLAGS_profiler_max_events caps the
+# store so long training runs can't grow host memory without limit; when
+# full, the OLDEST span is dropped (and counted in telemetry as
+# profiler.events_dropped)
+_events: "collections.deque[dict]" = collections.deque()
 
 
 def _now_us() -> float:
@@ -56,10 +64,19 @@ class RecordEvent:
         if self._t0 is None:
             return
         dur = _now_us() - self._t0
+        dropped = 0
+        cap = int(_flags.flag("profiler_max_events"))
         with _lock:
+            while cap > 0 and len(_events) >= cap:
+                _events.popleft()
+                dropped += 1
             _events.append({"name": self.name, "ts": self._t0, "dur": dur,
                             "tid": threading.get_ident()})
         self._t0 = None
+        if dropped:
+            # outside _lock: counter_add takes the telemetry lock, and
+            # telemetry.flush() takes locks in the opposite order
+            _telemetry.counter_add("profiler.events_dropped", dropped)
 
 
 @contextlib.contextmanager

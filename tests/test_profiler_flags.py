@@ -82,6 +82,88 @@ class TestFlags:
             pt.set_flags({"FLAGS_check_nan_inf": False})
 
 
+class TestExclusiveTimes:
+    """profiler._exclusive_times nesting math (was only exercised
+    implicitly through device_profile)."""
+
+    @staticmethod
+    def _ev(ts, dur, pid=1, tid=1, name="e"):
+        return {"ts": ts, "dur": dur, "pid": pid, "tid": tid, "name": name}
+
+    def test_proper_containment_chain(self):
+        from paddle_tpu.profiler import _exclusive_times
+
+        parent = self._ev(0, 100, name="parent")
+        child = self._ev(10, 20, name="child")
+        grand = self._ev(12, 5, name="grand")
+        excl = _exclusive_times([parent, child, grand])
+        assert excl[id(parent)] == 80      # 100 - child's 20
+        assert excl[id(child)] == 15       # 20 - grand's 5
+        assert id(grand) not in excl       # leaf: inclusive == exclusive
+
+    def test_sibling_children(self):
+        from paddle_tpu.profiler import _exclusive_times
+
+        parent = self._ev(0, 100, name="parent")
+        c1 = self._ev(10, 20, name="c1")
+        c2 = self._ev(50, 30, name="c2")
+        excl = _exclusive_times([parent, c1, c2])
+        assert excl[id(parent)] == 50      # 100 - 20 - 30
+
+    def test_partial_overlap_not_subtracted(self):
+        from paddle_tpu.profiler import _exclusive_times
+
+        # b starts inside a but ends after it — NOT properly contained, so
+        # nothing is subtracted (malformed traces degrade to inclusive)
+        a = self._ev(0, 50, name="a")
+        b = self._ev(40, 30, name="b")
+        excl = _exclusive_times([a, b])
+        assert id(a) not in excl
+        assert id(b) not in excl
+
+    def test_multi_pid_tid_timelines_independent(self):
+        from paddle_tpu.profiler import _exclusive_times
+
+        # identical time windows on two devices: each (pid, tid) timeline
+        # nests independently — no cross-device subtraction
+        p1_parent = self._ev(0, 100, pid=1, name="p1")
+        p1_child = self._ev(10, 20, pid=1, name="c1")
+        p2_span = self._ev(10, 20, pid=2, name="p2")
+        t2_span = self._ev(5, 90, pid=1, tid=2, name="t2")
+        excl = _exclusive_times([p1_parent, p1_child, p2_span, t2_span])
+        assert excl[id(p1_parent)] == 80
+        assert id(p2_span) not in excl
+        assert id(t2_span) not in excl
+
+    def test_events_without_dur_ignored(self):
+        from paddle_tpu.profiler import _exclusive_times
+
+        meta = {"ts": 0, "pid": 1, "tid": 1, "name": "meta"}
+        span = self._ev(0, 10)
+        assert _exclusive_times([meta, span]) == {}
+
+
+def test_chrome_tracing_roundtrip(tmp_path, capsys):
+    """export_chrome_tracing must round-trip every recorded span with its
+    name/ts/dur into chrome://tracing's event format."""
+    profiler.start_profiler()
+    with profiler.RecordEvent("alpha"):
+        with profiler.RecordEvent("beta"):
+            pass
+    live = profiler.events()
+    path = str(tmp_path / "trace.json")
+    profiler.stop_profiler(profile_path=path)
+    capsys.readouterr()
+    with open(path) as f:
+        doc = json.load(f)
+    evs = doc["traceEvents"]
+    assert [e["name"] for e in evs] == [e["name"] for e in live]
+    for got, src in zip(evs, live):
+        assert got["ph"] == "X"
+        assert got["ts"] == src["ts"] and got["dur"] == src["dur"]
+        assert got["tid"] == src["tid"]
+
+
 class TestMonitor:
     def test_stat_add(self):
         from paddle_tpu.core.monitor import StatRegistry, stat_add, stat_get

@@ -1,0 +1,424 @@
+"""Structured-telemetry tests (the observability PR's tier-1 gate):
+compile/recompile accounting with causes, the JSONL run-log sink,
+tools/perf_report.py, StatRegistry absorption, the profiler ring buffer,
+donation-copy and RPC accounting, and bench-extra embedding."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core import telemetry
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.configure(None)
+    telemetry.reset()
+    yield
+    telemetry.configure(None)
+    telemetry.reset()
+
+
+def _small_program(hidden=8):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4], stop_gradient=True)
+        y = layers.fc(x, hidden, act="relu")
+        loss = layers.mean(y)
+        pt.optimizer.SGDOptimizer(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _read(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+class TestCompileAccounting:
+    def test_compile_once_then_cache_hits(self, scope, tmp_path):
+        """Tier-1 smoke (ISSUE satellite 5): one compiled run emits exactly
+        one compile event; identical re-runs record cache hits."""
+        log = tmp_path / "run.jsonl"
+        telemetry.configure(str(log))
+        main, startup, loss = _small_program()
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        x = np.ones((4, 4), np.float32)
+        for _ in range(3):
+            exe.run(main, feed={"x": x}, fetch_list=[loss], scope=scope)
+        recs = _read(log)
+        compiles = [r for r in recs if r["kind"] == "compile"]
+        assert len(compiles) == 1
+        assert compiles[0]["attrs"]["cause"] == "first_compile"
+        assert compiles[0]["value"] > 0
+        hits = [r for r in recs if r["kind"] == "counter"
+                and r["name"] == "executor.cache_hits"]
+        assert hits and hits[-1]["value"] == 2
+        assert telemetry.counter_get("executor.compiles") == 1
+        assert telemetry.counter_get("executor.cache_hits") == 2
+        # schema: every record carries exactly the documented fields
+        for r in recs:
+            assert set(r) == set(telemetry.SCHEMA_FIELDS)
+
+    def test_two_program_sequence_twice(self, scope, tmp_path):
+        """Acceptance: a two-program train/eval sequence run twice → compile
+        events == distinct cache keys (2), second pass all hits."""
+        log = tmp_path / "run.jsonl"
+        telemetry.configure(str(log))
+        main, startup, loss = _small_program()
+        eval_prog = main.clone(for_test=True)
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        x = np.ones((4, 4), np.float32)
+        for _ in range(2):
+            exe.run(main, feed={"x": x}, fetch_list=[loss], scope=scope)
+            exe.run(eval_prog, feed={"x": x}, fetch_list=[loss], scope=scope)
+        recs = _read(log)
+        compiles = [r for r in recs if r["kind"] == "compile"]
+        assert len(compiles) == 2
+        assert compiles[1]["attrs"]["cause"].startswith("program")
+        assert telemetry.counter_get("executor.cache_hits") == 2
+
+    def test_recompile_cause_fetch_names(self, scope):
+        main, startup, loss = _small_program()
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        x = np.ones((4, 4), np.float32)
+        exe.run(main, feed={"x": x}, fetch_list=[loss], scope=scope)
+        exe.run(main, feed={"x": x}, fetch_list=[], scope=scope)
+        assert telemetry.counter_get("executor.compiles") == 2
+        assert telemetry.counter_get("executor.cache_misses") == 2
+
+    def test_recompile_cause_dp_divisibility(self, scope, tmp_path):
+        """Acceptance: a forced feed-shape change (batch no longer divides
+        the dp axis) yields a recompile event naming the changed key
+        component."""
+        from paddle_tpu.parallel import create_mesh
+
+        create_mesh({"dp": 2})
+        log = tmp_path / "run.jsonl"
+        telemetry.configure(str(log))
+        main, startup, loss = _small_program()
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        exe.run(main, feed={"x": np.ones((4, 4), np.float32)},
+                fetch_list=[loss], scope=scope)
+        exe.run(main, feed={"x": np.ones((3, 4), np.float32)},
+                fetch_list=[loss], scope=scope)
+        compiles = [r for r in _read(log) if r["kind"] == "compile"]
+        assert len(compiles) == 2
+        assert compiles[1]["attrs"]["cause"] == "dp_divisibility"
+
+    def test_recompile_cause_helper(self):
+        from paddle_tpu.core.executor import _recompile_cause
+
+        assert _recompile_cause((1,) * 7, []) == "first_compile"
+        base = (1, 0, 2, ("x",), ("loss",), None, ())
+        assert _recompile_cause(
+            (1, 0, 2, ("x", "y"), ("loss",), None, ()), [base]) \
+            == "feed_names"
+        assert _recompile_cause(
+            (1, 3, 2, ("x",), ("loss",), None, ()), [base]) \
+            == "program_version"
+        # nearest entry wins: a key differing in one component is a closer
+        # match than one differing everywhere
+        far = (9, 9, 9, ("z",), ("w",), "m", (("a", 1),))
+        assert _recompile_cause(
+            (1, 0, 2, ("x",), ("acc",), None, ()), [far, base]) \
+            == "fetch_names"
+
+
+class TestRunAccounting:
+    def test_path_routing_and_bytes(self, scope):
+        main, startup, loss = _small_program()
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        x = np.ones((4, 4), np.float32)
+        exe.run(main, feed={"x": x}, fetch_list=[loss], scope=scope,
+                use_compiled=False)
+        exe.run(main, feed={"x": x}, fetch_list=[loss], scope=scope)
+        c = telemetry.counters()
+        # startup + one interpreted run
+        assert c["executor.runs_interpreted"] == 2
+        assert c["executor.runs_compiled"] == 1
+        # two runs fed x (4x4 f32) from host numpy
+        assert c["executor.feed_host_bytes"] == 2 * x.nbytes
+        # scalar loss fetched twice as float32
+        assert c["executor.fetch_host_bytes"] == 8
+
+    def test_donation_copy_counter(self, scope):
+        """Two persistable names aliasing ONE device buffer force the
+        donation-aliasing jnp.copy fallback — it must be counted."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [4], stop_gradient=True)
+            h = layers.fc(x, 8, act="relu")
+            y = layers.fc(h, 8)
+            loss = layers.mean(y)
+            pt.optimizer.SGDOptimizer(0.1).minimize(loss)
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        # alias the two (8,)-shaped biases to the same array object
+        biases = [n for n, v in scope.items()
+                  if np.shape(v) == (8,) and main.global_block().has_var(n)
+                  and main.global_block().var(n).persistable]
+        assert len(biases) >= 2, biases
+        scope.set(biases[1], scope.find_var(biases[0]))
+        exe.run(main, feed={"x": np.ones((4, 4), np.float32)},
+                fetch_list=[loss], scope=scope)
+        assert telemetry.counter_get("executor.donation_copies") >= 1
+
+
+class TestSink:
+    def test_env_var_enables_sink(self, tmp_path, monkeypatch):
+        path = tmp_path / "env.jsonl"
+        monkeypatch.setenv("PT_TELEMETRY_LOG", str(path))
+        assert telemetry.enabled()
+        telemetry.counter_add("sink_env_probe", 1)
+        recs = _read(path)
+        assert recs and recs[-1]["name"] == "sink_env_probe"
+
+    def test_flag_wins_over_env(self, tmp_path, monkeypatch):
+        env_path = tmp_path / "env.jsonl"
+        flag_path = tmp_path / "flag.jsonl"
+        monkeypatch.setenv("PT_TELEMETRY_LOG", str(env_path))
+        telemetry.configure(str(flag_path))
+        telemetry.counter_add("sink_flag_probe", 1)
+        assert flag_path.exists() and not env_path.exists()
+
+    def test_disabled_writes_nothing_but_counts(self, tmp_path):
+        telemetry.counter_add("mem_only", 2)
+        assert telemetry.counter_get("mem_only") == 2
+        assert not telemetry.enabled()
+
+    def test_flush_snapshot_and_profiler_summary(self, scope, tmp_path,
+                                                 capsys):
+        from paddle_tpu import profiler
+
+        log = tmp_path / "run.jsonl"
+        telemetry.configure(str(log))
+        telemetry.counter_add("flush_probe", 3)
+        profiler.start_profiler()
+        with profiler.RecordEvent("flush_span"):
+            pass
+        telemetry.flush()
+        profiler.stop_profiler()
+        capsys.readouterr()
+        recs = _read(log)
+        snaps = [r for r in recs if r["kind"] == "snapshot"]
+        assert snaps and snaps[-1]["attrs"]["counters"]["flush_probe"] == 3
+        prows = [r for r in recs if r["kind"] == "profiler_summary"]
+        assert any(r["name"] == "flush_span" for r in prows)
+
+    def test_timer_and_histogram_summary(self):
+        with telemetry.timer("t_probe"):
+            pass
+        for v in (1.0, 2.0, 3.0):
+            telemetry.observe("h_probe", v)
+        snap = telemetry.snapshot()
+        assert snap["hists"]["t_probe"]["count"] == 1
+        h = snap["hists"]["h_probe"]
+        assert h["count"] == 3 and h["min"] == 1.0 and h["max"] == 3.0
+        assert h["p50"] == 2.0
+
+
+class TestPerfReport:
+    def _make_log(self, scope, tmp_path):
+        log = tmp_path / "run.jsonl"
+        telemetry.configure(str(log))
+        main, startup, loss = _small_program()
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        x = np.ones((4, 4), np.float32)
+        for _ in range(3):
+            exe.run(main, feed={"x": x}, fetch_list=[loss], scope=scope)
+        exe.run(main, feed={"x": x}, fetch_list=[], scope=scope)
+        telemetry.flush()
+        telemetry.configure(None)
+        return log
+
+    def test_cli_renders(self, scope, tmp_path):
+        """Acceptance: `python tools/perf_report.py <log>` renders without
+        error (stdlib-only — no jax import, so the subprocess is cheap)."""
+        log = self._make_log(scope, tmp_path)
+        r = subprocess.run(
+            [sys.executable, os.path.join("tools", "perf_report.py"),
+             str(log)],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
+        assert r.returncode == 0, r.stderr
+        assert "compile events: 2" in r.stdout
+        assert "first_compile" in r.stdout
+        assert "fetch_names" in r.stdout
+        assert "executor.run_ms" in r.stdout
+        assert "executor.cache_hits" in r.stdout
+
+    def test_summarize_log_structure(self, scope, tmp_path):
+        sys.path.insert(0, REPO_ROOT)
+        try:
+            from tools.perf_report import load, summarize_log
+        finally:
+            sys.path.remove(REPO_ROOT)
+        s = summarize_log(load(str(self._make_log(scope, tmp_path))))
+        assert len(s["compiles"]) == 2
+        assert s["compiles"][1]["cause"] == "fetch_names"
+        assert s["counters"]["executor.cache_hits"]["last"] == 2
+        assert s["timers"]["executor.run_ms"]["count"] == 2
+        assert s["records"] > 0 and s["span_s"] >= 0
+
+    def test_malformed_lines_skipped(self, tmp_path):
+        sys.path.insert(0, REPO_ROOT)
+        try:
+            from tools.perf_report import load
+        finally:
+            sys.path.remove(REPO_ROOT)
+        p = tmp_path / "bad.jsonl"
+        p.write_text('{"ts": 1, "kind": "counter", "name": "a", '
+                     '"value": 1, "attrs": {}}\n{torn line\n')
+        assert len(load(str(p))) == 1
+
+
+class TestStatRegistryAbsorbed:
+    def test_thin_aliases_over_telemetry(self):
+        from paddle_tpu.core.monitor import StatRegistry, stat_add, stat_get
+
+        stat_add("alias_probe", 3)
+        stat_add("alias_probe", 4)
+        assert stat_get("alias_probe") == 7
+        # the backing store IS the telemetry registry
+        assert telemetry.counter_get("alias_probe") == 7
+        assert StatRegistry.instance().stats()["alias_probe"] == 7
+
+    def test_set_and_get(self):
+        from paddle_tpu.core.monitor import StatRegistry
+
+        reg = StatRegistry.instance()
+        reg.set("set_probe", 42)
+        assert reg.get("set_probe") == 42
+
+
+class TestProfilerRingBuffer:
+    def test_bounded_and_drops_counted(self, capsys):
+        from paddle_tpu import profiler
+
+        pt.set_flags({"FLAGS_profiler_max_events": 10})
+        try:
+            profiler.start_profiler()
+            for i in range(25):
+                with profiler.RecordEvent(f"ev{i}"):
+                    pass
+            evs = profiler.events()
+            assert len(evs) == 10
+            # ring semantics: newest retained, oldest dropped
+            assert evs[-1]["name"] == "ev24"
+            assert evs[0]["name"] == "ev15"
+            assert telemetry.counter_get("profiler.events_dropped") == 15
+        finally:
+            profiler.stop_profiler()
+            capsys.readouterr()
+            pt.set_flags({"FLAGS_profiler_max_events": 1_000_000})
+
+
+class TestRPCTelemetry:
+    def test_rpc_call_accounting(self):
+        from paddle_tpu.distributed.ps.rpc import RPCClient, RPCServer
+
+        srv = RPCServer("127.0.0.1:0", lambda m, n, a, aux: (a, aux))
+        cli = None
+        try:
+            cli = RPCClient(srv.endpoint)
+            arr = np.ones(4, np.float32)
+            out, aux = cli.call("echo", "x", arr, 7)
+            assert aux == 7 and np.array_equal(out, arr)
+            assert telemetry.counter_get("ps.rpc_calls") == 1
+            assert telemetry.counter_get("ps.rpc_send_bytes") == arr.nbytes
+            assert telemetry.counter_get("ps.rpc_recv_bytes") == arr.nbytes
+            assert telemetry.snapshot()["hists"]["ps.rpc_ms"]["count"] == 1
+        finally:
+            if cli is not None:
+                cli.stop_server()
+            srv.shutdown()
+
+
+class TestHapiTelemetry:
+    def test_telemetry_logger_callback(self, tmp_path):
+        from paddle_tpu.hapi.callbacks import TelemetryLogger
+
+        log = tmp_path / "run.jsonl"
+        telemetry.configure(str(log))
+        cb = TelemetryLogger()
+        cb.on_epoch_begin(0)
+        cb.on_train_batch_begin(0)
+        cb.on_train_batch_end(0, {"loss": 0.25})
+        cb.on_eval_end({"eval_loss": 0.5})
+        recs = _read(log)
+        steps = [r for r in recs if r["kind"] == "step"]
+        assert [s["name"] for s in steps] == ["train", "eval"]
+        assert steps[0]["attrs"]["loss"] == 0.25
+        assert steps[0]["value"] == 0.25
+        assert "steps_per_s" in steps[0]["attrs"]
+        assert steps[1]["value"] == 0.5
+        assert telemetry.counter_get("hapi.train_steps") == 1
+        assert telemetry.snapshot()["hists"]["hapi.step_ms"]["count"] == 1
+
+    def test_fit_attaches_logger_when_enabled(self, tmp_path):
+        from paddle_tpu.hapi.callbacks import TelemetryLogger
+        from paddle_tpu.hapi.model import Model
+
+        telemetry.configure(str(tmp_path / "run.jsonl"))
+        assert telemetry.enabled()
+        # the wiring point fit() uses, without training a model here
+        import inspect
+
+        src = inspect.getsource(Model.fit)
+        assert "TelemetryLogger" in src
+
+
+class TestBenchEmbedding:
+    def test_bench_extra_keys(self, scope):
+        main, startup, loss = _small_program()
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        x = np.ones((4, 4), np.float32)
+        exe.run(main, feed={"x": x}, fetch_list=[loss], scope=scope)
+        exe.run(main, feed={"x": x}, fetch_list=[loss], scope=scope)
+        extra = telemetry.bench_extra()
+        assert extra["telemetry_compiles"] == 1
+        assert extra["telemetry_cache_hits"] == 1
+        assert extra["telemetry_donation_copies"] == 0
+
+    def test_bench_entrypoints_wired(self):
+        """bench.py and the bench_models CLI must merge bench_extra into
+        the BENCH json `extra`, so BENCH_r*.json carries the counters."""
+        bench_src = open(os.path.join(REPO_ROOT, "bench.py")).read()
+        assert "finalize_bench_result" in bench_src
+        models_src = open(os.path.join(
+            REPO_ROOT, "tools", "bench_models.py")).read()
+        assert "bench_extra" in models_src
+        assert "finalize_bench_result(WORKLOADS" in models_src
+
+    def test_finalize_bench_result(self, tmp_path):
+        sys.path.insert(0, REPO_ROOT)
+        try:
+            from tools.bench_models import finalize_bench_result
+        finally:
+            sys.path.remove(REPO_ROOT)
+        log = tmp_path / "run.jsonl"
+        telemetry.configure(str(log))
+        out = finalize_bench_result(
+            {"metric": "probe_tokens_per_sec", "value": 123.0,
+             "unit": "tokens/s", "vs_baseline": 1.0,
+             "extra": {"ms_per_step": 10.0, "mfu": 0.5}})
+        assert out["extra"]["telemetry_compiles"] == 0
+        assert "telemetry_cache_hits" in out["extra"]
+        recs = _read(log)
+        metrics = [r for r in recs if r["kind"] == "metric"]
+        assert metrics and metrics[0]["name"] == "probe_tokens_per_sec"
+        assert metrics[0]["attrs"]["mfu"] == 0.5

@@ -254,6 +254,28 @@ def bench_transformer_big(steps=15, batch=None, seq=256):
     }
 
 
+def finalize_bench_result(out):
+    """Attach telemetry accounting to a bench result and emit it as a
+    `metric` event: BENCH_r*.json rows carry the run's compile / cache-hit
+    / donation-copy counters in `extra`, and when a JSONL run log is
+    enabled (PT_TELEMETRY_LOG) the measured throughput/MFU lands in it."""
+    from paddle_tpu.core import telemetry
+
+    ex = out.setdefault("extra", {})
+    ex.update(telemetry.bench_extra())
+    attrs = {k: ex[k] for k in ("ms_per_step", "mfu", "batch", "seq_len")
+             if k in ex}
+    attrs["vs_baseline"] = out.get("vs_baseline")
+    attrs["unit"] = out.get("unit")
+    if "mfu" in ex:
+        telemetry.gauge_set("bench.mfu", ex["mfu"])
+    if "ms_per_step" in ex:
+        telemetry.gauge_set("bench.ms_per_step", ex["ms_per_step"])
+    telemetry.event("metric", out.get("metric", "bench"), out.get("value"),
+                    attrs)
+    return out
+
+
 WORKLOADS = {
     "mnist": bench_mnist,
     "ernie_large": bench_ernie_large,
@@ -276,7 +298,7 @@ def main():
         kw["steps"] = args.steps
     if args.batch:
         kw["batch"] = args.batch
-    out = WORKLOADS[args.workload](**kw)
+    out = finalize_bench_result(WORKLOADS[args.workload](**kw))
     print(json.dumps(out))
 
 

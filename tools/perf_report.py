@@ -1,0 +1,213 @@
+#!/usr/bin/env python
+"""perf_report — summarize a paddle_tpu JSONL telemetry run log.
+
+Renders the structured run log written by ``paddle_tpu.core.telemetry``
+(enable with ``PT_TELEMETRY_LOG=/path/run.jsonl`` or
+``FLAGS_telemetry_path``) back into tables:
+
+* step-time percentiles per timer (executor.run_ms, hapi.step_ms,
+  ps.rpc_ms, ...);
+* every compile event with its wall time and recompile CAUSE (which
+  cache-key component changed: program / program_version / feed_names /
+  fetch_names / mesh / dp_divisibility);
+* counter deltas over the log (compiles, cache hits, donation copies,
+  feed/fetch bytes, RPC traffic) and final gauges;
+* the profiler.summarize() host-span table when the log carries one
+  (telemetry.flush() embeds it at exit).
+
+Stdlib-only on purpose: a run log from a TPU worker renders on any
+machine, no jax/framework import.
+
+Usage:
+    python tools/perf_report.py run.jsonl            # tables
+    python tools/perf_report.py run.jsonl --json     # machine-readable
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+
+
+def load(path):
+    """Read a JSONL log, skipping malformed lines (a crashed run may leave
+    a torn final line — the report should still render)."""
+    recs = []
+    with open(path) as f:
+        for ln, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                print(f"perf_report: skipping malformed line {ln}",
+                      file=sys.stderr)
+                continue
+            if isinstance(rec, dict):
+                recs.append(rec)
+    return recs
+
+
+def _pct(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[i]
+
+
+def summarize_log(recs):
+    timers = defaultdict(list)
+    counter_delta = defaultdict(float)
+    counter_last = {}
+    gauges = {}
+    compiles = []
+    steps = []
+    metrics = []
+    profiler_rows = []
+    snapshot = None
+    ts = [r["ts"] for r in recs if isinstance(r.get("ts"), (int, float))]
+    for r in recs:
+        kind, name = r.get("kind"), r.get("name")
+        v, attrs = r.get("value"), r.get("attrs") or {}
+        if kind == "timer" and isinstance(v, (int, float)):
+            timers[name].append(float(v))
+        elif kind == "compile":
+            compiles.append({"ts": r.get("ts"), "ms": v,
+                             "cause": attrs.get("cause"),
+                             "cache_size": attrs.get("cache_size"),
+                             "feed_names": attrs.get("feed_names"),
+                             "fetch_names": attrs.get("fetch_names")})
+        elif kind == "counter":
+            if attrs.get("set"):
+                counter_last[name] = v
+            else:
+                try:
+                    counter_delta[name] += float(attrs.get("delta") or 0)
+                except (TypeError, ValueError):
+                    pass
+                counter_last[name] = v
+        elif kind == "gauge":
+            gauges[name] = v
+        elif kind == "step":
+            steps.append({"name": name, "value": v, **attrs})
+        elif kind == "metric":
+            metrics.append({"name": name, "value": v, **attrs})
+        elif kind == "profiler_summary":
+            profiler_rows.append({"name": name, "total_us": v, **attrs})
+        elif kind == "snapshot":
+            snapshot = attrs
+    # a final snapshot is authoritative for cumulative counter values
+    if snapshot:
+        for n, cv in (snapshot.get("counters") or {}).items():
+            counter_last[n] = cv
+        for n, gv in (snapshot.get("gauges") or {}).items():
+            gauges.setdefault(n, gv)
+    timer_summary = {}
+    for name, vals in timers.items():
+        s = sorted(vals)
+        timer_summary[name] = {
+            "count": len(s), "p50": round(_pct(s, 0.50), 3),
+            "p90": round(_pct(s, 0.90), 3), "p99": round(_pct(s, 0.99), 3),
+            "max": round(s[-1], 3),
+            "mean": round(sum(s) / len(s), 3)}
+    return {
+        "records": len(recs),
+        "span_s": round(max(ts) - min(ts), 3) if ts else 0.0,
+        "timers": timer_summary,
+        "compiles": compiles,
+        "counters": {n: {"delta": counter_delta.get(n, 0.0),
+                         "last": counter_last.get(n)}
+                     for n in sorted(set(counter_delta) | set(counter_last))},
+        "gauges": gauges,
+        "steps": steps,
+        "metrics": metrics,
+        "profiler": profiler_rows,
+    }
+
+
+def _fmt_num(v):
+    if isinstance(v, float):
+        return f"{v:,.3f}".rstrip("0").rstrip(".")
+    if isinstance(v, int):
+        return f"{v:,}"
+    return str(v)
+
+
+def render(s, out=sys.stdout):
+    w = out.write
+    w(f"== run log: {s['records']} records over {s['span_s']}s ==\n")
+
+    if s["timers"]:
+        w("\n-- step/latency timers (ms) --\n")
+        w(f"{'timer':<28}{'count':>8}{'p50':>10}{'p90':>10}"
+          f"{'p99':>10}{'max':>10}{'mean':>10}\n")
+        for name, t in sorted(s["timers"].items()):
+            w(f"{name[:27]:<28}{t['count']:>8}{t['p50']:>10}{t['p90']:>10}"
+              f"{t['p99']:>10}{t['max']:>10}{t['mean']:>10}\n")
+
+    w(f"\n-- compile events: {len(s['compiles'])} --\n")
+    if s["compiles"]:
+        t0 = s["compiles"][0].get("ts") or 0
+        w(f"{'+s':>8}  {'ms':>10}  {'cache':>5}  cause\n")
+        for c in s["compiles"]:
+            off = (c.get("ts") or t0) - t0
+            ms = c.get("ms")
+            w(f"{off:>8.2f}  {ms if ms is not None else '?':>10}  "
+              f"{c.get('cache_size') or '?':>5}  {c.get('cause')}\n")
+
+    if s["counters"]:
+        w("\n-- counters (delta over log / final) --\n")
+        for name, c in s["counters"].items():
+            w(f"{name[:40]:<42}{_fmt_num(c['delta']):>16}"
+              f"{_fmt_num(c['last']) if c['last'] is not None else '?':>18}\n")
+
+    if s["gauges"]:
+        w("\n-- gauges --\n")
+        for name, v in sorted(s["gauges"].items()):
+            w(f"{name[:40]:<42}{_fmt_num(v):>16}\n")
+
+    if s["metrics"]:
+        w("\n-- bench metrics --\n")
+        for m in s["metrics"]:
+            extras = {k: v for k, v in m.items()
+                      if k not in ("name", "value")}
+            w(f"{m['name']}: {_fmt_num(m['value'])} {extras}\n")
+
+    if s["steps"]:
+        last = s["steps"][-1]
+        w(f"\n-- train/eval steps: {len(s['steps'])} events "
+          f"(last: {last.get('name')} value={last.get('value')}) --\n")
+
+    if s["profiler"]:
+        w("\n-- profiler host spans (profiler.summarize) --\n")
+        w(f"{'event':<40}{'calls':>8}{'total_us':>14}{'avg_us':>12}"
+          f"{'max_us':>12}\n")
+        rows = sorted(s["profiler"],
+                      key=lambda r: -(r.get("total_us") or 0))
+        for r in rows:
+            w(f"{r['name'][:39]:<40}{r.get('calls', '?'):>8}"
+              f"{(r.get('total_us') or 0):>14.1f}"
+              f"{(r.get('avg_us') or 0):>12.1f}"
+              f"{(r.get('max_us') or 0):>12.1f}\n")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Summarize a paddle_tpu JSONL telemetry run log")
+    ap.add_argument("log", help="path to the JSONL run log")
+    ap.add_argument("--json", action="store_true",
+                    help="print the computed summary as JSON")
+    args = ap.parse_args(argv)
+    summary = summarize_log(load(args.log))
+    if args.json:
+        print(json.dumps(summary, indent=2, default=str))
+    else:
+        render(summary)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
