@@ -108,3 +108,38 @@ define_flag("profiler_max_events", 1_000_000,
             "runs overwrite the oldest spans instead of growing host "
             "memory without limit; drops are counted in the "
             "profiler.events_dropped telemetry counter")
+
+# -- fault tolerance (reference analogs: gRPC retry env knobs consumed by
+#    operators/distributed/grpc/grpc_client.cc, heart_beat_monitor.h) --------
+
+define_flag("fault_spec", "",
+            "deterministic fault-injection spec (core/faults.py grammar: "
+            "'site:trigger[:Exc]' clauses, e.g. 'ps.rpc.send:0.1'); the "
+            "PT_FAULT_SPEC env var is a lower-precedence alias; empty "
+            "disables injection")
+define_flag("fault_seed", 0,
+            "seed for probabilistic fault-injection rules (PT_FAULT_SEED "
+            "env alias when 0); the fire pattern is a pure function of "
+            "(seed, per-site call index)")
+define_flag("ps_rpc_timeout", 150.0,
+            "per-call deadline in seconds for PS RPCs — retries, backoff "
+            "and blocking reads all stop when it elapses and the call "
+            "raises RpcDeadlineError; must exceed "
+            "ps_sync_barrier_timeout so a legitimately-waiting sync recv "
+            "is not cut off; <= 0 disables the deadline")
+define_flag("ps_rpc_max_retries", 8,
+            "max reconnect-and-resend attempts per PS RPC before the "
+            "call raises RpcError (retries are deduplicated server-side "
+            "by sequence number, so a retried send_grad applies once)")
+define_flag("ps_rpc_backoff", 0.05,
+            "base seconds for exponential retry backoff (doubles per "
+            "attempt, +/-50% jitter, capped at 1s)")
+define_flag("ps_sync_barrier_timeout", 120.0,
+            "seconds a sync-mode recv_param waits for its version before "
+            "the pserver raises BarrierTimeoutError to the trainer")
+define_flag("ps_degrade_to_survivors", False,
+            "when the HeartBeatMonitor declares a trainer dead, shrink "
+            "the sync barrier to the live set (mean over survivors) "
+            "instead of stalling to the barrier timeout; a revived "
+            "trainer rejoins at the next version. Changes the effective "
+            "batch while degraded — opt-in")

@@ -23,7 +23,10 @@ kinds emitted by the framework: ``counter`` (value = new cumulative,
 attrs.delta = increment), ``gauge``, ``timer``/``hist`` (value = sample,
 ms for timers), ``compile`` (value = wall ms, attrs.cause = recompile
 cause), ``step`` (hapi per-step metrics), ``metric`` (bench results),
-``fallback`` (degraded-path latches), ``snapshot`` (full registry dump at
+``fallback`` (degraded-path latches), ``fault`` (one injected fault from
+the core/faults.py harness: name = site, value = per-site injection
+count, attrs.exc = raised type — pairs with the ``faults.injected``
+counter so chaos runs are auditable), ``snapshot`` (full registry dump at
 flush/exit), ``profiler_summary`` (one line per profiler.summarize row).
 
 In-memory aggregation (counters/gauges/histograms) is ALWAYS on — it is

@@ -4,6 +4,7 @@ fleet orchestration, collective user API, launch CLI, parallel env init —
 over jax.distributed + mesh sharding instead of NCCL/gRPC stacks.
 """
 
+from . import errors  # noqa: F401
 from . import fleet  # noqa: F401
 from .collective import (ReduceOp, all_gather, all_reduce, barrier,  # noqa: F401
                          broadcast, get_rank, get_world_size, reduce, scatter)

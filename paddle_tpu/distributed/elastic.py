@@ -23,11 +23,17 @@ from __future__ import annotations
 import logging
 from typing import Callable, Optional, Tuple
 
+from .errors import RpcError
+
 _LOG = logging.getLogger("paddle_tpu.elastic")
 
-# error types worth a restart (device resets, transient RPC failures);
-# programming errors (TypeError, ValueError, ...) re-raise immediately
-RECOVERABLE = (RuntimeError, ConnectionError, OSError, TimeoutError)
+# error types worth a restart: transport failures (RpcError covers
+# RpcDeadlineError/RpcRemoteError — retries exhausted, deadlines blown,
+# barrier stalls relayed from a pserver) and the OS-level network/device
+# errors underneath them. Plain RuntimeError is deliberately NOT here —
+# it swallowed programming errors; raise one of these (or subclass) from
+# custom step_fns that want a restart.
+RECOVERABLE = (RpcError, ConnectionError, OSError, TimeoutError)
 
 
 class ElasticRunner:
@@ -45,6 +51,20 @@ class ElasticRunner:
         self.mgr = CheckpointManager(ckpt_dir, max_to_keep=max_to_keep,
                                      save_interval_steps=save_interval_steps)
         self.restarts = 0
+
+    def _recoverable_exc(self, e: BaseException) -> bool:
+        """True if e — or anything on its explicit cause chain — is a
+        recoverable type. The interpreting executor wraps op failures in
+        ExecutionError `from` the original, so a transport RpcError
+        surfacing through a send/recv op still counts; a wrapped
+        TypeError still re-raises."""
+        seen = set()
+        while e is not None and id(e) not in seen:
+            if isinstance(e, self.recoverable):
+                return True
+            seen.add(id(e))
+            e = e.__cause__
+        return False
 
     def run(self, step_fn: Callable[[int], object], num_steps: int,
             on_restart: Optional[Callable[[int, BaseException], None]] = None):
@@ -71,7 +91,9 @@ class ElasticRunner:
         while step < num_steps:
             try:
                 result = step_fn(step)
-            except self.recoverable as e:
+            except Exception as e:
+                if not self._recoverable_exc(e):
+                    raise
                 self.restarts += 1
                 if self.restarts > self.max_restarts:
                     _LOG.error("elastic: step %d failed after %d restarts",

@@ -23,6 +23,13 @@ Wire format (rpc.py frames carry one tensor each):
             payload = int64 N | int64 ids [N] | f32 grads [N*D]
             aux = lr as 1e-9-fixed-point int
   kv_size:  name=<table>                           -> aux = #rows
+
+Fault tolerance rides the transport: RPCClient retries under the
+FLAGS_ps_rpc_timeout deadline, and because every frame carries a
+(client, seq) pair the server dedups a retried kv_push — a push whose
+reply was lost is NOT applied twice (pulls/size are idempotent anyway).
+A shard whose retries exhaust raises RpcError/RpcDeadlineError on the
+caller through _fanout, never silently dropping that shard's gradients.
 """
 
 from __future__ import annotations

@@ -16,6 +16,14 @@ Sync mode (reference SyncCommunicator / DistributeTranspiler sync_mode):
 Async mode (reference AsyncCommunicator, Downpour-style): every received
   grad applies immediately (scaled 1/trainers); recv returns the current
   value, no barriers.
+
+Fault tolerance: sync-mode recv waits are bounded by
+FLAGS_ps_sync_barrier_timeout (BarrierTimeoutError relayed to the
+trainer); with FLAGS_ps_degrade_to_survivors, a trainer the
+HeartBeatMonitor declares dead is dropped from the barrier — updates
+become the mean over survivors (ps.barrier_degraded telemetry) and a
+revived trainer is re-admitted at the next version. Checkpoint saves
+pass the `ps.checkpoint.save` fault-injection site first.
 """
 
 from __future__ import annotations
@@ -26,6 +34,9 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ...core import faults, telemetry
+from ...core import flags as _flags
+from ..errors import BarrierTimeoutError
 from .rpc import RPCServer
 
 
@@ -75,8 +86,12 @@ class HeartBeatMonitor:
     def ping(self, trainer_id: int):
         import time
 
-        self.last_seen[int(trainer_id)] = time.monotonic()
-        self.dead.discard(int(trainer_id))
+        tid = int(trainer_id)
+        self.last_seen[tid] = time.monotonic()
+        if tid in self.dead:
+            # re-admission: the next barrier requires this trainer again
+            self.dead.discard(tid)
+            telemetry.counter_add("ps.trainer_revived", 1, trainer=tid)
 
     def _watch(self):
         import logging
@@ -146,7 +161,8 @@ class PServer:
         if heartbeat_timeout > 0:
             self.monitor = HeartBeatMonitor(
                 num_trainers, timeout=heartbeat_timeout,
-                interval=min(heartbeat_timeout / 4, 5.0)).start()
+                interval=min(heartbeat_timeout / 4, 5.0),
+                on_dead=self._on_trainer_dead).start()
         # sparse KV tables served from THIS host's memory (reference:
         # large_scale_kv.h server tables; see kv_service.py)
         from .kv_service import KVTables
@@ -193,6 +209,60 @@ class PServer:
                 run_op(op, env, step=step)
             persist(self.grad_to_ops[grad_name])
 
+    # -- sync-barrier policy -------------------------------------------------
+    def _barrier_set(self, st: "ParamState") -> set:
+        """Trainer ids whose grads complete the current sync barrier.
+        Default: everyone. With FLAGS_ps_degrade_to_survivors and a
+        heartbeat monitor, the barrier shrinks to the LIVE set (anyone
+        whose grad already arrived counts as live regardless of the
+        monitor's view) — the update becomes the mean over survivors
+        instead of stalling to the barrier timeout."""
+        everyone = set(range(self.num_trainers))
+        if self.monitor is None or \
+                not _flags.flag("ps_degrade_to_survivors"):
+            return everyone
+        return (everyone - set(self.monitor.dead)) | set(st.pending)
+
+    def _maybe_apply_sync(self, grad_name: str, st: "ParamState"):
+        """Apply the mean grad + bump the version once every barrier
+        member contributed. Caller holds st.cond."""
+        need = self._barrier_set(st)
+        if not st.pending or not need <= set(st.pending):
+            return
+        if len(need) < self.num_trainers:
+            telemetry.counter_add("ps.barrier_degraded", 1,
+                                  grad=grad_name, survivors=len(need))
+        vals = list(st.pending.values())
+        mean = np.mean(vals, axis=0)
+        try:
+            self._apply(grad_name, mean.astype(vals[0].dtype))
+        finally:
+            # a failed apply must not leave this step's grads pending —
+            # the NEXT step's first send would complete the barrier with
+            # a stale mix
+            st.pending.clear()
+        st.version += 1
+        st.cond.notify_all()
+
+    def _on_trainer_dead(self, tid: int):
+        """HeartBeatMonitor callback: a trainer went silent. Under the
+        degradation policy, any barrier now satisfied by the survivors
+        alone completes immediately instead of waiting out the stall."""
+        import logging
+
+        logging.getLogger("paddle_tpu.ps").warning(
+            "trainer %d silent past %.1fs — marked DEAD%s", tid,
+            self.monitor.timeout,
+            " (degrading barriers to survivors)"
+            if _flags.flag("ps_degrade_to_survivors") else "")
+        telemetry.counter_add("ps.trainer_dead", 1, trainer=tid)
+        if not _flags.flag("ps_degrade_to_survivors"):
+            return
+        for grad_name, st in self.states.items():
+            with st.cond:
+                if self.sync_mode:
+                    self._maybe_apply_sync(grad_name, st)
+
     def _handle(self, method, name, arr, aux):
         # every contact is a liveness signal; recv_param's aux is a
         # version (not a trainer id), so sync-blocked trainers ping via
@@ -212,17 +282,7 @@ class PServer:
             with st.cond:
                 if self.sync_mode:
                     st.pending[aux] = arr     # aux = trainer_id
-                    if len(st.pending) == self.num_trainers:
-                        mean = np.mean(list(st.pending.values()), axis=0)
-                        try:
-                            self._apply(name, mean.astype(arr.dtype))
-                        finally:
-                            # a failed apply must not leave this step's
-                            # grads pending — the NEXT step's first send
-                            # would complete the barrier with a stale mix
-                            st.pending.clear()
-                        st.version += 1
-                        st.cond.notify_all()
+                    self._maybe_apply_sync(name, st)
                 elif self.mode == "half_async":
                     # buffer by arrival order (duplicates from one fast
                     # trainer merge too — reference HalfAsync's queue
@@ -249,18 +309,22 @@ class PServer:
             if grad_name is not None:
                 st = self.states[grad_name]
                 if self.sync_mode and aux > 0:
+                    timeout = _flags.flag("ps_sync_barrier_timeout")
                     with st.cond:
                         ok = st.cond.wait_for(lambda: st.version >= aux,
-                                              timeout=120)
+                                              timeout=timeout)
                     if not ok:
                         # surface the stalled barrier instead of silently
                         # serving a stale parameter (the RPC layer relays
                         # this to the trainer as an error status)
                         dead = (sorted(self.monitor.dead)
                                 if self.monitor else None)
-                        raise RuntimeError(
-                            f"sync barrier timed out: '{name}' at version "
-                            f"{st.version}, trainer expects >= {aux}"
+                        telemetry.counter_add("ps.barrier_timeouts", 1,
+                                              param=name)
+                        raise BarrierTimeoutError(
+                            f"sync barrier timed out after {timeout:.0f}s:"
+                            f" '{name}' at version {st.version}, trainer "
+                            f"expects >= {aux}"
                             + (f"; dead trainers: {dead}" if dead else ""))
                 ver = st.version
             val = self.scope.find_var(name)
@@ -290,6 +354,9 @@ class PServer:
         lock so the snapshot is a consistent cut."""
         import json
 
+        # fault site: a checkpoint that dies BEFORE writing must leave
+        # the previous snapshot intact (nothing is touched before here)
+        faults.maybe_fail("ps.checkpoint.save", dirname=dirname)
         os.makedirs(dirname, exist_ok=True)
         tag = tag or self._ckpt_tag()
         with self._apply_lock:
@@ -305,6 +372,7 @@ class PServer:
         with open(os.path.join(dirname, f"pserver_{tag}_meta.json"),
                   "w") as f:
             json.dump(meta, f)
+        telemetry.counter_add("ps.checkpoints", 1, tag=tag)
 
     def load_checkpoint(self, dirname: str, tag: str = None):
         import json

@@ -257,8 +257,8 @@ class DistributeTranspiler:
             ep = self.param_to_ep[p]
             dst.ops.append(OpDesc(
                 "recv", {}, {"Out": [p]},
-                {"endpoint": ep, "var_names": [p],
-                 "sync_mode": self.sync_mode, **role}))
+                {"endpoint": ep, "trainer_id": self.trainer_id,
+                 "var_names": [p], "sync_mode": self.sync_mode, **role}))
         for full, info in self._sliced.items():
             dst.ops.append(OpDesc(
                 "concat", {"X": list(info["p_blocks"])}, {"Out": [full]},

@@ -47,9 +47,11 @@ def send_op(ins, attrs):
     return {}
 
 
-# client-side per-(endpoint, param) last-seen version: sync recv waits for
-# last+1 (one update per training step); after a trainer restart the dict
-# resets to 0 and the wait degrades to "current version" — safe resume
+# client-side per-(endpoint, param, trainer) last-seen version: sync recv
+# waits for last+1 (one update per training step); after a trainer restart
+# the dict resets to 0 and the wait degrades to "current version" — safe
+# resume. Keyed by trainer_id so multiple in-process trainers (threads in
+# tests, chaos harnesses) track versions independently.
 _recv_versions = {}
 
 
@@ -65,7 +67,7 @@ def recv_op(ins, attrs):
     sync = bool(attrs.get("sync_mode", True))
     outs = []
     for name in attrs["var_names"]:
-        key = (attrs["endpoint"], name)
+        key = (attrs["endpoint"], name, int(attrs.get("trainer_id", 0)))
         want = _recv_versions.get(key, 0) + 1 if sync else 0
         val, ver = cli.call("recv_param", name, aux=want)
         _recv_versions[key] = ver

@@ -23,6 +23,17 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from tier-1 runs "
+                   "(-m 'not slow')")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection / failure-path tests driven by "
+                   "the core/faults.py harness (tools/chaos_check.py is "
+                   "the CLI twin). Tier-1-safe: localhost sockets, "
+                   "sub-second timeouts.")
+
+
 @pytest.fixture
 def scope():
     import paddle_tpu as pt

@@ -92,6 +92,7 @@ class TestElasticRunner:
         from paddle_tpu import layers
         from paddle_tpu.core import ir, unique_name
         from paddle_tpu.distributed.elastic import ElasticRunner
+        from paddle_tpu.distributed.errors import RpcError
 
         def build():
             ir._main_program, ir._startup_program = (ir.Program(),
@@ -120,7 +121,9 @@ class TestElasticRunner:
             def step_fn(step):
                 if inject_fail and step == 5 and not failed[0]:
                     failed[0] = True
-                    raise RuntimeError("injected device failure")
+                    # transport-typed: plain RuntimeError is no longer
+                    # recoverable (it swallowed programming errors)
+                    raise RpcError("injected transport failure")
                 out, = exe.run(main, feed=feed, fetch_list=[loss],
                                scope=scope)
                 return float(out)

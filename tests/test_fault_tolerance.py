@@ -1,0 +1,593 @@
+"""Fault-tolerance tests: seeded fault injection, the retrying PS
+transport (reconnect/backoff/deadline, exactly-once retries via
+sequence-number dedup), sync-barrier degradation to survivors, and
+pserver kill→restart→ElasticRunner resume.
+
+Reference analogs: heart_beat_monitor.h, the gRPC retry env knobs
+consumed by grpc_client.cc, checkpoint_notify recovery. All localhost
+sockets + sub-second injected timeouts — tier-1-safe chaos (`chaos`
+marker, tools/chaos_check.py is the CLI twin).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.chaos
+
+_FLAG_DEFAULTS = {
+    "FLAGS_fault_spec": "",
+    "FLAGS_fault_seed": 0,
+    "FLAGS_ps_rpc_timeout": 150.0,
+    "FLAGS_ps_rpc_max_retries": 8,
+    "FLAGS_ps_rpc_backoff": 0.05,
+    "FLAGS_ps_sync_barrier_timeout": 120.0,
+    "FLAGS_ps_degrade_to_survivors": False,
+}
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    import paddle_tpu as pt
+    from paddle_tpu.core import faults, telemetry
+    from paddle_tpu.distributed.ps.rpc import RPCClient
+    from paddle_tpu.ops.ps_ops import reset_recv_versions
+
+    def scrub():
+        for var in ("PT_FAULT_SPEC", "PT_FAULT_SEED"):
+            os.environ.pop(var, None)
+        pt.set_flags(_FLAG_DEFAULTS)
+        faults.reset()
+        telemetry.configure(None)
+        telemetry.reset()
+        RPCClient.reset_pool()
+        reset_recv_versions()
+
+    scrub()
+    yield
+    scrub()
+
+
+def _fresh():
+    from paddle_tpu.core import ir, unique_name
+
+    ir._main_program, ir._startup_program = ir.Program(), ir.Program()
+    unique_name.switch()
+
+
+def _build_net(in_dim=8, hidden=8, out_dim=2, lr=0.1):
+    """Deterministic 2-layer net; returns (main, startup, loss)."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    _fresh()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [in_dim], stop_gradient=True)
+        h = layers.fc(x, hidden, act="relu",
+                      param_attr=pt.ParamAttr(
+                          name="ft_w0",
+                          initializer=pt.initializer.Xavier(seed=11)),
+                      bias_attr=pt.ParamAttr(name="ft_b0"))
+        y = layers.fc(h, out_dim,
+                      param_attr=pt.ParamAttr(
+                          name="ft_w1",
+                          initializer=pt.initializer.Xavier(seed=12)),
+                      bias_attr=pt.ParamAttr(name="ft_b1"))
+        loss = layers.mean(y * y)
+        pt.optimizer.SGDOptimizer(lr).minimize(loss)
+    return main, startup, loss
+
+
+def _make_pserver(endpoint, trainers, main, startup, sync=True, **kw):
+    from paddle_tpu.distributed.ps import DistributeTranspiler, PServer
+
+    t = DistributeTranspiler()
+    t.transpile(0, program=main, startup_program=startup,
+                pservers=endpoint, trainers=trainers, sync_mode=sync)
+    prog, ps_startup = t.get_pserver_programs(endpoint)
+    server = PServer(endpoint, prog, ps_startup, num_trainers=trainers,
+                     sync_mode=sync, grad_to_param=prog._ps_grad_to_param,
+                     grad_to_ops=prog._ps_grad_to_ops,
+                     common_ops=prog._ps_common_ops, **kw)
+    return server, t
+
+
+def _free_endpoint():
+    """A concrete localhost endpoint the transpiler can pin params to
+    (trainer-program ops carry the endpoint STRING, so port-0 rebinding
+    would leave them pointing nowhere)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind(("127.0.0.1", 0))
+    ep = f"127.0.0.1:{s.getsockname()[1]}"
+    s.close()
+    return ep
+
+
+def _echo_server():
+    from paddle_tpu.distributed.ps.rpc import RPCServer
+
+    return RPCServer("127.0.0.1:0", lambda m, n, a, aux: (a, aux))
+
+
+class TestFaultSpec:
+    def test_seeded_probabilistic_pattern_reproduces(self):
+        """The fire pattern is a pure function of (seed, call index)."""
+        from paddle_tpu.core import faults
+
+        def pattern(seed):
+            faults.configure("t.site:0.3", seed=seed)
+            fired = []
+            for _ in range(200):
+                try:
+                    faults.maybe_fail("t.site")
+                    fired.append(False)
+                except ConnectionError:
+                    fired.append(True)
+            return fired
+
+        p_a, p_b, p_other = pattern(7), pattern(7), pattern(11)
+        assert p_a == p_b, "same seed must reproduce the fire pattern"
+        assert p_a != p_other, "different seed must change the pattern"
+        assert 20 < sum(p_a) < 120   # ~60 expected at p=0.3
+
+    def test_nth_and_every_triggers(self):
+        from paddle_tpu.core import faults
+
+        faults.configure("a:@3:RuntimeError,b:%4:OSError")
+        a_fired = []
+        for i in range(8):
+            try:
+                faults.maybe_fail("a")
+                a_fired.append(False)
+            except RuntimeError:
+                a_fired.append(True)
+        assert a_fired == [False, False, True] + [False] * 5, \
+            "@3 fires exactly once, on the 3rd call"
+        b_fired = []
+        for i in range(9):
+            try:
+                faults.maybe_fail("b")
+                b_fired.append(False)
+            except OSError:
+                b_fired.append(True)
+        assert [i + 1 for i, f in enumerate(b_fired) if f] == [4, 8]
+
+    def test_injection_emits_telemetry(self, tmp_path):
+        import json
+
+        from paddle_tpu.core import faults, telemetry
+
+        log = tmp_path / "run.jsonl"
+        telemetry.configure(str(log))
+        faults.configure("t.x:@1:ConnectionError")
+        with pytest.raises(ConnectionError, match="injected fault at t.x"):
+            faults.maybe_fail("t.x", method="send_grad")
+        assert telemetry.counter_get("faults.injected") == 1
+        recs = [json.loads(line) for line in open(log) if line.strip()]
+        inj = [r for r in recs if r["name"] == "faults.injected"]
+        assert inj and inj[0]["attrs"]["site"] == "t.x"
+        assert inj[0]["attrs"]["exc"] == "ConnectionError"
+        assert any(r["kind"] == "fault" for r in recs)
+
+    def test_malformed_specs_raise(self):
+        from paddle_tpu.core import faults
+        from paddle_tpu.core.faults import FaultSpecError
+
+        for bad in ("justasite", "s:2.0", "s:0", "s:@0", "s:%0",
+                    "s:0.1:NoSuchError", "s:0.1:extra:bits"):
+            with pytest.raises(FaultSpecError):
+                faults.configure(bad)
+            faults.configure(None)
+
+    def test_env_var_alias(self):
+        """PT_FAULT_SPEC / PT_FAULT_SEED drive the registry when the
+        flags are unset — the no-code-changes chaos knob."""
+        from paddle_tpu.core import faults
+
+        os.environ["PT_FAULT_SPEC"] = "env.site:@1:OSError"
+        faults.reset()
+        assert faults.active()
+        with pytest.raises(OSError):
+            faults.maybe_fail("env.site")
+        faults.maybe_fail("env.site")   # @1 is spent
+
+
+class TestRetryTransport:
+    def test_retry_until_success_under_send_faults(self):
+        import paddle_tpu as pt
+        from paddle_tpu.core import faults, telemetry
+        from paddle_tpu.distributed.ps.rpc import RPCClient
+
+        srv = _echo_server()
+        try:
+            pt.set_flags({"FLAGS_ps_rpc_backoff": 0.01})
+            faults.configure("ps.rpc.send:%2")   # every 2nd attempt dies
+            cli = RPCClient(srv.endpoint)
+            for i in range(6):
+                out, aux = cli.call("echo", "x",
+                                    np.full(3, i, np.float32), i)
+                assert aux == i and np.all(out == i)
+            assert telemetry.counter_get("ps.rpc_retries") >= 3
+        finally:
+            srv.shutdown()
+
+    def test_deadline_exceeded_raises_within_budget(self):
+        """A silent peer (accepts, never replies) must cost one deadline,
+        not hang: RpcDeadlineError (a TimeoutError) inside ~budget."""
+        import paddle_tpu as pt
+        from paddle_tpu.core import telemetry
+        from paddle_tpu.distributed.errors import RpcDeadlineError
+        from paddle_tpu.distributed.ps.rpc import RPCClient
+
+        lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        lst.bind(("127.0.0.1", 0))
+        lst.listen(4)
+        try:
+            pt.set_flags({"FLAGS_ps_rpc_timeout": 0.4,
+                          "FLAGS_ps_rpc_backoff": 0.01})
+            cli = RPCClient(f"127.0.0.1:{lst.getsockname()[1]}")
+            t0 = time.monotonic()
+            with pytest.raises(RpcDeadlineError):
+                cli.call("echo", "x")
+            assert time.monotonic() - t0 < 3.0
+            assert telemetry.counter_get("ps.rpc_deadline_exceeded") == 1
+            assert issubclass(RpcDeadlineError, TimeoutError)
+        finally:
+            lst.close()
+
+    def test_retries_exhausted_raises_rpc_error(self):
+        import paddle_tpu as pt
+        from paddle_tpu.core import telemetry
+        from paddle_tpu.distributed.errors import RpcError
+        from paddle_tpu.distributed.ps.rpc import RPCClient
+
+        probe = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        probe.bind(("127.0.0.1", 0))
+        dead_ep = f"127.0.0.1:{probe.getsockname()[1]}"
+        probe.close()   # nothing listens here now
+        pt.set_flags({"FLAGS_ps_rpc_max_retries": 2,
+                      "FLAGS_ps_rpc_backoff": 0.01})
+        with pytest.raises(RpcError, match="after 3 attempts"):
+            RPCClient(dead_ep).call("echo")
+        assert telemetry.counter_get("ps.rpc_retries") == 2
+
+    def test_pool_evicts_dead_client_and_reconnects(self):
+        """A pooled client whose server died must not stay a corpse: the
+        failed call evicts it, and once a server is back on the endpoint
+        the next get() talks to it."""
+        import paddle_tpu as pt
+        from paddle_tpu.core import telemetry
+        from paddle_tpu.distributed.errors import RpcError
+        from paddle_tpu.distributed.ps.rpc import RPCClient, RPCServer
+
+        srv = _echo_server()
+        ep = srv.endpoint
+        cli = RPCClient.get(ep)
+        _, aux = cli.call("echo", "x", None, 1)
+        assert aux == 1
+        srv.shutdown()
+        pt.set_flags({"FLAGS_ps_rpc_max_retries": 1,
+                      "FLAGS_ps_rpc_backoff": 0.01,
+                      "FLAGS_ps_rpc_timeout": 5.0})
+        with pytest.raises(RpcError):
+            cli.call("echo", "x", None, 2)
+        assert ep not in RPCClient._pool, "dead client must be evicted"
+
+        srv2 = RPCServer(ep, lambda m, n, a, aux: (a, aux))   # same port
+        try:
+            _, aux = RPCClient.get(ep).call("echo", "x", None, 3)
+            assert aux == 3
+            assert telemetry.counter_get("ps.rpc_calls") >= 2
+        finally:
+            srv2.shutdown()
+
+    def test_server_reaps_finished_connection_threads(self):
+        from paddle_tpu.distributed.ps.rpc import RPCClient
+
+        srv = _echo_server()
+        try:
+            for _ in range(40):
+                cli = RPCClient(srv.endpoint)
+                cli.call("echo")
+                cli._close()
+            time.sleep(0.2)   # let closed-conn threads notice and exit
+            # one extra live call keeps at most a few threads alive; the
+            # 40 finished ones must have been swept from the list
+            assert len(srv._threads) <= 32
+        finally:
+            srv.shutdown()
+            assert not any(t.is_alive() for t in srv._threads)
+
+
+class TestExactlyOnce:
+    def test_duplicate_send_grad_applies_once(self):
+        """Reply lost after the server applied the grad: the retry must
+        be answered from the dedup cache — version bumps once, the
+        param moves once."""
+        import paddle_tpu as pt
+        from paddle_tpu.core import faults, telemetry
+        from paddle_tpu.distributed.ps.rpc import RPCClient
+
+        main, startup, loss = _build_net()
+        server, _ = _make_pserver("127.0.0.1:0", 1, main, startup)
+        try:
+            (g,) = [g for g, p in server.grad_to_param.items()
+                    if p == "ft_w0"]
+            w0 = np.asarray(server.scope.find_var("ft_w0")).copy()
+            grad = np.ones_like(w0)
+            pt.set_flags({"FLAGS_ps_rpc_backoff": 0.01})
+            # the FIRST reply read dies AFTER the request reached the
+            # server — the classic duplicate-apply hazard
+            faults.configure("ps.rpc.recv:@1:ConnectionError")
+            cli = RPCClient(server.endpoint)
+            _, ver = cli.call("send_grad", g, grad, aux=0)
+            assert ver == 1, "version must bump exactly once"
+            assert server._apply_count[g] == 1
+            assert telemetry.counter_get("ps.rpc_dedup_hits") >= 1
+            np.testing.assert_allclose(
+                np.asarray(server.scope.find_var("ft_w0")),
+                w0 - 0.1 * grad, rtol=1e-6,
+                err_msg="grad applied more than once under retry")
+        finally:
+            server.shutdown()
+
+    def test_2trainer_chaos_run_matches_fault_free(self, tmp_path):
+        """Acceptance criterion: 10% connection drops on ps.rpc.send via
+        PT_FAULT_SPEC, 2-trainer sync run → final params IDENTICAL to
+        the fault-free run (exactly-once), ps.rpc_retries in the log."""
+        import json
+
+        import paddle_tpu as pt
+        from paddle_tpu.core import faults, telemetry
+        from paddle_tpu.distributed.ps.rpc import RPCClient
+
+        steps = 5
+
+        def run():
+            main, startup, loss = _build_net()
+            server, _ = _make_pserver("127.0.0.1:0", 2, main, startup)
+            shapes = {g: np.asarray(
+                server.scope.find_var(p)).shape
+                for g, p in server.grad_to_param.items()}
+            grads = sorted(shapes)
+            params = [server.grad_to_param[g] for g in grads]
+            errors = []
+
+            def trainer(tid):
+                try:
+                    cli = RPCClient(server.endpoint)
+                    for step in range(steps):
+                        for gi, g in enumerate(grads):
+                            rng = np.random.RandomState(
+                                10_000 + 97 * step + 13 * tid + gi)
+                            cli.call("send_grad", g,
+                                     rng.randn(*shapes[g]).astype(
+                                         np.float32) * 0.01, aux=tid)
+                        for p in params:
+                            cli.call("recv_param", p, aux=step + 1)
+                except Exception as e:   # surface on the main thread
+                    errors.append(e)
+
+            threads = [threading.Thread(target=trainer, args=(tid,))
+                       for tid in range(2)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            alive = [t for t in threads if t.is_alive()]
+            final = {p: np.asarray(server.scope.find_var(p)).copy()
+                     for p in params}
+            server.shutdown()
+            assert not errors, f"trainer failed: {errors[0]!r}"
+            assert not alive, "trainer thread deadlocked"
+            return final
+
+        pt.set_flags({"FLAGS_ps_rpc_backoff": 0.01,
+                      "FLAGS_ps_rpc_timeout": 30.0})
+        baseline = run()
+
+        log = tmp_path / "chaos.jsonl"
+        telemetry.configure(str(log))
+        os.environ["PT_FAULT_SPEC"] = "ps.rpc.send:0.1"
+        os.environ["PT_FAULT_SEED"] = "3"
+        faults.reset()
+        chaos = run()
+        faults.configure(None)
+
+        assert telemetry.counter_get("faults.injected") > 0, \
+            "the 10% spec never fired — chaos run proved nothing"
+        assert telemetry.counter_get("ps.rpc_retries") > 0
+        for p in baseline:
+            np.testing.assert_array_equal(
+                chaos[p], baseline[p],
+                err_msg=f"{p} diverged under injected faults — "
+                        f"retries were not exactly-once")
+        recs = [json.loads(line) for line in open(log) if line.strip()]
+        assert any(r["name"] == "ps.rpc_retries" for r in recs)
+        assert any(r["name"] == "faults.injected" for r in recs)
+
+
+class TestDegradedBarrier:
+    def test_sync_barrier_shrinks_to_survivors(self):
+        """A trainer that goes silent mid-run must not stall the other
+        to the barrier timeout: with FLAGS_ps_degrade_to_survivors the
+        monitor's death verdict completes the barrier over the live set,
+        and a revived trainer is required again at the next version."""
+        import paddle_tpu as pt
+        from paddle_tpu.core import telemetry
+        from paddle_tpu.distributed.ps.rpc import RPCClient
+
+        pt.set_flags({"FLAGS_ps_degrade_to_survivors": True})
+        main, startup, loss = _build_net()
+        server, _ = _make_pserver("127.0.0.1:0", 2, main, startup,
+                                  heartbeat_timeout=0.4)
+        try:
+            (g,) = [g for g, p in server.grad_to_param.items()
+                    if p == "ft_w0"]
+            st = server.states[g]
+            w0 = np.asarray(server.scope.find_var("ft_w0")).copy()
+            ones = np.ones_like(w0)
+            cli0, cli1 = (RPCClient(server.endpoint),
+                          RPCClient(server.endpoint))
+
+            # step 1: both trainers contribute — full barrier
+            cli0.call("send_grad", g, ones, aux=0)
+            cli1.call("send_grad", g, 3 * ones, aux=1)
+            assert st.version == 1
+            w1 = w0 - 0.1 * 2 * ones   # mean(1, 3) = 2
+            np.testing.assert_allclose(
+                np.asarray(server.scope.find_var("ft_w0")), w1, rtol=1e-6)
+
+            # step 2: trainer 1 goes silent; trainer 0 must not stall
+            cli0.call("send_grad", g, ones, aux=0)
+            deadline = time.monotonic() + 5.0
+            while st.version < 2 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert st.version == 2, \
+                "barrier never degraded to the survivor set"
+            np.testing.assert_allclose(
+                np.asarray(server.scope.find_var("ft_w0")),
+                w1 - 0.1 * ones, rtol=1e-6,
+                err_msg="degraded update must average survivors only")
+            assert telemetry.counter_get("ps.barrier_degraded") >= 1
+            assert telemetry.counter_get("ps.trainer_dead") >= 1
+            assert 1 in server.monitor.dead
+
+            # revival: trainer 1 pings back in and is required again
+            cli1.call("heartbeat", aux=1)
+            assert 1 not in server.monitor.dead
+            assert telemetry.counter_get("ps.trainer_revived") >= 1
+            cli0.call("send_grad", g, ones, aux=0)
+            cli1.call("send_grad", g, ones, aux=1)
+            assert st.version == 3, "revived trainer rejoins the barrier"
+        finally:
+            server.shutdown()
+
+
+class TestElasticPserverRestart:
+    def _feed(self, step):
+        rng = np.random.RandomState(700 + step)
+        return {"x": rng.randn(8, 8).astype(np.float32)}
+
+    def _baseline(self, steps):
+        import paddle_tpu as pt
+
+        main, startup, loss = _build_net()
+        server, t = _make_pserver(_free_endpoint(), 1, main, startup)
+        try:
+            exe = pt.Executor(pt.CPUPlace())
+            scope = pt.Scope()
+            exe.run(t.get_startup_program(), scope=scope,
+                    use_compiled=False)
+            prog = t.get_trainer_program()
+            out = []
+            for s in range(steps):
+                r = exe.run(prog, feed=self._feed(s), fetch_list=[loss],
+                            scope=scope, use_compiled=False)
+                out.append(float(np.asarray(r[0]).reshape(-1)[0]))
+            return out
+        finally:
+            server.shutdown()
+
+    def test_kill_restart_resumes_from_checkpoint(self, tmp_path):
+        """Acceptance criterion: the pserver dies mid-run; ElasticRunner
+        recognises the transport error, the operator hook restarts the
+        server from its snapshot, and training finishes — matching the
+        uninterrupted run step-for-step."""
+        import paddle_tpu as pt
+        from paddle_tpu.distributed.elastic import ElasticRunner
+        from paddle_tpu.distributed.ps import PServer
+        from paddle_tpu.distributed.ps.rpc import RPCClient
+        from paddle_tpu.ops.ps_ops import reset_recv_versions
+
+        steps = 6
+        base_losses = self._baseline(steps)
+
+        ep = _free_endpoint()   # a fixed endpoint the restart can rebind
+
+        main, startup, loss = _build_net()
+        from paddle_tpu.distributed.ps import DistributeTranspiler
+
+        t = DistributeTranspiler()
+        t.transpile(0, program=main, startup_program=startup,
+                    pservers=ep, trainers=1, sync_mode=True)
+        prog, ps_startup = t.get_pserver_programs(ep)
+
+        def start_server():
+            return PServer(ep, prog, ps_startup, num_trainers=1,
+                           sync_mode=True,
+                           grad_to_param=prog._ps_grad_to_param,
+                           grad_to_ops=prog._ps_grad_to_ops,
+                           common_ops=prog._ps_common_ops)
+
+        srv_ckpt = str(tmp_path / "srv")
+        server_holder = [start_server()]
+        pt.set_flags({"FLAGS_ps_rpc_timeout": 3.0,
+                      "FLAGS_ps_rpc_max_retries": 2,
+                      "FLAGS_ps_rpc_backoff": 0.02})
+        exe = pt.Executor(pt.CPUPlace())
+        scope = pt.Scope()
+        exe.run(t.get_startup_program(), scope=scope, use_compiled=False)
+        trainer_prog = t.get_trainer_program()
+        losses = {}
+        killed = [False]
+
+        def step_fn(step):
+            if step == 3 and not killed[0]:
+                killed[0] = True
+                server_holder[0].shutdown()   # the crash
+            r = exe.run(trainer_prog, feed=self._feed(step),
+                        fetch_list=[loss], scope=scope,
+                        use_compiled=False)
+            # coordinated snapshot: server state after this step's apply
+            RPCClient.get(ep).call("checkpoint", f"{srv_ckpt}|srv")
+            losses[step] = float(np.asarray(r[0]).reshape(-1)[0])
+            return losses[step]
+
+        def on_restart(step, exc):
+            fresh = start_server()
+            fresh.load_checkpoint(srv_ckpt, "srv")
+            server_holder[0] = fresh
+            RPCClient.reset_pool()
+            reset_recv_versions()
+
+        runner = ElasticRunner(str(tmp_path / "tr"), trainer_prog, scope,
+                               save_interval_steps=1, max_restarts=2)
+        try:
+            runner.run(step_fn, steps, on_restart=on_restart)
+        finally:
+            runner.mgr.close()
+            server_holder[0].shutdown()
+        assert killed[0] and runner.restarts == 1
+        got = [losses[s] for s in range(steps)]
+        np.testing.assert_allclose(
+            got, base_losses, rtol=1e-5,
+            err_msg="resume from checkpoint diverged from the "
+                    "uninterrupted run")
+
+
+class TestChaosCheckCLI:
+    def test_smoke(self):
+        """Tier-1 smoke of tools/chaos_check.py (satellite: CI/tooling)."""
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO_ROOT, "tools", "chaos_check.py"),
+             "--fault-spec", "ps.rpc.send:%5", "--seed", "3",
+             "--steps", "3", "--rpc-timeout", "10"],
+            capture_output=True, text=True, timeout=300,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert out.returncode == 0, \
+            f"chaos_check failed:\n{out.stdout[-3000:]}\n{out.stderr[-3000:]}"
+        assert "faults.injected" in out.stdout
+        assert "ps.rpc_retries" in out.stdout
+        assert "CHAOS OK" in out.stdout
