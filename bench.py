@@ -12,6 +12,12 @@ axon-relayed chip only a host transfer syncs, and each sync costs
 ~100 ms, so per-step fetches would overstate step time. Best of 3
 windows; the training state advances on-device between steps via buffer
 donation, so every step does real optimizer work.
+
+Pipelined mode: FLAGS_exec_steps_per_dispatch=k fuses k steps into one
+lax.scan dispatch (Executor.run_steps); the BENCH row records the
+configuration in extra.steps_per_dispatch and the dispatch-amortization
+counters (telemetry_fused_dispatches / telemetry_fused_steps) merged by
+finalize_bench_result.
 """
 
 from __future__ import annotations

@@ -37,7 +37,14 @@ class BuildStrategy:
 
 class ExecutionStrategy:
     """Reference: details/execution_strategy.h:22 — thread counts are moot
-    under one compiled XLA program; kept for API parity."""
+    under one compiled XLA program; kept for API parity.
+
+    ``num_iteration_per_drop_scope`` is meaningful again: the reference
+    used it to run N iterations before syncing/dropping local scopes;
+    here it maps onto K-step fused dispatch — ``Executor.
+    train_from_dataset`` over a CompiledProgram stacks that many batches
+    into one ``run_steps`` ``lax.scan`` dispatch (the global
+    ``FLAGS_exec_steps_per_dispatch`` flag takes precedence when set)."""
 
     def __init__(self):
         self.num_threads = 1

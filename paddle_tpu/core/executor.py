@@ -209,7 +209,35 @@ def _collect_collective_ops(ops, _seen=None) -> List[OpDesc]:
 # component names of the compile-cache key built in _run_compiled, in
 # key order — the recompile-cause diagnostic names these in events
 _KEY_COMPONENTS = ("program", "program_version", "scope", "feed_names",
-                   "fetch_names", "mesh", "dp_divisibility")
+                   "fetch_names", "mesh", "dp_divisibility",
+                   "steps_per_dispatch")
+
+
+def _assert_all_finite(named_vals, where: str):
+    """FLAGS_check_nan_inf verdict with ONE host sync: a fused per-var
+    jnp.isfinite all-reduce stays on device; only the [n_vars] bool
+    verdict vector crosses to the host (the old path np.asarray'd every
+    state var every step — a full device→host copy of the model).
+    """
+    import jax.numpy as jnp
+
+    names, fine = [], []
+    for name, v in named_vals:
+        if v is None:
+            continue
+        dt = getattr(v, "dtype", None)
+        if dt is None or not np.issubdtype(np.dtype(dt), np.floating):
+            continue
+        names.append(name)
+        fine.append(jnp.all(jnp.isfinite(jnp.asarray(v))))
+    if not names:
+        return
+    verdict = np.asarray(jnp.stack(fine))     # the single sync
+    if not verdict.all():
+        bad = [n for n, ok in zip(names, verdict) if not ok]
+        raise ExecutionError(
+            f"NaN/Inf detected in {bad} after executor {where} "
+            f"(FLAGS_check_nan_inf)")
 
 
 def _recompile_cause(key: tuple, cached_keys) -> str:
@@ -260,16 +288,12 @@ class Executor:
     def close(self):
         self._cache.clear()
 
-    # -- public API ----------------------------------------------------------
-    def run(self, program: Optional[Program] = None,
-            feed: Optional[Dict[str, Any]] = None,
-            fetch_list: Optional[Sequence[Union[str, Variable]]] = None,
-            scope: Optional[Scope] = None, return_numpy: bool = True,
-            use_compiled: bool = True, mesh: Optional[Any] = None):
+    def _unwrap_program(self, program, feed, mesh):
+        """Resolve (program, mesh, in_shardings): explicit mesh= arg >
+        CompiledProgram's mesh > global mesh (shared by run/run_steps)."""
         from .compiler import CompiledProgram  # local: avoid cycle
 
         in_shardings = None
-        # precedence: explicit mesh= arg > CompiledProgram's mesh > global mesh
         if isinstance(program, CompiledProgram):
             if mesh is None:
                 mesh = program._mesh
@@ -281,6 +305,32 @@ class Executor:
             mesh = get_mesh()
         if program is None:
             program = default_main_program()
+        return program, mesh, in_shardings
+
+    def _has_ps_io(self, program) -> bool:
+        """PS send/recv ops do host network IO — they force the
+        interpreting path and make K-step fusion illegal (answer cached
+        per program uid/version: no per-step op scan)."""
+        ps_key = (program.uid, program.version)
+        has_ps = self._ps_programs.get(ps_key)
+        if has_ps is None:
+            io_types = _host_callback_types()
+            # scan ALL blocks: a py_func inside a cond/while sub-block
+            # would otherwise reach the compiled path and crash on axon
+            has_ps = any(op.type in io_types
+                         for blk in program.blocks for op in blk.ops)
+            self._ps_programs[ps_key] = has_ps
+        return has_ps
+
+    # -- public API ----------------------------------------------------------
+    def run(self, program: Optional[Program] = None,
+            feed: Optional[Dict[str, Any]] = None,
+            fetch_list: Optional[Sequence[Union[str, Variable]]] = None,
+            scope: Optional[Scope] = None, return_numpy: bool = True,
+            use_compiled: bool = True, mesh: Optional[Any] = None,
+            sync_fetch: bool = True):
+        program, mesh, in_shardings = self._unwrap_program(program, feed,
+                                                           mesh)
         if scope is None:
             scope = global_scope()
         feed = dict(feed or {})
@@ -305,17 +355,7 @@ class Executor:
 
         # PS send/recv ops do host network IO — route to the interpreting
         # (op-by-op) path, the reference's executor model for PS workloads
-        # (answer cached per program uid/version: no per-step op scan)
-        ps_key = (program.uid, program.version)
-        has_ps = self._ps_programs.get(ps_key)
-        if has_ps is None:
-            io_types = _host_callback_types()
-            # scan ALL blocks: a py_func inside a cond/while sub-block
-            # would otherwise reach the compiled path and crash on axon
-            has_ps = any(op.type in io_types
-                         for blk in program.blocks for op in blk.ops)
-            self._ps_programs[ps_key] = has_ps
-        if use_compiled and has_ps:
+        if use_compiled and self._has_ps_io(program):
             use_compiled = False
             telemetry.counter_add("executor.ps_io_detours", 1,
                                   program=program.uid)
@@ -329,6 +369,17 @@ class Executor:
             with telemetry.timer("executor.interpret_ms"):
                 fetched = self._run_interpreted(program, block, feed,
                                                 fetch_names, scope, mesh)
+        return self._materialize_fetches(fetched, return_numpy, sync_fetch)
+
+    @staticmethod
+    def _materialize_fetches(fetched, return_numpy, sync_fetch):
+        """Host materialization policy for fetches. sync_fetch=False skips
+        the device→host transfer entirely and hands back device arrays
+        (XLA's async dispatch keeps running; callers materialize at their
+        own cadence — e.g. Model.fit's log_freq)."""
+        if not sync_fetch:
+            telemetry.counter_add("executor.async_fetches", 1)
+            return fetched
         if return_numpy:
             fetched = [np.asarray(v) for v in fetched]
             # device→host fetch traffic (the ~100 ms-sync direction on the
@@ -338,6 +389,96 @@ class Executor:
                 telemetry.counter_add("executor.fetch_host_bytes",
                                       int(fetch_bytes))
         return fetched
+
+    def run_steps(self, program: Optional[Program] = None,
+                  feed: Optional[Dict[str, Any]] = None,
+                  fetch_list: Optional[Sequence[Union[str, Variable]]] = None,
+                  k: Optional[int] = None, scope: Optional[Scope] = None,
+                  return_numpy: bool = True, sync_fetch: bool = True,
+                  mesh: Optional[Any] = None):
+        """K-step fused dispatch: one jitted ``lax.scan`` over the step
+        body runs ``k`` training steps in a single XLA execution.
+
+        ``feed`` is a STACKED pytree — every entry carries a leading
+        ``[k, ...]`` axis, slice ``[i]`` being step i's feed (the
+        reference amortizes per-step host overhead the same way with
+        py_reader double-buffering + num_iteration_per_drop_scope; here
+        the whole K-window is one device program, so Python dispatch,
+        feed device_put and fetch sync are paid once per window, not per
+        step). Fetches come back stacked ``[k, ...]``; training state is
+        donated across iterations and the step counter advances by k.
+
+        Bitwise-identical to k sequential ``run()`` calls. Programs with
+        PS-IO ops (send/recv/save/...) cannot fuse — they fall back to k
+        sequential runs (counted in executor.fused_fallback_steps).
+        """
+        program, mesh, in_shardings = self._unwrap_program(program, feed,
+                                                           mesh)
+        if scope is None:
+            scope = global_scope()
+        feed = dict(feed or {})
+        fetch_names = [f.name if isinstance(f, Variable) else str(f)
+                       for f in (fetch_list or [])]
+
+        # k: explicit, else inferred from the stacked feeds' leading dim
+        if k is None:
+            if not feed:
+                raise ExecutionError(
+                    "run_steps needs k= when there are no feeds to infer "
+                    "the step count from")
+            k = int(np.shape(next(iter(feed.values())))[0])
+        k = int(k)
+        if k < 1:
+            raise ExecutionError(f"run_steps: k must be >= 1, got {k}")
+        for name, v in feed.items():
+            shape = np.shape(v)
+            if len(shape) < 1 or shape[0] != k:
+                raise ExecutionError(
+                    f"run_steps: feed '{name}' must be stacked [k, ...] "
+                    f"with k={k}; got shape {shape} — stack per-step "
+                    f"batches along a new leading axis (np.stack)")
+
+        feed_host_bytes = sum(v.nbytes for v in feed.values()
+                              if isinstance(v, np.ndarray))
+        if feed_host_bytes:
+            telemetry.counter_add("executor.feed_host_bytes",
+                                  int(feed_host_bytes))
+
+        block = program.global_block()
+        # cast stacked feeds to declared per-step dtypes (the leading k
+        # axis does not change dtype)
+        for name in list(feed):
+            dtype = None
+            if block.has_var(name):
+                dtype = block.var(name).dtype
+            feed[name] = _as_device_array(feed[name], dtype)
+
+        # fusion is illegal across host-IO ops: fall back to k sequential
+        # single-step runs (still correct, no amortization)
+        if self._has_ps_io(program):
+            telemetry.counter_add("executor.fused_fallback_steps", k,
+                                  program=program.uid)
+            outs = []
+            for i in range(k):
+                outs.append(self.run(
+                    program, feed={n: v[i] for n, v in feed.items()},
+                    fetch_list=fetch_names, scope=scope,
+                    return_numpy=return_numpy, mesh=mesh,
+                    sync_fetch=sync_fetch))
+            if not fetch_names:
+                return []
+            stack = np.stack if (return_numpy and sync_fetch) else None
+            if stack is None:
+                import jax.numpy as jnp
+
+                stack = jnp.stack
+            return [stack([o[i] for o in outs])
+                    for i in range(len(fetch_names))]
+
+        telemetry.counter_add("executor.runs_compiled", 1)
+        fetched = self._run_compiled(program, block, feed, fetch_names,
+                                     scope, mesh, in_shardings, scan_k=k)
+        return self._materialize_fetches(fetched, return_numpy, sync_fetch)
 
     # -- interpreting path ---------------------------------------------------
     def _run_interpreted(self, program, block, feed, fetch_names, scope,
@@ -606,19 +747,22 @@ class Executor:
 
     # -- compiling path ------------------------------------------------------
     def _run_compiled(self, program, block, feed, fetch_names, scope, mesh=None,
-                      in_shardings=None):
+                      in_shardings=None, scan_k=None):
         import jax
 
         feed_names = tuple(sorted(feed))
         # default dp-sharding of a feed is only safe when its batch dim
-        # divides the dp axis; partial batches compile a replicated entry
+        # divides the dp axis; partial batches compile a replicated entry.
+        # Under K-step fusion the per-step batch dim sits BEHIND the
+        # stacked [k] axis (dim 1)
+        batch_dim = 1 if scan_k else 0
         dp = mesh.shape.get("dp") if mesh is not None else None
         dp_ok = {}
         if dp:
             for n in feed_names:
                 v = feed[n]
-                dp_ok[n] = bool(getattr(v, "ndim", 0) >= 1
-                                and v.shape[0] % dp == 0)
+                dp_ok[n] = bool(getattr(v, "ndim", 0) >= batch_dim + 1
+                                and v.shape[batch_dim] % dp == 0)
         from .. import profiler as _prof
 
         # mesh keyed by content (axes/topology), program/scope by uid —
@@ -628,7 +772,8 @@ class Executor:
             mesh_key = (tuple(mesh.axis_names), mesh.devices.shape,
                         tuple(d.id for d in mesh.devices.flat))
         key = (program.uid, program.version, scope.uid, feed_names,
-               tuple(fetch_names), mesh_key, tuple(sorted(dp_ok.items())))
+               tuple(fetch_names), mesh_key, tuple(sorted(dp_ok.items())),
+               scan_k)
         entry = self._cache.get(key)
         compile_cause = None
         t_compile = None
@@ -641,7 +786,8 @@ class Executor:
             t_compile = time.perf_counter()
             with _prof.RecordEvent("executor::compile"):
                 entry = self._compile(program, block, feed_names, fetch_names,
-                                      scope, mesh, in_shardings, dp_ok)
+                                      scope, mesh, in_shardings, dp_ok,
+                                      scan_k=scan_k)
             self._cache[key] = entry
         else:
             telemetry.counter_add("executor.cache_hits", 1)
@@ -698,6 +844,9 @@ class Executor:
         t_run = time.perf_counter()
         with _prof.RecordEvent("executor::run"):
             fetches, new_state, new_step = entry.jitted(state, ro, feed, step)
+        if scan_k:
+            telemetry.counter_add("executor.fused_dispatches", 1)
+            telemetry.counter_add("executor.fused_steps", scan_k)
         if compile_cause is not None:
             # jax.jit compiles lazily — the first execution carries the
             # trace + XLA compile, so compile wall time is measured through
@@ -714,33 +863,34 @@ class Executor:
                  "feed_names": list(feed_names),
                  "fetch_names": list(fetch_names),
                  "mesh": None if mesh_key is None else list(mesh_key[0]),
-                 "dp_divisibility": sorted(dp_ok.items())})
+                 "dp_divisibility": sorted(dp_ok.items()),
+                 "steps_per_dispatch": scan_k or 1})
         else:
             # host-side dispatch wall time (device dispatch is async —
-            # these are the step-time percentiles in the run log)
-            telemetry.observe("executor.run_ms",
-                              (time.perf_counter() - t_run) * 1e3,
-                              kind="timer")
+            # these are the step-time percentiles in the run log).
+            # Fused dispatches land in their own histogram: one sample
+            # covers scan_k device steps
+            telemetry.observe(
+                "executor.run_steps_ms" if scan_k else "executor.run_ms",
+                (time.perf_counter() - t_run) * 1e3, kind="timer")
         from .flags import flag as _flag
 
         if _flag("check_nan_inf"):
-            # host-side scan, forces device sync — debug flag semantics
-            # (reference: FLAGS_check_nan_inf, nan_inf_utils_detail.cc)
-            for name, v in list(new_state.items()) + \
-                    list(zip(entry.fetch_names, fetches)):
-                arr = np.asarray(v)
-                if np.issubdtype(arr.dtype, np.floating) and \
-                        not np.all(np.isfinite(arr)):
-                    raise ExecutionError(
-                        f"NaN/Inf detected in '{name}' after executor run "
-                        f"(FLAGS_check_nan_inf)")
+            # fused on-device isfinite reduction, one host sync of the
+            # verdict vector — debug flag semantics without a full state
+            # download (reference: FLAGS_check_nan_inf,
+            # nan_inf_utils_detail.cc)
+            _assert_all_finite(
+                list(new_state.items()) + list(zip(entry.fetch_names,
+                                                   fetches)),
+                "run_steps" if scan_k else "run")
         for n, v in new_state.items():
             scope.set(n, v)
         scope.set("@STEP_COUNTER@", new_step)
         return list(fetches)
 
     def _compile(self, program, block, feed_names, fetch_names, scope, mesh,
-                 in_shardings, dp_ok=None) -> _CompiledEntry:
+                 in_shardings, dp_ok=None, scan_k=None) -> _CompiledEntry:
         import jax
         import jax.numpy as jnp
 
@@ -778,7 +928,7 @@ class Executor:
                 f"(or pass mesh=) before running")
         use_spmd = mesh is not None and bool(coll_ops)
 
-        def fn(state, ro, feed, step):
+        def step_fn(state, ro, feed, step):
             env: Dict[str, Any] = {}
             env.update(ro)
             env.update(state)
@@ -804,10 +954,30 @@ class Executor:
             new_state = {n: env[n] for n in state_names}
             return tuple(fetches), new_state, step + 1
 
+        if scan_k is None:
+            fn = step_fn
+        else:
+            # K-step fusion: one lax.scan over the SAME traced step body —
+            # XLA sees a single program of k iterations (state threaded
+            # through the carry, per-step feed slices as scan xs, fetches
+            # stacked [k, ...] by scan). The reference's
+            # num_iteration_per_drop_scope/py_reader amortization, done as
+            # the JAX async-dispatch idiom.
+            def fn(state, ro, feeds, step):
+                def body(carry, feed_t):
+                    st, stp = carry
+                    fetches, new_st, new_stp = step_fn(st, ro, feed_t, stp)
+                    return (new_st, new_stp), fetches
+
+                (new_state, new_step), stacked = jax.lax.scan(
+                    body, (state, step), feeds, length=scan_k)
+                return stacked, new_state, new_step
+
         jit_kwargs: Dict[str, Any] = {"donate_argnums": (0,)}
         if use_spmd:
             fn = self._wrap_shard_map(fn, block, mesh, state_names, ro_names,
-                                      feed_names, dp_ok, in_shardings)
+                                      feed_names, dp_ok, in_shardings,
+                                      stacked_feeds=scan_k is not None)
         elif mesh is not None:
             # Shardings from VarDesc annotations (parallel/api.py): params use
             # their spec (default replicated), feeds default to batch-over-dp.
@@ -819,16 +989,23 @@ class Executor:
                     return named_sharding_for(block.var(name), mesh, default_spec)
                 return NamedSharding(mesh, P())
 
+            def shift(ns):
+                # stacked [k, ...] feeds: the per-step spec applies behind
+                # the (unsharded) leading k axis
+                return NamedSharding(mesh, P(None, *ns.spec)) \
+                    if scan_k is not None else ns
+
             state_sh = {n: var_sharding(n) for n in state_names}
             ro_sh = {n: var_sharding(n) for n in ro_names}
             feed_sh = {}
             for n in feed_names:
                 if in_shardings is not None and n in in_shardings:
-                    feed_sh[n] = in_shardings[n]
+                    feed_sh[n] = shift(in_shardings[n])
                 else:
                     feed_default = (("dp",) if "dp" in mesh.shape
                                     and (dp_ok or {}).get(n) else None)
-                    feed_sh[n] = var_sharding(n, default_spec=feed_default)
+                    feed_sh[n] = shift(var_sharding(
+                        n, default_spec=feed_default))
             step_sh = NamedSharding(mesh, P())
             jit_kwargs["in_shardings"] = (state_sh, ro_sh, feed_sh, step_sh)
             jit_kwargs["out_shardings"] = (None, state_sh, step_sh)
@@ -838,10 +1015,12 @@ class Executor:
 
     @staticmethod
     def _wrap_shard_map(fn, block, mesh, state_names, ro_names, feed_names,
-                        dp_ok, in_shardings=None):
+                        dp_ok, in_shardings=None, stacked_feeds=False):
         """Wrap the step in shard_map: params use their annotated specs
         (default replicated), feeds shard batch over dp when divisible.
-        CompiledProgram feed shardings (in_shardings) take precedence."""
+        CompiledProgram feed shardings (in_shardings) take precedence.
+        stacked_feeds (run_steps): feed specs apply behind the leading
+        [k] axis, which stays unsharded."""
         from jax.sharding import PartitionSpec as P
 
         from ..parallel.api import clean_spec, get_shard_map, get_sharding_spec
@@ -856,16 +1035,19 @@ class Executor:
                 return P()
             return P(*clean_spec(spec, mesh))
 
+        def shift(spec):
+            return P(None, *spec) if stacked_feeds else spec
+
         state_spec = {n: var_spec(n) for n in state_names}
         ro_spec = {n: var_spec(n) for n in ro_names}
         feed_spec = {}
         for n in feed_names:
             if in_shardings is not None and n in in_shardings:
-                feed_spec[n] = in_shardings[n].spec
+                feed_spec[n] = shift(in_shardings[n].spec)
                 continue
             default = ("dp",) if (dp_ok or {}).get(n) and "dp" in mesh.shape \
                 else None
-            feed_spec[n] = var_spec(n, default)
+            feed_spec[n] = shift(var_spec(n, default))
         in_specs = (state_spec, ro_spec, feed_spec, P())
         # fetches are pmean'd/all_gathered inside fn → replicated;
         # state stays on its spec
@@ -906,21 +1088,74 @@ class Executor:
         fetch_names = [f.name if isinstance(f, Variable) else str(f)
                        for f in (fetch_list or [])]
         fetch_info = fetch_info or fetch_names
+        from .flags import flag as _flag
+
+        # pipelined mode: stack k consecutive batches into one [k, ...]
+        # feed and dispatch a single fused lax.scan (run_steps) — the
+        # reference's num_iteration_per_drop_scope amortization. A
+        # CompiledProgram's ExecutionStrategy carries the same knob
+        k = max(1, int(_flag("exec_steps_per_dispatch")))
+        from .compiler import CompiledProgram
+
+        if k == 1 and isinstance(program, CompiledProgram):
+            k = max(1, int(getattr(program._exec_strategy,
+                                   "num_iteration_per_drop_scope", 1)))
         step = 0
         last = None
+
+        def run_pending(pending):
+            """Dispatch buffered batches: one fused run_steps when shapes
+            agree (uniform batches), sequential runs otherwise (the
+            ragged tail of an epoch)."""
+            nonlocal last, step
+            uniform = len(pending) > 1 and all(
+                {n: np.shape(v) for n, v in p.items()} ==
+                {n: np.shape(v) for n, v in pending[0].items()}
+                for p in pending[1:])
+            if uniform:
+                stacked = {n: np.stack([p[n] for p in pending])
+                           for n in pending[0]}
+                out = self.run_steps(program, feed=stacked,
+                                     fetch_list=fetch_names,
+                                     k=len(pending), scope=scope)
+                # per-step fetches for the debug cadence; `last` keeps
+                # the final step's values (fetch_handler contract)
+                for i in range(len(pending)):
+                    last = [v[i] for v in out]
+                    _debug_print(step)
+                    step += 1
+            else:
+                for p in pending:
+                    last = self.run(program, feed=p,
+                                    fetch_list=fetch_names, scope=scope)
+                    _debug_print(step)
+                    step += 1
+
+        def _debug_print(s):
+            if debug and fetch_names and s % max(print_period, 1) == 0:
+                msgs = ", ".join(f"{i}={np.asarray(v).reshape(-1)[0]:.6f}"
+                                 for i, v in zip(fetch_info, last))
+                print(f"[train_from_dataset] step {s}: {msgs}")
+
+        pending: List[Dict[str, Any]] = []
         for feed in dataset.iter_batches():
-            bad = [k for k, v in feed.items() if isinstance(v, tuple)]
+            bad = [kk for kk, v in feed.items() if isinstance(v, tuple)]
             if bad:
                 raise ExecutionError(
                     f"lod-tensor slots {bad} need a lod-aware program; dense "
                     f"training path expects fixed-shape slots")
-            last = self.run(program, feed=feed, fetch_list=fetch_names,
-                            scope=scope)
-            if debug and fetch_names and step % max(print_period, 1) == 0:
-                msgs = ", ".join(f"{i}={np.asarray(v).reshape(-1)[0]:.6f}"
-                                 for i, v in zip(fetch_info, last))
-                print(f"[train_from_dataset] step {step}: {msgs}")
-            step += 1
+            if k <= 1:
+                last = self.run(program, feed=feed, fetch_list=fetch_names,
+                                scope=scope)
+                _debug_print(step)
+                step += 1
+                continue
+            pending.append(feed)
+            if len(pending) == k:
+                run_pending(pending)
+                pending = []
+        if pending:
+            run_pending(pending)
         if step == 0:
             raise ExecutionError(
                 "dataset produced no batches — for InMemoryDataset call "

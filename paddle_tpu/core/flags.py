@@ -103,6 +103,17 @@ define_flag("telemetry_path", "",
             "telemetry.py); empty disables the sink. The PT_TELEMETRY_LOG "
             "env var is an alias with lower precedence. Render with "
             "tools/perf_report.py")
+define_flag("exec_steps_per_dispatch", 1,
+            "K-step fused execution: the static training loops "
+            "(Executor.train_from_dataset, tools/bench_models.py) stack K "
+            "consecutive batches into one [k, ...] feed and dispatch a "
+            "single jitted lax.scan via Executor.run_steps — one Python "
+            "dispatch, one feed transfer and one fetch sync per K device "
+            "steps (reference analog: ExecutionStrategy."
+            "num_iteration_per_drop_scope + py_reader double buffering). "
+            "Model.fit uses it as the host-sync cadence of the eager "
+            "loop. 1 disables fusion; programs with PS-IO ops fall back "
+            "to sequential steps")
 define_flag("profiler_max_events", 1_000_000,
             "ring-buffer bound on the profiler's host-span store — long "
             "runs overwrite the oldest spans instead of growing host "

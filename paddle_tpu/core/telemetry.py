@@ -323,10 +323,17 @@ def bench_extra() -> Dict[str, Any]:
     """Key counters for BENCH json `extra` — every BENCH_r*.json carries
     compile/cache/donation accounting from here on (bench.py merges it)."""
     c = counters()
-    return {"telemetry_compiles": int(c.get("executor.compiles", 0)),
-            "telemetry_cache_hits": int(c.get("executor.cache_hits", 0)),
-            "telemetry_donation_copies":
-                int(c.get("executor.donation_copies", 0))}
+    out = {"telemetry_compiles": int(c.get("executor.compiles", 0)),
+           "telemetry_cache_hits": int(c.get("executor.cache_hits", 0)),
+           "telemetry_donation_copies":
+               int(c.get("executor.donation_copies", 0))}
+    # dispatch-amortization accounting (K-step fused execution): how many
+    # device steps rode how many host dispatches
+    fused_d = int(c.get("executor.fused_dispatches", 0))
+    if fused_d:
+        out["telemetry_fused_dispatches"] = fused_d
+        out["telemetry_fused_steps"] = int(c.get("executor.fused_steps", 0))
+    return out
 
 
 atexit.register(flush)

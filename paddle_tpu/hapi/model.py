@@ -71,7 +71,14 @@ class Model:
     def _compute_loss(self, outputs, labels):
         return self._loss(*_as_list(outputs), *_as_list(labels))
 
-    def train_batch(self, inputs, labels=None):
+    def train_batch(self, inputs, labels=None, sync: bool = True):
+        """One optimizer step. sync=False skips the loss's device→host
+        round trip — the returned loss is a device array and the step's
+        dispatch stays async (XLA keeps computing while Python moves on);
+        materialization is deferred to whoever formats the value (the
+        callback layer at its log cadence). Metrics always accumulate on
+        host, so passing metrics forces a sync regardless.
+        """
         if self._loss is None or self._optimizer is None:
             raise RuntimeError("call prepare(optimizer, loss) before training")
         self.network.train()
@@ -86,8 +93,9 @@ class Model:
         for m in self._metrics:
             m.update(*_as_list(outputs), *lbls)
             metrics.append(m.accumulate())
-        return ([float(np.asarray(loss.numpy()).reshape(-1)[0])], metrics) \
-            if metrics else [float(np.asarray(loss.numpy()).reshape(-1)[0])]
+        loss_out = loss._array if not sync else \
+            float(np.asarray(loss.numpy()).reshape(-1)[0])
+        return ([loss_out], metrics) if metrics else [loss_out]
 
     def eval_batch(self, inputs, labels=None):
         self.network.eval()
@@ -133,6 +141,17 @@ class Model:
             verbose: int = 2, drop_last: bool = False, shuffle: bool = True,
             num_workers: int = 0, callbacks: Optional[List[Callback]] = None):
         loader = self._make_loader(train_data, batch_size, shuffle)
+        # async-dispatch cadence: the loss only crosses to the host on
+        # log steps (every log_freq batches) — per-batch float() syncs
+        # serialized the device pipeline. Metrics force a host sync every
+        # batch anyway, so they keep the synchronous path. With
+        # FLAGS_exec_steps_per_dispatch=k the sync cadence additionally
+        # aligns to k-step windows (the eager twin of run_steps fusion)
+        from ..core.flags import flag as _flag
+
+        k = max(1, int(_flag("exec_steps_per_dispatch")))
+        sync_every = max(1, int(log_freq or 1), k)
+        force_sync = bool(self._metrics)
         cbks = list(callbacks or [])
         if verbose and not any(isinstance(c, ProgBarLogger) for c in cbks):
             cbks.append(ProgBarLogger(log_freq, verbose=verbose))
@@ -144,7 +163,10 @@ class Model:
 
         if telemetry.enabled() and \
                 not any(isinstance(c, TelemetryLogger) for c in cbks):
-            cbks.append(TelemetryLogger())
+            # scalar JSONL step events only on sync steps — a per-step
+            # TelemetryLogger would float() the async losses back into
+            # per-batch syncs
+            cbks.append(TelemetryLogger(every=sync_every))
         steps = len(loader) if hasattr(loader, "__len__") else None
         cb = CallbackList(cbks, model=self,
                           params={"epochs": epochs, "steps": steps,
@@ -161,7 +183,9 @@ class Model:
                 for step, batch in enumerate(loader):
                     cb.on_train_batch_begin(step)
                     ins, lbls = self._split_batch(batch)
-                    result = self.train_batch(ins, lbls)
+                    result = self.train_batch(
+                        ins, lbls,
+                        sync=force_sync or step % sync_every == 0)
                     logs = self._result_logs(result)
                     cb.on_train_batch_end(step, logs)
                 cb.on_epoch_end(epoch, logs)

@@ -382,16 +382,56 @@ def default_collate_fn(batch_list):
 _END = object()
 
 
+def _prefetch_device_put(batch, mesh=None):
+    """device_put a prefetched batch with the active mesh's NamedSharding.
+
+    The double-buffer thread used to target the default device; under a
+    mesh the first pjit touch then re-laid the buffer out across devices
+    (an extra device-to-device copy on the critical path). Sharding the
+    batch dim over 'dp' here — exactly the compiled executor's default
+    feed sharding — makes the H2D copy land in final layout while the
+    previous step computes, so the jitted step sees ready buffers.
+    Arrays whose batch dim doesn't divide dp (ragged tails) replicate,
+    matching the executor's dp-divisibility fallback.
+    """
+    import jax
+
+    if mesh is None:
+        from .parallel.mesh import get_mesh
+
+        mesh = get_mesh()
+    if mesh is None:
+        return jax.tree.map(jax.device_put, batch)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .core import telemetry
+
+    dp = mesh.shape.get("dp")
+
+    def put(x):
+        spec = ()
+        if dp and getattr(x, "ndim", len(np.shape(x))) >= 1 \
+                and np.shape(x)[0] % dp == 0:
+            spec = ("dp",)
+        return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+
+    telemetry.counter_add("reader.sharded_device_puts", 1)
+    return jax.tree.map(put, batch)
+
+
 class _GeneratorLoader:
     """from_generator loader: queue-fed, iterable (reference:
-    fluid/reader.py GeneratorLoader)."""
+    fluid/reader.py GeneratorLoader). The prefetch thread device_puts
+    with the active mesh's sharding (see _prefetch_device_put)."""
 
     def __init__(self, feed_list=None, capacity: int = 16,
-                 return_list: bool = False, use_device_put: bool = True):
+                 return_list: bool = False, use_device_put: bool = True,
+                 mesh=None):
         self.feed_list = feed_list or []
         self.capacity = capacity
         self.return_list = return_list
         self.use_device_put = use_device_put
+        self.mesh = mesh
         self._gen: Optional[Callable] = None
         self._places = None
 
@@ -429,9 +469,7 @@ class _GeneratorLoader:
             try:
                 for b in self._gen():
                     if self.use_device_put:
-                        import jax
-
-                        b = jax.tree.map(jax.device_put, b)
+                        b = _prefetch_device_put(b, self.mesh)
                     q.put(b)
             except BaseException as e:
                 err.append(e)

@@ -58,21 +58,45 @@ def _time_steps(exe, prog, feed, loss_v, scope, *, steps, windows=3,
     tunnel, not the chip (real input pipelines overlap transfers).
     Both cache entries (with and without the loss fetch) are warmed so
     no compile lands inside a timed window.
+
+    FLAGS_exec_steps_per_dispatch=k > 1 switches the window to K-step
+    fused dispatches (Executor.run_steps, one lax.scan per k steps):
+    the window becomes n fused dispatches + one closing single-step loss
+    fetch, so the measured ms/step carries 1/k of the per-dispatch host
+    overhead — the pipelined-execution configuration BENCH rows record
+    via extra.steps_per_dispatch.
     """
     import jax.numpy as jnp
 
-    feed = {k: jnp.asarray(v) for k, v in feed.items()}
+    from paddle_tpu.core.flags import flag as _flag
+
+    k = max(1, int(_flag("exec_steps_per_dispatch")))
+    feed = {kk: jnp.asarray(v) for kk, v in feed.items()}
+    stacked = None
+    if k > 1:
+        stacked = {kk: jnp.stack([v] * k) for kk, v in feed.items()}
     for _ in range(warmup):
         exe.run(prog, feed=feed, fetch_list=[loss_v], scope=scope)
-        exe.run(prog, feed=feed, fetch_list=[], scope=scope)
+        if stacked is not None:
+            exe.run_steps(prog, feed=stacked, fetch_list=[], k=k,
+                          scope=scope)
+        else:
+            exe.run(prog, feed=feed, fetch_list=[], scope=scope)
     best = float("inf")
     loss = None
+    n_disp = max(1, (steps - 1) // k)
+    total = n_disp * k + 1 if k > 1 else steps
     for _ in range(windows):
         t0 = time.perf_counter()
-        for _ in range(steps - 1):
-            exe.run(prog, feed=feed, fetch_list=[], scope=scope)
+        if stacked is not None:
+            for _ in range(n_disp):
+                exe.run_steps(prog, feed=stacked, fetch_list=[], k=k,
+                              scope=scope)
+        else:
+            for _ in range(steps - 1):
+                exe.run(prog, feed=feed, fetch_list=[], scope=scope)
         out = exe.run(prog, feed=feed, fetch_list=[loss_v], scope=scope)
-        dt = (time.perf_counter() - t0) / steps
+        dt = (time.perf_counter() - t0) / total
         best = min(best, dt)
         loss = float(np.asarray(out[0]).reshape(-1)[0])
     return best * 1e3, loss
@@ -260,10 +284,15 @@ def finalize_bench_result(out):
     / donation-copy counters in `extra`, and when a JSONL run log is
     enabled (PT_TELEMETRY_LOG) the measured throughput/MFU lands in it."""
     from paddle_tpu.core import telemetry
+    from paddle_tpu.core.flags import flag as _flag
 
     ex = out.setdefault("extra", {})
     ex.update(telemetry.bench_extra())
-    attrs = {k: ex[k] for k in ("ms_per_step", "mfu", "batch", "seq_len")
+    # dispatch-amortization config of this run (K-step fused execution)
+    ex["steps_per_dispatch"] = max(
+        1, int(_flag("exec_steps_per_dispatch")))
+    attrs = {k: ex[k] for k in ("ms_per_step", "mfu", "batch", "seq_len",
+                                "steps_per_dispatch")
              if k in ex}
     attrs["vs_baseline"] = out.get("vs_baseline")
     attrs["unit"] = out.get("unit")

@@ -12,6 +12,10 @@ Renders the structured run log written by ``paddle_tpu.core.telemetry``
   fetch_names / mesh / dp_divisibility);
 * counter deltas over the log (compiles, cache hits, donation copies,
   feed/fetch bytes, RPC traffic) and final gauges;
+* a fused-dispatch section when the run used K-step pipelined execution
+  (Executor.run_steps / FLAGS_exec_steps_per_dispatch): dispatches,
+  steps per dispatch, per-dispatch ms percentiles, and the estimated
+  host-dispatch ms the fusion saved;
 * the profiler.summarize() host-span table when the log carries one
   (telemetry.flush() embeds it at exit).
 
@@ -113,7 +117,9 @@ def summarize_log(recs):
             "p90": round(_pct(s, 0.90), 3), "p99": round(_pct(s, 0.99), 3),
             "max": round(s[-1], 3),
             "mean": round(sum(s) / len(s), 3)}
+    fused = _fused_summary(counter_delta, counter_last, timer_summary)
     return {
+        "fused": fused,
         "records": len(recs),
         "span_s": round(max(ts) - min(ts), 3) if ts else 0.0,
         "timers": timer_summary,
@@ -126,6 +132,41 @@ def summarize_log(recs):
         "metrics": metrics,
         "profiler": profiler_rows,
     }
+
+
+def _fused_summary(counter_delta, counter_last, timer_summary):
+    """K-step fused-dispatch accounting (executor.run_steps): dispatches,
+    steps/dispatch, and the host-dispatch time fusion saved — estimated
+    as (fused_steps - fused_dispatches) * p50 single-dispatch host ms
+    (each fused step beyond the first would otherwise have paid one
+    host dispatch)."""
+
+    def cval(name):
+        v = counter_delta.get(name) or counter_last.get(name) or 0
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            return 0.0
+
+    dispatches = cval("executor.fused_dispatches")
+    steps = cval("executor.fused_steps")
+    if not dispatches:
+        return None
+    out = {"dispatches": int(dispatches), "fused_steps": int(steps),
+           "steps_per_dispatch": round(steps / dispatches, 2)}
+    rs = timer_summary.get("executor.run_steps_ms")
+    if rs:
+        out["dispatch_ms_p50"] = rs["p50"]
+        out["ms_per_fused_step_p50"] = round(
+            rs["p50"] / max(1.0, steps / dispatches), 3)
+    single = timer_summary.get("executor.run_ms")
+    if single and steps > dispatches:
+        out["host_dispatch_ms_saved"] = round(
+            (steps - dispatches) * single["p50"], 1)
+    fallback = cval("executor.fused_fallback_steps")
+    if fallback:
+        out["fallback_steps"] = int(fallback)
+    return out
 
 
 def _fmt_num(v):
@@ -157,6 +198,20 @@ def render(s, out=sys.stdout):
             ms = c.get("ms")
             w(f"{off:>8.2f}  {ms if ms is not None else '?':>10}  "
               f"{c.get('cache_size') or '?':>5}  {c.get('cause')}\n")
+
+    if s.get("fused"):
+        f = s["fused"]
+        w("\n-- fused dispatch (K-step pipelined execution) --\n")
+        w(f"dispatches: {f['dispatches']}  fused steps: {f['fused_steps']}"
+          f"  steps/dispatch: {f['steps_per_dispatch']}\n")
+        if "dispatch_ms_p50" in f:
+            w(f"p50 dispatch: {f['dispatch_ms_p50']} ms "
+              f"({f['ms_per_fused_step_p50']} ms/fused step)\n")
+        if "host_dispatch_ms_saved" in f:
+            w(f"host-dispatch ms saved vs single-step: "
+              f"~{_fmt_num(f['host_dispatch_ms_saved'])}\n")
+        if "fallback_steps" in f:
+            w(f"PS-IO fallback steps (ran unfused): {f['fallback_steps']}\n")
 
     if s["counters"]:
         w("\n-- counters (delta over log / final) --\n")
