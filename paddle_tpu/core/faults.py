@@ -4,9 +4,11 @@ The reference tests its PS failure paths with env-knob chaos (gRPC retry
 envs, heart_beat_monitor timeouts) but has no seeded, auditable way to
 MAKE a transport fail in a unit test. This module is that harness: a
 process-global registry of named injection sites (`ps.rpc.send`,
-`ps.rpc.recv`, `ps.handler`, `ps.checkpoint.save`, ...) consulted by the
-transport/pserver hot paths, driven by a spec string so chaos runs need
-no code changes:
+`ps.rpc.recv`, `ps.handler`, `ps.checkpoint.save`, `serving.handler` —
+the serving engine's batch loop, see paddle_tpu/serving/engine.py and
+tools/chaos_check.py --serving) consulted by the transport/pserver/
+serving hot paths, driven by a spec string so chaos runs need no code
+changes:
 
     FLAGS_fault_spec / PT_FAULT_SPEC =
         clause [ (','|';') clause ]*

@@ -114,6 +114,11 @@ define_flag("exec_steps_per_dispatch", 1,
             "Model.fit uses it as the host-sync cadence of the eager "
             "loop. 1 disables fusion; programs with PS-IO ops fall back "
             "to sequential steps")
+define_flag("predictor_cache_capacity", 32,
+            "LRU bound on AnalysisPredictor's per-shape jit cache — under "
+            "shape churn the oldest compiled entry is evicted instead of "
+            "growing host memory without limit (predictor.cache_evictions "
+            "counts drops); <= 0 disables the bound")
 define_flag("profiler_max_events", 1_000_000,
             "ring-buffer bound on the profiler's host-span store — long "
             "runs overwrite the oldest spans instead of growing host "
@@ -148,6 +153,33 @@ define_flag("ps_rpc_backoff", 0.05,
 define_flag("ps_sync_barrier_timeout", 120.0,
             "seconds a sync-mode recv_param waits for its version before "
             "the pserver raises BarrierTimeoutError to the trainer")
+# -- serving engine (paddle_tpu/serving/: dynamic micro-batching inference;
+#    reference analogs: TF-Serving BatchingParameters, Clipper adaptive
+#    batching) ----------------------------------------------------------------
+
+define_flag("serving_max_batch_size", 8,
+            "upper bound on coalesced rows per engine batch — requests "
+            "sharing a shape signature are merged up to this many rows "
+            "before dispatch (a single oversized request still runs, in "
+            "its own batch)")
+define_flag("serving_batch_timeout_ms", 5.0,
+            "how long the engine holds a partial batch open for more "
+            "same-signature rows before flushing it (measured from the "
+            "head request's enqueue); 0 dispatches immediately")
+define_flag("serving_max_queue_depth", 256,
+            "admission-control bound on queued requests — submits beyond "
+            "this raise ServerOverloadedError instead of stalling the "
+            "caller (serving.rejects counts them)")
+define_flag("serving_default_deadline_ms", 0.0,
+            "per-request deadline applied when the caller gives none: a "
+            "request still queued past its deadline is failed with "
+            "DeadlineExceededError at dequeue instead of wasting a batch "
+            "slot; <= 0 means no deadline")
+define_flag("serving_buckets", "",
+            "comma-separated leading-dim bucket boundaries the engine "
+            "pads coalesced batches up to (keeps the jit cache small and "
+            "warm); empty = powers of two up to serving_max_batch_size")
+
 define_flag("ps_degrade_to_survivors", False,
             "when the HeartBeatMonitor declares a trainer dead, shrink "
             "the sync barrier to the live set (mean over survivors) "

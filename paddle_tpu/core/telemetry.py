@@ -333,6 +333,17 @@ def bench_extra() -> Dict[str, Any]:
     if fused_d:
         out["telemetry_fused_dispatches"] = fused_d
         out["telemetry_fused_steps"] = int(c.get("executor.fused_steps", 0))
+    # serving-engine accounting (micro-batching runs: bench_serving)
+    sreq = int(c.get("serving.requests", 0))
+    if sreq:
+        out["telemetry_serving_requests"] = sreq
+        out["telemetry_serving_batches"] = int(c.get("serving.batches", 0))
+        out["telemetry_serving_rejects"] = int(c.get("serving.rejects", 0))
+        rows = int(c.get("serving.batched_rows", 0))
+        padded = int(c.get("serving.padded_rows", 0))
+        if rows:
+            out["telemetry_serving_batch_fill"] = round(
+                rows / (rows + padded), 4)
     return out
 
 

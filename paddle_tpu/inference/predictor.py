@@ -12,12 +12,16 @@ transfers the feeds.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Sequence
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from .. import io
-from ..core.executor import run_block
+from ..core import telemetry
+from ..core.executor import _as_device_array, run_block
+from ..core.flags import flag as _flag
 from ..core.ir import Program
 from ..core.passes import apply_passes
 from ..core.scope import Scope
@@ -98,8 +102,14 @@ class PredictorTensor:
 
     @property
     def shape(self):
-        v = self._owner._staged.get(self.name)
-        return None if v is None else v.shape
+        if self._is_input:
+            v = self._owner._staged.get(self.name)
+            return None if v is None else v.shape
+        out = self._owner._last_outputs
+        if out is None:
+            return None
+        v = out.get(self.name)
+        return None if v is None else tuple(v.shape)
 
 
 class AnalysisPredictor:
@@ -126,7 +136,10 @@ class AnalysisPredictor:
                                         scope=self.scope)
         self._staged: Dict[str, np.ndarray] = {}
         self._last_outputs: Optional[Dict[str, Any]] = None
-        self._cache: Dict[tuple, Any] = {}
+        # LRU over compiled entries: shape churn (ragged batches, variable
+        # seq lens) evicts the coldest signature instead of growing the
+        # jit cache without limit (FLAGS_predictor_cache_capacity)
+        self._cache: "OrderedDict[tuple, Any]" = OrderedDict()
         self._params = self._load_params_to_device()
 
     # -- internals ------------------------------------------------------------
@@ -138,23 +151,34 @@ class AnalysisPredictor:
             params[name] = jnp.asarray(val)
         return params
 
-    def _compiled(self, sig):
+    def _compiled(self, sig) -> Tuple[Any, bool]:
+        """Return (jitted entry, is_new) — mirrors the executor's
+        cache-accounting so perf_report shows predictor compiles too."""
         import jax
 
         entry = self._cache.get(sig)
-        if entry is None:
-            block = self.program.global_block()
-            fetch = tuple(self.fetch_names)
+        if entry is not None:
+            self._cache.move_to_end(sig)
+            telemetry.counter_add("predictor.cache_hits", 1)
+            return entry, False
+        telemetry.counter_add("predictor.cache_misses", 1)
+        block = self.program.global_block()
+        fetch = tuple(self.fetch_names)
 
-            def fn(params, feed):
-                env = dict(params)
-                env.update(feed)
-                run_block(block, env)
-                return tuple(env[n] for n in fetch)
+        def fn(params, feed):
+            env = dict(params)
+            env.update(feed)
+            run_block(block, env)
+            return tuple(env[n] for n in fetch)
 
-            entry = jax.jit(fn)
-            self._cache[sig] = entry
-        return entry
+        entry = jax.jit(fn)
+        self._cache[sig] = entry
+        cap = int(_flag("predictor_cache_capacity"))
+        while cap > 0 and len(self._cache) > cap:
+            self._cache.popitem(last=False)
+            telemetry.counter_add("predictor.cache_evictions", 1)
+        telemetry.gauge_set("predictor.cache_size", len(self._cache))
+        return entry, True
 
     # -- reference API surface ------------------------------------------------
     def get_input_names(self) -> List[str]:
@@ -177,10 +201,21 @@ class AnalysisPredictor:
 
     get_output_tensor = get_output_handle
 
+    def feed_specs(self) -> Dict[str, Tuple[tuple, str]]:
+        """{feed name: (static shape with -1 batch dims, dtype str)} —
+        the model's input signature (serving warmup + HTTP clients)."""
+        block = self.program.global_block()
+        specs = {}
+        for n in self.feed_names:
+            if block.has_var(n):
+                v = block.var(n)
+                specs[n] = (tuple(v.shape or ()), str(v.dtype))
+            else:
+                specs[n] = ((), "float32")
+        return specs
+
     def run(self, feeds: Optional[Dict[str, Any]] = None) -> List[np.ndarray]:
         """ZeroCopyRun (staged handles) or direct dict feed."""
-        import jax.numpy as jnp
-
         feed = dict(self._staged)
         if feeds:
             feed.update({k: np.asarray(v) for k, v in feeds.items()})
@@ -191,15 +226,24 @@ class AnalysisPredictor:
         block = self.program.global_block()
         for n in self.feed_names:
             v = feed[n]
-            dtype = None
-            if block.has_var(n):
-                dtype = block.var(n).dtype
-                if dtype == "int64":
-                    dtype = "int32"   # x64 disabled
-            dev_feed[n] = jnp.asarray(v, dtype=dtype)
+            dtype = block.var(n).dtype if block.has_var(n) else None
+            # x64-aware: 64-bit dtypes only narrow when jax x64 is off
+            dev_feed[n] = _as_device_array(v, dtype)
         sig = tuple((n, dev_feed[n].shape, str(dev_feed[n].dtype))
                     for n in self.feed_names)
-        outs = self._compiled(sig)(self._params, dev_feed)
+        entry, is_new = self._compiled(sig)
+        t0 = time.perf_counter() if is_new else None
+        outs = entry(self._params, dev_feed)
+        if is_new:
+            # like the executor, compile wall time is measured through the
+            # first (lazily-compiling) execution
+            ms = round((time.perf_counter() - t0) * 1e3, 3)
+            telemetry.counter_add("predictor.compiles", 1)
+            telemetry.event("compile", "predictor", ms,
+                            {"cause": "feed_signature",
+                             "cache_size": len(self._cache),
+                             "feed_names": [s[0] for s in sig],
+                             "fetch_names": list(self.fetch_names)})
         self._last_outputs = dict(zip(self.fetch_names, outs))
         return [np.asarray(o) for o in outs]
 

@@ -336,11 +336,23 @@ def save_inference_model(dirname: str, feeded_var_names: Sequence[str],
     inference_program = prune_program(program, feeded_var_names, fetch_names)
 
     os.makedirs(dirname, exist_ok=True)
+    # feed signature (static shapes with -1 batch dims + dtypes): lets
+    # serving front ends (paddle_tpu/serving/server.py, bench_serving)
+    # size warmup batches and coerce JSON inputs without rebuilding the
+    # program
+    feed_specs = {}
+    block = inference_program.global_block()
+    for n in feeded_var_names:
+        if block.has_var(n):
+            v = block.var(n)
+            feed_specs[n] = {"shape": list(v.shape or ()),
+                             "dtype": str(v.dtype)}
     doc = {
         "program": inference_program.to_dict(),
         "feed_names": list(feeded_var_names),
         "fetch_names": fetch_names,
-        "format_version": 1,
+        "feed_specs": feed_specs,
+        "format_version": 2,
     }
     with open(os.path.join(dirname, model_filename or _MODEL_FILE), "w") as f:
         json.dump(doc, f)
@@ -363,6 +375,26 @@ def load_inference_model(dirname: str, executor=None,
     load_vars(executor, dirname, program, predicate=is_persistable,
               filename=params_filename, scope=scope)
     return program, doc["feed_names"], doc["fetch_names"]
+
+
+def read_inference_model_meta(dirname: str,
+                              model_filename: Optional[str] = None) -> dict:
+    """Model signature WITHOUT loading program/params: {feed_names,
+    fetch_names, feed_specs, format_version}. format_version 1 models
+    (no persisted specs) return feed_specs read off the program vars."""
+    with open(os.path.join(dirname, model_filename or _MODEL_FILE)) as f:
+        doc = json.load(f)
+    specs = doc.get("feed_specs")
+    if specs is None:
+        program = Program.from_dict(doc["program"])
+        block = program.global_block()
+        specs = {n: {"shape": list(block.var(n).shape or ()),
+                     "dtype": str(block.var(n).dtype)}
+                 for n in doc["feed_names"] if block.has_var(n)}
+    return {"feed_names": list(doc["feed_names"]),
+            "fetch_names": list(doc["fetch_names"]),
+            "feed_specs": specs,
+            "format_version": doc.get("format_version", 1)}
 
 
 # -- paddle.io 2.0 dataset/loader namespace (reference: python/paddle/io)

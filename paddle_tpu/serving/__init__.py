@@ -1,0 +1,30 @@
+"""Serving — dynamic micro-batching inference behind admission control.
+
+The subsystem the reference keeps as AnalysisPredictor-plus-your-own-
+server, grown into a first-class layer (ROADMAP: "serving heavy traffic
+from millions of users"):
+
+* ``engine.ServingEngine`` — coalesces concurrent requests into padded,
+  shape-bucketed batches over one frozen AnalysisPredictor; responses
+  are bitwise-identical to unbatched runs;
+* ``admission`` — bounded queue, typed backpressure
+  (``ServerOverloadedError``), per-request deadlines, graceful drain;
+* ``server`` — stdlib HTTP JSON front end + in-process ``LocalClient``,
+  with every-bucket warmup.
+
+Load harness: tools/bench_serving.py. Chaos: the engine loop is a
+``serving.handler`` fault site (tools/chaos_check.py --serving).
+"""
+
+from .admission import (AdmissionQueue, DeadlineExceededError,
+                        EngineClosedError, InferenceRequest,
+                        ServerOverloadedError, ServingError)
+from .engine import ServingConfig, ServingEngine
+from .server import LocalClient, ServingHTTPServer, serve
+
+__all__ = [
+    "AdmissionQueue", "DeadlineExceededError", "EngineClosedError",
+    "InferenceRequest", "LocalClient", "ServerOverloadedError",
+    "ServingConfig", "ServingEngine", "ServingError",
+    "ServingHTTPServer", "serve",
+]

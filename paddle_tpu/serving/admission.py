@@ -1,0 +1,199 @@
+"""Admission control for the serving engine — bounded queue, deadlines,
+graceful drain.
+
+Production batching systems (TF-Serving's BatchScheduler, Clipper's
+request frontend) put a policy layer between the socket and the model:
+when the queue is full the right answer is a fast typed rejection the
+client can retry against a replica — not an unbounded stall that turns
+overload into latency collapse. This module is that layer:
+
+* ``AdmissionQueue.submit`` rejects with ``ServerOverloadedError`` once
+  ``max_depth`` requests are waiting (``serving.rejects`` counts them);
+* every request may carry a deadline — a request still queued past it is
+  failed with ``DeadlineExceededError`` at dequeue time instead of
+  wasting batch slots on an answer nobody is waiting for;
+* ``close(drain=True)`` stops admission and lets the engine loop finish
+  the backlog; ``drain=False`` fails the backlog with
+  ``EngineClosedError`` immediately.
+
+The queue is signature-aware on the *take* side: ``take_batch`` gathers
+FIFO-ordered requests that share the head request's shape signature so
+the engine can coalesce them into one padded device batch, holding the
+batch open up to ``timeout_ms`` past the head's enqueue for more rows.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..core import telemetry
+
+
+class ServingError(RuntimeError):
+    """Base of the serving engine's typed request failures."""
+
+
+class ServerOverloadedError(ServingError):
+    """Queue depth hit FLAGS_serving_max_queue_depth — retry later."""
+
+
+class DeadlineExceededError(ServingError):
+    """The request's deadline elapsed before it reached the model."""
+
+
+class EngineClosedError(ServingError):
+    """The engine is shut down (or draining) and takes no new work."""
+
+
+class InferenceRequest:
+    """One queued request: feeds + a future the caller blocks on."""
+
+    __slots__ = ("feeds", "rows", "deadline", "enqueue_t",
+                 "_event", "_result", "_error")
+
+    def __init__(self, feeds: Dict[str, Any], rows: int,
+                 deadline: Optional[float]):
+        self.feeds = feeds
+        self.rows = rows
+        self.deadline = deadline          # absolute time.monotonic() or None
+        self.enqueue_t = time.monotonic()
+        self._event = threading.Event()
+        self._result: Optional[List[Any]] = None
+        self._error: Optional[BaseException] = None
+
+    # -- producer side (engine loop) -----------------------------------------
+    def resolve(self, result: List[Any]):
+        self._result = result
+        self._event.set()
+
+    def fail(self, error: BaseException):
+        self._error = error
+        self._event.set()
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return (self.deadline is not None
+                and (now if now is not None else time.monotonic())
+                >= self.deadline)
+
+    # -- consumer side (client) ----------------------------------------------
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> List[Any]:
+        """Block for the response; raises the typed failure on error."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("inference request still pending after "
+                               f"{timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class AdmissionQueue:
+    """Bounded FIFO with deadline enforcement and drain semantics."""
+
+    def __init__(self, max_depth: int,
+                 default_deadline_ms: float = 0.0):
+        self.max_depth = int(max_depth)
+        self.default_deadline_ms = float(default_deadline_ms)
+        self._items: List[InferenceRequest] = []
+        self._cond = threading.Condition()
+        self._closed = False
+
+    # -- admission -----------------------------------------------------------
+    def submit(self, feeds: Dict[str, Any], rows: int,
+               deadline_ms: Optional[float] = None) -> InferenceRequest:
+        ms = self.default_deadline_ms if deadline_ms is None \
+            else float(deadline_ms)
+        deadline = time.monotonic() + ms / 1e3 if ms > 0 else None
+        with self._cond:
+            if self._closed:
+                raise EngineClosedError(
+                    "serving engine is shut down — no new requests")
+            if len(self._items) >= self.max_depth:
+                telemetry.counter_add("serving.rejects", 1)
+                raise ServerOverloadedError(
+                    f"serving queue full ({self.max_depth} requests "
+                    f"waiting) — retry later")
+            req = InferenceRequest(feeds, rows, deadline)
+            self._items.append(req)
+            depth = len(self._items)
+            self._cond.notify_all()
+        telemetry.counter_add("serving.requests", 1)
+        telemetry.gauge_set("serving.queue_depth", depth)
+        return req
+
+    # -- batch assembly ------------------------------------------------------
+    def take_batch(self, signature: Callable[[InferenceRequest], Any],
+                   max_rows: int, timeout_ms: float,
+                   ) -> Optional[Tuple[Any, List[InferenceRequest]]]:
+        """Gather one same-signature batch (FIFO head keys it), waiting up
+        to ``timeout_ms`` past the head's enqueue for the batch to fill.
+        Returns None only when closed AND drained (loop exit)."""
+        batch: List[InferenceRequest] = []
+        rows = 0
+        sig = None
+        flush_t = None
+        with self._cond:
+            while True:
+                now = time.monotonic()
+                # drop expired requests wherever they sit in the queue
+                for req in [r for r in self._items if r.expired(now)]:
+                    self._items.remove(req)
+                    telemetry.counter_add("serving.deadline_expired", 1)
+                    req.fail(DeadlineExceededError(
+                        "request deadline elapsed after "
+                        f"{(now - req.enqueue_t) * 1e3:.1f} ms in queue"))
+                # adopt the head's signature the moment work exists
+                if sig is None and self._items:
+                    head = self._items[0]
+                    sig = signature(head)
+                    flush_t = head.enqueue_t + max(0.0, timeout_ms) / 1e3
+                if sig is not None:
+                    for req in list(self._items):
+                        if rows >= max_rows:
+                            break
+                        if signature(req) != sig:
+                            continue
+                        if batch and rows + req.rows > max_rows:
+                            continue   # keep it for the next batch
+                        self._items.remove(req)
+                        batch.append(req)
+                        rows += req.rows
+                    if rows >= max_rows or now >= flush_t:
+                        break
+                if self._closed:
+                    if batch:
+                        break
+                    if not self._items:
+                        return None
+                    continue   # closed but other-signature work remains
+                wait_s = None if sig is None else max(0.0, flush_t - now)
+                self._cond.wait(wait_s)
+            depth = len(self._items)
+            self._cond.notify_all()
+        telemetry.gauge_set("serving.queue_depth", depth)
+        return sig, batch
+
+    # -- lifecycle -----------------------------------------------------------
+    def close(self, drain: bool = True):
+        with self._cond:
+            self._closed = True
+            if not drain:
+                for req in self._items:
+                    req.fail(EngineClosedError(
+                        "serving engine shut down before this request "
+                        "was served"))
+                self._items.clear()
+            self._cond.notify_all()
+
+    @property
+    def closed(self) -> bool:
+        with self._cond:
+            return self._closed
+
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._items)

@@ -1,0 +1,268 @@
+"""ServingEngine — dynamic micro-batching over a frozen inference program.
+
+The reference ships AnalysisPredictor as a one-caller-at-a-time engine;
+real serving (TF-Serving's batch scheduler, Clipper's adaptive batching)
+gets its throughput from coalescing concurrent requests into one device
+batch. This engine is that layer for paddle_tpu:
+
+* concurrent callers ``submit()`` requests into a bounded
+  ``AdmissionQueue`` (admission.py: backpressure + deadlines);
+* one worker thread pulls same-shape-signature requests, concatenates
+  their rows and PADS the batch up to a bucket boundary (powers of two
+  on the leading dim by default) so the predictor's jit cache holds one
+  entry per bucket — small and warm — instead of one per exact batch
+  size;
+* padded rows are sliced off before responses resolve, so every caller
+  sees output bitwise-identical to an unbatched
+  ``AnalysisPredictor.run`` of its own rows;
+* the handler is a ``serving.handler`` fault-injection site
+  (core/faults.py): an injected fault fails that batch's requests
+  individually and the loop keeps serving — never a wedged queue.
+
+Telemetry: serving.requests / batches / batched_rows / padded_rows /
+rejects / deadline_expired / handler_errors counters, serving.batch_fill
+histogram, serving.request_ms + serving.batch_ms timers,
+serving.queue_depth gauge — rendered by tools/perf_report.py's
+"Serving" section.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core import faults, telemetry
+from ..core.flags import flag as _flag
+from .admission import (AdmissionQueue, EngineClosedError, InferenceRequest,
+                        ServingError)
+
+
+def _pow2_buckets(max_batch: int) -> List[int]:
+    out, b = [], 1
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(max_batch)
+    return out
+
+
+class ServingConfig:
+    """Engine knobs; defaults come from the FLAGS_serving_* registry."""
+
+    def __init__(self, max_batch_size: Optional[int] = None,
+                 batch_timeout_ms: Optional[float] = None,
+                 max_queue_depth: Optional[int] = None,
+                 default_deadline_ms: Optional[float] = None,
+                 buckets: Optional[Sequence[int]] = None):
+        self.max_batch_size = int(
+            _flag("serving_max_batch_size") if max_batch_size is None
+            else max_batch_size)
+        self.batch_timeout_ms = float(
+            _flag("serving_batch_timeout_ms") if batch_timeout_ms is None
+            else batch_timeout_ms)
+        self.max_queue_depth = int(
+            _flag("serving_max_queue_depth") if max_queue_depth is None
+            else max_queue_depth)
+        self.default_deadline_ms = float(
+            _flag("serving_default_deadline_ms") if default_deadline_ms is None
+            else default_deadline_ms)
+        if buckets is None:
+            spec = str(_flag("serving_buckets")).strip()
+            buckets = [int(b) for b in spec.split(",") if b.strip()] \
+                if spec else None
+        if buckets:
+            buckets = sorted(set(int(b) for b in buckets))
+            if buckets[0] < 1:
+                raise ValueError(f"bucket boundaries must be >= 1: {buckets}")
+        else:
+            buckets = _pow2_buckets(self.max_batch_size)
+        self.buckets = buckets
+
+    def bucket(self, rows: int) -> int:
+        """Smallest boundary >= rows; an oversized request is its own
+        bucket (compiles once for that exact size)."""
+        for b in self.buckets:
+            if rows <= b:
+                return b
+        return rows
+
+
+class ServingEngine:
+    """Thread-safe micro-batching front end over an AnalysisPredictor.
+
+    Lifecycle: ``start()`` (optionally warming every bucket) → concurrent
+    ``submit``/``infer`` → ``close(drain=True)``. Only the single worker
+    thread (plus warmup, which runs before it starts) touches the
+    predictor, so the predictor itself needs no locking.
+    """
+
+    def __init__(self, predictor, config: Optional[ServingConfig] = None):
+        self.predictor = predictor
+        self.config = config or ServingConfig()
+        self.queue = AdmissionQueue(self.config.max_queue_depth,
+                                    self.config.default_deadline_ms)
+        self._thread: Optional[threading.Thread] = None
+        self._infer_lock = threading.Lock()
+        self._feed_names = list(predictor.feed_names)
+        self._fetch_names = list(predictor.fetch_names)
+
+    # -- client surface ------------------------------------------------------
+    @property
+    def feed_names(self) -> List[str]:
+        return list(self._feed_names)
+
+    @property
+    def fetch_names(self) -> List[str]:
+        return list(self._fetch_names)
+
+    def submit(self, feeds: Dict[str, Any],
+               deadline_ms: Optional[float] = None) -> InferenceRequest:
+        """Enqueue one request (non-blocking). feeds maps every feed name
+        to an array whose dim 0 is the request's rows; all feeds must
+        agree on rows. Raises ServerOverloadedError / EngineClosedError."""
+        arrs = {}
+        rows = None
+        for n in self._feed_names:
+            if n not in feeds:
+                raise ValueError(f"missing input '{n}'; "
+                                 f"need {self._feed_names}")
+            v = np.asarray(feeds[n])
+            if v.ndim == 0:
+                raise ValueError(f"input '{n}' needs a leading batch dim")
+            if rows is None:
+                rows = v.shape[0]
+            elif v.shape[0] != rows:
+                raise ValueError(
+                    f"inputs disagree on rows: '{n}' has {v.shape[0]}, "
+                    f"expected {rows}")
+            arrs[n] = v
+        extra = set(feeds) - set(self._feed_names)
+        if extra:
+            raise ValueError(f"unknown inputs {sorted(extra)}; "
+                             f"feeds are {self._feed_names}")
+        return self.queue.submit(arrs, rows, deadline_ms)
+
+    def infer(self, feeds: Dict[str, Any],
+              deadline_ms: Optional[float] = None,
+              timeout: Optional[float] = None) -> List[np.ndarray]:
+        """Blocking submit-and-wait; returns fetches in fetch_names order."""
+        return self.submit(feeds, deadline_ms).result(timeout)
+
+    def stats(self) -> Dict[str, Any]:
+        c = telemetry.counters()
+        return {k.split(".", 1)[1]: int(v) for k, v in c.items()
+                if k.startswith("serving.")} | \
+            {"queue_depth": self.queue.depth()}
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self, warmup: bool = True) -> "ServingEngine":
+        if self._thread is not None:
+            return self
+        if self.queue.closed:
+            raise EngineClosedError("engine was closed; build a new one")
+        if warmup:
+            self.warmup()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="pt-serving-engine",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def warmup(self) -> int:
+        """Pre-compile every bucket with zero feeds so the first real
+        request never pays a compile. Returns the number of fresh
+        compiles (serving.warmup_compiles)."""
+        specs = self.predictor.feed_specs()
+        for n, (shape, _dtype) in specs.items():
+            if any(d is None or d < 0 for d in shape[1:]):
+                telemetry.counter_add("serving.warmup_skipped", 1, feed=n)
+                return 0   # non-batch dynamic dims: nothing safe to build
+        before = telemetry.counter_get("predictor.compiles")
+        with telemetry.timer("serving.warmup_ms"):
+            for b in self.config.buckets:
+                feed = {n: np.zeros((b,) + tuple(shape[1:]), dtype=dtype)
+                        for n, (shape, dtype) in specs.items()}
+                with self._infer_lock:
+                    self.predictor.run(feed)
+        fresh = telemetry.counter_get("predictor.compiles") - before
+        if fresh:
+            telemetry.counter_add("serving.warmup_compiles", fresh)
+        return int(fresh)
+
+    def close(self, drain: bool = True, timeout: Optional[float] = None):
+        """Stop admission; with drain=True the worker finishes the backlog
+        before exiting, else queued requests fail with EngineClosedError."""
+        self.queue.close(drain=drain)
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    # -- engine loop ---------------------------------------------------------
+    def _signature(self, req: InferenceRequest):
+        return tuple((n, req.feeds[n].shape[1:], str(req.feeds[n].dtype))
+                     for n in self._feed_names)
+
+    def _loop(self):
+        while True:
+            taken = self.queue.take_batch(self._signature,
+                                          self.config.max_batch_size,
+                                          self.config.batch_timeout_ms)
+            if taken is None:
+                return
+            _sig, batch = taken
+            if not batch:
+                continue
+            try:
+                self._serve_batch(batch)
+            except BaseException as e:   # the loop must outlive any batch
+                telemetry.counter_add("serving.handler_errors", len(batch),
+                                      exc=type(e).__name__)
+                for req in batch:
+                    if not req.done():
+                        req.fail(e if isinstance(e, ServingError)
+                                 else ServingError(
+                                     f"serving handler failed: {e!r}"))
+
+    def _serve_batch(self, batch: List[InferenceRequest]):
+        import time as _time
+
+        rows = sum(r.rows for r in batch)
+        bucket = self.config.bucket(rows)
+        try:
+            faults.maybe_fail("serving.handler", batch_rows=rows,
+                              requests=len(batch))
+            feed = {}
+            for n in self._feed_names:
+                parts = [r.feeds[n] for r in batch]
+                if bucket > rows:
+                    pad_shape = (bucket - rows,) + parts[0].shape[1:]
+                    parts.append(np.zeros(pad_shape, dtype=parts[0].dtype))
+                feed[n] = parts[0] if len(parts) == 1 \
+                    else np.concatenate(parts, axis=0)
+            with self._infer_lock, telemetry.timer("serving.batch_ms"):
+                outs = self.predictor.run(feed)
+        except Exception as e:
+            # per-request error responses; the queue keeps moving
+            telemetry.counter_add("serving.handler_errors", len(batch),
+                                  exc=type(e).__name__)
+            for req in batch:
+                req.fail(e)
+            return
+        telemetry.counter_add("serving.batches", 1)
+        telemetry.counter_add("serving.batched_rows", rows)
+        if bucket > rows:
+            telemetry.counter_add("serving.padded_rows", bucket - rows)
+        telemetry.observe("serving.batch_fill", rows / bucket)
+        offset = 0
+        now = _time.monotonic()
+        for req in batch:
+            sliced = [o[offset:offset + req.rows]
+                      if getattr(o, "ndim", 0) >= 1 and len(o) == bucket
+                      else o   # non-per-row fetch: hand it through whole
+                      for o in outs]
+            offset += req.rows
+            req.resolve(sliced)
+            telemetry.observe("serving.request_ms",
+                              (now - req.enqueue_t) * 1e3, kind="timer")

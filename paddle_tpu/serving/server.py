@@ -1,0 +1,179 @@
+"""HTTP + in-process front ends for the ServingEngine.
+
+Stdlib-only on purpose (http.server + json): a paddle_tpu worker serves
+traffic with zero extra dependencies, the same way tools/perf_report.py
+renders logs anywhere. The reference's analog is the C++ inference
+server samples around AnalysisPredictor; TF-Serving's REST surface is
+the API shape being mirrored.
+
+API:
+    POST /v1/infer   {"inputs": {name: nested lists},
+                      "deadline_ms": optional float}
+             200 ->  {"outputs": {name: nested lists}, "latency_ms": f}
+             400 bad request (missing/odd inputs)
+             429 ServerOverloadedError (admission backpressure)
+             503 EngineClosedError (draining / shut down)
+             504 DeadlineExceededError
+             500 handler failure (per-request, queue keeps serving)
+    GET  /healthz    {"status": "ok", "queue_depth": n}
+    GET  /v1/stats   serving.* counter snapshot
+
+``serve()`` wires model dir → predictor → engine (with every-bucket
+warmup) → bound HTTP server in one call; ``LocalClient`` is the
+in-process twin the tier-1 tests and bench harness use (no sockets).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .admission import (DeadlineExceededError, EngineClosedError,
+                        ServerOverloadedError)
+from .engine import ServingConfig, ServingEngine
+
+
+class LocalClient:
+    """In-process client: same request/response shape as the HTTP front
+    end (outputs keyed by fetch name) without the socket."""
+
+    def __init__(self, engine: ServingEngine):
+        self.engine = engine
+
+    def infer(self, inputs: Dict[str, Any],
+              deadline_ms: Optional[float] = None,
+              timeout: Optional[float] = None) -> Dict[str, np.ndarray]:
+        outs = self.engine.infer(inputs, deadline_ms=deadline_ms,
+                                 timeout=timeout)
+        return dict(zip(self.engine.fetch_names, outs))
+
+
+def _coerce_inputs(engine: ServingEngine,
+                   raw: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    specs = engine.predictor.feed_specs()
+    feeds = {}
+    for name, value in raw.items():
+        dtype = specs.get(name, ((), "float32"))[1]
+        feeds[name] = np.asarray(value, dtype=np.dtype(dtype))
+    return feeds
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the engine is attached to the server object by make_http_server
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):   # silence per-request stderr spam
+        pass
+
+    def _reply(self, code: int, payload: Dict[str, Any],
+               headers: Optional[Dict[str, str]] = None):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        engine: ServingEngine = self.server.engine
+        if self.path == "/healthz":
+            self._reply(200, {"status": "ok",
+                              "queue_depth": engine.queue.depth()})
+        elif self.path == "/v1/stats":
+            self._reply(200, engine.stats())
+        else:
+            self._reply(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):
+        engine: ServingEngine = self.server.engine
+        if self.path != "/v1/infer":
+            self._reply(404, {"error": f"no route {self.path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            doc = json.loads(self.rfile.read(length) or b"{}")
+            feeds = _coerce_inputs(engine, doc.get("inputs") or {})
+        except (ValueError, TypeError) as e:
+            self._reply(400, {"error": f"bad request body: {e}"})
+            return
+        t0 = time.perf_counter()
+        try:
+            outs = engine.infer(feeds, deadline_ms=doc.get("deadline_ms"))
+        except ValueError as e:          # missing/ragged inputs
+            self._reply(400, {"error": str(e)})
+            return
+        except ServerOverloadedError as e:
+            self._reply(429, {"error": str(e)}, {"Retry-After": "0.05"})
+            return
+        except EngineClosedError as e:
+            self._reply(503, {"error": str(e)})
+            return
+        except DeadlineExceededError as e:
+            self._reply(504, {"error": str(e)})
+            return
+        except Exception as e:           # injected / handler failure
+            self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        self._reply(200, {
+            "outputs": {n: np.asarray(o).tolist()
+                        for n, o in zip(engine.fetch_names, outs)},
+            "latency_ms": round((time.perf_counter() - t0) * 1e3, 3)})
+
+
+class ServingHTTPServer:
+    """Bound-but-not-yet-serving HTTP wrapper; start()/shutdown() own the
+    acceptor thread. port=0 binds an ephemeral port (tests, CI)."""
+
+    def __init__(self, engine: ServingEngine, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.engine = engine
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.engine = engine
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def host(self) -> str:
+        return self._httpd.server_address[0]
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "ServingHTTPServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                name="pt-serving-http", daemon=True)
+            self._thread.start()
+        return self
+
+    def shutdown(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+def serve(model_dir: str, host: str = "127.0.0.1", port: int = 0,
+          config: Optional[ServingConfig] = None,
+          warmup: bool = True) -> ServingHTTPServer:
+    """model dir → predictor → warmed engine → started HTTP server."""
+    from ..inference import AnalysisConfig, create_predictor
+
+    predictor = create_predictor(AnalysisConfig(model_dir))
+    engine = ServingEngine(predictor, config=config)
+    engine.start(warmup=warmup)
+    return ServingHTTPServer(engine, host=host, port=port).start()

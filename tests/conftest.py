@@ -32,6 +32,11 @@ def pytest_configure(config):
                    "the core/faults.py harness (tools/chaos_check.py is "
                    "the CLI twin). Tier-1-safe: localhost sockets, "
                    "sub-second timeouts.")
+    config.addinivalue_line(
+        "markers", "serving: micro-batching serving-engine tests "
+                   "(paddle_tpu/serving/). Tier-1-fast: in-process "
+                   "client for engine tests, one ephemeral-port HTTP "
+                   "smoke.")
 
 
 @pytest.fixture

@@ -64,6 +64,96 @@ class TestSaveLoadPredict:
         assert out.shape == (2, 10)
 
 
+class TestPredictorCacheAndHandles:
+    def _mlp_predictor(self, tmp_path, scope):
+        import paddle_tpu as pt
+        from paddle_tpu import io, layers
+
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [6])
+            y = layers.fc(x, 4)
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        from paddle_tpu.inference import AnalysisConfig, create_predictor
+
+        io.save_inference_model(str(tmp_path / "m"), ["x"], [y],
+                                main_program=main, scope=scope)
+        return create_predictor(AnalysisConfig(str(tmp_path / "m")))
+
+    def test_cache_is_lru_bounded(self, tmp_path, scope):
+        """Shape churn beyond FLAGS_predictor_cache_capacity evicts the
+        coldest signature instead of growing without limit."""
+        import paddle_tpu as pt
+        from paddle_tpu.core import telemetry
+
+        pred = self._mlp_predictor(tmp_path, scope)
+        old = pt.get_flags("FLAGS_predictor_cache_capacity")
+        pt.set_flags({"FLAGS_predictor_cache_capacity": 2})
+        before = telemetry.counter_get("predictor.cache_evictions")
+        try:
+            for rows in (1, 2, 3):      # 3 signatures > capacity 2
+                pred.run({"x": np.zeros((rows, 6), np.float32)})
+            assert len(pred._cache) == 2
+            assert telemetry.counter_get(
+                "predictor.cache_evictions") - before == 1
+            # evicted signature recompiles and still answers correctly
+            x = np.random.RandomState(0).randn(1, 6).astype(np.float32)
+            out, = pred.run({"x": x})
+            assert out.shape == (1, 4)
+        finally:
+            pt.set_flags(old)
+
+    def test_cache_hits_counted(self, tmp_path, scope):
+        from paddle_tpu.core import telemetry
+
+        pred = self._mlp_predictor(tmp_path, scope)
+        x = np.zeros((2, 6), np.float32)
+        c0 = telemetry.counter_get("predictor.compiles")
+        h0 = telemetry.counter_get("predictor.cache_hits")
+        pred.run({"x": x})
+        pred.run({"x": x})
+        assert telemetry.counter_get("predictor.compiles") - c0 == 1
+        assert telemetry.counter_get("predictor.cache_hits") - h0 == 1
+
+    def test_output_handle_shape(self, tmp_path, scope):
+        """PredictorTensor.shape reads output handles too (it used to
+        only see staged inputs)."""
+        pred = self._mlp_predictor(tmp_path, scope)
+        out_name = pred.get_output_names()[0]
+        handle = pred.get_output_handle(out_name)
+        assert handle.shape is None          # run() not called yet
+        pred.run({"x": np.zeros((3, 6), np.float32)})
+        assert handle.shape == (3, 4)
+
+    def test_int64_downcast_follows_x64_config(self, tmp_path, scope):
+        """int64 feeds narrow to int32 only because jax x64 is OFF here
+        (the old code downcast unconditionally)."""
+        import jax
+
+        import paddle_tpu as pt
+        from paddle_tpu import io, layers
+
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            ids = layers.data("ids", [4], dtype="int64",
+                              stop_gradient=True)
+            emb = layers.embedding(ids, [16, 8])
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        from paddle_tpu.inference import AnalysisConfig, create_predictor
+
+        io.save_inference_model(str(tmp_path / "emb"), ["ids"], [emb],
+                                main_program=main, scope=scope)
+        pred = create_predictor(AnalysisConfig(str(tmp_path / "emb")))
+        out, = pred.run({"ids": np.zeros((2, 4), np.int64)})
+        assert out.shape == (2, 4, 8)
+        (sig,) = pred._cache.keys()
+        fed_dtype = dict((n, d) for n, _s, d in sig)["ids"]
+        expect = "int64" if jax.config.jax_enable_x64 else "int32"
+        assert fed_dtype == expect
+
+
 class TestPasses:
     def _bert_inference_program(self):
         from paddle_tpu.models import bert

@@ -1,0 +1,290 @@
+#!/usr/bin/env python
+"""bench_serving — closed/open-loop load generator for the serving engine.
+
+Measures the micro-batching win directly: the same LeNet model served
+
+  1. baseline — the single-request AnalysisPredictor, one caller at a
+     time (a lock serializes the same client threads, which is exactly
+     what the pre-serving predictor offered concurrent callers), and
+  2. engine — ServingEngine + LocalClient, requests coalesced into
+     padded shape-bucketed batches.
+
+Prints ONE BENCH-style JSON line:
+
+    {"metric": "serving_qps_lenet", "value": <engine QPS>,
+     "unit": "req/s", "vs_baseline": <engine QPS / baseline QPS>,
+     "extra": {"p50_ms", "p99_ms", "batch_fill", "qps_baseline",
+               "baseline_p50_ms", "concurrency", "requests", "mode",
+               "rejects", ... telemetry serving counters}}
+
+Modes:
+    closed (default)  N client threads, each issuing its share of
+                      --requests back-to-back (throughput-bound).
+    open              a dispatcher submits at --target-qps with
+                      non-blocking ``submit``; measures latency under a
+                      fixed arrival rate and counts admission rejects.
+
+Examples:
+    python tools/bench_serving.py                     # full closed-loop
+    python tools/bench_serving.py --smoke             # seconds, CI row
+    python tools/bench_serving.py --mode open --target-qps 200
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def build_lenet_model(model_dir: str):
+    """The test-suite LeNet (tests/test_inference.py), exported as an
+    inference model — the acceptance workload."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import io, layers
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        img = layers.data("img", [1, 28, 28])
+        conv = layers.conv2d(img, 6, 5, act="relu")
+        pool = layers.pool2d(conv, 2, pool_stride=2)
+        flat = layers.reshape(pool, [0, 6 * 12 * 12])
+        h = layers.fc(flat, 64, act="relu")
+        logits = layers.fc(h, 10)
+    scope = pt.Scope()
+    exe = pt.Executor()
+    exe.run(startup, scope=scope, use_compiled=False)
+    io.save_inference_model(model_dir, ["img"], [logits],
+                            main_program=main, scope=scope)
+    rng = np.random.RandomState(0)
+    return lambda rows: rng.randn(rows, 1, 28, 28).astype(np.float32)
+
+
+def _pct(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[i]
+
+
+def _run_clients(n_clients, n_requests, call):
+    """n_clients closed-loop threads splitting n_requests; returns
+    (wall_s, sorted per-request latencies ms, errors)."""
+    latencies, errors = [], []
+    lock = threading.Lock()
+
+    def worker(count):
+        for _ in range(count):
+            t0 = time.perf_counter()
+            try:
+                call()
+            except Exception as e:
+                with lock:
+                    errors.append(e)
+                continue
+            ms = (time.perf_counter() - t0) * 1e3
+            with lock:
+                latencies.append(ms)
+
+    shares = [n_requests // n_clients] * n_clients
+    for i in range(n_requests % n_clients):
+        shares[i] += 1
+    threads = [threading.Thread(target=worker, args=(s,), daemon=True)
+               for s in shares if s]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return time.perf_counter() - t0, sorted(latencies), errors
+
+
+def bench_closed(args, make_batch, model_dir):
+    from paddle_tpu.core import telemetry
+    from paddle_tpu.inference import AnalysisConfig, create_predictor
+    from paddle_tpu.serving import LocalClient, ServingConfig, ServingEngine
+
+    batch = make_batch(args.rows)
+
+    # -- baseline: the single-request predictor, one caller at a time ------
+    base_pred = create_predictor(AnalysisConfig(model_dir))
+    base_pred.run({"img": batch})            # compile outside the window
+    base_lock = threading.Lock()
+
+    def base_call():
+        with base_lock:
+            base_pred.run({"img": batch})
+
+    base_wall, base_lat, base_err = _run_clients(
+        args.concurrency, args.requests, base_call)
+    if base_err:
+        raise SystemExit(f"baseline errors: {base_err[:3]}")
+    qps_base = args.requests / base_wall
+
+    # -- engine: micro-batched serving -------------------------------------
+    engine = ServingEngine(
+        create_predictor(AnalysisConfig(model_dir)),
+        config=ServingConfig(max_batch_size=args.max_batch_size,
+                             batch_timeout_ms=args.batch_timeout_ms))
+    engine.start(warmup=True)
+    client = LocalClient(engine)
+    try:
+        wall, lat, errors = _run_clients(
+            args.concurrency, args.requests,
+            lambda: client.infer({"img": batch}, timeout=60))
+    finally:
+        engine.close(drain=True, timeout=10)
+    if errors:
+        raise SystemExit(f"engine errors: {errors[:3]}")
+    qps = args.requests / wall
+
+    c = telemetry.counters()
+    rows = c.get("serving.batched_rows", 0)
+    padded = c.get("serving.padded_rows", 0)
+    return {
+        "metric": "serving_qps_lenet",
+        "value": round(qps, 2),
+        "unit": "req/s",
+        "vs_baseline": round(qps / qps_base, 3),
+        "extra": {
+            "mode": "closed",
+            "requests": args.requests,
+            "concurrency": args.concurrency,
+            "rows_per_request": args.rows,
+            "max_batch_size": args.max_batch_size,
+            "batch_timeout_ms": args.batch_timeout_ms,
+            "p50_ms": round(_pct(lat, 0.50), 3),
+            "p99_ms": round(_pct(lat, 0.99), 3),
+            "qps_baseline": round(qps_base, 2),
+            "baseline_p50_ms": round(_pct(base_lat, 0.50), 3),
+            "baseline_p99_ms": round(_pct(base_lat, 0.99), 3),
+            "batch_fill": round(rows / (rows + padded), 4)
+            if rows else None,
+            "batches": int(c.get("serving.batches", 0)),
+            "rejects": int(c.get("serving.rejects", 0)),
+        },
+    }
+
+
+def bench_open(args, make_batch, model_dir):
+    from paddle_tpu.core import telemetry
+    from paddle_tpu.inference import AnalysisConfig, create_predictor
+    from paddle_tpu.serving import (ServerOverloadedError, ServingConfig,
+                                    ServingEngine)
+
+    batch = make_batch(args.rows)
+    engine = ServingEngine(
+        create_predictor(AnalysisConfig(model_dir)),
+        config=ServingConfig(max_batch_size=args.max_batch_size,
+                             batch_timeout_ms=args.batch_timeout_ms))
+    engine.start(warmup=True)
+    interval = 1.0 / args.target_qps
+    pending, rejects = [], 0
+    t_start = time.perf_counter()
+    try:
+        for i in range(args.requests):
+            target = t_start + i * interval
+            delay = target - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                pending.append((time.perf_counter(),
+                                engine.submit({"img": batch})))
+            except ServerOverloadedError:
+                rejects += 1
+        for _t0, req in pending:
+            req.result(timeout=60)
+        wall = time.perf_counter() - t_start
+    finally:
+        engine.close(drain=True, timeout=10)
+    served = len(pending)
+    snap = telemetry.snapshot()["hists"].get("serving.request_ms", {})
+    c = telemetry.counters()
+    rows = c.get("serving.batched_rows", 0)
+    padded = c.get("serving.padded_rows", 0)
+    return {
+        "metric": "serving_open_loop_lenet",
+        "value": round(served / wall, 2),
+        "unit": "req/s",
+        "vs_baseline": None,
+        "extra": {
+            "mode": "open",
+            "target_qps": args.target_qps,
+            "requests": args.requests,
+            "served": served,
+            "rejects": rejects + int(c.get("serving.rejects", 0)),
+            "p50_ms": snap.get("p50"),
+            "p99_ms": snap.get("p99"),
+            "batch_fill": round(rows / (rows + padded), 4)
+            if rows else None,
+            "batches": int(c.get("serving.batches", 0)),
+        },
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="serving-engine load generator (LeNet)")
+    ap.add_argument("--mode", choices=("closed", "open"), default="closed")
+    ap.add_argument("--requests", type=int, default=400)
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--rows", type=int, default=1,
+                    help="rows per request (leading dim)")
+    ap.add_argument("--max-batch-size", type=int, default=8)
+    ap.add_argument("--batch-timeout-ms", type=float, default=2.0)
+    ap.add_argument("--target-qps", type=float, default=200.0,
+                    help="open-loop arrival rate")
+    ap.add_argument("--model-dir", default="",
+                    help="saved inference model (default: build LeNet "
+                         "into a temp dir)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="seconds-fast CI row (64 requests)")
+    ap.add_argument("--telemetry-log", default="",
+                    help="also write the JSONL run log here")
+    args = ap.parse_args()
+    if args.smoke:
+        args.requests = min(args.requests, 64)
+
+    from paddle_tpu.core import telemetry
+
+    if args.telemetry_log:
+        telemetry.configure(args.telemetry_log)
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="pt_serving_bench_") as tmp:
+        if args.model_dir:
+            import numpy as np
+
+            model_dir = args.model_dir
+
+            def make_batch(rows):
+                from paddle_tpu import io
+                meta = io.read_inference_model_meta(model_dir)
+                name, spec = next(iter(meta["feed_specs"].items()))
+                shape = tuple(d for d in spec["shape"][1:])
+                return np.zeros((rows,) + shape,
+                                dtype=np.dtype(spec["dtype"]))
+        else:
+            model_dir = os.path.join(tmp, "lenet")
+            make_batch = build_lenet_model(model_dir)
+        fn = bench_closed if args.mode == "closed" else bench_open
+        out = fn(args, make_batch, model_dir)
+
+    from tools.bench_models import finalize_bench_result
+
+    print(json.dumps(finalize_bench_result(out)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

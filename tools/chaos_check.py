@@ -12,10 +12,19 @@ point it at a fault spec (core/faults.py grammar) and it
   4. prints the fault/retry telemetry tally (faults.injected,
      ps.rpc_retries, ps.rpc_reconnects, ps.rpc_dedup_hits, ...).
 
+With ``--serving`` it instead chaos-tests the micro-batching serving
+engine (paddle_tpu/serving/): concurrent clients push requests through a
+``serving.handler`` fault spec and the run asserts every request got a
+response — injected handler faults must surface as per-request error
+responses, never a wedged queue — and that the engine still serves
+cleanly once the fault spec is cleared.
+
 Examples:
     python tools/chaos_check.py --fault-spec "ps.rpc.send:0.1" --seed 7
     python tools/chaos_check.py --fault-spec "ps.rpc.recv:%9" --steps 8 \
         --servers 2 --telemetry-log /tmp/chaos.jsonl
+    python tools/chaos_check.py --serving \
+        --fault-spec "serving.handler:%3" --requests 24
 
 Exit status: 0 on success, 2 when the run failed or did not converge.
 Stdlib-only CLI surface (argparse); everything heavier lives in
@@ -144,6 +153,106 @@ def run(args) -> int:
     return 0
 
 
+def run_serving(args) -> int:
+    """--serving mode: injected serving.handler faults must produce
+    per-request error responses, never a wedged queue."""
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from paddle_tpu.core import faults, telemetry
+    from paddle_tpu.inference import AnalysisConfig, create_predictor
+    from paddle_tpu.serving import (LocalClient, ServingConfig,
+                                    ServingEngine, ServingError)
+    from tools.bench_serving import build_lenet_model
+
+    if args.telemetry_log:
+        telemetry.configure(args.telemetry_log)
+    spec = args.fault_spec or "serving.handler:%3"
+    faults.configure(spec, seed=args.seed)
+
+    with tempfile.TemporaryDirectory(prefix="pt_chaos_serving_") as tmp:
+        make_batch = build_lenet_model(tmp + "/lenet")
+        engine = ServingEngine(
+            create_predictor(AnalysisConfig(tmp + "/lenet")),
+            config=ServingConfig(max_batch_size=4, batch_timeout_ms=2.0))
+        # no warmup: warmup runs through the predictor, and a probabilistic
+        # handler spec must not decide the run before clients even start
+        engine.start(warmup=False)
+        client = LocalClient(engine)
+        batch = make_batch(1)
+
+        ok, failed, hung = [], [], []
+        lock = threading.Lock()
+
+        def worker(n):
+            for _ in range(n):
+                try:
+                    out = client.infer({"img": batch}, timeout=30)
+                except TimeoutError as e:
+                    with lock:
+                        hung.append(e)
+                except Exception as e:
+                    with lock:
+                        failed.append(type(e).__name__)
+                else:
+                    with lock:
+                        ok.append(out)
+
+        threads = [threading.Thread(target=worker, args=(args.requests // 4,),
+                                    daemon=True) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        # the queue must still move once the faults stop
+        faults.configure("")
+        try:
+            final = client.infer({"img": batch}, timeout=30)
+        except Exception as e:
+            print(f"CHAOS FAIL: post-fault request failed ({e!r}) — "
+                  f"engine wedged")
+            return 2
+        finally:
+            engine.close(drain=True, timeout=10)
+
+    counters = telemetry.counters()
+    injected = int(counters.get("faults.injected", 0))
+    print("-- serving chaos tally " + "-" * 26)
+    for key in ("faults.injected", "serving.requests", "serving.batches",
+                "serving.handler_errors", "serving.rejects"):
+        print(f"{key:28s} {int(counters.get(key, 0))}")
+    print(f"responses: {len(ok)} ok / {len(failed)} error / "
+          f"{len(hung)} hung")
+
+    if hung:
+        print(f"CHAOS FAIL: {len(hung)} requests never got a response — "
+              f"wedged queue")
+        return 2
+    total = len(ok) + len(failed)
+    if total != 4 * (args.requests // 4):
+        print("CHAOS FAIL: lost responses")
+        return 2
+    if injected and not failed:
+        print("CHAOS FAIL: faults were injected but no request saw an "
+              "error response")
+        return 2
+    if not injected:
+        print("CHAOS WARN: fault spec never fired (run too short for "
+              "the trigger?)")
+    if not ok or not np.all(np.isfinite(np.asarray(final["logits"]
+                                        if "logits" in final
+                                        else next(iter(final.values()))))):
+        print("CHAOS FAIL: no clean responses / non-finite output")
+        return 2
+    print(f"CHAOS OK: {total} requests, {len(failed)} per-request error "
+          f"responses from {injected} injected handler faults, queue "
+          f"never wedged")
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser(
         description="run a short PS training loop under fault injection "
@@ -151,6 +260,11 @@ def main():
     ap.add_argument("--fault-spec", default="",
                     help="core/faults.py spec, e.g. 'ps.rpc.send:0.1' "
                          "(empty = fault-free control run)")
+    ap.add_argument("--serving", action="store_true",
+                    help="chaos-test the micro-batching serving engine "
+                         "(serving.handler site) instead of the PS loop")
+    ap.add_argument("--requests", type=int, default=24,
+                    help="--serving mode: total client requests")
     ap.add_argument("--seed", type=int, default=0,
                     help="fault-injection seed (FLAGS_fault_seed)")
     ap.add_argument("--steps", type=int, default=6)
@@ -162,7 +276,8 @@ def main():
     ap.add_argument("--backoff", type=float, default=0.01)
     ap.add_argument("--telemetry-log", default="",
                     help="also write the JSONL run log here")
-    sys.exit(run(ap.parse_args()))
+    args = ap.parse_args()
+    sys.exit(run_serving(args) if args.serving else run(args))
 
 
 if __name__ == "__main__":

@@ -16,6 +16,10 @@ Renders the structured run log written by ``paddle_tpu.core.telemetry``
   (Executor.run_steps / FLAGS_exec_steps_per_dispatch): dispatches,
   steps per dispatch, per-dispatch ms percentiles, and the estimated
   host-dispatch ms the fusion saved;
+* a "Serving" section when the run used the micro-batching engine
+  (paddle_tpu/serving/): request/batch counts, batch-fill ratio,
+  padding overhead, rejects/deadline-drops, and request/batch latency
+  percentiles;
 * the profiler.summarize() host-span table when the log carries one
   (telemetry.flush() embeds it at exit).
 
@@ -118,8 +122,11 @@ def summarize_log(recs):
             "max": round(s[-1], 3),
             "mean": round(sum(s) / len(s), 3)}
     fused = _fused_summary(counter_delta, counter_last, timer_summary)
+    serving = _serving_summary(counter_delta, counter_last, timer_summary,
+                               gauges)
     return {
         "fused": fused,
+        "serving": serving,
         "records": len(recs),
         "span_s": round(max(ts) - min(ts), 3) if ts else 0.0,
         "timers": timer_summary,
@@ -169,6 +176,45 @@ def _fused_summary(counter_delta, counter_last, timer_summary):
     return out
 
 
+def _serving_summary(counter_delta, counter_last, timer_summary, gauges):
+    """Micro-batching engine accounting (paddle_tpu/serving/): how many
+    requests rode how many device batches, how full the padded batches
+    were, and what admission control rejected/expired."""
+
+    def cval(name):
+        v = counter_delta.get(name) or counter_last.get(name) or 0
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            return 0.0
+
+    requests = cval("serving.requests")
+    batches = cval("serving.batches")
+    if not requests and not batches:
+        return None
+    rows = cval("serving.batched_rows")
+    padded = cval("serving.padded_rows")
+    out = {"requests": int(requests), "batches": int(batches),
+           "rejects": int(cval("serving.rejects")),
+           "deadline_expired": int(cval("serving.deadline_expired")),
+           "handler_errors": int(cval("serving.handler_errors")),
+           "warmup_compiles": int(cval("serving.warmup_compiles"))}
+    if batches:
+        out["rows_per_batch"] = round(rows / batches, 2)
+        out["requests_per_batch"] = round(requests / batches, 2)
+    if rows:
+        out["batch_fill"] = round(rows / (rows + padded), 4)
+    for timer, key in (("serving.request_ms", "request_ms"),
+                       ("serving.batch_ms", "batch_ms")):
+        t = timer_summary.get(timer)
+        if t:
+            out[key] = {"p50": t["p50"], "p99": t["p99"], "max": t["max"]}
+    qd = gauges.get("serving.queue_depth")
+    if qd is not None:
+        out["last_queue_depth"] = qd
+    return out
+
+
 def _fmt_num(v):
     if isinstance(v, float):
         return f"{v:,.3f}".rstrip("0").rstrip(".")
@@ -212,6 +258,30 @@ def render(s, out=sys.stdout):
               f"~{_fmt_num(f['host_dispatch_ms_saved'])}\n")
         if "fallback_steps" in f:
             w(f"PS-IO fallback steps (ran unfused): {f['fallback_steps']}\n")
+
+    if s.get("serving"):
+        sv = s["serving"]
+        w("\n-- serving (micro-batching engine) --\n")
+        w(f"requests: {sv['requests']}  batches: {sv['batches']}")
+        if "requests_per_batch" in sv:
+            w(f"  req/batch: {sv['requests_per_batch']}"
+              f"  rows/batch: {sv['rows_per_batch']}")
+        w("\n")
+        if "batch_fill" in sv:
+            w(f"batch fill: {sv['batch_fill']:.1%} "
+              f"(padding overhead {1 - sv['batch_fill']:.1%})\n")
+        w(f"rejected: {sv['rejects']}  deadline-expired: "
+          f"{sv['deadline_expired']}  handler errors: "
+          f"{sv['handler_errors']}  warmup compiles: "
+          f"{sv['warmup_compiles']}\n")
+        for key, label in (("request_ms", "request latency"),
+                           ("batch_ms", "batch dispatch")):
+            if key in sv:
+                t = sv[key]
+                w(f"{label} ms: p50 {t['p50']}  p99 {t['p99']}"
+                  f"  max {t['max']}\n")
+        if "last_queue_depth" in sv:
+            w(f"last queue depth: {_fmt_num(sv['last_queue_depth'])}\n")
 
     if s["counters"]:
         w("\n-- counters (delta over log / final) --\n")
