@@ -1,31 +1,377 @@
-"""Sharded / async checkpointing over orbax.
+"""Crash-consistent checkpointing: atomic commits, integrity verification,
+exact-resume training snapshots.
 
 Capability mirror of the reference checkpoint stack (SURVEY.md §5:
 io.save_persistables / load_persistables emit save/load ops,
 framework/save_load_util.cc fast path, checkpoint_notify for PS snapshots,
-hapi ModelCheckpoint) re-designed for TPU scale: persistables are a pytree
-of (possibly sharded) jax.Arrays; orbax writes each shard from its home
-device (no host gather) and can do so ASYNCHRONOUSLY so training continues
-while the previous step's state flushes — the PS-era "snapshot without
-stopping trainers" capability, single-program style.
+hapi ModelCheckpoint) hardened to the CheckFreq / Check-N-Run bar: a
+checkpoint either exists COMPLETELY or not at all, restore never trusts
+bytes it has not verified, and a resumed run is the run that crashed.
 
-The io.py save/load (per-var .npy / .npz) surface remains for small models
-and inference export; this module is the training-time path.
+The commit protocol (write_checkpoint_dir):
 
-CheckpointManager adds retention + auto-resume: the checkpoint-restart
-failure-recovery story (the reference's collective mode has none —
-SURVEY.md §5 failure detection)."""
+1. the full state is staged into a ``.tmp-ckpt-*`` sibling directory —
+   ``state.npz`` (every array, filesystem-safe encoded names) is written,
+   flushed and fsynced;
+2. a ``MANIFEST.json`` COMMIT record is written last inside the staging
+   dir: per-array CRC32/shape/dtype/nbytes, the whole-file sha256 of
+   ``state.npz``, the training step, a monotonic save sequence number,
+   and JSON ``extras`` (global RNG state is captured automatically;
+   callers add reader cursors, epoch counters, PS step tables);
+3. the staging dir is fsynced and atomically ``rename``d to its final
+   ``ckpt-<step>`` name; the parent dir is fsynced.
+
+A process killed at ANY point leaves either the previous checkpoints
+untouched plus an ignorable uncommitted ``.tmp-ckpt-*`` dir, or the new
+checkpoint fully committed — never a torn directory under a final name.
+
+Restore (read_checkpoint_dir / CheckpointManager.restore_latest) verifies
+the manifest before a single byte enters the scope: commit marker, file
+size, sha256, per-array CRC32/shape/dtype (digest work gated by
+``FLAGS_ckpt_verify``). Corrupt or uncommitted checkpoints are moved to a
+``.quarantine/`` subdir (``ckpt.verify_failures`` / ``ckpt.quarantined``
+telemetry) and ``restore_latest`` falls back to the newest checkpoint
+that DOES verify (``ckpt.fallbacks``).
+
+Fault sites for the core/faults.py harness: ``ckpt.save.write`` (before
+any byte is staged), ``ckpt.save.commit`` (data durable, manifest/rename
+pending), ``ckpt.restore.read`` (per restore candidate). The
+``PT_CKPT_CRASH_AT=<site>[@<step>]`` env hook SIGKILLs the process at the
+matching site — the kill-during-save subprocess tests drive it.
+
+Async saves go through a single module-level background writer that
+commits in submit order; ``wait_for_checkpoint()`` joins it and an atexit
+hook joins it on interpreter exit, so process teardown cannot truncate an
+in-flight save. The arrays handed to an async save are snapshotted to
+host memory at submit time (XLA buffer donation may invalidate device
+buffers before the writer runs).
+
+The io.py save/load (per-var .npy / .npz) surface remains for small
+models and inference export; this module is the training-time path.
+"""
 
 from __future__ import annotations
 
+import atexit
+import hashlib
+import json
 import os
-from typing import Any, Dict, Optional
+import queue
+import shutil
+import signal
+import sys
+import threading
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .core import faults, telemetry
+from .core import flags as _flags
 from .core.ir import Program, default_main_program
 from .core.scope import Scope, global_scope
+from .io import _decode_name, _encode_name, _fsync_dir
 
+FORMAT = "paddle_tpu-ckpt-v1"
+MANIFEST_NAME = "MANIFEST.json"
+DATA_NAME = "state.npz"
+QUARANTINE_DIRNAME = ".quarantine"
+_CKPT_PREFIX = "ckpt-"
+_TMP_PREFIX = ".tmp-ckpt-"
+
+
+class CheckpointError(RuntimeError):
+    """Base for checkpoint protocol failures."""
+
+
+class CheckpointCorruptError(CheckpointError):
+    """A checkpoint failed integrity verification (torn write, bit rot,
+    uncommitted staging dir, manifest mismatch)."""
+
+
+# ---------------------------------------------------------------------------
+# protocol primitives
+# ---------------------------------------------------------------------------
+
+def _maybe_crash(site: str, step) -> None:
+    """Kill-during-save test hook: PT_CKPT_CRASH_AT='<site>[@<step>]'
+    SIGKILLs the process when the matching fault site is reached — the
+    honest version of a machine dying mid-save."""
+    spec = os.environ.get("PT_CKPT_CRASH_AT", "")
+    if not spec:
+        return
+    want, _, at = spec.partition("@")
+    if want != site:
+        return
+    if at and step is not None and int(at) != int(step):
+        return
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def _to_host(v) -> np.ndarray:
+    """Own-memory host copy (donated device buffers may be invalidated
+    by the time an async writer runs)."""
+    import jax
+
+    if hasattr(v, "addressable_shards"):
+        v = jax.device_get(v)
+    return np.array(v)
+
+
+def _crc32(a: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(a).tobytes()) & 0xFFFFFFFF
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _rng_state_jsonable() -> list:
+    from .generator import get_rng_state
+
+    gen, main, startup = get_rng_state()
+    return [list(gen), list(main), list(startup)]
+
+
+def _restore_rng(extras: Optional[Dict[str, Any]]):
+    rng = (extras or {}).get("rng")
+    if rng:
+        from .generator import set_rng_state
+
+        set_rng_state(rng)
+
+
+def write_checkpoint_dir(final_dir: str, arrays: Dict[str, Any],
+                         extras: Optional[Dict[str, Any]] = None,
+                         step: int = 0, seq: int = 0) -> str:
+    """Atomically commit `arrays` (+ JSON `extras`) as a verified
+    checkpoint directory. See the module docstring for the protocol."""
+    t0 = time.perf_counter()
+    final_dir = os.path.abspath(final_dir)
+    parent = os.path.dirname(final_dir)
+    os.makedirs(parent, exist_ok=True)
+    faults.maybe_fail("ckpt.save.write", step=int(step))
+    _maybe_crash("ckpt.save.write", step)
+    host = {name: _to_host(v) for name, v in arrays.items()}
+    extras = dict(extras or {})
+    extras.setdefault("rng", _rng_state_jsonable())
+    tmp = os.path.join(parent, f"{_TMP_PREFIX}{os.path.basename(final_dir)}"
+                               f"-{os.getpid()}-{threading.get_ident()}")
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    try:
+        data_path = os.path.join(tmp, DATA_NAME)
+        with open(data_path, "wb") as f:
+            np.savez(f, **{_encode_name(k): a for k, a in host.items()})
+            f.flush()
+            os.fsync(f.fileno())
+        manifest = {
+            "format": FORMAT,
+            "step": int(step),
+            "seq": int(seq),
+            "ts": time.time(),
+            "data_file": DATA_NAME,
+            "data_nbytes": os.path.getsize(data_path),
+            "data_sha256": _sha256_file(data_path),
+            "arrays": {k: {"shape": list(a.shape), "dtype": str(a.dtype),
+                           "crc32": _crc32(a), "nbytes": int(a.nbytes)}
+                       for k, a in host.items()},
+            "extras": extras,
+            "committed": True,
+        }
+        faults.maybe_fail("ckpt.save.commit", step=int(step))
+        _maybe_crash("ckpt.save.commit", step)
+        mpath = os.path.join(tmp, MANIFEST_NAME)
+        with open(mpath, "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        _fsync_dir(tmp)
+        if os.path.exists(final_dir):
+            shutil.rmtree(final_dir)    # re-commit of the same step
+        os.rename(tmp, final_dir)
+        _fsync_dir(parent)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    telemetry.counter_add("ckpt.saves", 1, step=int(step))
+    telemetry.counter_add("ckpt.bytes",
+                          int(sum(a.nbytes for a in host.values())))
+    telemetry.observe("ckpt.save_ms", (time.perf_counter() - t0) * 1e3,
+                      kind="timer", step=int(step))
+    return final_dir
+
+
+def verify_checkpoint_dir(path: str,
+                          deep: Optional[bool] = None) -> Dict[str, Any]:
+    """Check the COMMIT manifest (and, with deep verification, the data
+    file's size + sha256) WITHOUT loading arrays. Raises
+    CheckpointCorruptError; returns the parsed manifest."""
+    if deep is None:
+        deep = bool(_flags.flag("ckpt_verify"))
+    if not os.path.isdir(path):
+        raise CheckpointCorruptError(f"{path}: not a checkpoint directory")
+    mpath = os.path.join(path, MANIFEST_NAME)
+    if not os.path.exists(mpath):
+        raise CheckpointCorruptError(
+            f"{path}: no {MANIFEST_NAME} — save never committed")
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptError(f"{path}: unreadable manifest: {e}")
+    if manifest.get("format") != FORMAT:
+        raise CheckpointCorruptError(
+            f"{path}: unknown checkpoint format {manifest.get('format')!r}")
+    if not manifest.get("committed"):
+        raise CheckpointCorruptError(f"{path}: manifest lacks commit marker")
+    data = os.path.join(path, manifest.get("data_file", DATA_NAME))
+    if not os.path.exists(data):
+        raise CheckpointCorruptError(f"{path}: data file missing")
+    if deep:
+        nbytes = os.path.getsize(data)
+        if nbytes != int(manifest.get("data_nbytes", -1)):
+            raise CheckpointCorruptError(
+                f"{path}: torn data file ({nbytes} bytes, manifest says "
+                f"{manifest.get('data_nbytes')})")
+        digest = _sha256_file(data)
+        if digest != manifest.get("data_sha256"):
+            raise CheckpointCorruptError(
+                f"{path}: data sha256 mismatch (corrupt bytes)")
+    return manifest
+
+
+def read_checkpoint_dir(path: str) -> Tuple[Dict[str, np.ndarray],
+                                            Dict[str, Any]]:
+    """Verify, then load: returns ({name: array}, manifest). Every array
+    is checked against the manifest's shape/dtype/CRC32 (digest work
+    gated by FLAGS_ckpt_verify)."""
+    t0 = time.perf_counter()
+    path = os.path.abspath(path)
+    faults.maybe_fail("ckpt.restore.read", ckpt=os.path.basename(path))
+    deep = bool(_flags.flag("ckpt_verify"))
+    manifest = verify_checkpoint_dir(path, deep=deep)
+    data = os.path.join(path, manifest.get("data_file", DATA_NAME))
+    try:
+        with np.load(data, allow_pickle=False) as z:
+            arrays = {_decode_name(k): z[k] for k in z.files}
+    except Exception as e:
+        raise CheckpointCorruptError(f"{path}: unreadable data file: {e}")
+    want = manifest.get("arrays", {})
+    if set(want) != set(arrays):
+        raise CheckpointCorruptError(
+            f"{path}: array set mismatch — manifest has {len(want)} "
+            f"entries, data file has {len(arrays)}")
+    if deep:
+        for name, spec in want.items():
+            a = arrays[name]
+            if list(a.shape) != list(spec["shape"]) or \
+                    str(a.dtype) != spec["dtype"]:
+                raise CheckpointCorruptError(
+                    f"{path}: '{name}' is {a.dtype}{list(a.shape)}, "
+                    f"manifest says {spec['dtype']}{spec['shape']}")
+            if _crc32(a) != int(spec["crc32"]):
+                raise CheckpointCorruptError(
+                    f"{path}: CRC32 mismatch for '{name}'")
+    telemetry.counter_add("ckpt.restores", 1)
+    telemetry.observe("ckpt.restore_ms", (time.perf_counter() - t0) * 1e3,
+                      kind="timer")
+    return arrays, manifest
+
+
+def quarantine_checkpoint(path: str, reason: str) -> Optional[str]:
+    """Move a rejected checkpoint/staging dir aside (never delete — the
+    operator may want the forensics) and account for it."""
+    parent = os.path.dirname(os.path.abspath(path))
+    qdir = os.path.join(parent, QUARANTINE_DIRNAME)
+    dest = os.path.join(
+        qdir, f"{os.path.basename(path)}.{int(time.time() * 1e3)}")
+    try:
+        os.makedirs(qdir, exist_ok=True)
+        os.rename(path, dest)
+    except OSError:
+        shutil.rmtree(path, ignore_errors=True)
+        dest = None
+    telemetry.counter_add("ckpt.quarantined", 1, reason=reason)
+    return dest
+
+
+# ---------------------------------------------------------------------------
+# async writer (the satellite: exit can't truncate an in-flight save)
+# ---------------------------------------------------------------------------
+
+class AsyncCheckpointer:
+    """Single background writer: async saves commit in submit order. A
+    failed job's exception re-raises on the next submit/wait (the save
+    API stays fire-and-forget, but failures are never silent)."""
+
+    def __init__(self):
+        self._q: "queue.Queue" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._failure: Optional[BaseException] = None
+
+    def _ensure_thread(self):
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._loop, name="ckpt-async-writer", daemon=True)
+                self._thread.start()
+
+    def _loop(self):
+        while True:
+            fn = self._q.get()
+            try:
+                fn()
+            except BaseException as e:   # surfaced on next submit/wait
+                self._failure = e
+            finally:
+                self._q.task_done()
+
+    def _raise_failure(self):
+        e, self._failure = self._failure, None
+        if e is not None:
+            raise e
+
+    def submit(self, fn):
+        self._raise_failure()
+        self._ensure_thread()
+        self._q.put(fn)
+
+    def wait_until_finished(self):
+        if self._thread is not None:
+            self._q.join()
+        self._raise_failure()
+
+
+_writer = AsyncCheckpointer()
+
+
+def wait_for_checkpoint():
+    """Join any in-flight async save (re-raises its failure, if any)."""
+    _writer.wait_until_finished()
+
+
+def _join_writer_at_exit():
+    try:
+        _writer.wait_until_finished()
+    except Exception as e:
+        print(f"[checkpoint] async save failed at exit: {e!r}",
+              file=sys.stderr)
+
+
+atexit.register(_join_writer_at_exit)
+
+
+# ---------------------------------------------------------------------------
+# program/scope surface (the reference save_persistables role)
+# ---------------------------------------------------------------------------
 
 def _persistable_state(program: Program, scope: Scope) -> Dict[str, Any]:
     state = {}
@@ -40,18 +386,23 @@ def _persistable_state(program: Program, scope: Scope) -> Dict[str, Any]:
     return state
 
 
-_async_checkpointer = None
+def _read_seq(path: str) -> int:
+    try:
+        with open(os.path.join(path, MANIFEST_NAME)) as f:
+            return int(json.load(f).get("seq", 0))
+    except (OSError, ValueError):
+        return 0
 
 
 def save_checkpoint(path: str, program: Optional[Program] = None,
-                    scope: Optional[Scope] = None, async_save: bool = False):
-    """Write all persistables (sharded arrays stay sharded on disk).
+                    scope: Optional[Scope] = None, async_save: bool = False,
+                    extras: Optional[Dict[str, Any]] = None):
+    """Commit all persistables (+ @STEP_COUNTER@, RNG state, `extras`) to
+    `path` as one verified checkpoint directory.
 
-    async_save=True returns immediately; the write completes in the
-    background (call wait_for_checkpoint() to join)."""
-    global _async_checkpointer
-    import orbax.checkpoint as ocp
-
+    async_save=True returns immediately; the write completes on the
+    background writer (call wait_for_checkpoint() to join — an atexit
+    hook joins it on interpreter exit regardless)."""
     program = program or default_main_program()
     scope = scope or global_scope()
     state = _persistable_state(program, scope)
@@ -59,95 +410,195 @@ def save_checkpoint(path: str, program: Optional[Program] = None,
         raise ValueError("no persistable state in scope — run the startup "
                          "program first")
     path = os.path.abspath(path)
+    step = 0
+    if "@STEP_COUNTER@" in state:
+        step = int(np.asarray(state["@STEP_COUNTER@"]).reshape(-1)[0])
+    seq = _read_seq(path) + 1
+    host = {k: _to_host(v) for k, v in state.items()}
     if async_save:
-        if _async_checkpointer is None:
-            _async_checkpointer = ocp.AsyncCheckpointer(
-                ocp.PyTreeCheckpointHandler())
-        _async_checkpointer.save(path, state, force=True)
+        _writer.submit(lambda: write_checkpoint_dir(path, host, extras,
+                                                    step=step, seq=seq))
     else:
-        # the PyTree handler under the sync Checkpointer commits before
-        # returning (StandardCheckpointer finalises on a background
-        # thread — a restore right after save can miss the directory)
-        with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ckptr:
-            ckptr.save(path, state, force=True)
+        write_checkpoint_dir(path, host, extras, step=step, seq=seq)
     return path
-
-
-def wait_for_checkpoint():
-    """Join any in-flight async save."""
-    if _async_checkpointer is not None:
-        _async_checkpointer.wait_until_finished()
 
 
 def load_checkpoint(path: str, program: Optional[Program] = None,
                     scope: Optional[Scope] = None) -> int:
-    """Restore persistables into the scope. Returns the restored step."""
-    import orbax.checkpoint as ocp
-
+    """Verify + restore persistables (and the saved RNG state) into the
+    scope. Raises CheckpointCorruptError instead of loading torn or
+    corrupt bytes. Returns the restored step."""
     program = program or default_main_program()
     scope = scope or global_scope()
-    with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ckptr:
-        state = ckptr.restore(os.path.abspath(path))
-    step = 0
-    for name, val in state.items():
-        if name == "@STEP_COUNTER@":
-            step = int(np.asarray(val))
+    try:
+        arrays, manifest = read_checkpoint_dir(os.path.abspath(path))
+    except CheckpointCorruptError:
+        telemetry.counter_add("ckpt.verify_failures", 1,
+                              ckpt=os.path.basename(str(path)))
+        raise
+    for name, val in arrays.items():
         scope.set(name, val)
-    return step
+    _restore_rng(manifest.get("extras"))
+    return int(manifest.get("step", 0))
 
 
 class CheckpointManager:
-    """Retention + auto-resume driver (reference: hapi callbacks
-    ModelCheckpoint + the PS checkpoint_notify flow; orbax
-    CheckpointManager underneath).
+    """Retention + auto-resume driver over the atomic-commit protocol
+    (reference: hapi ModelCheckpoint + the PS checkpoint_notify flow).
 
     mgr = CheckpointManager(dir, max_to_keep=3)
     start = mgr.restore_latest(program, scope)      # 0 if fresh
     for step in range(start, N):
         ...train...
         mgr.save(step, program, scope)              # honors save_interval
+
+    restore_latest quarantines any candidate that fails verification and
+    falls back to the newest one that passes; `last_restore_extras`
+    exposes the restored snapshot's extras (reader cursor, epoch, ...).
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3,
                  save_interval_steps: int = 1, async_save: bool = True):
-        import orbax.checkpoint as ocp
-
         self.directory = os.path.abspath(directory)
-        opts = ocp.CheckpointManagerOptions(
-            max_to_keep=max_to_keep,
-            save_interval_steps=save_interval_steps,
-            enable_async_checkpointing=async_save)
-        self._mgr = ocp.CheckpointManager(self.directory, options=opts)
+        os.makedirs(self.directory, exist_ok=True)
+        self.max_to_keep = int(max_to_keep)
+        self.save_interval = max(1, int(save_interval_steps))
+        self.async_save = bool(async_save)
+        self._last_saved: Optional[int] = None
+        self.last_restore_extras: Dict[str, Any] = {}
+        # the monotonic save sequence resumes past anything on disk
+        self._seq = max([_read_seq(p) for _, p in self._candidates()],
+                        default=0)
+
+    # -- directory scanning --------------------------------------------------
+    def _candidates(self) -> List[Tuple[int, str]]:
+        """[(step, path)] of committed-named checkpoint dirs, ascending."""
+        out = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return out
+        for name in names:
+            if not name.startswith(_CKPT_PREFIX):
+                continue
+            try:
+                step = int(name[len(_CKPT_PREFIX):])
+            except ValueError:
+                continue
+            out.append((step, os.path.join(self.directory, name)))
+        return sorted(out)
+
+    def _sweep_uncommitted(self):
+        """Quarantine staging dirs a killed save left behind."""
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return
+        for name in names:
+            if name.startswith(_TMP_PREFIX):
+                telemetry.counter_add("ckpt.verify_failures", 1,
+                                      ckpt=name, reason="uncommitted")
+                quarantine_checkpoint(os.path.join(self.directory, name),
+                                      "uncommitted")
+
+    def all_steps(self) -> List[int]:
+        return [s for s, _ in self._candidates()]
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    # -- save ----------------------------------------------------------------
+    def save_arrays(self, step: int, arrays: Dict[str, Any],
+                    extras: Optional[Dict[str, Any]] = None,
+                    force: bool = False) -> bool:
+        """Arrays-level save (hapi training snapshots, PS tables). The
+        host snapshot is taken HERE so async writes see this step's
+        values even if training keeps mutating/donating buffers."""
+        step = int(step)
+        if not force and self._last_saved is not None and \
+                step - self._last_saved < self.save_interval:
+            return False
+        host = {k: _to_host(v) for k, v in arrays.items()}
+        self._seq += 1
+        seq = self._seq
+        self._last_saved = step
+        path = os.path.join(self.directory, f"{_CKPT_PREFIX}{step:010d}")
+
+        def job():
+            write_checkpoint_dir(path, host, extras, step=step, seq=seq)
+            self._retain()
+
+        if self.async_save:
+            _writer.submit(job)
+        else:
+            job()
+        return True
 
     def save(self, step: int, program: Optional[Program] = None,
-             scope: Optional[Scope] = None) -> bool:
-        import orbax.checkpoint as ocp
-
+             scope: Optional[Scope] = None,
+             extras: Optional[Dict[str, Any]] = None,
+             force: bool = False) -> bool:
         state = _persistable_state(program or default_main_program(),
                                    scope or global_scope())
-        return self._mgr.save(step, args=ocp.args.StandardSave(state))
+        if not state:
+            raise ValueError("no persistable state in scope — run the "
+                             "startup program first")
+        return self.save_arrays(step, state, extras=extras, force=force)
+
+    def _retain(self):
+        if self.max_to_keep <= 0:
+            return
+        dirs = self._candidates()
+        for _, path in dirs[:-self.max_to_keep]:
+            shutil.rmtree(path, ignore_errors=True)
+
+    # -- restore -------------------------------------------------------------
+    def restore_latest_arrays(self) -> Tuple[int, Dict[str, np.ndarray],
+                                             Dict[str, Any]]:
+        """Newest checkpoint that VERIFIES: (step, arrays, extras) —
+        (0, {}, {}) when none. Rejected candidates are quarantined; the
+        restored snapshot's RNG state is applied."""
+        self.wait_until_finished()
+        self._sweep_uncommitted()
+        rejected = 0
+        for step, path in reversed(self._candidates()):
+            try:
+                arrays, manifest = read_checkpoint_dir(path)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                # anything unreadable is untrustworthy: quarantine it and
+                # fall through to the next-newest candidate
+                telemetry.counter_add("ckpt.verify_failures", 1, step=step,
+                                      reason=type(e).__name__)
+                quarantine_checkpoint(path, type(e).__name__)
+                rejected += 1
+                continue
+            if rejected:
+                telemetry.counter_add("ckpt.fallbacks", 1, step=step,
+                                      skipped=rejected)
+            extras = manifest.get("extras") or {}
+            _restore_rng(extras)
+            self.last_restore_extras = extras
+            self._last_saved = int(manifest.get("step", step))
+            return self._last_saved, arrays, extras
+        return 0, {}, {}
 
     def restore_latest(self, program: Optional[Program] = None,
                        scope: Optional[Scope] = None) -> int:
-        """Load the newest checkpoint if any; returns its step (0 if none).
-        This is the failure-recovery entry point: rerun the same script and
-        training resumes."""
-        import orbax.checkpoint as ocp
-
-        step = self._mgr.latest_step()
-        if step is None:
-            return 0
-        program = program or default_main_program()
+        """Load the newest VERIFIED checkpoint if any; returns its step
+        (0 if none). This is the failure-recovery entry point: rerun the
+        same script and training resumes."""
         scope = scope or global_scope()
-        target = _persistable_state(program, scope)
-        state = self._mgr.restore(
-            step, args=ocp.args.StandardRestore(target if target else None))
-        for name, val in state.items():
+        step, arrays, _ = self.restore_latest_arrays()
+        for name, val in arrays.items():
             scope.set(name, val)
         return int(step)
 
     def wait_until_finished(self):
-        self._mgr.wait_until_finished()
+        if self.async_save:
+            _writer.wait_until_finished()
 
     def close(self):
-        self._mgr.close()
+        self.wait_until_finished()

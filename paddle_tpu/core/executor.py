@@ -1065,7 +1065,8 @@ class Executor:
                            scope: Optional[Scope] = None, thread: int = 0,
                            debug: bool = False, fetch_list=None,
                            fetch_info=None, print_period: int = 100,
-                           fetch_handler=None, _skip_update: bool = False):
+                           fetch_handler=None, _skip_update: bool = False,
+                           start_step: int = 0):
         """Stream the dataset's batches through the compiled training step.
 
         The reference spawns one DeviceWorker thread per core, each running
@@ -1073,6 +1074,12 @@ class Executor:
         jitted XLA step IS the worker: the native parse threads
         (native/data_feed.cc) keep the host side ahead while XLA's async
         dispatch pipelines device steps — same roles, two components.
+
+        start_step is the resumable-reader cursor: the first `start_step`
+        batches of the (deterministic) dataset stream are skipped and step
+        numbering starts there, so a run restored from a step-N checkpoint
+        passes start_step=N and consumes exactly the batches the crashed
+        run never trained on.
         """
         if dataset is None:
             raise ValueError("dataset is required")
@@ -1100,7 +1107,8 @@ class Executor:
         if k == 1 and isinstance(program, CompiledProgram):
             k = max(1, int(getattr(program._exec_strategy,
                                    "num_iteration_per_drop_scope", 1)))
-        step = 0
+        start_step = max(0, int(start_step))
+        step = start_step
         last = None
 
         def run_pending(pending):
@@ -1138,7 +1146,14 @@ class Executor:
                 print(f"[train_from_dataset] step {s}: {msgs}")
 
         pending: List[Dict[str, Any]] = []
-        for feed in dataset.iter_batches():
+        batches = dataset.iter_batches()
+        if start_step:
+            import itertools as _it
+
+            batches = _it.islice(batches, start_step, None)
+            telemetry.counter_add("executor.reader_skipped_batches",
+                                  start_step)
+        for feed in batches:
             bad = [kk for kk, v in feed.items() if isinstance(v, tuple)]
             if bad:
                 raise ExecutionError(
@@ -1156,10 +1171,11 @@ class Executor:
                 pending = []
         if pending:
             run_pending(pending)
-        if step == 0:
+        if step == start_step:
             raise ExecutionError(
                 "dataset produced no batches — for InMemoryDataset call "
-                "load_into_memory() before training")
+                "load_into_memory() before training (resuming past the "
+                "end of the stream also lands here)")
         if fetch_handler is not None and last is not None:
             fetch_handler(dict(zip(fetch_names, last)))
         return last

@@ -180,6 +180,15 @@ define_flag("serving_buckets", "",
             "pads coalesced batches up to (keeps the jit cache small and "
             "warm); empty = powers of two up to serving_max_batch_size")
 
+define_flag("ckpt_verify", True,
+            "verify checkpoint integrity before restoring (paddle_tpu/"
+            "checkpoint.py): data-file size + sha256 and per-array "
+            "crc32/shape/dtype against the COMMIT manifest; corrupt or "
+            "uncommitted checkpoints are quarantined and restore_latest "
+            "falls back to the newest valid one (ckpt.verify_failures / "
+            "ckpt.fallbacks telemetry). Disabling skips only the digest "
+            "work — the commit manifest itself is always required")
+
 define_flag("ps_degrade_to_survivors", False,
             "when the HeartBeatMonitor declares a trainer dead, shrink "
             "the sync barrier to the live set (mean over survivors) "

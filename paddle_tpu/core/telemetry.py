@@ -333,6 +333,15 @@ def bench_extra() -> Dict[str, Any]:
     if fused_d:
         out["telemetry_fused_dispatches"] = fused_d
         out["telemetry_fused_steps"] = int(c.get("executor.fused_steps", 0))
+    # crash-consistent checkpoint accounting (paddle_tpu/checkpoint.py)
+    saves = int(c.get("ckpt.saves", 0))
+    if saves:
+        out["telemetry_ckpt_saves"] = saves
+        out["telemetry_ckpt_bytes"] = int(c.get("ckpt.bytes", 0))
+        vf = int(c.get("ckpt.verify_failures", 0))
+        if vf:
+            out["telemetry_ckpt_verify_failures"] = vf
+            out["telemetry_ckpt_fallbacks"] = int(c.get("ckpt.fallbacks", 0))
     # serving-engine accounting (micro-batching runs: bench_serving)
     sreq = int(c.get("serving.requests", 0))
     if sreq:

@@ -7,11 +7,20 @@ Capability mirror of the reference's failure-detection story (SURVEY.md
 "checkpoint-restart based recovery is the realistic TPU equivalent".
 This module provides that equivalent: a supervised step loop that
 checkpoints periodically and, when a step raises a recoverable error,
-restores the newest checkpoint and resumes, up to max_restarts.
+restores the newest VERIFIED checkpoint and resumes, up to max_restarts.
 
     runner = ElasticRunner(ckpt_dir, program, scope,
                            save_interval_steps=10)
     runner.run(step_fn, num_steps)   # step_fn(step) -> loss
+
+Exact resume: each checkpoint carries the global RNG state (restored by
+the manager) and, when a `reader` with ``state_dict()``/``set_state()``
+is attached (the double-buffer _GeneratorLoader grew that surface), the
+reader cursor — a restored run re-reads exactly the batch that was in
+flight when the step failed. The step loop runs under try/finally
+``wait_until_finished()`` so teardown can't truncate an in-flight async
+save; checkpoint-save failures (e.g. injected ``ckpt.save.*`` faults)
+are themselves recoverable, not fatal.
 
 On a multi-host job the same script re-launched by the cluster manager
 lands in restore_latest() and continues — the reference's
@@ -40,7 +49,8 @@ class ElasticRunner:
     def __init__(self, ckpt_dir: str, program=None, scope=None,
                  save_interval_steps: int = 10, max_to_keep: int = 3,
                  max_restarts: int = 3,
-                 recoverable: Tuple[type, ...] = RECOVERABLE):
+                 recoverable: Tuple[type, ...] = RECOVERABLE,
+                 reader=None, async_save: bool = True):
         from ..checkpoint import CheckpointManager
 
         self.program = program
@@ -48,8 +58,10 @@ class ElasticRunner:
         self.max_restarts = int(max_restarts)
         self.recoverable = tuple(recoverable)
         self.save_interval = int(save_interval_steps)
+        self.reader = reader
         self.mgr = CheckpointManager(ckpt_dir, max_to_keep=max_to_keep,
-                                     save_interval_steps=save_interval_steps)
+                                     save_interval_steps=save_interval_steps,
+                                     async_save=async_save)
         self.restarts = 0
 
     def _recoverable_exc(self, e: BaseException) -> bool:
@@ -66,49 +78,83 @@ class ElasticRunner:
             e = e.__cause__
         return False
 
+    # -- exact-resume extras -------------------------------------------------
+    def _extras(self) -> dict:
+        ex = {}
+        if self.reader is not None and hasattr(self.reader, "state_dict"):
+            ex["reader"] = self.reader.state_dict()
+        return ex
+
+    def _apply_restored_extras(self):
+        ex = self.mgr.last_restore_extras
+        if self.reader is not None and hasattr(self.reader, "set_state") \
+                and "reader" in ex:
+            self.reader.set_state(ex["reader"])
+
+    def _save_baseline(self):
+        """Baseline checkpoint of the INITIAL weights: a failure before
+        the first periodic save must restore to step 0's state, not keep
+        the partially-trained scope and re-run from step 0. Saved
+        synchronously (durable before any step can fail and need it),
+        with one retry against injected/transient save faults."""
+        for attempt in (1, 2):
+            try:
+                self.mgr.save(0, self.program, self.scope,
+                              extras=self._extras(), force=True)
+                self.mgr.wait_until_finished()
+                return
+            except ValueError:
+                return   # nothing persistable yet -> nothing to restore
+            except self.recoverable as e:
+                _LOG.warning("elastic: baseline checkpoint attempt %d "
+                             "failed: %r", attempt, e)
+
     def run(self, step_fn: Callable[[int], object], num_steps: int,
             on_restart: Optional[Callable[[int, BaseException], None]] = None):
         """Run step_fn(step) for num_steps with failure recovery.
 
         Returns the last step_fn result. Restores from the newest
-        checkpoint on a recoverable exception; re-raises after
-        max_restarts (or immediately for non-recoverable types)."""
+        verified checkpoint on a recoverable exception (from the step OR
+        from the checkpoint save itself); re-raises after max_restarts
+        (or immediately for non-recoverable types)."""
         step = self.mgr.restore_latest(self.program, self.scope)
         if step:
+            self._apply_restored_extras()
             _LOG.info("elastic: resumed from checkpoint step %d", step)
         else:
-            # baseline checkpoint of the INITIAL weights: a failure before
-            # the first periodic save must restore to step 0's state, not
-            # keep the partially-trained scope and re-run from step 0
-            try:
-                self.mgr.save(0, self.program, self.scope)
-                # the manager saves ASYNC by default; the baseline must be
-                # durable before any step can fail and need it
-                self.mgr.wait_until_finished()
-            except ValueError:
-                pass     # nothing persistable yet -> nothing to restore
+            self._save_baseline()
         result = None
-        while step < num_steps:
-            try:
-                result = step_fn(step)
-            except Exception as e:
-                if not self._recoverable_exc(e):
-                    raise
-                self.restarts += 1
-                if self.restarts > self.max_restarts:
-                    _LOG.error("elastic: step %d failed after %d restarts",
-                               step, self.max_restarts)
-                    raise
-                restored = self.mgr.restore_latest(self.program, self.scope)
-                _LOG.warning(
-                    "elastic: step %d raised %r — restart %d/%d from "
-                    "checkpoint step %d", step, e, self.restarts,
-                    self.max_restarts, restored)
-                if on_restart is not None:
-                    on_restart(step, e)
-                step = restored
-                continue
-            step += 1
-            self.mgr.save(step, self.program, self.scope)
-        self.mgr.wait_until_finished()
+        try:
+            while step < num_steps:
+                try:
+                    result = step_fn(step)
+                    step += 1
+                    self.mgr.save(step, self.program, self.scope,
+                                  extras=self._extras())
+                except Exception as e:
+                    if not self._recoverable_exc(e):
+                        raise
+                    self.restarts += 1
+                    if self.restarts > self.max_restarts:
+                        _LOG.error("elastic: step %d failed after %d "
+                                   "restarts", step, self.max_restarts)
+                        raise
+                    restored = self.mgr.restore_latest(self.program,
+                                                       self.scope)
+                    self._apply_restored_extras()
+                    _LOG.warning(
+                        "elastic: step %d raised %r — restart %d/%d from "
+                        "checkpoint step %d", step, e, self.restarts,
+                        self.max_restarts, restored)
+                    if on_restart is not None:
+                        on_restart(step, e)
+                    step = restored
+        finally:
+            # teardown join: process exit must not truncate an in-flight
+            # async save (the checkpoint module's atexit hook is the
+            # last-resort backstop; this is the orderly path)
+            self.mgr.wait_until_finished()
         return result
+
+    def close(self):
+        self.mgr.close()

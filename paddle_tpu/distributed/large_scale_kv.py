@@ -118,15 +118,19 @@ class LargeScaleKV:
         return sum(len(s.table) for s in self.shards)
 
     def save(self, path: str):
+        from ..io import atomic_savez
+
         ids, rows = [], []
         for s in self.shards:
             with s.lock:
                 for k, v in s.table.items():
                     ids.append(k)
                     rows.append(v)
-        np.savez(path, ids=np.asarray(ids, np.int64),
-                 rows=np.stack(rows) if rows else
-                 np.zeros((0, self.dim), np.float32))
+        # atomic commit: a server killed mid-snapshot must not leave a
+        # torn table npz under the final name
+        atomic_savez(path, ids=np.asarray(ids, np.int64),
+                     rows=np.stack(rows) if rows else
+                     np.zeros((0, self.dim), np.float32))
 
     def load(self, path: str):
         data = np.load(path if path.endswith(".npz") else path + ".npz")

@@ -111,14 +111,18 @@ class KVTables:
         import json
         import os
 
+        from ...io import atomic_write_json
+
         os.makedirs(dirname, exist_ok=True)
         with self._lock:
             specs = dict(self._specs)
             tables = dict(self.tables)
         for name, kv in tables.items():
             kv.save(os.path.join(dirname, f"kv_{tag}_{name}.npz"))
-        with open(os.path.join(dirname, f"kv_{tag}_specs.json"), "w") as f:
-            json.dump({n: list(s) for n, s in specs.items()}, f)
+        # specs commit LAST (atomically): load_all keys off this file,
+        # so a kill mid-snapshot leaves the previous spec set in force
+        atomic_write_json(os.path.join(dirname, f"kv_{tag}_specs.json"),
+                          {n: list(s) for n, s in specs.items()})
 
     def load_all(self, dirname: str, tag: str):
         import json

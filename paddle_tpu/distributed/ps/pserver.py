@@ -351,8 +351,12 @@ class PServer:
     def save_checkpoint(self, dirname: str, tag: str = None):
         """Snapshot params + optimizer accumulators (the whole scope),
         the step counters, and every KV table. Taken under the apply
-        lock so the snapshot is a consistent cut."""
-        import json
+        lock so the snapshot is a consistent cut, committed through the
+        atomic checkpoint protocol (paddle_tpu/checkpoint.py: staged
+        write + fsync + COMMIT manifest with per-array checksums +
+        rename) so a server killed mid-snapshot leaves the previous
+        snapshot intact and verifiable."""
+        from ... import checkpoint as ckpt
 
         # fault site: a checkpoint that dies BEFORE writing must leave
         # the previous snapshot intact (nothing is touched before here)
@@ -366,29 +370,30 @@ class PServer:
             # still inside the lock: kv_* RPCs also serialise on it, so
             # the table snapshot pairs with the dense cut above
             self.kv.save_all(dirname, tag)
-        np.savez(os.path.join(dirname, f"pserver_{tag}.npz"),
-                 **{k.replace("/", "%SLASH%"): a
-                    for k, a in arrays.items()})
-        with open(os.path.join(dirname, f"pserver_{tag}_meta.json"),
-                  "w") as f:
-            json.dump(meta, f)
+        ckpt.write_checkpoint_dir(
+            os.path.join(dirname, f"pserver_{tag}"), arrays,
+            extras={"ps": meta}, step=self._global_step)
         telemetry.counter_add("ps.checkpoints", 1, tag=tag)
 
     def load_checkpoint(self, dirname: str, tag: str = None):
-        import json
+        """Verified restore: the snapshot's manifest (file sha256 +
+        per-array CRC32) must check out before any byte enters the
+        server scope — a torn snapshot raises CheckpointCorruptError
+        (relayed to the notifier as an RPC error) instead of silently
+        serving wrong parameters."""
+        from ... import checkpoint as ckpt
 
         tag = tag or self._ckpt_tag()
-        path = os.path.join(dirname, f"pserver_{tag}.npz")
+        arrays, manifest = ckpt.read_checkpoint_dir(
+            os.path.join(dirname, f"pserver_{tag}"))
+        meta = (manifest.get("extras") or {}).get("ps") or {}
         with self._apply_lock:
-            with np.load(path) as z:
-                for k in z.files:
-                    self.scope.set(k.replace("%SLASH%", "/"), z[k])
-            with open(os.path.join(dirname,
-                                   f"pserver_{tag}_meta.json")) as f:
-                meta = json.load(f)
-            self._global_step = int(meta["global_step"])
-            self._apply_count = {k: int(v)
-                                 for k, v in meta["apply_count"].items()}
+            for k, v in arrays.items():
+                self.scope.set(k, v)
+            self._global_step = int(meta.get("global_step", 0))
+            self._apply_count = {
+                k: int(v) for k, v in (meta.get("apply_count")
+                                       or {}).items()}
             # inside the lock, like save: a kv RPC between the dense
             # restore and the table restore would see a torn state
             self.kv.load_all(dirname, tag)

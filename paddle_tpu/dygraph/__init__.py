@@ -102,13 +102,16 @@ def save_dygraph(state_dict, model_path: str):
         else:
             meta[k] = v
             is_opt = True  # non-tensor entries only appear in optimizer state
+    from ..io import atomic_savez, atomic_write_json
+
     suffix = ".pdopt" if is_opt else ".pdparams"
     path = model_path if model_path.endswith((".pdparams", ".pdopt")) \
         else model_path + suffix
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    np.savez(path + ".npz", **arrays)
-    with open(path, "w") as f:
-        json.dump({"keys": sorted(arrays), "meta": meta}, f)
+    atomic_savez(path + ".npz", **arrays)
+    # the manifest commits LAST: a half-written snapshot has no manifest
+    # and load_dygraph skips it instead of reading a torn npz
+    atomic_write_json(path, {"keys": sorted(arrays), "meta": meta})
 
 
 def load_dygraph(model_path: str):

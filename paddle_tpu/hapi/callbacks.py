@@ -179,7 +179,12 @@ class TelemetryLogger(Callback):
 
 class ModelCheckpoint(Callback):
     """Save params (+opt state) every `save_freq` epochs into
-    `save_dir/{epoch}` and `save_dir/final` (reference: ModelCheckpoint)."""
+    `save_dir/{epoch}` and `save_dir/final` (reference: ModelCheckpoint).
+    Writes go through dygraph.save_dygraph, whose npz + manifest files
+    commit atomically (io.atomic_savez/atomic_write_json) — a process
+    killed mid-save can't leave a torn epoch directory. For exact crash
+    resume (optimizer + RNG + epoch cursor) use Model.fit(resume_from=)
+    instead, which snapshots through the verified checkpoint protocol."""
 
     def __init__(self, save_freq: int = 1, save_dir: str = "checkpoint"):
         super().__init__()

@@ -139,7 +139,16 @@ class Model:
             epochs: int = 1, eval_freq: int = 1, log_freq: int = 10,
             save_dir: Optional[str] = None, save_freq: int = 1,
             verbose: int = 2, drop_last: bool = False, shuffle: bool = True,
-            num_workers: int = 0, callbacks: Optional[List[Callback]] = None):
+            num_workers: int = 0, callbacks: Optional[List[Callback]] = None,
+            resume_from: Optional[str] = None):
+        """resume_from names a crash-consistency directory: fit restores
+        the newest VERIFIED training snapshot in it (network + optimizer
+        state incl. LR, global RNG state, completed-epoch count — torn or
+        corrupt snapshots are quarantined and skipped) and commits a new
+        atomic snapshot after every epoch. Re-running the same fit() call
+        after a crash continues exactly where the dead run left off; with
+        a deterministic data order (shuffle=False or a seeded sampler)
+        the resumed run matches an uninterrupted one bitwise."""
         loader = self._make_loader(train_data, batch_size, shuffle)
         # async-dispatch cadence: the loss only crosses to the host on
         # log steps (every log_freq batches) — per-batch float() syncs
@@ -173,10 +182,19 @@ class Model:
                                   "verbose": verbose, "save_dir": save_dir,
                                   "metrics": self._metrics_names()})
         self.stop_training = False
+        ckpt_mgr = None
+        if resume_from:
+            from ..checkpoint import CheckpointManager
+
+            ckpt_mgr = CheckpointManager(resume_from, max_to_keep=3,
+                                         async_save=False)
         with dygraph.guard():
+            start_epoch = 0
+            if ckpt_mgr is not None:
+                start_epoch = self._restore_training_state(ckpt_mgr)
             cb.on_train_begin()
             logs: Dict[str, Any] = {}
-            for epoch in range(epochs):
+            for epoch in range(start_epoch, epochs):
                 cb.on_epoch_begin(epoch)
                 for m in self._metrics:
                     m.reset()
@@ -193,6 +211,8 @@ class Model:
                     self.evaluate(eval_data, batch_size=batch_size,
                                   verbose=verbose, callbacks=cbks,
                                   num_workers=num_workers)
+                if ckpt_mgr is not None:
+                    self._save_training_state(ckpt_mgr, epoch)
                 if self.stop_training:
                     break
             cb.on_train_end(logs)
@@ -237,6 +257,42 @@ class Model:
         if stack_outputs:
             grouped = [np.concatenate(g, axis=0) for g in grouped]
         return grouped
+
+    # -- crash-consistent training snapshots (fit(resume_from=...)) ----------
+    def _training_state_arrays(self) -> Dict[str, np.ndarray]:
+        """One flat array dict for the atomic checkpoint protocol:
+        'net:<structured name>' for network params/buffers, 'opt:<key>'
+        for the optimizer's positional state (accumulators + LR)."""
+        arrays = {}
+        for k, v in self.network.state_dict().items():
+            arrays["net:" + k] = np.asarray(
+                v.numpy() if hasattr(v, "numpy") else v)
+        if self._optimizer is not None and hasattr(self._optimizer,
+                                                   "state_dict"):
+            for k, v in self._optimizer.state_dict().items():
+                arrays["opt:" + k] = np.asarray(v)
+        return arrays
+
+    def _save_training_state(self, mgr, epoch: int):
+        mgr.save_arrays(epoch + 1, self._training_state_arrays(),
+                        extras={"epoch": int(epoch + 1)})
+
+    def _restore_training_state(self, mgr) -> int:
+        """Restore the newest verified snapshot; returns the epoch to
+        resume at (0 when the directory is fresh). The manager applies
+        the snapshot's RNG state; optimizer state restores through the
+        pending-state path if no step has built the micro-program yet."""
+        step, arrays, extras = mgr.restore_latest_arrays()
+        if not step:
+            return 0
+        net = {k[4:]: v for k, v in arrays.items() if k.startswith("net:")}
+        opt = {k[4:]: v for k, v in arrays.items() if k.startswith("opt:")}
+        if net:
+            self.network.set_state_dict(net)
+        if opt and self._optimizer is not None and \
+                hasattr(self._optimizer, "set_state_dict"):
+            self._optimizer.set_state_dict(opt)
+        return int(extras.get("epoch", step))
 
     # -- persistence ----------------------------------------------------------
     def save(self, path: str):

@@ -20,8 +20,9 @@ from __future__ import annotations
 
 import json
 import os
+import tempfile
 import urllib.parse
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -33,9 +34,74 @@ __all__ = [
     "save_vars", "save_params", "save_persistables", "load_vars", "load_params",
     "load_persistables", "save_inference_model", "load_inference_model",
     "get_program_state", "set_program_state", "save", "load", "prune_program",
+    "atomic_write", "atomic_savez", "atomic_save_npy", "atomic_write_json",
 ]
 
 _MODEL_FILE = "__model__.json"
+
+
+# ---------------------------------------------------------------------------
+# Atomic file writes (crash consistency: a killed export must never leave
+# a torn .npy/.npz/__model__.json under its final name — the payload goes
+# to a same-directory temp file, is flushed + fsynced, then os.replace'd)
+# ---------------------------------------------------------------------------
+
+def _fsync_dir(path: str):
+    """Durably record a directory entry (rename/replace targets). Best
+    effort: some filesystems refuse O_RDONLY fsync on dirs."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write(path: str, writer: Callable, mode: str = "wb") -> str:
+    """Call `writer(f)` against a temp file in `path`'s directory, fsync,
+    then atomically replace `path`. On any failure the target is
+    untouched and the temp file is removed."""
+    path = os.path.abspath(path)
+    d = os.path.dirname(path) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(
+        dir=d, prefix=f".tmp-{os.path.basename(path)}-")
+    try:
+        with os.fdopen(fd, mode) as f:
+            writer(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        _fsync_dir(d)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
+
+
+def atomic_savez(path: str, **arrays) -> str:
+    """np.savez with atomic commit (keeps np.savez's implicit-.npz-suffix
+    behavior so op-path and host-path files interoperate)."""
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    return atomic_write(path, lambda f: np.savez(f, **arrays))
+
+
+def atomic_save_npy(path: str, array) -> str:
+    if not path.endswith(".npy"):
+        path = path + ".npy"
+    return atomic_write(path, lambda f: np.save(f, np.asarray(array)))
+
+
+def atomic_write_json(path: str, doc) -> str:
+    return atomic_write(path, lambda f: json.dump(doc, f), mode="w")
 
 
 def _encode_name(name: str) -> str:
@@ -154,11 +220,12 @@ def save_vars(executor=None, dirname: str = "", main_program: Optional[Program] 
                 f"run the startup program first")
         arrays[v.name] = _to_numpy(val)
     if filename is not None:
-        np.savez(os.path.join(dirname, filename),
-                 **{_encode_name(k): a for k, a in arrays.items()})
+        atomic_savez(os.path.join(dirname, filename),
+                     **{_encode_name(k): a for k, a in arrays.items()})
     else:
         for name, a in arrays.items():
-            np.save(os.path.join(dirname, _encode_name(name) + ".npy"), a)
+            atomic_save_npy(os.path.join(dirname, _encode_name(name) + ".npy"),
+                            a)
     return sorted(arrays)
 
 
@@ -298,10 +365,11 @@ def save(program: Program, model_path: str, scope: Optional[Scope] = None):
               for v in _select_vars(program, predicate=is_persistable)
               if not is_parameter(v) and scope.find_var(v.name) is not None}
     os.makedirs(os.path.dirname(os.path.abspath(base)), exist_ok=True)
-    np.savez(base + ".pdparams.npz", **{_encode_name(k): v for k, v in params.items()})
-    np.savez(base + ".pdopt.npz", **{_encode_name(k): v for k, v in others.items()})
-    with open(base + ".pdmodel", "w") as f:
-        json.dump(program.to_dict(), f)
+    atomic_savez(base + ".pdparams.npz",
+                 **{_encode_name(k): v for k, v in params.items()})
+    atomic_savez(base + ".pdopt.npz",
+                 **{_encode_name(k): v for k, v in others.items()})
+    atomic_write_json(base + ".pdmodel", program.to_dict())
 
 
 def load(program: Program, model_path: str, executor=None,
@@ -354,8 +422,8 @@ def save_inference_model(dirname: str, feeded_var_names: Sequence[str],
         "feed_specs": feed_specs,
         "format_version": 2,
     }
-    with open(os.path.join(dirname, model_filename or _MODEL_FILE), "w") as f:
-        json.dump(doc, f)
+    atomic_write_json(os.path.join(dirname, model_filename or _MODEL_FILE),
+                      doc)
 
     save_vars(executor, dirname, inference_program, predicate=is_persistable,
               filename=params_filename, scope=scope)

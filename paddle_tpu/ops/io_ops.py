@@ -40,10 +40,13 @@ def save_op(ins, attrs):
     overwrite = bool(attrs.get("overwrite", True))
 
     def host_save(arr):
+        from ..io import atomic_save_npy
+
         if not overwrite and os.path.exists(path):
             raise RuntimeError(f"save: '{path}' exists and overwrite=False")
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        np.save(path, np.asarray(arr))
+        # temp file + fsync + os.replace: a run killed mid-save never
+        # leaves a torn .npy under the final name
+        atomic_save_npy(path, np.asarray(arr))
         return np.zeros((), np.int32)
 
     import jax
@@ -87,11 +90,12 @@ def save_combine_op(ins, attrs):
     overwrite = bool(attrs.get("overwrite", True))
 
     def host_save(*arrays):
+        from ..io import atomic_savez
+
         if not overwrite and os.path.exists(path):
             raise RuntimeError(f"save_combine: '{path}' exists")
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        np.savez(path, **{_encode(n): np.asarray(a)
-                          for n, a in zip(names, arrays)})
+        atomic_savez(path, **{_encode(n): np.asarray(a)
+                              for n, a in zip(names, arrays)})
         return np.zeros((), np.int32)
 
     token = _io_callback(host_save, jax.ShapeDtypeStruct((), np.int32),

@@ -422,7 +422,14 @@ def _prefetch_device_put(batch, mesh=None):
 class _GeneratorLoader:
     """from_generator loader: queue-fed, iterable (reference:
     fluid/reader.py GeneratorLoader). The prefetch thread device_puts
-    with the active mesh's sharding (see _prefetch_device_put)."""
+    with the active mesh's sharding (see _prefetch_device_put).
+
+    Resumable: ``state_dict()`` returns the stream cursor (batches the
+    current iteration has delivered, skipped ones included) and
+    ``set_state()`` arms the NEXT iteration to fast-forward past that
+    many batches — the exact-resume hook the crash-consistent checkpoint
+    stack (paddle_tpu/checkpoint.py, ElasticRunner) stores and restores.
+    Exactness requires the underlying generator to be deterministic."""
 
     def __init__(self, feed_list=None, capacity: int = 16,
                  return_list: bool = False, use_device_put: bool = True,
@@ -434,6 +441,19 @@ class _GeneratorLoader:
         self.mesh = mesh
         self._gen: Optional[Callable] = None
         self._places = None
+        self._position = 0        # cursor of the live/most recent iteration
+        self._skip_next = 0       # armed by set_state for the next iteration
+
+    # -- resumable cursor --------------------------------------------------
+    def state_dict(self) -> Dict[str, int]:
+        """{'batches': N} — position in the (deterministic) batch stream."""
+        return {"batches": int(self._position)}
+
+    def set_state(self, state: Dict[str, int]):
+        """Arm the next iteration to discard the first N batches, so the
+        first delivered batch is the one a restored run expects."""
+        self._skip_next = max(0, int(state.get("batches", 0)))
+        self._position = self._skip_next
 
     # -- configuration ----------------------------------------------------
     def set_sample_generator(self, generator, batch_size: int,
@@ -477,10 +497,18 @@ class _GeneratorLoader:
                 q.put(_END)
 
         threading.Thread(target=produce, daemon=True).start()
+        skip, self._skip_next = self._skip_next, 0
+        self._position = 0
         while True:
             item = q.get()
             if item is _END:
                 break
+            self._position += 1
+            if skip > 0:
+                # fast-forward to the restored cursor: the batch was
+                # produced (deterministic stream) but never delivered
+                skip -= 1
+                continue
             if self.return_list or not names:
                 yield list(item) if isinstance(item, tuple) else [item]
             else:

@@ -1,6 +1,11 @@
-"""Orbax-backed checkpoint tests: save/restore roundtrip, async save,
+"""Crash-consistent checkpoint tests: save/restore roundtrip, async save,
 manager retention + auto-resume (the checkpoint-restart failure-recovery
-path — SURVEY.md §5)."""
+path — SURVEY.md §5), and the atomic-commit/verify/quarantine protocol
+(torn writes, injected save/restore faults, RNG capture, fallback to the
+newest valid checkpoint)."""
+
+import json
+import os
 
 import numpy as np
 import pytest
@@ -66,8 +71,8 @@ class TestCheckpoint:
             exe.run(main, feed={"x": x}, fetch_list=[loss], scope=scope)
             mgr.save(step, main, scope)
         mgr.wait_until_finished()
-        assert mgr._mgr.latest_step() == 4
-        assert len(list(mgr._mgr.all_steps())) == 2   # retention
+        assert mgr.latest_step() == 4
+        assert len(mgr.all_steps()) == 2   # retention
 
         scope2 = pt.Scope()
         exe.run(startup, scope=scope2, use_compiled=False)
@@ -79,6 +84,226 @@ class TestCheckpoint:
         np.testing.assert_allclose(w2, w1, atol=1e-6)
         mgr.close()
         mgr2.close()
+
+
+def _corrupt(path):
+    """Flip one byte in the middle of a file."""
+    raw = bytearray(open(path, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(raw))
+
+
+def _trained(tmp_path, scope, steps=2):
+    main, startup, loss = _program()
+    exe = pt.Executor()
+    exe.run(startup, scope=scope, use_compiled=False)
+    x = np.ones((4, 4), np.float32)
+    for _ in range(steps):
+        exe.run(main, feed={"x": x}, fetch_list=[loss], scope=scope)
+    return main, startup, loss, exe
+
+
+class TestCrashConsistency:
+    """The atomic-commit + manifest-verification protocol."""
+
+    def test_commit_manifest_contents(self, tmp_path, scope):
+        from paddle_tpu.checkpoint import (DATA_NAME, FORMAT, MANIFEST_NAME,
+                                           save_checkpoint)
+
+        main, startup, loss, exe = _trained(tmp_path, scope)
+        p = save_checkpoint(str(tmp_path / "ck"), main, scope)
+        with open(os.path.join(p, MANIFEST_NAME)) as f:
+            man = json.load(f)
+        assert man["format"] == FORMAT and man["committed"] is True
+        assert man["seq"] >= 1 and man["data_file"] == DATA_NAME
+        assert os.path.getsize(os.path.join(p, DATA_NAME)) == \
+            man["data_nbytes"]
+        w_name = next(n for n in man["arrays"] if "w" in n.lower()
+                      or "fc" in n.lower())
+        spec = man["arrays"][w_name]
+        assert set(spec) == {"shape", "dtype", "crc32", "nbytes"}
+        assert "rng" in man["extras"]   # exact-resume RNG capture
+
+    def test_load_rejects_corrupt_data(self, tmp_path, scope):
+        from paddle_tpu.checkpoint import (CheckpointCorruptError, DATA_NAME,
+                                           load_checkpoint, save_checkpoint)
+
+        main, startup, loss, exe = _trained(tmp_path, scope)
+        p = save_checkpoint(str(tmp_path / "ck"), main, scope)
+        _corrupt(os.path.join(p, DATA_NAME))
+        with pytest.raises(CheckpointCorruptError):
+            load_checkpoint(p, main, pt.Scope())
+
+    def test_load_rejects_uncommitted(self, tmp_path, scope):
+        from paddle_tpu.checkpoint import (CheckpointCorruptError,
+                                           MANIFEST_NAME, load_checkpoint,
+                                           save_checkpoint)
+
+        main, startup, loss, exe = _trained(tmp_path, scope)
+        p = save_checkpoint(str(tmp_path / "ck"), main, scope)
+        os.unlink(os.path.join(p, MANIFEST_NAME))
+        with pytest.raises(CheckpointCorruptError):
+            load_checkpoint(p, main, pt.Scope())
+
+    def test_restore_latest_falls_back_and_quarantines(self, tmp_path,
+                                                       scope):
+        from paddle_tpu.checkpoint import (DATA_NAME, QUARANTINE_DIRNAME,
+                                           CheckpointManager)
+        from paddle_tpu.core import telemetry
+
+        main, startup, loss, exe = _trained(tmp_path, scope)
+        x = np.ones((4, 4), np.float32)
+        mgr = CheckpointManager(str(tmp_path / "m"), async_save=False)
+        for s in (1, 2, 3):
+            exe.run(main, feed={"x": x}, fetch_list=[loss], scope=scope)
+            mgr.save(s, main, scope)
+        _corrupt(os.path.join(mgr.directory, "ckpt-%010d" % 3, DATA_NAME))
+        v0 = telemetry.counter_get("ckpt.verify_failures")
+        f0 = telemetry.counter_get("ckpt.fallbacks")
+        q0 = telemetry.counter_get("ckpt.quarantined")
+        scope2 = pt.Scope()
+        exe.run(startup, scope=scope2, use_compiled=False)
+        mgr2 = CheckpointManager(str(tmp_path / "m"), async_save=False)
+        assert mgr2.restore_latest(main, scope2) == 2
+        assert telemetry.counter_get("ckpt.verify_failures") - v0 == 1
+        assert telemetry.counter_get("ckpt.fallbacks") - f0 == 1
+        assert telemetry.counter_get("ckpt.quarantined") - q0 == 1
+        assert os.path.isdir(os.path.join(mgr.directory,
+                                          QUARANTINE_DIRNAME))
+        # the rejected step is gone from the candidate set
+        assert mgr2.all_steps() == [1, 2]
+
+    def test_stale_staging_dir_is_quarantined(self, tmp_path, scope):
+        """A dir a SIGKILLed save left behind is uncommitted garbage:
+        never restored from, swept into quarantine."""
+        from paddle_tpu.checkpoint import CheckpointManager
+
+        main, startup, loss, exe = _trained(tmp_path, scope)
+        mgr = CheckpointManager(str(tmp_path / "m"), async_save=False)
+        mgr.save(1, main, scope)
+        torn = os.path.join(mgr.directory, ".tmp-ckpt-0000000002-123-9")
+        os.makedirs(torn)
+        with open(os.path.join(torn, "state.npz"), "wb") as f:
+            f.write(b"half a checkpoint")
+        scope2 = pt.Scope()
+        exe.run(startup, scope=scope2, use_compiled=False)
+        assert mgr.restore_latest(main, scope2) == 1
+        assert not os.path.exists(torn)
+
+    @pytest.mark.chaos
+    @pytest.mark.parametrize("site", ["ckpt.save.write", "ckpt.save.commit"])
+    def test_injected_save_fault_keeps_previous_checkpoint(
+            self, tmp_path, scope, site):
+        """A save that dies at either fault site must leave the previous
+        checkpoint fully restorable and no torn dir under a final name."""
+        from paddle_tpu.checkpoint import CheckpointManager
+        from paddle_tpu.core import faults
+
+        main, startup, loss, exe = _trained(tmp_path, scope)
+        x = np.ones((4, 4), np.float32)
+        mgr = CheckpointManager(str(tmp_path / "m"), async_save=False)
+        mgr.save(1, main, scope)
+        w1 = np.asarray(scope.find_var(
+            main.all_parameters()[0].name)).copy()
+        exe.run(main, feed={"x": x}, fetch_list=[loss], scope=scope)
+        faults.configure(f"{site}:@1:OSError")
+        try:
+            with pytest.raises(OSError):
+                mgr.save(2, main, scope)
+        finally:
+            faults.configure("")
+        assert mgr.all_steps() == [1]   # no torn final dir
+        scope2 = pt.Scope()
+        exe.run(startup, scope=scope2, use_compiled=False)
+        mgr2 = CheckpointManager(str(tmp_path / "m"), async_save=False)
+        assert mgr2.restore_latest(main, scope2) == 1
+        np.testing.assert_array_equal(
+            np.asarray(scope2.find_var(main.all_parameters()[0].name)), w1)
+
+    @pytest.mark.chaos
+    def test_injected_restore_fault_falls_back(self, tmp_path, scope):
+        from paddle_tpu.checkpoint import CheckpointManager
+        from paddle_tpu.core import faults, telemetry
+
+        main, startup, loss, exe = _trained(tmp_path, scope)
+        x = np.ones((4, 4), np.float32)
+        mgr = CheckpointManager(str(tmp_path / "m"), async_save=False)
+        for s in (1, 2):
+            exe.run(main, feed={"x": x}, fetch_list=[loss], scope=scope)
+            mgr.save(s, main, scope)
+        f0 = telemetry.counter_get("ckpt.fallbacks")
+        faults.configure("ckpt.restore.read:@1:OSError")
+        try:
+            scope2 = pt.Scope()
+            exe.run(startup, scope=scope2, use_compiled=False)
+            assert mgr.restore_latest(main, scope2) == 1
+        finally:
+            faults.configure("")
+        assert telemetry.counter_get("ckpt.fallbacks") - f0 == 1
+
+    def test_rng_state_roundtrips(self, tmp_path, scope):
+        from paddle_tpu import generator
+        from paddle_tpu.checkpoint import (load_checkpoint, save_checkpoint)
+
+        main, startup, loss, exe = _trained(tmp_path, scope)
+        generator.default_generator().set_state((777, 5))
+        want = generator.get_rng_state()
+        p = save_checkpoint(str(tmp_path / "ck"), main, scope)
+        generator.default_generator().set_state((1, 0))
+        load_checkpoint(p, main, pt.Scope())
+        got = generator.get_rng_state()
+        assert tuple(got[0]) == tuple(want[0])
+
+    def test_save_sequence_is_monotonic(self, tmp_path, scope):
+        from paddle_tpu.checkpoint import MANIFEST_NAME, CheckpointManager
+
+        main, startup, loss, exe = _trained(tmp_path, scope)
+        mgr = CheckpointManager(str(tmp_path / "m"), max_to_keep=10,
+                                async_save=False)
+        mgr.save(1, main, scope)
+        mgr.save(2, main, scope)
+        # a new manager over the same dir resumes the sequence, never
+        # reuses a number (the manifest's total order survives restarts)
+        mgr2 = CheckpointManager(str(tmp_path / "m"), max_to_keep=10,
+                                 async_save=False)
+        mgr2.save(3, main, scope)
+        seqs = []
+        for s in (1, 2, 3):
+            with open(os.path.join(mgr.directory, "ckpt-%010d" % s,
+                                   MANIFEST_NAME)) as f:
+                seqs.append(json.load(f)["seq"])
+        assert seqs == sorted(seqs) and len(set(seqs)) == 3
+
+    def test_async_save_failure_surfaces_on_wait(self, tmp_path, scope):
+        from paddle_tpu.checkpoint import (CheckpointManager,
+                                           wait_for_checkpoint)
+        from paddle_tpu.core import faults
+
+        main, startup, loss, exe = _trained(tmp_path, scope)
+        mgr = CheckpointManager(str(tmp_path / "m"), async_save=True)
+        faults.configure("ckpt.save.write:@1:OSError")
+        try:
+            mgr.save(1, main, scope)
+            with pytest.raises(OSError):
+                mgr.wait_until_finished()
+        finally:
+            faults.configure("")
+        # the writer survives a failed job: the next save commits
+        mgr.save(2, main, scope, force=True)
+        wait_for_checkpoint()
+        assert mgr.latest_step() == 2
+
+    def test_telemetry_save_accounting(self, tmp_path, scope):
+        from paddle_tpu.checkpoint import save_checkpoint
+        from paddle_tpu.core import telemetry
+
+        main, startup, loss, exe = _trained(tmp_path, scope)
+        s0 = telemetry.counter_get("ckpt.saves")
+        b0 = telemetry.counter_get("ckpt.bytes")
+        save_checkpoint(str(tmp_path / "ck"), main, scope)
+        assert telemetry.counter_get("ckpt.saves") - s0 == 1
+        assert telemetry.counter_get("ckpt.bytes") > b0
 
 
 class TestElasticRunner:

@@ -1,0 +1,411 @@
+"""Crash consistency end-to-end: kill-during-save subprocess tests and
+exact-resume equivalence (RNG + LR + reader cursor) across the elastic,
+dataset and hapi training paths.
+
+The decisive property (ISSUE 5 acceptance): a run killed mid-save
+restores from the newest VERIFIED checkpoint and reaches final params
+bitwise-identical to an uninterrupted run.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Child for the SIGKILL tests: deterministic 2-layer net, per-step feeds,
+# CheckpointManager save every step, final weights dumped at the end.
+# PT_CKPT_CRASH_AT (checkpoint.py's kill hook) SIGKILLs it mid-save.
+_CHILD = """
+import os, sys
+import numpy as np
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.checkpoint import CheckpointManager
+
+ckpt_dir, out_path, steps = sys.argv[1], sys.argv[2], int(sys.argv[3])
+main, startup = pt.Program(), pt.Program()
+with pt.program_guard(main, startup):
+    x = layers.data("x", [6], stop_gradient=True)
+    h = layers.fc(x, 8, act="relu",
+                  param_attr=pt.ParamAttr(
+                      name="cc_w0", initializer=pt.initializer.Xavier(seed=11)),
+                  bias_attr=pt.ParamAttr(name="cc_b0"))
+    y = layers.fc(h, 1,
+                  param_attr=pt.ParamAttr(
+                      name="cc_w1", initializer=pt.initializer.Xavier(seed=12)),
+                  bias_attr=False)
+    loss = layers.mean(y * y)
+    pt.optimizer.AdamOptimizer(0.01).minimize(loss)
+exe = pt.Executor(pt.CPUPlace())
+scope = pt.Scope()
+exe.run(startup, scope=scope, use_compiled=False)
+mgr = CheckpointManager(ckpt_dir, max_to_keep=10, async_save=False)
+start = mgr.restore_latest(main, scope)
+print("RESUMED_AT", start, flush=True)
+for step in range(start, steps):
+    feed = {"x": np.random.RandomState(100 + step).randn(4, 6)
+            .astype(np.float32)}
+    exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+    mgr.save(step + 1, main, scope)
+np.save(out_path, np.asarray(scope.find_var("cc_w0")))
+print("DONE", flush=True)
+"""
+
+
+def _run_child(tmp_path, ckpt_dir, out_path, steps=6, crash_at=None):
+    script = tmp_path / "_ckpt_child.py"
+    script.write_text(_CHILD)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("PT_CKPT_CRASH_AT", None)
+    if crash_at:
+        env["PT_CKPT_CRASH_AT"] = crash_at
+    return subprocess.run(
+        [sys.executable, str(script), str(ckpt_dir), str(out_path),
+         str(steps)],
+        env=env, cwd=REPO_ROOT, capture_output=True, text=True, timeout=180)
+
+
+class TestKillDuringSave:
+    def test_sigkill_mid_save_resumes_bitwise_identical(self, tmp_path):
+        """SIGKILL the child in the middle of CheckpointManager.save
+        (after the state bytes are staged, before the commit): the
+        rerun must skip the torn step, resume from the previous good
+        checkpoint, and end with final params bitwise-identical to an
+        uninterrupted run."""
+        # uninterrupted reference
+        ref = _run_child(tmp_path, tmp_path / "clean", tmp_path / "w_ref.npy")
+        assert ref.returncode == 0, ref.stdout + ref.stderr
+        assert "RESUMED_AT 0" in ref.stdout
+
+        # crashed run: killed mid-save of step 4's checkpoint
+        crash = _run_child(tmp_path, tmp_path / "ck", tmp_path / "w_a.npy",
+                           crash_at="ckpt.save.commit@4")
+        assert crash.returncode == -signal.SIGKILL, \
+            crash.stdout + crash.stderr
+        assert not (tmp_path / "w_a.npy").exists()
+        # the torn step never appeared under a committed name; the
+        # staging dir it died in is still lying around
+        names = os.listdir(tmp_path / "ck")
+        assert "ckpt-%010d" % 4 not in names
+        assert any(n.startswith(".tmp-ckpt-") for n in names)
+
+        # rerun the SAME command: restores step 3, finishes, matches
+        resume = _run_child(tmp_path, tmp_path / "ck", tmp_path / "w_a.npy")
+        assert resume.returncode == 0, resume.stdout + resume.stderr
+        assert "RESUMED_AT 3" in resume.stdout
+        np.testing.assert_array_equal(np.load(tmp_path / "w_a.npy"),
+                                      np.load(tmp_path / "w_ref.npy"))
+        # the leftover staging dir was swept into quarantine on restore
+        assert not any(n.startswith(".tmp-ckpt-")
+                       for n in os.listdir(tmp_path / "ck"))
+
+    @pytest.mark.chaos
+    def test_chaos_check_checkpoint_cli(self, tmp_path):
+        """Tier-1 smoke of tools/chaos_check.py --checkpoint (satellite:
+        CI/tooling): injected commit faults + a kill/restart must still
+        converge with an auditable tally."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("PT_CKPT_CRASH_AT", None)
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO_ROOT, "tools", "chaos_check.py"),
+             "--checkpoint", "--fault-spec",
+             "ckpt.save.commit:%3,ckpt.restore.read:@1", "--steps", "8",
+             "--telemetry-log", str(tmp_path / "chaos.jsonl")],
+            env=env, cwd=REPO_ROOT, capture_output=True, text=True,
+            timeout=180)
+        assert out.returncode == 0, \
+            f"chaos_check --checkpoint failed:\n{out.stdout[-3000:]}\n" \
+            f"{out.stderr[-3000:]}"
+        assert "CHAOS OK" in out.stdout
+
+
+def _elastic_net():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [8], stop_gradient=True)
+        y = layers.fc(x, 1, param_attr=pt.ParamAttr(name="er_w"),
+                      bias_attr=False)
+        loss = layers.mean(y * y)
+        pt.optimizer.SGDOptimizer(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _batch_stream(n):
+    def gen():
+        for i in range(n):
+            yield np.random.RandomState(500 + i).randn(4, 8) \
+                .astype(np.float32)
+    return gen
+
+
+class TestExactResume:
+    def test_elastic_reader_cursor_resumes_exactly(self, tmp_path):
+        """A step that fails AFTER consuming its batch must re-read that
+        same batch on restart: the runner checkpoints the double-buffer
+        reader's cursor and rearms it on restore. Final params must be
+        bitwise-identical to an uninterrupted run over the same
+        (deterministic, per-step-distinct) stream."""
+        from paddle_tpu.core import ir, unique_name
+        from paddle_tpu.distributed.elastic import ElasticRunner
+        from paddle_tpu.distributed.errors import RpcError
+        from paddle_tpu.reader import DataLoader
+
+        def fresh():
+            ir._main_program, ir._startup_program = (ir.Program(),
+                                                     ir.Program())
+            unique_name.switch()
+            return _elastic_net()
+
+        def train(inject_fail, ckpt):
+            main, startup, loss = fresh()
+            exe = pt.Executor(pt.CPUPlace())
+            scope = pt.Scope()
+            exe.run(startup, scope=scope, use_compiled=False)
+            loader = DataLoader.from_generator(capacity=4, return_list=True)
+            loader.set_batch_generator(_batch_stream(8))
+            runner = ElasticRunner(str(ckpt), main, scope,
+                                   save_interval_steps=1, max_restarts=2,
+                                   reader=loader, async_save=False)
+            it_holder = [iter(loader)]
+            failed = [False]
+
+            def step_fn(step):
+                batch, = next(it_holder[0])
+                if inject_fail and step == 3 and not failed[0]:
+                    failed[0] = True
+                    # the batch is already consumed: without the cursor
+                    # the restarted step would silently train on batch 4
+                    raise RpcError("injected transport failure")
+                out, = exe.run(main, feed={"x": np.asarray(batch)},
+                               fetch_list=[loss], scope=scope)
+                return float(np.asarray(out).reshape(-1)[0])
+
+            def on_restart(step, exc):
+                it_holder[0] = iter(loader)   # rewound by set_state
+
+            runner.run(step_fn, 6, on_restart=on_restart)
+            runner.close()
+            return np.asarray(scope.find_var("er_w")).copy(), runner.restarts
+
+        w_fail, restarts = train(True, tmp_path / "a")
+        w_ok, _ = train(False, tmp_path / "b")
+        assert restarts == 1
+        np.testing.assert_array_equal(w_fail, w_ok)
+
+    def test_train_from_dataset_start_step_resumes_exactly(self, tmp_path):
+        """The dataset-path reader cursor: checkpoint after N batches,
+        reload into a fresh scope, continue with start_step=N — final
+        params bitwise-match one uninterrupted pass."""
+        import itertools
+
+        from paddle_tpu.core import ir, unique_name
+
+        class StubDataset:
+            def __init__(self, n, take=None):
+                self.n, self.take = n, take
+
+            def iter_batches(self):
+                def gen():
+                    for i in range(self.n):
+                        yield {"x": np.random.RandomState(700 + i)
+                               .randn(4, 8).astype(np.float32)}
+                it = gen()
+                return itertools.islice(it, self.take) if self.take else it
+
+        def fresh():
+            ir._main_program, ir._startup_program = (ir.Program(),
+                                                     ir.Program())
+            unique_name.switch()
+            return _elastic_net()
+
+        # uninterrupted: all 6 batches
+        main, startup, loss = fresh()
+        exe = pt.Executor(pt.CPUPlace())
+        scope = pt.Scope()
+        exe.run(startup, scope=scope, use_compiled=False)
+        exe.train_from_dataset(main, StubDataset(6), scope=scope)
+        w_ref = np.asarray(scope.find_var("er_w")).copy()
+
+        # crashed-at-3: train 3 batches, checkpoint, die
+        from paddle_tpu.checkpoint import CheckpointManager
+
+        main, startup, loss = fresh()
+        scope = pt.Scope()
+        exe.run(startup, scope=scope, use_compiled=False)
+        exe.train_from_dataset(main, StubDataset(6, take=3), scope=scope)
+        mgr = CheckpointManager(str(tmp_path / "ds"), async_save=False)
+        mgr.save(3, main, scope, force=True)
+        del scope
+        # restart: fresh scope, restore, resume at the stream cursor
+        scope2 = pt.Scope()
+        exe.run(startup, scope=scope2, use_compiled=False)
+        resumed = mgr.restore_latest(main, scope2)
+        assert resumed == 3
+        exe.train_from_dataset(main, StubDataset(6), scope=scope2,
+                               start_step=resumed)
+        np.testing.assert_array_equal(
+            np.asarray(scope2.find_var("er_w")), w_ref)
+
+    def test_lr_schedule_resumes_exactly(self, tmp_path):
+        """The persistable @LR_DECAY_COUNTER@ rides the checkpoint: a
+        resumed run continues the decay schedule where the crashed run
+        left it (a reset counter would re-warm the LR and diverge)."""
+        from paddle_tpu.checkpoint import CheckpointManager
+        from paddle_tpu.core import ir, unique_name
+
+        def fresh():
+            ir._main_program, ir._startup_program = (ir.Program(),
+                                                     ir.Program())
+            unique_name.switch()
+            main, startup = pt.Program(), pt.Program()
+            with pt.program_guard(main, startup):
+                x = layers.data("x", [8], stop_gradient=True)
+                y = layers.fc(x, 1, param_attr=pt.ParamAttr(name="lrw"),
+                              bias_attr=False)
+                loss = layers.mean(y * y)
+                lr = layers.exponential_decay(0.2, decay_steps=2,
+                                              decay_rate=0.5,
+                                              staircase=True)
+                pt.optimizer.SGDOptimizer(lr).minimize(loss)
+            return main, startup, loss
+
+        def feed(i):
+            return {"x": np.random.RandomState(900 + i).randn(4, 8)
+                    .astype(np.float32)}
+
+        # uninterrupted 6 steps
+        main, startup, loss = fresh()
+        exe = pt.Executor(pt.CPUPlace())
+        scope = pt.Scope()
+        exe.run(startup, scope=scope, use_compiled=False)
+        for i in range(6):
+            exe.run(main, feed=feed(i), fetch_list=[loss], scope=scope)
+        w_ref = np.asarray(scope.find_var("lrw")).copy()
+
+        # 3 steps, checkpoint, die, restore into a fresh scope, resume
+        main, startup, loss = fresh()
+        scope = pt.Scope()
+        exe.run(startup, scope=scope, use_compiled=False)
+        for i in range(3):
+            exe.run(main, feed=feed(i), fetch_list=[loss], scope=scope)
+        ctr = float(np.asarray(
+            scope.find_var("@LR_DECAY_COUNTER@")).reshape(-1)[0])
+        mgr = CheckpointManager(str(tmp_path / "lr"), async_save=False)
+        mgr.save(3, main, scope, force=True)
+        del scope
+        scope2 = pt.Scope()
+        exe.run(startup, scope=scope2, use_compiled=False)
+        assert mgr.restore_latest(main, scope2) == 3
+        np.testing.assert_array_equal(
+            np.asarray(scope2.find_var("@LR_DECAY_COUNTER@")).reshape(-1)[0],
+            ctr)   # the schedule counter came back
+        for i in range(3, 6):
+            exe.run(main, feed=feed(i), fetch_list=[loss], scope=scope2)
+        np.testing.assert_array_equal(np.asarray(scope2.find_var("lrw")),
+                                      w_ref)
+
+    def test_model_fit_resume_from_bitwise(self, tmp_path):
+        """Model.fit(resume_from=...): 2 epochs + crash + rerun-to-4
+        equals 4 uninterrupted epochs, bitwise — network, optimizer and
+        RNG state all ride the verified snapshots."""
+        import paddle_tpu.nn as nn
+        from paddle_tpu.hapi import Model
+        from paddle_tpu.reader import TensorDataset
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(32, 8).astype(np.float32)
+        yw = rng.randn(8, 4)
+        y = np.argmax(x @ yw, axis=1).astype(np.int64)
+        ds = TensorDataset([x, y])
+
+        class MLP(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(8, 16)
+                self.fc2 = nn.Linear(16, 4)
+
+            def forward(self, v):
+                return self.fc2(nn.functional.relu(self.fc1(v)))
+
+        def make_model():
+            with pt.dygraph.guard():
+                net = MLP()
+                model = Model(net)
+                model.prepare(
+                    optimizer=pt.optimizer.SGDOptimizer(
+                        0.1, parameter_list=net.parameters()),
+                    loss=nn.CrossEntropyLoss())
+            return model
+
+        def weights(model):
+            return {k: np.asarray(v.numpy())
+                    for k, v in model.network.state_dict().items()}
+
+        fit_kw = dict(batch_size=8, shuffle=False, verbose=0)
+
+        # uninterrupted 4 epochs (snapshotting along the way)
+        m_ref = make_model()
+        m_ref.fit(ds, epochs=4, resume_from=str(tmp_path / "ref"), **fit_kw)
+        w_ref = weights(m_ref)
+
+        # 2 epochs, "crash" (drop the model), rerun the same fit to 4
+        m1 = make_model()
+        m1.fit(ds, epochs=2, resume_from=str(tmp_path / "cr"), **fit_kw)
+        del m1
+        m2 = make_model()
+        m2.fit(ds, epochs=4, resume_from=str(tmp_path / "cr"), **fit_kw)
+        w2 = weights(m2)
+        assert set(w2) == set(w_ref)
+        for k in w_ref:
+            np.testing.assert_array_equal(w2[k], w_ref[k], err_msg=k)
+
+    def test_model_fit_resume_skips_corrupt_snapshot(self, tmp_path):
+        """A torn epoch snapshot must not poison resume: fit falls back
+        to the newest snapshot that verifies."""
+        import paddle_tpu.nn as nn
+        from paddle_tpu.checkpoint import DATA_NAME
+        from paddle_tpu.hapi import Model
+        from paddle_tpu.reader import TensorDataset
+
+        rng = np.random.RandomState(1)
+        x = rng.randn(16, 8).astype(np.float32)
+        y = rng.randint(0, 4, (16,)).astype(np.int64)
+        ds = TensorDataset([x, y])
+
+        def make_model():
+            with pt.dygraph.guard():
+                net = nn.Sequential(nn.Linear(8, 4))
+                model = Model(net)
+                model.prepare(
+                    optimizer=pt.optimizer.SGDOptimizer(
+                        0.1, parameter_list=net.parameters()),
+                    loss=nn.CrossEntropyLoss())
+            return model
+
+        d = str(tmp_path / "fitq")
+        m1 = make_model()
+        m1.fit(ds, epochs=3, batch_size=8, shuffle=False, verbose=0,
+               resume_from=d)
+        # corrupt the newest snapshot (epoch 3)
+        newest = os.path.join(d, "ckpt-%010d" % 3, DATA_NAME)
+        raw = bytearray(open(newest, "rb").read())
+        raw[len(raw) // 2] ^= 0xFF
+        with open(newest, "wb") as f:
+            f.write(bytes(raw))
+        m2 = make_model()
+        from paddle_tpu.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(d, async_save=False)
+        start = m2._restore_training_state(mgr)
+        assert start == 2   # fell back past the torn epoch-3 snapshot

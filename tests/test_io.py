@@ -92,6 +92,57 @@ def test_save_load_inference_model(tmp_path, scope):
     np.testing.assert_allclose(ref, out, rtol=1e-5, atol=1e-6)
 
 
+def test_atomic_write_torn_write_regression(tmp_path):
+    """A writer that dies mid-payload must leave the previous file
+    byte-identical and no temp litter — the torn-export regression the
+    atomic temp-file + os.replace protocol exists for."""
+    import pytest
+
+    target = tmp_path / "weights.npy"
+    pt.io.atomic_save_npy(str(target), np.arange(8, dtype=np.float32))
+    before = target.read_bytes()
+
+    def torn_writer(f):
+        f.write(b"half a paylo")          # partial bytes hit the temp file
+        raise ConnectionError("killed mid-write")
+
+    with pytest.raises(ConnectionError):
+        pt.io.atomic_write(str(target), torn_writer)
+    assert target.read_bytes() == before          # final name untouched
+    assert [p.name for p in tmp_path.iterdir()] == ["weights.npy"]  # no tmp
+
+
+def test_save_inference_model_overwrite_is_atomic(tmp_path, scope,
+                                                  monkeypatch):
+    """Re-exporting over an existing model dir must not tear
+    __model__.json even if the export dies: the old model keeps
+    loading."""
+    import pytest
+
+    main, startup, logits, loss = _model(optimizer=False)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope, use_compiled=False)
+    mdir = str(tmp_path / "model")
+    pt.io.save_inference_model(mdir, ["x"], [logits], exe, main, scope=scope)
+    good = open(tmp_path / "model" / "__model__.json").read()
+
+    real_dump = pt.io.json.dump
+
+    def exploding_dump(doc, f, *a, **k):
+        f.write('{"torn": ')
+        raise OSError("disk died mid-export")
+
+    monkeypatch.setattr(pt.io.json, "dump", exploding_dump)
+    with pytest.raises(OSError):
+        pt.io.save_inference_model(mdir, ["x"], [logits], exe, main,
+                                   scope=scope)
+    monkeypatch.setattr(pt.io.json, "dump", real_dump)
+    assert open(tmp_path / "model" / "__model__.json").read() == good
+    prog, feeds, fetches = pt.io.load_inference_model(mdir, exe,
+                                                      scope=pt.Scope())
+    assert feeds == ["x"]
+
+
 def test_static_save_load_state(tmp_path, scope):
     main, startup, logits, loss = _model()
     exe = pt.Executor(pt.CPUPlace())

@@ -1,7 +1,10 @@
 """EMA / ModelAverage / Lookahead wrapper optimizers
-(reference: fluid test_ema.py, test_lookahead.py, ModelAverage tests)."""
+(reference: fluid test_ema.py, test_lookahead.py, ModelAverage tests),
+plus the dygraph optimizer state_dict/set_state_dict restore paths the
+crash-consistent checkpoint stack depends on."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu as pt
 from paddle_tpu import layers
@@ -95,3 +98,85 @@ def test_model_average_window_bounded(scope):
     with ma.apply(exe, scope=scope):
         np.testing.assert_allclose(np.array(scope.find_var(w)), w_now,
                                    rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# dygraph optimizer state restore (checkpoint/exact-resume dependency)
+# ---------------------------------------------------------------------------
+
+def _dy_train(net, opt, x, y, steps):
+    from paddle_tpu.nn import functional as F
+
+    for _ in range(steps):
+        loss = F.cross_entropy(net(pt.dygraph.to_variable(x)),
+                               pt.dygraph.to_variable(y))
+        loss.backward()
+        opt.minimize(loss)
+        net.clear_gradients()
+
+
+def test_set_state_dict_into_fresh_optimizer():
+    """Restore-into-fresh-optimizer: state saved mid-run applies through
+    the pending-state path (set BEFORE the first step builds the
+    micro-program) and the continued run matches an uninterrupted one —
+    Adam's moments must carry over, not restart cold."""
+    from paddle_tpu import nn
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(16, 4).astype(np.float32)
+    y = (x.sum(1) > 2).astype(np.int32).reshape(16, 1)
+
+    def make():
+        net = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+        opt = pt.optimizer.AdamOptimizer(0.05,
+                                         parameter_list=net.parameters())
+        return net, opt
+
+    with pt.dygraph.guard():
+        net_a, opt_a = make()
+        _dy_train(net_a, opt_a, x, y, 4)
+        w_ref = {k: v.numpy().copy() for k, v in net_a.state_dict().items()}
+        st_ref = {k: np.asarray(v).copy()
+                  for k, v in opt_a.state_dict().items()}
+
+        # run B: 2 steps, checkpoint, then a FRESH net+optimizer resumes
+        net_b, opt_b = make()
+        _dy_train(net_b, opt_b, x, y, 2)
+        net_state = {k: v.numpy().copy() for k, v in net_b.state_dict().items()}
+        opt_state = {k: np.asarray(v).copy()
+                     for k, v in opt_b.state_dict().items()}
+        assert any("#" in k for k in opt_state)   # positional accum keys
+
+        net_c, opt_c = make()
+        net_c.set_state_dict(net_state)
+        opt_c.set_state_dict(opt_state)           # pending path: no scope yet
+        assert getattr(opt_c, "_pending_state", None)
+        _dy_train(net_c, opt_c, x, y, 2)
+        w_c = {k: v.numpy() for k, v in net_c.state_dict().items()}
+        for k in w_ref:
+            np.testing.assert_allclose(w_c[k], w_ref[k], rtol=1e-6,
+                                       atol=1e-7, err_msg=k)
+        st_c = opt_c.state_dict()
+        for k in st_ref:
+            np.testing.assert_allclose(np.asarray(st_c[k]),
+                                       np.asarray(st_ref[k]), rtol=1e-6,
+                                       atol=1e-7, err_msg=k)
+
+
+def test_set_state_dict_stale_keys_raise():
+    """Stale-checkpoint keys (a different optimizer type's accumulators)
+    must raise the 'restored 0 entries' error, not silently train with
+    cold state."""
+    from paddle_tpu import nn
+
+    rng = np.random.RandomState(1)
+    x = rng.rand(8, 4).astype(np.float32)
+    y = (x.sum(1) > 2).astype(np.int32).reshape(8, 1)
+    with pt.dygraph.guard():
+        net = nn.Sequential(nn.Linear(4, 2))
+        opt = pt.optimizer.AdamOptimizer(0.05,
+                                         parameter_list=net.parameters())
+        _dy_train(net, opt, x, y, 1)   # accumulators + scope now exist
+        with pytest.raises(ValueError, match="restored 0 entries"):
+            opt.set_state_dict({"bogus_acc#0": np.zeros((2,), np.float32),
+                                "bogus_acc#1": np.zeros((2,), np.float32)})

@@ -247,3 +247,60 @@ class TestKillRestart:
         finally:
             srv.shutdown()
             RPCClient.reset_pool()
+
+
+class TestVerifiedSnapshots:
+    """PServer snapshots ride the atomic-commit protocol: a torn
+    snapshot must fail verification loudly instead of silently serving
+    wrong parameters."""
+
+    def test_corrupt_pserver_snapshot_rejected(self, tmp_path):
+        import paddle_tpu as pt
+        from paddle_tpu.distributed.ps.pserver import PServer
+        from paddle_tpu.distributed.ps.rpc import RPCClient
+
+        _fresh()
+        prog, startup = pt.Program(), pt.Program()
+        prog.global_block().create_var(name="vp", shape=[4],
+                                       dtype="float32", persistable=True)
+        v = startup.global_block().create_var(
+            name="vp", shape=[4], dtype="float32", persistable=True)
+        from paddle_tpu.initializer import Constant
+
+        Constant(2.0)(v, startup.global_block())
+        apply_op = pt.core.ir.OpDesc(
+            "sgd", {"Param": ["vp"], "Grad": ["vp@GRAD"],
+                    "LearningRate": ["vlr"]},
+            {"ParamOut": ["vp"]}, {})
+        lv = startup.global_block().create_var(
+            name="vlr", shape=[1], dtype="float32", persistable=True)
+        Constant(0.5)(lv, startup.global_block())
+        prog.global_block().create_var(name="vlr", shape=[1],
+                                       dtype="float32", persistable=True)
+        srv = PServer("127.0.0.1:0", prog, startup, num_trainers=1,
+                      sync_mode=False, grad_to_param={"vp@GRAD": "vp"},
+                      grad_to_ops={"vp@GRAD": [apply_op]})
+        try:
+            cli = RPCClient.get(srv.endpoint)
+            d = str(tmp_path / "snap")
+            cli.call("checkpoint", d + "|0")
+            # the snapshot is a committed checkpoint dir with a manifest
+            from paddle_tpu.checkpoint import DATA_NAME, MANIFEST_NAME
+
+            sdir = os.path.join(d, "pserver_0")
+            assert os.path.exists(os.path.join(sdir, MANIFEST_NAME))
+            # corrupt the data file: the verified load must refuse it
+            data = os.path.join(sdir, DATA_NAME)
+            raw = bytearray(open(data, "rb").read())
+            raw[len(raw) // 2] ^= 0xFF
+            with open(data, "wb") as f:
+                f.write(bytes(raw))
+            before = np.asarray(srv.scope.find_var("vp")).copy()
+            with pytest.raises(Exception, match="(?i)corrupt|sha256|crc"):
+                cli.call("checkpoint_load", d + "|0")
+            # the server scope was not poisoned by the torn bytes
+            np.testing.assert_array_equal(
+                np.asarray(srv.scope.find_var("vp")), before)
+        finally:
+            srv.shutdown()
+            RPCClient.reset_pool()

@@ -273,6 +273,57 @@ class TestPerfReport:
         assert s["timers"]["executor.run_ms"]["count"] == 2
         assert s["records"] > 0 and s["span_s"] >= 0
 
+    def test_checkpoint_section(self, scope, tmp_path):
+        """A run that saves/restores through the crash-consistent
+        protocol gets a checkpoint section: commits, verify rejections,
+        fallbacks, save/restore latency percentiles."""
+        import numpy as np
+
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+        from paddle_tpu.checkpoint import DATA_NAME, CheckpointManager
+        from paddle_tpu.core import telemetry
+
+        sys.path.insert(0, REPO_ROOT)
+        try:
+            from tools.perf_report import load, render, summarize_log
+        finally:
+            sys.path.remove(REPO_ROOT)
+        log = tmp_path / "ckpt_run.jsonl"
+        telemetry.configure(str(log))
+        try:
+            main, startup = pt.Program(), pt.Program()
+            with pt.program_guard(main, startup):
+                x = layers.data("x", [4], stop_gradient=True)
+                loss = layers.mean(layers.fc(x, 4))
+                pt.optimizer.SGDOptimizer(0.1).minimize(loss)
+            exe = pt.Executor()
+            exe.run(startup, scope=scope, use_compiled=False)
+            mgr = CheckpointManager(str(tmp_path / "m"), async_save=False)
+            for s in (1, 2):
+                exe.run(main, feed={"x": np.ones((4, 4), np.float32)},
+                        fetch_list=[loss], scope=scope)
+                mgr.save(s, main, scope)
+            data = os.path.join(mgr.directory, "ckpt-%010d" % 2, DATA_NAME)
+            raw = bytearray(open(data, "rb").read())
+            raw[len(raw) // 2] ^= 0xFF
+            with open(data, "wb") as f:
+                f.write(bytes(raw))
+            assert mgr.restore_latest(main, pt.Scope()) == 1
+        finally:
+            telemetry.configure(None)
+        s = summarize_log(load(str(log)))
+        ck = s["checkpoint"]
+        assert ck["saves"] >= 2 and ck["restores"] >= 1
+        assert ck["verify_failures"] >= 1 and ck["fallbacks"] >= 1
+        assert ck["bytes"] > 0 and "save_ms" in ck
+        import io as _io
+
+        buf = _io.StringIO()
+        render(s, out=buf)
+        assert "checkpointing (atomic commits + verification)" in \
+            buf.getvalue()
+
     def test_malformed_lines_skipped(self, tmp_path):
         sys.path.insert(0, REPO_ROOT)
         try:

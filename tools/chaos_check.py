@@ -19,12 +19,22 @@ response — injected handler faults must surface as per-request error
 responses, never a wedged queue — and that the engine still serves
 cleanly once the fault spec is cleared.
 
+With ``--checkpoint`` it chaos-tests the crash-consistent checkpoint
+protocol (paddle_tpu/checkpoint.py): an ElasticRunner trains under a
+``ckpt.*`` fault spec (save write/commit failures become elastic
+restarts from the newest VERIFIED checkpoint), the run then "dies" —
+the trainer scope is discarded — and a fresh scope restores and keeps
+training. Asserts convergence across the kill/restart and prints the
+ckpt.saves / verify_failures / fallbacks / quarantined tally.
+
 Examples:
     python tools/chaos_check.py --fault-spec "ps.rpc.send:0.1" --seed 7
     python tools/chaos_check.py --fault-spec "ps.rpc.recv:%9" --steps 8 \
         --servers 2 --telemetry-log /tmp/chaos.jsonl
     python tools/chaos_check.py --serving \
         --fault-spec "serving.handler:%3" --requests 24
+    python tools/chaos_check.py --checkpoint \
+        --fault-spec "ckpt.save.commit:%3,ckpt.restore.read:@1" --steps 8
 
 Exit status: 0 on success, 2 when the run failed or did not converge.
 Stdlib-only CLI surface (argparse); everything heavier lives in
@@ -253,6 +263,98 @@ def run_serving(args) -> int:
     return 0
 
 
+def run_checkpoint(args) -> int:
+    """--checkpoint mode: train under ckpt.* faults with elastic
+    checkpoint-restart, kill the trainer (drop its scope), restore into
+    a fresh one, and prove the run still converges with every rejected
+    checkpoint accounted for."""
+    import tempfile
+
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.core import faults, telemetry
+    from paddle_tpu.distributed.elastic import ElasticRunner
+
+    if args.telemetry_log:
+        telemetry.configure(args.telemetry_log)
+    spec = args.fault_spec or "ckpt.save.commit:%3"
+    faults.configure(spec, seed=args.seed)
+
+    main_prog, startup, loss = build_net(args.lr)
+    exe = pt.Executor(pt.CPUPlace())
+    feed = {"x": np.random.RandomState(3000).randn(16, 16)
+            .astype(np.float32)}
+    losses = []
+
+    def make_step_fn(scope):
+        def step_fn(step):
+            out = exe.run(main_prog, feed=feed, fetch_list=[loss],
+                          scope=scope, use_compiled=False)
+            val = float(np.asarray(out[0]).reshape(-1)[0])
+            losses.append(val)
+            print(f"LOSS {step} {val:.6f}", flush=True)
+            return val
+        return step_fn
+
+    half = max(2, args.steps // 2)
+    with tempfile.TemporaryDirectory(prefix="pt_chaos_ckpt_") as ckpt_dir:
+        # phase 1: train half the steps under injected checkpoint faults
+        scope = pt.Scope()
+        exe.run(startup, scope=scope, use_compiled=False)
+        runner = ElasticRunner(ckpt_dir, main_prog, scope,
+                               save_interval_steps=1, max_restarts=100,
+                               async_save=False)
+        runner.run(make_step_fn(scope), half)
+        restarts1 = runner.restarts
+        # phase 2: the "kill" — discard the scope, restore into a fresh
+        # one (still under the fault spec: restore must fall back past
+        # any candidate it can't verify) and finish the run
+        del scope
+        scope2 = pt.Scope()
+        exe.run(startup, scope=scope2, use_compiled=False)
+        runner2 = ElasticRunner(ckpt_dir, main_prog, scope2,
+                                save_interval_steps=1, max_restarts=100,
+                                async_save=False)
+        runner2.run(make_step_fn(scope2), args.steps)
+        runner2.close()
+
+    counters = telemetry.counters()
+    tally_keys = ("faults.injected", "ckpt.saves", "ckpt.restores",
+                  "ckpt.verify_failures", "ckpt.fallbacks",
+                  "ckpt.quarantined")
+    print("-- checkpoint chaos tally " + "-" * 23)
+    for key in tally_keys:
+        print(f"{key:28s} {int(counters.get(key, 0))}")
+    inj = faults.counts()["injected"]
+    for site, n in sorted(inj.items()):
+        print(f"  injected@{site:18s} {n}")
+    print(f"elastic restarts: {restarts1} + {runner2.restarts}")
+
+    if not all(np.isfinite(v) for v in losses):
+        print("CHAOS FAIL: non-finite loss under injected ckpt faults")
+        return 2
+    if losses[-1] >= losses[0]:
+        print(f"CHAOS FAIL: loss did not converge across the "
+              f"kill/restart ({losses[0]:.6f} -> {losses[-1]:.6f})")
+        return 2
+    injected = int(counters.get("faults.injected", 0))
+    if args.fault_spec and not injected:
+        print("CHAOS WARN: fault spec never fired (run too short for "
+              "the trigger?)")
+    if injected and not (counters.get("ckpt.verify_failures", 0)
+                         or restarts1 or runner2.restarts):
+        print("CHAOS FAIL: faults were injected but neither the verifier "
+              "nor the elastic runner ever saw one")
+        return 2
+    print(f"CHAOS OK: {args.steps} steps across a kill/restart, loss "
+          f"{losses[0]:.6f} -> {losses[-1]:.6f}, {injected} faults "
+          f"injected, {int(counters.get('ckpt.saves', 0))} commits, "
+          f"{int(counters.get('ckpt.verify_failures', 0))} checkpoints "
+          f"rejected")
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser(
         description="run a short PS training loop under fault injection "
@@ -263,6 +365,11 @@ def main():
     ap.add_argument("--serving", action="store_true",
                     help="chaos-test the micro-batching serving engine "
                          "(serving.handler site) instead of the PS loop")
+    ap.add_argument("--checkpoint", action="store_true",
+                    help="chaos-test the crash-consistent checkpoint "
+                         "protocol (ckpt.save.write/commit + "
+                         "ckpt.restore.read sites) with an elastic "
+                         "kill/restart instead of the PS loop")
     ap.add_argument("--requests", type=int, default=24,
                     help="--serving mode: total client requests")
     ap.add_argument("--seed", type=int, default=0,
@@ -277,7 +384,11 @@ def main():
     ap.add_argument("--telemetry-log", default="",
                     help="also write the JSONL run log here")
     args = ap.parse_args()
-    sys.exit(run_serving(args) if args.serving else run(args))
+    if args.serving:
+        sys.exit(run_serving(args))
+    if args.checkpoint:
+        sys.exit(run_checkpoint(args))
+    sys.exit(run(args))
 
 
 if __name__ == "__main__":

@@ -20,6 +20,10 @@ Renders the structured run log written by ``paddle_tpu.core.telemetry``
   (paddle_tpu/serving/): request/batch counts, batch-fill ratio,
   padding overhead, rejects/deadline-drops, and request/batch latency
   percentiles;
+* a "Checkpointing" section when the run saved/restored through the
+  crash-consistent protocol (paddle_tpu/checkpoint.py): commits, bytes,
+  verification rejections + fallbacks to older checkpoints, quarantined
+  dirs, and save/restore latency percentiles;
 * the profiler.summarize() host-span table when the log carries one
   (telemetry.flush() embeds it at exit).
 
@@ -124,9 +128,11 @@ def summarize_log(recs):
     fused = _fused_summary(counter_delta, counter_last, timer_summary)
     serving = _serving_summary(counter_delta, counter_last, timer_summary,
                                gauges)
+    ckpt = _ckpt_summary(counter_delta, counter_last, timer_summary)
     return {
         "fused": fused,
         "serving": serving,
+        "checkpoint": ckpt,
         "records": len(recs),
         "span_s": round(max(ts) - min(ts), 3) if ts else 0.0,
         "timers": timer_summary,
@@ -215,6 +221,40 @@ def _serving_summary(counter_delta, counter_last, timer_summary, gauges):
     return out
 
 
+def _ckpt_summary(counter_delta, counter_last, timer_summary):
+    """Crash-consistent checkpoint accounting (paddle_tpu/checkpoint.py):
+    commits, bytes, verification rejections + fallbacks, and save/restore
+    latency percentiles."""
+
+    def cval(name):
+        v = counter_delta.get(name) or counter_last.get(name) or 0
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            return 0.0
+
+    saves = cval("ckpt.saves")
+    restores = cval("ckpt.restores")
+    if not saves and not restores:
+        return None
+    out = {"saves": int(saves), "restores": int(restores),
+           "bytes": int(cval("ckpt.bytes")),
+           "verify_failures": int(cval("ckpt.verify_failures")),
+           "fallbacks": int(cval("ckpt.fallbacks")),
+           "quarantined": int(cval("ckpt.quarantined"))}
+    if saves:
+        out["bytes_per_save"] = int(out["bytes"] / saves)
+    for timer, key in (("ckpt.save_ms", "save_ms"),
+                       ("ckpt.restore_ms", "restore_ms")):
+        t = timer_summary.get(timer)
+        if t:
+            out[key] = {"p50": t["p50"], "p99": t["p99"], "max": t["max"]}
+    ps = cval("ps.checkpoints")
+    if ps:
+        out["ps_checkpoints"] = int(ps)
+    return out
+
+
 def _fmt_num(v):
     if isinstance(v, float):
         return f"{v:,.3f}".rstrip("0").rstrip(".")
@@ -282,6 +322,25 @@ def render(s, out=sys.stdout):
                   f"  max {t['max']}\n")
         if "last_queue_depth" in sv:
             w(f"last queue depth: {_fmt_num(sv['last_queue_depth'])}\n")
+
+    if s.get("checkpoint"):
+        ck = s["checkpoint"]
+        w("\n-- checkpointing (atomic commits + verification) --\n")
+        w(f"saves: {ck['saves']}  restores: {ck['restores']}  bytes: "
+          f"{_fmt_num(ck['bytes'])}")
+        if "bytes_per_save" in ck:
+            w(f"  ({_fmt_num(ck['bytes_per_save'])}/save)")
+        w("\n")
+        w(f"verify failures: {ck['verify_failures']}  fallbacks: "
+          f"{ck['fallbacks']}  quarantined: {ck['quarantined']}\n")
+        for key, label in (("save_ms", "save latency"),
+                           ("restore_ms", "restore latency")):
+            if key in ck:
+                t = ck[key]
+                w(f"{label} ms: p50 {t['p50']}  p99 {t['p99']}"
+                  f"  max {t['max']}\n")
+        if "ps_checkpoints" in ck:
+            w(f"pserver snapshots: {ck['ps_checkpoints']}\n")
 
     if s["counters"]:
         w("\n-- counters (delta over log / final) --\n")
