@@ -24,7 +24,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
-from . import registry, telemetry
+from . import registry, telemetry, trace
 from .ir import Block, OpDesc, Program, Variable, default_main_program
 from .registry import EMPTY_VAR
 from .scope import Scope, global_scope
@@ -345,31 +345,39 @@ class Executor:
             telemetry.counter_add("executor.feed_host_bytes",
                                   int(feed_host_bytes))
 
-        block = program.global_block()
-        # cast feeds to declared dtypes
-        for name in list(feed):
-            dtype = None
-            if block.has_var(name):
-                dtype = block.var(name).dtype
-            feed[name] = _as_device_array(feed[name], dtype)
+        with trace.span("executor.run", program=program.uid):
+            block = program.global_block()
+            # cast feeds to declared dtypes
+            with trace.span("executor.feed", feeds=len(feed)):
+                for name in list(feed):
+                    dtype = None
+                    if block.has_var(name):
+                        dtype = block.var(name).dtype
+                    feed[name] = _as_device_array(feed[name], dtype)
 
-        # PS send/recv ops do host network IO — route to the interpreting
-        # (op-by-op) path, the reference's executor model for PS workloads
-        if use_compiled and self._has_ps_io(program):
-            use_compiled = False
-            telemetry.counter_add("executor.ps_io_detours", 1,
-                                  program=program.uid)
+            # PS send/recv ops do host network IO — route to the
+            # interpreting (op-by-op) path, the reference's executor model
+            # for PS workloads
+            if use_compiled and self._has_ps_io(program):
+                use_compiled = False
+                telemetry.counter_add("executor.ps_io_detours", 1,
+                                      program=program.uid)
 
-        telemetry.counter_add("executor.runs_compiled" if use_compiled
-                              else "executor.runs_interpreted", 1)
-        if use_compiled:
-            fetched = self._run_compiled(program, block, feed, fetch_names, scope,
-                                         mesh, in_shardings)
-        else:
-            with telemetry.timer("executor.interpret_ms"):
-                fetched = self._run_interpreted(program, block, feed,
-                                                fetch_names, scope, mesh)
-        return self._materialize_fetches(fetched, return_numpy, sync_fetch)
+            telemetry.counter_add("executor.runs_compiled" if use_compiled
+                                  else "executor.runs_interpreted", 1)
+            if use_compiled:
+                with trace.span("executor.dispatch", compiled=True):
+                    fetched = self._run_compiled(program, block, feed,
+                                                 fetch_names, scope,
+                                                 mesh, in_shardings)
+            else:
+                with telemetry.timer("executor.interpret_ms"), \
+                        trace.span("executor.dispatch", compiled=False):
+                    fetched = self._run_interpreted(program, block, feed,
+                                                    fetch_names, scope, mesh)
+            with trace.span("executor.fetch", sync=sync_fetch):
+                return self._materialize_fetches(fetched, return_numpy,
+                                                 sync_fetch)
 
     @staticmethod
     def _materialize_fetches(fetched, return_numpy, sync_fetch):
@@ -444,41 +452,47 @@ class Executor:
             telemetry.counter_add("executor.feed_host_bytes",
                                   int(feed_host_bytes))
 
-        block = program.global_block()
-        # cast stacked feeds to declared per-step dtypes (the leading k
-        # axis does not change dtype)
-        for name in list(feed):
-            dtype = None
-            if block.has_var(name):
-                dtype = block.var(name).dtype
-            feed[name] = _as_device_array(feed[name], dtype)
+        with trace.span("executor.run_steps", program=program.uid, k=k):
+            block = program.global_block()
+            # cast stacked feeds to declared per-step dtypes (the leading k
+            # axis does not change dtype)
+            with trace.span("executor.feed", feeds=len(feed)):
+                for name in list(feed):
+                    dtype = None
+                    if block.has_var(name):
+                        dtype = block.var(name).dtype
+                    feed[name] = _as_device_array(feed[name], dtype)
 
-        # fusion is illegal across host-IO ops: fall back to k sequential
-        # single-step runs (still correct, no amortization)
-        if self._has_ps_io(program):
-            telemetry.counter_add("executor.fused_fallback_steps", k,
-                                  program=program.uid)
-            outs = []
-            for i in range(k):
-                outs.append(self.run(
-                    program, feed={n: v[i] for n, v in feed.items()},
-                    fetch_list=fetch_names, scope=scope,
-                    return_numpy=return_numpy, mesh=mesh,
-                    sync_fetch=sync_fetch))
-            if not fetch_names:
-                return []
-            stack = np.stack if (return_numpy and sync_fetch) else None
-            if stack is None:
-                import jax.numpy as jnp
+            # fusion is illegal across host-IO ops: fall back to k
+            # sequential single-step runs (still correct, no amortization)
+            if self._has_ps_io(program):
+                telemetry.counter_add("executor.fused_fallback_steps", k,
+                                      program=program.uid)
+                outs = []
+                for i in range(k):
+                    outs.append(self.run(
+                        program, feed={n: v[i] for n, v in feed.items()},
+                        fetch_list=fetch_names, scope=scope,
+                        return_numpy=return_numpy, mesh=mesh,
+                        sync_fetch=sync_fetch))
+                if not fetch_names:
+                    return []
+                stack = np.stack if (return_numpy and sync_fetch) else None
+                if stack is None:
+                    import jax.numpy as jnp
 
-                stack = jnp.stack
-            return [stack([o[i] for o in outs])
-                    for i in range(len(fetch_names))]
+                    stack = jnp.stack
+                return [stack([o[i] for o in outs])
+                        for i in range(len(fetch_names))]
 
-        telemetry.counter_add("executor.runs_compiled", 1)
-        fetched = self._run_compiled(program, block, feed, fetch_names,
-                                     scope, mesh, in_shardings, scan_k=k)
-        return self._materialize_fetches(fetched, return_numpy, sync_fetch)
+            telemetry.counter_add("executor.runs_compiled", 1)
+            with trace.span("executor.dispatch", compiled=True, k=k):
+                fetched = self._run_compiled(program, block, feed,
+                                             fetch_names, scope, mesh,
+                                             in_shardings, scan_k=k)
+            with trace.span("executor.fetch", sync=sync_fetch):
+                return self._materialize_fetches(fetched, return_numpy,
+                                                 sync_fetch)
 
     # -- interpreting path ---------------------------------------------------
     def _run_interpreted(self, program, block, feed, fetch_names, scope,

@@ -103,6 +103,30 @@ define_flag("telemetry_path", "",
             "telemetry.py); empty disables the sink. The PT_TELEMETRY_LOG "
             "env var is an alias with lower precedence. Render with "
             "tools/perf_report.py")
+define_flag("telemetry_buffer_lines", 64,
+            "JSONL sink line-batching: records buffer in memory and are "
+            "written as one batched write once this many lines are "
+            "pending (or telemetry_flush_s elapses, or flush_sink() is "
+            "called); 1 restores write-through. Sink write failures are "
+            "counted in telemetry.dropped_records, never raised into the "
+            "instrumented thread")
+define_flag("telemetry_flush_s", 0.25,
+            "max seconds a buffered JSONL record waits before the sink "
+            "flushes it (inline on the next emit + a lazy daemon flusher "
+            "thread); flush also happens at exit and on path change")
+define_flag("metrics_window_s", 60.0,
+            "rolling-window length for the live metrics plane "
+            "(telemetry.windowed / prometheus_text / the /metrics "
+            "endpoints): counter rates and histogram p50/p95/p99 are "
+            "computed over the last this-many seconds")
+define_flag("trace_sample_rate", 0.0,
+            "distributed-tracing sample rate in [0, 1] (core/trace.py): "
+            "the probability a ROOT span starts a sampled trace whose "
+            "spans are emitted as kind:'span' JSONL records (merged "
+            "across processes by tools/trace_view.py). Children and "
+            "propagated remote contexts never re-sample. 0 (default) "
+            "disables tracing at ~zero cost; a serving request carrying "
+            "an X-Request-Id header is always traced")
 define_flag("exec_steps_per_dispatch", 1,
             "K-step fused execution: the static training loops "
             "(Executor.train_from_dataset, tools/bench_models.py) stack K "

@@ -26,12 +26,29 @@ cause), ``step`` (hapi per-step metrics), ``metric`` (bench results),
 ``fallback`` (degraded-path latches), ``fault`` (one injected fault from
 the core/faults.py harness: name = site, value = per-site injection
 count, attrs.exc = raised type — pairs with the ``faults.injected``
-counter so chaos runs are auditable), ``snapshot`` (full registry dump at
-flush/exit), ``profiler_summary`` (one line per profiler.summarize row).
+counter so chaos runs are auditable), ``span`` (one finished distributed-
+tracing span from core/trace.py: value = duration ms, attrs = trace/
+span/parent ids + start + pid — merged across processes by
+tools/trace_view.py), ``snapshot`` (full registry dump at flush/exit),
+``profiler_summary`` (one line per profiler.summarize row).
 
 In-memory aggregation (counters/gauges/histograms) is ALWAYS on — it is
 a few dict updates per executor run, invisible next to a device step.
-JSONL records are written only when a sink path is configured.
+JSONL records are written only when a sink path is configured; the sink
+batches lines in memory and flushes when the buffer reaches
+``FLAGS_telemetry_buffer_lines``, every ``FLAGS_telemetry_flush_s``
+seconds (a lazy daemon flusher), on ``flush_sink()``/``flush()``, on a
+path change, and atexit. Sink write failures NEVER raise into the
+instrumented thread — they are counted in ``telemetry.dropped_records``.
+
+Live metrics plane: every counter increment and histogram observation is
+also tracked in a rolling window (1-second delta buckets / timestamped
+sample rings), so ``windowed()`` yields last-``FLAGS_metrics_window_s``
+rates and p50/p95/p99 while the run is live, ``prometheus_text()``
+renders them in Prometheus exposition format, and
+``start_metrics_server(port)`` serves ``GET /metrics`` from any process
+(trainer, pserver, serving worker) — the pull-based scrape surface the
+cluster control plane (ROADMAP item 2) load-balances on.
 """
 
 from __future__ import annotations
@@ -40,9 +57,11 @@ import atexit
 import contextlib
 import json
 import os
+import re
 import sys
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, Optional
 
 from . import flags as _flags
@@ -50,6 +69,8 @@ from . import flags as _flags
 SCHEMA_FIELDS = ("ts", "kind", "name", "value", "attrs")
 
 _HIST_SAMPLE_CAP = 8192  # per-histogram retained samples (sliding ring)
+_WIN_BUCKET_CAP = 600    # rolling-window 1 s counter buckets (10 min cap)
+_WIN_SAMPLE_CAP = 8192   # rolling-window retained histogram samples
 
 
 class _Hist:
@@ -91,7 +112,7 @@ class _Hist:
                 "max": round(self.vmax, 3) if self.count else 0.0,
                 "avg": round(self.total / self.count, 3) if self.count else 0.0,
                 "p50": round(pct(0.50), 3), "p90": round(pct(0.90), 3),
-                "p99": round(pct(0.99), 3)}
+                "p95": round(pct(0.95), 3), "p99": round(pct(0.99), 3)}
 
 
 class TelemetryRegistry:
@@ -106,6 +127,14 @@ class TelemetryRegistry:
         self._file = None
         self._path: Optional[str] = None
         self._sink_warned = False
+        # buffered sink: pending JSONL lines + flush bookkeeping
+        self._buf: list = []
+        self._last_flush = 0.0
+        self._flusher_started = False
+        # rolling window: per-counter 1 s delta buckets ([sec, sum]) and
+        # per-histogram (ts, value) sample rings — pruned lazily on read
+        self._win_counts: Dict[str, deque] = {}
+        self._win_samples: Dict[str, deque] = {}
 
     @classmethod
     def instance(cls) -> "TelemetryRegistry":
@@ -125,19 +154,23 @@ class TelemetryRegistry:
     def _sink(self):
         """Current sink file (called under self._lock); follows flag/env
         changes so set_flags({'FLAGS_telemetry_path': ...}) takes effect
-        mid-run and '' closes the sink."""
+        mid-run and '' closes the sink (flushing the buffer into the old
+        file first — readers of a just-closed log see every record)."""
         path = self._resolve_path()
         if path != self._path:
             if self._file is not None:
+                self._flush_buf_locked()
                 try:
                     self._file.close()
                 except OSError:
                     pass
                 self._file = None
+            self._buf.clear()
             self._path = path
             if path:
                 try:
-                    self._file = open(path, "a", buffering=1)
+                    self._file = open(path, "a")
+                    self._last_flush = time.time()
                 except OSError as e:
                     if not self._sink_warned:
                         self._sink_warned = True
@@ -145,6 +178,52 @@ class TelemetryRegistry:
                               file=sys.stderr)
                     self._path = None
         return self._file
+
+    def _drop_locked(self, n: int):
+        """Count records lost to a failing sink — in-memory only (a
+        counter_add here would recurse into emit)."""
+        self._counters["telemetry.dropped_records"] = \
+            self._counters.get("telemetry.dropped_records", 0) + n
+
+    def _flush_buf_locked(self):
+        """Write the buffered lines as ONE batched write + flush (called
+        under self._lock). A failing filesystem must never raise into the
+        executor/serving thread that happened to trigger the flush."""
+        if not self._buf or self._file is None:
+            return
+        batch, self._buf = self._buf, []
+        self._last_flush = time.time()
+        try:
+            self._file.write("\n".join(batch) + "\n")
+            self._file.flush()
+        except (OSError, ValueError):
+            self._drop_locked(len(batch))
+
+    def _ensure_flusher_locked(self):
+        """Lazy daemon thread: flushes the sink buffer every
+        FLAGS_telemetry_flush_s so a mostly-idle process still lands its
+        records without waiting for the next emit or exit."""
+        if self._flusher_started:
+            return
+        self._flusher_started = True
+
+        def loop():
+            while True:
+                try:
+                    delay = float(_flags.flag("telemetry_flush_s"))
+                except Exception:
+                    delay = 0.25
+                time.sleep(max(0.05, delay))
+                with self._lock:
+                    self._flush_buf_locked()
+
+        threading.Thread(target=loop, name="pt-telemetry-flush",
+                         daemon=True).start()
+
+    def flush_sink(self):
+        """Force the buffered JSONL lines to disk now (tests, scrapes)."""
+        with self._lock:
+            self._flush_buf_locked()
 
     def enabled(self) -> bool:
         return self._resolve_path() is not None
@@ -159,7 +238,9 @@ class TelemetryRegistry:
 
     def emit(self, kind: str, name: str, value=None,
              attrs: Optional[Dict[str, Any]] = None):
-        """Append one schema record to the sink (no-op when disabled)."""
+        """Append one schema record to the sink (no-op when disabled).
+        Lines are buffered and batch-written (see module docstring); any
+        serialisation/write failure is counted, never raised."""
         with self._lock:
             f = self._sink()
             if f is None:
@@ -167,16 +248,49 @@ class TelemetryRegistry:
             rec = {"ts": time.time(), "kind": kind, "name": name,
                    "value": value, "attrs": attrs or {}}
             try:
-                f.write(json.dumps(rec, default=str) + "\n")
-            except (OSError, ValueError, TypeError):
-                pass
+                self._buf.append(json.dumps(rec, default=str))
+            except (ValueError, TypeError):
+                self._drop_locked(1)
+                return
+            try:
+                limit = int(_flags.flag("telemetry_buffer_lines"))
+            except Exception:
+                limit = 1
+            if len(self._buf) >= max(1, limit) or \
+                    rec["ts"] - self._last_flush >= \
+                    float(_flags.flag("telemetry_flush_s")):
+                self._flush_buf_locked()
+            self._ensure_flusher_locked()
 
     # -- metrics -------------------------------------------------------------
+    def _window_count_locked(self, name: str, delta, now: float):
+        """Fold one counter increment into its 1 s rolling-window bucket
+        (called under self._lock)."""
+        dq = self._win_counts.get(name)
+        if dq is None:
+            dq = self._win_counts[name] = deque(maxlen=_WIN_BUCKET_CAP)
+        sec = int(now)
+        if dq and dq[-1][0] == sec:
+            dq[-1][1] += delta
+        else:
+            dq.append([sec, delta])
+
     def counter_add(self, name: str, delta=1, **attrs):
         with self._lock:
             val = self._counters.get(name, 0) + delta
             self._counters[name] = val
+            self._window_count_locked(name, delta, time.time())
         self.emit("counter", name, val, {"delta": delta, **attrs})
+        return val
+
+    def counter_quiet(self, name: str, delta=1):
+        """In-memory-only increment: no JSONL record. For accounting that
+        must not recurse into (or double the volume of) the sink — span
+        counts, sink-failure counts."""
+        with self._lock:
+            val = self._counters.get(name, 0) + delta
+            self._counters[name] = val
+            self._window_count_locked(name, delta, time.time())
         return val
 
     def counter_set(self, name: str, value, **attrs):
@@ -199,6 +313,10 @@ class TelemetryRegistry:
             if h is None:
                 h = self._hists[name] = _Hist()
             h.observe(value)
+            dq = self._win_samples.get(name)
+            if dq is None:
+                dq = self._win_samples[name] = deque(maxlen=_WIN_SAMPLE_CAP)
+            dq.append((time.time(), float(value)))
         self.emit(kind, name, round(float(value), 4), attrs)
 
     @contextlib.contextmanager
@@ -232,6 +350,93 @@ class TelemetryRegistry:
             self._counters.clear()
             self._gauges.clear()
             self._hists.clear()
+            self._win_counts.clear()
+            self._win_samples.clear()
+
+    # -- rolling-window metrics (the live /metrics plane) --------------------
+    def windowed(self, window_s: Optional[float] = None) -> Dict[str, Any]:
+        """Last-N-seconds view of the registry: counter deltas + per-second
+        rates, current gauges, and histogram count/rate/p50/p95/p99 over
+        the window (default FLAGS_metrics_window_s). Scrapeable while the
+        run is live — this is what /metrics and /v1/stats render."""
+        W = float(window_s if window_s is not None
+                  else _flags.flag("metrics_window_s"))
+        W = max(W, 1.0)
+        now = time.time()
+        cut = now - W
+        with self._lock:
+            counters = {}
+            for name, dq in self._win_counts.items():
+                tot = 0
+                for sec, v in dq:
+                    if sec >= cut - 0.999:   # boundary bucket counts whole
+                        tot += v
+                if tot:
+                    counters[name] = {"delta": tot,
+                                      "rate": round(tot / W, 6)}
+            hists = {}
+            for name, dq in self._win_samples.items():
+                vals = sorted(v for ts, v in dq if ts >= cut)
+                if not vals:
+                    continue
+                n = len(vals)
+
+                def pct(q, vals=vals, n=n):
+                    return round(vals[min(n - 1, int(q * (n - 1) + 0.5))], 4)
+
+                hists[name] = {"count": n, "rate": round(n / W, 6),
+                               "avg": round(sum(vals) / n, 4),
+                               "p50": pct(0.50), "p95": pct(0.95),
+                               "p99": pct(0.99), "max": round(vals[-1], 4)}
+            gauges = dict(self._gauges)
+        return {"window_s": W, "ts": now, "counters": counters,
+                "gauges": gauges, "hists": hists}
+
+    def prometheus_text(self, window_s: Optional[float] = None) -> str:
+        """Prometheus text exposition (0.0.4): cumulative counters as
+        ``pt_<name>_total``, rolling-window rates as ``pt_<name>_rate``,
+        gauges, and histograms as summaries whose quantiles are computed
+        over the rolling window (cumulative _sum/_count)."""
+        win = self.windowed(window_s)
+        W = int(win["window_s"])
+        with self._lock:
+            cum = {n: v for n, v in self._counters.items()
+                   if isinstance(v, (int, float))}
+            hist_cum = {n: (h.count, h.total)
+                        for n, h in self._hists.items()}
+        lines = []
+        for name in sorted(cum):
+            m = _prom_name(name)
+            lines.append(f"# TYPE {m}_total counter")
+            lines.append(f"{m}_total {_prom_num(cum[name])}")
+            wc = win["counters"].get(name)
+            if wc is not None:
+                lines.append(f"# TYPE {m}_rate gauge")
+                lines.append(f'{m}_rate{{window="{W}s"}} '
+                             f'{_prom_num(wc["rate"])}')
+        for name in sorted(win["gauges"]):
+            v = win["gauges"][name]
+            if not isinstance(v, (int, float)):
+                continue
+            lines.append(f"# TYPE {_prom_name(name)} gauge")
+            lines.append(f"{_prom_name(name)} {_prom_num(v)}")
+        for name in sorted(hist_cum):
+            cnt, tot = hist_cum[name]
+            m = _prom_name(name)
+            wh = win["hists"].get(name)
+            lines.append(f"# TYPE {m} summary")
+            if wh:
+                for q, key in (("0.5", "p50"), ("0.95", "p95"),
+                               ("0.99", "p99")):
+                    lines.append(f'{m}{{quantile="{q}"}} '
+                                 f'{_prom_num(wh[key])}')
+            lines.append(f"{m}_sum {_prom_num(round(tot, 4))}")
+            lines.append(f"{m}_count {cnt}")
+            if wh:
+                lines.append(f"# TYPE {m}_window_rate gauge")
+                lines.append(f'{m}_window_rate{{window="{W}s"}} '
+                             f'{_prom_num(wh["rate"])}')
+        return "\n".join(lines) + "\n"
 
     def flush(self):
         """Persist a full registry snapshot + the profiler's summary table
@@ -254,6 +459,85 @@ class TelemetryRegistry:
         for name, row in prof_rows.items():
             self.emit("profiler_summary", name, row.get("total_us"),
                       {k: v for k, v in row.items() if k != "total_us"})
+        self.flush_sink()
+
+
+def _prom_name(name: str) -> str:
+    return "pt_" + re.sub(r"[^a-zA-Z0-9_]", "_", name)
+
+
+def _prom_num(v) -> str:
+    if isinstance(v, float):
+        return repr(round(v, 6))
+    return str(v)
+
+
+class MetricsServer:
+    """Stdlib HTTP scrape surface over the live registry: ``/metrics``
+    (Prometheus text) + ``/healthz``. Started by start_metrics_server —
+    usable from trainers and pservers, and mirrored by the serving
+    server's own /metrics route."""
+
+    def __init__(self, registry: "TelemetryRegistry",
+                 host: str = "127.0.0.1", port: int = 0):
+        from http.server import (BaseHTTPRequestHandler,
+                                 ThreadingHTTPServer)
+
+        reg = registry
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, code, body: bytes, ctype: str):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    self._send(200, reg.prometheus_text().encode(),
+                               "text/plain; version=0.0.4; charset=utf-8")
+                elif path == "/healthz":
+                    self._send(200, b'{"status": "ok"}',
+                               "application/json")
+                elif path == "/varz":
+                    body = json.dumps({"snapshot": reg.snapshot(),
+                                       "window": reg.windowed()},
+                                      default=str).encode()
+                    self._send(200, body, "application/json")
+                else:
+                    self._send(404, b'{"error": "no route"}',
+                               "application/json")
+
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="pt-metrics-http", daemon=True)
+        self._thread.start()
+
+    @property
+    def host(self) -> str:
+        return self._httpd.server_address[0]
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def shutdown(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
 
 
 # -- module-level convenience API (the surface everything instruments
@@ -273,6 +557,10 @@ def counter_set(name: str, value, **attrs):
 
 def counter_get(name: str):
     return _reg().counter_get(name)
+
+
+def counter_quiet(name: str, delta=1):
+    return _reg().counter_quiet(name, delta)
 
 
 def gauge_set(name: str, value, **attrs):
@@ -317,6 +605,26 @@ def reset():
 
 def flush():
     return _reg().flush()
+
+
+def flush_sink():
+    return _reg().flush_sink()
+
+
+def windowed(window_s: Optional[float] = None) -> Dict[str, Any]:
+    return _reg().windowed(window_s)
+
+
+def prometheus_text(window_s: Optional[float] = None) -> str:
+    return _reg().prometheus_text(window_s)
+
+
+def start_metrics_server(port: int = 0,
+                         host: str = "127.0.0.1") -> MetricsServer:
+    """Serve GET /metrics (Prometheus text) + /healthz + /varz from this
+    process's live registry on ``host:port`` (port 0 = ephemeral).
+    Returns the started MetricsServer (``.url``, ``.shutdown()``)."""
+    return MetricsServer(_reg(), host=host, port=port)
 
 
 def bench_extra() -> Dict[str, Any]:

@@ -50,9 +50,16 @@ from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
-from ...core import faults, telemetry
+from ...core import faults, telemetry, trace
 from ...core import flags as _flags
 from ..errors import RpcDeadlineError, RpcError, RpcRemoteError
+
+# trace-context separator on the wire: when a sampled trace is active the
+# client appends "\x1f<trace>-<span>" to the frame's method string, so the
+# context survives retries byte-identically (same frame, same seq) and the
+# server's dedup replay path never re-dispatches — one logical client span,
+# at most one handler span per applied request
+_TRACE_SEP = "\x1f"
 
 # method_len, name_len, dtype_code, ndim, aux, client_id, seq
 _HDR = struct.Struct("<IIHHIQQ")
@@ -218,6 +225,10 @@ class RPCServer:
         try:
             while not self._stop.is_set():
                 method, name, arr, aux, client, seq = _recv_msg(conn)
+                # strip the propagated trace context (if any) BEFORE any
+                # method comparison/dispatch — the wire method is
+                # "<method>[\x1f<trace>-<span>]"
+                method, _, tparent = method.partition(_TRACE_SEP)
                 if method == "__stop__":
                     _send_msg(conn, "ok", "", None, client=client, seq=seq)
                     self._stop.set()
@@ -237,7 +248,15 @@ class RPCServer:
                         _send_msg(conn, *replay, client=client, seq=seq)
                         continue
                 try:
-                    reply = self._dispatch(method, name, arr, aux)
+                    if tparent:
+                        # continue the client's trace: one handler span per
+                        # actually-dispatched request (replays above never
+                        # reach here)
+                        with trace.span_from(tparent, "ps.rpc.handler",
+                                             method=method):
+                            reply = self._dispatch(method, name, arr, aux)
+                    else:
+                        reply = self._dispatch(method, name, arr, aux)
                 except BaseException:
                     # dispatch died without a reply (injected connection
                     # fault): release the in-flight claim so the retry
@@ -381,70 +400,80 @@ class RPCClient:
         backoff = _flags.flag("ps_rpc_backoff")
         t0 = time.perf_counter()
         deadline_t = t0 + budget if budget and budget > 0 else None
-        with self._lock:
-            self._seq += 1
-            seq = self._seq
-            attempt = 0
-            while True:
-                try:
-                    faults.maybe_fail("ps.rpc.send", method=method,
-                                      endpoint=self.endpoint)
-                    if self._sock is None:
-                        self._connect(deadline_t)
-                    self._sock.settimeout(self._remaining(deadline_t))
-                    _send_msg(self._sock, method, name, a, aux,
-                              self._client_id, seq)
-                    faults.maybe_fail("ps.rpc.recv", method=method,
-                                      endpoint=self.endpoint)
-                    status, err, out, oaux, _, rseq = \
-                        _recv_msg(self._sock)
-                    if rseq and rseq != seq:
-                        raise ConnectionError(
-                            f"out-of-sequence reply: got {rseq}, "
-                            f"expected {seq}")
-                    break
-                except (ConnectionError, OSError) as e:
-                    self._close()
-                    attempt += 1
-                    now = time.perf_counter()
-                    if deadline_t is not None and now >= deadline_t:
-                        telemetry.counter_add("ps.rpc_deadline_exceeded",
-                                              1, method=method)
-                        self.evict()
-                        raise RpcDeadlineError(
-                            f"PS RPC '{method}' to {self.endpoint} "
-                            f"exceeded its {budget:.3f}s deadline "
-                            f"(attempt {attempt}: "
-                            f"{type(e).__name__}: {e})") from e
-                    if attempt > retries:
-                        self.evict()
-                        raise RpcError(
-                            f"PS RPC '{method}' to {self.endpoint} "
-                            f"failed after {attempt} attempts: "
-                            f"{type(e).__name__}: {e}") from e
-                    telemetry.counter_add("ps.rpc_retries", 1,
-                                          method=method)
-                    delay = min(backoff * (2 ** (attempt - 1)), 1.0)
-                    delay *= 0.5 + random.random()  # +/-50% jitter
-                    if deadline_t is not None:
-                        delay = min(delay, max(deadline_t - now, 0.0))
-                    time.sleep(delay)
-        # transport accounting (reference analog: the gRPC/BRPC client
-        # metrics) — call count, payload bytes each way, latency histogram
-        telemetry.counter_add("ps.rpc_calls", 1, method=method)
-        if a is not None:
-            telemetry.counter_add("ps.rpc_send_bytes", int(a.nbytes))
-        if out is not None:
-            telemetry.counter_add("ps.rpc_recv_bytes", int(out.nbytes))
-        telemetry.observe("ps.rpc_ms", (time.perf_counter() - t0) * 1e3,
-                          kind="timer", method=method)
-        if status == "__err__":
-            telemetry.counter_add("ps.rpc_errors", 1, method=method)
-            rtype = err.split(":", 1)[0] if ":" in err else ""
-            raise RpcRemoteError(
-                f"PS RPC '{method}' failed on {self.endpoint}: {err}",
-                remote_type=rtype)
-        return out, oaux
+        # the span covers the WHOLE retry schedule — retries resend the
+        # same frame (same seq, same propagated context), so client call
+        # and server handler stay one logical parent/child pair no matter
+        # how many wire attempts it took
+        with trace.span("ps.rpc.call", method=method,
+                        endpoint=self.endpoint) as tctx:
+            wire_method = method if tctx is None \
+                else method + _TRACE_SEP + tctx.header()
+            with self._lock:
+                self._seq += 1
+                seq = self._seq
+                attempt = 0
+                while True:
+                    try:
+                        faults.maybe_fail("ps.rpc.send", method=method,
+                                          endpoint=self.endpoint)
+                        if self._sock is None:
+                            self._connect(deadline_t)
+                        self._sock.settimeout(self._remaining(deadline_t))
+                        _send_msg(self._sock, wire_method, name, a, aux,
+                                  self._client_id, seq)
+                        faults.maybe_fail("ps.rpc.recv", method=method,
+                                          endpoint=self.endpoint)
+                        status, err, out, oaux, _, rseq = \
+                            _recv_msg(self._sock)
+                        if rseq and rseq != seq:
+                            raise ConnectionError(
+                                f"out-of-sequence reply: got {rseq}, "
+                                f"expected {seq}")
+                        break
+                    except (ConnectionError, OSError) as e:
+                        self._close()
+                        attempt += 1
+                        now = time.perf_counter()
+                        if deadline_t is not None and now >= deadline_t:
+                            telemetry.counter_add(
+                                "ps.rpc_deadline_exceeded", 1,
+                                method=method)
+                            self.evict()
+                            raise RpcDeadlineError(
+                                f"PS RPC '{method}' to {self.endpoint} "
+                                f"exceeded its {budget:.3f}s deadline "
+                                f"(attempt {attempt}: "
+                                f"{type(e).__name__}: {e})") from e
+                        if attempt > retries:
+                            self.evict()
+                            raise RpcError(
+                                f"PS RPC '{method}' to {self.endpoint} "
+                                f"failed after {attempt} attempts: "
+                                f"{type(e).__name__}: {e}") from e
+                        telemetry.counter_add("ps.rpc_retries", 1,
+                                              method=method)
+                        delay = min(backoff * (2 ** (attempt - 1)), 1.0)
+                        delay *= 0.5 + random.random()  # +/-50% jitter
+                        if deadline_t is not None:
+                            delay = min(delay, max(deadline_t - now, 0.0))
+                        time.sleep(delay)
+            # transport accounting (reference analog: the gRPC/BRPC client
+            # metrics) — call count, payload bytes each way, latency
+            # histogram
+            telemetry.counter_add("ps.rpc_calls", 1, method=method)
+            if a is not None:
+                telemetry.counter_add("ps.rpc_send_bytes", int(a.nbytes))
+            if out is not None:
+                telemetry.counter_add("ps.rpc_recv_bytes", int(out.nbytes))
+            telemetry.observe("ps.rpc_ms", (time.perf_counter() - t0) * 1e3,
+                              kind="timer", method=method)
+            if status == "__err__":
+                telemetry.counter_add("ps.rpc_errors", 1, method=method)
+                rtype = err.split(":", 1)[0] if ":" in err else ""
+                raise RpcRemoteError(
+                    f"PS RPC '{method}' failed on {self.endpoint}: {err}",
+                    remote_type=rtype)
+            return out, oaux
 
     def stop_server(self):
         try:

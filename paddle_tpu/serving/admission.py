@@ -48,17 +48,24 @@ class EngineClosedError(ServingError):
 
 
 class InferenceRequest:
-    """One queued request: feeds + a future the caller blocks on."""
+    """One queued request: feeds + a future the caller blocks on.
 
-    __slots__ = ("feeds", "rows", "deadline", "enqueue_t",
-                 "_event", "_result", "_error")
+    ``trace``/``enqueue_wall`` carry the submitter's sampled trace
+    context (core/trace.py) across the thread boundary into the engine's
+    batch worker, which emits the queue-wait/batch/predictor spans
+    against it retroactively."""
+
+    __slots__ = ("feeds", "rows", "deadline", "enqueue_t", "trace",
+                 "enqueue_wall", "_event", "_result", "_error")
 
     def __init__(self, feeds: Dict[str, Any], rows: int,
-                 deadline: Optional[float]):
+                 deadline: Optional[float], trace: Optional[Any] = None):
         self.feeds = feeds
         self.rows = rows
         self.deadline = deadline          # absolute time.monotonic() or None
         self.enqueue_t = time.monotonic()
+        self.trace = trace                # SpanContext of the submitter
+        self.enqueue_wall = time.time() if trace is not None else 0.0
         self._event = threading.Event()
         self._result: Optional[List[Any]] = None
         self._error: Optional[BaseException] = None
@@ -104,7 +111,8 @@ class AdmissionQueue:
 
     # -- admission -----------------------------------------------------------
     def submit(self, feeds: Dict[str, Any], rows: int,
-               deadline_ms: Optional[float] = None) -> InferenceRequest:
+               deadline_ms: Optional[float] = None,
+               trace: Optional[Any] = None) -> InferenceRequest:
         ms = self.default_deadline_ms if deadline_ms is None \
             else float(deadline_ms)
         deadline = time.monotonic() + ms / 1e3 if ms > 0 else None
@@ -117,7 +125,7 @@ class AdmissionQueue:
                 raise ServerOverloadedError(
                     f"serving queue full ({self.max_depth} requests "
                     f"waiting) — retry later")
-            req = InferenceRequest(feeds, rows, deadline)
+            req = InferenceRequest(feeds, rows, deadline, trace=trace)
             self._items.append(req)
             depth = len(self._items)
             self._cond.notify_all()

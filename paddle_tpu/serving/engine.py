@@ -33,7 +33,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from ..core import faults, telemetry
+from ..core import faults, telemetry, trace
 from ..core.flags import flag as _flag
 from .admission import (AdmissionQueue, EngineClosedError, InferenceRequest,
                         ServingError)
@@ -142,7 +142,11 @@ class ServingEngine:
         if extra:
             raise ValueError(f"unknown inputs {sorted(extra)}; "
                              f"feeds are {self._feed_names}")
-        return self.queue.submit(arrs, rows, deadline_ms)
+        # the submitter's sampled trace context (if any) rides the request
+        # into the batch worker, which reconstructs the queue-wait/batch/
+        # predictor span timeline against it
+        return self.queue.submit(arrs, rows, deadline_ms,
+                                 trace=trace.current())
 
     def infer(self, feeds: Dict[str, Any],
               deadline_ms: Optional[float] = None,
@@ -151,10 +155,37 @@ class ServingEngine:
         return self.submit(feeds, deadline_ms).result(timeout)
 
     def stats(self) -> Dict[str, Any]:
+        """Live stats: cumulative serving.* counters (flat, as before)
+        plus request/batch latency percentiles and rolling-window rates —
+        the /v1/stats payload."""
         c = telemetry.counters()
-        return {k.split(".", 1)[1]: int(v) for k, v in c.items()
-                if k.startswith("serving.")} | \
-            {"queue_depth": self.queue.depth()}
+        out = {k.split(".", 1)[1]: int(v) for k, v in c.items()
+               if k.startswith("serving.") and isinstance(v, (int, float))}
+        out["queue_depth"] = self.queue.depth()
+        hists = telemetry.snapshot()["hists"]
+        for key in ("serving.request_ms", "serving.batch_ms"):
+            h = hists.get(key)
+            if h:
+                out[key.split(".", 1)[1]] = {
+                    "count": h["count"], "avg": h["avg"], "p50": h["p50"],
+                    "p95": h["p95"], "p99": h["p99"], "max": h["max"]}
+        win = telemetry.windowed()
+        wout = {"seconds": win["window_s"]}
+        wc = win["counters"].get("serving.requests")
+        if wc:
+            wout["request_rate"] = wc["rate"]
+        wb = win["counters"].get("serving.batches")
+        if wb:
+            wout["batch_rate"] = wb["rate"]
+        for key in ("serving.request_ms", "serving.batch_ms"):
+            wh = win["hists"].get(key)
+            if wh:
+                short = key.split(".", 1)[1]
+                wout[short] = {"count": wh["count"], "rate": wh["rate"],
+                               "p50": wh["p50"], "p95": wh["p95"],
+                               "p99": wh["p99"]}
+        out["window"] = wout
+        return out
 
     # -- lifecycle -----------------------------------------------------------
     def start(self, warmup: bool = True) -> "ServingEngine":
@@ -230,6 +261,12 @@ class ServingEngine:
 
         rows = sum(r.rows for r in batch)
         bucket = self.config.bucket(rows)
+        # requests whose submitter was inside a sampled trace get their
+        # queue-wait/batch-assembly/predictor spans reconstructed here
+        # (the contextvar does not cross into this worker thread)
+        traced = [r for r in batch if r.trace is not None]
+        t_dequeue = _time.time() if traced else 0.0
+        t_run0 = t_run1 = 0.0
         try:
             faults.maybe_fail("serving.handler", batch_rows=rows,
                               requests=len(batch))
@@ -241,12 +278,29 @@ class ServingEngine:
                     parts.append(np.zeros(pad_shape, dtype=parts[0].dtype))
                 feed[n] = parts[0] if len(parts) == 1 \
                     else np.concatenate(parts, axis=0)
+            if traced:
+                t_run0 = _time.time()
             with self._infer_lock, telemetry.timer("serving.batch_ms"):
                 outs = self.predictor.run(feed)
+            if traced:
+                t_run1 = _time.time()
+                for req in traced:
+                    trace.record("serving.queue_wait", req.trace,
+                                 req.enqueue_wall, t_dequeue)
+                    trace.record("serving.batch_assemble", req.trace,
+                                 t_dequeue, t_run0, bucket=bucket,
+                                 rows=rows, requests=len(batch))
+                    trace.record("serving.predictor_run", req.trace,
+                                 t_run0, t_run1, bucket=bucket)
         except Exception as e:
             # per-request error responses; the queue keeps moving
             telemetry.counter_add("serving.handler_errors", len(batch),
                                   exc=type(e).__name__)
+            for req in traced:
+                trace.record("serving.queue_wait", req.trace,
+                             req.enqueue_wall, t_dequeue)
+                trace.record("serving.batch_error", req.trace, t_dequeue,
+                             _time.time(), error=type(e).__name__)
             for req in batch:
                 req.fail(e)
             return

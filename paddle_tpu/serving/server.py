@@ -9,14 +9,26 @@ the API shape being mirrored.
 API:
     POST /v1/infer   {"inputs": {name: nested lists},
                       "deadline_ms": optional float}
-             200 ->  {"outputs": {name: nested lists}, "latency_ms": f}
+             200 ->  {"outputs": {name: nested lists}, "latency_ms": f,
+                      "trace_id": str|null}
              400 bad request (missing/odd inputs)
              429 ServerOverloadedError (admission backpressure)
              503 EngineClosedError (draining / shut down)
              504 DeadlineExceededError
              500 handler failure (per-request, queue keeps serving)
     GET  /healthz    {"status": "ok", "queue_depth": n}
-    GET  /v1/stats   serving.* counter snapshot
+    GET  /v1/stats   serving.* counters + request/batch latency
+                     percentiles + rolling-window rates (engine.stats())
+    GET  /metrics    Prometheus text exposition of the live registry —
+                     cumulative counters, rolling-window rates and
+                     p50/p95/p99 over FLAGS_metrics_window_s
+
+Tracing: every /v1/infer request opens a root span (core/trace.py,
+sampled by FLAGS_trace_sample_rate) whose context flows through the
+admission queue into the engine's batch worker, so one trace_id links
+request → queue-wait → batch-assembly → predictor-run. A client-supplied
+``X-Request-Id`` header forces sampling and pins the trace id; the
+response carries it back as ``trace_id`` + an ``X-Trace-Id`` header.
 
 ``serve()`` wires model dir → predictor → engine (with every-bucket
 warmup) → bound HTTP server in one call; ``LocalClient`` is the
@@ -33,6 +45,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from ..core import telemetry, trace
 from .admission import (DeadlineExceededError, EngineClosedError,
                         ServerOverloadedError)
 from .engine import ServingConfig, ServingEngine
@@ -88,6 +101,14 @@ class _Handler(BaseHTTPRequestHandler):
                               "queue_depth": engine.queue.depth()})
         elif self.path == "/v1/stats":
             self._reply(200, engine.stats())
+        elif self.path == "/metrics":
+            body = telemetry.prometheus_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         else:
             self._reply(404, {"error": f"no route {self.path}"})
 
@@ -103,28 +124,41 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, TypeError) as e:
             self._reply(400, {"error": f"bad request body: {e}"})
             return
+        # request root span: an X-Request-Id header pins the trace id and
+        # forces sampling; otherwise FLAGS_trace_sample_rate decides. The
+        # context captured by engine.submit() inside this block links the
+        # whole queue → batch → predictor timeline to one trace_id
+        rid = self.headers.get("X-Request-Id")
+        code, payload, headers = 500, {"error": "unhandled"}, {}
         t0 = time.perf_counter()
-        try:
-            outs = engine.infer(feeds, deadline_ms=doc.get("deadline_ms"))
-        except ValueError as e:          # missing/ragged inputs
-            self._reply(400, {"error": str(e)})
-            return
-        except ServerOverloadedError as e:
-            self._reply(429, {"error": str(e)}, {"Retry-After": "0.05"})
-            return
-        except EngineClosedError as e:
-            self._reply(503, {"error": str(e)})
-            return
-        except DeadlineExceededError as e:
-            self._reply(504, {"error": str(e)})
-            return
-        except Exception as e:           # injected / handler failure
-            self._reply(500, {"error": f"{type(e).__name__}: {e}"})
-            return
-        self._reply(200, {
-            "outputs": {n: np.asarray(o).tolist()
-                        for n, o in zip(engine.fetch_names, outs)},
-            "latency_ms": round((time.perf_counter() - t0) * 1e3, 3)})
+        with trace.root_span("serving.http_request", trace_id=rid,
+                             force=bool(rid), path=self.path) as tctx:
+            try:
+                outs = engine.infer(feeds,
+                                    deadline_ms=doc.get("deadline_ms"))
+            except ValueError as e:      # missing/ragged inputs
+                code, payload = 400, {"error": str(e)}
+            except ServerOverloadedError as e:
+                code, payload = 429, {"error": str(e)}
+                headers = {"Retry-After": "0.05"}
+            except EngineClosedError as e:
+                code, payload = 503, {"error": str(e)}
+            except DeadlineExceededError as e:
+                code, payload = 504, {"error": str(e)}
+            except Exception as e:       # injected / handler failure
+                code, payload = 500, {"error": f"{type(e).__name__}: {e}"}
+            else:
+                code = 200
+                payload = {
+                    "outputs": {n: np.asarray(o).tolist()
+                                for n, o in zip(engine.fetch_names, outs)},
+                    "latency_ms": round(
+                        (time.perf_counter() - t0) * 1e3, 3)}
+        if code == 200 or tctx is not None:
+            payload["trace_id"] = tctx.trace_id if tctx else None
+        if tctx is not None:
+            headers["X-Trace-Id"] = tctx.trace_id
+        self._reply(code, payload, headers)
 
 
 class ServingHTTPServer:

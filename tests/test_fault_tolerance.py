@@ -173,6 +173,7 @@ class TestFaultSpec:
         with pytest.raises(ConnectionError, match="injected fault at t.x"):
             faults.maybe_fail("t.x", method="send_grad")
         assert telemetry.counter_get("faults.injected") == 1
+        telemetry.flush_sink()   # the sink line-batches writes
         recs = [json.loads(line) for line in open(log) if line.strip()]
         inj = [r for r in recs if r["name"] == "faults.injected"]
         assert inj and inj[0]["attrs"]["site"] == "t.x"
@@ -412,6 +413,7 @@ class TestExactlyOnce:
                 chaos[p], baseline[p],
                 err_msg=f"{p} diverged under injected faults — "
                         f"retries were not exactly-once")
+        telemetry.flush_sink()   # the sink line-batches writes
         recs = [json.loads(line) for line in open(log) if line.strip()]
         assert any(r["name"] == "ps.rpc_retries" for r in recs)
         assert any(r["name"] == "faults.injected" for r in recs)

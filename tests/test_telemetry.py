@@ -38,6 +38,7 @@ def _small_program(hidden=8):
 
 
 def _read(path):
+    telemetry.flush_sink()   # the sink line-batches writes; land them
     with open(path) as f:
         return [json.loads(line) for line in f if line.strip()]
 

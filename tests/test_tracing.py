@@ -1,0 +1,581 @@
+"""Distributed tracing + live metrics plane tests (ISSUE 6 tier-1 gate).
+
+Contracts under test:
+* core/trace.py spans: off-by-default zero-record, root sampling via
+  FLAGS_trace_sample_rate, parent/child linkage, inject/extract
+  propagation (remote contexts honoured at local rate 0);
+* executor.run / run_steps emit feed → dispatch → fetch child spans
+  under one trace, and emit NOTHING when tracing is off;
+* PS RPC propagation: client call span and server handler span share a
+  trace, and a retried+deduped frame (core/faults.py ps.rpc.recv fault)
+  keeps its trace id and yields exactly ONE handler span;
+* serving end-to-end: one HTTP request traces client → server → queue →
+  batch → predictor under a single trace_id, returned in the response
+  and pinnable via X-Request-Id;
+* tools/trace_view.py merges a two-process log pair into a valid
+  chrome://tracing file asserting that linkage (+ CLI smoke incl.
+  perf_report on the same logs);
+* telemetry rolling-window metrics: windowed() rates/percentiles,
+  Prometheus text exposition, start_metrics_server scrape, /metrics on
+  the serving server, /v1/stats percentiles + window rates;
+* the buffered JSONL sink: line-batching, flush_sink, and
+  telemetry.dropped_records on write failure (never raising).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core import faults, telemetry, trace
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    pt.set_flags({"FLAGS_trace_sample_rate": 0.0})
+    telemetry.configure(None)
+    telemetry.reset()
+    faults.configure(None)
+    yield
+    pt.set_flags({"FLAGS_trace_sample_rate": 0.0,
+                  "FLAGS_telemetry_buffer_lines": 64,
+                  "FLAGS_telemetry_flush_s": 0.25})
+    telemetry.configure(None)
+    telemetry.reset()
+    faults.configure(None)
+
+
+def _read(path):
+    telemetry.flush_sink()
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _spans(path):
+    return [r for r in _read(path) if r["kind"] == "span"]
+
+
+class TestSpanBasics:
+    def test_off_by_default_zero_records(self, tmp_path):
+        log = tmp_path / "run.jsonl"
+        telemetry.configure(str(log))
+        with trace.span("root") as c:
+            assert c is None
+            assert trace.current() is None
+            assert trace.inject() is None
+        assert _spans(log) == []
+        assert telemetry.counter_get("trace.spans") == 0
+
+    def test_sampled_tree_linkage(self, tmp_path):
+        log = tmp_path / "run.jsonl"
+        telemetry.configure(str(log))
+        pt.set_flags({"FLAGS_trace_sample_rate": 1.0})
+        with trace.span("root") as root:
+            assert trace.current() is root
+            with trace.span("child") as child:
+                assert child.trace_id == root.trace_id
+                assert child.span_id != root.span_id
+        assert trace.current() is None
+        sp = {s["name"]: s for s in _spans(log)}
+        assert set(sp) == {"root", "child"}
+        assert sp["child"]["attrs"]["parent"] == root.span_id
+        assert sp["root"]["attrs"]["parent"] is None
+        for s in sp.values():
+            assert s["attrs"]["trace"] == root.trace_id
+            assert s["value"] >= 0 and s["attrs"]["start"] > 0
+            assert s["attrs"]["pid"] == os.getpid()
+        assert telemetry.counter_get("trace.spans") == 2
+
+    def test_inject_extract_roundtrip_and_remote_at_rate_zero(
+            self, tmp_path):
+        log = tmp_path / "run.jsonl"
+        telemetry.configure(str(log))
+        pt.set_flags({"FLAGS_trace_sample_rate": 1.0})
+        with trace.span("origin") as origin:
+            header = trace.inject()
+        ctx = trace.extract(header)
+        assert ctx.trace_id == origin.trace_id
+        assert ctx.span_id == origin.span_id
+        assert trace.extract(None) is None
+        assert trace.extract("not a header !") is None
+        # the origin sampled; the remote side honours it even at rate 0
+        pt.set_flags({"FLAGS_trace_sample_rate": 0.0})
+        with trace.span_from(header, "remote.handler") as remote:
+            assert remote.trace_id == origin.trace_id
+        sp = [s for s in _spans(log) if s["name"] == "remote.handler"]
+        assert len(sp) == 1
+        assert sp[0]["attrs"]["parent"] == origin.span_id
+
+    def test_root_span_pins_and_sanitizes_external_ids(self):
+        pt.set_flags({"FLAGS_trace_sample_rate": 0.0})
+        with trace.root_span("req", trace_id="req-42", force=True) as c:
+            assert c.trace_id == "req-42"
+        with trace.root_span("req", trace_id="weird id\n!", force=True) as c:
+            assert len(c.trace_id) == 16 and c.trace_id.isalnum()
+        # not forced + rate 0: unsampled
+        with trace.root_span("req", trace_id="req-43") as c:
+            assert c is None
+
+
+class TestExecutorSpans:
+    def _program(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [4], stop_gradient=True)
+            loss = layers.mean(layers.fc(x, 8))
+            pt.optimizer.SGDOptimizer(0.1).minimize(loss)
+        return main, startup, loss
+
+    def test_run_emits_feed_dispatch_fetch_children(self, scope, tmp_path):
+        log = tmp_path / "run.jsonl"
+        telemetry.configure(str(log))
+        main, startup, loss = self._program()
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        pt.set_flags({"FLAGS_trace_sample_rate": 1.0})
+        x = np.ones((4, 4), np.float32)
+        exe.run(main, feed={"x": x}, fetch_list=[loss], scope=scope)
+        sp = _spans(log)
+        by_name = {}
+        for s in sp:
+            by_name.setdefault(s["name"], []).append(s)
+        run = by_name["executor.run"][-1]
+        for child in ("executor.feed", "executor.dispatch",
+                      "executor.fetch"):
+            ours = [s for s in by_name[child]
+                    if s["attrs"]["trace"] == run["attrs"]["trace"]]
+            assert ours, f"missing {child} span"
+            assert ours[-1]["attrs"]["parent"] == run["attrs"]["span"]
+
+    def test_run_steps_emits_k_attr(self, scope, tmp_path):
+        log = tmp_path / "run.jsonl"
+        telemetry.configure(str(log))
+        main, startup, loss = self._program()
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        feeds = np.stack([np.ones((4, 4), np.float32)] * 3)
+        pt.set_flags({"FLAGS_trace_sample_rate": 1.0})
+        exe.run_steps(main, feed={"x": feeds}, fetch_list=[loss],
+                      scope=scope)
+        sp = [s for s in _spans(log) if s["name"] == "executor.run_steps"]
+        assert sp and sp[0]["attrs"]["k"] == 3
+
+    def test_disabled_emits_no_span_records(self, scope, tmp_path):
+        """Acceptance: default sample rate 0 → zero span records from the
+        executor hot path."""
+        log = tmp_path / "run.jsonl"
+        telemetry.configure(str(log))
+        main, startup, loss = self._program()
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        x = np.ones((4, 4), np.float32)
+        for _ in range(3):
+            exe.run(main, feed={"x": x}, fetch_list=[loss], scope=scope)
+        assert _spans(log) == []
+        assert telemetry.counter_get("trace.spans") == 0
+
+
+@pytest.mark.chaos
+class TestRpcTracePropagation:
+    def test_client_and_handler_share_one_trace(self, tmp_path):
+        from paddle_tpu.distributed.ps.rpc import RPCClient, RPCServer
+
+        log = tmp_path / "run.jsonl"
+        telemetry.configure(str(log))
+        pt.set_flags({"FLAGS_trace_sample_rate": 1.0})
+        srv = RPCServer("127.0.0.1:0", lambda m, n, a, aux: (a, aux))
+        try:
+            cli = RPCClient(srv.endpoint)
+            with trace.span("trainer.step") as root:
+                cli.call("echo", "x", np.ones(3, np.float32), 7)
+            cli.stop_server()
+        finally:
+            srv.shutdown()
+        sp = _spans(log)
+        handler = [s for s in sp if s["name"] == "ps.rpc.handler"]
+        call = [s for s in sp if s["name"] == "ps.rpc.call"
+                and s["attrs"]["trace"] == root.trace_id]
+        assert len(handler) == 1 and len(call) == 1
+        assert handler[0]["attrs"]["trace"] == root.trace_id
+        assert handler[0]["attrs"]["parent"] == call[0]["attrs"]["span"]
+        assert handler[0]["attrs"]["method"] == "echo"
+
+    def test_retried_deduped_frame_one_handler_span(self, tmp_path):
+        """ISSUE 6 satellite: under a ps.rpc.recv fault (reply lost AFTER
+        the server applied + published) the client retries the same frame
+        — the dedup cache replays the reply, the trace id survives, and
+        exactly ONE server-side handler span exists for the call."""
+        from paddle_tpu.distributed.ps.rpc import RPCClient, RPCServer
+
+        log = tmp_path / "run.jsonl"
+        telemetry.configure(str(log))
+        pt.set_flags({"FLAGS_trace_sample_rate": 1.0,
+                      "FLAGS_ps_rpc_backoff": 0.01})
+        applied = []
+        srv = RPCServer(
+            "127.0.0.1:0",
+            lambda m, n, a, aux: (applied.append(m), (a, aux))[1])
+        try:
+            cli = RPCClient(srv.endpoint)
+            faults.configure("ps.rpc.recv:@1", seed=3)
+            with trace.span("trainer.step") as root:
+                cli.call("send_grad", "g", np.ones(2, np.float32), 1)
+            faults.configure(None)
+            cli.stop_server()
+        finally:
+            srv.shutdown()
+        assert telemetry.counter_get("ps.rpc_retries") >= 1
+        assert telemetry.counter_get("ps.rpc_dedup_hits") == 1
+        assert applied.count("send_grad") == 1, \
+            "dedup must not re-apply the retried frame"
+        sp = _spans(log)
+        handler = [s for s in sp if s["name"] == "ps.rpc.handler"
+                   and s["attrs"]["trace"] == root.trace_id]
+        call = [s for s in sp if s["name"] == "ps.rpc.call"
+                and s["attrs"]["trace"] == root.trace_id]
+        assert len(call) == 1, "retries stay inside ONE client span"
+        assert len(handler) == 1, \
+            "a retried+deduped frame must yield exactly one handler span"
+        assert handler[0]["attrs"]["parent"] == call[0]["attrs"]["span"]
+
+
+IN_DIM, OUT_DIM = 6, 4
+
+
+def _save_mlp(tmp_path, name="m"):
+    from paddle_tpu import io
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [IN_DIM])
+        y = layers.fc(layers.fc(x, 8, act="relu"), OUT_DIM)
+    scope = pt.Scope()
+    exe = pt.Executor()
+    exe.run(startup, scope=scope, use_compiled=False)
+    model_dir = str(tmp_path / name)
+    io.save_inference_model(model_dir, ["x"], [y],
+                            main_program=main, scope=scope)
+    return model_dir
+
+
+def _engine(model_dir, **cfg):
+    from paddle_tpu.inference import AnalysisConfig, create_predictor
+    from paddle_tpu.serving import ServingConfig, ServingEngine
+
+    cfg.setdefault("max_batch_size", 8)
+    cfg.setdefault("batch_timeout_ms", 5.0)
+    return ServingEngine(create_predictor(AnalysisConfig(model_dir)),
+                         config=ServingConfig(**cfg))
+
+
+@pytest.mark.serving
+class TestServingTrace:
+    def test_http_request_traced_end_to_end(self, tmp_path):
+        """Acceptance: one serving HTTP request is traceable end-to-end —
+        request → queue-wait → batch-assembly → predictor-run share a
+        single trace_id, pinned by X-Request-Id and echoed back."""
+        from paddle_tpu.serving.server import ServingHTTPServer
+
+        log = tmp_path / "serving.jsonl"
+        telemetry.configure(str(log))
+        engine = _engine(_save_mlp(tmp_path)).start(warmup=True)
+        srv = ServingHTTPServer(engine).start()
+        try:
+            body = json.dumps(
+                {"inputs": {"x": np.zeros((2, IN_DIM)).tolist()}}).encode()
+            req = urllib.request.Request(
+                srv.url + "/v1/infer", data=body,
+                headers={"Content-Type": "application/json",
+                         "X-Request-Id": "it-req-7"})
+            resp = urllib.request.urlopen(req, timeout=30)
+            doc = json.loads(resp.read())
+            assert doc["trace_id"] == "it-req-7"
+            assert resp.headers["X-Trace-Id"] == "it-req-7"
+            assert "outputs" in doc
+        finally:
+            srv.shutdown()
+            engine.close(drain=True, timeout=10)
+        names = {s["name"] for s in _spans(log)
+                 if s["attrs"]["trace"] == "it-req-7"}
+        for want in ("serving.http_request", "serving.queue_wait",
+                     "serving.batch_assemble", "serving.predictor_run"):
+            assert want in names, f"missing {want} in {names}"
+
+    def test_untraced_request_emits_nothing(self, tmp_path):
+        from paddle_tpu.serving.server import ServingHTTPServer
+
+        log = tmp_path / "serving.jsonl"
+        telemetry.configure(str(log))
+        engine = _engine(_save_mlp(tmp_path)).start(warmup=True)
+        srv = ServingHTTPServer(engine).start()
+        try:
+            body = json.dumps(
+                {"inputs": {"x": np.zeros((1, IN_DIM)).tolist()}}).encode()
+            req = urllib.request.Request(
+                srv.url + "/v1/infer", data=body,
+                headers={"Content-Type": "application/json"})
+            doc = json.loads(urllib.request.urlopen(req, timeout=30).read())
+            assert doc["trace_id"] is None
+        finally:
+            srv.shutdown()
+            engine.close(drain=True, timeout=10)
+        assert _spans(log) == []
+
+    def test_stats_percentiles_and_window(self, tmp_path):
+        """ISSUE 6 satellite: /v1/stats carries request_ms/batch_ms
+        percentiles and rolling-window rates, not just counters."""
+        from paddle_tpu.serving import LocalClient
+        from paddle_tpu.serving.server import ServingHTTPServer
+
+        engine = _engine(_save_mlp(tmp_path)).start(warmup=True)
+        srv = ServingHTTPServer(engine).start()
+        try:
+            client = LocalClient(engine)
+            for _ in range(4):
+                client.infer({"x": np.zeros((1, IN_DIM), np.float32)},
+                             timeout=30)
+            stats = json.loads(urllib.request.urlopen(
+                srv.url + "/v1/stats", timeout=10).read())
+            assert stats["requests"] >= 4
+            for key in ("request_ms", "batch_ms"):
+                assert {"p50", "p95", "p99"} <= set(stats[key]), stats
+            assert stats["window"]["request_rate"] > 0
+            assert stats["window"]["request_ms"]["p99"] >= \
+                stats["window"]["request_ms"]["p50"]
+        finally:
+            srv.shutdown()
+            engine.close(drain=True, timeout=10)
+
+    def test_metrics_endpoint_rolling_window(self, tmp_path):
+        """Acceptance: GET /metrics returns rolling-window p99 request
+        latency and request rate in Prometheus text format."""
+        from paddle_tpu.serving import LocalClient
+        from paddle_tpu.serving.server import ServingHTTPServer
+
+        engine = _engine(_save_mlp(tmp_path)).start(warmup=True)
+        srv = ServingHTTPServer(engine).start()
+        try:
+            client = LocalClient(engine)
+            for _ in range(4):
+                client.infer({"x": np.zeros((1, IN_DIM), np.float32)},
+                             timeout=30)
+            body = urllib.request.urlopen(
+                srv.url + "/metrics", timeout=10).read().decode()
+        finally:
+            srv.shutdown()
+            engine.close(drain=True, timeout=10)
+        assert "pt_serving_requests_total" in body
+        assert 'pt_serving_request_ms{quantile="0.99"}' in body
+        import re
+
+        assert re.search(r'^pt_serving_requests_rate\{window="\d+s"\} ',
+                         body, re.M)
+
+
+class TestTraceView:
+    def _two_process_pair(self, tmp_path):
+        """A trainer log (root + client span) and a pserver log (handler
+        span continuing the propagated context) — the merge fixture."""
+        a = str(tmp_path / "trainer.jsonl")
+        b = str(tmp_path / "pserver.jsonl")
+        pt.set_flags({"FLAGS_trace_sample_rate": 1.0})
+        telemetry.configure(a)
+        with trace.span("trainer.step"):
+            with trace.span("ps.rpc.call", method="send_grad") as c:
+                header = trace.inject()
+                time.sleep(0.002)
+        telemetry.flush_sink()
+        telemetry.configure(b)
+        with trace.span_from(header, "ps.rpc.handler", method="send_grad"):
+            time.sleep(0.001)
+        telemetry.flush_sink()
+        telemetry.configure(None)
+        return a, b, c
+
+    def test_merge_two_process_pair_asserts_linkage(self, tmp_path):
+        """Acceptance: trace_view merges a two-process JSONL log pair
+        into a valid chrome://tracing file with the cross-process
+        parent/child linkage intact."""
+        sys.path.insert(0, REPO_ROOT)
+        try:
+            from tools.trace_view import build_trees, chrome_trace, \
+                load_spans
+        finally:
+            sys.path.remove(REPO_ROOT)
+        a, b, call_ctx = self._two_process_pair(tmp_path)
+        spans, malformed, total = load_spans([a, b])
+        assert malformed == 0 and len(spans) == 3
+        doc = chrome_trace(spans, [a, b])
+        events = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert len(events) == 3
+        assert {e["pid"] for e in events} == {0, 1}   # one row per log
+        assert len({e["args"]["trace"] for e in events}) == 1
+        handler = [e for e in events if e["name"] == "ps.rpc.handler"][0]
+        assert handler["args"]["parent"] == call_ctx.span_id
+        json.dumps(doc)   # chrome-loadable: valid JSON
+        trees = build_trees(spans)
+        (roots, children, _), = trees.values()
+        assert [r["name"] for r in roots] == ["trainer.step"]
+
+    def test_cli_end_to_end_smoke(self, tmp_path):
+        """ISSUE 6 satellite: trace_view.py + perf_report.py run
+        end-to-end (incl. --help) on a generated two-process log pair —
+        stdlib-only subprocesses, no jax import."""
+        a, b, _ = self._two_process_pair(tmp_path)
+        # torn final line (SIGKILLed writer): both tools must tolerate it
+        with open(b, "a") as f:
+            f.write('{"ts": 1, "kind": "coun')
+        out = str(tmp_path / "merged.json")
+        r = subprocess.run(
+            [sys.executable, os.path.join("tools", "trace_view.py"),
+             a, b, "--out", out],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
+        assert r.returncode == 0, r.stderr
+        assert "2 log(s)" in r.stdout and "critical path" in r.stdout
+        assert "skipped 1 malformed" in r.stderr
+        doc = json.load(open(out))
+        assert len([e for e in doc["traceEvents"] if e["ph"] == "X"]) == 3
+        r2 = subprocess.run(
+            [sys.executable, os.path.join("tools", "perf_report.py"),
+             b, "--json"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
+        assert r2.returncode == 0, r2.stderr
+        s = json.loads(r2.stdout)
+        assert s["malformed_lines"] == 1
+        assert s["tracing"]["spans"] == 1
+        for tool in ("trace_view.py", "perf_report.py"):
+            h = subprocess.run(
+                [sys.executable, os.path.join("tools", tool), "--help"],
+                cwd=REPO_ROOT, capture_output=True, timeout=60)
+            assert h.returncode == 0
+
+    def test_missing_trace_exits_2(self, tmp_path):
+        a, b, _ = self._two_process_pair(tmp_path)
+        r = subprocess.run(
+            [sys.executable, os.path.join("tools", "trace_view.py"),
+             a, "--trace", "no-such-trace"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
+        assert r.returncode == 2
+
+
+class TestWindowedMetrics:
+    def test_rates_and_percentiles(self):
+        for _ in range(6):
+            telemetry.counter_add("w.hits", 2)
+        for v in (1.0, 2.0, 3.0, 4.0, 100.0):
+            telemetry.observe("w.ms", v, kind="timer")
+        win = telemetry.windowed(30)
+        assert win["window_s"] == 30.0
+        c = win["counters"]["w.hits"]
+        assert c["delta"] == 12 and c["rate"] == pytest.approx(0.4)
+        h = win["hists"]["w.ms"]
+        assert h["count"] == 5 and h["p99"] == 100.0
+        assert h["p50"] == 3.0
+        assert h["rate"] == pytest.approx(5 / 30, rel=1e-4)
+
+    def test_old_samples_age_out(self):
+        telemetry.counter_add("w.old", 5)
+        telemetry.observe("w.oldms", 9.0)
+        reg = telemetry.TelemetryRegistry.instance()
+        with reg._lock:   # age the entries past any window
+            for dq in reg._win_counts.values():
+                for entry in dq:
+                    entry[0] -= 10_000
+            reg._win_samples["w.oldms"] = type(
+                reg._win_samples["w.oldms"])(
+                [(ts - 10_000, v)
+                 for ts, v in reg._win_samples["w.oldms"]],
+                maxlen=reg._win_samples["w.oldms"].maxlen)
+        win = telemetry.windowed(60)
+        assert "w.old" not in win["counters"]
+        assert "w.oldms" not in win["hists"]
+        # cumulative registry still remembers
+        assert telemetry.counter_get("w.old") == 5
+
+    def test_prometheus_text_format(self):
+        telemetry.counter_add("p.reqs", 3)
+        telemetry.gauge_set("p.depth", 7)
+        telemetry.observe("p.ms", 12.5, kind="timer")
+        txt = telemetry.prometheus_text()
+        assert "# TYPE pt_p_reqs_total counter" in txt
+        assert "pt_p_reqs_total 3" in txt
+        assert "pt_p_depth 7" in txt
+        assert 'pt_p_ms{quantile="0.5"} 12.5' in txt
+        assert "pt_p_ms_count 1" in txt
+        assert "pt_p_reqs_rate" in txt
+
+    def test_standalone_metrics_server(self):
+        telemetry.counter_add("m.probe", 11)
+        srv = telemetry.start_metrics_server()
+        try:
+            body = urllib.request.urlopen(
+                srv.url + "/metrics", timeout=10).read().decode()
+            assert "pt_m_probe_total 11" in body
+            hz = json.loads(urllib.request.urlopen(
+                srv.url + "/healthz", timeout=10).read())
+            assert hz["status"] == "ok"
+            varz = json.loads(urllib.request.urlopen(
+                srv.url + "/varz", timeout=10).read())
+            assert varz["snapshot"]["counters"]["m.probe"] == 11
+        finally:
+            srv.shutdown()
+
+
+class TestBufferedSink:
+    def test_line_batching_and_flush_sink(self, tmp_path):
+        log = tmp_path / "run.jsonl"
+        pt.set_flags({"FLAGS_telemetry_buffer_lines": 1000,
+                      "FLAGS_telemetry_flush_s": 3600.0})
+        telemetry.configure(str(log))
+        for i in range(10):
+            telemetry.counter_add("b.x", 1)
+        on_disk = [l for l in open(log)] if log.exists() else []
+        assert len(on_disk) < 10, "writes must be buffered"
+        telemetry.flush_sink()
+        assert len([l for l in open(log) if l.strip()]) == 10
+
+    def test_path_change_flushes(self, tmp_path):
+        log = tmp_path / "run.jsonl"
+        pt.set_flags({"FLAGS_telemetry_buffer_lines": 1000,
+                      "FLAGS_telemetry_flush_s": 3600.0})
+        telemetry.configure(str(log))
+        telemetry.counter_add("b.y", 1)
+        telemetry.configure(None)   # close → flush
+        recs = [json.loads(l) for l in open(log) if l.strip()]
+        assert [r["name"] for r in recs] == ["b.y"]
+
+    def test_write_failure_counts_dropped_never_raises(self, tmp_path):
+        log = tmp_path / "run.jsonl"
+        pt.set_flags({"FLAGS_telemetry_buffer_lines": 1})
+        telemetry.configure(str(log))
+        telemetry.counter_add("d.ok", 1)
+
+        class _Broken:
+            def write(self, *_):
+                raise OSError("disk full")
+
+            def flush(self):
+                raise OSError("disk full")
+
+            def close(self):
+                pass
+
+        reg = telemetry.TelemetryRegistry.instance()
+        with reg._lock:
+            reg._file.close()
+            reg._file = _Broken()
+        # must NOT raise into the instrumented thread
+        telemetry.counter_add("d.lost", 1)
+        telemetry.counter_add("d.lost", 1)
+        assert telemetry.counter_get("telemetry.dropped_records") >= 2
+        assert telemetry.counter_get("d.lost") == 2   # in-memory intact
+        telemetry.configure(None)

@@ -108,10 +108,36 @@ def _run_clients(n_clients, n_requests, call):
     return time.perf_counter() - t0, sorted(latencies), errors
 
 
+def _scrape_metrics(url, stop_event, out):
+    """Poll GET /metrics while the load runs (stdlib HTTP client) and keep
+    the last scrape that carried a rolling-window p99 request latency and
+    request rate — the live-metrics acceptance probe."""
+    import re
+    import urllib.request
+
+    while not stop_event.is_set():
+        stop_event.wait(0.05)
+        try:
+            body = urllib.request.urlopen(
+                url + "/metrics", timeout=5).read().decode()
+        except Exception:
+            continue
+        p99 = re.search(
+            r'^pt_serving_request_ms\{quantile="0\.99"\} ([\d.eE+-]+)',
+            body, re.M)
+        rate = re.search(
+            r'^pt_serving_requests_rate\{[^}]*\} ([\d.eE+-]+)', body, re.M)
+        if p99 and rate:
+            out["p99_ms"] = float(p99.group(1))
+            out["request_rate"] = float(rate.group(1))
+            out["scrapes"] = out.get("scrapes", 0) + 1
+
+
 def bench_closed(args, make_batch, model_dir):
     from paddle_tpu.core import telemetry
     from paddle_tpu.inference import AnalysisConfig, create_predictor
     from paddle_tpu.serving import LocalClient, ServingConfig, ServingEngine
+    from paddle_tpu.serving.server import ServingHTTPServer
 
     batch = make_batch(args.rows)
 
@@ -137,14 +163,31 @@ def bench_closed(args, make_batch, model_dir):
                              batch_timeout_ms=args.batch_timeout_ms))
     engine.start(warmup=True)
     client = LocalClient(engine)
+    # live-metrics plane: scrape GET /metrics mid-load over real HTTP —
+    # the rolling-window p99 + request rate must be visible WHILE the
+    # load runs, not just post-hoc (ISSUE 6 acceptance; --smoke CI row)
+    http_srv = ServingHTTPServer(engine).start()
+    scraped = {}
+    stop_scrape = threading.Event()
+    scraper = threading.Thread(target=_scrape_metrics,
+                               args=(http_srv.url, stop_scrape, scraped),
+                               daemon=True)
+    scraper.start()
     try:
         wall, lat, errors = _run_clients(
             args.concurrency, args.requests,
             lambda: client.infer({"img": batch}, timeout=60))
     finally:
+        stop_scrape.set()
+        scraper.join(timeout=10)
+        http_srv.shutdown()
         engine.close(drain=True, timeout=10)
     if errors:
         raise SystemExit(f"engine errors: {errors[:3]}")
+    if "p99_ms" not in scraped:
+        raise SystemExit(
+            "GET /metrics never returned a rolling-window p99 + request "
+            "rate during the load — live metrics plane is broken")
     qps = args.requests / wall
 
     c = telemetry.counters()
@@ -171,6 +214,9 @@ def bench_closed(args, make_batch, model_dir):
             if rows else None,
             "batches": int(c.get("serving.batches", 0)),
             "rejects": int(c.get("serving.rejects", 0)),
+            "metrics_scrapes": int(scraped.get("scrapes", 0)),
+            "scraped_window_p99_ms": round(scraped["p99_ms"], 3),
+            "scraped_request_rate": round(scraped["request_rate"], 2),
         },
     }
 

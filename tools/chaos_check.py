@@ -83,7 +83,8 @@ def run(args) -> int:
         telemetry.configure(args.telemetry_log)
     pt.set_flags({"FLAGS_ps_rpc_timeout": args.rpc_timeout,
                   "FLAGS_ps_rpc_max_retries": args.max_retries,
-                  "FLAGS_ps_rpc_backoff": args.backoff})
+                  "FLAGS_ps_rpc_backoff": args.backoff,
+                  "FLAGS_trace_sample_rate": args.trace_sample})
     faults.configure(args.fault_spec, seed=args.seed)
 
     main, startup, loss = build_net(args.lr)
@@ -136,7 +137,8 @@ def run(args) -> int:
 
     tally_keys = ("faults.injected", "ps.rpc_calls", "ps.rpc_retries",
                   "ps.rpc_reconnects", "ps.rpc_dedup_hits",
-                  "ps.rpc_deadline_exceeded", "ps.rpc_errors")
+                  "ps.rpc_deadline_exceeded", "ps.rpc_errors",
+                  "trace.spans")
     counters = telemetry.counters()
     print("-- telemetry tally " + "-" * 30)
     for key in tally_keys:
@@ -179,6 +181,10 @@ def run_serving(args) -> int:
 
     if args.telemetry_log:
         telemetry.configure(args.telemetry_log)
+    if args.trace_sample:
+        from paddle_tpu.core import flags as _flags
+
+        _flags.set_flags({"trace_sample_rate": args.trace_sample})
     spec = args.fault_spec or "serving.handler:%3"
     faults.configure(spec, seed=args.seed)
 
@@ -232,7 +238,8 @@ def run_serving(args) -> int:
     injected = int(counters.get("faults.injected", 0))
     print("-- serving chaos tally " + "-" * 26)
     for key in ("faults.injected", "serving.requests", "serving.batches",
-                "serving.handler_errors", "serving.rejects"):
+                "serving.handler_errors", "serving.rejects",
+                "trace.spans"):
         print(f"{key:28s} {int(counters.get(key, 0))}")
     print(f"responses: {len(ok)} ok / {len(failed)} error / "
           f"{len(hung)} hung")
@@ -278,6 +285,8 @@ def run_checkpoint(args) -> int:
 
     if args.telemetry_log:
         telemetry.configure(args.telemetry_log)
+    if args.trace_sample:
+        pt.set_flags({"FLAGS_trace_sample_rate": args.trace_sample})
     spec = args.fault_spec or "ckpt.save.commit:%3"
     faults.configure(spec, seed=args.seed)
 
@@ -322,7 +331,7 @@ def run_checkpoint(args) -> int:
     counters = telemetry.counters()
     tally_keys = ("faults.injected", "ckpt.saves", "ckpt.restores",
                   "ckpt.verify_failures", "ckpt.fallbacks",
-                  "ckpt.quarantined")
+                  "ckpt.quarantined", "trace.spans")
     print("-- checkpoint chaos tally " + "-" * 23)
     for key in tally_keys:
         print(f"{key:28s} {int(counters.get(key, 0))}")
@@ -374,6 +383,12 @@ def main():
                     help="--serving mode: total client requests")
     ap.add_argument("--seed", type=int, default=0,
                     help="fault-injection seed (FLAGS_fault_seed)")
+    ap.add_argument("--trace-sample", type=float, default=0.0,
+                    help="FLAGS_trace_sample_rate for the run — with a "
+                         "--telemetry-log, span records land in the log "
+                         "(render with tools/trace_view.py) and the "
+                         "trace.spans tally is printed alongside the "
+                         "fault counts")
     ap.add_argument("--steps", type=int, default=6)
     ap.add_argument("--servers", type=int, default=1)
     ap.add_argument("--lr", type=float, default=0.1)

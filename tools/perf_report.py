@@ -24,8 +24,17 @@ Renders the structured run log written by ``paddle_tpu.core.telemetry``
   crash-consistent protocol (paddle_tpu/checkpoint.py): commits, bytes,
   verification rejections + fallbacks to older checkpoints, quarantined
   dirs, and save/restore latency percentiles;
+* a "Tracing" section when the run emitted distributed-tracing spans
+  (core/trace.py, FLAGS_trace_sample_rate): trace/span counts and
+  per-span-name duration percentiles — merge multi-process logs with
+  tools/trace_view.py for the full causal trees;
 * the profiler.summarize() host-span table when the log carries one
   (telemetry.flush() embeds it at exit).
+
+Malformed lines (a SIGKILLed process tears its final line mid-write —
+PR 5 chaos runs produce these) are skipped AND counted: the summary
+carries ``malformed_lines`` and the report prints the count instead of
+the tool crashing on a torn log.
 
 Stdlib-only on purpose: a run log from a TPU worker renders on any
 machine, no jax/framework import.
@@ -43,10 +52,11 @@ import sys
 from collections import defaultdict
 
 
-def load(path):
-    """Read a JSONL log, skipping malformed lines (a crashed run may leave
-    a torn final line — the report should still render)."""
-    recs = []
+def load_counted(path):
+    """Read a JSONL log, skipping malformed lines (a SIGKILLed run tears
+    its final line mid-write — the report must still render). Returns
+    (records, malformed_line_count)."""
+    recs, malformed = [], 0
     with open(path) as f:
         for ln, line in enumerate(f, 1):
             line = line.strip()
@@ -55,12 +65,20 @@ def load(path):
             try:
                 rec = json.loads(line)
             except json.JSONDecodeError:
+                malformed += 1
                 print(f"perf_report: skipping malformed line {ln}",
                       file=sys.stderr)
                 continue
             if isinstance(rec, dict):
                 recs.append(rec)
-    return recs
+            else:
+                malformed += 1
+    return recs, malformed
+
+
+def load(path):
+    """Records only (compat shim over load_counted)."""
+    return load_counted(path)[0]
 
 
 def _pct(sorted_vals, q):
@@ -70,7 +88,7 @@ def _pct(sorted_vals, q):
     return sorted_vals[i]
 
 
-def summarize_log(recs):
+def summarize_log(recs, malformed=0):
     timers = defaultdict(list)
     counter_delta = defaultdict(float)
     counter_last = {}
@@ -79,6 +97,8 @@ def summarize_log(recs):
     steps = []
     metrics = []
     profiler_rows = []
+    spans = defaultdict(list)
+    span_traces = set()
     snapshot = None
     ts = [r["ts"] for r in recs if isinstance(r.get("ts"), (int, float))]
     for r in recs:
@@ -86,6 +106,11 @@ def summarize_log(recs):
         v, attrs = r.get("value"), r.get("attrs") or {}
         if kind == "timer" and isinstance(v, (int, float)):
             timers[name].append(float(v))
+        elif kind == "span":
+            if isinstance(v, (int, float)):
+                spans[name].append(float(v))
+            if attrs.get("trace"):
+                span_traces.add(attrs["trace"])
         elif kind == "compile":
             compiles.append({"ts": r.get("ts"), "ms": v,
                              "cause": attrs.get("cause"),
@@ -129,10 +154,24 @@ def summarize_log(recs):
     serving = _serving_summary(counter_delta, counter_last, timer_summary,
                                gauges)
     ckpt = _ckpt_summary(counter_delta, counter_last, timer_summary)
+    tracing = None
+    if spans:
+        by_name = {}
+        for name, vals in sorted(spans.items()):
+            s = sorted(vals)
+            by_name[name] = {"count": len(s),
+                             "p50_ms": round(_pct(s, 0.50), 3),
+                             "p99_ms": round(_pct(s, 0.99), 3),
+                             "max_ms": round(s[-1], 3)}
+        tracing = {"spans": sum(len(v) for v in spans.values()),
+                   "traces": len(span_traces),
+                   "by_name": by_name}
     return {
         "fused": fused,
         "serving": serving,
         "checkpoint": ckpt,
+        "tracing": tracing,
+        "malformed_lines": int(malformed),
         "records": len(recs),
         "span_s": round(max(ts) - min(ts), 3) if ts else 0.0,
         "timers": timer_summary,
@@ -266,6 +305,9 @@ def _fmt_num(v):
 def render(s, out=sys.stdout):
     w = out.write
     w(f"== run log: {s['records']} records over {s['span_s']}s ==\n")
+    if s.get("malformed_lines"):
+        w(f"(skipped {s['malformed_lines']} malformed/torn line(s) — "
+          f"crashed writer?)\n")
 
     if s["timers"]:
         w("\n-- step/latency timers (ms) --\n")
@@ -342,6 +384,17 @@ def render(s, out=sys.stdout):
         if "ps_checkpoints" in ck:
             w(f"pserver snapshots: {ck['ps_checkpoints']}\n")
 
+    if s.get("tracing"):
+        tr = s["tracing"]
+        w("\n-- tracing (distributed spans) --\n")
+        w(f"spans: {tr['spans']}  traces: {tr['traces']}  "
+          f"(merge multi-process logs with tools/trace_view.py)\n")
+        w(f"{'span':<34}{'count':>8}{'p50 ms':>10}{'p99 ms':>10}"
+          f"{'max ms':>10}\n")
+        for name, row in tr["by_name"].items():
+            w(f"{name[:33]:<34}{row['count']:>8}{row['p50_ms']:>10}"
+              f"{row['p99_ms']:>10}{row['max_ms']:>10}\n")
+
     if s["counters"]:
         w("\n-- counters (delta over log / final) --\n")
         for name, c in s["counters"].items():
@@ -385,7 +438,8 @@ def main(argv=None):
     ap.add_argument("--json", action="store_true",
                     help="print the computed summary as JSON")
     args = ap.parse_args(argv)
-    summary = summarize_log(load(args.log))
+    recs, malformed = load_counted(args.log)
+    summary = summarize_log(recs, malformed=malformed)
     if args.json:
         print(json.dumps(summary, indent=2, default=str))
     else:

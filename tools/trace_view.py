@@ -1,0 +1,229 @@
+#!/usr/bin/env python
+"""trace_view — merge multi-process JSONL run logs into one trace.
+
+The reference renders single-process CUPTI dumps with tools/timeline.py;
+a distributed run (trainer + pserver + serving worker) writes one
+telemetry JSONL log PER PROCESS, and the causal picture only exists
+after merging them by ``trace_id``. This tool is that merge:
+
+  1. reads any number of run logs (``kind:"span"`` records from
+     paddle_tpu/core/trace.py; malformed/torn lines are skipped and
+     counted, crashed processes still render);
+  2. groups spans by trace id ACROSS files — a PS RPC's client span
+     (trainer log) and handler span (pserver log), or a serving
+     request's HTTP + queue + predictor spans, land in one tree via
+     their propagated parent ids;
+  3. writes a chrome://tracing / Perfetto-loadable JSON (``--out``):
+     one chrome "process" row per source log (named file:pid), spans as
+     complete ("X") events carrying trace/span/parent in args;
+  4. prints a per-trace summary: span tree with durations and the
+     critical path (the chain of latest-finishing children from the
+     root) — the first thing to read when a p99 request is slow.
+
+Stdlib-only on purpose, like tools/perf_report.py: logs from any worker
+render on any machine.
+
+Usage:
+    python tools/trace_view.py trainer.jsonl pserver.jsonl --out t.json
+    python tools/trace_view.py run.jsonl --trace 4f2a...   # one trace
+    python tools/trace_view.py serving.jsonl --summary-only
+
+Exit status: 0 on success; 2 when no span records were found (or
+``--trace`` named a trace that is not in the logs).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import defaultdict
+
+
+def load_spans(paths):
+    """Span records from each log, tagged with their source file index.
+    Returns (spans, malformed_count, records_count)."""
+    spans, malformed, total = [], 0, 0
+    for idx, path in enumerate(paths):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    malformed += 1
+                    continue
+                if not isinstance(rec, dict):
+                    malformed += 1
+                    continue
+                total += 1
+                if rec.get("kind") != "span":
+                    continue
+                attrs = rec.get("attrs") or {}
+                if not attrs.get("trace") or "start" not in attrs:
+                    continue
+                try:
+                    spans.append({
+                        "name": str(rec.get("name")),
+                        "dur_ms": float(rec.get("value") or 0.0),
+                        "start": float(attrs["start"]),
+                        "trace": str(attrs["trace"]),
+                        "span": str(attrs.get("span") or ""),
+                        "parent": attrs.get("parent"),
+                        "pid": attrs.get("pid", 0),
+                        "tid": str(attrs.get("tid") or "main"),
+                        "file": idx,
+                        "attrs": {k: v for k, v in attrs.items()
+                                  if k not in ("trace", "span", "parent",
+                                               "start", "pid", "tid")},
+                    })
+                except (TypeError, ValueError):
+                    malformed += 1
+    return spans, malformed, total
+
+
+def chrome_trace(spans, paths):
+    """chrome://tracing JSON: one chrome process per source log (so a
+    trainer and a pserver render as separate swimlanes even when a
+    synthetic pair shares an OS pid), threads mapped per (file, tid)."""
+    events = []
+    pid_of = {}          # file idx -> chrome pid
+    tid_of = {}          # (file idx, tid name) -> chrome tid
+    for idx, path in enumerate(paths):
+        pid_of[idx] = idx
+        events.append({"ph": "M", "name": "process_name", "pid": idx,
+                       "tid": 0, "args": {"name": os.path.basename(path)}})
+    for s in spans:
+        pid = pid_of[s["file"]]
+        key = (s["file"], s["tid"])
+        if key not in tid_of:
+            tid_of[key] = len([k for k in tid_of if k[0] == s["file"]]) + 1
+            events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                           "tid": tid_of[key],
+                           "args": {"name": f"{s['tid']} (pid {s['pid']})"}})
+        events.append({
+            "name": s["name"], "ph": "X", "cat": "span",
+            "ts": s["start"] * 1e6, "dur": max(s["dur_ms"], 0.0) * 1e3,
+            "pid": pid, "tid": tid_of[key],
+            "args": {"trace": s["trace"], "span": s["span"],
+                     "parent": s["parent"], **s["attrs"]},
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def build_trees(spans):
+    """trace id -> (roots, children map, spans-by-id) with cross-process
+    parent links resolved; spans whose parent is not in the logs are
+    roots of their trace."""
+    by_trace = defaultdict(list)
+    for s in spans:
+        by_trace[s["trace"]].append(s)
+    trees = {}
+    for trace_id, group in by_trace.items():
+        by_id = {s["span"]: s for s in group if s["span"]}
+        children = defaultdict(list)
+        roots = []
+        for s in group:
+            parent = s.get("parent")
+            if parent and parent in by_id:
+                children[parent].append(s)
+            else:
+                roots.append(s)
+        for kids in children.values():
+            kids.sort(key=lambda s: s["start"])
+        roots.sort(key=lambda s: s["start"])
+        trees[trace_id] = (roots, children, by_id)
+    return trees
+
+
+def critical_path(root, children):
+    """Chain of latest-finishing children from the root — the sequence
+    of spans that actually bounded this trace's wall time."""
+    path = [root]
+    node = root
+    while children.get(node["span"]):
+        node = max(children[node["span"]],
+                   key=lambda s: s["start"] + s["dur_ms"] / 1e3)
+        path.append(node)
+    return path
+
+
+def render_summary(trees, paths, out=sys.stdout):
+    w = out.write
+    for trace_id in sorted(trees, key=lambda t: min(
+            s["start"] for s in trees[t][0]) if trees[t][0] else 0):
+        roots, children, by_id = trees[trace_id]
+        all_spans = list(by_id.values()) or roots
+        files = sorted({s["file"] for s in all_spans})
+        t0 = min(s["start"] for s in all_spans)
+        t1 = max(s["start"] + s["dur_ms"] / 1e3 for s in all_spans)
+        w(f"\n== trace {trace_id}: {len(all_spans)} spans across "
+          f"{len(files)} process(es), {(t1 - t0) * 1e3:.2f} ms ==\n")
+
+        def emit(span, depth):
+            src = os.path.basename(paths[span["file"]])
+            off = (span["start"] - t0) * 1e3
+            w(f"  {'  ' * depth}{span['name']:<{max(1, 38 - 2 * depth)}}"
+              f"{span['dur_ms']:>10.3f} ms  +{off:>8.2f}  [{src}]\n")
+            for kid in children.get(span["span"], ()):
+                emit(kid, depth + 1)
+
+        for root in roots:
+            emit(root, 0)
+        if roots:
+            cp = critical_path(roots[0], children)
+            if len(cp) > 1:
+                w("  critical path: "
+                  + " -> ".join(s["name"] for s in cp)
+                  + f"  ({cp[-1]['dur_ms']:.3f} ms at the leaf)\n")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="merge JSONL run logs by trace id into one "
+                    "chrome://tracing file + span-tree summaries")
+    ap.add_argument("logs", nargs="+", help="telemetry JSONL run logs "
+                    "(one per process: trainer, pserver, serving, ...)")
+    ap.add_argument("--out", default="",
+                    help="write the merged chrome://tracing JSON here")
+    ap.add_argument("--trace", default="",
+                    help="only this trace id (summary + output)")
+    ap.add_argument("--summary-only", action="store_true",
+                    help="skip the chrome trace even if --out is set")
+    args = ap.parse_args(argv)
+
+    spans, malformed, total = load_spans(args.logs)
+    if malformed:
+        print(f"trace_view: skipped {malformed} malformed line(s)",
+              file=sys.stderr)
+    if args.trace:
+        spans = [s for s in spans if s["trace"] == args.trace]
+        if not spans:
+            print(f"trace_view: trace {args.trace!r} not found in "
+                  f"{len(args.logs)} log(s) ({total} records)",
+                  file=sys.stderr)
+            return 2
+    if not spans:
+        print(f"trace_view: no span records in {len(args.logs)} log(s) "
+              f"({total} records) — was FLAGS_trace_sample_rate 0?",
+              file=sys.stderr)
+        return 2
+
+    print(f"{len(spans)} spans, "
+          f"{len({s['trace'] for s in spans})} trace(s), "
+          f"{len(args.logs)} log(s)")
+    if args.out and not args.summary_only:
+        doc = chrome_trace(spans, args.logs)
+        with open(args.out, "w") as f:
+            json.dump(doc, f)
+        print(f"wrote {args.out}: {len(doc['traceEvents'])} events "
+              f"(load in chrome://tracing or ui.perfetto.dev)")
+    render_summary(build_trees(spans), args.logs)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
