@@ -18,6 +18,13 @@ lax.scan dispatch (Executor.run_steps); the BENCH row records the
 configuration in extra.steps_per_dispatch and the dispatch-amortization
 counters (telemetry_fused_dispatches / telemetry_fused_steps) merged by
 finalize_bench_result.
+
+Sharded mode: when a mesh is active the row also records
+extra.mesh_shape, extra.axis_rules_hash (the logical-axis-rule table
+fingerprint, parallel/axis_rules.py) and extra.zero_stage (the fleet
+ShardingOptimizer's ZeRO stage) — MULTICHIP rows stay attributable to
+their exact partitioning config. No TPU relay in this container, so the
+sharded config is validated on the MLP/LeNet harness.
 """
 
 from __future__ import annotations

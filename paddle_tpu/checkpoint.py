@@ -131,6 +131,37 @@ def _sha256_file(path: str) -> str:
     return h.hexdigest()
 
 
+def _sharding_manifest_extras(program) -> Optional[Dict[str, Any]]:
+    """Sharding configuration of the saving run (rule-table fingerprint +
+    ZeRO stage) — recorded so a restore under a DIFFERENT table is
+    detected (and counted) as a reshard-on-load. Arrays are always saved
+    at GLOBAL shape (_to_host device_gets sharded arrays), so resharding
+    is just the next compile's in_shardings — no data munging."""
+    from .parallel import axis_rules
+
+    fp = axis_rules.fingerprint()
+    zs = getattr(program, "_zero_stage", None) if program is not None else None
+    if fp is None and zs is None:
+        return None
+    return {"axis_rules": fp, "zero_stage": zs}
+
+
+def _note_resharding(extras: Optional[Dict[str, Any]]):
+    """Compare the snapshot's recorded rule table with the active one;
+    count a sharding.resharding_events when they differ (the restored
+    global arrays re-lay out lazily at the next dispatch)."""
+    sh = (extras or {}).get("sharding") or {}
+    saved = sh.get("axis_rules")
+    if saved is None:
+        return
+    from .parallel import axis_rules
+
+    active = axis_rules.fingerprint()
+    if active != saved:
+        telemetry.counter_add("sharding.resharding_events", 1,
+                              saved_rules=saved, active_rules=active)
+
+
 def _rng_state_jsonable() -> list:
     from .generator import get_rng_state
 
@@ -414,6 +445,10 @@ def save_checkpoint(path: str, program: Optional[Program] = None,
     if "@STEP_COUNTER@" in state:
         step = int(np.asarray(state["@STEP_COUNTER@"]).reshape(-1)[0])
     seq = _read_seq(path) + 1
+    sh = _sharding_manifest_extras(program)
+    if sh is not None:
+        extras = dict(extras or {})
+        extras.setdefault("sharding", sh)
     host = {k: _to_host(v) for k, v in state.items()}
     if async_save:
         _writer.submit(lambda: write_checkpoint_dir(path, host, extras,
@@ -439,6 +474,7 @@ def load_checkpoint(path: str, program: Optional[Program] = None,
     for name, val in arrays.items():
         scope.set(name, val)
     _restore_rng(manifest.get("extras"))
+    _note_resharding(manifest.get("extras"))
     return int(manifest.get("step", 0))
 
 
@@ -539,11 +575,15 @@ class CheckpointManager:
              scope: Optional[Scope] = None,
              extras: Optional[Dict[str, Any]] = None,
              force: bool = False) -> bool:
-        state = _persistable_state(program or default_main_program(),
-                                   scope or global_scope())
+        program = program or default_main_program()
+        state = _persistable_state(program, scope or global_scope())
         if not state:
             raise ValueError("no persistable state in scope — run the "
                              "startup program first")
+        sh = _sharding_manifest_extras(program)
+        if sh is not None:
+            extras = dict(extras or {})
+            extras.setdefault("sharding", sh)
         return self.save_arrays(step, state, extras=extras, force=force)
 
     def _retain(self):
@@ -580,6 +620,7 @@ class CheckpointManager:
                                       skipped=rejected)
             extras = manifest.get("extras") or {}
             _restore_rng(extras)
+            _note_resharding(extras)
             self.last_restore_extras = extras
             self._last_saved = int(manifest.get("step", step))
             return self._last_saved, arrays, extras

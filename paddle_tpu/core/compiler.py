@@ -64,19 +64,28 @@ class CompiledProgram:
     def with_data_parallel(self, loss_name: Optional[str] = None,
                            build_strategy: Optional[BuildStrategy] = None,
                            exec_strategy: Optional[ExecutionStrategy] = None,
-                           places=None, mesh=None, data_axis: str = "dp"):
+                           places=None, mesh=None,
+                           data_axis: Optional[str] = None):
         """Data parallelism: shard the feed batch axis over the mesh's data
-        axis; parameters stay replicated; XLA inserts the grad allreduce.
+        axis (rule-table driven — the axis the active table maps 'batch'
+        to, 'dp' under the default table); parameters stay replicated; XLA
+        inserts the grad allreduce. A data_axis absent from the mesh is a
+        typed ShardingAxisError at the first run, not an XLA error.
         """
         import jax
         import numpy as np
         from jax.sharding import Mesh
+
+        from ..parallel import axis_rules
 
         self._loss_name = loss_name
         if build_strategy is not None:
             self._build_strategy = build_strategy
         if exec_strategy is not None:
             self._exec_strategy = exec_strategy
+        if data_axis is None:
+            data_axis = (axis_rules.batch_mesh_axis(mesh) if mesh is not None
+                         else None) or "dp"
         if mesh is None:
             devs = np.array(jax.devices())
             mesh = Mesh(devs.reshape(len(devs)), (data_axis,))
@@ -86,10 +95,16 @@ class CompiledProgram:
 
     def _sharding_for_feed(self, feed: Dict[str, Any]):
         """Batch axis of every feed is sharded over the data axis; called by
-        the Executor at run time (feed names are only known then)."""
+        the Executor at run time (feed names are only known then). The
+        spec is validated against the mesh HERE (clean_spec on_missing=
+        'error'): a feed sharding that cannot bind fails with a typed
+        ShardingAxisError instead of an opaque pjit/XLA error."""
         if self._mesh is None:
             return None
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        return {name: NamedSharding(self._mesh, P(self._data_axis))
+        from ..parallel.api import clean_spec
+
+        spec = clean_spec((self._data_axis,), self._mesh, on_missing="error")
+        return {name: NamedSharding(self._mesh, P(*spec))
                 for name in feed}

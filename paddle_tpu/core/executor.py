@@ -210,7 +210,7 @@ def _collect_collective_ops(ops, _seen=None) -> List[OpDesc]:
 # key order — the recompile-cause diagnostic names these in events
 _KEY_COMPONENTS = ("program", "program_version", "scope", "feed_names",
                    "fetch_names", "mesh", "dp_divisibility",
-                   "steps_per_dispatch")
+                   "steps_per_dispatch", "axis_rules", "zero_stage")
 
 
 def _assert_all_finite(named_vals, where: str):
@@ -547,8 +547,8 @@ class Executor:
 
         from jax.sharding import PartitionSpec as P
 
-        from ..parallel.api import clean_spec, get_shard_map, \
-            get_sharding_spec
+        from ..parallel import axis_rules
+        from ..parallel.api import clean_spec, get_shard_map, spec_for_var
 
         axes = tuple(mesh.axis_names)
         mesh_shape = tuple(int(mesh.shape[a]) for a in axes)
@@ -556,12 +556,16 @@ class Executor:
         coords = list(np.ndindex(*mesh_shape))   # rank -> per-axis coord
 
         def var_spec(name, default=None):
-            spec = None
+            # ONE resolution path with the compiled executor. use_rules
+            # off: the oracle mirrors shard_map, where ops see LOCAL
+            # shards — only explicit specs (paired with in-program
+            # collectives by their author) are sound
             if block.has_var(name):
-                spec = get_sharding_spec(block.var(name))
-            if spec is None:
-                spec = default
-            return tuple(clean_spec(spec, mesh)) if spec else ()
+                spec = spec_for_var(block.var(name), mesh, default=default,
+                                    use_rules=False)
+            else:
+                spec = clean_spec(default, mesh) if default else None
+            return tuple(spec) if spec else ()
 
         def shard_value(val, spec, coord):
             v = val
@@ -616,12 +620,13 @@ class Executor:
         specs: Dict[str, tuple] = {}
         names_vals = dict(scope.items())
         names_vals.update(feed)
+        batch_axis = axis_rules.batch_mesh_axis(mesh)
         for name, val in names_vals.items():
             dp_default = None
-            if name in feed and "dp" in mesh.shape and \
+            if name in feed and batch_axis and \
                     getattr(val, "ndim", 0) >= 1 and \
-                    np.shape(val)[0] % mesh.shape["dp"] == 0:
-                dp_default = ("dp",)
+                    np.shape(val)[0] % mesh.shape[batch_axis] == 0:
+                dp_default = (batch_axis,)
             spec = var_spec(name, dp_default)
             specs[name] = spec
             for r, c in enumerate(coords):
@@ -765,12 +770,16 @@ class Executor:
         import jax
 
         feed_names = tuple(sorted(feed))
-        # default dp-sharding of a feed is only safe when its batch dim
-        # divides the dp axis; partial batches compile a replicated entry.
+        # default batch-sharding of a feed is only safe when its batch dim
+        # divides the mesh's batch axis (rule-table driven, 'dp' under the
+        # default table); partial batches compile a replicated entry.
         # Under K-step fusion the per-step batch dim sits BEHIND the
         # stacked [k] axis (dim 1)
+        from ..parallel import axis_rules
+
         batch_dim = 1 if scan_k else 0
-        dp = mesh.shape.get("dp") if mesh is not None else None
+        batch_axis = axis_rules.batch_mesh_axis(mesh)
+        dp = mesh.shape.get(batch_axis) if batch_axis else None
         dp_ok = {}
         if dp:
             for n in feed_names:
@@ -785,9 +794,15 @@ class Executor:
         if mesh is not None:
             mesh_key = (tuple(mesh.axis_names), mesh.devices.shape,
                         tuple(d.id for d in mesh.devices.flat))
+        # the rule table resolves shardings at trace time, so its content
+        # hash MUST key the cache (a swapped table recompiles instead of
+        # reusing stale shardings); zero_stage names the ZeRO config in
+        # recompile-cause diagnostics
+        rules_fp = axis_rules.fingerprint() if mesh is not None else None
+        zero_stage = getattr(program, "_zero_stage", None)
         key = (program.uid, program.version, scope.uid, feed_names,
                tuple(fetch_names), mesh_key, tuple(sorted(dp_ok.items())),
-               scan_k)
+               scan_k, rules_fp, zero_stage)
         entry = self._cache.get(key)
         compile_cause = None
         t_compile = None
@@ -856,8 +871,29 @@ class Executor:
             step = _as_device_array(0, np.int32)
 
         t_run = time.perf_counter()
+        t_run_wall = time.time()
         with _prof.RecordEvent("executor::run"):
             fetches, new_state, new_step = entry.jitted(state, ro, feed, step)
+        # sharded-training collective accounting: the ShardingOptimizer
+        # (fleet/meta_optimizers.py) precomputes the per-step dp-collective
+        # payloads of the program; every dispatch books them (×k under
+        # fusion) and, when tracing, a child span puts the collectives on
+        # the trace_view critical path
+        sbytes = getattr(program, "_sharding_bytes", None)
+        if sbytes:
+            k_mult = scan_k or 1
+            for cname, nbytes in sbytes.items():
+                if nbytes:
+                    telemetry.counter_add(f"sharding.{cname}_bytes",
+                                          int(nbytes) * k_mult)
+            parent = trace.current()
+            if parent is not None:
+                # span timebase is epoch seconds (trace._Span.start)
+                trace.record("sharding.collectives", parent, t_run_wall,
+                             time.time(), zero_stage=zero_stage,
+                             steps=k_mult,
+                             **{f"{cn}_bytes": int(nb)
+                                for cn, nb in sbytes.items() if nb})
         if scan_k:
             telemetry.counter_add("executor.fused_dispatches", 1)
             telemetry.counter_add("executor.fused_steps", scan_k)
@@ -878,7 +914,8 @@ class Executor:
                  "fetch_names": list(fetch_names),
                  "mesh": None if mesh_key is None else list(mesh_key[0]),
                  "dp_divisibility": sorted(dp_ok.items()),
-                 "steps_per_dispatch": scan_k or 1})
+                 "steps_per_dispatch": scan_k or 1,
+                 "axis_rules": rules_fp, "zero_stage": zero_stage})
         else:
             # host-side dispatch wall time (device dispatch is async —
             # these are the step-time percentiles in the run log).
@@ -993,8 +1030,10 @@ class Executor:
                                       feed_names, dp_ok, in_shardings,
                                       stacked_feeds=scan_k is not None)
         elif mesh is not None:
-            # Shardings from VarDesc annotations (parallel/api.py): params use
-            # their spec (default replicated), feeds default to batch-over-dp.
+            # Shardings derive from ONE resolution path (parallel/api.py
+            # spec_for_var): explicit VarDesc specs > logical axes through
+            # the rule table; feeds default to batch-over-the-batch-axis.
+            from ..parallel import axis_rules
             from ..parallel.api import named_sharding_for
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -1009,6 +1048,7 @@ class Executor:
                 return NamedSharding(mesh, P(None, *ns.spec)) \
                     if scan_k is not None else ns
 
+            batch_axis = axis_rules.batch_mesh_axis(mesh)
             state_sh = {n: var_sharding(n) for n in state_names}
             ro_sh = {n: var_sharding(n) for n in ro_names}
             feed_sh = {}
@@ -1016,7 +1056,7 @@ class Executor:
                 if in_shardings is not None and n in in_shardings:
                     feed_sh[n] = shift(in_shardings[n])
                 else:
-                    feed_default = (("dp",) if "dp" in mesh.shape
+                    feed_default = ((batch_axis,) if batch_axis
                                     and (dp_ok or {}).get(n) else None)
                     feed_sh[n] = shift(var_sharding(
                         n, default_spec=feed_default))
@@ -1037,21 +1077,26 @@ class Executor:
         [k] axis, which stays unsharded."""
         from jax.sharding import PartitionSpec as P
 
-        from ..parallel.api import clean_spec, get_shard_map, get_sharding_spec
+        from ..parallel import axis_rules
+        from ..parallel.api import clean_spec, get_shard_map, spec_for_var
 
         def var_spec(name, default=None):
-            spec = None
+            # explicit specs only (use_rules off): inside shard_map ops
+            # compute on LOCAL shards, so rule-resolved auto-TP would
+            # change the math unless the program carries matching psums —
+            # explicit specs are the author's contract that it does (the
+            # ZeRO transpile emits its own)
             if block.has_var(name):
-                spec = get_sharding_spec(block.var(name))
-            if spec is None:
-                spec = default
-            if spec is None:
-                return P()
-            return P(*clean_spec(spec, mesh))
+                spec = spec_for_var(block.var(name), mesh, default=default,
+                                    use_rules=False)
+            else:
+                spec = clean_spec(default, mesh) if default else None
+            return P(*spec) if spec else P()
 
         def shift(spec):
             return P(None, *spec) if stacked_feeds else spec
 
+        batch_axis = axis_rules.batch_mesh_axis(mesh)
         state_spec = {n: var_spec(n) for n in state_names}
         ro_spec = {n: var_spec(n) for n in ro_names}
         feed_spec = {}
@@ -1059,7 +1104,7 @@ class Executor:
             if in_shardings is not None and n in in_shardings:
                 feed_spec[n] = shift(in_shardings[n].spec)
                 continue
-            default = ("dp",) if (dp_ok or {}).get(n) and "dp" in mesh.shape \
+            default = (batch_axis,) if (dp_ok or {}).get(n) and batch_axis \
                 else None
             feed_spec[n] = shift(var_spec(n, default))
         in_specs = (state_spec, ro_spec, feed_spec, P())

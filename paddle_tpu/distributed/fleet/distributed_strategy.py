@@ -55,9 +55,14 @@ class DistributedStrategy:
         self.tensor_parallel = False
         self.tensor_parallel_configs: Dict[str, Any] = {
             "tensor_parallel_degree": 1}
-        # sharding/ZeRO-style optimizer-state partitioning
+        # sharding/ZeRO-style optimizer-state partitioning (reference
+        # :1026 sharding/sharding_configs; meta_optimizers.py
+        # ShardingOptimizer): stage 1 shards optimizer state over dp,
+        # stage 2 additionally reduce-scatters the gradients.
+        # sharding_degree <= 1 means "use the full dp world"
         self.sharding = False
-        self.sharding_configs: Dict[str, Any] = {"sharding_degree": 1}
+        self.sharding_configs: Dict[str, Any] = {"sharding_degree": 0,
+                                                 "stage": 1}
         self.elastic = False
         self.auto = False
 

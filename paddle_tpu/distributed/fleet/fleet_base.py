@@ -105,10 +105,26 @@ class DistributedOptimizer:
 
             inner = LocalSGDOptimizer(inner, strategy.localsgd_configs,
                                       nranks=fleet_obj.worker_num())
+        if strategy.sharding:
+            # ZeRO stage-1/2: replaces the grad allreduce tail with the
+            # reduce-scatter → sharded update → allgather schedule
+            if strategy.dgc or strategy.localsgd or strategy.gradient_merge:
+                on = [k for k in ("dgc", "localsgd", "gradient_merge")
+                      if getattr(strategy, k)]
+                raise ValueError(
+                    f"strategy.sharding composes with amp/recompute/"
+                    f"lars/lamb but not with {on} — they own the gradient "
+                    f"exchange themselves")
+            from .meta_optimizers import ShardingOptimizer
+
+            inner = ShardingOptimizer(inner, strategy.sharding_configs,
+                                      nranks=fleet_obj.worker_num())
         self.inner = inner
         # localsgd replaces grad allreduce with periodic param averaging;
-        # dgc carries its own (compressed-grad) allreduce
-        self._skip_grad_allreduce = bool(strategy.localsgd or strategy.dgc)
+        # dgc carries its own (compressed-grad) allreduce; sharding
+        # reduce-scatters instead of allreducing
+        self._skip_grad_allreduce = bool(strategy.localsgd or strategy.dgc
+                                         or strategy.sharding)
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):

@@ -17,6 +17,7 @@ from ..core.ir import Variable, default_main_program
 from ..core.types import convert_dtype
 from ..initializer import Constant, Xavier
 from ..layer_helper import LayerHelper
+from ..parallel.api import set_logical_axes
 from ..param_attr import ParamAttr
 
 
@@ -77,11 +78,15 @@ def fc(input: Variable, size: int, num_flatten_dims: int = 1, param_attr=None,
     helper = LayerHelper("fc", name=name)
     in_features = int(np.prod(input.shape[num_flatten_dims:]))
     w = helper.create_parameter(param_attr, [in_features, size], input.dtype)
+    # logical axis names: the rule table (parallel/axis_rules.py) maps
+    # these to mesh axes at compile time (explicit shard_tensor wins)
+    set_logical_axes(w, ("embed", "mlp"))
     out = helper.create_variable_for_type_inference(input.dtype)
     helper.append_op("mul", {"X": [input], "Y": [w]}, {"Out": [out]},
                      {"x_num_col_dims": num_flatten_dims, "y_num_col_dims": 1})
     if bias_attr is not False:
         b = helper.create_parameter(bias_attr, [size], input.dtype, is_bias=True)
+        set_logical_axes(b, ("mlp",))
         pre_act = helper.create_variable_for_type_inference(input.dtype)
         helper.append_op("elementwise_add", {"X": [out], "Y": [b]},
                          {"Out": [pre_act]}, {"axis": num_flatten_dims})
@@ -109,6 +114,7 @@ def embedding(input: Variable, size, is_sparse: bool = False,
     helper = LayerHelper("embedding", name=name)
     w = helper.create_parameter(param_attr, list(size), dtype,
                                 default_initializer=Xavier())
+    set_logical_axes(w, ("vocab", "embed"))
     out = helper.create_variable_for_type_inference(dtype)
     helper.append_op("lookup_table_v2", {"W": [w], "Ids": [input]},
                      {"Out": [out]},

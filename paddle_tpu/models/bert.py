@@ -23,7 +23,7 @@ from .. import layers
 from ..core.ir import Program, program_guard
 from ..initializer import Normal, TruncatedNormal
 from ..param_attr import ParamAttr
-from ..parallel.api import shard_tensor
+from ..parallel.api import set_logical_axes, shard_tensor
 
 
 @dataclass
@@ -91,10 +91,16 @@ def _dense(x, d_out, name, cfg, act=None, tp_spec=None):
                                 attr=_param(name + "_w", cfg))
     if tp_spec is not None:
         shard_tensor(w, tp_spec)
+    else:
+        # declarative tier: the rule table maps ("embed","mlp") to mesh
+        # axes (parallel/axis_rules.py); explicit tp_spec overrides
+        set_logical_axes(w, ("embed", "mlp"))
     b = layers.create_parameter([d_out], cfg.dtype,
                                 attr=ParamAttr(name=name + "_b"), is_bias=True)
     if tp_spec is not None and tp_spec[-1] is not None:
         shard_tensor(b, (tp_spec[-1],))
+    elif tp_spec is None:
+        set_logical_axes(b, ("mlp",))
     out = layers.linear(x, w, b)
     if act == "gelu":
         out = layers.gelu(out, approximate=True)

@@ -25,7 +25,7 @@ from .. import layers
 from ..core.ir import Program, program_guard
 from ..initializer import Normal, NumpyArrayInitializer
 from ..param_attr import ParamAttr
-from ..parallel.api import shard_tensor
+from ..parallel.api import set_logical_axes, shard_tensor
 
 
 @dataclass
@@ -83,10 +83,14 @@ def _dense(x, d_out, name, cfg, act=None, tp_spec=None):
                        initializer=Normal(0.0, cfg.d_model ** -0.5)))
     if tp_spec is not None:
         shard_tensor(w, tp_spec)
+    else:
+        set_logical_axes(w, ("embed", "mlp"))
     b = layers.create_parameter([d_out], "float32",
                                 attr=ParamAttr(name=name + "_b"), is_bias=True)
     if tp_spec is not None and tp_spec[-1] is not None:
         shard_tensor(b, (tp_spec[-1],))
+    elif tp_spec is None:
+        set_logical_axes(b, ("mlp",))
     out = layers.linear(x, w, b)
     if act:
         out = getattr(layers, act)(out)

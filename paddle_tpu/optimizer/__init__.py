@@ -110,13 +110,18 @@ class Optimizer:
             name=unique_name.generate(f"{param.name}_{name}"))
         # moments of a sharded param must shard the same way (shard_map
         # in_specs come from var annotations; a replicated moment would
-        # meet a sharded grad inside the update op)
+        # meet a sharded grad inside the update op) — both annotation
+        # tiers carry over: explicit specs and logical axis names
         if shape is None or list(shape) == list(param.shape):
-            from ..parallel.api import get_sharding_spec, shard_tensor
+            from ..parallel.api import (get_logical_axes, get_sharding_spec,
+                                        set_logical_axes, shard_tensor)
 
             spec = get_sharding_spec(param)
             if spec is not None:
                 shard_tensor(var, spec)
+            axes = get_logical_axes(param)
+            if axes is not None:
+                set_logical_axes(var, axes)
         acc[param.name] = var
         return var
 

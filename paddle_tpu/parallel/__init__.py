@@ -10,4 +10,15 @@ insert collectives).
 
 from .mesh import (create_hybrid_mesh, create_mesh, get_mesh,  # noqa: F401
                    mesh_axis_size, set_mesh)
-from .api import shard_tensor, shard_parameter, PartitionSpec  # noqa: F401
+from .api import (PartitionSpec, ShardingAxisError,  # noqa: F401
+                  get_logical_axes, set_logical_axes, shard_parameter,
+                  shard_tensor, spec_for_var)
+# NOTE: the axis_rules SUBMODULE stays reachable as parallel.axis_rules;
+# its scoped-override context manager is re-exported as `rule_scope` so
+# the module binding isn't shadowed
+from .axis_rules import AxisRules, DEFAULT_RULES  # noqa: F401
+from .axis_rules import axis_rules as rule_scope  # noqa: F401
+from .axis_rules import get_rules, set_rules  # noqa: F401
+from . import axis_rules as _axis_rules_module  # noqa: F401
+
+axis_rules = _axis_rules_module

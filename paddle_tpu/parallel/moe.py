@@ -45,7 +45,7 @@ def switch_moe(x, gate_w, w1, b1, w2, b2, capacity_factor: float = 1.25,
     t, h = x.shape
     e_local = w1.shape[0]
     spmd = _in_spmd(axis_name)
-    ep = lax.axis_size(axis_name) if spmd else 1
+    ep = lax.axis_size(axis_name) if spmd else 1  # see pipeline_ops._check_ring note
     e = e_local * ep
 
     xf = x.astype(jnp.float32)

@@ -1,0 +1,225 @@
+"""Logical-axis-rule partitioning (parallel/axis_rules.py + api.py):
+rule resolution, typed spec validation, rule-driven executor shardings,
+and the compile-cache keying on the table fingerprint."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.parallel import api, axis_rules, create_mesh
+from paddle_tpu.parallel import mesh as meshmod
+from paddle_tpu.parallel.api import (ShardingAxisError, clean_spec,
+                                     get_logical_axes, set_logical_axes,
+                                     shard_tensor, spec_for_var)
+from paddle_tpu.parallel.axis_rules import AxisRules
+
+
+@pytest.fixture(autouse=True)
+def _clear_mesh():
+    yield
+    meshmod.set_mesh(None)
+
+
+class TestResolve:
+    def test_default_table_maps_batch_and_mlp(self):
+        mesh = create_mesh({"dp": 2, "mp": 4})
+        rules = axis_rules.get_rules()
+        assert rules.resolve(("batch", None), mesh,
+                             shape=(16, 8)) == ("dp", None)
+        assert rules.resolve(("embed", "mlp"), mesh,
+                             shape=(32, 64)) == (None, "mp")
+
+    def test_indivisible_dim_falls_back_to_replicated(self):
+        mesh = create_mesh({"dp": 2, "mp": 4})
+        rules = axis_rules.get_rules()
+        # 10 % 4 != 0 → the mlp→mp rule is skipped, dim replicated
+        assert rules.resolve(("embed", "mlp"), mesh,
+                             shape=(32, 10)) == (None, None)
+
+    def test_mesh_axis_used_once_per_array(self):
+        mesh = create_mesh({"mp": 4})
+        rules = AxisRules((("heads", "mp"), ("mlp", "mp")))
+        # both dims want mp; only the first gets it
+        assert rules.resolve(("heads", "mlp"), mesh,
+                             shape=(8, 8)) == ("mp", None)
+
+    def test_fallback_chain_second_rule_wins(self):
+        mesh = create_mesh({"sp": 8})
+        rules = AxisRules((("batch", "dp"), ("batch", "sp")))
+        assert rules.resolve(("batch",), mesh, shape=(16,)) == ("sp",)
+
+    def test_scoped_override_and_fingerprint(self):
+        fp0 = axis_rules.fingerprint()
+        with axis_rules.axis_rules([("batch", "sp")]):
+            assert axis_rules.fingerprint() != fp0
+            assert axis_rules.get_rules().first_mesh_axis("batch") == "sp"
+        assert axis_rules.fingerprint() == fp0
+
+    def test_batch_mesh_axis_rule_driven(self):
+        mesh = create_mesh({"dp": 8})
+        assert axis_rules.batch_mesh_axis(mesh) == "dp"
+        with axis_rules.axis_rules([("batch", "sp")]):
+            # table names sp, mesh has none → dp fallback
+            assert axis_rules.batch_mesh_axis(mesh) == "dp"
+        mesh2 = create_mesh({"sp": 8})
+        with axis_rules.axis_rules([("batch", "sp")]):
+            assert axis_rules.batch_mesh_axis(mesh2) == "sp"
+
+
+class TestValidation:
+    def test_shard_tensor_rejects_unknown_axis(self):
+        create_mesh({"dp": 8})
+        main = pt.Program()
+        with pt.program_guard(main, pt.Program()):
+            x = layers.data("x", [8])
+        with pytest.raises(ShardingAxisError, match="typo"):
+            shard_tensor(x, ("not_an_axis",))
+
+    def test_clean_spec_rejects_unknown_axis(self):
+        mesh = create_mesh({"dp": 8})
+        with pytest.raises(ShardingAxisError):
+            clean_spec(("dq",), mesh)
+
+    def test_clean_spec_drops_known_but_absent_axis(self):
+        mesh = create_mesh({"dp": 8})
+        assert clean_spec(("mp", "dp"), mesh) == (None, "dp")
+
+    def test_clean_spec_error_mode_raises_on_absent(self):
+        mesh = create_mesh({"sp": 8})
+        with pytest.raises(ShardingAxisError, match="not in the active"):
+            clean_spec(("dp",), mesh, on_missing="error")
+
+    def test_clean_spec_translates_logical_names(self):
+        mesh = create_mesh({"dp": 2, "mp": 4})
+        assert clean_spec(("batch", "mlp"), mesh) == ("dp", "mp")
+
+    def test_compiled_program_feed_axis_validated(self):
+        """A CompiledProgram data axis absent from the mesh fails with a
+        typed error at feed-sharding time, not an opaque XLA error."""
+        from paddle_tpu.core.compiler import CompiledProgram
+
+        mesh = create_mesh({"sp": 8})
+        prog = CompiledProgram(pt.Program()).with_data_parallel(
+            mesh=mesh, data_axis="dp")
+        with pytest.raises(ShardingAxisError):
+            prog._sharding_for_feed({"x": np.zeros((8, 2))})
+
+
+class TestVarResolution:
+    def test_fc_attaches_logical_axes(self):
+        main = pt.Program()
+        with pt.program_guard(main, pt.Program()):
+            x = layers.data("x", [32])
+            layers.fc(x, 64)
+        w = next(p for p in main.all_parameters() if p.shape == (32, 64))
+        b = next(p for p in main.all_parameters() if p.shape == (64,))
+        assert get_logical_axes(w) == ("embed", "mlp")
+        assert get_logical_axes(b) == ("mlp",)
+
+    def test_named_sharding_derives_from_rules(self):
+        mesh = create_mesh({"dp": 2, "mp": 4})
+        main = pt.Program()
+        with pt.program_guard(main, pt.Program()):
+            x = layers.data("x", [32])
+            layers.fc(x, 64)
+        w = next(p for p in main.all_parameters() if p.shape == (32, 64))
+        ns = api.named_sharding_for(w, mesh)
+        assert tuple(ns.spec) == (None, "mp")
+
+    def test_explicit_spec_overrides_rules(self):
+        mesh = create_mesh({"dp": 2, "mp": 4})
+        main = pt.Program()
+        with pt.program_guard(main, pt.Program()):
+            x = layers.data("x", [32])
+            layers.fc(x, 64)
+        w = next(p for p in main.all_parameters() if p.shape == (32, 64))
+        shard_tensor(w, ("mp", None))
+        assert spec_for_var(w, mesh) == ("mp", None)
+
+    def test_use_rules_false_ignores_logical_axes(self):
+        mesh = create_mesh({"dp": 2, "mp": 4})
+        main = pt.Program()
+        with pt.program_guard(main, pt.Program()):
+            x = layers.data("x", [32])
+            layers.fc(x, 64)
+        w = next(p for p in main.all_parameters() if p.shape == (32, 64))
+        assert spec_for_var(w, mesh, use_rules=False) is None
+
+    def test_accumulator_inherits_logical_axes(self):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [32])
+            h = layers.fc(x, 64)
+            loss = layers.mean(h)
+            opt = pt.optimizer.MomentumOptimizer(0.1, 0.9)
+            opt.minimize(loss)
+        w = next(p for p in main.all_parameters() if p.shape == (32, 64))
+        vel = opt._get_accumulator("velocity", w)
+        assert get_logical_axes(vel) == ("embed", "mlp")
+
+
+def test_axis_rules_smoke_reexec():
+    """Minimal end-to-end: an fc program trains on a dp×mp mesh with
+    rule-derived shardings — the subprocess re-exec fixture
+    (test_mesh_reexec.py) runs exactly this under a freshly-forced
+    XLA_FLAGS device count."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = create_mesh({"dp": 2, "mp": 4})
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [32])
+        label = layers.data("label", [1], dtype="int64")
+        h = layers.fc(x, 64, act="relu")
+        logits = layers.fc(h, 8)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        pt.optimizer.SGDOptimizer(0.1).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    sc = pt.Scope()
+    exe.run(startup, scope=sc, use_compiled=False)
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(16, 32).astype(np.float32),
+            "label": rng.randint(0, 8, (16, 1)).astype(np.int64)}
+    lv, = exe.run(main, feed=feed, fetch_list=[loss], scope=sc, mesh=mesh)
+    assert np.isfinite(float(np.asarray(lv).reshape(-1)[0]))
+    # the fc weight really landed mp-sharded via the rule table
+    w = next(p for p in main.all_parameters() if p.shape == (32, 64))
+    sharded = sc.find_var(w.name)
+    assert "mp" in str(getattr(sharded, "sharding").spec)
+
+
+def test_rule_table_change_recompiles_with_cause(tmp_path):
+    """Swapping the rule table must MISS the compile cache (stale
+    shardings otherwise) and the recompile-cause diagnostic names
+    axis_rules."""
+    from paddle_tpu.core import telemetry
+
+    log = tmp_path / "run.jsonl"
+    telemetry.configure(str(log))
+    try:
+        mesh = create_mesh({"dp": 8})
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [8])
+            loss = layers.mean(layers.fc(x, 4))
+            pt.optimizer.SGDOptimizer(0.1).minimize(loss)
+        exe = pt.Executor(pt.CPUPlace())
+        sc = pt.Scope()
+        exe.run(startup, scope=sc, use_compiled=False)
+        feed = {"x": np.ones((8, 8), np.float32)}
+        exe.run(main, feed=feed, fetch_list=[loss], scope=sc, mesh=mesh)
+        with axis_rules.axis_rules([("batch", "dp"), ("mlp", "dp")]):
+            exe.run(main, feed=feed, fetch_list=[loss], scope=sc, mesh=mesh)
+        telemetry.flush_sink()
+    finally:
+        telemetry.configure(None)
+    import json
+
+    causes = [json.loads(ln)["attrs"].get("cause")
+              for ln in log.read_text().splitlines()
+              if '"compile"' in ln and json.loads(ln).get("kind") == "compile"]
+    assert len(causes) == 2
+    assert causes[1] == "axis_rules"

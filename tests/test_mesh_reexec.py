@@ -1,0 +1,60 @@
+"""Re-exec the mesh suite's smoke legs in a SUBPROCESS with
+XLA_FLAGS=--xla_force_host_platform_device_count=8 set explicitly.
+
+The in-process suite gets its 8 virtual devices from conftest.py; this
+fixture proves the dp×mp rule-table and ZeRO paths also come up on a
+CPU-only CI build that never imports the conftest (fresh interpreter,
+env forced by hand) — and skips clean when the platform cannot
+materialise the devices at all."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# the two re-exec smoke legs: rule-table fc training on dp×mp, and a
+# ZeRO stage-2 step on dp8 (selected via -k reexec)
+MESH_SUITE = ["tests/test_axis_rules.py", "tests/test_zero_sharding.py"]
+
+
+def _forced_env():
+    env = dict(os.environ)
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    env["XLA_FLAGS"] = " ".join(
+        flags + ["--xla_force_host_platform_device_count=8"]).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    # the child is a PARTIAL pytest session: it must not inherit (and
+    # tear down) the parent suite's op-coverage dir
+    env.pop("PT_OP_COVERAGE_DIR", None)
+    return env
+
+
+def _device_count(env):
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(len(jax.devices()))"],
+            env=env, cwd=ROOT, capture_output=True, text=True, timeout=120)
+    except subprocess.TimeoutExpired:
+        return 0
+    try:
+        return int(r.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return 0
+
+
+def test_reexec_mesh_suite_under_forced_device_count():
+    env = _forced_env()
+    n = _device_count(env)
+    if n < 8:
+        pytest.skip(f"platform cannot materialise 8 host devices (got {n})")
+    cmd = [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+           "-p", "no:randomly", "-k", "reexec", *MESH_SUITE]
+    r = subprocess.run(cmd, cwd=ROOT, env=env, capture_output=True,
+                       text=True, timeout=480)
+    tail = (r.stdout[-2000:] + r.stderr[-1000:])
+    assert r.returncode == 0, f"re-exec'd mesh suite failed:\n{tail}"
+    assert "2 passed" in r.stdout, f"expected both smoke legs to run:\n{tail}"

@@ -291,6 +291,24 @@ def finalize_bench_result(out):
     # dispatch-amortization config of this run (K-step fused execution)
     ex["steps_per_dispatch"] = max(
         1, int(_flag("exec_steps_per_dispatch")))
+    # sharded-training config: mesh geometry, rule-table hash and ZeRO
+    # stage ride every BENCH row so multi-chip results are attributable
+    # (MULTICHIP rows stay TPU-ready; on the 1-chip container these are
+    # null/0 — validated on the MLP/LeNet harness)
+    from paddle_tpu.parallel import axis_rules
+    from paddle_tpu.parallel.mesh import get_mesh
+
+    m = get_mesh()
+    ex["mesh_shape"] = ({a: int(s) for a, s in m.shape.items()}
+                        if m is not None else None)
+    ex["axis_rules_hash"] = axis_rules.fingerprint()
+    g = telemetry.gauges()
+    if g.get("sharding.zero_stage") is not None:
+        ex["zero_stage"] = int(g["sharding.zero_stage"])
+        for key in ("sharding.optimizer_state_bytes",
+                    "sharding.optimizer_state_bytes_per_device"):
+            if g.get(key) is not None:
+                ex[key.replace(".", "_")] = int(g[key])
     attrs = {k: ex[k] for k in ("ms_per_step", "mfu", "batch", "seq_len",
                                 "steps_per_dispatch")
              if k in ex}

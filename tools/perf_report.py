@@ -24,6 +24,11 @@ Renders the structured run log written by ``paddle_tpu.core.telemetry``
   crash-consistent protocol (paddle_tpu/checkpoint.py): commits, bytes,
   verification rejections + fallbacks to older checkpoints, quarantined
   dirs, and save/restore latency percentiles;
+* a "Sharding" section when the run used rule-table partitioning / the
+  ZeRO ShardingOptimizer (parallel/axis_rules.py, fleet
+  meta_optimizers.py): per-kind dp-collective bytes, optimizer-state
+  bytes global vs per-device, rule resolutions and reshard-on-load
+  events;
 * a "Tracing" section when the run emitted distributed-tracing spans
   (core/trace.py, FLAGS_trace_sample_rate): trace/span counts and
   per-span-name duration percentiles — merge multi-process logs with
@@ -154,6 +159,7 @@ def summarize_log(recs, malformed=0):
     serving = _serving_summary(counter_delta, counter_last, timer_summary,
                                gauges)
     ckpt = _ckpt_summary(counter_delta, counter_last, timer_summary)
+    sharding = _sharding_summary(counter_delta, counter_last, gauges)
     tracing = None
     if spans:
         by_name = {}
@@ -170,6 +176,7 @@ def summarize_log(recs, malformed=0):
         "fused": fused,
         "serving": serving,
         "checkpoint": ckpt,
+        "sharding": sharding,
         "tracing": tracing,
         "malformed_lines": int(malformed),
         "records": len(recs),
@@ -294,6 +301,51 @@ def _ckpt_summary(counter_delta, counter_last, timer_summary):
     return out
 
 
+def _sharding_summary(counter_delta, counter_last, gauges):
+    """Sharded-training accounting (parallel/axis_rules.py rule table +
+    fleet ShardingOptimizer ZeRO): dp-collective payload per kind, the
+    optimizer-state bytes the sharding keeps resident per device, rule
+    resolutions, and reshard-on-load events."""
+
+    def cval(name):
+        v = counter_delta.get(name) or counter_last.get(name) or 0
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            return 0.0
+
+    rs = cval("sharding.reduce_scatter_bytes")
+    ag = cval("sharding.allgather_bytes")
+    ar = cval("sharding.allreduce_bytes")
+    params = cval("sharding.params_sharded")
+    resolutions = cval("sharding.rule_resolutions")
+    reshards = cval("sharding.resharding_events")
+    stage = gauges.get("sharding.zero_stage")
+    if not any((rs, ag, ar, params, resolutions, reshards)) \
+            and stage is None:
+        return None
+    out = {"reduce_scatter_bytes": int(rs), "allgather_bytes": int(ag),
+           "allreduce_bytes": int(ar), "params_sharded": int(params),
+           "rule_resolutions": int(resolutions),
+           "rules_skipped_indivisible":
+               int(cval("sharding.rule_skipped_indivisible")),
+           "resharding_events": int(reshards)}
+    if stage is not None:
+        out["zero_stage"] = int(stage)
+    deg = gauges.get("sharding.degree")
+    if deg is not None:
+        out["degree"] = int(deg)
+    state = gauges.get("sharding.optimizer_state_bytes")
+    per_dev = gauges.get("sharding.optimizer_state_bytes_per_device")
+    if state is not None:
+        out["optimizer_state_bytes"] = int(state)
+    if per_dev is not None:
+        out["optimizer_state_bytes_per_device"] = int(per_dev)
+        if state:
+            out["state_shard_ratio"] = round(per_dev / state, 4)
+    return out
+
+
 def _fmt_num(v):
     if isinstance(v, float):
         return f"{v:,.3f}".rstrip("0").rstrip(".")
@@ -383,6 +435,32 @@ def render(s, out=sys.stdout):
                   f"  max {t['max']}\n")
         if "ps_checkpoints" in ck:
             w(f"pserver snapshots: {ck['ps_checkpoints']}\n")
+
+    if s.get("sharding"):
+        sh = s["sharding"]
+        w("\n-- sharding (rule-table partitioning + ZeRO) --\n")
+        head = []
+        if "zero_stage" in sh:
+            head.append(f"zero stage: {sh['zero_stage']}")
+        if "degree" in sh:
+            head.append(f"degree: {sh['degree']}")
+        head.append(f"params sharded: {sh['params_sharded']}")
+        w("  ".join(head) + "\n")
+        w(f"dp collectives: reduce-scatter {_fmt_num(sh['reduce_scatter_bytes'])} B"
+          f"  allgather {_fmt_num(sh['allgather_bytes'])} B"
+          f"  allreduce {_fmt_num(sh['allreduce_bytes'])} B\n")
+        if "optimizer_state_bytes" in sh:
+            line = (f"optimizer state: {_fmt_num(sh['optimizer_state_bytes'])} B"
+                    f" global")
+            if "optimizer_state_bytes_per_device" in sh:
+                line += (f", {_fmt_num(sh['optimizer_state_bytes_per_device'])}"
+                         f" B/device")
+            if "state_shard_ratio" in sh:
+                line += f" (ratio {sh['state_shard_ratio']})"
+            w(line + "\n")
+        w(f"rule resolutions: {sh['rule_resolutions']}  "
+          f"indivisible skips: {sh['rules_skipped_indivisible']}  "
+          f"reshard-on-load: {sh['resharding_events']}\n")
 
     if s.get("tracing"):
         tr = s["tracing"]
