@@ -30,6 +30,7 @@ from .core import (CPUPlace, CUDAPlace, Executor, Parameter, Program,  # noqa: F
                    global_scope, gradients, in_dygraph_mode, program_guard)
 from .core.compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: F401
 from .core.executor import run_startup  # noqa: F401
+from .core.verify import ProgramVerifyError, verify_program  # noqa: F401
 from .param_attr import ParamAttr  # noqa: F401
 from . import dataset  # noqa: F401  (native-backed Dataset API)
 from .dataset import DatasetFactory, InMemoryDataset, QueueDataset  # noqa: F401

@@ -8,5 +8,7 @@ from .ir import (Block, OpDesc, OpRole, Parameter, Program, VarDesc,  # noqa: F4
                  Variable, default_main_program, default_startup_program,
                  device_guard, in_dygraph_mode, program_guard)
 from .scope import Scope, global_scope, reset_global_scope  # noqa: F401
+from .verify import (ProgramVerifyError, VerifyResult,  # noqa: F401
+                     Violation, verify_program)
 from .types import (CPUPlace, CUDAPlace, Place, TPUPlace, VarType,  # noqa: F401
                     XLAPlace, convert_dtype, default_place)

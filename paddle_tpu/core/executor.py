@@ -284,9 +284,32 @@ class Executor:
         self.place = place or default_place()
         self._cache: Dict[tuple, _CompiledEntry] = {}
         self._ps_programs: Dict[tuple, bool] = {}
+        self._verified: set = set()
 
     def close(self):
         self._cache.clear()
+
+    def _maybe_verify(self, program, feed, fetch_names, scope):
+        """FLAGS_verify_program pre-compile gate: run the static
+        verifier (core/verify.py) once per (program, version) before
+        anything is traced — a corrupt program raises a typed, located
+        ProgramVerifyError instead of an opaque pjit error (or a silent
+        wrong answer under buffer donation). Cheap pure-Python checks
+        only (structure/dataflow/hazards/donation); re-verifies when a
+        transform bumps the program version."""
+        from .flags import flag as _flag
+
+        if not _flag("verify_program"):
+            return
+        vkey = (program.uid, program.version)
+        if vkey in self._verified:
+            return
+        from .verify import verify_program
+
+        verify_program(program, feed_names=set(feed or ()),
+                       fetch_names=fetch_names, scope=scope,
+                       context="executor pre-compile gate")
+        self._verified.add(vkey)
 
     def _unwrap_program(self, program, feed, mesh):
         """Resolve (program, mesh, in_shardings): explicit mesh= arg >
@@ -336,6 +359,7 @@ class Executor:
         feed = dict(feed or {})
         fetch_names = [f.name if isinstance(f, Variable) else str(f)
                        for f in (fetch_list or [])]
+        self._maybe_verify(program, feed, fetch_names, scope)
 
         # host→device feed traffic (bytes that actually cross: values
         # still host-side; jax arrays are already device-resident)
@@ -427,6 +451,7 @@ class Executor:
         feed = dict(feed or {})
         fetch_names = [f.name if isinstance(f, Variable) else str(f)
                        for f in (fetch_list or [])]
+        self._maybe_verify(program, feed, fetch_names, scope)
 
         # k: explicit, else inferred from the stacked feeds' leading dim
         if k is None:

@@ -94,6 +94,26 @@ define_flag("cudnn_deterministic", False,
             "deterministic kernels (XLA is deterministic by default)")
 define_flag("max_inplace_grad_add", 0,
             "grad accumulation chunking (API compat)")
+define_flag("verify_program", False,
+            "static program verification gate (core/verify.py): every "
+            "program an Executor runs is checked once per (program, "
+            "version) — structural integrity (vars exist, ops "
+            "registered, required attrs), dataflow (def-before-use, "
+            "dangling reads vs the actual feed/scope), write-write "
+            "hazards and donation safety — raising a typed "
+            "ProgramVerifyError BEFORE compile instead of an opaque "
+            "pjit error at dispatch. Cheap pure-Python checks only; the "
+            "eval_shape propagation check stays opt-in via "
+            "verify.verify_program(infer_shapes=True) / tools/"
+            "graph_lint.py")
+define_flag("verify_passes", True,
+            "verify the program after EVERY pass applied through "
+            "core.passes.apply_passes (the MLIR pass-verifier "
+            "discipline): a pass that leaves a dangling input, an "
+            "unregistered op or a write hazard raises ProgramVerifyError "
+            "naming the offending pass; VarDescs a pass orphans are "
+            "pruned (verifier.pruned_vars). Disable to bisect a "
+            "misbehaving pass pipeline without the gate")
 define_flag("infer_shape_debug", False,
             "warn (with op type + error) when build-time shape inference "
             "fails instead of silently skipping — surfaces op-lowering bugs "

@@ -555,6 +555,38 @@ class Block:
         return f"Block(idx={self.idx}, vars={len(self.vars)}, ops={len(self.ops)})"
 
 
+def _collect_op_refs(ops, refs: set, seen: set):
+    """Every var name the ops reference: io slots plus (conservatively)
+    any string reachable through attr values — name lists carried in
+    attrs (control-flow input_names/carry_names, fusion_group sub_ops
+    io) keep their vars alive — recursing into attr-held sub-blocks."""
+
+    def scan(val):
+        if isinstance(val, str):
+            refs.add(val)
+        elif isinstance(val, Block):
+            if id(val) not in seen:
+                seen.add(id(val))
+                _collect_op_refs(val.ops, refs, seen)
+        elif isinstance(val, Program):
+            if id(val) not in seen:
+                seen.add(id(val))
+                for blk in val.blocks:
+                    _collect_op_refs(blk.ops, refs, seen)
+        elif isinstance(val, dict):
+            for v in val.values():
+                scan(v)
+        elif isinstance(val, (list, tuple)):
+            for v in val:
+                scan(v)
+
+    for op in ops:
+        refs.update(op.input_names())
+        refs.update(op.output_names())
+        for val in (op.attrs or {}).values():
+            scan(val)
+
+
 class Program:
     """A whole computation (reference: framework.py Program:3969).
 
@@ -654,6 +686,26 @@ class Program:
             p.blocks.append(nb)
         if not p.blocks:
             p.blocks = [Block(p, 0, -1)]
+        if for_test:
+            # dropping the backward/optimize ops orphans their VarDescs
+            # (@GRAD vars, optimizer temporaries) — prune any
+            # non-persistable var whose only producers were removed, so
+            # the test clone verifies dead-var clean (core/verify.py)
+            # and serialized eval programs don't carry training litter.
+            # Source vars (feeds — no producer anywhere) always survive.
+            produced: set = set()
+            for blk in self.blocks:
+                for op in blk.ops:
+                    produced.update(op.output_names())
+            refs: set = set()
+            seen: set = set()
+            for nb in p.blocks:
+                _collect_op_refs(nb.ops, refs, seen)
+            for nb in p.blocks:
+                for name in [n for n, v in nb.vars.items()
+                             if n in produced and n not in refs
+                             and not v.desc.persistable]:
+                    del nb.vars[name]
         p.grad_var_map = dict(self.grad_var_map)
         p._bump_version()
         return p

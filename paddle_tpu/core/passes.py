@@ -16,8 +16,9 @@ executor compiles — so anything a pass rewrites is exactly what jit sees.
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Set
 
+from . import telemetry
 from .ir import OpDesc, Program
 
 PassFn = Callable[[Program], Program]
@@ -43,18 +44,76 @@ def registered_passes() -> List[str]:
     return sorted(_PASS_REGISTRY)
 
 
-def apply_passes(program: Program, names: List[str], scope=None) -> Program:
-    """Value-level passes (weight-folding fusions like conv+BN) declare a
+def _referenced_names(program: Program) -> Set[str]:
+    """Every var name any op references — io slots plus (over-
+    approximately) any string reachable through attr values, so name
+    lists carried in attrs (control-flow input_names/carry_names,
+    fusion_group sub_ops io) keep their vars alive."""
+    from .verify import VerifyContext
+
+    return VerifyContext(program).referenced
+
+
+def _prune_orphaned_vars(program: Program, before: Set[str],
+                         keep: Set[str]) -> int:
+    """Drop non-persistable VarDescs a pass just orphaned: referenced
+    before the pass, referenced by nothing after it (the classic fusion
+    leak — the consumed intermediate's desc left behind). Only vars the
+    pass itself disconnected are touched; pre-existing unreferenced
+    declarations (e.g. an unused data var that is somebody's feed) are
+    left alone."""
+    after = _referenced_names(program)
+    pruned = 0
+    for blk in program.blocks:
+        for name in [n for n in blk.vars
+                     if n in before and n not in after and n not in keep
+                     and not blk.vars[n].desc.persistable]:
+            del blk.vars[name]
+            pruned += 1
+    if pruned:
+        program._bump_version()
+        telemetry.counter_add("verifier.pruned_vars", pruned)
+    return pruned
+
+
+def apply_passes(program: Program, names: List[str], scope=None,
+                 feed_names=None, fetch_names=None,
+                 verify: Optional[bool] = None) -> Program:
+    """Apply passes in order, verifying the program after each one.
+
+    Value-level passes (weight-folding fusions like conv+BN) declare a
     `scope` parameter and receive the parameter store; pure structural
-    passes keep the Program -> Program signature."""
+    passes keep the Program -> Program signature.
+
+    After every pass the static verifier (core/verify.py) re-checks the
+    program — structure, dataflow, hazards, donation safety — so
+    pass-introduced corruption raises a ProgramVerifyError NAMING the
+    offending pass instead of surfacing as a pjit error later; VarDescs
+    the pass orphaned are pruned first (counted in
+    verifier.pruned_vars). feed_names/fetch_names sharpen the dataflow
+    checks when the caller knows them (the predictor does). verify=None
+    follows FLAGS_verify_passes (default on)."""
     import inspect
 
+    from .flags import flag as _flag
+
+    if verify is None:
+        verify = bool(_flag("verify_passes"))
+    keep = set(feed_names or ()) | {str(f) for f in (fetch_names or ())}
     for n in names:
         fn = get_pass(n)
+        before = _referenced_names(program) if verify else None
         if "scope" in inspect.signature(fn).parameters:
             program = fn(program, scope=scope)
         else:
             program = fn(program)
+        if verify:
+            from .verify import verify_program
+
+            _prune_orphaned_vars(program, before, keep)
+            verify_program(program, feed_names=feed_names,
+                           fetch_names=fetch_names, scope=scope,
+                           context=f"after pass '{n}'")
     return program
 
 

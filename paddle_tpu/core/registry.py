@@ -44,6 +44,11 @@ class OpDef:
     non_diff_inputs: tuple = ()
     # True for ops with side-band semantics the compiler must know about
     is_collective: bool = False
+    # attrs the lowering dereferences unconditionally (attrs["..."]) — the
+    # static verifier (core/verify.py) flags their absence at build/lint
+    # time instead of a KeyError mid-trace (reference: OpProto required
+    # attr checking, framework/op_desc.cc CheckAttrs)
+    required_attrs: tuple = ()
     doc: str = ""
 
 
@@ -71,7 +76,8 @@ def _recorded(op_type: str, fn: LoweringFn) -> LoweringFn:
 
 def register_op(type: str, *, grad_maker: Optional[GradMakerFn] = None,
                 skip_infer_shape: bool = False, non_diff_inputs: tuple = (),
-                is_collective: bool = False, doc: str = ""):
+                is_collective: bool = False, required_attrs: tuple = (),
+                doc: str = ""):
     """Decorator registering a forward lowering for `type`."""
 
     def deco(fn: LoweringFn) -> LoweringFn:
@@ -83,6 +89,7 @@ def register_op(type: str, *, grad_maker: Optional[GradMakerFn] = None,
         od.skip_infer_shape = skip_infer_shape
         od.non_diff_inputs = tuple(non_diff_inputs)
         od.is_collective = is_collective
+        od.required_attrs = tuple(required_attrs)
         od.doc = doc or fn.__doc__ or ""
         if grad_maker is not None:
             od.grad_maker = grad_maker
@@ -183,7 +190,8 @@ def _is_inexact(x) -> bool:
     return jnp.issubdtype(jnp.result_type(x), jnp.inexact)
 
 
-@register_op("__vjp_grad__", skip_infer_shape=True)
+@register_op("__vjp_grad__", skip_infer_shape=True,
+             required_attrs=("fwd_type", "fwd_attrs"))
 def _vjp_grad_lowering(ins: Dict[str, List[Any]], attrs: Dict[str, Any]):
     import jax
     import jax.numpy as jnp

@@ -41,7 +41,10 @@ _LOG = logging.getLogger("paddle_tpu.elastic")
 # barrier stalls relayed from a pserver) and the OS-level network/device
 # errors underneath them. Plain RuntimeError is deliberately NOT here —
 # it swallowed programming errors; raise one of these (or subclass) from
-# custom step_fns that want a restart.
+# custom step_fns that want a restart. In particular core.verify's
+# ProgramVerifyError (a RuntimeError) names a corrupt PROGRAM: restoring
+# a checkpoint and re-running the same program would fail identically
+# forever, so it must re-raise (tests/test_verify.py pins this).
 RECOVERABLE = (RpcError, ConnectionError, OSError, TimeoutError)
 
 

@@ -131,9 +131,14 @@ class AnalysisPredictor:
         self.feed_names = list(feed_names or [])
         self.fetch_names = list(fetch_names or [])
         if config.ir_optim:
+            # feed/fetch names sharpen the post-pass verification
+            # (core/verify.py): a pass that orphans a read or drops a
+            # fetch target fails HERE, named, not at first run()
             self.program = apply_passes(self.program,
                                         config.enabled_passes(),
-                                        scope=self.scope)
+                                        scope=self.scope,
+                                        feed_names=self.feed_names,
+                                        fetch_names=self.fetch_names)
         self._staged: Dict[str, np.ndarray] = {}
         self._last_outputs: Optional[Dict[str, Any]] = None
         # LRU over compiled entries: shape churn (ragged batches, variable

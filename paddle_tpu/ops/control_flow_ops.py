@@ -27,7 +27,8 @@ def _run_sub_block(blk, env: Dict[str, Any], step=None, axis_coords=None):
     return env
 
 
-@register_op("block_call", skip_infer_shape=True)
+@register_op("block_call", skip_infer_shape=True,
+             required_attrs=("sub_block", "input_names", "output_names"))
 def block_call(ins, attrs):
     """Run a sub-block as a function of its inputs; optionally rematerialised.
 
@@ -53,7 +54,8 @@ def block_call(ins, attrs):
 
 
 @register_op("conditional_block", skip_infer_shape=True,
-             non_diff_inputs=("Cond",))
+             non_diff_inputs=("Cond",),
+             required_attrs=("sub_block", "input_names", "output_names"))
 def conditional_block(ins, attrs):
     """lax.cond over a sub-block (reference: conditional_block_op.cc).
     The false branch passes through the current values of the output vars,
@@ -81,7 +83,8 @@ def conditional_block(ins, attrs):
     return {"Out": list(outs)}
 
 
-@register_op("while", skip_infer_shape=True, non_diff_inputs=("Condition",))
+@register_op("while", skip_infer_shape=True, non_diff_inputs=("Condition",),
+             required_attrs=("sub_block", "carry_names", "cond_name"))
 def while_op(ins, attrs):
     """lax.while_loop over a sub-block (reference: while_op.cc). The
     sub-block must rewrite the condition var each iteration; carried shapes
@@ -119,7 +122,9 @@ def print_op(ins, attrs):
     return {"Out": x}
 
 
-@register_op("cond", skip_infer_shape=True, non_diff_inputs=("Cond",))
+@register_op("cond", skip_infer_shape=True, non_diff_inputs=("Cond",),
+             required_attrs=("true_block", "input_names",
+                             "true_out_names", "false_out_names"))
 def cond_two_branch(ins, attrs):
     """Two-sub-block lax.cond (layers/control_flow.py cond): both branches
     trace; reverse-differentiable via the generic vjp grad maker."""
@@ -154,7 +159,9 @@ def cond_two_branch(ins, attrs):
     return {"Out": list(outs)}
 
 
-@register_op("while_loop", skip_infer_shape=True)
+@register_op("while_loop", skip_infer_shape=True,
+             required_attrs=("cond_block", "body_block", "carry_names",
+                             "body_out_names", "ext_names", "cond_out_name"))
 def while_loop_op(ins, attrs):
     """Separate cond/body sub-blocks (layers/control_flow.py while_loop).
 
@@ -266,7 +273,9 @@ def _while_loop_grad_maker(op, out_grads, in_grads):
     return default_grad_maker(op, out_grads, in_grads)
 
 
-@register_op("static_loop", skip_infer_shape=True)
+@register_op("static_loop", skip_infer_shape=True,
+             required_attrs=("body_block", "carry_names", "body_out_names",
+                             "ext_names", "i_name", "num_steps"))
 def static_loop_op(ins, attrs):
     """Fixed-trip lax.scan loop (layers/control_flow.py static_loop) —
     reverse-differentiable; the StaticRNN role with static shapes."""
@@ -368,7 +377,8 @@ def merge_lod_tensor(ins, attrs):
     return {"Out": jnp.where(m, t, f.astype(t.dtype))}
 
 
-@register_op("run_program", skip_infer_shape=True)
+@register_op("run_program", skip_infer_shape=True,
+             required_attrs=("program",))
 def run_program(ins, attrs):
     """Execute a captured sub-Program as ONE op (reference:
     operators/run_program_op.cc — the dygraph<->static bridge backing

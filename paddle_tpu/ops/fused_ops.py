@@ -118,7 +118,7 @@ def fusion_seqpool_concat(ins, attrs):
     return {"Out": jnp.concatenate(pooled, axis=-1)}
 
 
-@register_op("fused_elemwise_activation")
+@register_op("fused_elemwise_activation", required_attrs=("functor_list",))
 def fused_elemwise_activation(ins, attrs):
     """Compose a binary elementwise op with a unary activation
     (reference: fused/fused_elemwise_activation_op.cc,
@@ -174,7 +174,8 @@ def fusion_seqpool_cvm_concat(ins, attrs):
     return {"Out": jnp.concatenate(outs, axis=1)}
 
 
-@register_op("fusion_group", skip_infer_shape=True)
+@register_op("fusion_group", skip_infer_shape=True,
+             required_attrs=("sub_ops", "ext_in_names", "ext_out_names"))
 def fusion_group(ins, attrs):
     """Composite elementwise-chain op (reference: ir/fusion_group/ +
     fusion_group_op — runtime CUDA codegen for elementwise subgraphs).

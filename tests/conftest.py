@@ -66,6 +66,35 @@ def _fresh_programs():
     mesh_mod._current_mesh = old_mesh
 
 
+# Op-sweep modules run with the static program verifier gating every
+# executor dispatch (FLAGS_verify_program, core/verify.py): the OpTest
+# harness builds one program per op, so the whole registry's programs
+# flow through the verifier's structure/dataflow/hazard/donation checks
+# — any op whose desc wiring the verifier would mis-judge fails loudly
+# here, keeping the lint trustworthy on real models.
+_VERIFY_FLAG_MODULES = {
+    "test_op_registry_sweep", "test_gate_smoke_execution",
+    "test_ops_batch2", "test_ops_batch3", "test_ops_extended",
+    "test_ops_round4", "test_ops_round5", "test_crf_ops",
+}
+
+
+@pytest.fixture(autouse=True)
+def _verify_program_on_op_sweeps(request):
+    mod = request.module.__name__.rsplit(".", 1)[-1]
+    if mod not in _VERIFY_FLAG_MODULES:
+        yield
+        return
+    from paddle_tpu.core import flags as _flags
+
+    old = _flags.flag("verify_program")
+    _flags.set_flags({"verify_program": True})
+    try:
+        yield
+    finally:
+        _flags.set_flags({"verify_program": old})
+
+
 def rand(*shape, dtype=np.float32, seed=None):
     rng = np.random.RandomState(seed if seed is not None else 42)
     return rng.randn(*shape).astype(dtype)

@@ -1,0 +1,670 @@
+"""Static program verifier + dataflow lint (core/verify.py, ISSUE 8).
+
+Covers the seeded corruption classes the verifier must catch (dangling
+input, undefined output, unregistered op, def-after-use, unordered
+write-write hazard, static shape mismatch, missing required attr,
+missing fetch, donation hazards), the typed ProgramVerifyError contract
+(located fields, NOT swallowed by ElasticRunner), control-flow
+sub-block recursion, the apply_passes post-pass gate + orphaned-desc
+pruning, the registered-pass sweep over book-model programs, the
+Executor's FLAGS_verify_program pre-compile gate (incl. run_steps
+donation), the tools/graph_lint.py CLI, and the perf_report verifier
+section.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core import telemetry
+from paddle_tpu.core.ir import OpDesc
+from paddle_tpu.core.passes import apply_passes, register_pass, \
+    registered_passes, _PASS_REGISTRY
+from paddle_tpu.core.verify import (ProgramVerifyError, VerifyContext,
+                                    Violation, registered_checks,
+                                    verify_program)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mlp_program(with_optimizer=False):
+    """data -> matmul -> mean (+ optional SGD): the corruption substrate."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4], stop_gradient=False)
+        w = layers.create_parameter([4, 8], "float32", name="w")
+        y = layers.matmul(x, w)
+        loss = layers.mean(y)
+        if with_optimizer:
+            pt.optimizer.SGDOptimizer(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _checks_of(exc):
+    return {v.check for v in exc.violations}
+
+
+# ---------------------------------------------------------------------------
+# seeded corruption classes
+# ---------------------------------------------------------------------------
+
+class TestCorruptionClasses:
+    def test_clean_program_verifies(self):
+        main, _, loss = _mlp_program(with_optimizer=True)
+        r = verify_program(main, feed_names={"x"}, fetch_names=[loss.name],
+                          infer_shapes=True)
+        assert r.ok and r.violations == []
+        assert set(r.checks_run) >= {"structure", "dataflow", "hazards",
+                                     "donation", "shapes"}
+
+    def test_dangling_input(self):
+        main, _, _ = _mlp_program()
+        main.global_block().ops[0].inputs["X"] = ["ghost"]
+        with pytest.raises(ProgramVerifyError) as ei:
+            verify_program(main)
+        assert ei.value.check == "dangling_input"
+        assert ei.value.op_type == "matmul"
+
+    def test_undefined_output(self):
+        main, _, _ = _mlp_program()
+        main.global_block().ops[0].outputs["Out"] = ["ghost_out"]
+        with pytest.raises(ProgramVerifyError) as ei:
+            verify_program(main)
+        assert "undefined_output" in _checks_of(ei.value)
+
+    def test_unregistered_op(self):
+        main, _, _ = _mlp_program()
+        main.global_block().ops.insert(
+            0, OpDesc("totally_unknown_op", {}, {}))
+        with pytest.raises(ProgramVerifyError) as ei:
+            verify_program(main)
+        assert ei.value.check == "unregistered_op"
+        assert ei.value.op_type == "totally_unknown_op"
+
+    def test_def_after_use(self):
+        main, _, _ = _mlp_program()
+        blk = main.global_block()
+        blk.ops = [blk.ops[1], blk.ops[0]]   # mean before matmul
+        with pytest.raises(ProgramVerifyError) as ei:
+            verify_program(main)
+        assert ei.value.check == "def_after_use"
+        assert ei.value.op_idx == 0
+
+    def test_waw_hazard(self):
+        main, _, _ = _mlp_program()
+        blk = main.global_block()
+        blk.create_var(name="t", shape=[2], dtype="float32")
+        fill = {"shape": [2], "value": 1.0, "dtype": "float32"}
+        blk.ops.insert(0, OpDesc("fill_constant", {}, {"Out": ["t"]},
+                                 dict(fill)))
+        blk.ops.insert(1, OpDesc("fill_constant", {}, {"Out": ["t"]},
+                                 dict(fill, value=2.0)))
+        with pytest.raises(ProgramVerifyError) as ei:
+            verify_program(main)
+        assert ei.value.check == "waw_hazard"
+        assert ei.value.var if hasattr(ei.value, "var") else True
+        [v] = [v for v in ei.value.violations if v.check == "waw_hazard"]
+        assert v.var == "t"
+
+    def test_waw_with_intervening_read_is_clean(self):
+        """Read-modify-write chains (param updates, increments) must NOT
+        trip the hazard check."""
+        main, _, _ = _mlp_program()
+        blk = main.global_block()
+        blk.create_var(name="t", shape=[2], dtype="float32")
+        blk.create_var(name="t2", shape=[2], dtype="float32")
+        blk.ops.insert(0, OpDesc("fill_constant", {}, {"Out": ["t"]},
+                                 {"shape": [2], "value": 1.0,
+                                  "dtype": "float32"}))
+        blk.ops.insert(1, OpDesc("scale", {"X": ["t"]}, {"Out": ["t"]},
+                                 {"scale": 2.0}))
+        blk.ops.insert(2, OpDesc("scale", {"X": ["t"]}, {"Out": ["t2"]},
+                                 {"scale": 1.0}))
+        assert verify_program(main).ok
+
+    def test_static_shape_mismatch_lowering_rejects(self):
+        """Corrupt an INPUT desc: the matmul lowering fails under
+        eval_shape at the declared shapes — the pjit error, caught
+        statically."""
+        main, _, _ = _mlp_program()
+        main.global_block().vars["w"].desc.shape = (5, 8)
+        with pytest.raises(ProgramVerifyError) as ei:
+            verify_program(main, infer_shapes=True)
+        assert ei.value.check == "shape_mismatch"
+        assert ei.value.op_type == "matmul"
+
+    def test_static_shape_mismatch_declared_vs_inferred(self):
+        """Corrupt an OUTPUT desc: inference disagrees with the declared
+        shape."""
+        main, _, loss = _mlp_program()
+        blk = main.global_block()
+        out_name = blk.ops[0].outputs["Out"][0]
+        blk.vars[out_name].desc.shape = (-1, 16)   # really (-1, 8)
+        with pytest.raises(ProgramVerifyError) as ei:
+            verify_program(main, infer_shapes=True)
+        vs = [v for v in ei.value.violations if v.check == "shape_mismatch"]
+        assert vs and vs[0].var == out_name
+        assert "declared" in vs[0].message
+
+    def test_shapes_check_is_opt_in(self):
+        """Without infer_shapes the cheap checks pass the corrupt-shape
+        program — the hot-path gates stay pure Python."""
+        main, _, _ = _mlp_program()
+        main.global_block().vars["w"].desc.shape = (5, 8)
+        assert verify_program(main).ok
+
+    def test_missing_required_attr(self):
+        main, _, _ = _mlp_program()
+        blk = main.global_block()
+        blk.create_var(name="fa", shape=[-1, 4], dtype="float32")
+        blk.create_var(name="fa_i", shape=[-1, 4], dtype="float32")
+        blk.ops.append(OpDesc("fused_elemwise_activation",
+                              {"X": ["x"], "Y": ["x"]},
+                              {"Out": ["fa"], "IntermediateOut": ["fa_i"]},
+                              {}))   # no functor_list
+        with pytest.raises(ProgramVerifyError) as ei:
+            verify_program(main)
+        assert ei.value.check == "missing_attr"
+        [v] = [v for v in ei.value.violations if v.check == "missing_attr"]
+        assert v.var == "functor_list"
+
+    def test_dangling_read_with_feed_knowledge(self):
+        """A non-persistable var nobody produces or feeds — the classic
+        pass-removed-producer corruption — needs feed info to judge."""
+        main, _, _ = _mlp_program()
+        blk = main.global_block()
+        blk.create_var(name="orphan", shape=[-1, 8], dtype="float32")
+        blk.ops[1].inputs["X"] = ["orphan"]
+        # without feed knowledge: structurally fine (could be a feed)
+        assert verify_program(main).ok
+        with pytest.raises(ProgramVerifyError) as ei:
+            verify_program(main, feed_names={"x"})
+        assert ei.value.check == "dangling_read"
+
+    def test_missing_fetch(self):
+        main, _, _ = _mlp_program()
+        with pytest.raises(ProgramVerifyError) as ei:
+            verify_program(main, feed_names={"x"},
+                           fetch_names=["never_produced"])
+        assert ei.value.check == "missing_fetch"
+
+    def test_donated_feed_overlap(self):
+        """Feeding a var that is also written persistable state: the feed
+        shadows the donated carry — run_steps scan donation breaks."""
+        main, _, _ = _mlp_program()
+        blk = main.global_block()
+        blk.ops.append(OpDesc("scale", {"X": ["w"]}, {"Out": ["w"]},
+                              {"scale": 0.5}))
+        assert verify_program(main, feed_names={"x"}).ok
+        with pytest.raises(ProgramVerifyError) as ei:
+            verify_program(main, feed_names={"x", "w"})
+        assert ei.value.check == "donated_feed_overlap"
+
+
+# ---------------------------------------------------------------------------
+# typed error contract
+# ---------------------------------------------------------------------------
+
+class TestTypedError:
+    def test_error_carries_location_and_message(self):
+        main, _, _ = _mlp_program()
+        main.global_block().ops[0].inputs["X"] = ["ghost"]
+        with pytest.raises(ProgramVerifyError) as ei:
+            verify_program(main, context="unit test")
+        e = ei.value
+        assert (e.block_idx, e.op_idx) == (0, 0)
+        assert e.op_type == "matmul" and e.check == "dangling_input"
+        assert e.context == "unit test"
+        # clickable-style location in the rendered message
+        assert "program:block0:op0" in str(e)
+        assert "[dangling_input/error]" in str(e)
+        assert isinstance(e, RuntimeError)
+
+    def test_elastic_runner_does_not_recover_verify_errors(self, tmp_path):
+        """ProgramVerifyError names a programming error — RECOVERABLE
+        (typed transport errors) must re-raise it, even wrapped under an
+        ExecutionError cause chain."""
+        from paddle_tpu.core.executor import ExecutionError
+        from paddle_tpu.distributed.elastic import RECOVERABLE, ElasticRunner
+
+        assert not issubclass(ProgramVerifyError, RECOVERABLE)
+        runner = ElasticRunner(str(tmp_path / "ckpt"))
+        err = ProgramVerifyError(
+            [Violation("dangling_input", "error", 0, 0, "matmul")])
+        assert not runner._recoverable_exc(err)
+        wrapped = ExecutionError("step failed")
+        wrapped.__cause__ = err
+        assert not runner._recoverable_exc(wrapped)
+        # sanity: real transport errors still recover
+        from paddle_tpu.distributed.errors import RpcError
+
+        assert runner._recoverable_exc(RpcError("boom"))
+
+    def test_warnings_do_not_raise(self):
+        main, _, _ = _mlp_program()
+        blk = main.global_block()
+        blk.create_var(name="never_used", shape=[2], dtype="float32")
+        # pre-existing unreferenced decl with feed knowledge -> dead_var
+        r = verify_program(main, feed_names={"x"}, raise_on_error=False)
+        assert r.ok
+        assert any(v.check == "dead_var" and v.var == "never_used"
+                   for v in r.warnings)
+        verify_program(main, feed_names={"x"})   # errors only -> no raise
+
+
+# ---------------------------------------------------------------------------
+# control-flow sub-blocks
+# ---------------------------------------------------------------------------
+
+class TestControlFlowRecursion:
+    def _cond_program(self):
+        from paddle_tpu.layers.control_flow import cond
+
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [4], stop_gradient=False)
+            flag = layers.data("flag", [1], dtype="bool",
+                               append_batch_size=False)
+            out = cond(flag, lambda: layers.scale(x, scale=3.0),
+                       lambda: layers.scale(x, scale=0.5))
+            loss = layers.mean(out)
+        return main, loss
+
+    def test_cond_program_clean(self):
+        main, loss = self._cond_program()
+        r = verify_program(main, feed_names={"x", "flag"},
+                           fetch_names=[loss.name], infer_shapes=True)
+        assert r.ok and not r.violations
+
+    def test_corruption_inside_sub_block_located(self):
+        main, _ = self._cond_program()
+        cop = [op for op in main.global_block().ops
+               if op.type == "cond"][0]
+        cop.attrs["true_block"].ops[0].inputs["X"] = ["ghost_inner"]
+        with pytest.raises(ProgramVerifyError) as ei:
+            verify_program(main, feed_names={"x", "flag"})
+        assert ei.value.check == "dangling_input"
+        assert ei.value.block_idx > 0   # located IN the sub-block
+
+    def test_while_loop_program_clean(self):
+        from paddle_tpu.layers.control_flow import while_loop
+
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            i = layers.fill_constant([1], "int32", 0)
+            acc = layers.fill_constant([1], "float32", 0.0)
+            limit = layers.data("limit", [1], dtype="int32",
+                                append_batch_size=False)
+            i_out, acc_out = while_loop(
+                lambda i, a: layers.less_than(i, limit),
+                lambda i, a: (layers.increment(i, 1.0), a + 2.0),
+                [i, acc])
+        r = verify_program(main, feed_names={"limit"},
+                           fetch_names=[i_out.name, acc_out.name])
+        assert r.ok and not r.violations
+
+    def test_static_loop_with_grad_clean(self):
+        from paddle_tpu.layers.control_flow import static_loop
+
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [3], stop_gradient=True)
+            w = layers.create_parameter(
+                [1], "float32", name="w",
+                default_initializer=pt.initializer.Constant(1.5))
+            (out,) = static_loop(
+                3, lambda i, acc: layers.elementwise_mul(acc, w, axis=-1),
+                [x])
+            loss = layers.reduce_sum(out)
+            grads = pt.gradients([loss], [w])
+        r = verify_program(main, feed_names={"x"},
+                           fetch_names=[out.name, grads[0].name])
+        assert r.ok and not r.violations
+
+    def test_sub_block_write_to_outer_persistable_warns(self):
+        """The compiling executor's state analysis only sees block-0
+        writes — a sub-block update of an outer persistable is silently
+        dropped. The donation lint flags it."""
+        main, _ = self._cond_program()
+        cop = [op for op in main.global_block().ops
+               if op.type == "cond"][0]
+        tb = cop.attrs["true_block"]
+        tb.ops.append(OpDesc("scale", {"X": ["x"]}, {"Out": ["p_state"]},
+                             {"scale": 1.0}))
+        main.global_block().create_var(name="p_state", shape=[-1, 4],
+                                       dtype="float32", persistable=True)
+        r = verify_program(main, feed_names={"x", "flag"},
+                           raise_on_error=False)
+        assert any(v.check == "sub_block_state_write" and
+                   v.var == "p_state" for v in r.warnings)
+
+
+# ---------------------------------------------------------------------------
+# apply_passes gate + orphan pruning
+# ---------------------------------------------------------------------------
+
+class TestApplyPassesGate:
+    def test_bad_pass_named_in_error(self):
+        @register_pass("_test_bad_pass")
+        def _bad(program):
+            # fuse-gone-wrong: rewires an op to a var it then deletes
+            blk = program.global_block()
+            blk.ops[0].inputs["X"] = ["vanished"]
+            return program
+
+        try:
+            main, _, _ = _mlp_program()
+            with pytest.raises(ProgramVerifyError) as ei:
+                apply_passes(main, ["_test_bad_pass"])
+            assert "_test_bad_pass" in str(ei.value)
+            assert ei.value.check == "dangling_input"
+        finally:
+            _PASS_REGISTRY.pop("_test_bad_pass", None)
+
+    def test_fc_fuse_prunes_orphaned_intermediate(self):
+        """mul+add -> fc orphans the mul's Out desc; apply_passes prunes
+        it and the verifier reports the program dead-var clean."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [4])
+            y = layers.fc(x, 8)
+        blk = main.global_block()
+        inter = [op for op in blk.ops if op.type == "mul"]
+        assert inter, "expected an unfused mul op"
+        mul_out = inter[0].outputs["Out"][0]
+        assert blk.has_var(mul_out)
+        telemetry.reset()
+        apply_passes(main, ["fc_fuse_pass"], feed_names={"x"},
+                     fetch_names=[y.name])
+        assert [op.type for op in blk.ops] == ["fc"]
+        assert not blk.has_var(mul_out), "orphaned desc not pruned"
+        r = verify_program(main, feed_names={"x"}, fetch_names=[y.name],
+                           raise_on_error=False)
+        assert r.ok and not r.warnings
+
+    def test_verify_false_skips_gate(self):
+        @register_pass("_test_bad_pass2")
+        def _bad(program):
+            program.global_block().ops[0].inputs["X"] = ["vanished"]
+            return program
+
+        try:
+            main, _, _ = _mlp_program()
+            apply_passes(main, ["_test_bad_pass2"], verify=False)  # no raise
+        finally:
+            _PASS_REGISTRY.pop("_test_bad_pass2", None)
+
+
+# ---------------------------------------------------------------------------
+# registered-pass sweep over the book-model programs (ISSUE satellite)
+# ---------------------------------------------------------------------------
+
+def _book_builders():
+    from paddle_tpu.models import lenet, sentiment, word2vec
+
+    return {
+        "lenet": lambda: lenet.build_lenet_program(batch_size=4),
+        "word2vec": lambda: word2vec.build_word2vec_program(
+            dict_size=100, batch_size=4),
+        "sentiment_conv": lambda: sentiment.build_sentiment_program(
+            net="conv", vocab=100, seq_len=8, batch_size=4),
+    }
+
+
+class TestPassSweepBookModels:
+    @pytest.mark.parametrize("model", sorted(_book_builders()))
+    def test_every_registered_pass_verifies_clean(self, model):
+        """Each registered pass applied to the book model's eval clone
+        must leave a program with zero violations — errors AND warnings
+        (no dangling vars, no stale wiring) — under full verification
+        including static shape propagation."""
+        main, startup, feeds, fetches = _book_builders()[model]()
+        feed_names = set(feeds)
+        fetch_names = [v.name for v in fetches.values()]
+        for pname in registered_passes():
+            infer = main.clone(for_test=True)
+            apply_passes(infer, [pname], feed_names=feed_names,
+                         fetch_names=fetch_names)
+            r = verify_program(infer, feed_names=feed_names,
+                               fetch_names=fetch_names, infer_shapes=True,
+                               raise_on_error=False,
+                               context=f"{model}/{pname}")
+            assert not r.violations, (
+                f"{model} after {pname}: "
+                f"{[v.format() for v in r.violations]}")
+
+    @pytest.mark.parametrize("model", sorted(_book_builders()))
+    def test_default_pipeline_verifies_clean(self, model):
+        from paddle_tpu.inference.predictor import DEFAULT_PASSES
+
+        main, startup, feeds, fetches = _book_builders()[model]()
+        feed_names = set(feeds)
+        fetch_names = [v.name for v in fetches.values()]
+        infer = main.clone(for_test=True)
+        apply_passes(infer, DEFAULT_PASSES, feed_names=feed_names,
+                     fetch_names=fetch_names)
+        r = verify_program(infer, feed_names=feed_names,
+                           fetch_names=fetch_names, infer_shapes=True,
+                           raise_on_error=False)
+        assert not r.violations, [v.format() for v in r.violations]
+
+    def test_training_programs_verify_clean(self):
+        for model, build in _book_builders().items():
+            main, startup, feeds, fetches = build()
+            fetch_names = [v.name for v in fetches.values()]
+            r = verify_program(main, feed_names=set(feeds),
+                               fetch_names=fetch_names, infer_shapes=True,
+                               raise_on_error=False, context=model)
+            assert not r.errors, (model, [v.format() for v in r.errors])
+            r2 = verify_program(startup, raise_on_error=False)
+            assert not r2.errors, (model, [v.format() for v in r2.errors])
+
+
+# ---------------------------------------------------------------------------
+# executor FLAGS_verify_program gate
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def verify_flag():
+    old = pt.get_flags("FLAGS_verify_program")["FLAGS_verify_program"]
+    pt.set_flags({"FLAGS_verify_program": True})
+    yield
+    pt.set_flags({"FLAGS_verify_program": old})
+
+
+class TestExecutorGate:
+    def test_corrupt_program_fails_before_compile(self, scope, verify_flag):
+        main, startup, loss = _mlp_program_with_opt()
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        main.global_block().ops[0].inputs["X"] = ["ghost"]
+        with pytest.raises(ProgramVerifyError) as ei:
+            exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                    fetch_list=[loss], scope=scope)
+        assert ei.value.check == "dangling_input"
+
+    def test_clean_program_runs_and_verification_is_cached(self, scope,
+                                                           verify_flag):
+        telemetry.reset()
+        main, startup, loss = _mlp_program_with_opt()
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        feed = {"x": np.ones((2, 4), np.float32)}
+        l1, = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+        l2, = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+        assert np.isfinite(float(np.asarray(l1).reshape(-1)[0]))
+        snap = telemetry.snapshot()
+        # startup + main verified once each; the second run hit the
+        # (uid, version) cache
+        assert snap["counters"].get("verifier.programs") == 2
+        assert snap["counters"].get("verifier.checks_run", 0) >= 8
+
+    def test_run_steps_donation_gate(self, scope, verify_flag):
+        """run_steps with a feed aliasing donated state is exactly the
+        silent-wrong-answer case — the gate turns it into a typed error."""
+        main, startup, loss = _mlp_program_with_opt()
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        k = 2
+        feed = {"x": np.ones((k, 2, 4), np.float32),
+                "w": np.ones((k, 4, 8), np.float32)}
+        with pytest.raises(ProgramVerifyError) as ei:
+            exe.run_steps(main, feed=feed, fetch_list=[loss], k=k,
+                          scope=scope)
+        assert ei.value.check == "donated_feed_overlap"
+
+    def test_run_steps_clean_program_unaffected(self, scope, verify_flag):
+        main, startup, loss = _mlp_program_with_opt()
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        k = 3
+        feed = {"x": np.stack([np.full((2, 4), i, np.float32)
+                               for i in range(k)])}
+        out, = exe.run_steps(main, feed=feed, fetch_list=[loss], k=k,
+                             scope=scope)
+        assert np.shape(out)[0] == k
+
+    def test_flag_off_means_no_verification(self, scope):
+        telemetry.reset()
+        main, startup, loss = _mlp_program_with_opt()
+        main.global_block().vars["w"].desc.shape = (5, 8)  # corrupt desc
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        # cheap checks don't look at shapes; flag off -> no verify at all
+        exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                fetch_list=[loss], scope=scope)
+        assert "verifier.programs" not in telemetry.snapshot()["counters"]
+
+
+def _mlp_program_with_opt():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4], stop_gradient=False)
+        w = layers.create_parameter([4, 8], "float32", name="w")
+        y = layers.matmul(x, w)
+        loss = layers.mean(y)
+        pt.optimizer.SGDOptimizer(0.1).minimize(loss)
+    return main, startup, loss
+
+
+# ---------------------------------------------------------------------------
+# graph_lint CLI (tier-1 smoke, ISSUE satellite)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def lint_main():
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        from tools.graph_lint import main as lint
+        yield lint
+    finally:
+        sys.path.remove(REPO_ROOT)
+
+
+def _save_small_model(tmp_path, scope):
+    from paddle_tpu import io
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [6])
+        y = layers.fc(x, 4, act="relu")
+        out = layers.softmax(y)
+    exe = pt.Executor()
+    exe.run(startup, scope=scope, use_compiled=False)
+    d = str(tmp_path / "model")
+    io.save_inference_model(d, ["x"], [out], main_program=main, scope=scope)
+    return d, out.name
+
+
+class TestGraphLintCLI:
+    def test_clean_model_exits_zero(self, tmp_path, scope, lint_main,
+                                    capsys):
+        d, _ = _save_small_model(tmp_path, scope)
+        assert lint_main([d]) == 0
+        assert "clean" in capsys.readouterr().out
+
+    def test_corrupted_model_exits_nonzero(self, tmp_path, scope,
+                                           lint_main, capsys):
+        d, _ = _save_small_model(tmp_path, scope)
+        mf = os.path.join(d, "__model__.json")
+        doc = json.load(open(mf))
+        b0 = doc["program"]["blocks"][0]
+        keep = b0["ops"][0]["inputs"]
+        # corrupt: first op reads a var whose desc we delete
+        victim = next(n for ns in keep.values() for n in ns)
+        b0["vars"] = [v for v in b0["vars"] if v["name"] != victim]
+        json.dump(doc, open(mf, "w"))
+        assert lint_main([d]) == 1
+        assert "dangling_input" in capsys.readouterr().out
+
+    def test_json_report(self, tmp_path, scope, lint_main, capsys):
+        d, _ = _save_small_model(tmp_path, scope)
+        assert lint_main([d, "--json"]) == 0
+        rep = json.loads(capsys.readouterr().out)
+        assert rep["errors"] == 0 and rep["ops"] >= 2
+        assert "shapes" in rep["checks_run"]
+
+    def test_unloadable_path_exits_two(self, lint_main):
+        assert lint_main([os.path.join("/nonexistent", "dir")]) == 2
+
+    def test_bare_program_json(self, tmp_path, lint_main, capsys):
+        main, _, _ = _mlp_program()
+        f = tmp_path / "prog.json"
+        f.write_text(json.dumps(main.to_dict()))
+        assert lint_main([str(f)]) == 0
+
+    def test_strict_fails_on_warnings(self, tmp_path, scope, lint_main,
+                                      capsys):
+        d, _ = _save_small_model(tmp_path, scope)
+        mf = os.path.join(d, "__model__.json")
+        doc = json.load(open(mf))
+        doc["program"]["blocks"][0]["vars"].append(
+            {"name": "dead_decl", "shape": [2], "dtype": "float32"})
+        json.dump(doc, open(mf, "w"))
+        assert lint_main([d]) == 0
+        assert lint_main([d, "--strict"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# telemetry + perf_report section
+# ---------------------------------------------------------------------------
+
+class TestVerifierTelemetry:
+    def test_counters_and_perf_report_section(self, tmp_path):
+        log = tmp_path / "run.jsonl"
+        telemetry.configure(str(log))
+        telemetry.reset()
+        try:
+            main, _, loss = _mlp_program()
+            verify_program(main, feed_names={"x"}, fetch_names=[loss.name],
+                           infer_shapes=True)
+            bad, _, _ = _mlp_program()
+            bad.global_block().ops[0].inputs["X"] = ["ghost"]
+            with pytest.raises(ProgramVerifyError):
+                verify_program(bad)
+            telemetry.flush_sink()
+        finally:
+            telemetry.configure(None)
+        sys.path.insert(0, REPO_ROOT)
+        try:
+            from tools.perf_report import load_counted, summarize_log
+        finally:
+            sys.path.remove(REPO_ROOT)
+        recs, malformed = load_counted(str(log))
+        s = summarize_log(recs, malformed)
+        vf = s["verifier"]
+        assert vf["programs"] == 2
+        assert vf["violations"] >= 1
+        assert vf["checks_run"] >= 8
+        assert "verify_ms" in vf
+
+    def test_check_registry_surface(self):
+        assert {"structure", "dataflow", "hazards", "donation",
+                "shapes"} <= set(registered_checks())
+        ctx = VerifyContext(pt.Program())
+        assert ctx.blocks and ctx.referenced == set()

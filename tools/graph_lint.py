@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""graph_lint — statically lint a saved inference model or serialized
+program with the core/verify.py program verifier.
+
+The CI/ops twin of the in-process gates (apply_passes post-pass
+verification, the Executor's FLAGS_verify_program pre-compile check):
+point it at a directory written by ``io.save_inference_model`` (or a
+bare program JSON) and it runs the full static-analysis suite —
+structure (vars exist, ops registered, required attrs), dataflow
+(def-before-use, dangling reads against the model's declared feeds,
+missing fetch targets, dead VarDescs), write-write hazards, donation
+safety, and (by default) static shape/dtype propagation through every
+op's registered lowering under jax.eval_shape.
+
+Exit codes: 0 clean, 1 violations found (report on stdout), 2 the
+model/program could not be loaded.
+
+Usage:
+    python tools/graph_lint.py MODEL_DIR                 # saved model
+    python tools/graph_lint.py MODEL_DIR --json          # machine-readable
+    python tools/graph_lint.py prog.json                 # program doc
+    python tools/graph_lint.py MODEL_DIR --no-shapes     # cheap checks only
+    python tools/graph_lint.py MODEL_DIR --strict        # warnings fail too
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _load(path: str, model_filename=None):
+    """Returns (program, feed_names, fetch_names, source_desc)."""
+    from paddle_tpu.core.ir import Program
+
+    if os.path.isdir(path):
+        fname = os.path.join(path, model_filename or "__model__.json")
+        with open(fname) as f:
+            doc = json.load(f)
+    else:
+        fname = path
+        with open(fname) as f:
+            doc = json.load(f)
+    if "program" in doc:
+        program = Program.from_dict(doc["program"])
+        feeds = doc.get("feed_names") or []
+        fetches = doc.get("fetch_names") or []
+    elif "blocks" in doc:
+        program = Program.from_dict(doc)
+        feeds, fetches = None, []
+    else:
+        raise ValueError(
+            f"{fname}: neither an inference-model doc (has 'program') nor "
+            f"a serialized program (has 'blocks')")
+    return program, feeds, fetches, fname
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Statically lint a saved inference model / serialized "
+                    "program (core/verify.py)")
+    ap.add_argument("path", help="model dir (io.save_inference_model) or a "
+                                 "program/model JSON file")
+    ap.add_argument("--model-filename", default=None,
+                    help="model file name inside the dir "
+                         "(default __model__.json)")
+    ap.add_argument("--no-shapes", action="store_true",
+                    help="skip the eval_shape static shape/dtype "
+                         "propagation check (pure-Python checks only)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit nonzero on warnings too, not just errors")
+    ap.add_argument("--json", action="store_true",
+                    help="print the violation report as JSON")
+    args = ap.parse_args(argv)
+
+    try:
+        program, feeds, fetches, src = _load(args.path, args.model_filename)
+    except Exception as e:
+        print(f"graph_lint: cannot load '{args.path}': "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+
+    from paddle_tpu.core.verify import verify_program
+
+    result = verify_program(
+        program,
+        feed_names=set(feeds) if feeds is not None else None,
+        fetch_names=fetches,
+        infer_shapes=not args.no_shapes,
+        raise_on_error=False,
+        context=f"graph_lint {src}")
+
+    nops = sum(len(b.ops) for b in program.blocks)
+    if args.json:
+        print(json.dumps({
+            "source": src,
+            "blocks": len(program.blocks),
+            "ops": nops,
+            "checks_run": list(result.checks_run),
+            "elapsed_ms": round(result.elapsed_ms, 3),
+            "errors": len(result.errors),
+            "warnings": len(result.warnings),
+            "violations": [{
+                "check": v.check, "severity": v.severity,
+                "block_idx": v.block_idx, "op_idx": v.op_idx,
+                "op_type": v.op_type, "var": v.var,
+                "message": v.message,
+            } for v in result.violations],
+        }, indent=2))
+    else:
+        print(f"graph_lint: {src}: {len(program.blocks)} block(s), "
+              f"{nops} op(s); checks: {', '.join(result.checks_run)} "
+              f"({result.elapsed_ms:.1f} ms)")
+        for v in result.violations:
+            print("  " + v.format())
+        if not result.violations:
+            print("  clean — no violations")
+        else:
+            print(f"  {len(result.errors)} error(s), "
+                  f"{len(result.warnings)} warning(s)")
+    failed = result.errors or (args.strict and result.warnings)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

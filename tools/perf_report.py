@@ -29,6 +29,10 @@ Renders the structured run log written by ``paddle_tpu.core.telemetry``
   meta_optimizers.py): per-kind dp-collective bytes, optimizer-state
   bytes global vs per-device, rule resolutions and reshard-on-load
   events;
+* a "Verifier" section when the run ran static program verification
+  (core/verify.py — apply_passes post-pass gates, FLAGS_verify_program,
+  tools/graph_lint.py): programs verified, checks run, violations,
+  orphaned VarDescs pruned, and verify-time percentiles;
 * a "Tracing" section when the run emitted distributed-tracing spans
   (core/trace.py, FLAGS_trace_sample_rate): trace/span counts and
   per-span-name duration percentiles — merge multi-process logs with
@@ -160,6 +164,7 @@ def summarize_log(recs, malformed=0):
                                gauges)
     ckpt = _ckpt_summary(counter_delta, counter_last, timer_summary)
     sharding = _sharding_summary(counter_delta, counter_last, gauges)
+    verifier = _verifier_summary(counter_delta, counter_last, timer_summary)
     tracing = None
     if spans:
         by_name = {}
@@ -177,6 +182,7 @@ def summarize_log(recs, malformed=0):
         "serving": serving,
         "checkpoint": ckpt,
         "sharding": sharding,
+        "verifier": verifier,
         "tracing": tracing,
         "malformed_lines": int(malformed),
         "records": len(recs),
@@ -346,6 +352,34 @@ def _sharding_summary(counter_delta, counter_last, gauges):
     return out
 
 
+def _verifier_summary(counter_delta, counter_last, timer_summary):
+    """Static-verification accounting (core/verify.py): how many programs
+    were checked, how many checks ran, what they found (violations /
+    orphaned VarDescs pruned after passes), and what verification cost."""
+
+    def cval(name):
+        v = counter_delta.get(name) or counter_last.get(name) or 0
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            return 0.0
+
+    programs = cval("verifier.programs")
+    if not programs:
+        return None
+    out = {"programs": int(programs),
+           "checks_run": int(cval("verifier.checks_run")),
+           "violations": int(cval("verifier.violations")),
+           "pruned_vars": int(cval("verifier.pruned_vars")),
+           "shape_infer_skips": int(cval("verifier.shape_infer_skips"))}
+    t = timer_summary.get("verifier.verify_ms")
+    if t:
+        out["verify_ms"] = {"p50": t["p50"], "p99": t["p99"],
+                            "max": t["max"]}
+        out["total_verify_ms"] = round(t["mean"] * t["count"], 1)
+    return out
+
+
 def _fmt_num(v):
     if isinstance(v, float):
         return f"{v:,.3f}".rstrip("0").rstrip(".")
@@ -461,6 +495,20 @@ def render(s, out=sys.stdout):
         w(f"rule resolutions: {sh['rule_resolutions']}  "
           f"indivisible skips: {sh['rules_skipped_indivisible']}  "
           f"reshard-on-load: {sh['resharding_events']}\n")
+
+    if s.get("verifier"):
+        vf = s["verifier"]
+        w("\n-- verifier (static program checks) --\n")
+        w(f"programs: {vf['programs']}  checks run: {vf['checks_run']}  "
+          f"violations: {vf['violations']}  pruned vars: "
+          f"{vf['pruned_vars']}\n")
+        if vf.get("shape_infer_skips"):
+            w(f"shape-inference skips (untraceable lowerings): "
+              f"{vf['shape_infer_skips']}\n")
+        if "verify_ms" in vf:
+            t = vf["verify_ms"]
+            w(f"verify ms: p50 {t['p50']}  p99 {t['p99']}  max {t['max']}"
+              f"  (total ~{_fmt_num(vf['total_verify_ms'])})\n")
 
     if s.get("tracing"):
         tr = s["tracing"]
