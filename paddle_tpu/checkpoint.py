@@ -478,6 +478,190 @@ def load_checkpoint(path: str, program: Optional[Program] = None,
     return int(manifest.get("step", 0))
 
 
+# ---------------------------------------------------------------------------
+# model publishing + manifest watching (the serving control plane's feed)
+# ---------------------------------------------------------------------------
+#
+# A trained model reaches the serving fleet the same way a checkpoint
+# reaches a restart: staged, manifested, fsynced, atomically renamed. A
+# "published model" is an inference-model dir (io.save_inference_model
+# layout) committed under <models_root>/model-<version>/ with a
+# MANIFEST.json COMMIT record listing every file's sha256 — so a watcher
+# (serving/cluster.py's rolling-swap driver) can poll the root and trust
+# that any version it sees is COMPLETE, verified bytes, never a
+# half-copied directory.
+
+MODEL_FORMAT = "paddle_tpu-model-v1"
+_MODEL_PREFIX = "model-"
+_TMP_MODEL_PREFIX = ".tmp-model-"
+
+
+def list_model_versions(models_root: str) -> List[Tuple[int, str]]:
+    """[(version, path)] of committed-named model dirs, ascending. Only
+    the NAME is checked here — verify_model_dir() judges the contents."""
+    out = []
+    try:
+        names = os.listdir(models_root)
+    except OSError:
+        return out
+    for name in names:
+        if not name.startswith(_MODEL_PREFIX):
+            continue
+        try:
+            version = int(name[len(_MODEL_PREFIX):])
+        except ValueError:
+            continue
+        out.append((version, os.path.join(models_root, name)))
+    return sorted(out)
+
+
+def publish_model(models_root: str, src_dir: str,
+                  version: Optional[int] = None,
+                  extras: Optional[Dict[str, Any]] = None) -> str:
+    """Atomically publish the inference-model dir ``src_dir`` as
+    ``<models_root>/model-<version>/`` with a COMMIT manifest.
+
+    Same crash-safety contract as write_checkpoint_dir: every file is
+    copied into a staging dir and fsynced, the manifest (per-file sha256
+    + nbytes, committed marker) is written last, then one atomic rename.
+    ``version`` defaults to newest-on-disk + 1. Returns the final dir."""
+    t0 = time.perf_counter()
+    models_root = os.path.abspath(models_root)
+    os.makedirs(models_root, exist_ok=True)
+    if version is None:
+        published = list_model_versions(models_root)
+        version = (published[-1][0] + 1) if published else 1
+    version = int(version)
+    final_dir = os.path.join(models_root, f"{_MODEL_PREFIX}{version:06d}")
+    names = sorted(n for n in os.listdir(src_dir)
+                   if os.path.isfile(os.path.join(src_dir, n)))
+    if not names:
+        raise ValueError(f"{src_dir}: no model files to publish")
+    tmp = os.path.join(models_root,
+                       f"{_TMP_MODEL_PREFIX}{version:06d}"
+                       f"-{os.getpid()}-{threading.get_ident()}")
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    try:
+        files = {}
+        for name in names:
+            dst = os.path.join(tmp, name)
+            shutil.copyfile(os.path.join(src_dir, name), dst)
+            with open(dst, "rb") as f:
+                f.flush()
+                os.fsync(f.fileno())
+            files[name] = {"sha256": _sha256_file(dst),
+                           "nbytes": os.path.getsize(dst)}
+        manifest = {
+            "format": MODEL_FORMAT,
+            "version": version,
+            "ts": time.time(),
+            "files": files,
+            "extras": dict(extras or {}),
+            "committed": True,
+        }
+        mpath = os.path.join(tmp, MANIFEST_NAME)
+        with open(mpath, "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        _fsync_dir(tmp)
+        if os.path.exists(final_dir):
+            raise CheckpointError(
+                f"{final_dir}: model version {version} already published "
+                f"(versions are immutable — publish a new one)")
+        os.rename(tmp, final_dir)
+        _fsync_dir(models_root)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    telemetry.counter_add("serving.models_published", 1, version=version)
+    telemetry.observe("ckpt.publish_ms", (time.perf_counter() - t0) * 1e3,
+                      kind="timer")
+    return final_dir
+
+
+def verify_model_dir(path: str, deep: Optional[bool] = None) -> Dict[str, Any]:
+    """Verify a published model dir's COMMIT manifest (and, with deep
+    verification — FLAGS_ckpt_verify default — every file's size +
+    sha256). Raises CheckpointCorruptError; returns the manifest."""
+    if deep is None:
+        deep = bool(_flags.flag("ckpt_verify"))
+    if not os.path.isdir(path):
+        raise CheckpointCorruptError(f"{path}: not a model directory")
+    mpath = os.path.join(path, MANIFEST_NAME)
+    if not os.path.exists(mpath):
+        raise CheckpointCorruptError(
+            f"{path}: no {MANIFEST_NAME} — publish never committed")
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptError(f"{path}: unreadable manifest: {e}")
+    if manifest.get("format") != MODEL_FORMAT:
+        raise CheckpointCorruptError(
+            f"{path}: unknown model format {manifest.get('format')!r}")
+    if not manifest.get("committed"):
+        raise CheckpointCorruptError(f"{path}: manifest lacks commit marker")
+    for name, spec in (manifest.get("files") or {}).items():
+        fpath = os.path.join(path, name)
+        if not os.path.exists(fpath):
+            raise CheckpointCorruptError(f"{path}: model file '{name}' "
+                                         f"missing")
+        if deep:
+            nbytes = os.path.getsize(fpath)
+            if nbytes != int(spec.get("nbytes", -1)):
+                raise CheckpointCorruptError(
+                    f"{path}: torn model file '{name}' ({nbytes} bytes, "
+                    f"manifest says {spec.get('nbytes')})")
+            if _sha256_file(fpath) != spec.get("sha256"):
+                raise CheckpointCorruptError(
+                    f"{path}: sha256 mismatch for model file '{name}'")
+    return manifest
+
+
+class ModelWatcher:
+    """Poll a models root for newly published VERIFIED versions — the
+    manifest-watch helper behind the serving control plane's
+    zero-downtime swap (a new committed version appearing under the root
+    is the signal to roll the replica fleet onto it).
+
+    ``latest()`` returns the newest (version, path) whose manifest
+    verifies — an unverifiable candidate is skipped (counted on
+    ``serving.model_rejected``), falling back to the next-newest, same
+    discipline as restore_latest. ``poll()`` returns it only when it is
+    NEWER than the last version this watcher reported (None otherwise),
+    so a polling loop fires exactly once per published version."""
+
+    def __init__(self, models_root: str,
+                 last_version: Optional[int] = None):
+        self.models_root = os.path.abspath(models_root)
+        self.last_version = last_version
+
+    def latest(self) -> Optional[Tuple[int, str]]:
+        for version, path in reversed(list_model_versions(self.models_root)):
+            try:
+                verify_model_dir(path)
+            except CheckpointCorruptError as e:
+                telemetry.counter_add("serving.model_rejected", 1,
+                                      version=version,
+                                      reason=type(e).__name__)
+                continue
+            return version, path
+        return None
+
+    def poll(self) -> Optional[Tuple[int, str]]:
+        newest = self.latest()
+        if newest is None:
+            return None
+        if self.last_version is not None and \
+                newest[0] <= self.last_version:
+            return None
+        self.last_version = newest[0]
+        return newest
+
+
 class CheckpointManager:
     """Retention + auto-resume driver over the atomic-commit protocol
     (reference: hapi ModelCheckpoint + the PS checkpoint_notify flow).

@@ -224,6 +224,46 @@ define_flag("serving_buckets", "",
             "pads coalesced batches up to (keeps the jit cache small and "
             "warm); empty = powers of two up to serving_max_batch_size")
 
+# -- cluster serving control plane (paddle_tpu/serving/router.py +
+#    cluster.py: replicated engines, health-checked routing, zero-downtime
+#    model swap; reference analogs: the PS/Fleet elastic-serving promise,
+#    TF-Serving + an L7 LB in front) ------------------------------------------
+
+define_flag("router_health_interval_s", 0.2,
+            "seconds between router health/stats probes of each replica "
+            "(GET /healthz + /v1/stats): readiness gates routing, scraped "
+            "queue_depth drives least-loaded balancing")
+define_flag("router_max_retries", 4,
+            "max retry/failover attempts per routed request beyond the "
+            "first — each retry prefers a replica not yet tried for the "
+            "request (router.retries / router.failovers count them)")
+define_flag("router_backoff", 0.02,
+            "base seconds for the router's exponential retry backoff "
+            "(core/retry.py schedule: doubles per attempt, +/-50% "
+            "jitter, capped at 1s, clipped to the request deadline)")
+define_flag("router_timeout_s", 30.0,
+            "total per-request budget in seconds when the client sends "
+            "no deadline_ms — retries and failovers all stop when it "
+            "elapses; <= 0 disables")
+define_flag("router_dispatch_timeout_s", 10.0,
+            "cap on a SINGLE dispatch attempt's socket timeout (the "
+            "request's remaining deadline still applies when smaller) — "
+            "bounds how long one dead-but-accepting replica can stall a "
+            "request before failover")
+define_flag("router_dedup_capacity", 1024,
+            "bound on the router's request-id dedup cache: a client retry "
+            "carrying an X-Request-Id already answered replays the cached "
+            "response (router.dedup_hits) instead of re-dispatching — "
+            "exactly-once serving under client retries; <= 0 disables")
+define_flag("serving_model_poll_s", 0.5,
+            "seconds between cluster-controller polls of the published-"
+            "models root (checkpoint.ModelWatcher): a new verified COMMIT "
+            "manifest triggers the rolling zero-downtime swap")
+define_flag("cluster_max_restarts", 5,
+            "respawn budget per replica process: a replica that dies is "
+            "relaunched (router.replica_restarts) up to this many times "
+            "before the controller gives up on the slot")
+
 define_flag("ckpt_verify", True,
             "verify checkpoint integrity before restoring (paddle_tpu/"
             "checkpoint.py): data-file size + sha256 and per-array "

@@ -22,7 +22,10 @@ PS transport itself):
   (FLAGS_ps_rpc_timeout / FLAGS_ps_rpc_max_retries /
   FLAGS_ps_rpc_backoff), raising errors.RpcDeadlineError /
   errors.RpcError when the budget is gone, and evicting itself from the
-  shared pool so the next get() starts from a fresh connection;
+  shared pool so the next get() starts from a fresh connection. The
+  schedule itself (backoff curve, jitter, deadline-first decision) is
+  the shared core/retry.py RetryPolicy — this transport contributes the
+  sockets, the typed errors and the ps.rpc_* counter names;
 * named fault-injection sites (core/faults.py): `ps.rpc.send` before a
   request frame leaves, `ps.rpc.recv` before the reply is read,
   `ps.handler` around server-side dispatch — a seeded PT_FAULT_SPEC
@@ -41,7 +44,6 @@ from __future__ import annotations
 
 import itertools
 import os
-import random
 import socket
 import struct
 import threading
@@ -52,6 +54,7 @@ import numpy as np
 
 from ...core import faults, telemetry, trace
 from ...core import flags as _flags
+from ...core import retry as _retry
 from ..errors import RpcDeadlineError, RpcError, RpcRemoteError
 
 # trace-context separator on the wire: when a sampled trace is active the
@@ -366,15 +369,11 @@ class RPCClient:
             except OSError:
                 pass
 
-    def _remaining(self, deadline_t: Optional[float]) -> Optional[float]:
-        if deadline_t is None:
-            return self._timeout
-        return max(deadline_t - time.perf_counter(), 0.01)
-
-    def _connect(self, deadline_t: Optional[float]):
+    def _connect(self, sched: "_retry.RetrySchedule"):
         host, port = self.endpoint.rsplit(":", 1)
         self._sock = socket.create_connection(
-            (host, int(port)), timeout=self._remaining(deadline_t))
+            (host, int(port)),
+            timeout=sched.remaining(default=self._timeout))
         if self._was_connected:
             telemetry.counter_add("ps.rpc_reconnects", 1,
                                   endpoint=self.endpoint)
@@ -399,7 +398,9 @@ class RPCClient:
             else int(max_retries)
         backoff = _flags.flag("ps_rpc_backoff")
         t0 = time.perf_counter()
-        deadline_t = t0 + budget if budget and budget > 0 else None
+        policy = _retry.RetryPolicy(
+            max_retries=retries, backoff=backoff,
+            deadline=budget if budget and budget > 0 else None)
         # the span covers the WHOLE retry schedule — retries resend the
         # same frame (same seq, same propagated context), so client call
         # and server handler stay one logical parent/child pair no matter
@@ -411,14 +412,15 @@ class RPCClient:
             with self._lock:
                 self._seq += 1
                 seq = self._seq
-                attempt = 0
+                sched = policy.start()
                 while True:
                     try:
                         faults.maybe_fail("ps.rpc.send", method=method,
                                           endpoint=self.endpoint)
                         if self._sock is None:
-                            self._connect(deadline_t)
-                        self._sock.settimeout(self._remaining(deadline_t))
+                            self._connect(sched)
+                        self._sock.settimeout(
+                            sched.remaining(default=self._timeout))
                         _send_msg(self._sock, wire_method, name, a, aux,
                                   self._client_id, seq)
                         faults.maybe_fail("ps.rpc.recv", method=method,
@@ -432,9 +434,8 @@ class RPCClient:
                         break
                     except (ConnectionError, OSError) as e:
                         self._close()
-                        attempt += 1
-                        now = time.perf_counter()
-                        if deadline_t is not None and now >= deadline_t:
+                        outcome, delay = sched.note_failure()
+                        if outcome == _retry.DEADLINE:
                             telemetry.counter_add(
                                 "ps.rpc_deadline_exceeded", 1,
                                 method=method)
@@ -442,20 +443,16 @@ class RPCClient:
                             raise RpcDeadlineError(
                                 f"PS RPC '{method}' to {self.endpoint} "
                                 f"exceeded its {budget:.3f}s deadline "
-                                f"(attempt {attempt}: "
+                                f"(attempt {sched.attempt}: "
                                 f"{type(e).__name__}: {e})") from e
-                        if attempt > retries:
+                        if outcome == _retry.EXHAUSTED:
                             self.evict()
                             raise RpcError(
                                 f"PS RPC '{method}' to {self.endpoint} "
-                                f"failed after {attempt} attempts: "
+                                f"failed after {sched.attempt} attempts: "
                                 f"{type(e).__name__}: {e}") from e
                         telemetry.counter_add("ps.rpc_retries", 1,
                                               method=method)
-                        delay = min(backoff * (2 ** (attempt - 1)), 1.0)
-                        delay *= 0.5 + random.random()  # +/-50% jitter
-                        if deadline_t is not None:
-                            delay = min(delay, max(deadline_t - now, 0.0))
                         time.sleep(delay)
             # transport accounting (reference analog: the gRPC/BRPC client
             # metrics) — call count, payload bytes each way, latency

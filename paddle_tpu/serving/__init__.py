@@ -12,19 +12,41 @@ from millions of users"):
 * ``server`` — stdlib HTTP JSON front end + in-process ``LocalClient``,
   with every-bucket warmup.
 
-Load harness: tools/bench_serving.py. Chaos: the engine loop is a
-``serving.handler`` fault site (tools/chaos_check.py --serving).
+Cluster control plane (ROADMAP item 2):
+
+* ``health`` — the liveness/readiness state machine behind ``/healthz``
+  (503 while starting/swapping/draining) and ``/livez``;
+* ``router`` — health-checked queue-depth load balancing with
+  retry/failover on the shared core/retry.py schedule and request-id
+  dedup (exactly-once under retries);
+* ``cluster`` — ``ClusterController`` launches/supervises N replica
+  processes (serving/replica.py) and rolls the fleet onto newly
+  published model versions (checkpoint.publish_model COMMIT manifests)
+  with zero downtime.
+
+Load harness: tools/bench_serving.py (``--replicas N`` drives the
+cluster). Chaos: ``serving.handler`` (engine loop), ``router.dispatch``
+(router), ``replica.swap`` (model swap) fault sites;
+tools/chaos_check.py --serving / --cluster.
 """
 
 from .admission import (AdmissionQueue, DeadlineExceededError,
                         EngineClosedError, InferenceRequest,
                         ServerOverloadedError, ServingError)
+from .cluster import ClusterController, ClusterError, InprocReplica, \
+    ReplicaProcess
 from .engine import ServingConfig, ServingEngine
+from .health import HealthState
+from .router import (NoReplicaAvailableError, ReplicaHandle, Router,
+                     RouterHTTPServer)
 from .server import LocalClient, ServingHTTPServer, serve
 
 __all__ = [
-    "AdmissionQueue", "DeadlineExceededError", "EngineClosedError",
-    "InferenceRequest", "LocalClient", "ServerOverloadedError",
+    "AdmissionQueue", "ClusterController", "ClusterError",
+    "DeadlineExceededError", "EngineClosedError", "HealthState",
+    "InferenceRequest", "InprocReplica", "LocalClient",
+    "NoReplicaAvailableError", "ReplicaHandle", "ReplicaProcess",
+    "Router", "RouterHTTPServer", "ServerOverloadedError",
     "ServingConfig", "ServingEngine", "ServingError",
     "ServingHTTPServer", "serve",
 ]

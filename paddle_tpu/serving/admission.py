@@ -56,7 +56,8 @@ class InferenceRequest:
     against it retroactively."""
 
     __slots__ = ("feeds", "rows", "deadline", "enqueue_t", "trace",
-                 "enqueue_wall", "_event", "_result", "_error")
+                 "enqueue_wall", "served_version", "_event", "_result",
+                 "_error")
 
     def __init__(self, feeds: Dict[str, Any], rows: int,
                  deadline: Optional[float], trace: Optional[Any] = None):
@@ -66,6 +67,7 @@ class InferenceRequest:
         self.enqueue_t = time.monotonic()
         self.trace = trace                # SpanContext of the submitter
         self.enqueue_wall = time.time() if trace is not None else 0.0
+        self.served_version: Optional[int] = None  # engine.version at serve
         self._event = threading.Event()
         self._result: Optional[List[Any]] = None
         self._error: Optional[BaseException] = None

@@ -1,0 +1,484 @@
+"""Cluster serving control plane — replicated engines behind one router,
+with supervised respawn and zero-downtime rolling model swaps.
+
+This is ROADMAP item 2: the composition of the robustness subsystems
+into one deployment. The pieces and where they came from:
+
+* N **replicas** — each a PR 4 ServingEngine process
+  (serving/replica.py) with the health.py liveness/readiness machine;
+* a **router** (router.py) balancing on live per-replica telemetry and
+  failing over on the shared core/retry.py schedule (PR 2 heritage);
+* a **model watcher** (checkpoint.ModelWatcher) polling a published-
+  models root for new verified COMMIT manifests (PR 5 protocol); a new
+  version triggers the **rolling swap**: one replica at a time, the
+  controller POSTs /v1/admin/swap — the replica goes not-ready, warms
+  every bucket on the new predictor while the OLD one keeps serving,
+  flips atomically, and returns ready. At most one replica is swapping
+  at any moment, so N-1 replicas carry traffic throughout: zero
+  downtime, zero dropped requests, never a cold-bucket response;
+* a **monitor** thread supervising replica processes: a death is
+  counted (router.replica_deaths), the handle is marked down (the
+  router already failed over by then), and the slot is respawned on a
+  core/retry.py backoff schedule up to FLAGS_cluster_max_restarts.
+
+Two replica backends share every code path above:
+
+* ``inprocess=False`` (default) — real OS processes via
+  ``python -m paddle_tpu.serving.replica``; what production and the
+  chaos gate (tools/chaos_check.py --cluster, SIGKILL mid-load) use;
+* ``inprocess=True`` — engine + HTTP server threads in THIS process;
+  same wire surface on real sockets, a fraction of the startup cost —
+  what most tier-1 tests use.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from .. import checkpoint as _ckpt
+from ..core import retry, telemetry
+from ..core.flags import flag as _flag
+from .router import Router, RouterHTTPServer, _http_json
+
+
+class ClusterError(RuntimeError):
+    """Control-plane failure (replica never came up, swap never took)."""
+
+
+# ---------------------------------------------------------------------------
+# replica backends
+# ---------------------------------------------------------------------------
+
+class ReplicaProcess:
+    """One supervised replica OS process."""
+
+    def __init__(self, name: str, model_root: str,
+                 env: Optional[Dict[str, str]] = None,
+                 serving_config=None, telemetry_log: str = "",
+                 ready_timeout_s: float = 120.0, **_ignored):
+        self.name = name
+        self.model_root = model_root
+        self.env = env
+        self.serving_config = serving_config
+        self.telemetry_log = telemetry_log
+        self.ready_timeout_s = ready_timeout_s
+        self.proc: Optional[subprocess.Popen] = None
+        self.url: Optional[str] = None
+        self.version: Optional[int] = None
+        self.log_tail: "deque[str]" = deque(maxlen=200)
+        self._drain_thread: Optional[threading.Thread] = None
+
+    def spawn(self):
+        """Launch and block until the PT_REPLICA_READY announce line."""
+        env = dict(os.environ if self.env is None else self.env)
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = repo_root + os.pathsep + \
+            env.get("PYTHONPATH", "")
+        cmd = [sys.executable, "-m", "paddle_tpu.serving.replica",
+               "--model-root", self.model_root, "--port", "0"]
+        if self.serving_config is not None:
+            cmd += ["--max-batch-size",
+                    str(self.serving_config.max_batch_size),
+                    "--batch-timeout-ms",
+                    str(self.serving_config.batch_timeout_ms)]
+        if self.telemetry_log:
+            cmd += ["--telemetry-log", self.telemetry_log]
+        self.proc = subprocess.Popen(
+            cmd, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, bufsize=1)
+        deadline = time.monotonic() + self.ready_timeout_s
+        announce = None
+        assert self.proc.stdout is not None
+        while time.monotonic() < deadline:
+            line = self.proc.stdout.readline()
+            if not line:
+                break
+            self.log_tail.append(line.rstrip())
+            if line.startswith("PT_REPLICA_READY "):
+                announce = json.loads(line[len("PT_REPLICA_READY "):])
+                break
+            if line.startswith("PT_REPLICA_FAIL"):
+                break
+        if announce is None:
+            rc = self.proc.poll()
+            raise ClusterError(
+                f"replica {self.name} never announced readiness "
+                f"(exit={rc}); last output: "
+                f"{list(self.log_tail)[-5:]}")
+        self.url = announce["url"]
+        self.version = announce.get("version")
+        # keep draining stdout so the pipe never fills and wedges the child
+        self._drain_thread = threading.Thread(
+            target=self._drain, name=f"pt-replica-log-{self.name}",
+            daemon=True)
+        self._drain_thread.start()
+        return self
+
+    def _drain(self):
+        try:
+            assert self.proc is not None and self.proc.stdout is not None
+            for line in self.proc.stdout:
+                self.log_tail.append(line.rstrip())
+        except (OSError, ValueError):
+            pass
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def kill(self, sig: int = signal.SIGKILL):
+        """Chaos/test helper: the ungraceful death."""
+        if self.alive():
+            assert self.proc is not None
+            self.proc.send_signal(sig)
+
+    def stop(self, timeout: float = 30.0):
+        """Graceful stop: SIGTERM (replica drains), then SIGKILL."""
+        if self.proc is None:
+            return
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=5)
+        if self._drain_thread is not None:
+            self._drain_thread.join(timeout=2)
+
+
+class InprocReplica:
+    """Engine + HTTP server threads in this process: the same wire
+    surface as ReplicaProcess at a fraction of the startup cost."""
+
+    def __init__(self, name: str, model_root: str, serving_config=None,
+                 **_ignored):
+        self.name = name
+        self.model_root = model_root
+        self.serving_config = serving_config
+        self.engine = None
+        self.server = None
+        self.url: Optional[str] = None
+        self.version: Optional[int] = None
+        self._stopped = False
+
+    def spawn(self):
+        from ..inference import AnalysisConfig, create_predictor
+        from .engine import ServingEngine
+        from .server import ServingHTTPServer
+
+        newest = _ckpt.ModelWatcher(self.model_root).latest()
+        if newest is None:
+            raise ClusterError(f"no verified published model under "
+                               f"{self.model_root}")
+        version, model_dir = newest
+        self.engine = ServingEngine(
+            create_predictor(AnalysisConfig(model_dir)),
+            config=self.serving_config, version=version)
+        self.server = ServingHTTPServer(self.engine).start()
+        self.url = self.server.url
+        self.version = version
+        self.engine.start(warmup=True)
+        self._stopped = False
+        return self
+
+    def alive(self) -> bool:
+        return not self._stopped
+
+    def kill(self, sig: int = signal.SIGKILL):
+        """Abrupt death: tear the socket down and fail the backlog —
+        in-flight router dispatches see reset/refused, like a SIGKILL."""
+        self._stopped = True
+        if self.server is not None:
+            self.server.shutdown()
+        if self.engine is not None:
+            self.engine.close(drain=False, timeout=5)
+
+    def stop(self, timeout: float = 30.0):
+        if self._stopped:
+            return
+        self._stopped = True
+        if self.engine is not None:
+            self.engine.close(drain=True, timeout=timeout)
+        if self.server is not None:
+            self.server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the controller
+# ---------------------------------------------------------------------------
+
+class ClusterController:
+    """Launch N replicas over a published-models root, front them with a
+    router, supervise deaths, and roll the fleet onto newly published
+    model versions with zero downtime.
+
+        cluster = ClusterController(models_root, replicas=3).start()
+        ... POST cluster.url + "/v1/infer" ...
+        checkpoint.publish_model(models_root, new_model_dir)   # auto-rolls
+        cluster.close()
+    """
+
+    def __init__(self, model_root: str, replicas: int = 2,
+                 inprocess: bool = False,
+                 serving_config=None,
+                 replica_env: Optional[Dict[str, str]] = None,
+                 router: Optional[Router] = None,
+                 host: str = "127.0.0.1", router_port: int = 0,
+                 model_poll_s: Optional[float] = None,
+                 max_restarts: Optional[int] = None,
+                 replica_telemetry_dir: str = "",
+                 auto_swap: bool = True):
+        self.model_root = os.path.abspath(model_root)
+        self.n_replicas = int(replicas)
+        self.inprocess = bool(inprocess)
+        self.serving_config = serving_config
+        self.replica_env = replica_env
+        self.model_poll_s = float(
+            _flag("serving_model_poll_s") if model_poll_s is None
+            else model_poll_s)
+        self.max_restarts = int(
+            _flag("cluster_max_restarts") if max_restarts is None
+            else max_restarts)
+        self.replica_telemetry_dir = replica_telemetry_dir
+        self.auto_swap = bool(auto_swap)
+        self.router = router or Router()
+        self.router_server = RouterHTTPServer(self.router, host=host,
+                                              port=router_port)
+        self.replicas: List[Any] = []
+        self._handles: Dict[str, Any] = {}
+        self._restarts: Dict[str, int] = {}
+        self._watcher: Optional[_ckpt.ModelWatcher] = None
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._swap_lock = threading.Lock()
+        self._counted_dead: set = set()
+        self.current_version: Optional[int] = None
+
+    # -- lifecycle -----------------------------------------------------------
+    @property
+    def url(self) -> str:
+        return self.router_server.url
+
+    def _make_replica(self, index: int):
+        name = f"replica-{index}"
+        log = ""
+        if self.replica_telemetry_dir:
+            log = os.path.join(self.replica_telemetry_dir,
+                               f"{name}.jsonl")
+        cls = InprocReplica if self.inprocess else ReplicaProcess
+        return cls(name, self.model_root, env=self.replica_env,
+                   serving_config=self.serving_config,
+                   telemetry_log=log)
+
+    def start(self, ready_timeout_s: float = 120.0) -> "ClusterController":
+        self._watcher = _ckpt.ModelWatcher(self.model_root)
+        newest = self._watcher.poll()
+        if newest is None:
+            raise ClusterError(f"no verified published model under "
+                               f"{self.model_root} — publish_model() one "
+                               f"before starting the cluster")
+        self.current_version = newest[0]
+        for i in range(self.n_replicas):
+            replica = self._make_replica(i)
+            replica.spawn()
+            self.replicas.append(replica)
+            self._restarts[replica.name] = 0
+            self._handles[replica.name] = self.router.add_replica(
+                replica.name, replica.url)
+        self.router.start()
+        self.router_server.start()
+        self._wait_ready(ready_timeout_s)
+        mon = threading.Thread(target=self._monitor_loop,
+                               name="pt-cluster-monitor", daemon=True)
+        mon.start()
+        self._threads.append(mon)
+        if self.auto_swap:
+            watch = threading.Thread(target=self._watch_loop,
+                                     name="pt-cluster-modelwatch",
+                                     daemon=True)
+            watch.start()
+            self._threads.append(watch)
+        return self
+
+    def _wait_ready(self, timeout_s: float):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            for handle in self.router.handles():
+                self.router.probe(handle)
+            if all(h.ready for h in self.router.handles()):
+                return
+            time.sleep(0.1)
+        not_ready = [h.name for h in self.router.handles() if not h.ready]
+        raise ClusterError(f"replicas never became ready: {not_ready}")
+
+    def close(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=10)
+        self._threads = []
+        self.router_server.shutdown()
+        self.router.close()
+        for replica in self.replicas:
+            replica.stop()
+
+    # -- supervision ---------------------------------------------------------
+    def _monitor_loop(self):
+        while not self._stop.wait(0.25):
+            for i, replica in enumerate(list(self.replicas)):
+                if self._stop.is_set():
+                    return
+                if replica.alive():
+                    self._counted_dead.discard(id(replica))
+                    continue
+                handle = self._handles.get(replica.name)
+                if handle is not None:
+                    handle.mark_down("process_died")
+                if id(replica) not in self._counted_dead:
+                    self._counted_dead.add(id(replica))
+                    telemetry.counter_add("router.replica_deaths", 1,
+                                          replica=replica.name)
+                if self.inprocess:
+                    continue   # tests kill in-proc replicas on purpose
+                if self._restarts[replica.name] >= self.max_restarts:
+                    telemetry.counter_add("router.replica_abandoned", 1,
+                                          replica=replica.name)
+                    continue
+                self._restarts[replica.name] += 1
+                telemetry.counter_add("router.replica_restarts", 1,
+                                      replica=replica.name)
+                sched = retry.RetryPolicy(
+                    max_retries=3, backoff=0.2, deadline=60.0).start()
+                while not self._stop.is_set():
+                    try:
+                        fresh = self._make_replica(i)
+                        fresh.spawn()
+                    except ClusterError:
+                        outcome, delay = sched.note_failure()
+                        if outcome != retry.RETRY:
+                            telemetry.counter_add(
+                                "router.replica_abandoned", 1,
+                                replica=replica.name)
+                            break
+                        time.sleep(delay)
+                        continue
+                    self.replicas[i] = fresh
+                    if handle is not None:
+                        handle.rebind(fresh.url)
+                        self.router.probe(handle)
+                    # a respawn comes up on the NEWEST published version;
+                    # converge it if the fleet is ahead/behind
+                    if self.current_version is not None and \
+                            fresh.version != self.current_version:
+                        newest = _ckpt.ModelWatcher(
+                            self.model_root).latest()
+                        if newest is not None and \
+                                newest[0] == self.current_version:
+                            self._swap_one(fresh, newest[0], newest[1])
+                    break
+
+    # -- rolling model swap --------------------------------------------------
+    def _watch_loop(self):
+        while not self._stop.wait(self.model_poll_s):
+            assert self._watcher is not None
+            newest = self._watcher.poll()
+            if newest is not None:
+                version, path = newest
+                try:
+                    self.roll_to(version, path)
+                except ClusterError as e:
+                    telemetry.counter_add("router.swap_errors", 1,
+                                          version=version,
+                                          reason=type(e).__name__)
+                    print(f"[cluster] rolling swap to v{version} "
+                          f"failed: {e}", file=sys.stderr)
+
+    def _swap_one(self, replica, version: int, path: str) -> bool:
+        """Swap ONE replica (POST /v1/admin/swap), with retries. Returns
+        success; the replica keeps serving its old version on failure."""
+        sched = retry.RetryPolicy(max_retries=2, backoff=0.1,
+                                  deadline=120.0).start()
+        while True:
+            try:
+                code, doc = _http_json(
+                    "POST", replica.url, "/v1/admin/swap",
+                    body=json.dumps({"model_dir": path,
+                                     "version": version}).encode(),
+                    timeout=sched.remaining(default=90.0) or 90.0)
+            except (ConnectionError, OSError) as e:
+                code, doc = -1, {"error": repr(e)}
+            if code == 200:
+                telemetry.counter_add("router.swaps", 1,
+                                      replica=replica.name,
+                                      version=version)
+                replica.version = version
+                return True
+            telemetry.counter_add("router.swap_errors", 1,
+                                  replica=replica.name, version=version,
+                                  status=code)
+            outcome, delay = sched.note_failure()
+            if outcome != retry.RETRY:
+                return False
+            time.sleep(delay)
+
+    def _await_peer_ready(self, name: str, timeout_s: float):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline and not self._stop.is_set():
+            for handle in self.router.handles():
+                if handle.name != name:
+                    self.router.probe(handle)
+            if any(h.ready for h in self.router.handles()
+                   if h.name != name):
+                return
+            time.sleep(0.1)
+
+    def roll_to(self, version: int, path: str):
+        """Rolling zero-downtime swap: one replica at a time — readiness
+        drops while it warms/flips, the router routes around it, and the
+        next replica only starts once this one is ready again."""
+        with self._swap_lock:
+            failed = []
+            for replica in list(self.replicas):
+                if not replica.alive():
+                    continue
+                # never take the LAST ready replica offline: if a death/
+                # respawn window has degraded the fleet, wait for a peer
+                # to be ready before making this one not-ready. (If no
+                # peer recovers, proceed anyway — the router's swapping-
+                # fallback still dispatches to a warming replica, which
+                # serves its OLD version until the flip.)
+                self._await_peer_ready(replica.name, timeout_s=30.0)
+                if not self._swap_one(replica, version, path):
+                    failed.append(replica.name)
+                    continue
+                # wait for readiness to return before touching the next
+                # replica: N-1 ready replicas at all times
+                handle = self._handles.get(replica.name)
+                deadline = time.monotonic() + 60.0
+                while handle is not None and time.monotonic() < deadline:
+                    self.router.probe(handle)
+                    if handle.ready:
+                        break
+                    time.sleep(0.05)
+            self.current_version = version
+            if failed:
+                raise ClusterError(
+                    f"rolling swap to v{version}: replicas {failed} "
+                    f"failed to swap (still serving their old version)")
+
+    # -- introspection -------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        out = self.router.stats()
+        out["current_version"] = self.current_version
+        out["restarts"] = dict(self._restarts)
+        out["replica_backend"] = "inprocess" if self.inprocess \
+            else "process"
+        return out

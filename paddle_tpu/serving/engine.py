@@ -37,6 +37,8 @@ from ..core import faults, telemetry, trace
 from ..core.flags import flag as _flag
 from .admission import (AdmissionQueue, EngineClosedError, InferenceRequest,
                         ServingError)
+from .health import (DRAINING, READY, STOPPED, SWAPPING, HealthState,
+                     ReadyGate)
 
 
 def _pow2_buckets(max_batch: int) -> List[int]:
@@ -98,15 +100,22 @@ class ServingEngine:
     predictor, so the predictor itself needs no locking.
     """
 
-    def __init__(self, predictor, config: Optional[ServingConfig] = None):
+    def __init__(self, predictor, config: Optional[ServingConfig] = None,
+                 version: int = 0):
         self.predictor = predictor
         self.config = config or ServingConfig()
         self.queue = AdmissionQueue(self.config.max_queue_depth,
                                     self.config.default_deadline_ms)
         self._thread: Optional[threading.Thread] = None
         self._infer_lock = threading.Lock()
+        self._swap_lock = threading.Lock()
         self._feed_names = list(predictor.feed_names)
         self._fetch_names = list(predictor.fetch_names)
+        # liveness/readiness state machine (health.py): STARTING until
+        # start() finishes warmup — a router/LB polling /healthz never
+        # routes to a cold replica
+        self.health = HealthState()
+        self.version = int(version)
 
     # -- client surface ------------------------------------------------------
     @property
@@ -162,6 +171,9 @@ class ServingEngine:
         out = {k.split(".", 1)[1]: int(v) for k, v in c.items()
                if k.startswith("serving.") and isinstance(v, (int, float))}
         out["queue_depth"] = self.queue.depth()
+        out["model_version"] = self.version
+        out["status"] = self.health.state
+        out["ready"] = self.health.is_ready()
         hists = telemetry.snapshot()["hists"]
         for key in ("serving.request_ms", "serving.batch_ms"):
             h = hists.get(key)
@@ -199,13 +211,21 @@ class ServingEngine:
                                         name="pt-serving-engine",
                                         daemon=True)
         self._thread.start()
+        self.health.set(READY)
         return self
 
     def warmup(self) -> int:
         """Pre-compile every bucket with zero feeds so the first real
         request never pays a compile. Returns the number of fresh
         compiles (serving.warmup_compiles)."""
-        specs = self.predictor.feed_specs()
+        return self._warm(self.predictor, locked=True)
+
+    def _warm(self, predictor, locked: bool = False) -> int:
+        """Run every bucket through ``predictor`` once. ``locked`` guards
+        runs of the LIVE predictor with the infer lock; a swap candidate
+        is private until the flip, and warming it unlocked keeps the old
+        predictor serving (zero downtime) while the new one compiles."""
+        specs = predictor.feed_specs()
         for n, (shape, _dtype) in specs.items():
             if any(d is None or d < 0 for d in shape[1:]):
                 telemetry.counter_add("serving.warmup_skipped", 1, feed=n)
@@ -215,20 +235,62 @@ class ServingEngine:
             for b in self.config.buckets:
                 feed = {n: np.zeros((b,) + tuple(shape[1:]), dtype=dtype)
                         for n, (shape, dtype) in specs.items()}
-                with self._infer_lock:
-                    self.predictor.run(feed)
+                if locked:
+                    with self._infer_lock:
+                        predictor.run(feed)
+                else:
+                    predictor.run(feed)
         fresh = telemetry.counter_get("predictor.compiles") - before
         if fresh:
             telemetry.counter_add("serving.warmup_compiles", fresh)
         return int(fresh)
 
+    def swap_predictor(self, predictor, version: Optional[int] = None,
+                       warmup: bool = True) -> int:
+        """Zero-downtime model swap: warm every bucket on the NEW
+        predictor while the old one keeps serving, then flip atomically
+        under the infer lock (the in-flight batch completes on the old
+        predictor first — every response is served entirely by one
+        version, never a mix). Readiness is false (SWAPPING) for the
+        duration so a router drains new traffic away from the warming
+        replica. Returns the number of fresh warmup compiles; on any
+        failure the old predictor stays live and readiness is restored.
+        ``replica.swap`` is a fault-injection site (core/faults.py)."""
+        with self._swap_lock:
+            faults.maybe_fail("replica.swap", version=version)
+            # clients feed by NAME and read outputs by the engine's stable
+            # fetch schema, so a swap needs identical feed names and fetch
+            # arity; fresh auto-generated fetch VAR names (a republished
+            # model) are fine — the engine keeps its original output keys
+            if list(predictor.feed_names) != self._feed_names or \
+                    len(predictor.fetch_names) != len(self._fetch_names):
+                raise ValueError(
+                    f"swap candidate signature mismatch: feeds "
+                    f"{list(predictor.feed_names)} / {len(predictor.fetch_names)} "
+                    f"fetches, serving {self._feed_names} / "
+                    f"{len(self._fetch_names)} fetches")
+            with ReadyGate(self.health, SWAPPING), \
+                    telemetry.timer("serving.swap_ms"):
+                fresh = self._warm(predictor, locked=False) if warmup else 0
+                with self._infer_lock:
+                    self.predictor = predictor
+                    if version is not None:
+                        self.version = int(version)
+            telemetry.counter_add("serving.swaps", 1, version=self.version,
+                                  warmup_compiles=fresh)
+            return fresh
+
     def close(self, drain: bool = True, timeout: Optional[float] = None):
         """Stop admission; with drain=True the worker finishes the backlog
-        before exiting, else queued requests fail with EngineClosedError."""
+        before exiting, else queued requests fail with EngineClosedError.
+        Readiness drops to DRAINING immediately (the router stops routing
+        here) and the state ends STOPPED."""
+        self.health.set(DRAINING)
         self.queue.close(drain=drain)
         if self._thread is not None:
             self._thread.join(timeout)
             self._thread = None
+        self.health.set(STOPPED)
 
     # -- engine loop ---------------------------------------------------------
     def _signature(self, req: InferenceRequest):
@@ -281,6 +343,10 @@ class ServingEngine:
             if traced:
                 t_run0 = _time.time()
             with self._infer_lock, telemetry.timer("serving.batch_ms"):
+                # predictor + version read under the lock: a concurrent
+                # swap_predictor flips both atomically, so this batch is
+                # served entirely by ONE model version
+                version = self.version
                 outs = self.predictor.run(feed)
             if traced:
                 t_run1 = _time.time()
@@ -317,6 +383,7 @@ class ServingEngine:
                       else o   # non-per-row fetch: hand it through whole
                       for o in outs]
             offset += req.rows
+            req.served_version = version
             req.resolve(sliced)
             telemetry.observe("serving.request_ms",
                               (now - req.enqueue_t) * 1e3, kind="timer")

@@ -1,0 +1,138 @@
+"""Replica runner — one ServingEngine process of the cluster fleet.
+
+``python -m paddle_tpu.serving.replica --model-root DIR`` (or
+``--model-dir`` for a bare inference-model dir) builds the predictor
+from the newest VERIFIED published model (checkpoint.ModelWatcher),
+binds the PR 4 HTTP server FIRST — so the controller can poll
+``/healthz`` and watch readiness go ``starting`` → ``ok`` as warmup
+finishes — then warms every bucket and serves until told to stop.
+
+The process announces itself on stdout with one machine-readable line::
+
+    PT_REPLICA_READY {"url": ..., "port": ..., "pid": ..., "version": ...}
+
+which is the only contract serving/cluster.py parses (everything after
+it is ordinary logging). Model swaps arrive over ``POST /v1/admin/swap``
+from the controller's rolling-swap driver; ``--poll-s`` > 0 instead arms
+a SELF-watching loop for routerless single-replica deployments.
+SIGTERM/SIGINT drain the queue and exit 0 — the controller's graceful
+stop; anything harder (SIGKILL, the chaos gate's weapon) is exactly the
+crash the router's failover exists for.
+
+Fault injection: the process inherits PT_FAULT_SPEC / PT_FAULT_SEED from
+its environment, so a chaos run arms ``serving.handler`` /
+``replica.swap`` in every replica without code changes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+from typing import Optional
+
+
+def run_replica(args) -> int:
+    from .. import checkpoint as ckpt
+    from ..core import telemetry
+    from ..inference import AnalysisConfig, create_predictor
+    from .engine import ServingConfig, ServingEngine
+    from .server import ServingHTTPServer
+
+    if args.telemetry_log:
+        telemetry.configure(args.telemetry_log)
+
+    version = 0
+    watcher: Optional[ckpt.ModelWatcher] = None
+    if args.model_root:
+        watcher = ckpt.ModelWatcher(args.model_root)
+        newest = watcher.poll()
+        if newest is None:
+            print(f"PT_REPLICA_FAIL no verified published model under "
+                  f"{args.model_root}", flush=True)
+            return 2
+        version, model_dir = newest
+    else:
+        model_dir = args.model_dir
+
+    cfg = ServingConfig(
+        max_batch_size=args.max_batch_size or None,
+        batch_timeout_ms=args.batch_timeout_ms
+        if args.batch_timeout_ms >= 0 else None)
+    engine = ServingEngine(create_predictor(AnalysisConfig(model_dir)),
+                           config=cfg, version=version)
+    server = ServingHTTPServer(engine, host=args.host,
+                               port=args.port).start()
+    # announce BEFORE warmup: the controller learns the port immediately
+    # and watches /healthz flip from "starting" to "ok" when warm
+    print("PT_REPLICA_READY " + json.dumps(
+        {"url": server.url, "port": server.port, "pid": os.getpid(),
+         "version": version, "model_dir": model_dir}), flush=True)
+
+    stop = threading.Event()
+
+    def _graceful(_sig, _frm):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _graceful)
+    signal.signal(signal.SIGINT, _graceful)
+
+    engine.start(warmup=not args.no_warmup)
+
+    try:
+        while not stop.wait(args.poll_s if args.poll_s > 0 else 1.0):
+            if watcher is not None and args.poll_s > 0:
+                # self-watching mode (no controller): swap in place when a
+                # newer verified version lands
+                newest = watcher.poll()
+                if newest is not None:
+                    v, path = newest
+                    try:
+                        pred = create_predictor(AnalysisConfig(path))
+                        engine.swap_predictor(pred, version=v)
+                        print(f"PT_REPLICA_SWAPPED {v}", flush=True)
+                    except Exception as e:
+                        print(f"PT_REPLICA_SWAP_FAIL {v} {e!r}", flush=True)
+    finally:
+        engine.close(drain=True, timeout=30)
+        server.shutdown()
+        telemetry.flush_sink()
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="one serving replica process (cluster.py launches "
+                    "these; standalone use works too)")
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--model-root",
+                     help="published-models root (checkpoint.publish_model "
+                          "layout); serves the newest VERIFIED version")
+    src.add_argument("--model-dir",
+                     help="bare inference-model dir (io.save_inference_"
+                          "model layout), served as version 0")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="0 binds an ephemeral port (announced on stdout)")
+    ap.add_argument("--max-batch-size", type=int, default=0,
+                    help="0 = FLAGS_serving_max_batch_size")
+    ap.add_argument("--batch-timeout-ms", type=float, default=-1.0,
+                    help="< 0 = FLAGS_serving_batch_timeout_ms")
+    ap.add_argument("--no-warmup", action="store_true")
+    ap.add_argument("--poll-s", type=float, default=0.0,
+                    help="> 0 arms SELF-watching of --model-root for new "
+                         "versions (routerless mode); the cluster "
+                         "controller leaves this 0 and drives swaps over "
+                         "/v1/admin/swap")
+    ap.add_argument("--telemetry-log", default="",
+                    help="JSONL run log for this replica (one file per "
+                         "process; tools/trace_view.py merges them)")
+    args = ap.parse_args(argv)
+    return run_replica(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

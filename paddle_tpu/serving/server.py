@@ -16,7 +16,21 @@ API:
              503 EngineClosedError (draining / shut down)
              504 DeadlineExceededError
              500 handler failure (per-request, queue keeps serving)
-    GET  /healthz    {"status": "ok", "queue_depth": n}
+    GET  /healthz    READINESS (health.py state machine): 200
+                     {"status": "ok", ...} only when the replica can
+                     serve NOW; 503 {"status": "starting"} during
+                     warmup, "swapping" during a model swap,
+                     "draining"/"stopped" during/after close — a router
+                     or external LB polling it never routes to a cold or
+                     dying replica
+    GET  /livez      LIVENESS: 200 while the process/engine can still
+                     make progress (any state but stopped), else 503
+    POST /v1/admin/swap {"model_dir": path, "version": int?}
+                     zero-downtime model swap: verify the dir's COMMIT
+                     manifest when present (PR 5 protocol), build + warm
+                     the new predictor on every bucket, atomically flip
+                     (engine.swap_predictor) — old version serves until
+                     the flip
     GET  /v1/stats   serving.* counters + request/batch latency
                      percentiles + rolling-window rates (engine.stats())
     GET  /metrics    Prometheus text exposition of the live registry —
@@ -38,6 +52,7 @@ in-process twin the tier-1 tests and bench harness use (no sockets).
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -97,8 +112,15 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         engine: ServingEngine = self.server.engine
         if self.path == "/healthz":
-            self._reply(200, {"status": "ok",
-                              "queue_depth": engine.queue.depth()})
+            # READINESS: 200 iff this replica should receive traffic NOW
+            snap = engine.health.snapshot(
+                queue_depth=engine.queue.depth(),
+                model_version=engine.version)
+            self._reply(200 if snap["ready"] else 503, snap)
+        elif self.path == "/livez":
+            alive = engine.health.is_alive()
+            self._reply(200 if alive else 503,
+                        {"status": "alive" if alive else "stopped"})
         elif self.path == "/v1/stats":
             self._reply(200, engine.stats())
         elif self.path == "/metrics":
@@ -112,8 +134,39 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._reply(404, {"error": f"no route {self.path}"})
 
+    def _handle_swap(self, engine: ServingEngine):
+        """POST /v1/admin/swap — the replica side of the cluster's
+        zero-downtime rolling swap (serving/cluster.py drives it)."""
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            doc = json.loads(self.rfile.read(length) or b"{}")
+            model_dir = doc["model_dir"]
+        except (ValueError, TypeError, KeyError) as e:
+            self._reply(400, {"error": f"bad swap request: {e!r}"})
+            return
+        try:
+            from .. import checkpoint as _ckpt
+            from ..inference import AnalysisConfig, create_predictor
+
+            version = doc.get("version")
+            if os.path.exists(os.path.join(model_dir, _ckpt.MANIFEST_NAME)):
+                manifest = _ckpt.verify_model_dir(model_dir)
+                if version is None:
+                    version = manifest.get("version")
+            predictor = create_predictor(AnalysisConfig(model_dir))
+            fresh = engine.swap_predictor(predictor, version=version)
+        except Exception as e:   # verify/build/warm/injected failure:
+            # the old predictor is still live — report, don't die
+            self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        self._reply(200, {"status": "ok", "model_version": engine.version,
+                          "warmup_compiles": fresh})
+
     def do_POST(self):
         engine: ServingEngine = self.server.engine
+        if self.path == "/v1/admin/swap":
+            self._handle_swap(engine)
+            return
         if self.path != "/v1/infer":
             self._reply(404, {"error": f"no route {self.path}"})
             return
@@ -133,9 +186,12 @@ class _Handler(BaseHTTPRequestHandler):
         t0 = time.perf_counter()
         with trace.root_span("serving.http_request", trace_id=rid,
                              force=bool(rid), path=self.path) as tctx:
+            served_version = None
             try:
-                outs = engine.infer(feeds,
+                req = engine.submit(feeds,
                                     deadline_ms=doc.get("deadline_ms"))
+                outs = req.result()
+                served_version = req.served_version
             except ValueError as e:      # missing/ragged inputs
                 code, payload = 400, {"error": str(e)}
             except ServerOverloadedError as e:
@@ -152,6 +208,7 @@ class _Handler(BaseHTTPRequestHandler):
                 payload = {
                     "outputs": {n: np.asarray(o).tolist()
                                 for n, o in zip(engine.fetch_names, outs)},
+                    "model_version": served_version,
                     "latency_ms": round(
                         (time.perf_counter() - t0) * 1e3, 3)}
         if code == 200 or tctx is not None:

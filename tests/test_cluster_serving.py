@@ -1,0 +1,585 @@
+"""Cluster serving control plane tests (paddle_tpu/serving/router.py,
+cluster.py, health.py + core/retry.py).
+
+Contracts under test:
+* core/retry.py reproduces the PS transport's schedule semantics
+  (deadline beats budget, exponential+jittered backoff, capped delays) —
+  the rpc.py rebase itself is pinned by tests/test_fault_tolerance.py;
+* /healthz is READINESS (503 while starting/draining), /livez liveness;
+* the router balances by live queue-depth score, skips not-ready
+  replicas, and falls back to a SWAPPING replica only when nothing is
+  READY;
+* models publish atomically with COMMIT manifests; the watcher only
+  reports verified versions and falls back past corrupt ones;
+* a replica death mid-load loses ZERO accepted requests — retried on a
+  survivor, exactly once per request id (process-mode SIGKILL included);
+* a hot swap under load returns only committed-version results: every
+  response is bitwise one version's output, tagged with that version,
+  and the fleet converges to the new version with zero failures;
+* deadlines hold across a failover hop, including the all-replicas-down
+  case (bounded 503, not a hang).
+"""
+
+import json
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.serving
+
+IN_DIM, OUT_DIM = 6, 4
+
+
+def _save_mlp(dirname, seed):
+    import paddle_tpu as pt
+    from paddle_tpu import io, layers
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [IN_DIM])
+        h = layers.fc(x, 8, act="relu", param_attr=pt.ParamAttr(
+            name="cs_w0", initializer=pt.initializer.Xavier(seed=seed)))
+        y = layers.fc(h, OUT_DIM, param_attr=pt.ParamAttr(
+            name="cs_w1", initializer=pt.initializer.Xavier(seed=seed + 1)))
+    scope = pt.Scope()
+    exe = pt.Executor()
+    exe.run(startup, scope=scope, use_compiled=False)
+    io.save_inference_model(str(dirname), ["x"], [y],
+                            main_program=main, scope=scope)
+    return str(dirname)
+
+
+def _predictor(model_dir):
+    from paddle_tpu.inference import AnalysisConfig, create_predictor
+
+    return create_predictor(AnalysisConfig(model_dir))
+
+
+def _rows(n, seed=0):
+    return np.random.RandomState(seed).randn(n, IN_DIM).astype(np.float32)
+
+
+def _post_infer(url, x, rid=None, deadline_ms=None, timeout=60):
+    doc = {"inputs": {"x": x.tolist()}}
+    if deadline_ms is not None:
+        doc["deadline_ms"] = deadline_ms
+    headers = {"Content-Type": "application/json"}
+    if rid is not None:
+        headers["X-Request-Id"] = rid
+    req = urllib.request.Request(url + "/v1/infer",
+                                 data=json.dumps(doc).encode(),
+                                 headers=headers)
+    try:
+        resp = urllib.request.urlopen(req, timeout=timeout)
+        return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(url, path, timeout=10):
+    try:
+        resp = urllib.request.urlopen(url + path, timeout=timeout)
+        return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+# ---------------------------------------------------------------------------
+# core/retry.py — the extracted schedule
+# ---------------------------------------------------------------------------
+
+class TestRetrySchedule:
+    def test_backoff_doubles_and_caps(self):
+        from paddle_tpu.core import retry
+
+        sched = retry.RetryPolicy(max_retries=5, backoff=0.1, jitter=0.0,
+                                  max_delay=0.4).start()
+        delays = []
+        for _ in range(5):
+            outcome, delay = sched.note_failure()
+            assert outcome == retry.RETRY
+            delays.append(round(delay, 6))
+        assert delays == [0.1, 0.2, 0.4, 0.4, 0.4]
+        outcome, _ = sched.note_failure()
+        assert outcome == retry.EXHAUSTED
+        assert sched.attempt == 6
+
+    def test_deadline_beats_remaining_budget(self):
+        from paddle_tpu.core import retry
+
+        sched = retry.RetryPolicy(max_retries=100, backoff=0.001,
+                                  deadline=0.02).start()
+        time.sleep(0.03)
+        outcome, _ = sched.note_failure()
+        assert outcome == retry.DEADLINE
+        assert sched.expired()
+
+    def test_delay_clipped_to_deadline(self):
+        from paddle_tpu.core import retry
+
+        sched = retry.RetryPolicy(max_retries=10, backoff=10.0, jitter=0.0,
+                                  max_delay=10.0, deadline=0.2).start()
+        outcome, delay = sched.note_failure()
+        assert outcome == retry.RETRY
+        assert delay <= 0.2
+
+    def test_jitter_bounds(self):
+        from paddle_tpu.core import retry
+
+        policy = retry.RetryPolicy(max_retries=1, backoff=1.0, jitter=0.5,
+                                   max_delay=10.0)
+        for seed in range(20):
+            sched = policy.start(rng=random.Random(seed))
+            _, delay = sched.note_failure()
+            assert 0.5 <= delay < 1.5
+
+    def test_remaining_default_without_deadline(self):
+        from paddle_tpu.core import retry
+
+        sched = retry.RetryPolicy(deadline=None).start()
+        assert sched.remaining(default=7.5) == 7.5
+        assert sched.remaining() is None
+        bounded = retry.RetryPolicy(deadline=5.0).start()
+        assert 0 < bounded.remaining() <= 5.0
+
+
+# ---------------------------------------------------------------------------
+# /healthz readiness vs /livez liveness
+# ---------------------------------------------------------------------------
+
+class TestHealthEndpoints:
+    def test_readiness_lifecycle(self, tmp_path):
+        from paddle_tpu.serving import ServingConfig, ServingEngine
+        from paddle_tpu.serving.server import ServingHTTPServer
+
+        model_dir = _save_mlp(tmp_path / "m", seed=3)
+        engine = ServingEngine(_predictor(model_dir),
+                               config=ServingConfig(max_batch_size=4,
+                                                    batch_timeout_ms=2.0))
+        server = ServingHTTPServer(engine).start()
+        try:
+            code, doc = _get(server.url, "/healthz")
+            assert (code, doc["status"]) == (503, "starting")
+            assert doc["ready"] is False and doc["alive"] is True
+            assert _get(server.url, "/livez")[0] == 200
+
+            engine.start(warmup=True)
+            code, doc = _get(server.url, "/healthz")
+            assert (code, doc["status"]) == (200, "ok")
+            assert doc["ready"] is True
+
+            engine.close(drain=True, timeout=10)
+            code, doc = _get(server.url, "/healthz")
+            assert code == 503
+            assert doc["status"] in ("draining", "stopped")
+            code, doc = _get(server.url, "/livez")
+            assert (code, doc["status"]) == (503, "stopped")
+        finally:
+            server.shutdown()
+
+    def test_swap_gate_restores_ready_only_from_ready(self):
+        from paddle_tpu.serving.health import (DRAINING, READY, SWAPPING,
+                                               HealthState, ReadyGate)
+
+        h = HealthState(READY)
+        with ReadyGate(h, SWAPPING):
+            assert h.state == SWAPPING
+        assert h.state == READY
+        h.set(DRAINING)
+        with ReadyGate(h, SWAPPING):
+            pass
+        assert h.state == DRAINING   # a failed swap must not resurrect
+
+
+# ---------------------------------------------------------------------------
+# router balancing (stubbed handles, no sockets)
+# ---------------------------------------------------------------------------
+
+class TestRouterPick:
+    def _router_with(self, states):
+        """states: list of (ready, queue_depth[, status])"""
+        from paddle_tpu.serving.router import ReplicaHandle, Router
+
+        router = Router()
+        for i, st in enumerate(states):
+            handle = ReplicaHandle(f"r{i}", f"http://127.0.0.1:{40000 + i}")
+            handle.ready = st[0]
+            handle.queue_depth = st[1]
+            if len(st) > 2:
+                handle.status = st[2]
+            router._handles.append(handle)
+        return router
+
+    def test_picks_lowest_queue_depth(self):
+        router = self._router_with([(True, 5), (True, 1), (True, 9)])
+        for _ in range(6):
+            assert router.pick().name == "r1"
+
+    def test_skips_not_ready(self):
+        router = self._router_with([(False, 0), (True, 7)])
+        assert router.pick().name == "r1"
+
+    def test_inflight_counts_toward_score(self):
+        router = self._router_with([(True, 2), (True, 2)])
+        router._handles[0].inflight = 5
+        assert router.pick().name == "r1"
+
+    def test_round_robins_ties(self):
+        router = self._router_with([(True, 0), (True, 0), (True, 0)])
+        picks = {router.pick().name for _ in range(9)}
+        assert picks == {"r0", "r1", "r2"}, \
+            "an idle fleet must share work, not hammer one replica"
+
+    def test_swapping_fallback_only_when_nothing_ready(self):
+        router = self._router_with([(False, 0, "swapping"), (True, 50)])
+        assert router.pick().name == "r1"   # READY beats swapping
+        router = self._router_with([(False, 0, "swapping"),
+                                    (False, 0, "down")])
+        assert router.pick().name == "r0"   # swapping still serves
+        router = self._router_with([(False, 0, "down"), (False, 0, "down")])
+        assert router.pick() is None
+
+    def test_exclude_honored(self):
+        router = self._router_with([(True, 0), (True, 5)])
+        first = router.pick()
+        other = router.pick(exclude={first})
+        assert other is not None and other is not first
+
+
+# ---------------------------------------------------------------------------
+# model publishing + watching
+# ---------------------------------------------------------------------------
+
+class TestModelPublishing:
+    def test_publish_verify_watch(self, tmp_path):
+        from paddle_tpu import checkpoint as ckpt
+
+        src = _save_mlp(tmp_path / "src", seed=5)
+        root = str(tmp_path / "models")
+        p1 = ckpt.publish_model(root, src)
+        manifest = ckpt.verify_model_dir(p1)
+        assert manifest["version"] == 1 and manifest["committed"]
+        assert "__model__.json" in manifest["files"]
+
+        watcher = ckpt.ModelWatcher(root)
+        assert watcher.poll() == (1, p1)
+        assert watcher.poll() is None       # fires once per version
+        p2 = ckpt.publish_model(root, src)
+        assert watcher.poll() == (2, p2)
+
+    def test_corrupt_version_is_skipped(self, tmp_path):
+        import os
+
+        from paddle_tpu import checkpoint as ckpt
+
+        src = _save_mlp(tmp_path / "src", seed=6)
+        root = str(tmp_path / "models")
+        p1 = ckpt.publish_model(root, src)
+        p2 = ckpt.publish_model(root, src)
+        # corrupt v2's params: the watcher must fall back to v1
+        victim = [n for n in os.listdir(p2) if n.endswith(".npy")][0]
+        with open(os.path.join(p2, victim), "ab") as f:
+            f.write(b"rot")
+        with pytest.raises(ckpt.CheckpointCorruptError):
+            ckpt.verify_model_dir(p2)
+        assert ckpt.ModelWatcher(root).latest() == (1, p1)
+
+    def test_uncommitted_dir_is_invisible(self, tmp_path):
+        import os
+
+        from paddle_tpu import checkpoint as ckpt
+
+        src = _save_mlp(tmp_path / "src", seed=7)
+        root = str(tmp_path / "models")
+        ckpt.publish_model(root, src)
+        # a torn publish: files but no manifest under a committed-style name
+        torn = os.path.join(root, "model-000009")
+        os.makedirs(torn)
+        with open(os.path.join(torn, "__model__.json"), "w") as f:
+            f.write("{}")
+        assert ckpt.ModelWatcher(root).latest()[0] == 1
+        with pytest.raises(ckpt.CheckpointCorruptError,
+                           match="never committed"):
+            ckpt.verify_model_dir(torn)
+
+    def test_versions_are_immutable(self, tmp_path):
+        from paddle_tpu import checkpoint as ckpt
+
+        src = _save_mlp(tmp_path / "src", seed=8)
+        root = str(tmp_path / "models")
+        ckpt.publish_model(root, src, version=3)
+        with pytest.raises(ckpt.CheckpointError, match="immutable"):
+            ckpt.publish_model(root, src, version=3)
+
+
+# ---------------------------------------------------------------------------
+# in-process cluster: balance, failover, dedup, deadlines
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="class")
+def inproc_cluster(tmp_path_factory):
+    from paddle_tpu import checkpoint as ckpt
+    from paddle_tpu.serving import ClusterController, ServingConfig
+
+    tmp = tmp_path_factory.mktemp("cluster")
+    model_dir = _save_mlp(tmp / "m1", seed=11)
+    root = str(tmp / "models")
+    ckpt.publish_model(root, model_dir, version=1)
+    cluster = ClusterController(
+        root, replicas=2, inprocess=True,
+        serving_config=ServingConfig(max_batch_size=4,
+                                     batch_timeout_ms=1.0),
+        auto_swap=False).start(ready_timeout_s=120)
+    yield cluster, model_dir
+    cluster.close()
+
+
+class TestInprocCluster:
+    def test_routes_and_balances(self, inproc_cluster):
+        cluster, model_dir = inproc_cluster
+        reference = _predictor(model_dir)
+        x = _rows(2, seed=1)
+        want, = reference.run({"x": x})
+        replicas_hit = set()
+        for _ in range(12):
+            code, doc = _post_infer(cluster.url, x)
+            assert code == 200, doc
+            name = next(iter(doc["outputs"]))
+            got = np.asarray(doc["outputs"][name], dtype=np.float32)
+            np.testing.assert_array_equal(got, want)
+            assert doc["model_version"] == 1
+            replicas_hit.add(doc["replica"])
+        assert replicas_hit == {"replica-0", "replica-1"}, \
+            "idle fleet must round-robin"
+
+    def test_request_id_dedup_replays(self, inproc_cluster):
+        from paddle_tpu.core import telemetry
+
+        cluster, _ = inproc_cluster
+        x = _rows(1, seed=2)
+        before_req = telemetry.counter_get("serving.requests")
+        code1, doc1 = _post_infer(cluster.url, x, rid="dedup-me")
+        code2, doc2 = _post_infer(cluster.url, x, rid="dedup-me")
+        assert code1 == code2 == 200
+        assert doc2.get("deduped") is True
+        assert doc1["outputs"] == doc2["outputs"]
+        # exactly ONE backend inference for the two client attempts
+        assert telemetry.counter_get("serving.requests") - before_req == 1
+
+    def test_failover_loses_nothing(self, inproc_cluster):
+        from paddle_tpu.core import telemetry
+
+        cluster, _ = inproc_cluster
+        x = _rows(1, seed=3)
+        results = {}
+        lock = threading.Lock()
+
+        def worker(wid):
+            for i in range(25):
+                rid = f"fo-{wid}-{i}"
+                code, doc = _post_infer(cluster.url, x, rid=rid)
+                with lock:
+                    results[rid] = (code, doc.get("request_id"))
+
+        threads = [threading.Thread(target=worker, args=(w,))
+                   for w in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)
+        cluster.replicas[0].kill()   # abrupt: socket torn, backlog failed
+        for t in threads:
+            t.join(60)
+        assert len(results) == 100
+        bad = {k: v for k, v in results.items() if v[0] != 200}
+        assert not bad, f"lost requests across replica death: {bad}"
+        # response ids round-trip, so exactly-once is id-verifiable
+        assert all(v[1] == k for k, v in results.items())
+        assert telemetry.counter_get("router.replica_deaths") >= 1
+        # the dead replica is out of rotation; traffic still flows
+        code, doc = _post_infer(cluster.url, x)
+        assert code == 200 and doc["replica"] == "replica-1"
+
+    def test_deadline_bounded_when_all_replicas_down(self, inproc_cluster):
+        cluster, _ = inproc_cluster
+        cluster.replicas[1].kill()   # [0] already dead from the test above
+        t0 = time.monotonic()
+        code, doc = _post_infer(cluster.url, _rows(1), deadline_ms=1500)
+        waited = time.monotonic() - t0
+        assert code in (503, 504), doc
+        assert waited < 10.0, "dead fleet must answer within the deadline" \
+            f" window, waited {waited:.1f}s"
+
+
+# ---------------------------------------------------------------------------
+# hot swap under load (its own cluster: the one above gets killed)
+# ---------------------------------------------------------------------------
+
+class TestHotSwapUnderLoad:
+    def test_only_committed_version_results(self, tmp_path):
+        from paddle_tpu import checkpoint as ckpt
+        from paddle_tpu.serving import ClusterController, ServingConfig
+
+        m1 = _save_mlp(tmp_path / "m1", seed=21)
+        m2 = _save_mlp(tmp_path / "m2", seed=77)
+        root = str(tmp_path / "models")
+        ckpt.publish_model(root, m1, version=1)
+        x = _rows(2, seed=9)
+        want = {1: _predictor(m1).run({"x": x})[0],
+                2: _predictor(m2).run({"x": x})[0]}
+        assert not np.array_equal(want[1], want[2])
+
+        cluster = ClusterController(
+            root, replicas=2, inprocess=True,
+            serving_config=ServingConfig(max_batch_size=4,
+                                         batch_timeout_ms=1.0),
+            model_poll_s=0.1).start(ready_timeout_s=120)
+        stop = threading.Event()
+        records = []
+        lock = threading.Lock()
+
+        def load():
+            while not stop.is_set():
+                code, doc = _post_infer(cluster.url, x)
+                name = next(iter(doc["outputs"])) if code == 200 else None
+                with lock:
+                    records.append(
+                        (code, doc.get("model_version"),
+                         np.asarray(doc["outputs"][name],
+                                    dtype=np.float32)
+                         if code == 200 else None))
+
+        threads = [threading.Thread(target=load) for _ in range(3)]
+        try:
+            for t in threads:
+                t.start()
+            time.sleep(0.2)
+            ckpt.publish_model(root, m2, version=2)   # triggers the roll
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                with lock:
+                    seen_v2 = any(r[1] == 2 for r in records)
+                if seen_v2 and cluster.current_version == 2:
+                    break
+                time.sleep(0.1)
+            time.sleep(0.3)   # a little post-swap traffic
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(30)
+            cluster.close()
+
+        assert records, "no traffic recorded"
+        failures = [r for r in records if r[0] != 200]
+        assert not failures, \
+            f"hot swap dropped {len(failures)} requests: {failures[:3]}"
+        versions = {r[1] for r in records}
+        assert versions <= {1, 2}
+        assert 2 in versions, "fleet never served the new version"
+        for _code, version, out in records:
+            # every response is BITWISE one committed version's output,
+            # tagged with that version — never a mixed/cold response
+            assert np.array_equal(out, want[version]), \
+                "response does not match its tagged model version"
+
+
+# ---------------------------------------------------------------------------
+# process-mode: the real SIGKILL
+# ---------------------------------------------------------------------------
+
+class TestProcessClusterKill:
+    def test_sigkill_mid_load_exactly_once(self, tmp_path):
+        from paddle_tpu import checkpoint as ckpt
+        from paddle_tpu.core import telemetry
+        from paddle_tpu.serving import ClusterController, ServingConfig
+
+        model_dir = _save_mlp(tmp_path / "m1", seed=31)
+        root = str(tmp_path / "models")
+        ckpt.publish_model(root, model_dir, version=1)
+        cluster = ClusterController(
+            root, replicas=2, inprocess=False,
+            serving_config=ServingConfig(max_batch_size=4,
+                                         batch_timeout_ms=1.0),
+            auto_swap=False).start(ready_timeout_s=180)
+        x = _rows(1, seed=4)
+        results = {}
+        lock = threading.Lock()
+
+        def worker(wid):
+            for i in range(50):
+                rid = f"pk-{wid}-{i}"
+                code, doc = _post_infer(cluster.url, x, rid=rid)
+                with lock:
+                    results[rid] = results.get(rid, 0) + (
+                        1 if code == 200 else 0)
+
+        try:
+            threads = [threading.Thread(target=worker, args=(w,))
+                       for w in range(4)]
+            for t in threads:
+                t.start()
+            time.sleep(0.25)
+            victim = cluster.replicas[0]
+            victim.kill()            # the real SIGKILL, mid-load
+            for t in threads:
+                t.join(120)
+            assert victim.proc.poll() is not None
+            assert len(results) == 200
+            lost = {k: v for k, v in results.items() if v != 1}
+            assert not lost, \
+                f"SIGKILL lost/duplicated requests: {list(lost)[:5]}"
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and \
+                    not telemetry.counter_get("router.replica_deaths"):
+                time.sleep(0.2)
+            assert telemetry.counter_get("router.replica_deaths") >= 1
+        finally:
+            cluster.close()
+
+
+# ---------------------------------------------------------------------------
+# stats surfaces
+# ---------------------------------------------------------------------------
+
+class TestStatsSurfaces:
+    def test_engine_stats_carry_version_and_state(self, tmp_path):
+        from paddle_tpu.serving import ServingConfig, ServingEngine
+
+        model_dir = _save_mlp(tmp_path / "m", seed=41)
+        engine = ServingEngine(_predictor(model_dir),
+                               config=ServingConfig(max_batch_size=4,
+                                                    batch_timeout_ms=1.0),
+                               version=7)
+        stats = engine.stats()
+        assert stats["model_version"] == 7
+        assert stats["status"] == "starting" and stats["ready"] is False
+        engine.start(warmup=False)
+        assert engine.stats()["ready"] is True
+        engine.close(drain=True, timeout=10)
+        assert engine.stats()["status"] == "stopped"
+
+    def test_router_stats_and_perf_report_section(self):
+        from paddle_tpu.core import telemetry
+        from paddle_tpu.serving.router import Router
+
+        telemetry.counter_add("router.requests", 0)
+        router = Router()
+        stats = router.stats()
+        assert "replicas" in stats and stats["ready"] is False
+
+        import importlib.util
+        import os
+        spec = importlib.util.spec_from_file_location(
+            "perf_report", os.path.join(os.path.dirname(__file__), "..",
+                                        "tools", "perf_report.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        summary = mod._router_summary(
+            {"router.requests": 10, "router.retries": 2,
+             "router.failovers": 1, "router.swaps": 1}, {}, {})
+        assert summary["requests"] == 10 and summary["failovers"] == 1
+        assert mod._router_summary({}, {}, {}) is None

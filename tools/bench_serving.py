@@ -24,10 +24,18 @@ Modes:
                       non-blocking ``submit``; measures latency under a
                       fixed arrival rate and counts admission rejects.
 
+With ``--replicas N`` the closed loop instead drives the CLUSTER control
+plane (paddle_tpu/serving/cluster.py): N in-process replicas behind the
+health-checked router, clients POSTing over real HTTP through the
+router's front end. ``--kill-one`` SIGKILL-equivalently downs a replica
+mid-load, so the row measures failover cost; the BENCH extra records
+replicas, failover_count, retries and the router-observed p99.
+
 Examples:
     python tools/bench_serving.py                     # full closed-loop
     python tools/bench_serving.py --smoke             # seconds, CI row
     python tools/bench_serving.py --mode open --target-qps 200
+    python tools/bench_serving.py --replicas 2 --kill-one
 """
 
 from __future__ import annotations
@@ -277,6 +285,81 @@ def bench_open(args, make_batch, model_dir):
     }
 
 
+def bench_cluster(args, make_batch, model_dir):
+    """--replicas N closed loop through the cluster control plane."""
+    import json as _json
+    import tempfile
+    import urllib.request
+
+    from paddle_tpu import checkpoint as ckpt
+    from paddle_tpu.core import telemetry
+    from paddle_tpu.serving import ClusterController, ServingConfig
+
+    batch = make_batch(args.rows)
+    body = _json.dumps({"inputs": {"img": batch.tolist()}}).encode()
+
+    with tempfile.TemporaryDirectory(prefix="pt_cluster_bench_") as tmp:
+        root = tmp + "/models"
+        ckpt.publish_model(root, model_dir, version=1)
+        cluster = ClusterController(
+            root, replicas=args.replicas, inprocess=True,
+            serving_config=ServingConfig(
+                max_batch_size=args.max_batch_size,
+                batch_timeout_ms=args.batch_timeout_ms),
+            auto_swap=False).start(ready_timeout_s=120)
+
+        def call():
+            req = urllib.request.Request(
+                cluster.url + "/v1/infer", data=body,
+                headers={"Content-Type": "application/json"})
+            resp = urllib.request.urlopen(req, timeout=60)
+            resp.read()
+            if resp.status != 200:
+                raise RuntimeError(f"router returned {resp.status}")
+
+        killer = None
+        if args.kill_one:
+            def kill_later():
+                time.sleep(0.3)
+                cluster.replicas[0].kill()
+            killer = threading.Thread(target=kill_later, daemon=True)
+            killer.start()
+        try:
+            wall, lat, errors = _run_clients(
+                args.concurrency, args.requests, call)
+        finally:
+            if killer is not None:
+                killer.join(timeout=5)
+            cluster.close()
+        if errors:
+            raise SystemExit(f"cluster errors: {errors[:3]}")
+
+    c = telemetry.counters()
+    qps = args.requests / wall
+    return {
+        "metric": "serving_cluster_qps_lenet",
+        "value": round(qps, 2),
+        "unit": "req/s",
+        "vs_baseline": None,
+        "extra": {
+            "mode": "cluster_closed",
+            "replicas": args.replicas,
+            "killed_one": bool(args.kill_one),
+            "requests": args.requests,
+            "concurrency": args.concurrency,
+            "p50_ms": round(_pct(lat, 0.50), 3),
+            "p99_ms": round(_pct(lat, 0.99), 3),
+            "failover_count": int(c.get("router.failovers", 0)),
+            "router_retries": int(c.get("router.retries", 0)),
+            "router_rejects": int(c.get("router.rejects", 0)),
+            "replica_deaths": int(c.get("router.replica_deaths", 0)),
+            "dedup_hits": int(c.get("router.dedup_hits", 0)),
+            "engine_requests": int(c.get("serving.requests", 0)),
+            "batches": int(c.get("serving.batches", 0)),
+        },
+    }
+
+
 def main():
     ap = argparse.ArgumentParser(
         description="serving-engine load generator (LeNet)")
@@ -289,6 +372,13 @@ def main():
     ap.add_argument("--batch-timeout-ms", type=float, default=2.0)
     ap.add_argument("--target-qps", type=float, default=200.0,
                     help="open-loop arrival rate")
+    ap.add_argument("--replicas", type=int, default=0,
+                    help="> 0 benches the CLUSTER control plane: this "
+                         "many in-process replicas behind the router "
+                         "(closed loop over real HTTP)")
+    ap.add_argument("--kill-one", action="store_true",
+                    help="with --replicas: down one replica mid-load so "
+                         "the row measures failover cost")
     ap.add_argument("--model-dir", default="",
                     help="saved inference model (default: build LeNet "
                          "into a temp dir)")
@@ -323,7 +413,10 @@ def main():
         else:
             model_dir = os.path.join(tmp, "lenet")
             make_batch = build_lenet_model(model_dir)
-        fn = bench_closed if args.mode == "closed" else bench_open
+        if args.replicas > 0:
+            fn = bench_cluster
+        else:
+            fn = bench_closed if args.mode == "closed" else bench_open
         out = fn(args, make_batch, model_dir)
 
     from tools.bench_models import finalize_bench_result

@@ -27,6 +27,18 @@ the trainer scope is discarded — and a fresh scope restores and keeps
 training. Asserts convergence across the kill/restart and prints the
 ckpt.saves / verify_failures / fallbacks / quarantined tally.
 
+With ``--cluster`` it chaos-tests the whole serving control plane
+(paddle_tpu/serving/cluster.py): N real replica processes behind the
+router, concurrent closed-loop clients with unique request ids, the
+fault spec armed BOTH router-side (``router.dispatch``) and inside
+every replica (``serving.handler``, via PT_FAULT_SPEC in the replica
+env) — then one replica is SIGKILLed mid-load and a new model version
+is published mid-load, driving a rolling hot swap while traffic flows.
+The gate asserts: every accepted request got EXACTLY one successful
+response (dedup-verified by request id), p99 stays under --p99-bound,
+the swap completed (responses carry the new version), and the fault /
+failover / swap telemetry tally is printed.
+
 Examples:
     python tools/chaos_check.py --fault-spec "ps.rpc.send:0.1" --seed 7
     python tools/chaos_check.py --fault-spec "ps.rpc.recv:%9" --steps 8 \
@@ -35,6 +47,8 @@ Examples:
         --fault-spec "serving.handler:%3" --requests 24
     python tools/chaos_check.py --checkpoint \
         --fault-spec "ckpt.save.commit:%3,ckpt.restore.read:@1" --steps 8
+    python tools/chaos_check.py --cluster --replicas 2 --requests 400 \
+        --fault-spec "router.dispatch:0.02,serving.handler:%7"
 
 Exit status: 0 on success, 2 when the run failed or did not converge.
 Stdlib-only CLI surface (argparse); everything heavier lives in
@@ -364,6 +378,208 @@ def run_checkpoint(args) -> int:
     return 0
 
 
+def run_cluster(args) -> int:
+    """--cluster mode: the full control-plane gate. Replica processes
+    behind the router, faults armed on both sides of the hop, one
+    replica SIGKILLed mid-load, one model version published mid-load
+    (rolling hot swap) — and still: every accepted request answered
+    exactly once, p99 bounded."""
+    import json
+    import tempfile
+    import threading
+    import time
+    import urllib.error
+    import urllib.request
+
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import checkpoint as ckpt
+    from paddle_tpu import io, layers
+    from paddle_tpu.core import faults, telemetry
+    from paddle_tpu.serving import ClusterController, ServingConfig
+
+    if args.telemetry_log:
+        telemetry.configure(args.telemetry_log)
+    if args.trace_sample:
+        pt.set_flags({"FLAGS_trace_sample_rate": args.trace_sample})
+    spec = args.fault_spec or "router.dispatch:0.02,serving.handler:%7"
+    # the SAME spec arms both sides of the hop: router.dispatch fires in
+    # THIS process (the router), serving.handler inside every replica
+    # (PT_FAULT_SPEC in the replica env — each site only exists where its
+    # code runs, so one spec string covers the fleet)
+    faults.configure(spec, seed=args.seed)
+    replica_env = dict(os.environ)
+    replica_env["PT_FAULT_SPEC"] = spec
+    replica_env["PT_FAULT_SEED"] = str(args.seed)
+
+    def save_mlp(d, seed):
+        main_p, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main_p, startup):
+            x = layers.data("x", [16])
+            h = layers.fc(x, 16, act="relu", param_attr=pt.ParamAttr(
+                name="ch_w0", initializer=pt.initializer.Xavier(seed=seed)))
+            y = layers.fc(h, 4, param_attr=pt.ParamAttr(
+                name="ch_w1",
+                initializer=pt.initializer.Xavier(seed=seed + 1)))
+        scope = pt.Scope()
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        io.save_inference_model(d, ["x"], [y], main_program=main_p,
+                                scope=scope)
+
+    n_requests = args.requests
+    workers = 4
+    results: dict = {}
+    latencies: list = []
+    versions_seen: set = set()
+    lock = threading.Lock()
+    xbatch = np.random.RandomState(7).randn(1, 16).astype(np.float32)
+
+    with tempfile.TemporaryDirectory(prefix="pt_chaos_cluster_") as tmp:
+        save_mlp(tmp + "/m1", 11)
+        save_mlp(tmp + "/m2", 53)
+        root = tmp + "/models"
+        ckpt.publish_model(root, tmp + "/m1", version=1)
+        cluster = ClusterController(
+            root, replicas=args.replicas, inprocess=False,
+            serving_config=ServingConfig(max_batch_size=4,
+                                         batch_timeout_ms=1.0),
+            model_poll_s=0.25,
+            replica_env=replica_env).start(ready_timeout_s=180)
+        print(f"cluster up: {args.replicas} replica processes behind "
+              f"{cluster.url}, fault spec '{spec}'", flush=True)
+
+        def worker(wid, count):
+            for i in range(count):
+                rid = f"chaos-{wid}-{i}"
+                body = json.dumps({"inputs": {"x": xbatch.tolist()},
+                                   "deadline_ms": 30000}).encode()
+                req = urllib.request.Request(
+                    cluster.url + "/v1/infer", data=body,
+                    headers={"Content-Type": "application/json",
+                             "X-Request-Id": rid})
+                t0 = time.perf_counter()
+                try:
+                    resp = urllib.request.urlopen(req, timeout=60)
+                    doc = json.loads(resp.read())
+                except urllib.error.HTTPError as e:
+                    with lock:
+                        results[rid] = f"HTTP {e.code}"
+                    continue
+                except Exception as e:
+                    with lock:
+                        results[rid] = f"{type(e).__name__}"
+                    continue
+                ms = (time.perf_counter() - t0) * 1e3
+                with lock:
+                    prev = results.get(rid, 0)
+                    results[rid] = prev + 1 if isinstance(prev, int) \
+                        else prev
+                    latencies.append(ms)
+                    if doc.get("model_version") is not None:
+                        versions_seen.add(doc["model_version"])
+
+        share = n_requests // workers
+        threads = [threading.Thread(target=worker, args=(w, share),
+                                    daemon=True) for w in range(workers)]
+        t_load0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        victim = cluster.replicas[0]
+        victim.kill()
+        print(f"SIGKILLed {victim.name} (pid {victim.proc.pid}) "
+              f"mid-load", flush=True)
+        time.sleep(0.3)
+        ckpt.publish_model(root, tmp + "/m2", version=2)
+        print("published model v2 mid-load (rolling hot swap)", flush=True)
+        for t in threads:
+            t.join()
+        load_s = time.perf_counter() - t_load0
+
+        # let the rolling swap finish, then prove the fleet serves v2
+        swap_ok = False
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if cluster.current_version == 2:
+                body = json.dumps(
+                    {"inputs": {"x": xbatch.tolist()}}).encode()
+                try:
+                    doc = json.loads(urllib.request.urlopen(
+                        urllib.request.Request(
+                            cluster.url + "/v1/infer", data=body,
+                            headers={"Content-Type": "application/json"}),
+                        timeout=30).read())
+                    if doc.get("model_version") == 2:
+                        versions_seen.add(2)
+                        swap_ok = True
+                        break
+                except Exception:
+                    pass
+            time.sleep(0.25)
+        stats = cluster.stats()
+        cluster.close()
+
+    counters = telemetry.counters()
+    served = sum(1 for v in results.values() if v == 1)
+    multi = {k: v for k, v in results.items()
+             if isinstance(v, int) and v > 1}
+    failed = {k: v for k, v in results.items() if not isinstance(v, int)}
+    lat = sorted(latencies)
+    p50 = lat[int(0.50 * (len(lat) - 1))] if lat else 0.0
+    p99 = lat[int(0.99 * (len(lat) - 1))] if lat else 0.0
+
+    print("-- cluster chaos tally " + "-" * 26)
+    for key in ("faults.injected", "router.requests", "router.retries",
+                "router.failovers", "router.rejects",
+                "router.dispatch_errors", "router.dedup_hits",
+                "router.replica_deaths", "router.replica_restarts",
+                "router.swaps", "router.swap_errors",
+                "router.deadline_exceeded", "trace.spans"):
+        print(f"{key:28s} {int(counters.get(key, 0))}")
+    inj = faults.counts()["injected"]
+    for site, n in sorted(inj.items()):
+        print(f"  injected@{site:18s} {n}  (router-side)")
+    print(f"requests: {served} served exactly-once / {len(multi)} "
+          f"duplicated / {len(failed)} failed, load wall {load_s:.1f}s")
+    print(f"latency ms: p50 {p50:.1f}  p99 {p99:.1f}  "
+          f"(bound {args.p99_bound:.0f})")
+    print(f"versions seen in responses: {sorted(versions_seen)}; "
+          f"fleet on v{stats.get('current_version')}")
+
+    if failed:
+        sample = list(failed.items())[:5]
+        print(f"CHAOS FAIL: {len(failed)} accepted requests never got a "
+              f"successful response (lost): {sample}")
+        return 2
+    if multi:
+        print(f"CHAOS FAIL: duplicated responses (exactly-once broken): "
+              f"{list(multi.items())[:5]}")
+        return 2
+    if served != workers * share:
+        print(f"CHAOS FAIL: {served} != {workers * share} responses")
+        return 2
+    if p99 > args.p99_bound:
+        print(f"CHAOS FAIL: p99 {p99:.1f} ms above bound "
+              f"{args.p99_bound:.0f} ms")
+        return 2
+    if not counters.get("router.replica_deaths", 0):
+        print("CHAOS FAIL: the SIGKILL was never observed by the monitor")
+        return 2
+    if not swap_ok:
+        print("CHAOS FAIL: the mid-load model swap never completed to v2")
+        return 2
+    if args.fault_spec and not counters.get("faults.injected", 0):
+        print("CHAOS WARN: router-side fault spec never fired (run too "
+              "short for the trigger?)")
+    print(f"CHAOS OK: {served} requests exactly-once through SIGKILL + "
+          f"hot swap, {int(counters.get('router.failovers', 0))} "
+          f"failovers, {int(counters.get('router.swaps', 0))} replica "
+          f"swaps, p99 {p99:.1f} ms")
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser(
         description="run a short PS training loop under fault injection "
@@ -379,8 +595,19 @@ def main():
                          "protocol (ckpt.save.write/commit + "
                          "ckpt.restore.read sites) with an elastic "
                          "kill/restart instead of the PS loop")
+    ap.add_argument("--cluster", action="store_true",
+                    help="chaos-test the cluster serving control plane "
+                         "(replica processes + router): SIGKILL a "
+                         "replica and hot-swap the model mid-load under "
+                         "router.dispatch/serving.handler faults, assert "
+                         "exactly-once responses and bounded p99")
+    ap.add_argument("--replicas", type=int, default=2,
+                    help="--cluster mode: replica process count")
+    ap.add_argument("--p99-bound", type=float, default=5000.0,
+                    help="--cluster mode: fail if client-observed p99 "
+                         "latency exceeds this many ms")
     ap.add_argument("--requests", type=int, default=24,
-                    help="--serving mode: total client requests")
+                    help="--serving/--cluster mode: total client requests")
     ap.add_argument("--seed", type=int, default=0,
                     help="fault-injection seed (FLAGS_fault_seed)")
     ap.add_argument("--trace-sample", type=float, default=0.0,
@@ -399,10 +626,15 @@ def main():
     ap.add_argument("--telemetry-log", default="",
                     help="also write the JSONL run log here")
     args = ap.parse_args()
+    if args.cluster and args.requests == 24:
+        args.requests = 400   # the serving default is too short to span
+        # a kill + a rolling swap; --requests still overrides
     if args.serving:
         sys.exit(run_serving(args))
     if args.checkpoint:
         sys.exit(run_checkpoint(args))
+    if args.cluster:
+        sys.exit(run_cluster(args))
     sys.exit(run(args))
 
 

@@ -162,6 +162,7 @@ def summarize_log(recs, malformed=0):
     fused = _fused_summary(counter_delta, counter_last, timer_summary)
     serving = _serving_summary(counter_delta, counter_last, timer_summary,
                                gauges)
+    router = _router_summary(counter_delta, counter_last, timer_summary)
     ckpt = _ckpt_summary(counter_delta, counter_last, timer_summary)
     sharding = _sharding_summary(counter_delta, counter_last, gauges)
     verifier = _verifier_summary(counter_delta, counter_last, timer_summary)
@@ -180,6 +181,7 @@ def summarize_log(recs, malformed=0):
     return {
         "fused": fused,
         "serving": serving,
+        "router": router,
         "checkpoint": ckpt,
         "sharding": sharding,
         "verifier": verifier,
@@ -270,6 +272,43 @@ def _serving_summary(counter_delta, counter_last, timer_summary, gauges):
     qd = gauges.get("serving.queue_depth")
     if qd is not None:
         out["last_queue_depth"] = qd
+    return out
+
+
+def _router_summary(counter_delta, counter_last, timer_summary):
+    """Cluster control-plane accounting (paddle_tpu/serving/router.py +
+    cluster.py): routed requests, retries/failovers, replica deaths and
+    respawns, model swaps, and the router-observed latency."""
+
+    def cval(name):
+        v = counter_delta.get(name) or counter_last.get(name) or 0
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            return 0.0
+
+    requests = cval("router.requests")
+    if not requests:
+        return None
+    out = {"requests": int(requests),
+           "retries": int(cval("router.retries")),
+           "failovers": int(cval("router.failovers")),
+           "rejects": int(cval("router.rejects")),
+           "dedup_hits": int(cval("router.dedup_hits")),
+           "dispatch_errors": int(cval("router.dispatch_errors")),
+           "deadline_exceeded": int(cval("router.deadline_exceeded")),
+           "replica_deaths": int(cval("router.replica_deaths")),
+           "replica_restarts": int(cval("router.replica_restarts")),
+           "swaps": int(cval("router.swaps")),
+           "swap_errors": int(cval("router.swap_errors"))}
+    fallback = cval("router.swapping_fallback")
+    if fallback:
+        out["swapping_fallbacks"] = int(fallback)
+    for timer, key in (("router.request_ms", "request_ms"),
+                       ("router.dispatch_ms", "dispatch_ms")):
+        t = timer_summary.get(timer)
+        if t:
+            out[key] = {"p50": t["p50"], "p99": t["p99"], "max": t["max"]}
     return out
 
 
@@ -450,6 +489,27 @@ def render(s, out=sys.stdout):
                   f"  max {t['max']}\n")
         if "last_queue_depth" in sv:
             w(f"last queue depth: {_fmt_num(sv['last_queue_depth'])}\n")
+
+    if s.get("router"):
+        rt = s["router"]
+        w("\n-- router (cluster serving control plane) --\n")
+        w(f"requests: {rt['requests']}  retries: {rt['retries']}  "
+          f"failovers: {rt['failovers']}  rejects: {rt['rejects']}  "
+          f"dedup hits: {rt['dedup_hits']}\n")
+        w(f"dispatch errors: {rt['dispatch_errors']}  deadline exceeded: "
+          f"{rt['deadline_exceeded']}\n")
+        w(f"replica deaths: {rt['replica_deaths']}  respawns: "
+          f"{rt['replica_restarts']}  model swaps: {rt['swaps']}  "
+          f"swap errors: {rt['swap_errors']}\n")
+        if "swapping_fallbacks" in rt:
+            w(f"dispatches to a swapping replica (no READY peer): "
+              f"{rt['swapping_fallbacks']}\n")
+        for key, label in (("request_ms", "routed request"),
+                           ("dispatch_ms", "replica dispatch")):
+            if key in rt:
+                t = rt[key]
+                w(f"{label} ms: p50 {t['p50']}  p99 {t['p99']}"
+                  f"  max {t['max']}\n")
 
     if s.get("checkpoint"):
         ck = s["checkpoint"]
