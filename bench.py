@@ -19,6 +19,13 @@ configuration in extra.steps_per_dispatch and the dispatch-amortization
 counters (telemetry_fused_dispatches / telemetry_fused_steps) merged by
 finalize_bench_result.
 
+Cost & memory: every row embeds extra.model_flops (the analytic
+per-step flop count the MFU figure is derived from) and extra.live_mfu
+(the runtime MFU gauge from core/costmodel.py — windowed captured-flop
+rate / peak device flops), so BENCH rows are self-attributing; with
+FLAGS_cost_capture=full the row also carries the composed HBM ledger
+total (extra.mem_hbm_total_bytes).
+
 Sharded mode: when a mesh is active the row also records
 extra.mesh_shape, extra.axis_rules_hash (the logical-axis-rule table
 fingerprint, parallel/axis_rules.py) and extra.zero_stage (the fleet

@@ -24,7 +24,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
-from . import registry, telemetry, trace
+from . import costmodel, registry, telemetry, trace
 from .ir import Block, OpDesc, Program, Variable, default_main_program
 from .registry import EMPTY_VAR
 from .scope import Scope, global_scope
@@ -258,7 +258,8 @@ def _recompile_cause(key: tuple, cached_keys) -> str:
 
 
 class _CompiledEntry:
-    __slots__ = ("jitted", "state_names", "ro_names", "fetch_names", "has_state_out")
+    __slots__ = ("jitted", "state_names", "ro_names", "fetch_names",
+                 "has_state_out", "cost")
 
     def __init__(self, jitted, state_names, ro_names, fetch_names, has_state_out):
         self.jitted = jitted
@@ -266,6 +267,9 @@ class _CompiledEntry:
         self.ro_names = ro_names
         self.fetch_names = fetch_names
         self.has_state_out = has_state_out
+        # ProgramCost captured at compile (core/costmodel.py) — None when
+        # capture is off or the backend exposes no analysis APIs
+        self.cost = None
 
 
 class Executor:
@@ -895,10 +899,41 @@ class Executor:
         if step is None:
             step = _as_device_array(0, np.int32)
 
+        # per-compile cost/memory capture (core/costmodel.py): the AOT
+        # analyses run against THIS cache entry's lowering before state
+        # buffers are donated; lower() shares the trace cache with the
+        # first execution, so 'cost' level adds ~no work. Degrades by
+        # counting (costmodel.unavailable), never by raising.
+        if compile_cause is not None and \
+                costmodel.capture_mode() != "off":
+            entry.cost = costmodel.capture(
+                lambda: entry.jitted.lower(state, ro, feed, step),
+                key_id=costmodel.key_id_for(key), kind="executor",
+                program=f"{program.uid}v{program.version}",
+                steps_per_dispatch=scan_k or 1)
+            # HBM ledger: persistable split of this program's resident
+            # state (params vs optimizer/run state)
+            names = list(entry.state_names) + list(entry.ro_names)
+            vals = [state.get(n, ro.get(n)) for n in names]
+            pb, ob = costmodel.split_persistable_bytes(block, names, vals)
+            costmodel.record_model_bytes(pb, ob)
+
         t_run = time.perf_counter()
         t_run_wall = time.time()
-        with _prof.RecordEvent("executor::run"):
-            fetches, new_state, new_step = entry.jitted(state, ro, feed, step)
+        try:
+            with _prof.RecordEvent("executor::run"):
+                fetches, new_state, new_step = entry.jitted(state, ro,
+                                                            feed, step)
+        except Exception as e:
+            # allocation failure: land the OOM forensics record (ledger
+            # snapshot + top cached programs by peak bytes + this
+            # program's id) in the run log, then raise typed
+            if costmodel.is_oom_error(e):
+                raise costmodel.oom_forensics(
+                    f"{program.uid}v{program.version}", e,
+                    where="executor.dispatch") from e
+            raise
+        costmodel.book_dispatch(entry.cost, steps=scan_k or 1)
         # sharded-training collective accounting: the ShardingOptimizer
         # (fleet/meta_optimizers.py) precomputes the per-step dp-collective
         # payloads of the program; every dispatch books them (×k under

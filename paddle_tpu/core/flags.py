@@ -139,6 +139,27 @@ define_flag("metrics_window_s", 60.0,
             "(telemetry.windowed / prometheus_text / the /metrics "
             "endpoints): counter rates and histogram p50/p95/p99 are "
             "computed over the last this-many seconds")
+define_flag("cost_capture", "auto",
+            "per-compile XLA cost/memory capture level (core/"
+            "costmodel.py): 'off' disables; 'cost' runs the lowered-"
+            "module cost_analysis (flops/bytes — nearly free, the trace "
+            "cache is shared with the first execution); 'full' adds an "
+            "AOT compile for memory_analysis (peak/argument/output/temp "
+            "bytes — one extra XLA compile per cache entry, opt in for "
+            "memory-report runs); 'auto' (default) behaves as 'cost' "
+            "when the run is instrumented (telemetry sink or metrics "
+            "server active) and 'off' otherwise. Backends lacking the "
+            "analysis APIs degrade gracefully (costmodel.unavailable "
+            "counted, never raised)")
+define_flag("device_peak_flops", 0.0,
+            "peak dense flops/s of one device for the live MFU gauge "
+            "and roofline verdicts (core/costmodel.py); <= 0 uses the "
+            "built-in device table keyed on jax device_kind (unknown "
+            "kinds fall back to the v5e figure)")
+define_flag("device_peak_bw", 0.0,
+            "peak HBM bytes/s of one device for the roofline ridge "
+            "point (core/costmodel.py); <= 0 uses the built-in device "
+            "table")
 define_flag("trace_sample_rate", 0.0,
             "distributed-tracing sample rate in [0, 1] (core/trace.py): "
             "the probability a ROOT span starts a sampled trace whose "

@@ -354,22 +354,32 @@ class TelemetryRegistry:
             self._win_samples.clear()
 
     # -- rolling-window metrics (the live /metrics plane) --------------------
-    def windowed(self, window_s: Optional[float] = None) -> Dict[str, Any]:
+    def windowed(self, window_s: Optional[float] = None,
+                 now: Optional[float] = None) -> Dict[str, Any]:
         """Last-N-seconds view of the registry: counter deltas + per-second
         rates, current gauges, and histogram count/rate/p50/p95/p99 over
         the window (default FLAGS_metrics_window_s). Scrapeable while the
-        run is live — this is what /metrics and /v1/stats render."""
+        run is live — this is what /metrics and /v1/stats render.
+
+        ONE cutoff rule for both families: an observation is in the
+        window iff its timestamp >= now - W, where a counter bucket's
+        timestamp is its second-start (bucket granularity: increments in
+        the partial boundary bucket are dropped, never double-counted —
+        counters and histogram samples used to disagree by up to a whole
+        boundary bucket). ``now`` is injectable for deterministic tests.
+        """
         W = float(window_s if window_s is not None
                   else _flags.flag("metrics_window_s"))
         W = max(W, 1.0)
-        now = time.time()
+        if now is None:
+            now = time.time()
         cut = now - W
         with self._lock:
             counters = {}
             for name, dq in self._win_counts.items():
                 tot = 0
                 for sec, v in dq:
-                    if sec >= cut - 0.999:   # boundary bucket counts whole
+                    if sec >= cut:
                         tot += v
                 if tot:
                     counters[name] = {"delta": tot,
@@ -472,6 +482,16 @@ def _prom_num(v) -> str:
     return str(v)
 
 
+# live MetricsServer count: costmodel's 'auto' capture level treats a
+# process that started a scrape surface as instrumented
+_metrics_servers = 0
+_metrics_servers_lock = threading.Lock()
+
+
+def metrics_server_active() -> bool:
+    return _metrics_servers > 0
+
+
 class MetricsServer:
     """Stdlib HTTP scrape surface over the live registry: ``/metrics``
     (Prometheus text) + ``/healthz``. Started by start_metrics_server —
@@ -521,6 +541,9 @@ class MetricsServer:
             target=self._httpd.serve_forever,
             name="pt-metrics-http", daemon=True)
         self._thread.start()
+        global _metrics_servers
+        with _metrics_servers_lock:
+            _metrics_servers += 1
 
     @property
     def host(self) -> str:
@@ -538,6 +561,9 @@ class MetricsServer:
         self._httpd.shutdown()
         self._httpd.server_close()
         self._thread.join(timeout=5)
+        global _metrics_servers
+        with _metrics_servers_lock:
+            _metrics_servers = max(0, _metrics_servers - 1)
 
 
 # -- module-level convenience API (the surface everything instruments
@@ -611,8 +637,9 @@ def flush_sink():
     return _reg().flush_sink()
 
 
-def windowed(window_s: Optional[float] = None) -> Dict[str, Any]:
-    return _reg().windowed(window_s)
+def windowed(window_s: Optional[float] = None,
+             now: Optional[float] = None) -> Dict[str, Any]:
+    return _reg().windowed(window_s, now=now)
 
 
 def prometheus_text(window_s: Optional[float] = None) -> str:

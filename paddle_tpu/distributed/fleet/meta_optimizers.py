@@ -605,6 +605,12 @@ class ShardingOptimizer:
         telemetry.gauge_set("sharding.optimizer_state_bytes", total)
         telemetry.gauge_set("sharding.optimizer_state_bytes_per_device",
                             per_dev)
+        # the HBM ledger (core/costmodel.py) prefers the sharded
+        # per-device figure over the capture-time unsharded estimate —
+        # recompose mem.hbm_total_bytes now that it moved
+        from ...core import costmodel
+
+        costmodel.refresh_ledger()
         return {"total_bytes": total, "per_device_bytes": per_dev,
                 "state_vars": len(self._state_var_names)}
 

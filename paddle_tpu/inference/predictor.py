@@ -19,7 +19,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import io
-from ..core import telemetry
+from ..core import costmodel, telemetry
 from ..core.executor import _as_device_array, run_block
 from ..core.flags import flag as _flag
 from ..core.ir import Program
@@ -145,6 +145,10 @@ class AnalysisPredictor:
         # seq lens) evicts the coldest signature instead of growing the
         # jit cache without limit (FLAGS_predictor_cache_capacity)
         self._cache: "OrderedDict[tuple, Any]" = OrderedDict()
+        # per-signature cost/memory records (core/costmodel.py) — the
+        # serving engine reads these at warmup for bucket footprints
+        self._cost_records: Dict[tuple, Any] = {}
+        self._last_cost: Any = None   # record of the most recent run()
         self._params = self._load_params_to_device()
 
     # -- internals ------------------------------------------------------------
@@ -237,8 +241,34 @@ class AnalysisPredictor:
         sig = tuple((n, dev_feed[n].shape, str(dev_feed[n].dtype))
                     for n in self.feed_names)
         entry, is_new = self._compiled(sig)
+        if is_new and costmodel.capture_mode() != "off":
+            # per-signature cost/memory capture: one record per jit-cache
+            # entry (= one serving bucket), keyed like the executor's
+            rows = dev_feed[self.feed_names[0]].shape[0] \
+                if self.feed_names and dev_feed[self.feed_names[0]].ndim \
+                else 0
+            self._cost_records[sig] = costmodel.capture(
+                lambda: entry.lower(self._params, dev_feed),
+                key_id=costmodel.key_id_for(sig), kind="predictor",
+                program=f"rows{rows}")
+            if not getattr(self, "_params_booked", False):
+                # HBM ledger: the frozen inference weights are this
+                # process's persistable params (no optimizer state)
+                self._params_booked = True
+                costmodel.record_model_bytes(
+                    sum(int(getattr(v, "nbytes", 0) or 0)
+                        for v in self._params.values()), 0)
         t0 = time.perf_counter() if is_new else None
-        outs = entry(self._params, dev_feed)
+        try:
+            outs = entry(self._params, dev_feed)
+        except Exception as e:
+            if costmodel.is_oom_error(e):
+                raise costmodel.oom_forensics(
+                    f"predictor{list(sig)}"[:200], e,
+                    where="predictor.run") from e
+            raise
+        self._last_cost = self._cost_records.get(sig)
+        costmodel.book_dispatch(self._last_cost)
         if is_new:
             # like the executor, compile wall time is measured through the
             # first (lazily-compiling) execution

@@ -33,7 +33,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from ..core import faults, telemetry, trace
+from ..core import costmodel, faults, telemetry, trace
 from ..core.flags import flag as _flag
 from .admission import (AdmissionQueue, EngineClosedError, InferenceRequest,
                         ServingError)
@@ -116,6 +116,9 @@ class ServingEngine:
         # routes to a cold replica
         self.health = HealthState()
         self.version = int(version)
+        # per-bucket cost/memory footprints captured at warmup
+        # (core/costmodel.py ProgramCost records, keyed by bucket size)
+        self._bucket_costs: Dict[int, Any] = {}
 
     # -- client surface ------------------------------------------------------
     @property
@@ -197,6 +200,19 @@ class ServingEngine:
                                "p50": wh["p50"], "p95": wh["p95"],
                                "p99": wh["p99"]}
         out["window"] = wout
+        if self._bucket_costs:
+            # per-warmed-bucket cost/memory footprints + the composed
+            # HBM ledger (core/costmodel.py) — the capacity-planning
+            # numbers a router/operator reads off /v1/stats
+            out["memory"] = {
+                "buckets": {str(b): {
+                    "peak_bytes": rec.peak_bytes,
+                    "temp_bytes": rec.temp_bytes,
+                    "arg_bytes": rec.arg_bytes,
+                    "flops": rec.flops,
+                    "roofline": rec.roofline()}
+                    for b, rec in sorted(self._bucket_costs.items())},
+                "ledger": costmodel.ledger()}
         return out
 
     # -- lifecycle -----------------------------------------------------------
@@ -218,19 +234,23 @@ class ServingEngine:
         """Pre-compile every bucket with zero feeds so the first real
         request never pays a compile. Returns the number of fresh
         compiles (serving.warmup_compiles)."""
-        return self._warm(self.predictor, locked=True)
+        fresh, costs = self._warm(self.predictor, locked=True)
+        self._publish_bucket_costs(costs)
+        return fresh
 
-    def _warm(self, predictor, locked: bool = False) -> int:
-        """Run every bucket through ``predictor`` once. ``locked`` guards
-        runs of the LIVE predictor with the infer lock; a swap candidate
-        is private until the flip, and warming it unlocked keeps the old
+    def _warm(self, predictor, locked: bool = False):
+        """Run every bucket through ``predictor`` once; returns (fresh
+        compile count, {bucket: ProgramCost}). ``locked`` guards runs of
+        the LIVE predictor with the infer lock; a swap candidate is
+        private until the flip, and warming it unlocked keeps the old
         predictor serving (zero downtime) while the new one compiles."""
         specs = predictor.feed_specs()
         for n, (shape, _dtype) in specs.items():
             if any(d is None or d < 0 for d in shape[1:]):
                 telemetry.counter_add("serving.warmup_skipped", 1, feed=n)
-                return 0   # non-batch dynamic dims: nothing safe to build
+                return 0, {}   # non-batch dynamic dims: nothing to build
         before = telemetry.counter_get("predictor.compiles")
+        costs: Dict[int, Any] = {}
         with telemetry.timer("serving.warmup_ms"):
             for b in self.config.buckets:
                 feed = {n: np.zeros((b,) + tuple(shape[1:]), dtype=dtype)
@@ -240,10 +260,28 @@ class ServingEngine:
                         predictor.run(feed)
                 else:
                     predictor.run(feed)
+                # per-bucket cost/memory footprint (captured by the
+                # predictor when FLAGS_cost_capture is on)
+                rec = getattr(predictor, "_last_cost", None)
+                if rec is not None:
+                    costs[b] = rec
         fresh = telemetry.counter_get("predictor.compiles") - before
         if fresh:
             telemetry.counter_add("serving.warmup_compiles", fresh)
-        return int(fresh)
+        return int(fresh), costs
+
+    def _publish_bucket_costs(self, costs: Dict[int, Any]):
+        """Publish the warmed buckets' footprints on the HBM ledger:
+        mem.serving.bucket<B>_peak_bytes gauges (full capture only — the
+        peak needs memory_analysis) + the /v1/stats memory section."""
+        if not costs:
+            return
+        self._bucket_costs = dict(costs)
+        for b, rec in costs.items():
+            if rec.peak_bytes:
+                telemetry.gauge_set(f"mem.serving.bucket{b}_peak_bytes",
+                                    int(rec.peak_bytes))
+        costmodel.refresh_ledger()
 
     def swap_predictor(self, predictor, version: Optional[int] = None,
                        warmup: bool = True) -> int:
@@ -271,11 +309,13 @@ class ServingEngine:
                     f"{len(self._fetch_names)} fetches")
             with ReadyGate(self.health, SWAPPING), \
                     telemetry.timer("serving.swap_ms"):
-                fresh = self._warm(predictor, locked=False) if warmup else 0
+                fresh, costs = self._warm(predictor, locked=False) \
+                    if warmup else (0, {})
                 with self._infer_lock:
                     self.predictor = predictor
                     if version is not None:
                         self.version = int(version)
+                self._publish_bucket_costs(costs)
             telemetry.counter_add("serving.swaps", 1, version=self.version,
                                   warmup_compiles=fresh)
             return fresh

@@ -32,7 +32,10 @@ API:
                      (engine.swap_predictor) — old version serves until
                      the flip
     GET  /v1/stats   serving.* counters + request/batch latency
-                     percentiles + rolling-window rates (engine.stats())
+                     percentiles + rolling-window rates (engine.stats());
+                     when FLAGS_cost_capture is on, a "memory" section
+                     with per-warmed-bucket cost/memory footprints and
+                     the composed HBM ledger (core/costmodel.py)
     GET  /metrics    Prometheus text exposition of the live registry —
                      cumulative counters, rolling-window rates and
                      p50/p95/p99 over FLAGS_metrics_window_s

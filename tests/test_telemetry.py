@@ -356,6 +356,58 @@ class TestStatRegistryAbsorbed:
         assert reg.get("set_probe") == 42
 
 
+class TestWindowBoundary:
+    """PR 10 satellite: one cutoff rule for the rolling window. Counter
+    buckets used to include the `cut - 0.999` boundary bucket while hist
+    samples filtered `ts >= cut` — up to a whole bucket of disagreement
+    between the two families. Both now use timestamp >= cut (a bucket's
+    timestamp being its second-start)."""
+
+    def test_counters_and_hists_share_the_cutoff(self):
+        from collections import deque
+
+        from paddle_tpu.core.telemetry import TelemetryRegistry
+
+        reg = TelemetryRegistry()
+        now = 1_000_000.5          # injected — no real clock involved
+        W = 10.0                   # cut = 999_990.5
+        base = int(now)
+        reg._win_counts["c"] = deque([
+            [base - 11, 100],      # well outside
+            [base - 10, 7],        # the old boundary bucket: sec 999_990
+            [base - 5, 3],         # inside
+            [base, 2],             # current second
+        ])
+        reg._win_samples["h"] = deque([
+            (now - 11.0, 1.0),     # well outside
+            (now - 10.4, 2.0),     # ts 999_990.1 < cut → outside
+            (now - 5.0, 3.0),      # inside
+            (now, 4.0),            # now
+        ])
+        win = reg.windowed(window_s=W, now=now)
+        # bucket sec 999_990 < cut 999_990.5 → EXCLUDED (the old rule
+        # `sec >= cut - 0.999` counted its whole 7)
+        assert win["counters"]["c"]["delta"] == 5
+        assert win["counters"]["c"]["rate"] == round(5 / W, 6)
+        h = win["hists"]["h"]
+        assert h["count"] == 2
+        assert h["p50"] in (3.0, 4.0)
+
+    def test_boundary_bucket_included_when_cut_reaches_it(self):
+        from collections import deque
+
+        from paddle_tpu.core.telemetry import TelemetryRegistry
+
+        reg = TelemetryRegistry()
+        now = 2_000_000.0          # integral now: cut lands ON a second
+        reg._win_counts["c"] = deque([
+            [int(now) - 10, 7],    # sec == cut → included
+            [int(now) - 5, 3],
+        ])
+        win = reg.windowed(window_s=10.0, now=now)
+        assert win["counters"]["c"]["delta"] == 10
+
+
 class TestProfilerRingBuffer:
     def test_bounded_and_drops_counted(self, capsys):
         from paddle_tpu import profiler

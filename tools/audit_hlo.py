@@ -109,12 +109,17 @@ def main():
     print(f"wrote {len(txt)} bytes to {args.out}", file=sys.stderr)
 
     try:
-        ca = compiled.cost_analysis()
-        if isinstance(ca, list):
-            ca = ca[0]
-        print(json.dumps({k: v for k, v in ca.items()
-                          if k in ("flops", "bytes accessed",
-                                   "transcendentals")}), file=sys.stderr)
+        # one place knows XLA's cost_analysis() shape (list-vs-dict, the
+        # 'bytes accessed' key): core/costmodel.py — CLI output keeps the
+        # raw XLA key names
+        from paddle_tpu.core.costmodel import normalize_cost_analysis
+
+        ca = normalize_cost_analysis(compiled.cost_analysis())
+        print(json.dumps({xla_key: ca[k] for xla_key, k in
+                          (("flops", "flops"),
+                           ("bytes accessed", "bytes_accessed"),
+                           ("transcendentals", "transcendentals"))
+                          if k in ca}), file=sys.stderr)
     except Exception as e:
         print(f"cost_analysis unavailable: {e}", file=sys.stderr)
 

@@ -25,16 +25,12 @@ import numpy as np
 
 
 def peak_flops_per_chip() -> float:
-    import jax
+    # the device table moved to core/costmodel.py (shared with the live
+    # MFU gauge + roofline verdicts); FLAGS_device_peak_flops overrides.
+    # Same figures as before — unknown kinds still read as v5e
+    from paddle_tpu.core.costmodel import peak_device_flops
 
-    kind = jax.devices()[0].device_kind.lower()
-    if "v5p" in kind or "v5 p" in kind:
-        return 459e12
-    if "v4" in kind:
-        return 275e12
-    if "v6" in kind or "trillium" in kind:
-        return 918e12
-    return 197e12  # v5e / v5 lite
+    return peak_device_flops()
 
 
 def transformer_step_flops(cfg, batch, seq, lm_positions=None) -> float:
@@ -131,6 +127,7 @@ def bench_bert_like(model_cfg_fn, *, seq, batch, max_preds, steps,
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.35, 4),
         "extra": {"ms_per_step": round(ms, 2), "mfu": round(mfu, 4),
+                  "model_flops": flops,
                   "batch": batch, "seq_len": seq, "loss": round(loss, 4)},
     }
 
@@ -181,6 +178,7 @@ def bench_resnet50(steps=20, batch=None, amp=True):
         "unit": "imgs/s",
         "vs_baseline": round(mfu / 0.35, 4),
         "extra": {"ms_per_step": round(ms, 2), "mfu": round(mfu, 4),
+                  "model_flops": flops,
                   "batch": batch, "loss": round(loss, 4)},
     }
 
@@ -238,7 +236,7 @@ def bench_mnist(steps=200, batch=None):
         "unit": "imgs/s",
         "vs_baseline": round(mfu / 0.35, 4),
         "extra": {"ms_per_step": round(ms, 2), "batch": batch,
-                  "loss": round(loss, 4)},
+                  "model_flops": flops, "loss": round(loss, 4)},
     }
 
 
@@ -274,6 +272,7 @@ def bench_transformer_big(steps=15, batch=None, seq=256):
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.35, 4),
         "extra": {"ms_per_step": round(ms, 2), "mfu": round(mfu, 4),
+                  "model_flops": matmul + attn,
                   "batch": batch, "seq_len": seq, "loss": round(loss, 4)},
     }
 
@@ -302,6 +301,21 @@ def finalize_bench_result(out):
     ex["mesh_shape"] = ({a: int(s) for a, s in m.shape.items()}
                         if m is not None else None)
     ex["axis_rules_hash"] = axis_rules.fingerprint()
+    # cost & memory observability (core/costmodel.py): the live MFU
+    # gauge (windowed captured-flop rate / peak device flops) rides
+    # every BENCH row next to the analytic model_flops the workload
+    # embedded, so rows are self-attributing — an MFU claim can be
+    # cross-checked against what XLA says the program actually does
+    from paddle_tpu.core import costmodel
+
+    ex["live_mfu"] = round(costmodel.live_mfu(), 6)
+    c = telemetry.counters()
+    if c.get("cost.captures"):
+        ex["cost_captures"] = int(c["cost.captures"])
+        ex["cost_dispatch_flops"] = int(c.get("cost.dispatch_flops", 0))
+    g0 = telemetry.gauges()
+    if g0.get("mem.hbm_total_bytes") is not None:
+        ex["mem_hbm_total_bytes"] = int(g0["mem.hbm_total_bytes"])
     g = telemetry.gauges()
     if g.get("sharding.zero_stage") is not None:
         ex["zero_stage"] = int(g["sharding.zero_stage"])

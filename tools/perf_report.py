@@ -33,6 +33,11 @@ Renders the structured run log written by ``paddle_tpu.core.telemetry``
   (core/verify.py — apply_passes post-pass gates, FLAGS_verify_program,
   tools/graph_lint.py): programs verified, checks run, violations,
   orphaned VarDescs pruned, and verify-time percentiles;
+* a "Memory & cost" section when the run captured XLA cost/memory
+  analyses (core/costmodel.py, FLAGS_cost_capture): capture health, the
+  HBM ledger gauges, dispatched flop volume, the live-MFU gauge and
+  roofline verdict counts — the full per-program table and OOM
+  forensics render with tools/mem_report.py;
 * a "Tracing" section when the run emitted distributed-tracing spans
   (core/trace.py, FLAGS_trace_sample_rate): trace/span counts and
   per-span-name duration percentiles — merge multi-process logs with
@@ -106,6 +111,8 @@ def summarize_log(recs, malformed=0):
     steps = []
     metrics = []
     profiler_rows = []
+    cost_events = []
+    oom_events = 0
     spans = defaultdict(list)
     span_traces = set()
     snapshot = None
@@ -143,6 +150,10 @@ def summarize_log(recs, malformed=0):
             metrics.append({"name": name, "value": v, **attrs})
         elif kind == "profiler_summary":
             profiler_rows.append({"name": name, "total_us": v, **attrs})
+        elif kind == "cost":
+            cost_events.append(attrs)
+        elif kind == "oom":
+            oom_events += 1
         elif kind == "snapshot":
             snapshot = attrs
     # a final snapshot is authoritative for cumulative counter values
@@ -166,6 +177,8 @@ def summarize_log(recs, malformed=0):
     ckpt = _ckpt_summary(counter_delta, counter_last, timer_summary)
     sharding = _sharding_summary(counter_delta, counter_last, gauges)
     verifier = _verifier_summary(counter_delta, counter_last, timer_summary)
+    memcost = _memcost_summary(counter_delta, counter_last, gauges,
+                               cost_events, oom_events)
     tracing = None
     if spans:
         by_name = {}
@@ -185,6 +198,7 @@ def summarize_log(recs, malformed=0):
         "checkpoint": ckpt,
         "sharding": sharding,
         "verifier": verifier,
+        "memcost": memcost,
         "tracing": tracing,
         "malformed_lines": int(malformed),
         "records": len(recs),
@@ -391,6 +405,49 @@ def _sharding_summary(counter_delta, counter_last, gauges):
     return out
 
 
+def _memcost_summary(counter_delta, counter_last, gauges, cost_events,
+                     oom_events):
+    """Cost & memory observability accounting (core/costmodel.py): the
+    HBM ledger gauges, per-compile capture health, dispatched flop
+    volume and the live-MFU gauge — tools/mem_report.py renders the full
+    per-program table and OOM forensics."""
+
+    def cval(name):
+        v = counter_delta.get(name) or counter_last.get(name) or 0
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            return 0.0
+
+    captures = cval("cost.captures")
+    unavailable = cval("costmodel.unavailable")
+    if not captures and not unavailable and not cost_events \
+            and not oom_events:
+        return None
+    out = {"captures": int(captures),
+           "unavailable": int(unavailable),
+           "programs": len({a.get("key") for a in cost_events}),
+           "dispatch_flops": int(cval("cost.dispatch_flops")),
+           "dispatch_bytes": int(cval("cost.dispatch_bytes")),
+           "oom_events": int(cval("mem.oom_events") or oom_events)}
+    for gname, key in (("mem.param_bytes", "param_bytes"),
+                       ("mem.opt_state_bytes", "opt_state_bytes"),
+                       ("mem.peak_temp_bytes", "peak_temp_bytes"),
+                       ("mem.hbm_total_bytes", "hbm_total_bytes"),
+                       ("cost.live_mfu", "live_mfu")):
+        v = gauges.get(gname)
+        if v is not None:
+            out[key] = v
+    verdicts = {}
+    for a in cost_events:
+        verdict = a.get("roofline")
+        if verdict:
+            verdicts[verdict] = verdicts.get(verdict, 0) + 1
+    if verdicts:
+        out["roofline"] = verdicts
+    return out
+
+
 def _verifier_summary(counter_delta, counter_last, timer_summary):
     """Static-verification accounting (core/verify.py): how many programs
     were checked, how many checks ran, what they found (violations /
@@ -569,6 +626,27 @@ def render(s, out=sys.stdout):
             t = vf["verify_ms"]
             w(f"verify ms: p50 {t['p50']}  p99 {t['p99']}  max {t['max']}"
               f"  (total ~{_fmt_num(vf['total_verify_ms'])})\n")
+
+    if s.get("memcost"):
+        mc = s["memcost"]
+        w("\n-- memory & cost (XLA cost/memory capture) --\n")
+        w(f"captures: {mc['captures']}  programs: {mc['programs']}  "
+          f"unavailable probes: {mc['unavailable']}  "
+          f"oom events: {mc['oom_events']}\n")
+        if any(k in mc for k in ("param_bytes", "opt_state_bytes",
+                                 "peak_temp_bytes", "hbm_total_bytes")):
+            w(f"HBM ledger: params {_fmt_num(mc.get('param_bytes', 0))} B"
+              f"  opt state {_fmt_num(mc.get('opt_state_bytes', 0))} B"
+              f"  peak scratch {_fmt_num(mc.get('peak_temp_bytes', 0))} B"
+              f"  total {_fmt_num(mc.get('hbm_total_bytes', 0))} B\n")
+        if mc["dispatch_flops"]:
+            w(f"dispatched: {_fmt_num(mc['dispatch_flops'])} FLOP, "
+              f"{_fmt_num(mc['dispatch_bytes'])} B accessed\n")
+        if "live_mfu" in mc:
+            w(f"last live MFU: {mc['live_mfu']}\n")
+        if "roofline" in mc:
+            w(f"roofline verdicts: {mc['roofline']}  "
+              f"(full table: tools/mem_report.py)\n")
 
     if s.get("tracing"):
         tr = s["tracing"]
