@@ -31,6 +31,12 @@ from .core import (CPUPlace, CUDAPlace, Executor, Parameter, Program,  # noqa: F
 from .core.compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: F401
 from .core.executor import run_startup  # noqa: F401
 from .core.verify import ProgramVerifyError, verify_program  # noqa: F401
+from .core.analysis import LockOrderError, install_thread_excepthook  # noqa: F401
+
+# worker threads must never die silently: every uncaught exception in a
+# thread books threads.uncaught_exceptions + a thread_error run-log
+# record before the default stderr print (core/analysis/lockdep.py)
+install_thread_excepthook()
 from .param_attr import ParamAttr  # noqa: F401
 from . import dataset  # noqa: F401  (native-backed Dataset API)
 from .dataset import DatasetFactory, InMemoryDataset, QueueDataset  # noqa: F401

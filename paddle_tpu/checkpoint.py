@@ -69,6 +69,7 @@ import numpy as np
 
 from .core import faults, telemetry
 from .core import flags as _flags
+from .core.analysis import lockdep
 from .core.ir import Program, default_main_program
 from .core.scope import Scope, global_scope
 from .io import _decode_name, _encode_name, _fsync_dir
@@ -345,14 +346,15 @@ class AsyncCheckpointer:
     def __init__(self):
         self._q: "queue.Queue" = queue.Queue()
         self._thread: Optional[threading.Thread] = None
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("ckpt.async_writer")
         self._failure: Optional[BaseException] = None
 
     def _ensure_thread(self):
         with self._lock:
             if self._thread is None or not self._thread.is_alive():
                 self._thread = threading.Thread(
-                    target=self._loop, name="ckpt-async-writer", daemon=True)
+                    target=self._loop, name="pt-ckpt-async-writer",
+                    daemon=True)
                 self._thread.start()
 
     def _loop(self):
@@ -361,12 +363,14 @@ class AsyncCheckpointer:
             try:
                 fn()
             except BaseException as e:   # surfaced on next submit/wait
-                self._failure = e
+                with self._lock:
+                    self._failure = e
             finally:
                 self._q.task_done()
 
     def _raise_failure(self):
-        e, self._failure = self._failure, None
+        with self._lock:
+            e, self._failure = self._failure, None
         if e is not None:
             raise e
 

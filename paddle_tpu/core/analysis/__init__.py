@@ -1,0 +1,22 @@
+"""core.analysis — the repo's second static/dynamic analysis framework
+(alongside core/verify.py, which checks *programs*; this package checks
+the *runtime itself*).
+
+Two halves, one discipline:
+
+* :mod:`.lockdep` — runtime concurrency sanitizer: instrumented lock
+  factories (``lock``/``rlock``/``condition``) behind
+  ``FLAGS_sanitize_locks``, lock-order cycle + re-entry detection
+  (typed :class:`LockOrderError`), a stall watchdog dumping all-thread
+  stacks, contention/held-duration telemetry, and the
+  ``threading.excepthook`` wiring that makes worker-thread deaths
+  observable;
+* :mod:`.concurrency_lint` — the static twin: an AST lint over the
+  ``paddle_tpu/`` + ``tools/`` sources (lock-order inversions, blocking
+  calls under held locks, unguarded shared fields, thread-lifecycle
+  discipline) with ``# pt-lint: disable=<rule>(reason)`` suppressions.
+  CLI: ``tools/lint_concurrency.py``.
+"""
+
+from .lockdep import (LockOrderError, condition,  # noqa: F401
+                      install_thread_excepthook, lock, rlock)

@@ -1,0 +1,749 @@
+"""Static concurrency lint — the AST twin of the runtime lock sanitizer.
+
+Lints the ``paddle_tpu/`` + ``tools/`` sources (stdlib-only: ast + re,
+no jax import — runs anywhere, like tools/perf_report.py) for the
+concurrency defects that become 3 a.m. stalls:
+
+* ``lock-order`` (error) — per module, every ``with <lock>:`` nesting
+  (and every call made under a held lock, expanded transitively through
+  same-module/same-class callees) contributes an edge to a lock-
+  acquisition graph; a cycle is a potential A/B–B/A deadlock and every
+  edge inside the cycle is reported with its ``file:line``;
+* ``blocking-call-under-lock`` (warning) — socket/HTTP operations,
+  ``subprocess`` launches, ``time.sleep``, queue ``get``/``put`` and
+  bare ``.wait()``/``.join()`` without timeouts, and jit/compile entry
+  points (``predictor.run``, ``jax.jit``) executed while a lock is
+  held, including through one same-module call chain;
+* ``unlocked-shared-field`` (warning) — a ``self.<attr>`` written both
+  from a thread-entrypoint path (``Thread(target=self.m)`` targets and
+  their same-class callees, plus ``do_*`` handler methods of
+  *Handler classes) and from the main path, where at least one write
+  holds no lock (``__init__`` writes are construction-time and exempt);
+* ``thread-unnamed`` (error) / ``thread-unjoined`` (warning) — every
+  ``threading.Thread(...)`` spawn must carry ``name=`` (the
+  ``pt-<subsystem>-<role>`` convention the stall dumps and excepthook
+  records key on) and must either be a daemon or be joined with a
+  bounded timeout.
+
+Findings carry ``file:line`` + severity. Inline suppression::
+
+    something_risky()   # pt-lint: disable=<rule>(reason)
+
+on the finding line or the line above; multiple rules comma-separate.
+A suppressed finding is counted but does not fail the lint. CLI:
+``tools/lint_concurrency.py`` (exit 0 clean / 1 findings / 2 unloadable
+source, like tools/graph_lint.py).
+
+This is a heuristic source lint, not a soundness proof: lock identity is
+name-based (``ClassName.attr`` for ``self.*`` locks, module-qualified
+otherwise), call expansion stays within one module, and two instances of
+the same class share a lock name (same-name edges are skipped, exactly
+like the runtime graph). The runtime half (lockdep.py) covers what the
+static half cannot see.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+RULES: Dict[str, str] = {
+    "lock-order": "error",
+    "blocking-call-under-lock": "warning",
+    "unlocked-shared-field": "warning",
+    "thread-unnamed": "error",
+    "thread-unjoined": "warning",
+}
+
+_LOCKISH = re.compile(r"lock$|mutex$|cond$|cv$|condition$", re.I)
+_QUEUEISH = re.compile(r"(?:^|_)q(?:ueue)?$", re.I)
+_SUPPRESS = re.compile(
+    r"#\s*pt-lint:\s*disable=([a-z0-9_\-,\s]+?)\s*(?:\((.*)\))?\s*$")
+_BLOCK_SUBPROCESS = {"run", "Popen", "check_call", "check_output", "call"}
+_BLOCK_SOCKET_METHODS = {"recv", "recv_into", "accept", "sendall",
+                         "getresponse", "create_connection", "urlopen"}
+
+
+class Finding:
+    __slots__ = ("rule", "severity", "path", "line", "message",
+                 "suppressed")
+
+    def __init__(self, rule: str, path: str, line: int, message: str):
+        self.rule = rule
+        self.severity = RULES[rule]
+        self.path = path
+        self.line = int(line)
+        self.message = message
+        self.suppressed: Optional[str] = None   # suppression reason
+
+    def format(self) -> str:
+        sup = f"  [suppressed: {self.suppressed}]" \
+            if self.suppressed is not None else ""
+        return (f"{self.path}:{self.line}: {self.severity}: "
+                f"{self.rule}: {self.message}{sup}")
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"rule": self.rule, "severity": self.severity,
+                "path": self.path, "line": self.line,
+                "message": self.message, "suppressed": self.suppressed}
+
+
+class LintResult:
+    def __init__(self):
+        self.findings: List[Finding] = []     # unsuppressed
+        self.suppressed: List[Finding] = []
+        self.files = 0
+        self.parse_errors: List[Tuple[str, str]] = []
+
+    @property
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == "error"]
+
+    @property
+    def warnings(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == "warning"]
+
+
+# ---------------------------------------------------------------------------
+# per-function collection
+# ---------------------------------------------------------------------------
+
+def _chain(node) -> Optional[str]:
+    """Dotted text of a Name/Attribute chain ('self._lock',
+    'telemetry.counter_add'); None for anything else."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _FuncInfo:
+    def __init__(self, qualname: str, class_name: Optional[str]):
+        self.qualname = qualname
+        self.class_name = class_name
+        # (lock_id, line, held_names_tuple)
+        self.acquisitions: List[Tuple[str, int, tuple]] = []
+        # (callee_key, display, line, held_names_tuple)
+        self.calls: List[Tuple[tuple, str, int, tuple]] = []
+        # (description, line, held_names_tuple)
+        self.blocking: List[Tuple[str, int, tuple]] = []
+        # (attr, line, locked)
+        self.self_stores: List[Tuple[str, int, bool]] = []
+
+
+class _ThreadSpawn:
+    def __init__(self, line: int, func: "_FuncInfo"):
+        self.line = line
+        self.func = func
+        self.has_name = False
+        self.daemon = False
+        self.assigned_to: Optional[str] = None   # last segment of target
+        self.assigned_self = False               # target was self.<attr>
+        self.target_method: Optional[str] = None  # self.X target
+        self.target_func: Optional[str] = None    # bare-name target
+
+
+class _ModuleLint:
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.modname = os.path.splitext(os.path.basename(path))[0]
+        self.tree = tree
+        self.lines = source.splitlines()
+        self.functions: Dict[str, _FuncInfo] = {}
+        self.methods: Dict[Tuple[str, str], _FuncInfo] = {}
+        self.spawns: List[_ThreadSpawn] = []
+        # (receiver_last_segment, bounded, enclosing_qualname)
+        self.joins: List[Tuple[str, bool, str]] = []
+        self.daemon_sets: Set[str] = set()   # `x.daemon = True` receivers
+        self.handler_classes: Set[str] = set()
+        self.class_methods: Dict[str, Set[str]] = {}
+        self.findings: List[Finding] = []
+
+    # -- identity helpers ----------------------------------------------------
+    def lock_id(self, expr, class_name: Optional[str]) -> Optional[str]:
+        chain = _chain(expr)
+        if chain is None:
+            return None
+        last = chain.rsplit(".", 1)[-1]
+        if not _LOCKISH.search(last):
+            return None
+        if chain.startswith("self."):
+            rest = chain[len("self."):]
+            return f"{class_name}.{rest}" if class_name else rest
+        if "." not in chain:
+            return f"{self.modname}.{chain}"
+        return f"{self.modname}:{chain}"
+
+    # -- collection ----------------------------------------------------------
+    def collect(self):
+        # module-level statements run too (scripts, __main__ blocks):
+        # walk them as a pseudo-function so module-level spawns/withs
+        # are linted like any other code
+        top = _FuncInfo("<module>", None)
+        self.functions["<module>"] = top
+        toplevel = [n for n in self.tree.body
+                    if not isinstance(n, (ast.ClassDef, ast.FunctionDef,
+                                          ast.AsyncFunctionDef))]
+        self._walk(toplevel, top, [], None, "<module>")
+        for node in self.tree.body:
+            if isinstance(node, ast.ClassDef):
+                bases = [(_chain(b) or "") for b in node.bases]
+                if any(base.rsplit(".", 1)[-1].endswith("Handler")
+                       for base in bases):
+                    self.handler_classes.add(node.name)
+                self.class_methods[node.name] = set()
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        self.class_methods[node.name].add(sub.name)
+                        self._collect_function(sub, node.name,
+                                               f"{node.name}.{sub.name}")
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._collect_function(node, None, node.name)
+
+    def _collect_function(self, node, class_name, qualname):
+        info = _FuncInfo(qualname, class_name)
+        self.functions[qualname] = info
+        if class_name:
+            self.methods[(class_name, node.name)] = info
+        self._walk(node.body, info, [], class_name, qualname)
+
+    def _walk(self, stmts, info: _FuncInfo, held: List[str],
+              class_name, qualname):
+        for stmt in stmts:
+            self._walk_stmt(stmt, info, held, class_name, qualname)
+
+    def _walk_stmt(self, node, info, held, class_name, qualname):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested def is its own function (runs when CALLED, not
+            # where defined) — empty held stack of its own
+            nested = f"{qualname}.{node.name}"
+            sub = _FuncInfo(nested, class_name)
+            self.functions[nested] = sub
+            # callable by bare name from the enclosing scope
+            self.functions.setdefault(node.name, sub)
+            self._walk(node.body, sub, [], class_name, nested)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            pushed = []
+            for item in node.items:
+                lid = self.lock_id(item.context_expr, class_name)
+                if lid is not None:
+                    info.acquisitions.append(
+                        (lid, item.context_expr.lineno, tuple(held)))
+                    held.append(lid)
+                    pushed.append(lid)
+                else:
+                    self._scan_expr(item.context_expr, info, held,
+                                    class_name)
+            self._walk(node.body, info, held, class_name, qualname)
+            for _ in pushed:
+                held.pop()
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = list(node.targets) if isinstance(node, ast.Assign) \
+                else [node.target]
+            for tgt in list(targets):
+                if isinstance(tgt, (ast.Tuple, ast.List)):
+                    targets.extend(tgt.elts)
+            for tgt in targets:
+                if isinstance(tgt, ast.Attribute) and \
+                        isinstance(tgt.value, ast.Name) and \
+                        tgt.value.id == "self" and class_name:
+                    info.self_stores.append(
+                        (tgt.attr, tgt.lineno, bool(held)))
+                # `x.daemon = True`
+                if isinstance(tgt, ast.Attribute) and \
+                        tgt.attr == "daemon" and \
+                        isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Constant) and \
+                        node.value.value is True:
+                    recv = _chain(tgt.value)
+                    if recv:
+                        self.daemon_sets.add(recv.rsplit(".", 1)[-1])
+            value = getattr(node, "value", None)
+            if value is not None:
+                spawn = self._thread_spawn_of(value, info)
+                if spawn is not None:
+                    for tgt in targets:
+                        tchain = _chain(tgt)
+                        if tchain:
+                            spawn.assigned_to = tchain.rsplit(".", 1)[-1]
+                            spawn.assigned_self = \
+                                tchain.startswith("self.")
+                self._scan_expr(value, info, held, class_name)
+            return
+        # generic: scan this statement's expressions, recurse into bodies
+        for field in ("test", "iter", "value", "exc", "cause"):
+            sub = getattr(node, field, None)
+            if isinstance(sub, ast.expr):
+                self._scan_expr(sub, info, held, class_name)
+        for field in ("body", "orelse", "finalbody"):
+            body = getattr(node, field, None)
+            if isinstance(body, list):
+                self._walk(body, info, held, class_name, qualname)
+        for handler in getattr(node, "handlers", []) or []:
+            self._walk(handler.body, info, held, class_name, qualname)
+
+    def _scan_expr(self, expr, info, held, class_name):
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                self._scan_call(node, info, held, class_name)
+
+    # -- call classification -------------------------------------------------
+    def _thread_spawn_of(self, expr, info) -> Optional[_ThreadSpawn]:
+        """A threading.Thread(...) / Thread(...) construction (also when
+        wrapped as `Thread(...).start()` or inside a comprehension)."""
+        calls = [n for n in ast.walk(expr) if isinstance(n, ast.Call)]
+        for call in calls:
+            chain = _chain(call.func) or ""
+            if chain in ("threading.Thread", "Thread"):
+                spawn = _ThreadSpawn(call.lineno, info)
+                for kw in call.keywords:
+                    if kw.arg == "name":
+                        spawn.has_name = True
+                    elif kw.arg == "daemon" and \
+                            isinstance(kw.value, ast.Constant) and \
+                            kw.value.value is True:
+                        spawn.daemon = True
+                    elif kw.arg == "target":
+                        tchain = _chain(kw.value) or ""
+                        if tchain.startswith("self."):
+                            spawn.target_method = tchain[len("self."):]
+                        elif tchain and "." not in tchain:
+                            spawn.target_func = tchain
+                self.spawns.append(spawn)
+                return spawn
+        return None
+
+    def _scan_call(self, call: ast.Call, info: _FuncInfo, held, class_name):
+        chain = _chain(call.func)
+        if chain in ("threading.Thread", "Thread"):
+            if not any(s.line == call.lineno for s in self.spawns):
+                self._thread_spawn_of(call, info)
+            return
+        if chain is None:
+            return
+        parts = chain.split(".")
+        last = parts[-1]
+        recv = ".".join(parts[:-1])
+        recv_last = parts[-2] if len(parts) > 1 else ""
+        kwargs = {kw.arg for kw in call.keywords if kw.arg}
+        npos = len(call.args)
+        line = call.lineno
+        held_t = tuple(held)
+
+        # explicit lock.acquire() counts as an acquisition edge
+        if last == "acquire" and recv:
+            lid = self.lock_id(call.func.value, class_name)
+            if lid is not None:
+                info.acquisitions.append((lid, line, held_t))
+                return
+
+        # joins feed the thread-lifecycle rule
+        if last == "join" and recv:
+            bounded = npos >= 1 or "timeout" in kwargs
+            self.joins.append((recv_last, bounded, info.qualname))
+
+        # resolvable same-module calls (for lock/blocking expansion)
+        if chain.startswith("self.") and len(parts) == 2 and class_name:
+            info.calls.append((("m", class_name, last), chain, line,
+                               held_t))
+        elif len(parts) == 1:
+            info.calls.append((("f", last), chain, line, held_t))
+
+        # direct blocking operations
+        desc = self._blocking_desc(chain, parts, last, recv, recv_last,
+                                   kwargs, npos, held)
+        if desc is not None:
+            info.blocking.append((desc, line, held_t))
+
+    def _blocking_desc(self, chain, parts, last, recv, recv_last,
+                       kwargs, npos, held) -> Optional[str]:
+        if chain == "time.sleep":
+            return "time.sleep()"
+        if parts[0] == "subprocess" and last in _BLOCK_SUBPROCESS:
+            return f"subprocess.{last}()"
+        if last in _BLOCK_SOCKET_METHODS:
+            return f"socket/HTTP operation .{last}()"
+        if last in ("get", "put") and "timeout" not in kwargs and \
+                _QUEUEISH.search(recv_last or ""):
+            if last == "get" and npos > 0:
+                return None   # dict-style get(key)
+            return f"queue .{last}() without timeout"
+        if last == "join" and recv and npos == 0 and \
+                "timeout" not in kwargs:
+            return "unbounded .join()"
+        if last in ("wait", "wait_for") and "timeout" not in kwargs and \
+                (npos == 0 if last == "wait" else npos <= 1):
+            # waiting on the condition/lock you hold is how Conditions
+            # work; any OTHER unbounded wait under a lock is a stall seed
+            if recv and _LOCKISH.search(recv_last or ""):
+                return None
+            return f"unbounded .{last}()"
+        if chain == "jax.jit" or \
+                (last == "run" and "predictor" in (recv or "").lower()):
+            return f"jit/compile entry point {chain}()"
+        return None
+
+
+# ---------------------------------------------------------------------------
+# module-level rules
+# ---------------------------------------------------------------------------
+
+def _lock_footprints(mod: _ModuleLint) -> Dict[str, Set[str]]:
+    """Transitive per-function lock-acquisition sets (same-module call
+    resolution, cycle-safe)."""
+    memo: Dict[str, Set[str]] = {}
+    visiting: Set[str] = set()
+
+    def resolve(key) -> Optional[_FuncInfo]:
+        if key[0] == "m":
+            return mod.methods.get((key[1], key[2]))
+        return mod.functions.get(key[1])
+
+    def fp(name: str) -> Set[str]:
+        if name in memo:
+            return memo[name]
+        if name in visiting:
+            return set()
+        visiting.add(name)
+        info = mod.functions[name]
+        out = {lid for lid, _, _ in info.acquisitions}
+        for key, _disp, _line, _held in info.calls:
+            callee = resolve(key)
+            if callee is not None and callee.qualname in mod.functions:
+                out |= fp(callee.qualname)
+        visiting.discard(name)
+        memo[name] = out
+        return out
+
+    for name in mod.functions:
+        fp(name)
+    return memo
+
+
+def _blocking_surfaces(mod: _ModuleLint) -> Dict[str, List[Tuple[str, int]]]:
+    """Transitive blocking operations reachable from a function's entry
+    with NO lock held inside it (i.e. what a caller inherits)."""
+    memo: Dict[str, List[Tuple[str, int]]] = {}
+    visiting: Set[str] = set()
+
+    def resolve(key) -> Optional[_FuncInfo]:
+        if key[0] == "m":
+            return mod.methods.get((key[1], key[2]))
+        return mod.functions.get(key[1])
+
+    def surface(name: str) -> List[Tuple[str, int]]:
+        if name in memo:
+            return memo[name]
+        if name in visiting:
+            return []
+        visiting.add(name)
+        info = mod.functions[name]
+        out = [(desc, line) for desc, line, held in info.blocking
+               if not held]
+        for key, disp, line, held in info.calls:
+            if held:
+                continue
+            callee = resolve(key)
+            if callee is not None:
+                for desc, bline in surface(callee.qualname):
+                    out.append((f"{desc} (via {disp}:{bline})", line))
+        visiting.discard(name)
+        memo[name] = out[:8]
+        return memo[name]
+
+    for name in mod.functions:
+        surface(name)
+    return memo
+
+
+def _rule_lock_order(mod: _ModuleLint):
+    footprints = _lock_footprints(mod)
+    edges: Dict[str, Set[str]] = {}
+    sites: Dict[Tuple[str, str], Tuple[int, str]] = {}
+
+    def resolve(key) -> Optional[_FuncInfo]:
+        if key[0] == "m":
+            return mod.methods.get((key[1], key[2]))
+        return mod.functions.get(key[1])
+
+    def add(a: str, b: str, line: int, why: str):
+        if a == b:
+            return   # same name = same instance or a sibling; skip
+        edges.setdefault(a, set()).add(b)
+        sites.setdefault((a, b), (line, why))
+
+    for info in mod.functions.values():
+        for lid, line, held in info.acquisitions:
+            for h in dict.fromkeys(held):
+                add(h, lid, line, f"'{lid}' acquired directly")
+        for key, disp, line, held in info.calls:
+            if not held:
+                continue
+            callee = resolve(key)
+            if callee is None:
+                continue
+            for lid in footprints.get(callee.qualname, ()):
+                for h in dict.fromkeys(held):
+                    add(h, lid, line, f"'{lid}' acquired inside {disp}()")
+
+    # strongly connected components (iterative Tarjan)
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    onstack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v0: str):
+        work = [(v0, iter(sorted(edges.get(v0, ()))))]
+        index[v0] = low[v0] = counter[0]
+        counter[0] += 1
+        stack.append(v0)
+        onstack.add(v0)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    onstack.add(w)
+                    work.append((w, iter(sorted(edges.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in onstack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    onstack.discard(w)
+                    scc.append(w)
+                    if w == v:
+                        break
+                if len(scc) > 1:
+                    sccs.append(scc)
+
+    for v in sorted(edges):
+        if v not in index:
+            strongconnect(v)
+
+    for scc in sccs:
+        members = set(scc)
+        cyc = " <-> ".join(sorted(members))
+        for (a, b), (line, why) in sorted(sites.items(),
+                                          key=lambda kv: kv[1][0]):
+            if a in members and b in members:
+                mod.findings.append(Finding(
+                    "lock-order", mod.path, line,
+                    f"acquiring '{b}' while holding '{a}' closes a "
+                    f"lock-order cycle [{cyc}] — potential A/B-B/A "
+                    f"deadlock ({why})"))
+
+
+def _rule_blocking(mod: _ModuleLint):
+    surfaces = _blocking_surfaces(mod)
+
+    def resolve(key) -> Optional[_FuncInfo]:
+        if key[0] == "m":
+            return mod.methods.get((key[1], key[2]))
+        return mod.functions.get(key[1])
+
+    for info in mod.functions.values():
+        for desc, line, held in info.blocking:
+            if held:
+                mod.findings.append(Finding(
+                    "blocking-call-under-lock", mod.path, line,
+                    f"{desc} while holding lock '{held[-1]}' — a slow "
+                    f"peer stalls every thread contending on it"))
+        for key, disp, line, held in info.calls:
+            if not held:
+                continue
+            callee = resolve(key)
+            if callee is None:
+                continue
+            surf = surfaces.get(callee.qualname) or []
+            if surf:
+                desc, bline = surf[0]
+                mod.findings.append(Finding(
+                    "blocking-call-under-lock", mod.path, line,
+                    f"call to {disp}() performs {desc} while lock "
+                    f"'{held[-1]}' is held"))
+
+
+def _rule_unlocked_fields(mod: _ModuleLint):
+    # entrypoints: Thread(target=self.m) targets anywhere in the class,
+    # plus do_* methods of *Handler subclasses (server worker threads)
+    entry_by_class: Dict[str, Set[str]] = {}
+    for spawn in mod.spawns:
+        if spawn.target_method and spawn.func.class_name:
+            entry_by_class.setdefault(spawn.func.class_name, set()).add(
+                spawn.target_method)
+    for cls in mod.handler_classes:
+        for m in mod.class_methods.get(cls, ()):
+            if m.startswith("do_"):
+                entry_by_class.setdefault(cls, set()).add(m)
+
+    for cls, entries in entry_by_class.items():
+        methods = mod.class_methods.get(cls, set())
+        # close each entrypoint over its same-class callees
+        reach: Set[str] = set()
+        frontier = [m for m in entries if m in methods]
+        while frontier:
+            m = frontier.pop()
+            if m in reach:
+                continue
+            reach.add(m)
+            info = mod.methods.get((cls, m))
+            if info is None:
+                continue
+            for key, _disp, _line, _held in info.calls:
+                if key[0] == "m" and key[1] == cls and key[2] in methods:
+                    frontier.append(key[2])
+        # collect per-attr write contexts
+        writes: Dict[str, List[Tuple[str, int, bool, str]]] = {}
+        for m in methods:
+            if m == "__init__":
+                continue
+            info = mod.methods.get((cls, m))
+            if info is None:
+                continue
+            ctx = "worker" if m in reach else "main"
+            for attr, line, locked in info.self_stores:
+                writes.setdefault(attr, []).append((ctx, line, locked, m))
+        for attr, sites in writes.items():
+            ctxs = {c for c, _, _, _ in sites}
+            unlocked = [(line, m) for _c, line, locked, m in sites
+                        if not locked]
+            if len(ctxs) >= 2 and unlocked:
+                for line, m in unlocked:
+                    mod.findings.append(Finding(
+                        "unlocked-shared-field", mod.path, line,
+                        f"'self.{attr}' is written from a thread "
+                        f"entrypoint path and from the main path, but "
+                        f"this write in {cls}.{m}() holds no lock — "
+                        f"torn/lost update under concurrency"))
+
+
+def _rule_thread_lifecycle(mod: _ModuleLint):
+    for spawn in mod.spawns:
+        if not spawn.has_name:
+            mod.findings.append(Finding(
+                "thread-unnamed", mod.path, spawn.line,
+                "threading.Thread(...) without name= — stall dumps, "
+                "excepthook records and ps/top views need the "
+                "'pt-<subsystem>-<role>' name"))
+        daemon = spawn.daemon or (
+            spawn.assigned_to is not None and
+            spawn.assigned_to in mod.daemon_sets)
+        if daemon:
+            continue
+        joined = False
+        for recv_last, bounded, qual in mod.joins:
+            if not bounded:
+                continue
+            if qual == spawn.func.qualname:
+                joined = True   # bounded join in the same function body
+                break
+            # a thread stored on self is typically joined from another
+            # method (start()/close() pairs) — match by attribute name
+            if spawn.assigned_self and recv_last == spawn.assigned_to:
+                joined = True
+                break
+        if not joined:
+            mod.findings.append(Finding(
+                "thread-unjoined", mod.path, spawn.line,
+                "non-daemon thread is never joined with a bounded "
+                "timeout — a wedged worker blocks interpreter exit "
+                "forever (pass daemon=True or join(timeout=...))"))
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def _apply_suppressions(mod: _ModuleLint):
+    sup: Dict[int, List[Tuple[Set[str], str]]] = {}
+    for i, line in enumerate(mod.lines, 1):
+        m = _SUPPRESS.search(line)
+        if m:
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            sup.setdefault(i, []).append((rules, m.group(2) or ""))
+    for f in mod.findings:
+        for ln in (f.line, f.line - 1):
+            for rules, reason in sup.get(ln, ()):
+                if f.rule in rules or "all" in rules:
+                    f.suppressed = reason or "no reason given"
+                    break
+            if f.suppressed is not None:
+                break
+
+
+def lint_file(path: str, result: LintResult):
+    try:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        tree = ast.parse(source, filename=path)
+    except (OSError, SyntaxError, ValueError) as e:
+        result.parse_errors.append((path, f"{type(e).__name__}: {e}"))
+        return
+    result.files += 1
+    mod = _ModuleLint(path, source, tree)
+    mod.collect()
+    _rule_lock_order(mod)
+    _rule_blocking(mod)
+    _rule_unlocked_fields(mod)
+    _rule_thread_lifecycle(mod)
+    _apply_suppressions(mod)
+    mod.findings.sort(key=lambda f: (f.line, f.rule))
+    for f in mod.findings:
+        (result.suppressed if f.suppressed is not None
+         else result.findings).append(f)
+
+
+def iter_sources(roots: List[str]) -> List[str]:
+    out = []
+    for root in roots:
+        if os.path.isfile(root):
+            out.append(root)
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return out
+
+
+def lint_paths(paths: List[str]) -> LintResult:
+    result = LintResult()
+    for path in iter_sources(paths):
+        lint_file(path, result)
+    result.findings.sort(key=lambda f: (f.path, f.line))
+    result.suppressed.sort(key=lambda f: (f.path, f.line))
+    return result
+
+
+def default_roots() -> List[str]:
+    """The lint scope from the repo root: framework + tools sources
+    (tests spawn scratch threads on purpose and are out of scope)."""
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    return [os.path.join(here, "paddle_tpu"), os.path.join(here, "tools")]

@@ -1,0 +1,445 @@
+"""Runtime lock sanitizer — lockdep/TSan discipline for the threaded
+runtime.
+
+PRs 4-10 made paddle_tpu a heavily threaded system (serving engine +
+router + cluster supervisor, PS RPC server threads, async checkpoint
+writer, telemetry flusher, heartbeat monitors). Every deadlock-freedom
+property was proven only dynamically by chaos tests; this module makes
+the two classic failure modes *detectable in process*, the way the Linux
+kernel's lockdep does:
+
+* **lock-order cycles** — every instrumented acquire records the edge
+  (each currently-held lock name) -> (acquired lock name) in one global
+  acquisition-order graph. An acquire that would close a cycle (thread 1
+  takes A then B while thread 2 takes B then A) raises a typed
+  :class:`LockOrderError` *before blocking* — the potential deadlock is
+  reported the first time the inverted order is even attempted, whether
+  or not the schedule actually wedged;
+* **same-thread re-entry** — re-acquiring a non-reentrant lock the
+  current thread already holds is a guaranteed self-deadlock; it raises
+  :class:`LockOrderError` immediately instead of hanging;
+* **stall watchdog** — a daemon thread watches every in-flight
+  instrumented acquire; one that has been waiting longer than
+  ``FLAGS_lock_stall_s`` produces a ``kind:"stall"`` run-log record with
+  ALL thread stacks (named threads, held/waited locks) — the 3 a.m.
+  wedged-router forensics, captured while the process is still wedged;
+* **contention accounting** — ``lock.acquires`` / ``lock.contentions``
+  counters and per-lock ``lock.<name>.held_ms`` / ``lock.<name>.wait_ms``
+  timers, rendered by tools/perf_report.py's "Concurrency" section.
+
+Cost discipline (same as core/costmodel.py): everything is behind
+``FLAGS_sanitize_locks``, default off. The factories below return PLAIN
+``threading`` primitives when the flag is off — zero wrapper, zero
+records, bit-identical lock behavior. The flag is read at *construction*
+time, so enabling it mid-process instruments locks created afterwards
+(tests construct their engines/routers under the flag; module-level
+locks pick it up via the FLAGS_sanitize_locks env var at import).
+
+Static twin: tools/lint_concurrency.py runs the same discipline over the
+SOURCES (core/analysis/concurrency_lint.py) — lock-order inversions,
+blocking calls under locks and unguarded shared fields become lint
+failures before they become runtime stalls.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional, Set
+
+from .. import flags as _flags
+
+
+class LockOrderError(RuntimeError):
+    """A lock acquisition that is a (potential) deadlock: either it
+    closes a cycle in the global acquisition-order graph, or it re-enters
+    a non-reentrant lock the same thread already holds."""
+
+
+def enabled() -> bool:
+    return bool(_flags.flag("sanitize_locks"))
+
+
+# -- global sanitizer state ---------------------------------------------------
+# _state_lock is a PLAIN lock guarding the order graph + waiter table; it
+# is never held while blocking on an instrumented lock or calling out
+# into telemetry, so it cannot itself participate in a deadlock.
+_state_lock = threading.Lock()
+_edges: Dict[str, Set[str]] = {}            # name -> names acquired under it
+_waiters: Dict[int, Dict[str, Any]] = {}    # thread ident -> waiting info
+_held_by_thread: Dict[int, List[Dict[str, Any]]] = {}   # diagnostics mirror
+_watchdog_started = False
+
+_tls = threading.local()
+
+
+def _held() -> List[Dict[str, Any]]:
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = _tls.held = []
+        _held_by_thread[threading.get_ident()] = held
+    return held
+
+
+def _booking() -> bool:
+    return bool(getattr(_tls, "booking", False))
+
+
+def _book(fn, *args, **kwargs):
+    """Run one telemetry call with the re-entrancy guard set: telemetry's
+    own (instrumented) registry lock must not recurse back into
+    order-recording/booking from inside a booking call."""
+    _tls.booking = True
+    try:
+        fn(*args, **kwargs)
+    except Exception:
+        pass
+    finally:
+        _tls.booking = False
+
+
+def _telemetry():
+    from .. import telemetry
+
+    return telemetry
+
+
+def _reachable(src: str, dst: str) -> Optional[List[str]]:
+    """Path src ->* dst in the order graph (caller holds _state_lock);
+    returns the node path or None."""
+    stack = [(src, [src])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        if node == dst:
+            return path
+        for nxt in _edges.get(node, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+def reset_order_graph():
+    """Drop every recorded acquisition-order edge (tests)."""
+    with _state_lock:
+        _edges.clear()
+
+
+def _ensure_watchdog():
+    global _watchdog_started
+    with _state_lock:
+        if _watchdog_started:
+            return
+        _watchdog_started = True
+    threading.Thread(target=_watchdog_loop, name="pt-lockdep-watchdog",
+                     daemon=True).start()
+
+
+def _watchdog_loop():
+    """Scan the waiter table; any instrumented acquire stalled past
+    FLAGS_lock_stall_s gets ONE all-thread stack dump (kind:"stall")."""
+    while True:
+        try:
+            stall_s = float(_flags.flag("lock_stall_s"))
+        except Exception:
+            stall_s = 30.0
+        time.sleep(max(min(stall_s / 4.0, 0.5), 0.02))
+        now = time.monotonic()
+        dumps = []
+        with _state_lock:
+            for ident, w in _waiters.items():
+                if not w.get("dumped") and now - w["t0"] >= stall_s:
+                    w["dumped"] = True
+                    dumps.append((ident, dict(w)))
+        for ident, w in dumps:
+            _dump_stall(ident, w, now - w["t0"])
+
+
+def _thread_table() -> Dict[int, str]:
+    return {t.ident: t.name for t in threading.enumerate()
+            if t.ident is not None}
+
+
+def _dump_stall(ident: int, waiter: Dict[str, Any], waited_s: float):
+    """One stalled acquire -> one kind:"stall" record: every live
+    thread's name, held locks, waited lock and stack."""
+    names = _thread_table()
+    with _state_lock:
+        waiting = {tid: dict(w) for tid, w in _waiters.items()}
+        held = {tid: [dict(e) for e in entries]
+                for tid, entries in _held_by_thread.items() if entries}
+    threads = []
+    for tid, frame in sys._current_frames().items():
+        info = {
+            "name": names.get(tid, f"tid-{tid}"),
+            "ident": tid,
+            "held": [e["name"] for e in held.get(tid, [])],
+            "stack": "".join(traceback.format_stack(frame, limit=12)),
+        }
+        w = waiting.get(tid)
+        if w is not None:
+            info["waiting_for"] = w["lock"]
+            info["waited_s"] = round(time.monotonic() - w["t0"], 3)
+        threads.append(info)
+    tel = _telemetry()
+    _book(tel.counter_add, "lock.stalls", 1, lock=waiter["lock"],
+          thread=names.get(ident, f"tid-{ident}"))
+    _book(tel.event, "stall", "lockdep.stall", round(waited_s, 3), {
+        "lock": waiter["lock"],
+        "thread": names.get(ident, f"tid-{ident}"),
+        "waited_s": round(waited_s, 3),
+        "stall_s": float(_flags.flag("lock_stall_s")),
+        "threads": threads,
+    })
+
+
+class SanitizedLock:
+    """Instrumented Lock/RLock: same acquire/release/context-manager
+    surface, plus order-graph recording, re-entry detection, stall
+    registration and held/wait accounting. Also implements the
+    ``_is_owned``/``_release_save``/``_acquire_restore`` trio so it can
+    back a ``threading.Condition``."""
+
+    def __init__(self, name: str, reentrant: bool = False,
+                 record: bool = True):
+        self.name = name
+        self._reentrant = bool(reentrant)
+        self._record = bool(record)
+        self._inner = threading.RLock() if reentrant else threading.Lock()
+        _ensure_watchdog()
+
+    def __repr__(self):
+        return (f"<SanitizedLock {self.name!r} "
+                f"{'rlock' if self._reentrant else 'lock'}>")
+
+    # -- order graph ---------------------------------------------------------
+    def _depth(self, held) -> int:
+        return sum(1 for e in held if e["inst"] is self)
+
+    def _check_order(self, held):
+        """Record held->self edges; raise before blocking when the new
+        edge would close a cycle (a lockdep 'circular dependency')."""
+        held_names = []
+        for e in held:
+            if e["name"] != self.name and e["name"] not in held_names:
+                held_names.append(e["name"])
+        if not held_names:
+            return
+        with _state_lock:
+            for h in held_names:
+                path = _reachable(self.name, h)
+                if path is not None:
+                    cycle = " -> ".join(path + [self.name])
+                    break
+            else:
+                for h in held_names:
+                    _edges.setdefault(h, set()).add(self.name)
+                return
+        tel = _telemetry()
+        _book(tel.counter_add, "lock.order_violations", 1, lock=self.name,
+              thread=threading.current_thread().name)
+        _book(tel.event, "lock_order", "lockdep.order_violation", None, {
+            "lock": self.name, "held": held_names, "cycle": cycle,
+            "thread": threading.current_thread().name})
+        raise LockOrderError(
+            f"lock-order inversion acquiring '{self.name}' while holding "
+            f"{held_names} (thread '{threading.current_thread().name}'): "
+            f"existing order {cycle} would close a cycle — potential "
+            f"deadlock")
+
+    def _push(self, held, t0: float):
+        held.append({"name": self.name, "inst": self, "t0": t0})
+
+    def _pop(self, held) -> Optional[float]:
+        """Pop the most recent entry for this instance; returns its
+        acquire time when this release drops the lock entirely (the
+        outermost release of a reentrant hold)."""
+        for i in range(len(held) - 1, -1, -1):
+            if held[i]["inst"] is self:
+                entry = held.pop(i)
+                if self._depth(held) == 0:
+                    return entry["t0"]
+                return None
+        return None
+
+    # -- lock surface --------------------------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        held = _held()
+        if not blocking:
+            ok = self._inner.acquire(False)
+            if ok:
+                self._push(held, time.monotonic())
+            return ok
+        booking = _booking()
+        if not self._reentrant and self._depth(held):
+            if not booking:
+                tel = _telemetry()
+                _book(tel.counter_add, "lock.order_violations", 1,
+                      lock=self.name, reentry=True)
+            raise LockOrderError(
+                f"re-entry: thread '{threading.current_thread().name}' "
+                f"already holds non-reentrant lock '{self.name}' — "
+                f"acquiring it again would self-deadlock")
+        if not booking and self._depth(held) == 0:
+            self._check_order(held)
+        # fast path: uncontended acquire costs one trylock + a list append
+        if self._inner.acquire(False):
+            self._push(held, time.monotonic())
+            if not booking and self._record:
+                _book(_telemetry().counter_quiet, "lock.acquires")
+            return True
+        # contended: register with the watchdog, then block
+        ident = threading.get_ident()
+        t0 = time.monotonic()
+        with _state_lock:
+            _waiters[ident] = {"lock": self.name, "t0": t0,
+                               "thread": threading.current_thread().name}
+        try:
+            if timeout is not None and timeout >= 0:
+                ok = self._inner.acquire(True, timeout)
+            else:
+                ok = self._inner.acquire(True)
+        finally:
+            with _state_lock:
+                _waiters.pop(ident, None)
+        if not ok:
+            return False
+        now = time.monotonic()
+        self._push(held, now)
+        if not booking and self._record:
+            tel = _telemetry()
+            _book(tel.counter_quiet, "lock.acquires")
+            _book(tel.counter_quiet, "lock.contentions")
+            _book(tel.observe, f"lock.{self.name}.wait_ms",
+                  (now - t0) * 1e3, kind="timer")
+        return True
+
+    def release(self):
+        held = _held()
+        t0 = self._pop(held)
+        self._inner.release()
+        if t0 is not None and self._record and not _booking():
+            _book(_telemetry().observe, f"lock.{self.name}.held_ms",
+                  (time.monotonic() - t0) * 1e3, kind="timer")
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, et, ev, tb):
+        self.release()
+        return False
+
+    def locked(self) -> bool:
+        if hasattr(self._inner, "locked"):
+            return self._inner.locked()
+        return False   # RLock has no locked(); Condition never asks
+
+    # -- Condition backing ---------------------------------------------------
+    def _is_owned(self) -> bool:
+        return self._depth(_held()) > 0
+
+    def _release_save(self):
+        """Drop ALL recursion levels (Condition.wait); returns opaque
+        state for _acquire_restore."""
+        held = _held()
+        depth = self._depth(held)
+        t0 = None
+        for _ in range(depth):
+            t = self._pop(held)
+            if t is not None:
+                t0 = t
+        if hasattr(self._inner, "_release_save"):
+            inner_state = self._inner._release_save()
+        else:
+            self._inner.release()
+            inner_state = None
+        if t0 is not None and self._record and not _booking():
+            _book(_telemetry().observe, f"lock.{self.name}.held_ms",
+                  (time.monotonic() - t0) * 1e3, kind="timer")
+        return (inner_state, depth)
+
+    def _acquire_restore(self, state):
+        inner_state, depth = state
+        if hasattr(self._inner, "_acquire_restore"):
+            self._inner._acquire_restore(inner_state)
+        else:
+            self._inner.acquire()
+        held = _held()
+        now = time.monotonic()
+        for _ in range(max(depth, 1)):
+            self._push(held, now)
+
+
+# -- factories (the surface the lock-holding modules adopt) -------------------
+
+def lock(name: str, record: bool = True):
+    """A mutex named for the order graph. Returns a plain
+    ``threading.Lock()`` when FLAGS_sanitize_locks is off (zero cost);
+    an instrumented :class:`SanitizedLock` when on. ``record=False``
+    keeps detection but skips telemetry booking — for locks inside the
+    telemetry registry itself."""
+    if not enabled():
+        return threading.Lock()
+    return SanitizedLock(name, reentrant=False, record=record)
+
+
+def rlock(name: str, record: bool = True):
+    if not enabled():
+        return threading.RLock()
+    return SanitizedLock(name, reentrant=True, record=record)
+
+
+def condition(name: str, record: bool = True):
+    """A ``threading.Condition`` whose underlying lock is sanitized
+    (reentrant, matching Condition's default RLock)."""
+    if not enabled():
+        return threading.Condition()
+    return threading.Condition(
+        SanitizedLock(name, reentrant=True, record=record))
+
+
+def held_locks() -> List[str]:
+    """Names of instrumented locks the CURRENT thread holds (tests)."""
+    return [e["name"] for e in _held()]
+
+
+# -- thread excepthook (satellite: no silent worker deaths) -------------------
+
+_excepthook_installed = False
+
+
+def install_thread_excepthook():
+    """Chain onto ``threading.excepthook``: an uncaught exception in any
+    worker thread books ``threads.uncaught_exceptions`` (thread name +
+    exception type) and a ``kind:"thread_error"`` run-log record with
+    the traceback, then falls through to the previous hook (which still
+    prints to stderr). Idempotent; always on — a died-silently thread is
+    a bug regardless of FLAGS_sanitize_locks."""
+    global _excepthook_installed
+    if _excepthook_installed:
+        return
+    _excepthook_installed = True
+    prev = threading.excepthook
+
+    def hook(args):
+        if args.exc_type is not SystemExit:
+            try:
+                name = args.thread.name if args.thread is not None else "?"
+                tb = "".join(traceback.format_exception(
+                    args.exc_type, args.exc_value, args.exc_traceback))
+                tel = _telemetry()
+                tel.counter_add("threads.uncaught_exceptions", 1,
+                                thread=name, exc=args.exc_type.__name__)
+                tel.event("thread_error", name, None, {
+                    "exc": args.exc_type.__name__,
+                    "message": str(args.exc_value)[:500],
+                    "traceback": tb[-4000:]})
+            except Exception:
+                pass
+        prev(args)
+
+    threading.excepthook = hook

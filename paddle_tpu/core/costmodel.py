@@ -60,6 +60,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from . import telemetry
+from .analysis import lockdep as _lockdep
 from .flags import flag as _flag
 
 # -- typed OOM error ----------------------------------------------------------
@@ -224,7 +225,7 @@ class ProgramCost:
 
 _PROGRAM_CAP = 256      # bounded registry of captured programs
 _programs: "OrderedDict[str, ProgramCost]" = OrderedDict()
-_lock = threading.Lock()
+_lock = _lockdep.lock("costmodel.programs")
 _last_mfu_set = [0.0]   # throttle for the live-MFU gauge refresh
 
 

@@ -49,6 +49,7 @@ from typing import Any, Dict, List, Optional
 
 from . import flags as _flags
 from . import telemetry
+from .analysis import lockdep as _lockdep
 
 
 class FaultSpecError(ValueError):
@@ -134,7 +135,7 @@ class FaultRegistry:
     _instance_lock = threading.Lock()
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = _lockdep.lock("faults.registry")
         self._rules: Dict[str, List[_Rule]] = {}
         self._calls: Dict[str, int] = {}
         self._injected: Dict[str, int] = {}

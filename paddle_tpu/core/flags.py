@@ -294,6 +294,28 @@ define_flag("ckpt_verify", True,
             "ckpt.fallbacks telemetry). Disabling skips only the digest "
             "work — the commit manifest itself is always required")
 
+define_flag("sanitize_locks", False,
+            "runtime concurrency sanitizer (core/analysis/lockdep.py, "
+            "the lockdep/TSan discipline): the lock factories the "
+            "threaded subsystems build their locks through return "
+            "instrumented wrappers that record per-thread acquisition "
+            "order in one global graph, raise a typed LockOrderError on "
+            "a lock-order cycle or a same-thread re-entry of a "
+            "non-reentrant lock (potential deadlocks become errors "
+            "BEFORE the schedule wedges), book lock.acquires/"
+            "lock.contentions counters + per-lock held/wait-ms timers "
+            "into telemetry, and register with a stall watchdog. Off "
+            "(default): the factories return plain threading primitives "
+            "— zero wrapper, zero lock.* records. Read at lock "
+            "CONSTRUCTION time; module-level locks pick a flip up via "
+            "the env var at import")
+define_flag("lock_stall_s", 30.0,
+            "deadlock-watchdog threshold (FLAGS_sanitize_locks): an "
+            "instrumented lock acquire still waiting after this many "
+            "seconds makes the watchdog thread dump EVERY thread's "
+            "stack, held locks and waited lock into the run log as one "
+            "kind:'stall' record (lock.stalls counts them) — wedged-"
+            "process forensics captured while it is still wedged")
 define_flag("ps_degrade_to_survivors", False,
             "when the HeartBeatMonitor declares a trainer dead, shrink "
             "the sync barrier to the live set (mean over survivors) "

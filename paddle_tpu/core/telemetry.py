@@ -65,6 +65,7 @@ from collections import deque
 from typing import Any, Dict, Optional
 
 from . import flags as _flags
+from .analysis import lockdep as _lockdep
 
 SCHEMA_FIELDS = ("ts", "kind", "name", "value", "attrs")
 
@@ -120,7 +121,10 @@ class TelemetryRegistry:
     _instance_lock = threading.Lock()
 
     def __init__(self):
-        self._lock = threading.RLock()
+        # record=False: the sanitizer books its lock metrics THROUGH this
+        # registry — the registry's own lock gets order/re-entry/stall
+        # detection but must not book about itself
+        self._lock = _lockdep.rlock("telemetry.registry", record=False)
         self._counters: Dict[str, Any] = {}
         self._gauges: Dict[str, Any] = {}
         self._hists: Dict[str, _Hist] = {}

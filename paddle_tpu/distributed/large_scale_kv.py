@@ -15,10 +15,11 @@ set with jax.distributed coordinating — the pull/push surface is the same.
 
 from __future__ import annotations
 
-import threading
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
+
+from ..core.analysis import lockdep
 
 
 def id_keyed_init(seed: int = 0, scale: float = 0.01):
@@ -54,7 +55,7 @@ class SparseShard:
         self.dim = dim
         self.table: Dict[int, np.ndarray] = {}
         self.init = initializer          # init(dim, id) -> row
-        self.lock = threading.Lock()
+        self.lock = lockdep.lock("kv.shard")
 
     def pull(self, ids: np.ndarray) -> np.ndarray:
         out = np.empty((len(ids), self.dim), np.float32)

@@ -35,10 +35,14 @@ caller through _fanout, never silently dropping that shard's gradients.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
 
+from ...core import flags as _flags
+from ...core.analysis import lockdep
+from ..errors import RpcError
 from ..large_scale_kv import LargeScaleKV, id_keyed_init
 from .rpc import RPCClient
 
@@ -68,7 +72,7 @@ class KVTables:
     def __init__(self):
         self.tables: Dict[str, LargeScaleKV] = {}
         self._specs: Dict[str, tuple] = {}
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("ps.kv.tables")
 
     def ensure(self, name: str, dim: int, seed: int = 0) -> LargeScaleKV:
         with self._lock:
@@ -205,11 +209,24 @@ class DistributedKV:
             except Exception as e:
                 errors.append(e)
 
-        threads = [threading.Thread(target=wrap, args=(j,)) for j in jobs]
+        threads = [threading.Thread(target=wrap, args=(j,),
+                                    name=f"pt-ps-kv-fanout-{i}",
+                                    daemon=True)
+                   for i, j in enumerate(jobs)]
         for t in threads:
             t.start()
+        # bounded join: every job is an RPC whose own deadline
+        # (FLAGS_ps_rpc_timeout + retries) terminates it — a join that
+        # outlives twice that budget means the transport is wedged, and
+        # hanging the CALLER forever hides it
+        budget = float(_flags.flag("ps_rpc_timeout"))
+        deadline = time.monotonic() + (budget * 2 if budget > 0 else 600.0)
         for t in threads:
-            t.join()
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        if any(t.is_alive() for t in threads):
+            raise RpcError(
+                "kv fanout wedged: a shard RPC outlived twice its "
+                "deadline budget")
         if errors:
             raise errors[0]
 
@@ -249,7 +266,7 @@ class DistributedKV:
 
 
 _client_cache: Dict[tuple, DistributedKV] = {}
-_client_lock = threading.Lock()
+_client_lock = lockdep.lock("ps.kv.client_pool")
 
 
 def get_kv_client(endpoints: str, table: str, dim: int,

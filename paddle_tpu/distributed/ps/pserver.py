@@ -36,6 +36,7 @@ import numpy as np
 
 from ...core import faults, telemetry
 from ...core import flags as _flags
+from ...core.analysis import lockdep
 from ..errors import BarrierTimeoutError
 from .rpc import RPCServer
 
@@ -46,7 +47,7 @@ class ParamState:
     def __init__(self):
         self.pending: Dict[int, np.ndarray] = {}
         self.version = 0
-        self.cond = threading.Condition()
+        self.cond = lockdep.condition("ps.param_state")
 
 
 class HeartBeatMonitor:
@@ -69,7 +70,9 @@ class HeartBeatMonitor:
         self.num_trainers = int(num_trainers)
         self.dead: set = set()
         self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._watch, daemon=True)
+        self._thread = threading.Thread(target=self._watch,
+                                        name="pt-ps-heartbeat-monitor",
+                                        daemon=True)
 
     def start(self):
         import time
@@ -156,7 +159,7 @@ class PServer:
             g: ParamState() for g in self.grad_to_param}
         # one update at a time: connection threads race on the shared
         # scope (items() iteration vs insertion) and on the step counters
-        self._apply_lock = threading.Lock()
+        self._apply_lock = lockdep.lock("ps.apply")
         self.monitor = None
         if heartbeat_timeout > 0:
             self.monitor = HeartBeatMonitor(

@@ -55,6 +55,7 @@ import numpy as np
 from ...core import faults, telemetry, trace
 from ...core import flags as _flags
 from ...core import retry as _retry
+from ...core.analysis import lockdep
 from ..errors import RpcDeadlineError, RpcError, RpcRemoteError
 
 # trace-context separator on the wire: when a sampled trace is active the
@@ -155,7 +156,7 @@ class RPCServer:
         self._stop = threading.Event()
         self._threads = []
         self._conns = set()
-        self._conns_lock = threading.Lock()
+        self._conns_lock = lockdep.lock("rpc.server.conns")
         # retry dedup: client_id -> (last seq, reply | None=in-flight).
         # The client serialises its calls, so one entry per client makes
         # a resent frame (reply lost in transit) answerable without
@@ -164,8 +165,9 @@ class RPCServer:
         # client gave up on the reply early) waits on the condition for
         # the in-flight reply instead of racing a second apply.
         self._dedup: Dict[int, Tuple[int, Optional[tuple]]] = {}
-        self._dedup_cv = threading.Condition()
+        self._dedup_cv = lockdep.condition("rpc.server.dedup")
         self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               name="pt-ps-rpc-accept",
                                                daemon=True)
         self._accept_thread.start()
 
@@ -178,14 +180,17 @@ class RPCServer:
             with self._conns_lock:
                 self._conns.add(conn)
             t = threading.Thread(target=self._serve_conn, args=(conn,),
-                                 daemon=True)
+                                 name="pt-ps-rpc-conn", daemon=True)
             t.start()
             # reap finished connection threads so a long-lived server
-            # with churning clients doesn't grow the list without bound
-            self._threads.append(t)
-            if len(self._threads) > 32:
-                self._threads = [th for th in self._threads
-                                 if th.is_alive()]
+            # with churning clients doesn't grow the list without bound;
+            # the list is rebound here AND in shutdown() (another
+            # thread), so both writers take the conns lock
+            with self._conns_lock:
+                self._threads.append(t)
+                if len(self._threads) > 32:
+                    self._threads = [th for th in self._threads
+                                     if th.is_alive()]
 
     def _dedup_claim(self, client: int, seq: int) -> Optional[tuple]:
         """Returns the cached reply to replay for a duplicate frame, or
@@ -304,9 +309,12 @@ class RPCServer:
             except OSError:
                 pass
         deadline = time.monotonic() + 2.0
-        for t in self._threads:
+        with self._conns_lock:
+            threads = list(self._threads)
+        for t in threads:
             t.join(timeout=max(0.0, deadline - time.monotonic()))
-        self._threads = [t for t in self._threads if t.is_alive()]
+        with self._conns_lock:
+            self._threads = [t for t in self._threads if t.is_alive()]
 
 
 class RPCClient:
@@ -316,7 +324,7 @@ class RPCClient:
     under a deadline instead of dying with its socket."""
 
     _pool: Dict[str, "RPCClient"] = {}
-    _pool_lock = threading.Lock()
+    _pool_lock = lockdep.lock("rpc.client.pool")
     _ids = itertools.count(1)
 
     def __init__(self, endpoint: str, timeout: Optional[float] = None):
@@ -327,7 +335,9 @@ class RPCClient:
         self.endpoint = endpoint
         self._timeout = timeout
         self._sock: Optional[socket.socket] = None
-        self._lock = threading.Lock()
+        # held for the WHOLE retry schedule of one call: this client's
+        # calls are serialised by design (one socket, one in-flight seq)
+        self._lock = lockdep.lock("rpc.client.call")
         self._was_connected = False
         # (client id, per-call seq) ride the frame header for server-side
         # retry dedup; pid + process counter keeps ids unique across the
@@ -418,15 +428,17 @@ class RPCClient:
                         faults.maybe_fail("ps.rpc.send", method=method,
                                           endpoint=self.endpoint)
                         if self._sock is None:
+                            # pt-lint: disable=blocking-call-under-lock(one socket per client: calls serialise on the lock by design, bounded by the retry schedule's deadline)
                             self._connect(sched)
                         self._sock.settimeout(
                             sched.remaining(default=self._timeout))
+                        # pt-lint: disable=blocking-call-under-lock(serialised per-client protocol; the socket timeout bounds the send)
                         _send_msg(self._sock, wire_method, name, a, aux,
                                   self._client_id, seq)
                         faults.maybe_fail("ps.rpc.recv", method=method,
                                           endpoint=self.endpoint)
                         status, err, out, oaux, _, rseq = \
-                            _recv_msg(self._sock)
+                            _recv_msg(self._sock)  # pt-lint: disable=blocking-call-under-lock(reply read is the call; settimeout() above bounds it to the deadline)
                         if rseq and rseq != seq:
                             raise ConnectionError(
                                 f"out-of-sequence reply: got {rseq}, "
@@ -453,7 +465,7 @@ class RPCClient:
                                 f"{type(e).__name__}: {e}") from e
                         telemetry.counter_add("ps.rpc_retries", 1,
                                               method=method)
-                        time.sleep(delay)
+                        time.sleep(delay)  # pt-lint: disable=blocking-call-under-lock(retry backoff: concurrent callers of this client must wait out the schedule anyway; delay is deadline-clipped)
             # transport accounting (reference analog: the gRPC/BRPC client
             # metrics) — call count, payload bytes each way, latency
             # histogram
@@ -492,7 +504,7 @@ def start_heartbeat(endpoints, trainer_id: int, interval: float = 10.0):
         endpoints = [e.strip() for e in endpoints.split(",") if e.strip()]
     stop = threading.Event()
     clients: Dict[str, Optional[RPCClient]] = {ep: None for ep in endpoints}
-    clients_lock = threading.Lock()
+    clients_lock = lockdep.lock("rpc.heartbeat.clients")
 
     def beat():
         # connect lazily + reconnect after any failure: a pserver that is
@@ -515,7 +527,8 @@ def start_heartbeat(endpoints, trainer_id: int, interval: float = 10.0):
                         if cli is not None:
                             cli._close()
 
-    threading.Thread(target=beat, daemon=True).start()
+    threading.Thread(target=beat, name="pt-ps-heartbeat",
+                     daemon=True).start()
 
     def stop_heartbeat():
         stop.set()

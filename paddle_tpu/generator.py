@@ -7,12 +7,12 @@ plus a counter for unique per-op seeds."""
 
 from __future__ import annotations
 
-import threading
+from .core.analysis import lockdep as _lockdep
 
 
 class Generator:
     def __init__(self, seed: int = 0):
-        self._lock = threading.Lock()
+        self._lock = _lockdep.lock("generator.state")
         self._seed = seed
         self._offset = 0
 

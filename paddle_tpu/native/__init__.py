@@ -15,12 +15,13 @@ import ctypes
 import hashlib
 import os
 import subprocess
-import threading
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-_LOCK = threading.Lock()
+from ..core.analysis import lockdep as _lockdep
+
+_LOCK = _lockdep.lock("native.build")
 _LIB = None
 _ERR: Optional[str] = None
 
@@ -100,6 +101,7 @@ def get_lib():
     global _LIB
     with _LOCK:
         if _LIB is None and _ERR is None:
+            # pt-lint: disable=blocking-call-under-lock(one-time g++ build on first use; concurrent importers MUST wait for it rather than double-compile)
             _build_and_load()
     return _LIB
 

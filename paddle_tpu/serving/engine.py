@@ -34,6 +34,7 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from ..core import costmodel, faults, telemetry, trace
+from ..core.analysis import lockdep
 from ..core.flags import flag as _flag
 from .admission import (AdmissionQueue, EngineClosedError, InferenceRequest,
                         ServingError)
@@ -107,8 +108,8 @@ class ServingEngine:
         self.queue = AdmissionQueue(self.config.max_queue_depth,
                                     self.config.default_deadline_ms)
         self._thread: Optional[threading.Thread] = None
-        self._infer_lock = threading.Lock()
-        self._swap_lock = threading.Lock()
+        self._infer_lock = lockdep.lock("engine.infer")
+        self._swap_lock = lockdep.lock("engine.swap")
         self._feed_names = list(predictor.feed_names)
         self._fetch_names = list(predictor.fetch_names)
         # liveness/readiness state machine (health.py): STARTING until
@@ -257,6 +258,7 @@ class ServingEngine:
                         for n, (shape, dtype) in specs.items()}
                 if locked:
                     with self._infer_lock:
+                        # pt-lint: disable=blocking-call-under-lock(warmup of the LIVE predictor must exclude the worker's batches; the lock is exactly what serialises them)
                         predictor.run(feed)
                 else:
                     predictor.run(feed)
@@ -309,6 +311,7 @@ class ServingEngine:
                     f"{len(self._fetch_names)} fetches")
             with ReadyGate(self.health, SWAPPING), \
                     telemetry.timer("serving.swap_ms"):
+                # pt-lint: disable=blocking-call-under-lock(the swap lock serialises SWAPS only — warmup compiles run unlocked while the old predictor keeps serving; that is the zero-downtime design)
                 fresh, costs = self._warm(predictor, locked=False) \
                     if warmup else (0, {})
                 with self._infer_lock:
@@ -387,6 +390,7 @@ class ServingEngine:
                 # swap_predictor flips both atomically, so this batch is
                 # served entirely by ONE model version
                 version = self.version
+                # pt-lint: disable=blocking-call-under-lock(the single worker thread IS the serialisation point; a swap flip is the only other holder and must exclude in-flight batches)
                 outs = self.predictor.run(feed)
             if traced:
                 t_run1 = _time.time()

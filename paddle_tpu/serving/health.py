@@ -28,11 +28,11 @@ live-metrics plane shows readiness transitions next to queue depth.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Any, Dict, Optional
 
 from ..core import telemetry
+from ..core.analysis import lockdep
 
 STARTING = "starting"
 READY = "ok"            # the wire string /healthz always reported when up
@@ -47,7 +47,7 @@ class HealthState:
     """Thread-safe replica health: one current state + transition log."""
 
     def __init__(self, state: str = STARTING, name: str = ""):
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("serving.health")
         self._state = state
         self._since = time.time()
         self.name = name

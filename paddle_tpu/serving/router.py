@@ -47,6 +47,7 @@ from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..core import faults, retry, telemetry, trace
+from ..core.analysis import lockdep
 from ..core.flags import flag as _flag
 from .admission import ServingError
 
@@ -61,7 +62,7 @@ class ReplicaHandle:
     def __init__(self, name: str, url: str):
         self.name = name
         self.url = url.rstrip("/")
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("router.replica")
         self.ready = False
         self.alive = True
         self.status = "unknown"     # /healthz status string (health.py)
@@ -175,13 +176,13 @@ class Router:
             _flag("router_health_interval_s") if health_interval_s is None
             else health_interval_s)
         self._handles: List[ReplicaHandle] = []
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("router.core")
         self._stop = threading.Event()
         self._probe_thread: Optional[threading.Thread] = None
         # request-id dedup: id -> ("inflight", Event) | ("done", code,
         # payload). Bounded FIFO over done entries.
         self._dedup: "OrderedDict[str, tuple]" = OrderedDict()
-        self._dedup_lock = threading.Lock()
+        self._dedup_lock = lockdep.lock("router.dedup")
         self._dedup_cap = int(_flag("router_dedup_capacity"))
         self._ids = 0
         self._rr = 0   # rotating tie-break offset for equal load scores

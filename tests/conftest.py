@@ -95,6 +95,36 @@ def _verify_program_on_op_sweeps(request):
         _flags.set_flags({"verify_program": old})
 
 
+# Concurrency-sanitizer opt-in (PT_SANITIZE_TESTS=1): the serving/
+# cluster tier-1 modules — the most thread-dense surfaces — run with
+# FLAGS_sanitize_locks=1, so every engine/router/cluster lock they
+# construct is an instrumented core/analysis/lockdep.py lock: a
+# lock-order inversion or a same-thread re-entry introduced by a new
+# change raises LockOrderError inside the test instead of wedging a
+# production router at 3 a.m. Off by default: the instrumented wrappers
+# add per-acquire bookkeeping the rest of the suite shouldn't pay.
+_SANITIZE_MODULES = {"test_serving", "test_cluster_serving"}
+
+
+@pytest.fixture(autouse=True)
+def _sanitize_locks_opt_in(request):
+    if not os.environ.get("PT_SANITIZE_TESTS"):
+        yield
+        return
+    mod = request.module.__name__.rsplit(".", 1)[-1]
+    if mod not in _SANITIZE_MODULES:
+        yield
+        return
+    from paddle_tpu.core import flags as _flags
+
+    old = _flags.flag("sanitize_locks")
+    _flags.set_flags({"sanitize_locks": True})
+    try:
+        yield
+    finally:
+        _flags.set_flags({"sanitize_locks": old})
+
+
 def rand(*shape, dtype=np.float32, seed=None):
     rng = np.random.RandomState(seed if seed is not None else 42)
     return rng.randn(*shape).astype(dtype)

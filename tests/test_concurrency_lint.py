@@ -1,0 +1,648 @@
+"""Concurrency sanitizer tests (ISSUE 11): the static AST lint
+(core/analysis/concurrency_lint.py + tools/lint_concurrency.py) over
+seeded-defect fixture modules — each rule must fire with the right
+file:line — plus the runtime half (core/analysis/lockdep.py): a real
+A/B–B/A two-thread deadlock under FLAGS_sanitize_locks=1 must raise a
+typed LockOrderError AND land a kind:"stall" all-thread stack dump in
+the run log, while FLAGS_sanitize_locks=0 keeps every lock a plain
+threading primitive (no lock.* records). Also: the live-tree gate
+(lint_concurrency --strict exits 0 on this repo), the
+threading.excepthook satellite, and the perf_report "Concurrency"
+section.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.core import flags as _flags
+from paddle_tpu.core import telemetry
+from paddle_tpu.core.analysis import concurrency_lint as clint
+from paddle_tpu.core.analysis import lockdep
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint_source(tmp_path, name, source):
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(source))
+    return clint.lint_paths([str(path)]), str(path)
+
+
+def _by_rule(result, rule):
+    return [f for f in result.findings if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# static lint: seeded-defect fixtures (one per rule)
+# ---------------------------------------------------------------------------
+
+class TestLockOrderRule:
+    def test_inversion_detected_with_lines(self, tmp_path):
+        """A/B vs B/A nesting is reported as a cycle, with the inner
+        `with` lines of BOTH edges."""
+        result, path = _lint_source(tmp_path, "fix_lockorder.py", """\
+            import threading
+
+
+            class Worker:
+                def __init__(self):
+                    self._a_lock = threading.Lock()
+                    self._b_lock = threading.Lock()
+
+                def forward(self):
+                    with self._a_lock:
+                        with self._b_lock:
+                            pass
+
+                def backward(self):
+                    with self._b_lock:
+                        with self._a_lock:
+                            pass
+        """)
+        findings = _by_rule(result, "lock-order")
+        assert len(findings) == 2, [f.format() for f in result.findings]
+        assert {f.line for f in findings} == {11, 16}
+        assert all(f.severity == "error" for f in findings)
+        assert all(f.path == path for f in findings)
+        assert "cycle" in findings[0].message
+
+    def test_inversion_through_a_call_is_seen(self, tmp_path):
+        """One level of same-class call expansion: m1 holds A and calls
+        m2 which takes B; m3 nests B then A — still a cycle."""
+        result, _ = _lint_source(tmp_path, "fix_lockorder_call.py", """\
+            import threading
+
+
+            class Worker:
+                def __init__(self):
+                    self._a_lock = threading.Lock()
+                    self._b_lock = threading.Lock()
+
+                def m1(self):
+                    with self._a_lock:
+                        self.m2()
+
+                def m2(self):
+                    with self._b_lock:
+                        pass
+
+                def m3(self):
+                    with self._b_lock:
+                        with self._a_lock:
+                            pass
+        """)
+        assert _by_rule(result, "lock-order"), \
+            [f.format() for f in result.findings]
+
+    def test_consistent_order_is_clean(self, tmp_path):
+        result, _ = _lint_source(tmp_path, "fix_lockorder_ok.py", """\
+            import threading
+
+
+            class Worker:
+                def __init__(self):
+                    self._a_lock = threading.Lock()
+                    self._b_lock = threading.Lock()
+
+                def forward(self):
+                    with self._a_lock:
+                        with self._b_lock:
+                            pass
+
+                def backward(self):
+                    with self._a_lock:
+                        with self._b_lock:
+                            pass
+        """)
+        assert not _by_rule(result, "lock-order")
+
+
+class TestBlockingUnderLockRule:
+    def test_direct_blocking_calls(self, tmp_path):
+        result, _ = _lint_source(tmp_path, "fix_blocking.py", """\
+            import subprocess
+            import threading
+            import time
+
+
+            class Server:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.predictor = None
+
+                def handle(self, sock, q):
+                    with self._lock:
+                        time.sleep(1.0)
+                        sock.recv(1024)
+                        q.get()
+                        subprocess.run(["ls"])
+                        self.predictor.run({})
+        """)
+        findings = _by_rule(result, "blocking-call-under-lock")
+        lines = {f.line for f in findings}
+        assert lines == {13, 14, 15, 16, 17}, \
+            [f.format() for f in result.findings]
+        msgs = " ".join(f.message for f in findings)
+        assert "time.sleep" in msgs
+        assert ".recv" in msgs
+        assert "queue .get() without timeout" in msgs
+        assert "subprocess.run" in msgs
+        assert "jit/compile entry point" in msgs
+
+    def test_blocking_through_local_call_chain(self, tmp_path):
+        """Transitive: the lock holder calls a helper whose body sleeps."""
+        result, _ = _lint_source(tmp_path, "fix_blocking_call.py", """\
+            import threading
+            import time
+
+
+            class Server:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def helper(self):
+                    time.sleep(0.1)
+
+                def indirect(self):
+                    with self._lock:
+                        self.helper()
+        """)
+        findings = _by_rule(result, "blocking-call-under-lock")
+        assert len(findings) == 1 and findings[0].line == 14, \
+            [f.format() for f in result.findings]
+        assert "helper" in findings[0].message
+
+    def test_bounded_waits_are_clean(self, tmp_path):
+        result, _ = _lint_source(tmp_path, "fix_blocking_ok.py", """\
+            import threading
+            import time
+
+
+            class Server:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cond = threading.Condition()
+
+                def ok(self, q, event):
+                    with self._lock:
+                        q.get(timeout=1.0)
+                        event.wait(0.5)
+                    time.sleep(1.0)
+                    with self._cond:
+                        self._cond.wait(timeout=2.0)
+        """)
+        assert not _by_rule(result, "blocking-call-under-lock"), \
+            [f.format() for f in result.findings]
+
+
+class TestUnlockedSharedFieldRule:
+    def test_worker_and_main_write_without_lock(self, tmp_path):
+        result, _ = _lint_source(tmp_path, "fix_unlocked.py", """\
+            import threading
+
+
+            class Stats:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+
+                def start(self):
+                    t = threading.Thread(target=self._worker,
+                                         name="pt-fix-worker", daemon=True)
+                    t.start()
+
+                def _worker(self):
+                    self.count = self.count + 1
+
+                def reset(self):
+                    self.count = 0
+        """)
+        findings = _by_rule(result, "unlocked-shared-field")
+        assert {f.line for f in findings} == {15, 18}, \
+            [f.format() for f in result.findings]
+        assert "self.count" in findings[0].message
+
+    def test_locked_writes_are_clean(self, tmp_path):
+        result, _ = _lint_source(tmp_path, "fix_locked_ok.py", """\
+            import threading
+
+
+            class Stats:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+
+                def start(self):
+                    t = threading.Thread(target=self._worker,
+                                         name="pt-fix-worker", daemon=True)
+                    t.start()
+
+                def _worker(self):
+                    with self._lock:
+                        self.count = self.count + 1
+
+                def reset(self):
+                    with self._lock:
+                        self.count = 0
+        """)
+        assert not _by_rule(result, "unlocked-shared-field")
+
+
+class TestThreadLifecycleRule:
+    def test_unnamed_and_unjoined(self, tmp_path):
+        result, _ = _lint_source(tmp_path, "fix_threads.py", """\
+            import threading
+
+
+            def spawn_bad(fn):
+                t = threading.Thread(target=fn)
+                t.start()
+                return t
+
+
+            def spawn_named_unjoined(fn):
+                t = threading.Thread(target=fn, name="pt-fix-loose")
+                t.start()
+
+
+            def spawn_good(fn):
+                t = threading.Thread(target=fn, name="pt-fix-d",
+                                     daemon=True)
+                t.start()
+
+
+            def spawn_joined(fn):
+                t = threading.Thread(target=fn, name="pt-fix-j")
+                t.start()
+                t.join(timeout=5)
+        """)
+        unnamed = _by_rule(result, "thread-unnamed")
+        unjoined = _by_rule(result, "thread-unjoined")
+        assert [f.line for f in unnamed] == [5], \
+            [f.format() for f in result.findings]
+        assert unnamed[0].severity == "error"
+        assert {f.line for f in unjoined} == {5, 11}, \
+            [f.format() for f in result.findings]
+
+
+class TestSuppressions:
+    def test_inline_suppression_with_reason(self, tmp_path):
+        result, _ = _lint_source(tmp_path, "fix_suppressed.py", """\
+            import threading
+            import time
+
+
+            class Server:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def handle(self):
+                    with self._lock:
+                        time.sleep(1.0)  # pt-lint: disable=blocking-call-under-lock(backoff by design (bounded))
+        """)
+        assert not result.findings
+        assert len(result.suppressed) == 1
+        assert result.suppressed[0].suppressed == \
+            "backoff by design (bounded)"
+
+    def test_suppression_on_preceding_line(self, tmp_path):
+        result, _ = _lint_source(tmp_path, "fix_suppressed2.py", """\
+            import threading
+            import time
+
+
+            class Server:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def handle(self):
+                    with self._lock:
+                        # pt-lint: disable=blocking-call-under-lock(fine here)
+                        time.sleep(1.0)
+        """)
+        assert not result.findings
+        assert len(result.suppressed) == 1
+
+    def test_wrong_rule_does_not_suppress(self, tmp_path):
+        result, _ = _lint_source(tmp_path, "fix_suppressed3.py", """\
+            import threading
+            import time
+
+
+            class Server:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def handle(self):
+                    with self._lock:
+                        time.sleep(1.0)  # pt-lint: disable=lock-order(nope)
+        """)
+        assert len(result.findings) == 1
+
+
+# ---------------------------------------------------------------------------
+# CLI: exit codes + live-tree gate (ISSUE satellite: CI wiring)
+# ---------------------------------------------------------------------------
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "lint_concurrency.py"), *args],
+        capture_output=True, text=True, cwd=REPO)
+
+
+class TestCLI:
+    def test_findings_exit_1(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("import threading\n"
+                       "t = threading.Thread(target=print)\n"
+                       "t.start()\n")
+        r = _run_cli(str(bad))
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "thread-unnamed" in r.stdout
+
+    def test_clean_exit_0_and_json(self, tmp_path):
+        ok = tmp_path / "ok.py"
+        ok.write_text("x = 1\n")
+        r = _run_cli(str(ok), "--json")
+        assert r.returncode == 0, r.stdout + r.stderr
+        doc = json.loads(r.stdout)
+        assert doc["errors"] == 0 and doc["files"] == 1
+
+    def test_unparseable_exit_2(self, tmp_path):
+        broken = tmp_path / "broken.py"
+        broken.write_text("def f(:\n")
+        r = _run_cli(str(broken))
+        assert r.returncode == 2, r.stdout + r.stderr
+
+    def test_warnings_need_strict(self, tmp_path):
+        warny = tmp_path / "warny.py"
+        warny.write_text(
+            "import threading\nimport time\n\n\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n\n"
+            "    def m(self):\n"
+            "        with self._lock:\n"
+            "            time.sleep(1)\n")
+        assert _run_cli(str(warny)).returncode == 0
+        assert _run_cli(str(warny), "--strict").returncode == 1
+
+    def test_live_tree_is_clean_strict(self):
+        """Acceptance: zero unsuppressed findings on the merged tree —
+        the same invocation the tools smoke path runs."""
+        r = _run_cli("--strict")
+        assert r.returncode == 0, \
+            f"live tree has lint findings:\n{r.stdout}\n{r.stderr}"
+        assert "0 error(s), 0 warning(s)" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# runtime sanitizer (core/analysis/lockdep.py)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def sanitize(tmp_path):
+    """FLAGS_sanitize_locks=1 + a telemetry sink; restores both."""
+    log = str(tmp_path / "run.jsonl")
+    old = _flags.all_flags()
+    _flags.set_flags({"sanitize_locks": True, "lock_stall_s": 0.2})
+    telemetry.configure(log)
+    try:
+        yield log
+    finally:
+        telemetry.configure(None)
+        _flags.set_flags({"sanitize_locks": old["sanitize_locks"],
+                          "lock_stall_s": old["lock_stall_s"]})
+
+
+def _records(log):
+    telemetry.flush_sink()
+    with open(log) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+class TestLockdepRuntime:
+    def test_off_means_plain_primitives_and_no_records(self, tmp_path):
+        """Acceptance: FLAGS_sanitize_locks=0 keeps lock overhead at
+        parity — factories hand back stock threading objects and no
+        lock.* telemetry exists."""
+        assert not _flags.flag("sanitize_locks")
+        before = {k for k in telemetry.counters() if k.startswith("lock.")}
+        lk = lockdep.lock("parity.test")
+        assert type(lk) is type(threading.Lock())
+        cond = lockdep.condition("parity.cond")
+        assert type(cond) is threading.Condition
+        with lk:
+            pass
+        hists = telemetry.snapshot()["hists"]
+        assert not any(k.startswith("lock.parity") for k in hists)
+        after = {k for k in telemetry.counters() if k.startswith("lock.")}
+        assert after == before
+
+    def test_ab_ba_deadlock_detected_and_dumped(self, sanitize):
+        """Acceptance: a REAL two-thread A/B–B/A deadlock raises a typed
+        LockOrderError in the inverting thread (un-wedging the other)
+        and the watchdog lands a kind:"stall" all-thread stack dump."""
+        A = lockdep.lock("dl.A")
+        B = lockdep.lock("dl.B")
+        assert isinstance(A, lockdep.SanitizedLock)
+        caught = []
+
+        def t1():
+            with A:
+                time.sleep(0.15)
+                with B:        # blocks on t2 past lock_stall_s=0.2
+                    pass
+
+        def t2():
+            with B:
+                time.sleep(0.7)
+                try:
+                    with A:    # closes the cycle -> typed error
+                        pass
+                except lockdep.LockOrderError as e:
+                    caught.append(e)
+
+        th2 = threading.Thread(target=t2, name="pt-test-dl2", daemon=True)
+        th1 = threading.Thread(target=t1, name="pt-test-dl1", daemon=True)
+        th2.start()
+        time.sleep(0.05)
+        th1.start()
+        th1.join(5)
+        th2.join(5)
+        # the sanitizer must UN-WEDGE the schedule: both threads exit
+        assert not th1.is_alive() and not th2.is_alive()
+        assert caught, "inverting thread saw no LockOrderError"
+        assert "dl.A" in str(caught[0]) and "cycle" in str(caught[0])
+
+        stalls = [r for r in _records(sanitize) if r["kind"] == "stall"]
+        assert stalls, "watchdog produced no stall record"
+        attrs = stalls[0]["attrs"]
+        assert attrs["lock"] == "dl.B"
+        assert attrs["thread"] == "pt-test-dl1"
+        by_name = {t["name"]: t for t in attrs["threads"]}
+        assert by_name["pt-test-dl1"]["held"] == ["dl.A"]
+        assert by_name["pt-test-dl1"]["waiting_for"] == "dl.B"
+        assert "dl.B" in by_name["pt-test-dl2"]["held"]
+        assert "stack" in by_name["pt-test-dl1"]
+        assert telemetry.counter_get("lock.stalls") >= 1
+        assert telemetry.counter_get("lock.order_violations") >= 1
+
+    def test_same_thread_reentry_raises(self, sanitize):
+        L = lockdep.lock("re.L")
+        with L:
+            with pytest.raises(lockdep.LockOrderError, match="re-entry"):
+                with L:
+                    pass
+        # the lock is released and reusable after the unwind
+        with L:
+            pass
+
+    def test_rlock_reentry_is_legal(self, sanitize):
+        R = lockdep.rlock("re.R")
+        with R:
+            with R:
+                assert R._is_owned()
+        assert not R._is_owned()
+
+    def test_condition_wrapper_roundtrip(self, sanitize):
+        cond = lockdep.condition("cv.test")
+        got = []
+
+        def waiter():
+            with cond:
+                cond.wait_for(lambda: got, timeout=2)
+                got.append("woke")
+
+        w = threading.Thread(target=waiter, name="pt-test-cv",
+                             daemon=True)
+        w.start()
+        time.sleep(0.1)
+        with cond:
+            got.append(1)
+            cond.notify_all()
+        w.join(3)
+        assert "woke" in got
+
+    def test_contention_and_held_telemetry(self, sanitize):
+        L = lockdep.lock("tele.L")
+        release = threading.Event()
+
+        def holder():
+            with L:
+                release.wait(2)
+
+        h = threading.Thread(target=holder, name="pt-test-holder",
+                             daemon=True)
+        h.start()
+        time.sleep(0.05)
+        t = threading.Thread(target=lambda: L.acquire() and L.release(),
+                             name="pt-test-contender", daemon=True)
+        t.start()
+        time.sleep(0.05)
+        release.set()
+        t.join(3)
+        h.join(3)
+        assert telemetry.counter_get("lock.contentions") >= 1
+        hists = telemetry.snapshot()["hists"]
+        assert "lock.tele.L.held_ms" in hists
+        assert "lock.tele.L.wait_ms" in hists
+
+
+class TestThreadExcepthook:
+    def test_uncaught_exception_is_counted_and_logged(self, tmp_path):
+        log = str(tmp_path / "hook.jsonl")
+        telemetry.configure(log)
+        try:
+            before = telemetry.counter_get("threads.uncaught_exceptions")
+
+            def boom():
+                raise ValueError("seeded worker crash")
+
+            t = threading.Thread(target=boom, name="pt-test-boom",
+                                 daemon=True)
+            t.start()
+            t.join(3)
+            assert telemetry.counter_get(
+                "threads.uncaught_exceptions") == before + 1
+            recs = _records(log)
+            errs = [r for r in recs if r["kind"] == "thread_error"]
+            assert errs and errs[-1]["name"] == "pt-test-boom"
+            assert errs[-1]["attrs"]["exc"] == "ValueError"
+            assert "seeded worker crash" in errs[-1]["attrs"]["traceback"]
+        finally:
+            telemetry.configure(None)
+
+
+# ---------------------------------------------------------------------------
+# perf_report "Concurrency" section
+# ---------------------------------------------------------------------------
+
+class TestPerfReportSection:
+    def test_section_renders(self, tmp_path):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            import perf_report
+        finally:
+            sys.path.pop(0)
+        log = tmp_path / "cc.jsonl"
+        recs = [
+            {"ts": 1.0, "kind": "timer", "name": "lock.engine.infer.held_ms",
+             "value": 2.5, "attrs": {}},
+            {"ts": 1.1, "kind": "timer", "name": "lock.engine.infer.wait_ms",
+             "value": 0.4, "attrs": {}},
+            {"ts": 1.2, "kind": "counter", "name": "lock.stalls",
+             "value": 1, "attrs": {"delta": 1}},
+            {"ts": 1.3, "kind": "stall", "name": "lockdep.stall",
+             "value": 0.3,
+             "attrs": {"lock": "engine.infer", "thread": "pt-x",
+                       "waited_s": 0.3,
+                       "threads": [{"name": "pt-x", "held": [],
+                                    "stack": "..."}]}},
+            {"ts": 1.4, "kind": "thread_error", "name": "pt-dead",
+             "value": None, "attrs": {"exc": "ValueError"}},
+            {"ts": 2.0, "kind": "snapshot", "name": "telemetry",
+             "value": None,
+             "attrs": {"counters": {"lock.acquires": 42,
+                                    "lock.contentions": 3,
+                                    "threads.uncaught_exceptions": 1},
+                       "gauges": {}, "hists": {}}},
+        ]
+        log.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+        s = perf_report.summarize_log(*perf_report.load_counted(str(log)))
+        cc = s["concurrency"]
+        assert cc["acquires"] == 42
+        assert cc["contentions"] == 3
+        assert cc["stalls"] == 1
+        assert cc["uncaught_thread_exceptions"] == 1
+        assert "engine.infer" in cc["locks"]
+        assert cc["locks"]["engine.infer"]["held_ms"]["count"] == 1
+        import io
+
+        out = io.StringIO()
+        perf_report.render(s, out=out)
+        text = out.getvalue()
+        assert "concurrency (lock sanitizer)" in text
+        assert "STALL: thread 'pt-x'" in text
+        assert "THREAD DIED: 'pt-dead'" in text
+
+    def test_quiet_run_has_no_section(self, tmp_path):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            import perf_report
+        finally:
+            sys.path.pop(0)
+        log = tmp_path / "quiet.jsonl"
+        log.write_text(json.dumps(
+            {"ts": 1.0, "kind": "counter", "name": "executor.compiles",
+             "value": 1, "attrs": {"delta": 1}}) + "\n")
+        s = perf_report.summarize_log(*perf_report.load_counted(str(log)))
+        assert s["concurrency"] is None

@@ -106,8 +106,9 @@ def _run_clients(n_clients, n_requests, call):
     shares = [n_requests // n_clients] * n_clients
     for i in range(n_requests % n_clients):
         shares[i] += 1
-    threads = [threading.Thread(target=worker, args=(s,), daemon=True)
-               for s in shares if s]
+    threads = [threading.Thread(target=worker, args=(s,),
+                                name=f"pt-bench-client-{i}", daemon=True)
+               for i, s in enumerate(shares) if s]
     t0 = time.perf_counter()
     for t in threads:
         t.start()
@@ -179,7 +180,7 @@ def bench_closed(args, make_batch, model_dir):
     stop_scrape = threading.Event()
     scraper = threading.Thread(target=_scrape_metrics,
                                args=(http_srv.url, stop_scrape, scraped),
-                               daemon=True)
+                               name="pt-bench-scrape", daemon=True)
     scraper.start()
     try:
         wall, lat, errors = _run_clients(
@@ -322,7 +323,8 @@ def bench_cluster(args, make_batch, model_dir):
             def kill_later():
                 time.sleep(0.3)
                 cluster.replicas[0].kill()
-            killer = threading.Thread(target=kill_later, daemon=True)
+            killer = threading.Thread(target=kill_later,
+                                      name="pt-bench-killer", daemon=True)
             killer.start()
         try:
             wall, lat, errors = _run_clients(

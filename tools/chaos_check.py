@@ -231,7 +231,8 @@ def run_serving(args) -> int:
                         ok.append(out)
 
         threads = [threading.Thread(target=worker, args=(args.requests // 4,),
-                                    daemon=True) for _ in range(4)]
+                                    name=f"pt-chaos-serving-{i}",
+                                    daemon=True) for i in range(4)]
         for t in threads:
             t.start()
         for t in threads:
@@ -482,6 +483,7 @@ def run_cluster(args) -> int:
 
         share = n_requests // workers
         threads = [threading.Thread(target=worker, args=(w, share),
+                                    name=f"pt-chaos-cluster-{w}",
                                     daemon=True) for w in range(workers)]
         t_load0 = time.perf_counter()
         for t in threads:

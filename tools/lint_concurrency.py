@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""lint_concurrency — static concurrency lint over the paddle_tpu
+sources (core/analysis/concurrency_lint.py).
+
+The CI twin of the runtime lock sanitizer (FLAGS_sanitize_locks,
+core/analysis/lockdep.py): builds a lock-acquisition graph per module
+and reports lock-order inversions as cycles, flags blocking calls
+performed under a held lock (socket/HTTP ops, subprocess, time.sleep,
+queue waits without timeout, jit/compile entry points), flags shared
+fields written from more than one thread entrypoint without a guarding
+lock, and enforces thread-lifecycle discipline (every spawn names its
+thread and is daemon or joined with a bounded timeout).
+
+Suppress a finding inline with a reason::
+
+    sock.recv(n)   # pt-lint: disable=blocking-call-under-lock(client
+                   # serialises calls by design)
+
+Exit codes (same contract as tools/graph_lint.py): 0 clean, 1 findings
+(errors; warnings too with --strict), 2 a source file failed to load or
+parse.
+
+Usage:
+    python tools/lint_concurrency.py                    # paddle_tpu/ + tools/
+    python tools/lint_concurrency.py path/to/file.py dir/
+    python tools/lint_concurrency.py --strict           # warnings fail too
+    python tools/lint_concurrency.py --json             # machine-readable
+    python tools/lint_concurrency.py --show-suppressed
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from paddle_tpu.core.analysis import concurrency_lint as clint  # noqa: E402
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Static concurrency lint (lock-order cycles, "
+                    "blocking-under-lock, unguarded shared fields, "
+                    "thread lifecycle)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories to lint (default: the "
+                         "repo's paddle_tpu/ and tools/ trees)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit nonzero on warnings too, not just errors")
+    ap.add_argument("--json", action="store_true",
+                    help="print the findings as JSON")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print findings silenced by "
+                         "'# pt-lint: disable=...' comments")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or clint.default_roots()
+    result = clint.lint_paths(list(paths))
+
+    if result.parse_errors:
+        for path, err in result.parse_errors:
+            print(f"lint_concurrency: cannot lint '{path}': {err}",
+                  file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps({
+            "files": result.files,
+            "errors": len(result.errors),
+            "warnings": len(result.warnings),
+            "suppressed": len(result.suppressed),
+            "findings": [f.as_dict() for f in result.findings],
+            "suppressed_findings": [f.as_dict()
+                                    for f in result.suppressed],
+        }, indent=2))
+    else:
+        for f in result.findings:
+            print(f.format())
+        if args.show_suppressed:
+            for f in result.suppressed:
+                print(f.format())
+        print(f"lint_concurrency: {result.files} file(s): "
+              f"{len(result.errors)} error(s), "
+              f"{len(result.warnings)} warning(s), "
+              f"{len(result.suppressed)} suppressed")
+    failed = result.errors or (args.strict and result.warnings)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

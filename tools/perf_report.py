@@ -38,6 +38,11 @@ Renders the structured run log written by ``paddle_tpu.core.telemetry``
   HBM ledger gauges, dispatched flop volume, the live-MFU gauge and
   roofline verdict counts — the full per-program table and OOM
   forensics render with tools/mem_report.py;
+* a "Concurrency" section when the run held instrumented locks
+  (core/analysis/lockdep.py, FLAGS_sanitize_locks): acquire/contention
+  counts, lock-order violations, stall dumps (kind:"stall" all-thread
+  stack records from the deadlock watchdog), uncaught worker-thread
+  exceptions, and per-lock held/wait-ms percentiles;
 * a "Tracing" section when the run emitted distributed-tracing spans
   (core/trace.py, FLAGS_trace_sample_rate): trace/span counts and
   per-span-name duration percentiles — merge multi-process logs with
@@ -113,6 +118,8 @@ def summarize_log(recs, malformed=0):
     profiler_rows = []
     cost_events = []
     oom_events = 0
+    stall_events = []
+    thread_errors = []
     spans = defaultdict(list)
     span_traces = set()
     snapshot = None
@@ -154,6 +161,15 @@ def summarize_log(recs, malformed=0):
             cost_events.append(attrs)
         elif kind == "oom":
             oom_events += 1
+        elif kind == "stall":
+            stall_events.append({"lock": attrs.get("lock"),
+                                 "thread": attrs.get("thread"),
+                                 "waited_s": attrs.get("waited_s"),
+                                 "threads": len(attrs.get("threads")
+                                                or [])})
+        elif kind == "thread_error":
+            thread_errors.append({"thread": name,
+                                  "exc": attrs.get("exc")})
         elif kind == "snapshot":
             snapshot = attrs
     # a final snapshot is authoritative for cumulative counter values
@@ -179,6 +195,9 @@ def summarize_log(recs, malformed=0):
     verifier = _verifier_summary(counter_delta, counter_last, timer_summary)
     memcost = _memcost_summary(counter_delta, counter_last, gauges,
                                cost_events, oom_events)
+    concurrency = _concurrency_summary(counter_delta, counter_last,
+                                       timer_summary, stall_events,
+                                       thread_errors)
     tracing = None
     if spans:
         by_name = {}
@@ -199,6 +218,7 @@ def summarize_log(recs, malformed=0):
         "sharding": sharding,
         "verifier": verifier,
         "memcost": memcost,
+        "concurrency": concurrency,
         "tracing": tracing,
         "malformed_lines": int(malformed),
         "records": len(recs),
@@ -476,6 +496,53 @@ def _verifier_summary(counter_delta, counter_last, timer_summary):
     return out
 
 
+def _concurrency_summary(counter_delta, counter_last, timer_summary,
+                         stall_events, thread_errors):
+    """Lock-sanitizer accounting (core/analysis/lockdep.py,
+    FLAGS_sanitize_locks): contention pressure, order violations, stall
+    dumps, uncaught worker-thread exceptions and per-lock hold times.
+    lock.acquires/contentions are quiet counters — their values ride the
+    exit snapshot, so counter_last is the authoritative read."""
+
+    def cval(name):
+        v = counter_delta.get(name) or counter_last.get(name) or 0
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            return 0.0
+
+    locks = {name: t for name, t in timer_summary.items()
+             if name.startswith("lock.")}
+    acquires = cval("lock.acquires")
+    uncaught = cval("threads.uncaught_exceptions")
+    if not (acquires or locks or stall_events or thread_errors
+            or uncaught):
+        return None
+    out = {"acquires": int(acquires),
+           "contentions": int(cval("lock.contentions")),
+           "order_violations": int(cval("lock.order_violations")),
+           "stalls": int(cval("lock.stalls")),
+           "uncaught_thread_exceptions": int(uncaught)}
+    by_lock = {}
+    for name, t in sorted(locks.items()):
+        # lock.<name>.held_ms / lock.<name>.wait_ms
+        parts = name.split(".")
+        if len(parts) < 3:
+            continue
+        lock_name = ".".join(parts[1:-1])
+        metric = parts[-1]
+        by_lock.setdefault(lock_name, {})[metric] = {
+            "count": t["count"], "p50": t["p50"], "p99": t["p99"],
+            "max": t["max"]}
+    if by_lock:
+        out["locks"] = by_lock
+    if stall_events:
+        out["stall_events"] = stall_events[:10]
+    if thread_errors:
+        out["thread_errors"] = thread_errors[:10]
+    return out
+
+
 def _fmt_num(v):
     if isinstance(v, float):
         return f"{v:,.3f}".rstrip("0").rstrip(".")
@@ -647,6 +714,31 @@ def render(s, out=sys.stdout):
         if "roofline" in mc:
             w(f"roofline verdicts: {mc['roofline']}  "
               f"(full table: tools/mem_report.py)\n")
+
+    if s.get("concurrency"):
+        cc = s["concurrency"]
+        w("\n-- concurrency (lock sanitizer) --\n")
+        w(f"acquires: {cc['acquires']}  contentions: "
+          f"{cc['contentions']}  order violations: "
+          f"{cc['order_violations']}  stalls: {cc['stalls']}  "
+          f"uncaught thread exceptions: "
+          f"{cc['uncaught_thread_exceptions']}\n")
+        if cc.get("locks"):
+            w(f"{'lock':<26}{'held p50':>10}{'held p99':>10}"
+              f"{'held max':>10}{'wait p99':>10}{'holds':>8}\n")
+            for name, m in cc["locks"].items():
+                held = m.get("held_ms") or {}
+                wait = m.get("wait_ms") or {}
+                w(f"{name[:25]:<26}{held.get('p50', 0):>10}"
+                  f"{held.get('p99', 0):>10}{held.get('max', 0):>10}"
+                  f"{wait.get('p99', 0):>10}{held.get('count', 0):>8}\n")
+        for ev in cc.get("stall_events", []):
+            w(f"STALL: thread '{ev['thread']}' waited "
+              f"{ev['waited_s']}s on '{ev['lock']}' "
+              f"({ev['threads']} thread stacks in the run log)\n")
+        for ev in cc.get("thread_errors", []):
+            w(f"THREAD DIED: '{ev['thread']}' uncaught "
+              f"{ev['exc']}\n")
 
     if s.get("tracing"):
         tr = s["tracing"]
