@@ -25,7 +25,11 @@ measures *time* (PR 1 telemetry, PR 6 tracing); this module measures
   cached programs), ``mem.hbm_total_bytes`` (the composed ledger
   verdict), per-serving-bucket footprints
   (``mem.serving.bucket<B>_peak_bytes``, captured at engine warmup and
-  exposed in ``/v1/stats``), plus a live MFU gauge
+  exposed in ``/v1/stats``), the decode engine's preallocated KV page
+  pool (``mem.serving.kv_pool_bytes`` / ``kv_used_bytes`` /
+  ``kv_high_water_bytes`` — serving/kv_cache.py, what lets decode
+  admission refuse would-OOM requests with a typed error), plus a live
+  MFU gauge
   (``cost.live_mfu`` = windowed ``cost.dispatch_flops`` rate ÷ peak
   device flops from the device table / ``FLAGS_device_peak_flops``)
   and a per-program roofline verdict (compute- vs memory-bound by
@@ -414,15 +418,26 @@ def ledger() -> Dict[str, Any]:
                for n, v in g.items()
                if n.startswith("mem.serving.bucket")
                and n.endswith("_peak_bytes")}
+    # the decode engine's preallocated KV page pool (serving/kv_cache.py)
+    # is RESIDENT for the process lifetime — its full preallocation, not
+    # just the used pages, belongs in the composed total
+    kv_pool = int(g.get("mem.serving.kv_pool_bytes", 0) or 0)
     out = {"param_bytes": param_bytes, "opt_state_bytes": opt_bytes,
            "peak_temp_bytes": int(peak_temp),
-           "total_bytes": param_bytes + opt_bytes + int(peak_temp),
+           "total_bytes": param_bytes + opt_bytes + int(peak_temp)
+           + kv_pool,
            "programs": len(recs)}
     if opt_global is not None:
         out["opt_state_bytes_global"] = int(opt_global)
     if buckets:
         out["serving_bucket_bytes"] = buckets
         out["serving_peak_bytes"] = max(buckets.values())
+    if kv_pool:
+        out["serving_kv_pool_bytes"] = kv_pool
+        out["serving_kv_used_bytes"] = int(
+            g.get("mem.serving.kv_used_bytes", 0) or 0)
+        out["serving_kv_high_water_bytes"] = int(
+            g.get("mem.serving.kv_high_water_bytes", 0) or 0)
     return out
 
 

@@ -6,8 +6,12 @@ MAKE a transport fail in a unit test. This module is that harness: a
 process-global registry of named injection sites (`ps.rpc.send`,
 `ps.rpc.recv`, `ps.handler`, `ps.checkpoint.save`, `serving.handler` —
 the serving engine's batch loop, see paddle_tpu/serving/engine.py and
-tools/chaos_check.py --serving — and the crash-consistent checkpoint
-protocol's `ckpt.save.write` / `ckpt.save.commit` / `ckpt.restore.read`,
+tools/chaos_check.py --serving — the generative decode engine's
+`decode.step` / `decode.kv_alloc` — the continuous-batching step loop
+and the KV page-pool allocator, see paddle_tpu/serving/decode.py,
+serving/kv_cache.py and tools/chaos_check.py --decode — and the
+crash-consistent checkpoint protocol's `ckpt.save.write` /
+`ckpt.save.commit` / `ckpt.restore.read`,
 see paddle_tpu/checkpoint.py and tools/chaos_check.py --checkpoint)
 consulted by the transport/pserver/serving/checkpoint hot paths, driven
 by a spec string so chaos runs need no code changes:

@@ -245,6 +245,50 @@ define_flag("serving_buckets", "",
             "pads coalesced batches up to (keeps the jit cache small and "
             "warm); empty = powers of two up to serving_max_batch_size")
 
+# -- generative decode engine (paddle_tpu/serving/decode.py: continuous
+#    batching over a paged KV cache; reference analogs: the beam_search /
+#    while-op inference decoding programs, Orca continuous batching,
+#    vLLM PagedAttention) ------------------------------------------------------
+
+define_flag("decode_max_slots", 8,
+            "decode-state slots of the generative engine — the upper "
+            "bound on sequences decoded concurrently; the step program "
+            "runs at fixed slot-array shapes (decode_buckets) so the jit "
+            "cache stays one entry per bucket")
+define_flag("decode_buckets", "",
+            "comma-separated slot-array sizes the decode step pads the "
+            "active set up to; empty = ONE bucket of decode_max_slots "
+            "(fixed step shape — keeps continuous-batched generations "
+            "bitwise-identical to sequential decode on backends whose "
+            "GEMM kernels are batch-size-dependent)")
+define_flag("decode_page_size", 16,
+            "tokens per KV-cache page: requests allocate/free fixed-size "
+            "pages from the preallocated pool (serving/kv_cache.py) "
+            "instead of per-request max-length buffers")
+define_flag("decode_kv_pages", 64,
+            "pages in the preallocated KV pool (per layer, keys+values "
+            "together); the pool's bytes book into the HBM ledger as "
+            "mem.serving.kv_* and admission refuses requests whose "
+            "worst-case page need cannot ever fit (typed "
+            "KVCacheExhaustedError, never a device OOM)")
+define_flag("decode_max_queue_depth", 256,
+            "admission bound on queued generation requests — submits "
+            "beyond this raise ServerOverloadedError (decode.rejects)")
+define_flag("decode_default_deadline_ms", 0.0,
+            "per-request generation deadline when the caller gives none; "
+            "checked at STEP granularity mid-generation — an expired "
+            "request retires with DeadlineExceededError and frees its "
+            "pages without draining the batch; <= 0 means no deadline")
+define_flag("decode_max_new_tokens", 64,
+            "default generation budget when a request does not set "
+            "max_new_tokens (always additionally capped by the model's "
+            "max_seq_len)")
+define_flag("decode_weight_quant", "none",
+            "weight format of the decode engine: 'none' serves fp32 "
+            "weights, 'int8' serves per-output-channel weight-only int8 "
+            "(ops/quant_ops.py dequantize_weight fused into the consuming "
+            "matmul read — half the weight HBM traffic)")
+
 # -- cluster serving control plane (paddle_tpu/serving/router.py +
 #    cluster.py: replicated engines, health-checked routing, zero-downtime
 #    model swap; reference analogs: the PS/Fleet elastic-serving promise,

@@ -164,6 +164,102 @@ def flash_attention_grad_op(ins, attrs):
     return outs
 
 
+@register_op("kv_cache_write",
+             non_diff_inputs=("K", "V", "PoolK", "PoolV", "PageTable",
+                              "Lengths"))
+def kv_cache_write_op(ins, attrs):
+    """Bulk-write a prompt's keys/values into the paged KV pool — the
+    PREFILL half of the decode engine's cache discipline
+    (serving/kv_cache.py; vLLM's PagedAttention cache layout in dense
+    jax form).
+
+    K, V [B, S, kvdim]; PoolK, PoolV [N, P, kvdim] (N pages of P tokens);
+    PageTable [B, MP] int32 physical page ids owned by each row;
+    Lengths [B] int32 true prompt lengths. Token s of row b lands at
+    page PageTable[b, s // P], offset s % P. Positions at or past the
+    row's length are routed to page 0 — the pool's reserved scratch page
+    (never allocated to a request) — so padded prompt tail writes can
+    never corrupt another request's pages."""
+    import jax.numpy as jnp
+
+    k, v = ins["K"][0], ins["V"][0]
+    # .at[] updates need jax arrays (a direct OpTest call feeds numpy)
+    pool_k = jnp.asarray(ins["PoolK"][0])
+    pool_v = jnp.asarray(ins["PoolV"][0])
+    table = jnp.asarray(ins["PageTable"][0])
+    lengths = jnp.asarray(ins["Lengths"][0]).reshape(-1)
+    b, s, _ = k.shape
+    page = int(pool_k.shape[1])
+    pos = jnp.arange(s, dtype=jnp.int32)                       # [S]
+    logical = pos // page                                      # [S]
+    phys = jnp.take_along_axis(
+        table, jnp.broadcast_to(logical[None, :], (b, s)), axis=1)
+    valid = pos[None, :] < lengths[:, None]                    # [B, S]
+    phys = jnp.where(valid, phys, 0).reshape(-1)
+    off = jnp.broadcast_to((pos % page)[None, :], (b, s)).reshape(-1)
+    pool_k = pool_k.at[phys, off].set(k.reshape(b * s, -1))
+    pool_v = pool_v.at[phys, off].set(v.reshape(b * s, -1))
+    return {"PoolKOut": pool_k, "PoolVOut": pool_v}
+
+
+@register_op("cached_kv_attention",
+             required_attrs=("num_heads", "head_dim"),
+             non_diff_inputs=("K", "V", "PoolK", "PoolV", "PageTable",
+                              "Positions"))
+def cached_kv_attention_op(ins, attrs):
+    """One autoregressive DECODE step of attention against the paged KV
+    cache — the cached-KV twin of flash_attention for the generative
+    serving engine (serving/decode.py).
+
+    Q, K, V [B, nh*hd] — the new token's projections; PoolK/PoolV
+    [N, P, kvdim]; PageTable [B, MP]; Positions [B] int32 — the new
+    token's 0-based position (context length = pos + 1). The op first
+    writes the new K/V at (PageTable[b, pos//P], pos%P), then attends
+    the query over the row's gathered pages with positions > pos masked
+    to -1e9 BEFORE the softmax, so stale page contents (the pool
+    recycles pages across requests) contribute exactly zero — per-row
+    outputs are a pure function of the row's own tokens, which is what
+    keeps continuous-batched decode bitwise-identical to sequential
+    decode. Empty slots carry an all-zero page table and write to the
+    pool's reserved scratch page 0.
+
+    Outputs: Out [B, nh*hd], PoolKOut, PoolVOut (the engine threads the
+    pools through the step program and donates them to the jit so XLA
+    can update in place)."""
+    import jax
+    import jax.numpy as jnp
+
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    # .at[] updates need jax arrays (a direct OpTest call feeds numpy)
+    pool_k = jnp.asarray(ins["PoolK"][0])
+    pool_v = jnp.asarray(ins["PoolV"][0])
+    table = jnp.asarray(ins["PageTable"][0])
+    pos = jnp.asarray(ins["Positions"][0]).reshape(-1)
+    n = int(attrs["num_heads"])
+    hd = int(attrs["head_dim"])
+    scale = float(attrs.get("scale") or hd ** -0.5)
+    b = q.shape[0]
+    page = int(pool_k.shape[1])
+    mp = int(table.shape[1])
+    # write the step's K/V into each row's current page
+    phys = jnp.take_along_axis(table, (pos // page)[:, None], axis=1)[:, 0]
+    pool_k = pool_k.at[phys, pos % page].set(k)
+    pool_v = pool_v.at[phys, pos % page].set(v)
+    # gather each row's pages into a dense [B, MP*P, kvdim] context
+    ctx_k = pool_k[table].reshape(b, mp * page, -1)
+    ctx_v = pool_v[table].reshape(b, mp * page, -1)
+    qh = q.reshape(b, n, hd)
+    kh = ctx_k.reshape(b, mp * page, n, hd)
+    vh = ctx_v.reshape(b, mp * page, n, hd)
+    scores = jnp.einsum("bnh,bsnh->bns", qh, kh) * scale
+    mask = jnp.arange(mp * page, dtype=jnp.int32)[None, None, :] \
+        <= pos[:, None, None]
+    scores = jnp.where(mask, scores, -1e9)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bns,bsnh->bnh", probs, vh).reshape(b, n * hd)
+    return {"Out": out, "PoolKOut": pool_k, "PoolVOut": pool_v}
+
+
 @register_op("ring_attention", non_diff_inputs=("Bias",), is_collective=True)
 def ring_attention_op(ins, attrs):
     """Sequence-parallel attention over the `sp` mesh axis

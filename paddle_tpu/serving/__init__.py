@@ -12,6 +12,19 @@ from millions of users"):
 * ``server`` — stdlib HTTP JSON front end + in-process ``LocalClient``,
   with every-bucket warmup.
 
+Generative decode plane (ROADMAP item 1):
+
+* ``decode.DecodeEngine`` — continuous-batching autoregressive
+  generation with a prefill/decode phase split, slot-recycled decode
+  state and per-request deadlines checked at step granularity;
+  continuous-batched output is bitwise-identical to sequential decode;
+* ``kv_cache.KVPagePool`` — the preallocated paged KV cache whose bytes
+  book into the HBM ledger as ``mem.serving.kv_*``; a request that
+  could never fit is refused with ``KVCacheExhaustedError`` at submit
+  instead of OOMing mid-generation;
+* int8 weight-only serving (``DecodeConfig(weight_quant="int8")``) via
+  ops/quant_ops.py ``dequantize_weight``.
+
 Cluster control plane (ROADMAP item 2):
 
 * ``health`` — the liveness/readiness state machine behind ``/healthz``
@@ -32,21 +45,27 @@ tools/chaos_check.py --serving / --cluster.
 
 from .admission import (AdmissionQueue, DeadlineExceededError,
                         EngineClosedError, InferenceRequest,
-                        ServerOverloadedError, ServingError)
+                        KVCacheExhaustedError, ServerOverloadedError,
+                        ServingError)
 from .cluster import ClusterController, ClusterError, InprocReplica, \
     ReplicaProcess
+from .decode import (DecodeConfig, DecodeEngine, GenerationRequest,
+                     decode_engine_from_dir, demo_engine)
 from .engine import ServingConfig, ServingEngine
 from .health import HealthState
+from .kv_cache import KVPagePool
 from .router import (NoReplicaAvailableError, ReplicaHandle, Router,
                      RouterHTTPServer)
-from .server import LocalClient, ServingHTTPServer, serve
+from .server import LocalClient, ServingHTTPServer, serve, serve_decode
 
 __all__ = [
     "AdmissionQueue", "ClusterController", "ClusterError",
-    "DeadlineExceededError", "EngineClosedError", "HealthState",
-    "InferenceRequest", "InprocReplica", "LocalClient",
-    "NoReplicaAvailableError", "ReplicaHandle", "ReplicaProcess",
-    "Router", "RouterHTTPServer", "ServerOverloadedError",
-    "ServingConfig", "ServingEngine", "ServingError",
-    "ServingHTTPServer", "serve",
+    "DeadlineExceededError", "DecodeConfig", "DecodeEngine",
+    "EngineClosedError", "GenerationRequest", "HealthState",
+    "InferenceRequest", "InprocReplica", "KVCacheExhaustedError",
+    "KVPagePool", "LocalClient", "NoReplicaAvailableError",
+    "ReplicaHandle", "ReplicaProcess", "Router", "RouterHTTPServer",
+    "ServerOverloadedError", "ServingConfig", "ServingEngine",
+    "ServingError", "ServingHTTPServer", "decode_engine_from_dir",
+    "demo_engine", "serve", "serve_decode",
 ]

@@ -101,40 +101,102 @@ class InferenceRequest:
         return self._result
 
 
+class KVCacheExhaustedError(ServingError):
+    """The request's worst-case KV-cache page need can never be satisfied
+    by the preallocated pool (serving/kv_cache.py) — a typed refusal at
+    admission instead of a device OOM mid-generation."""
+
+
 class AdmissionQueue:
-    """Bounded FIFO with deadline enforcement and drain semantics."""
+    """Bounded FIFO with deadline enforcement and drain semantics.
+
+    ``metric_prefix`` names the counter family ("serving" for the
+    micro-batching engine, "decode" for the generative decode engine) so
+    both engines share one admission policy layer with separable
+    telemetry."""
 
     def __init__(self, max_depth: int,
-                 default_deadline_ms: float = 0.0):
+                 default_deadline_ms: float = 0.0,
+                 metric_prefix: str = "serving"):
         self.max_depth = int(max_depth)
         self.default_deadline_ms = float(default_deadline_ms)
+        self.metric_prefix = metric_prefix
         self._items: List[InferenceRequest] = []
-        self._cond = lockdep.condition("serving.admission")
+        self._cond = lockdep.condition(f"{metric_prefix}.admission")
         self._closed = False
+
+    def deadline_for(self, deadline_ms: Optional[float]) -> Optional[float]:
+        """Resolve a caller deadline (ms from now, None = default flag)
+        into an absolute time.monotonic() instant, or None."""
+        ms = self.default_deadline_ms if deadline_ms is None \
+            else float(deadline_ms)
+        return time.monotonic() + ms / 1e3 if ms > 0 else None
 
     # -- admission -----------------------------------------------------------
     def submit(self, feeds: Dict[str, Any], rows: int,
                deadline_ms: Optional[float] = None,
                trace: Optional[Any] = None) -> InferenceRequest:
-        ms = self.default_deadline_ms if deadline_ms is None \
-            else float(deadline_ms)
-        deadline = time.monotonic() + ms / 1e3 if ms > 0 else None
+        return self.submit_request(InferenceRequest(
+            feeds, rows, self.deadline_for(deadline_ms), trace=trace))
+
+    def submit_request(self, req: InferenceRequest) -> InferenceRequest:
+        """Admit a pre-built request (the decode engine subclasses
+        InferenceRequest with generation state): bounded-depth check,
+        typed backpressure, the same counters as submit()."""
         with self._cond:
             if self._closed:
                 raise EngineClosedError(
                     "serving engine is shut down — no new requests")
             if len(self._items) >= self.max_depth:
-                telemetry.counter_add("serving.rejects", 1)
+                telemetry.counter_add(f"{self.metric_prefix}.rejects", 1)
                 raise ServerOverloadedError(
                     f"serving queue full ({self.max_depth} requests "
                     f"waiting) — retry later")
-            req = InferenceRequest(feeds, rows, deadline, trace=trace)
             self._items.append(req)
             depth = len(self._items)
             self._cond.notify_all()
-        telemetry.counter_add("serving.requests", 1)
-        telemetry.gauge_set("serving.queue_depth", depth)
+        telemetry.counter_add(f"{self.metric_prefix}.requests", 1)
+        telemetry.gauge_set(f"{self.metric_prefix}.queue_depth", depth)
         return req
+
+    # -- decode-engine take side ---------------------------------------------
+    def poll(self, max_n: int) -> List[InferenceRequest]:
+        """Non-blocking FIFO take of up to ``max_n`` requests. Expired
+        requests are failed here (deadline-at-dequeue, like take_batch)
+        wherever they sit, so a stale request never claims a slot."""
+        out: List[InferenceRequest] = []
+        with self._cond:
+            now = time.monotonic()
+            for req in [r for r in self._items if r.expired(now)]:
+                self._items.remove(req)
+                telemetry.counter_add(
+                    f"{self.metric_prefix}.deadline_expired", 1)
+                req.fail(DeadlineExceededError(
+                    "request deadline elapsed after "
+                    f"{(now - req.enqueue_t) * 1e3:.1f} ms in queue"))
+            while self._items and len(out) < max_n:
+                out.append(self._items.pop(0))
+            depth = len(self._items)
+        telemetry.gauge_set(f"{self.metric_prefix}.queue_depth", depth)
+        return out
+
+    def requeue(self, reqs: List[InferenceRequest]):
+        """Put polled-but-unadmitted requests back at the FIFO head (the
+        decode engine polls, checks pool headroom, and returns what it
+        cannot seat yet — admission order is preserved)."""
+        if not reqs:
+            return
+        with self._cond:
+            self._items[0:0] = list(reqs)
+            self._cond.notify_all()
+
+    def wait_for_work(self, timeout_s: Optional[float]) -> bool:
+        """Block until the queue holds work or is closed (or timeout);
+        returns True when items are waiting."""
+        with self._cond:
+            if not self._items and not self._closed:
+                self._cond.wait(timeout_s)
+            return bool(self._items)
 
     # -- batch assembly ------------------------------------------------------
     def take_batch(self, signature: Callable[[InferenceRequest], Any],
@@ -153,7 +215,8 @@ class AdmissionQueue:
                 # drop expired requests wherever they sit in the queue
                 for req in [r for r in self._items if r.expired(now)]:
                     self._items.remove(req)
-                    telemetry.counter_add("serving.deadline_expired", 1)
+                    telemetry.counter_add(
+                        f"{self.metric_prefix}.deadline_expired", 1)
                     req.fail(DeadlineExceededError(
                         "request deadline elapsed after "
                         f"{(now - req.enqueue_t) * 1e3:.1f} ms in queue"))
@@ -185,7 +248,7 @@ class AdmissionQueue:
                 self._cond.wait(wait_s)
             depth = len(self._items)
             self._cond.notify_all()
-        telemetry.gauge_set("serving.queue_depth", depth)
+        telemetry.gauge_set(f"{self.metric_prefix}.queue_depth", depth)
         return sig, batch
 
     # -- lifecycle -----------------------------------------------------------

@@ -1,0 +1,599 @@
+"""DecodeEngine — continuous-batching autoregressive generation over a
+paged KV cache.
+
+The ServingEngine (engine.py) micro-batches single-shot predictors; this
+engine is its generative twin for the workload that dominates LLM
+serving traffic: many concurrent requests each producing tokens one
+step at a time. Orca-style continuous batching + vLLM-style paged KV
+caching, on the repo's frozen-program stack:
+
+* **Phase split.** An admitted request first runs ONE prefill program
+  (models/decoder_lm.build_prefill_program, padded to a prompt-length
+  bucket) that writes the whole prompt's K/V into its pool pages and
+  yields the first sampled token; from then on it only rides the shared
+  decode step.
+* **Continuous batching.** Decode state lives in a slot array of
+  ``max_slots`` recycled slots. Every iteration the scheduler retires
+  finished/expired sequences (freeing their pages) and admits queued
+  requests into the vacated slots at the step boundary — no
+  drain-and-refill: a long generation never holds the batch hostage for
+  a short one. One ``jax.jit`` entry per slot-array bucket
+  (FLAGS_decode_buckets; the default is a single fixed bucket of
+  ``max_slots``, which ALSO pins the step shapes — per-row math is then
+  independent of occupancy, keeping continuous-batched generations
+  BITWISE-identical to sequential one-request-at-a-time decode).
+* **Paged KV cache.** Pages come from the preallocated
+  ``KVPagePool`` (kv_cache.py); the pool arrays are threaded through
+  the step program and donated to the jit so XLA updates them in place.
+  Pool bytes book into the PR 10 HBM ledger (``mem.serving.kv_*``) and
+  a request whose worst-case page need can never fit is refused at
+  submit with a typed ``KVCacheExhaustedError`` — admission control,
+  not a device OOM.
+* **int8 weight-only serving** as a first-class config
+  (``weight_quant="int8"`` / FLAGS_decode_weight_quant): dense weights
+  are stored int8 with per-output-channel scales and dequantized through
+  ops/quant_ops.py ``dequantize_weight`` inside the programs.
+* **Deadline-aware scheduling** reusing serving/admission.py: queued
+  requests expire at dequeue (AdmissionQueue.poll), running requests
+  are checked at STEP granularity — an expired generation retires
+  mid-flight with ``DeadlineExceededError`` and frees its pages without
+  draining the batch.
+
+Sampling happens host-side per row (greedy argmax, or temperature
+sampling driven by a per-request pinned ``np.random.RandomState``), so
+token selection is a pure function of the row's logits bits and the
+request's own seed — scheduling cannot perturb it.
+
+Fault sites (core/faults.py, tools/chaos_check.py --decode):
+``decode.step`` fails the in-flight step (every affected request gets a
+per-request error, pages are freed, the queue keeps moving) and
+``decode.kv_alloc`` fails one request's page allocation.
+
+Telemetry: decode.requests/rejects/deadline_expired (admission),
+decode.prefills / prefill_tokens / steps / tokens / retired / errors /
+kv_refusals / kv_pages_allocated / kv_pages_freed counters,
+decode.prefill_ms + decode.step_ms timers, decode.batch_occupancy
+histogram, decode.active_slots + decode.queue_depth +
+mem.serving.kv_* gauges — rendered by tools/perf_report.py's "Decode"
+section and /v1/stats.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core import costmodel, faults, telemetry
+from ..core.flags import flag as _flag
+from ..models.decoder_lm import (DecoderLMConfig, build_prefill_program,
+                                 build_step_program, decoder_lm_params,
+                                 quantize_decoder_lm_params)
+from .admission import (AdmissionQueue, DeadlineExceededError,
+                        EngineClosedError, InferenceRequest,
+                        KVCacheExhaustedError, ServingError)
+from .health import DRAINING, READY, STOPPED, HealthState
+from .kv_cache import KVPagePool
+
+
+def _pow2_ladder(lo: int, hi: int) -> List[int]:
+    out, b = [], lo
+    while b < hi:
+        out.append(b)
+        b *= 2
+    out.append(hi)
+    return sorted(set(out))
+
+
+class DecodeConfig:
+    """Decode-engine knobs; defaults come from the FLAGS_decode_*
+    registry. ``continuous=False`` turns the scheduler into the
+    drain-and-refill static-batching baseline (admit a wave, run it to
+    completion, only then admit the next) — the control arm of
+    tools/bench_serving.py --generate."""
+
+    def __init__(self, max_slots: Optional[int] = None,
+                 buckets: Optional[Sequence[int]] = None,
+                 page_size: Optional[int] = None,
+                 kv_pages: Optional[int] = None,
+                 max_queue_depth: Optional[int] = None,
+                 default_deadline_ms: Optional[float] = None,
+                 max_new_tokens: Optional[int] = None,
+                 weight_quant: Optional[str] = None,
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 continuous: bool = True):
+        self.max_slots = int(_flag("decode_max_slots") if max_slots is None
+                             else max_slots)
+        if buckets is None:
+            spec = str(_flag("decode_buckets")).strip()
+            buckets = [int(b) for b in spec.split(",") if b.strip()] \
+                if spec else None
+        # default: ONE fixed bucket — constant step shapes keep
+        # continuous batching bitwise-identical to sequential decode
+        self.buckets = sorted(set(int(b) for b in buckets)) if buckets \
+            else [self.max_slots]
+        if self.buckets[0] < 1 or self.buckets[-1] != self.max_slots:
+            raise ValueError(
+                f"decode buckets {self.buckets} must be >= 1 and end at "
+                f"max_slots ({self.max_slots})")
+        self.page_size = int(_flag("decode_page_size") if page_size is None
+                             else page_size)
+        self.kv_pages = int(_flag("decode_kv_pages") if kv_pages is None
+                            else kv_pages)
+        self.max_queue_depth = int(
+            _flag("decode_max_queue_depth") if max_queue_depth is None
+            else max_queue_depth)
+        self.default_deadline_ms = float(
+            _flag("decode_default_deadline_ms") if default_deadline_ms is None
+            else default_deadline_ms)
+        self.max_new_tokens = int(
+            _flag("decode_max_new_tokens") if max_new_tokens is None
+            else max_new_tokens)
+        self.weight_quant = str(
+            _flag("decode_weight_quant") if weight_quant is None
+            else weight_quant).lower()
+        if self.weight_quant not in ("none", "int8"):
+            raise ValueError(f"decode weight_quant must be 'none' or "
+                             f"'int8', got {self.weight_quant!r}")
+        self.prefill_buckets = sorted(set(int(b) for b in prefill_buckets)) \
+            if prefill_buckets else None   # None -> pow2 up to max_seq_len
+        self.continuous = bool(continuous)
+
+    def bucket(self, active: int) -> int:
+        for b in self.buckets:
+            if active <= b:
+                return b
+        return self.buckets[-1]
+
+
+class GenerationRequest(InferenceRequest):
+    """One queued/running generation: prompt + sampling params + the
+    engine-side decode state. Rides the shared AdmissionQueue (deadline
+    at dequeue, typed backpressure); ``result()`` returns the generated
+    token ids as an int32 array. ``ttft_ms`` / ``token_walls`` expose
+    time-to-first-token and per-token arrival times for the bench
+    harness."""
+
+    __slots__ = ("prompt", "max_new_tokens", "temperature", "seed",
+                 "eos_id", "tokens", "token_walls", "t_submit", "t_first",
+                 "pages", "table_row", "pos_next", "last_token", "_rng")
+
+    def __init__(self, prompt: np.ndarray, max_new_tokens: int,
+                 deadline: Optional[float], temperature: float = 0.0,
+                 seed: Optional[int] = None, eos_id: Optional[int] = None,
+                 trace: Optional[Any] = None):
+        super().__init__({"prompt": prompt}, 1, deadline, trace=trace)
+        self.prompt = prompt
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.seed = seed
+        self.eos_id = eos_id
+        self.tokens: List[int] = []
+        self.token_walls: List[float] = []
+        self.t_submit = time.monotonic()
+        self.t_first: Optional[float] = None
+        # engine-side slot state (worker-thread-owned once admitted)
+        self.pages: List[int] = []
+        self.table_row: Optional[np.ndarray] = None
+        self.pos_next = 0
+        self.last_token = 0
+        self._rng = np.random.RandomState(seed) if seed is not None \
+            else None
+
+    @property
+    def ttft_ms(self) -> Optional[float]:
+        if self.t_first is None:
+            return None
+        return (self.t_first - self.t_submit) * 1e3
+
+    def sample(self, logits_row: np.ndarray) -> int:
+        """Host-side token choice — a pure function of the row's logits
+        bits and this request's own RNG stream, so batching/scheduling
+        cannot perturb it. Greedy when temperature <= 0 (argmax, lowest
+        index on ties); else softmax-at-temperature inverse-CDF driven
+        by the pinned per-request RandomState."""
+        if self.temperature <= 0.0:
+            return int(np.argmax(logits_row))
+        if self._rng is None:
+            raise ValueError("sampled decoding (temperature > 0) needs a "
+                             "per-request seed for reproducible serving")
+        z = logits_row.astype(np.float64) / self.temperature
+        z -= z.max()
+        p = np.exp(z)
+        p /= p.sum()
+        # clamp: a draw past the fp cumsum tail must not index vocab+1
+        idx = np.searchsorted(np.cumsum(p), self._rng.random_sample())
+        return int(min(idx, len(p) - 1))
+
+    def finished(self) -> bool:
+        return bool(self.tokens) and (
+            len(self.tokens) >= self.max_new_tokens
+            or (self.eos_id is not None and self.tokens[-1] == self.eos_id))
+
+
+class DecodeEngine:
+    """Thread-safe generative front end over a frozen decoder-LM param
+    set. Lifecycle mirrors ServingEngine: ``start()`` → concurrent
+    ``submit``/``generate`` → ``close(drain=True)``. One worker thread
+    owns the slot array, the pools and every program run."""
+
+    def __init__(self, model_cfg: DecoderLMConfig, params: Dict[str, Any],
+                 config: Optional[DecodeConfig] = None, version: int = 0):
+        import jax.numpy as jnp
+
+        self.model_cfg = model_cfg
+        self.config = config or DecodeConfig()
+        if self.config.weight_quant == "int8":
+            params = quantize_decoder_lm_params(params, model_cfg)
+            telemetry.counter_add("decode.int8_weight_tensors",
+                                  sum(1 for n in params
+                                      if n.endswith("_w_i8")))
+        self._params = {n: jnp.asarray(v) for n, v in params.items()}
+        self.pool = KVPagePool(model_cfg.n_layers, self.config.kv_pages,
+                               self.config.page_size, model_cfg.d_model)
+        self._pools = self.pool.make_arrays()
+        self._mp = -(-model_cfg.max_seq_len // self.config.page_size)
+        self.queue = AdmissionQueue(self.config.max_queue_depth,
+                                    self.config.default_deadline_ms,
+                                    metric_prefix="decode")
+        if self.config.prefill_buckets is None:
+            self.config.prefill_buckets = _pow2_ladder(
+                min(8, model_cfg.max_seq_len), model_cfg.max_seq_len)
+        self._active: List[GenerationRequest] = []
+        self._entries: Dict[Any, Any] = {}   # (phase, bucket) -> jitted fn
+        self._thread: Optional[threading.Thread] = None
+        self.health = HealthState()
+        self.version = int(version)
+
+    # -- client surface ------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               deadline_ms: Optional[float] = None,
+               temperature: float = 0.0, seed: Optional[int] = None,
+               stop_at_eos: bool = True) -> GenerationRequest:
+        """Enqueue one generation (non-blocking). ``prompt`` is a 1-D
+        int token-id array. Raises ValueError (malformed / over the
+        model length), KVCacheExhaustedError (can never fit the KV
+        pool), ServerOverloadedError, EngineClosedError."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt needs at least one token")
+        if max_new_tokens is None:
+            max_new_tokens = self.config.max_new_tokens
+        max_new_tokens = int(max_new_tokens)
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, "
+                             f"got {max_new_tokens}")
+        total = int(prompt.size) + max_new_tokens
+        if total > self.model_cfg.max_seq_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds the model's max_seq_len "
+                f"({self.model_cfg.max_seq_len})")
+        # typed would-OOM refusal BEFORE the request enters the queue
+        self.pool.check_fits(total)
+        req = GenerationRequest(
+            prompt, max_new_tokens, self.queue.deadline_for(deadline_ms),
+            temperature=temperature, seed=seed,
+            eos_id=self.model_cfg.eos_id if stop_at_eos else None)
+        self.queue.submit_request(req)
+        return req
+
+    def generate(self, prompt, timeout: Optional[float] = None,
+                 **kw) -> np.ndarray:
+        """Blocking submit-and-wait; returns the generated int32 ids."""
+        return self.submit(prompt, **kw).result(timeout)
+
+    def stats(self) -> Dict[str, Any]:
+        """decode.* counters + KV pool accounting + latency percentiles
+        + rolling-window token rate — the /v1/stats "decode" payload."""
+        c = telemetry.counters()
+        out = {k.split(".", 1)[1]: int(v) for k, v in c.items()
+               if k.startswith("decode.") and isinstance(v, (int, float))}
+        out["queue_depth"] = self.queue.depth()
+        out["model_version"] = self.version
+        out["status"] = self.health.state
+        out["kv_cache"] = self.pool.stats()
+        hists = telemetry.snapshot()["hists"]
+        for key in ("decode.step_ms", "decode.prefill_ms",
+                    "decode.request_ms"):
+            h = hists.get(key)
+            if h:
+                out[key.split(".", 1)[1]] = {
+                    "count": h["count"], "avg": h["avg"], "p50": h["p50"],
+                    "p95": h["p95"], "p99": h["p99"], "max": h["max"]}
+        occ = hists.get("decode.batch_occupancy")
+        if occ:
+            out["batch_occupancy"] = {"avg": occ["avg"], "p50": occ["p50"]}
+        win = telemetry.windowed()
+        wout = {"seconds": win["window_s"]}
+        for name, key in (("decode.tokens", "tokens_per_s"),
+                          ("decode.steps", "steps_per_s")):
+            wc = win["counters"].get(name)
+            if wc:
+                wout[key] = wc["rate"]
+        out["window"] = wout
+        return out
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self, warmup: bool = False) -> "DecodeEngine":
+        if self._thread is not None:
+            return self
+        if self.queue.closed:
+            raise EngineClosedError("decode engine was closed; "
+                                    "build a new one")
+        if warmup:
+            self.warmup()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="pt-decode-engine",
+                                        daemon=True)
+        self._thread.start()
+        self.health.set(READY)
+        return self
+
+    def warmup(self) -> int:
+        """Pre-compile every decode bucket and every prefill bucket so
+        no request ever pays a compile mid-load (a mid-generation
+        compile stalls the WHOLE slot array, not just one request).
+        Returns the number of fresh compiles."""
+        before = telemetry.counter_get("decode.compiles")
+        for b in self.config.buckets:
+            self._entry("step", b)
+        for b in self.config.prefill_buckets:
+            self._entry("prefill", b)
+        return int(telemetry.counter_get("decode.compiles") - before)
+
+    def close(self, drain: bool = True, timeout: Optional[float] = None):
+        self.health.set(DRAINING)
+        self.queue.close(drain=drain)
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        self.health.set(STOPPED)
+
+    # -- program compilation -------------------------------------------------
+    def _entry(self, phase: str, bucket: int):
+        """One jitted (params, pools, feed) -> (logits, new_pools) entry
+        per (phase, bucket), pools donated so XLA updates the KV arrays
+        in place; compile wall time + XLA cost capture accounted like
+        the predictor's cache."""
+        key = (phase, bucket)
+        entry = self._entries.get(key)
+        if entry is not None:
+            return entry
+        import jax
+
+        from ..core.executor import run_block
+
+        cfg, cc = self.model_cfg, self.config
+        if phase == "step":
+            program, _feeds, _fetches = build_step_program(
+                cfg, bucket, cc.kv_pages, cc.page_size, cc.weight_quant)
+        else:
+            program, _feeds, _fetches = build_prefill_program(
+                cfg, 1, bucket, cc.kv_pages, cc.page_size, cc.weight_quant)
+        block = program.global_block()
+        pool_names = sorted(self._pools)
+
+        def fn(params, pools, feed):
+            env = dict(params)
+            env.update(pools)
+            env.update(feed)
+            run_block(block, env)
+            return env["logits"], {n: env[n + "_out"] for n in pool_names}
+
+        entry = jax.jit(fn, donate_argnums=(1,))
+        self._entries[key] = entry
+        t0 = time.perf_counter()
+        feed = self._zero_feed(phase, bucket)
+        if costmodel.capture_mode() != "off":
+            costmodel.capture(
+                lambda: entry.lower(self._params, dict(self._pools), feed),
+                key_id=costmodel.key_id_for((phase, bucket,
+                                             cc.weight_quant)),
+                kind="decode", program=f"{phase}_b{bucket}")
+        # compile through a throwaway execution on zero feeds (the
+        # predictor's measure-through-first-run discipline); FRESH pool
+        # arrays, because donation consumes whatever is passed in
+        entry(self._params, self.pool.make_arrays(), feed)
+        ms = round((time.perf_counter() - t0) * 1e3, 3)
+        telemetry.counter_add("decode.compiles", 1)
+        telemetry.event("compile", "decode", ms,
+                        {"cause": "decode_bucket", "phase": phase,
+                         "bucket": bucket,
+                         "cache_size": len(self._entries)})
+        return entry
+
+    def _zero_feed(self, phase: str, bucket: int):
+        import jax.numpy as jnp
+
+        if phase == "step":
+            return {"tokens": jnp.zeros((bucket,), jnp.int32),
+                    "positions": jnp.zeros((bucket,), jnp.int32),
+                    "page_table": jnp.zeros((bucket, self._mp), jnp.int32)}
+        oh = np.zeros((1, bucket), np.float32)
+        oh[0, 0] = 1.0
+        return {"tokens": jnp.zeros((1, bucket), jnp.int32),
+                "lengths": jnp.ones((1,), jnp.int32),
+                "last_onehot": jnp.asarray(oh),
+                "page_table": jnp.zeros((1, self._mp), jnp.int32)}
+
+    # -- scheduler loop ------------------------------------------------------
+    def _loop(self):
+        while True:
+            if not self._active:
+                has_work = self.queue.wait_for_work(0.05)
+                if not has_work:
+                    if self.queue.closed:
+                        return
+                    continue
+            try:
+                self._admit()
+                if self._active:
+                    self._run_step()
+            except BaseException as e:   # the loop must outlive any step
+                telemetry.counter_add("decode.errors",
+                                      max(1, len(self._active)),
+                                      exc=type(e).__name__)
+                err = e if isinstance(e, ServingError) else ServingError(
+                    f"decode step failed: {e!r}")
+                for req in self._active:
+                    self._retire(req, error=err)
+                self._active = []
+            telemetry.gauge_set("decode.active_slots", len(self._active))
+
+    def _admit(self):
+        """Seat queued requests into free slots at the step boundary.
+        Non-continuous (drain-and-refill baseline) only admits into an
+        EMPTY slot array."""
+        if not self.config.continuous and self._active:
+            return
+        free = self.config.max_slots - len(self._active)
+        if free <= 0:
+            return
+        unseated: List[GenerationRequest] = []
+        for req in self.queue.poll(free):
+            need = self.pool.pages_for_tokens(
+                int(req.prompt.size) + req.max_new_tokens)
+            try:
+                pages = self.pool.try_alloc(need)
+            except Exception as e:   # injected decode.kv_alloc fault
+                telemetry.counter_add("decode.errors", 1,
+                                      exc=type(e).__name__)
+                req.fail(e if isinstance(e, ServingError) else ServingError(
+                    f"KV page allocation failed: {e!r}"))
+                continue
+            if not pages:
+                unseated.append(req)   # no headroom NOW — wait for frees
+                continue
+            try:
+                self._prefill(req, pages)
+            except BaseException as e:
+                self.pool.free(pages)
+                telemetry.counter_add("decode.errors", 1,
+                                      exc=type(e).__name__)
+                req.fail(e if isinstance(e, ServingError) else ServingError(
+                    f"prefill failed: {e!r}"))
+        self.queue.requeue(unseated)
+
+    def _prefill(self, req: GenerationRequest, pages: List[int]):
+        """PREFILL phase: one causal pass over the padded prompt writes
+        its K/V into the allocated pages and yields the first token."""
+        import jax.numpy as jnp
+
+        L = int(req.prompt.size)
+        bucket = next(b for b in self.config.prefill_buckets if b >= L)
+        req.pages = pages
+        row = np.zeros(self._mp, np.int32)
+        row[:len(pages)] = pages
+        req.table_row = row
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :L] = req.prompt
+        oh = np.zeros((1, bucket), np.float32)
+        oh[0, L - 1] = 1.0
+        feed = {"tokens": jnp.asarray(tokens),
+                "lengths": jnp.asarray([L], jnp.int32),
+                "last_onehot": jnp.asarray(oh),
+                "page_table": jnp.asarray(row[None, :])}
+        entry = self._entry("prefill", bucket)
+        with telemetry.timer("decode.prefill_ms"):
+            logits, self._pools = entry(self._params, self._pools, feed)
+            logits = np.asarray(logits)
+        telemetry.counter_add("decode.prefills", 1)
+        telemetry.counter_add("decode.prefill_tokens", L)
+        self._append_token(req, logits[0])
+        req.pos_next = L
+        if req.finished():
+            self._retire(req)
+        else:
+            self._active.append(req)
+
+    def _run_step(self):
+        """DECODE phase: one fixed-shape step over the padded slot
+        array; per-request deadlines checked here, at step granularity."""
+        import jax.numpy as jnp
+
+        now = time.monotonic()
+        for req in [r for r in self._active if r.expired(now)]:
+            self._active.remove(req)
+            telemetry.counter_add("decode.deadline_expired", 1,
+                                  phase="generation")
+            self._retire(req, error=DeadlineExceededError(
+                f"generation deadline elapsed after {len(req.tokens)} of "
+                f"{req.max_new_tokens} tokens"))
+        if not self._active:
+            return
+        active = self._active
+        bucket = self.config.bucket(len(active))
+        faults.maybe_fail("decode.step", active=len(active), bucket=bucket)
+        tokens = np.zeros(bucket, np.int32)
+        positions = np.zeros(bucket, np.int32)
+        table = np.zeros((bucket, self._mp), np.int32)
+        for i, req in enumerate(active):
+            tokens[i] = req.last_token
+            positions[i] = req.pos_next
+            table[i] = req.table_row
+        feed = {"tokens": jnp.asarray(tokens),
+                "positions": jnp.asarray(positions),
+                "page_table": jnp.asarray(table)}
+        entry = self._entry("step", bucket)
+        with telemetry.timer("decode.step_ms"):
+            logits, self._pools = entry(self._params, self._pools, feed)
+            logits = np.asarray(logits)
+        telemetry.counter_add("decode.steps", 1)
+        telemetry.counter_add("decode.tokens", len(active))
+        telemetry.observe("decode.batch_occupancy", len(active) / bucket)
+        still = []
+        for i, req in enumerate(active):
+            self._append_token(req, logits[i])
+            req.pos_next += 1
+            if req.finished():
+                self._retire(req)
+            else:
+                still.append(req)
+        self._active = still
+
+    def _append_token(self, req: GenerationRequest, logits_row: np.ndarray):
+        tok = req.sample(logits_row)
+        now = time.monotonic()
+        if req.t_first is None:
+            req.t_first = now
+        req.tokens.append(tok)
+        req.token_walls.append(now)
+        req.last_token = tok
+
+    def _retire(self, req: GenerationRequest, error: Optional[BaseException]
+                = None):
+        """Slot recycling: free the request's pages and resolve/fail it
+        — finished sequences leave WITHOUT draining the batch."""
+        if req.pages:
+            self.pool.free(req.pages)
+            req.pages = []
+        telemetry.counter_add("decode.retired", 1)
+        telemetry.observe("decode.request_ms",
+                          (time.monotonic() - req.t_submit) * 1e3,
+                          kind="timer")
+        if error is not None:
+            req.fail(error)
+        else:
+            req.resolve(np.asarray(req.tokens, np.int32))
+
+
+def decode_engine_from_dir(model_dir: str,
+                           config: Optional[DecodeConfig] = None,
+                           version: int = 0) -> DecodeEngine:
+    """Servable dir (models/decoder_lm.save_decoder_lm) -> engine — the
+    frozen-model path the HTTP server and cluster plane use."""
+    from ..models.decoder_lm import load_decoder_lm
+
+    cfg, params = load_decoder_lm(model_dir)
+    return DecodeEngine(cfg, params, config=config, version=version)
+
+
+def demo_engine(config: Optional[DecodeConfig] = None,
+                model_cfg: Optional[DecoderLMConfig] = None,
+                seed: int = 0) -> DecodeEngine:
+    """Deterministically-initialised small LM engine (tests/bench)."""
+    cfg = model_cfg or DecoderLMConfig()
+    return DecodeEngine(cfg, decoder_lm_params(cfg, seed), config=config)

@@ -1,0 +1,157 @@
+"""Paged KV cache — the decode engine's preallocated page pool.
+
+vLLM's PagedAttention memory discipline in dense-jax form: instead of
+one max-length KV buffer per request (whose worst case is what forces
+tiny batch sizes), the engine preallocates ONE pool of fixed-size pages
+per layer and hands each request just the pages its sequence actually
+needs. Pages are allocated at admission (worst case for the request:
+ceil((prompt + max_new_tokens) / page_size), so a mid-generation
+allocation can never fail) and freed the moment the request retires —
+continuous batching churns requests through the same arrays with no
+device alloc/free traffic at all.
+
+Page 0 is a reserved scratch page: the ops route padded prompt
+positions and empty decode slots there (see ops/attention_ops.py
+kv_cache_write / cached_kv_attention), so a masked write can never
+touch a page owned by a live request.
+
+Accounting: the pool's bytes book into the PR 10 HBM ledger as
+``mem.serving.kv_pool_bytes`` (preallocated, the resident figure),
+``mem.serving.kv_used_bytes`` (pages currently owned by live requests)
+and ``mem.serving.kv_high_water_bytes`` — rendered by tools/mem_report
+and /v1/stats, and what lets admission refuse a request that would OOM
+(typed ``KVCacheExhaustedError``) instead of dying mid-decode.
+``decode.kv_alloc`` is a fault-injection site (core/faults.py,
+tools/chaos_check.py --decode).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from ..core import costmodel, faults, telemetry
+from ..core.analysis import lockdep
+from .admission import KVCacheExhaustedError
+
+
+class KVPagePool:
+    """Free-list allocator over preallocated per-layer page arrays.
+
+    The jax arrays themselves (``pools``: kv_k_<l>/kv_v_<l> ->
+    [num_pages, page_size, kv_dim]) are owned and threaded/donated by
+    the engine's step function; this object owns the PAGE IDS and the
+    ledger accounting. Page 0 is never handed out."""
+
+    def __init__(self, n_layers: int, num_pages: int, page_size: int,
+                 kv_dim: int, dtype: str = "float32"):
+        if num_pages < 2:
+            raise ValueError(f"KV pool needs >= 2 pages (page 0 is the "
+                             f"reserved scratch page), got {num_pages}")
+        self.n_layers = int(n_layers)
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.kv_dim = int(kv_dim)
+        self.dtype = dtype
+        self._lock = lockdep.lock("serving.kv_pool")
+        self._free: List[int] = list(range(1, self.num_pages))
+        self._high_water_pages = 0
+        import numpy as np
+
+        itemsize = np.dtype(dtype).itemsize
+        # keys + values, every layer
+        self.pool_bytes = (2 * self.n_layers * self.num_pages *
+                           self.page_size * self.kv_dim * itemsize)
+        self._page_bytes = self.pool_bytes // self.num_pages
+        telemetry.gauge_set("mem.serving.kv_pool_bytes", self.pool_bytes)
+        telemetry.gauge_set("mem.serving.kv_used_bytes", 0)
+        telemetry.gauge_set("mem.serving.kv_high_water_bytes", 0)
+        costmodel.refresh_ledger()
+
+    def make_arrays(self) -> Dict[str, Any]:
+        """Fresh zeroed device pools keyed by the program feed names."""
+        import jax.numpy as jnp
+
+        shape = (self.num_pages, self.page_size, self.kv_dim)
+        out = {}
+        for i in range(self.n_layers):
+            out[f"kv_k_{i}"] = jnp.zeros(shape, self.dtype)
+            out[f"kv_v_{i}"] = jnp.zeros(shape, self.dtype)
+        return out
+
+    # -- capacity ------------------------------------------------------------
+    @property
+    def capacity_pages(self) -> int:
+        """Allocatable pages (page 0 excluded)."""
+        return self.num_pages - 1
+
+    def pages_for_tokens(self, tokens: int) -> int:
+        return -(-int(tokens) // self.page_size)
+
+    def free_pages(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def check_fits(self, tokens: int):
+        """Typed admission-time refusal: a request whose WORST-CASE page
+        need exceeds the whole pool can never be served — refuse it now
+        instead of letting it OOM the cache mid-generation."""
+        need = self.pages_for_tokens(tokens)
+        if need > self.capacity_pages:
+            telemetry.counter_add("decode.kv_refusals", 1, pages=need)
+            raise KVCacheExhaustedError(
+                f"request needs {need} KV pages ({tokens} tokens at "
+                f"{self.page_size}/page) but the pool holds "
+                f"{self.capacity_pages} — over the KV budget "
+                f"(mem.serving.kv_pool_bytes={self.pool_bytes}); raise "
+                f"FLAGS_decode_kv_pages or shorten the request")
+        return need
+
+    # -- alloc / free --------------------------------------------------------
+    def try_alloc(self, n: int) -> List[int]:
+        """Pop n pages, or [] when the pool cannot seat them right now
+        (the request stays queued until retirements free pages).
+        ``decode.kv_alloc`` faults inject here."""
+        faults.maybe_fail("decode.kv_alloc", pages=n)
+        with self._lock:
+            if n > len(self._free):
+                return []
+            pages = self._free[:n]
+            del self._free[:n]
+            used = self.capacity_pages - len(self._free)
+            self._high_water_pages = max(self._high_water_pages, used)
+            hw = self._high_water_pages
+        telemetry.counter_add("decode.kv_pages_allocated", n)
+        telemetry.gauge_set("mem.serving.kv_used_bytes",
+                            used * self._page_bytes)
+        telemetry.gauge_set("mem.serving.kv_high_water_bytes",
+                            hw * self._page_bytes)
+        return pages
+
+    def free(self, pages: List[int]):
+        if not pages:
+            return
+        with self._lock:
+            dup = set(pages) & set(self._free)
+            if dup or 0 in pages:
+                raise AssertionError(
+                    f"KV pool corruption: freeing pages {sorted(dup)} "
+                    f"already free (or the reserved page 0)")
+            self._free.extend(pages)
+            used = self.capacity_pages - len(self._free)
+        telemetry.counter_add("decode.kv_pages_freed", len(pages))
+        telemetry.gauge_set("mem.serving.kv_used_bytes",
+                            used * self._page_bytes)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            free = len(self._free)
+            hw = self._high_water_pages
+        return {"page_size": self.page_size,
+                "pages_total": self.capacity_pages,
+                "pages_free": free,
+                "pages_used": self.capacity_pages - free,
+                "high_water_pages": hw,
+                "pool_bytes": self.pool_bytes,
+                "used_bytes": (self.capacity_pages - free) *
+                self._page_bytes,
+                "high_water_bytes": hw * self._page_bytes}

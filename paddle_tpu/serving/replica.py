@@ -22,6 +22,12 @@ crash the router's failover exists for.
 Fault injection: the process inherits PT_FAULT_SPEC / PT_FAULT_SEED from
 its environment, so a chaos run arms ``serving.handler`` /
 ``replica.swap`` in every replica without code changes.
+
+``--decode-model-dir`` instead runs a GENERATIVE replica: the
+continuous-batching decode engine (serving/decode.py) over a
+models/decoder_lm servable dir, same announce/drain contract, serving
+``POST /v1/generate`` (``decode.step`` / ``decode.kv_alloc`` fault
+sites armed the same way).
 """
 
 from __future__ import annotations
@@ -33,6 +39,42 @@ import signal
 import sys
 import threading
 from typing import Optional
+
+
+def run_decode_replica(args) -> int:
+    """--decode-model-dir mode: one GENERATIVE replica (DecodeEngine
+    over a models/decoder_lm servable dir) behind the same HTTP surface
+    and PT_REPLICA_READY / SIGTERM-drain contract — POST /v1/generate
+    instead of /v1/infer."""
+    from ..core import telemetry
+    from .decode import decode_engine_from_dir
+    from .server import ServingHTTPServer
+
+    if args.telemetry_log:
+        telemetry.configure(args.telemetry_log)
+    engine = decode_engine_from_dir(args.decode_model_dir)
+    server = ServingHTTPServer(None, host=args.host, port=args.port,
+                               decode_engine=engine).start()
+    print("PT_REPLICA_READY " + json.dumps(
+        {"url": server.url, "port": server.port, "pid": os.getpid(),
+         "version": engine.version, "model_dir": args.decode_model_dir,
+         "decode": True}), flush=True)
+
+    stop = threading.Event()
+
+    def _graceful(_sig, _frm):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _graceful)
+    signal.signal(signal.SIGINT, _graceful)
+    engine.start(warmup=not args.no_warmup)
+    try:
+        stop.wait()
+    finally:
+        engine.close(drain=True, timeout=30)
+        server.shutdown()
+        telemetry.flush_sink()
+    return 0
 
 
 def run_replica(args) -> int:
@@ -114,6 +156,11 @@ def main(argv=None) -> int:
     src.add_argument("--model-dir",
                      help="bare inference-model dir (io.save_inference_"
                           "model layout), served as version 0")
+    src.add_argument("--decode-model-dir",
+                     help="decoder-LM servable dir (models/decoder_lm."
+                          "save_decoder_lm layout): run a GENERATIVE "
+                          "replica — POST /v1/generate via the "
+                          "continuous-batching decode engine")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=0,
                     help="0 binds an ephemeral port (announced on stdout)")
@@ -131,6 +178,8 @@ def main(argv=None) -> int:
                     help="JSONL run log for this replica (one file per "
                          "process; tools/trace_view.py merges them)")
     args = ap.parse_args(argv)
+    if args.decode_model_dir:
+        return run_decode_replica(args)
     return run_replica(args)
 
 

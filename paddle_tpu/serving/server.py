@@ -7,6 +7,14 @@ server samples around AnalysisPredictor; TF-Serving's REST surface is
 the API shape being mirrored.
 
 API:
+    POST /v1/generate {"prompt_ids": [ints], "max_new_tokens"?,
+                      "temperature"?, "seed"?, "deadline_ms"?}
+             200 ->  {"tokens": [ints], "num_tokens", "ttft_ms",
+                      "model_version", "latency_ms"} — the generative
+                     decode plane (serving/decode.py) when a
+                     decode_engine is attached; 429 carries
+                     error_type "KVCacheExhaustedError" for the typed
+                     would-OOM refusal
     POST /v1/infer   {"inputs": {name: nested lists},
                       "deadline_ms": optional float}
              200 ->  {"outputs": {name: nested lists}, "latency_ms": f,
@@ -65,7 +73,7 @@ import numpy as np
 
 from ..core import telemetry, trace
 from .admission import (DeadlineExceededError, EngineClosedError,
-                        ServerOverloadedError)
+                        KVCacheExhaustedError, ServerOverloadedError)
 from .engine import ServingConfig, ServingEngine
 
 
@@ -113,7 +121,7 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_GET(self):
-        engine: ServingEngine = self.server.engine
+        engine = self.server.engine or self.server.decode_engine
         if self.path == "/healthz":
             # READINESS: 200 iff this replica should receive traffic NOW
             snap = engine.health.snapshot(
@@ -125,7 +133,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(200 if alive else 503,
                         {"status": "alive" if alive else "stopped"})
         elif self.path == "/v1/stats":
-            self._reply(200, engine.stats())
+            stats = self.server.engine.stats() \
+                if self.server.engine is not None else {}
+            if self.server.decode_engine is not None:
+                # the generative plane's counters + KV-cache/pool ledger
+                stats["decode"] = self.server.decode_engine.stats()
+            self._reply(200, stats)
         elif self.path == "/metrics":
             body = telemetry.prometheus_text().encode()
             self.send_response(200)
@@ -165,13 +178,72 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply(200, {"status": "ok", "model_version": engine.version,
                           "warmup_compiles": fresh})
 
+    def _handle_generate(self):
+        """POST /v1/generate — the generative decode plane
+        (serving/decode.py): {"prompt_ids": [ints], "max_new_tokens"?,
+        "temperature"?, "seed"?, "deadline_ms"?} -> {"tokens": [ints],
+        "num_tokens", "ttft_ms", "latency_ms", "model_version"}."""
+        de = self.server.decode_engine
+        if de is None:
+            self._reply(404, {"error": "no decode engine attached — "
+                                       "this replica serves /v1/infer "
+                                       "only"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            doc = json.loads(self.rfile.read(length) or b"{}")
+            prompt = doc["prompt_ids"]
+        except (ValueError, TypeError, KeyError) as e:
+            self._reply(400, {"error": f"bad generate request: {e!r}"})
+            return
+        t0 = time.perf_counter()
+        try:
+            req = de.submit(prompt,
+                            max_new_tokens=doc.get("max_new_tokens"),
+                            deadline_ms=doc.get("deadline_ms"),
+                            temperature=float(doc.get("temperature", 0.0)),
+                            seed=doc.get("seed"))
+            tokens = req.result()
+        except ValueError as e:
+            self._reply(400, {"error": str(e)})
+        except KVCacheExhaustedError as e:
+            # typed would-OOM refusal: the client must shrink or retry
+            # against a bigger pool — 429 with the typed name
+            self._reply(429, {"error": str(e),
+                              "error_type": "KVCacheExhaustedError"})
+        except ServerOverloadedError as e:
+            self._reply(429, {"error": str(e)},
+                        {"Retry-After": "0.05"})
+        except EngineClosedError as e:
+            self._reply(503, {"error": str(e)})
+        except DeadlineExceededError as e:
+            self._reply(504, {"error": str(e)})
+        except Exception as e:
+            self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+        else:
+            self._reply(200, {
+                "tokens": np.asarray(tokens).tolist(),
+                "num_tokens": int(np.asarray(tokens).size),
+                "ttft_ms": round(req.ttft_ms, 3)
+                if req.ttft_ms is not None else None,
+                "model_version": de.version,
+                "latency_ms": round((time.perf_counter() - t0) * 1e3, 3)})
+
     def do_POST(self):
         engine: ServingEngine = self.server.engine
+        if self.path == "/v1/generate":
+            self._handle_generate()
+            return
         if self.path == "/v1/admin/swap":
             self._handle_swap(engine)
             return
         if self.path != "/v1/infer":
             self._reply(404, {"error": f"no route {self.path}"})
+            return
+        if engine is None:
+            self._reply(404, {"error": "no micro-batching engine "
+                                       "attached — this replica serves "
+                                       "/v1/generate only"})
             return
         try:
             length = int(self.headers.get("Content-Length") or 0)
@@ -225,12 +297,18 @@ class ServingHTTPServer:
     """Bound-but-not-yet-serving HTTP wrapper; start()/shutdown() own the
     acceptor thread. port=0 binds an ephemeral port (tests, CI)."""
 
-    def __init__(self, engine: ServingEngine, host: str = "127.0.0.1",
-                 port: int = 0):
+    def __init__(self, engine: Optional[ServingEngine],
+                 host: str = "127.0.0.1", port: int = 0,
+                 decode_engine=None):
+        if engine is None and decode_engine is None:
+            raise ValueError("ServingHTTPServer needs an engine and/or a "
+                             "decode_engine")
         self.engine = engine
+        self.decode_engine = decode_engine
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.engine = engine
+        self._httpd.decode_engine = decode_engine
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -271,3 +349,15 @@ def serve(model_dir: str, host: str = "127.0.0.1", port: int = 0,
     engine = ServingEngine(predictor, config=config)
     engine.start(warmup=warmup)
     return ServingHTTPServer(engine, host=host, port=port).start()
+
+
+def serve_decode(model_dir: str, host: str = "127.0.0.1", port: int = 0,
+                 config=None, warmup: bool = True) -> ServingHTTPServer:
+    """Decoder-LM dir (models/decoder_lm.save_decoder_lm) → started
+    generative HTTP server (POST /v1/generate)."""
+    from .decode import decode_engine_from_dir
+
+    de = decode_engine_from_dir(model_dir, config=config)
+    de.start(warmup=warmup)
+    return ServingHTTPServer(None, host=host, port=port,
+                             decode_engine=de).start()

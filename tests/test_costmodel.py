@@ -108,7 +108,8 @@ class TestCaptureExecutor:
         led = costmodel.ledger()
         assert led["total_bytes"] == (led["param_bytes"] +
                                       led["opt_state_bytes"] +
-                                      led["peak_temp_bytes"])
+                                      led["peak_temp_bytes"] +
+                                      led.get("serving_kv_pool_bytes", 0))
         assert g["mem.hbm_total_bytes"] == led["total_bytes"]
         # dispatch accounting + live MFU gauge (set on first dispatch)
         assert telemetry.counter_get("cost.dispatch_flops") >= 3 * rec.flops

@@ -31,11 +31,26 @@ router's front end. ``--kill-one`` SIGKILL-equivalently downs a replica
 mid-load, so the row measures failover cost; the BENCH extra records
 replicas, failover_count, retries and the router-observed p99.
 
+With ``--generate`` it instead benches the GENERATIVE decode engine
+(paddle_tpu/serving/decode.py): a closed-loop client fleet submits
+variable-length generation requests against (1) the drain-and-refill
+static-batching baseline (``DecodeConfig(continuous=False)`` — admit a
+wave, run it to completion, refill) and (2) continuous batching, same
+harness. The row's value is continuous tokens/s, ``vs_baseline`` the
+continuous/drain ratio, and ``extra`` embeds time-to-first-token +
+inter-token-latency percentiles, batch occupancy, the KV-pool
+high-water mark and a mid-load /metrics scrape of the live token rate
+(the PR 6 pattern). Both arms are additionally checked BITWISE against
+sequential one-request-at-a-time decode — the row aborts on any
+divergence.
+
 Examples:
     python tools/bench_serving.py                     # full closed-loop
     python tools/bench_serving.py --smoke             # seconds, CI row
     python tools/bench_serving.py --mode open --target-qps 200
     python tools/bench_serving.py --replicas 2 --kill-one
+    python tools/bench_serving.py --generate          # decode tokens/s
+    python tools/bench_serving.py --generate --int8   # int8 weight-only
 """
 
 from __future__ import annotations
@@ -362,6 +377,233 @@ def bench_cluster(args, make_batch, model_dir):
     }
 
 
+def _gen_workload(args):
+    """Deterministic generation request set with a LONG-TAIL length mix
+    (3/4 short answers, 1/4 near the budget — the chat-serving shape):
+    generation-length variance is exactly what drain-and-refill loses
+    throughput to, because a static wave is held open by its longest
+    member while finished slots sit idle."""
+    import numpy as np
+
+    rng = np.random.RandomState(11)
+    hi = args.gen_max_new
+    out = []
+    for _ in range(args.gen_requests):
+        plen = int(rng.randint(4, args.gen_prompt_len + 1))
+        prompt = rng.randint(3, 90, plen).astype(np.int32)
+        if rng.random_sample() < 0.75:
+            max_new = int(rng.randint(2, max(3, hi // 4)))
+        else:
+            max_new = int(rng.randint(max(3, 3 * hi // 4), hi + 1))
+        out.append((prompt, max_new))
+    return out
+
+
+def _run_gen_load(engine, workload, concurrency):
+    """Closed-loop client fleet over a started DecodeEngine; returns
+    (wall_s, results keyed by workload index, ttft list, itl list)."""
+    import numpy as np
+
+    results = {}
+    ttft, itl = [], []
+    errors = []
+    lock = threading.Lock()
+    shares = [list(range(w, len(workload), concurrency))
+              for w in range(concurrency)]
+
+    def worker(indices):
+        for i in indices:
+            prompt, max_new = workload[i]
+            try:
+                req = engine.submit(prompt, max_new_tokens=max_new)
+                toks = req.result(timeout=300)
+            except Exception as e:
+                with lock:
+                    errors.append(e)
+                continue
+            with lock:
+                results[i] = np.asarray(toks)
+                if req.ttft_ms is not None:
+                    ttft.append(req.ttft_ms)
+                walls = req.token_walls
+                itl.extend((b - a) * 1e3
+                           for a, b in zip(walls, walls[1:]))
+    threads = [threading.Thread(target=worker, args=(ix,),
+                                name=f"pt-bench-gen-{w}", daemon=True)
+               for w, ix in enumerate(shares) if ix]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    if errors:
+        raise SystemExit(f"generate errors: {errors[:3]}")
+    return wall, results, sorted(ttft), sorted(itl)
+
+
+def bench_generate(args):
+    """--generate: continuous batching vs the drain-and-refill baseline,
+    gated on bitwise identity with sequential decode."""
+    import numpy as np
+
+    from paddle_tpu.core import telemetry
+    from paddle_tpu.models.decoder_lm import (DecoderLMConfig,
+                                              decoder_lm_params)
+    from paddle_tpu.serving import (DecodeConfig, DecodeEngine,
+                                    ServingHTTPServer)
+
+    concurrency = args.gen_concurrency or 2 * args.gen_slots
+    cfg = DecoderLMConfig(vocab_size=512, d_model=args.gen_d_model,
+                          n_head=4, n_layers=args.gen_layers,
+                          d_inner=2 * args.gen_d_model,
+                          max_seq_len=args.gen_prompt_len
+                          + args.gen_max_new)
+    params = decoder_lm_params(cfg, seed=0)
+    quant = "int8" if args.int8 else "none"
+    workload = _gen_workload(args)
+    total_pages = 2 + sum(
+        -(-(len(p) + m) // args.gen_page_size) for p, m in workload)
+
+    def make_engine(continuous):
+        # one prefill bucket (= max prompt len): every arm pays exactly
+        # the same padded-prefill cost and warmup covers every program
+        return DecodeEngine(cfg, params, DecodeConfig(
+            max_slots=args.gen_slots, page_size=args.gen_page_size,
+            kv_pages=total_pages, weight_quant=quant,
+            prefill_buckets=[args.gen_prompt_len],
+            continuous=continuous)).start(warmup=True)
+
+    # -- sequential reference (also warms nothing shared) ------------------
+    seq_eng = make_engine(True)
+    reference = {}
+    t0 = time.perf_counter()
+    for i, (prompt, max_new) in enumerate(workload):
+        reference[i] = np.asarray(
+            seq_eng.generate(prompt, max_new_tokens=max_new, timeout=300))
+    seq_wall = time.perf_counter() - t0
+    seq_eng.close(drain=True, timeout=10)
+    total_tokens = sum(len(v) for v in reference.values())
+
+    # -- drain-and-refill baseline (static batching) -----------------------
+    # each arm runs --gen-rounds times on its warmed engine and scores
+    # its best wall (the standard best-of-N discipline: scheduler noise
+    # only ever slows a run down)
+    drain_eng = make_engine(False)
+    drain_wall = None
+    for _ in range(args.gen_rounds):
+        wall, drain_res, _t, _i = _run_gen_load(
+            drain_eng, workload, concurrency)
+        drain_wall = wall if drain_wall is None else min(drain_wall, wall)
+    drain_eng.close(drain=True, timeout=10)
+
+    # -- continuous batching, with the live /metrics scrape mid-load -------
+    cont_eng = make_engine(True)
+    http_srv = ServingHTTPServer(None, decode_engine=cont_eng).start()
+    scraped = {}
+    stop_scrape = threading.Event()
+    scraper = threading.Thread(
+        target=_scrape_gen_metrics,
+        args=(http_srv.url, stop_scrape, scraped),
+        name="pt-bench-gen-scrape", daemon=True)
+    scraper.start()
+    steps_before = telemetry_counter("decode.steps")
+    tokens_before = telemetry_counter("decode.tokens")
+    try:
+        cont_wall = None
+        for _ in range(args.gen_rounds):
+            wall, cont_res, ttft, itl = _run_gen_load(
+                cont_eng, workload, concurrency)
+            cont_wall = wall if cont_wall is None else min(cont_wall, wall)
+    finally:
+        stop_scrape.set()
+        scraper.join(timeout=10)
+        http_srv.shutdown()
+        pool_stats = cont_eng.pool.stats()
+        cont_eng.close(drain=True, timeout=10)
+
+    # -- bitwise gate: every arm must reproduce sequential decode ----------
+    for name, res in (("drain", drain_res), ("continuous", cont_res)):
+        for i, want in reference.items():
+            got = res.get(i)
+            if got is None or not np.array_equal(got, want):
+                raise SystemExit(
+                    f"BITWISE MISMATCH: {name} decode of request {i} "
+                    f"differs from sequential decode — continuous "
+                    f"batching must not change generations")
+
+    c = telemetry.counters()
+    # occupancy of the CONTINUOUS arm only (counters are global across
+    # the three arms): generated tokens / (steps * slot count)
+    cont_steps = int(c.get("decode.steps", 0)) - steps_before
+    cont_tokens = int(c.get("decode.tokens", 0)) - tokens_before
+    occupancy = cont_tokens / (cont_steps * args.gen_slots) \
+        if cont_steps else 0.0
+    toks_s = total_tokens / cont_wall
+    toks_s_drain = total_tokens / drain_wall
+    return {
+        "metric": "decode_tokens_per_s" + ("_int8" if args.int8 else ""),
+        "value": round(toks_s, 2),
+        "unit": "tokens/s",
+        # the acceptance ratio: continuous vs drain-and-refill, same
+        # harness, bitwise-identical outputs
+        "vs_baseline": round(toks_s / toks_s_drain, 3),
+        "extra": {
+            "mode": "generate_closed",
+            "weight_quant": quant,
+            "requests": len(workload),
+            "concurrency": concurrency,
+            "slots": args.gen_slots,
+            "page_size": args.gen_page_size,
+            "kv_pages": total_pages,
+            "total_tokens": total_tokens,
+            "tokens_per_s_drain": round(toks_s_drain, 2),
+            "tokens_per_s_sequential": round(total_tokens / seq_wall, 2),
+            "ttft_p50_ms": round(_pct(ttft, 0.50), 3),
+            "ttft_p99_ms": round(_pct(ttft, 0.99), 3),
+            "itl_p50_ms": round(_pct(itl, 0.50), 3),
+            "itl_p99_ms": round(_pct(itl, 0.99), 3),
+            "batch_occupancy": round(occupancy, 4),
+            "decode_steps": cont_steps,
+            "decode_tokens": cont_tokens,
+            "kv_high_water_bytes": int(pool_stats["high_water_bytes"]),
+            "kv_pool_bytes": int(pool_stats["pool_bytes"]),
+            "kv_pages_leaked": int(pool_stats["pages_used"]),
+            "bitwise_vs_sequential": True,
+            "metrics_scrapes": int(scraped.get("scrapes", 0)),
+            "scraped_tokens_per_s": scraped.get("tokens_per_s"),
+        },
+    }
+
+
+def telemetry_counter(name):
+    from paddle_tpu.core import telemetry
+
+    return int(telemetry.counter_get(name))
+
+
+def _scrape_gen_metrics(url, stop_event, out):
+    """Poll GET /metrics mid-load for the live decode token rate — the
+    generative twin of _scrape_metrics."""
+    import re
+    import urllib.request
+
+    while not stop_event.is_set():
+        # coarse poll: the exposition walk takes the registry lock, so a
+        # hot scrape loop would perturb the measured arm
+        stop_event.wait(0.2)
+        try:
+            body = urllib.request.urlopen(
+                url + "/metrics", timeout=5).read().decode()
+        except Exception:
+            continue
+        rate = re.search(
+            r'^pt_decode_tokens_rate\{[^}]*\} ([\d.eE+-]+)', body, re.M)
+        if rate:
+            out["tokens_per_s"] = float(rate.group(1))
+            out["scrapes"] = out.get("scrapes", 0) + 1
+
+
 def main():
     ap = argparse.ArgumentParser(
         description="serving-engine load generator (LeNet)")
@@ -381,6 +623,36 @@ def main():
     ap.add_argument("--kill-one", action="store_true",
                     help="with --replicas: down one replica mid-load so "
                          "the row measures failover cost")
+    ap.add_argument("--generate", action="store_true",
+                    help="bench the GENERATIVE decode engine (closed-"
+                         "loop tokens/s: continuous batching vs the "
+                         "drain-and-refill baseline, bitwise-gated "
+                         "against sequential decode)")
+    ap.add_argument("--int8", action="store_true",
+                    help="with --generate: int8 weight-only serving")
+    ap.add_argument("--gen-requests", type=int, default=64,
+                    help="--generate: request count")
+    ap.add_argument("--gen-rounds", type=int, default=3,
+                    help="--generate: load rounds per arm; each arm "
+                         "scores its best wall (noise-robust)")
+    ap.add_argument("--gen-concurrency", type=int, default=0,
+                    help="--generate: closed-loop client threads "
+                         "(default 2x slots — keeps the admission queue "
+                         "nonempty so retired slots refill immediately)")
+    ap.add_argument("--gen-slots", type=int, default=8,
+                    help="--generate: decode slot-array size")
+    ap.add_argument("--gen-prompt-len", type=int, default=24,
+                    help="--generate: max prompt length")
+    ap.add_argument("--gen-max-new", type=int, default=96,
+                    help="--generate: max generation budget (3/4 of "
+                         "requests draw a short budget < max/4, the rest "
+                         "land near max — the long-tail serving mix)")
+    ap.add_argument("--gen-page-size", type=int, default=8,
+                    help="--generate: KV page size (tokens)")
+    ap.add_argument("--gen-d-model", type=int, default=128,
+                    help="--generate: model width")
+    ap.add_argument("--gen-layers", type=int, default=2,
+                    help="--generate: decoder layers")
     ap.add_argument("--model-dir", default="",
                     help="saved inference model (default: build LeNet "
                          "into a temp dir)")
@@ -391,11 +663,20 @@ def main():
     args = ap.parse_args()
     if args.smoke:
         args.requests = min(args.requests, 64)
+        args.gen_requests = min(args.gen_requests, 10)
+        args.gen_max_new = min(args.gen_max_new, 24)
+        args.gen_rounds = 1
 
     from paddle_tpu.core import telemetry
 
     if args.telemetry_log:
         telemetry.configure(args.telemetry_log)
+
+    if args.generate:
+        from tools.bench_models import finalize_bench_result
+
+        print(json.dumps(finalize_bench_result(bench_generate(args))))
+        return 0
 
     import tempfile
 

@@ -27,6 +27,15 @@ the trainer scope is discarded — and a fresh scope restores and keeps
 training. Asserts convergence across the kill/restart and prints the
 ckpt.saves / verify_failures / fallbacks / quarantined tally.
 
+With ``--decode`` it chaos-tests the generative decode engine
+(paddle_tpu/serving/decode.py): concurrent clients run generations
+under ``decode.step`` / ``decode.kv_alloc`` fault specs and the run
+asserts every request got a response (a mid-generation fault surfaces
+as a per-request error, never a wedged queue), that the KV page pool's
+accounting returns to baseline — zero pages leaked across fault-killed
+generations — and that the engine still generates cleanly once the
+spec is cleared.
+
 With ``--cluster`` it chaos-tests the whole serving control plane
 (paddle_tpu/serving/cluster.py): N real replica processes behind the
 router, concurrent closed-loop clients with unique request ids, the
@@ -45,6 +54,8 @@ Examples:
         --servers 2 --telemetry-log /tmp/chaos.jsonl
     python tools/chaos_check.py --serving \
         --fault-spec "serving.handler:%3" --requests 24
+    python tools/chaos_check.py --decode \
+        --fault-spec "decode.step:%7,decode.kv_alloc:@3" --requests 16
     python tools/chaos_check.py --checkpoint \
         --fault-spec "ckpt.save.commit:%3,ckpt.restore.read:@1" --steps 8
     python tools/chaos_check.py --cluster --replicas 2 --requests 400 \
@@ -282,6 +293,134 @@ def run_serving(args) -> int:
     print(f"CHAOS OK: {total} requests, {len(failed)} per-request error "
           f"responses from {injected} injected handler faults, queue "
           f"never wedged")
+    return 0
+
+
+def run_decode(args) -> int:
+    """--decode mode: injected decode.step / decode.kv_alloc faults must
+    surface as per-request errors, the KV page pool must account back to
+    baseline (zero leaked pages), and the queue must never wedge."""
+    import threading
+
+    import numpy as np
+
+    from paddle_tpu.core import faults, telemetry
+    from paddle_tpu.models.decoder_lm import (DecoderLMConfig,
+                                              decoder_lm_params)
+    from paddle_tpu.serving import DecodeConfig, DecodeEngine
+
+    if args.telemetry_log:
+        telemetry.configure(args.telemetry_log)
+    if args.trace_sample:
+        from paddle_tpu.core import flags as _flags
+
+        _flags.set_flags({"trace_sample_rate": args.trace_sample})
+    # a decode.step fault fails the WHOLE in-flight slot array (every
+    # affected generation gets a per-request error), so the default uses
+    # one-shot triggers — a %N step spec would leave no survivors
+    spec = args.fault_spec or "decode.step:@4,decode.kv_alloc:@3"
+
+    cfg = DecoderLMConfig(vocab_size=128, d_model=32, n_head=2, n_layers=2,
+                          d_inner=64, max_seq_len=48)
+    engine = DecodeEngine(cfg, decoder_lm_params(cfg, seed=0),
+                          DecodeConfig(max_slots=4, page_size=4,
+                                       kv_pages=32, prefill_buckets=[16]))
+    # warm OUTSIDE the fault window: a probabilistic step spec must not
+    # decide the run before clients even start
+    engine.start(warmup=True)
+    baseline_free = engine.pool.free_pages()
+    faults.configure(spec, seed=args.seed)
+
+    rng = np.random.RandomState(5)
+    prompts = [rng.randint(3, 120, rng.randint(3, 13)).astype(np.int32)
+               for _ in range(args.requests)]
+    ok, failed, hung = [], [], []
+    lock = threading.Lock()
+
+    def worker(indices):
+        for i in indices:
+            try:
+                toks = engine.generate(prompts[i], max_new_tokens=12,
+                                       timeout=60)
+            except TimeoutError as e:
+                with lock:
+                    hung.append(e)
+            except Exception as e:
+                with lock:
+                    failed.append(type(e).__name__)
+            else:
+                with lock:
+                    ok.append(toks)
+
+    workers = 4
+    threads = [threading.Thread(
+        target=worker, args=(list(range(w, args.requests, workers)),),
+        name=f"pt-chaos-decode-{w}", daemon=True) for w in range(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    # the queue must still move — and the pool must be back to baseline —
+    # once the faults stop
+    faults.configure("")
+    try:
+        final = engine.generate(prompts[0], max_new_tokens=8, timeout=60)
+    except Exception as e:
+        print(f"CHAOS FAIL: post-fault generation failed ({e!r}) — "
+              f"engine wedged")
+        return 2
+    finally:
+        pool_stats = engine.pool.stats()
+        engine.close(drain=True, timeout=10)
+
+    counters = telemetry.counters()
+    injected = int(counters.get("faults.injected", 0))
+    print("-- decode chaos tally " + "-" * 27)
+    for key in ("faults.injected", "decode.requests", "decode.prefills",
+                "decode.steps", "decode.tokens", "decode.retired",
+                "decode.errors", "decode.kv_pages_allocated",
+                "decode.kv_pages_freed", "decode.kv_refusals",
+                "trace.spans"):
+        print(f"{key:28s} {int(counters.get(key, 0))}")
+    inj = faults.counts()["injected"]
+    for site, n in sorted(inj.items()):
+        print(f"  injected@{site:18s} {n}")
+    print(f"responses: {len(ok)} ok / {len(failed)} error / "
+          f"{len(hung)} hung; pool free {pool_stats['pages_free']}/"
+          f"{pool_stats['pages_total']} (baseline {baseline_free})")
+
+    if hung:
+        print(f"CHAOS FAIL: {len(hung)} generations never got a response "
+              f"— wedged queue")
+        return 2
+    if len(ok) + len(failed) != args.requests:
+        print("CHAOS FAIL: lost responses")
+        return 2
+    if pool_stats["pages_free"] != baseline_free or \
+            pool_stats["pages_used"] != 0:
+        print(f"CHAOS FAIL: KV pool leaked pages "
+              f"({pool_stats['pages_used']} still allocated after every "
+              f"request resolved)")
+        return 2
+    alloc = int(counters.get("decode.kv_pages_allocated", 0))
+    freed = int(counters.get("decode.kv_pages_freed", 0))
+    if alloc != freed:
+        print(f"CHAOS FAIL: page alloc/free imbalance ({alloc} vs {freed})")
+        return 2
+    if injected and not failed:
+        print("CHAOS FAIL: faults were injected but no request saw an "
+              "error response")
+        return 2
+    if not injected:
+        print("CHAOS WARN: fault spec never fired (run too short for "
+              "the trigger?)")
+    if not ok or not np.asarray(final).size:
+        print("CHAOS FAIL: no clean generations")
+        return 2
+    print(f"CHAOS OK: {args.requests} generations, {len(failed)} "
+          f"per-request error responses from {injected} injected faults, "
+          f"pool accounting back to baseline, queue never wedged")
     return 0
 
 
@@ -592,6 +731,12 @@ def main():
     ap.add_argument("--serving", action="store_true",
                     help="chaos-test the micro-batching serving engine "
                          "(serving.handler site) instead of the PS loop")
+    ap.add_argument("--decode", action="store_true",
+                    help="chaos-test the generative decode engine "
+                         "(decode.step / decode.kv_alloc sites): "
+                         "mid-generation faults must become per-request "
+                         "errors with the KV page pool accounting back "
+                         "to baseline")
     ap.add_argument("--checkpoint", action="store_true",
                     help="chaos-test the crash-consistent checkpoint "
                          "protocol (ckpt.save.write/commit + "
@@ -633,6 +778,8 @@ def main():
         # a kill + a rolling swap; --requests still overrides
     if args.serving:
         sys.exit(run_serving(args))
+    if args.decode:
+        sys.exit(run_decode(args))
     if args.checkpoint:
         sys.exit(run_checkpoint(args))
     if args.cluster:

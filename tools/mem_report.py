@@ -9,7 +9,10 @@ cost & memory observability plane (paddle_tpu/core/costmodel.py) writes:
   (the ZeRO per-device figure from ``sharding.optimizer_state_bytes*``
   when present), worst-case compiled-program scratch
   (``mem.peak_temp_bytes``), per-serving-bucket footprints
-  (``mem.serving.bucket<B>_peak_bytes``) and the composed total;
+  (``mem.serving.bucket<B>_peak_bytes``), the decode engine's
+  preallocated KV page pool (``mem.serving.kv_pool_bytes`` /
+  ``mem.serving.kv_used_bytes`` / ``mem.serving.kv_high_water_bytes``)
+  and the composed total;
 * the **per-program cost table**: one row per captured compile-cache
   entry (``kind:"cost"`` records) — flops, bytes accessed, argument/
   output/temp bytes, arithmetic intensity and the roofline verdict
@@ -84,15 +87,24 @@ def summarize_mem(recs, malformed=0):
                int(_num(v)) for n, v in gauges.items()
                if n.startswith("mem.serving.bucket")
                and n.endswith("_peak_bytes")}
+    kv_pool = int(_num(gauges.get("mem.serving.kv_pool_bytes")))
     ledger = {"param_bytes": param_b, "opt_state_bytes": opt_b,
               "peak_temp_bytes": peak_temp,
               "total_bytes": int(_num(gauges.get("mem.hbm_total_bytes"),
-                                      param_b + opt_b + peak_temp))}
+                                      param_b + opt_b + peak_temp
+                                      + kv_pool))}
     if gauges.get("sharding.optimizer_state_bytes") is not None:
         ledger["opt_state_bytes_global"] = int(
             _num(gauges["sharding.optimizer_state_bytes"]))
     if buckets:
         ledger["serving_bucket_bytes"] = buckets
+    if kv_pool:
+        # the decode engine's paged KV cache (serving/kv_cache.py)
+        ledger["serving_kv_pool_bytes"] = kv_pool
+        ledger["serving_kv_used_bytes"] = int(
+            _num(gauges.get("mem.serving.kv_used_bytes")))
+        ledger["serving_kv_high_water_bytes"] = int(
+            _num(gauges.get("mem.serving.kv_high_water_bytes")))
 
     rows = sorted(programs.values(),
                   key=lambda a: -_num(a.get("peak_bytes"),
@@ -151,6 +163,12 @@ def render(s, out=sys.stdout):
         for b, nb in sorted(led["serving_bucket_bytes"].items(),
                             key=lambda kv: int(kv[0])):
             w(f"  bucket {b:>6}: {_fmt_bytes(nb)}\n")
+    if led.get("serving_kv_pool_bytes"):
+        w(f"{'KV page pool (decode)':<26}"
+          f"{_fmt_bytes(led['serving_kv_pool_bytes']):>16}"
+          f"   (in use {_fmt_bytes(led['serving_kv_used_bytes'])}, "
+          f"high water "
+          f"{_fmt_bytes(led['serving_kv_high_water_bytes'])})\n")
 
     w(f"\n-- per-program cost table: {len(s['programs'])} captured --\n")
     if s["programs"]:
@@ -216,6 +234,13 @@ def smoke() -> int:
         {"ts": 1.2, "kind": "gauge",
          "name": "mem.serving.bucket8_peak_bytes", "value": 4096,
          "attrs": {}},
+        {"ts": 1.2, "kind": "gauge", "name": "mem.serving.kv_pool_bytes",
+         "value": 1 << 20, "attrs": {}},
+        {"ts": 1.2, "kind": "gauge", "name": "mem.serving.kv_used_bytes",
+         "value": 1 << 18, "attrs": {}},
+        {"ts": 1.2, "kind": "gauge",
+         "name": "mem.serving.kv_high_water_bytes", "value": 1 << 19,
+         "attrs": {}},
         {"ts": 1.2, "kind": "cost", "name": "costmodel.executor",
          "value": 2.0e9, "attrs": {
              "key": "deadbeef", "kind": "executor", "program": "1v0",
@@ -257,6 +282,9 @@ def smoke() -> int:
     missing = [sec for sec in REQUIRED_SECTIONS + ("-- OOM forensics",)
                if sec not in text]
     checks = [("param bytes", s["ledger"]["param_bytes"] == 1 << 20),
+              ("kv pool", s["ledger"].get("serving_kv_pool_bytes")
+               == 1 << 20),
+              ("kv pool rendered", "KV page pool" in text),
               ("program rows", len(s["programs"]) == 1),
               ("oom rows", len(s["ooms"]) == 1),
               ("captures", s["capture"]["captures"] == 1),

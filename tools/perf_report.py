@@ -20,6 +20,11 @@ Renders the structured run log written by ``paddle_tpu.core.telemetry``
   (paddle_tpu/serving/): request/batch counts, batch-fill ratio,
   padding overhead, rejects/deadline-drops, and request/batch latency
   percentiles;
+* a "Decode" section when the run used the continuous-batching
+  generative engine (paddle_tpu/serving/decode.py): tokens/s, slot
+  occupancy, prefill-vs-decode-step latency percentiles, KV page-pool
+  bytes + high-water mark and the alloc/free page balance (a nonzero
+  difference prints as LEAKED);
 * a "Checkpointing" section when the run saved/restored through the
   crash-consistent protocol (paddle_tpu/checkpoint.py): commits, bytes,
   verification rejections + fallbacks to older checkpoints, quarantined
@@ -109,6 +114,7 @@ def _pct(sorted_vals, q):
 
 def summarize_log(recs, malformed=0):
     timers = defaultdict(list)
+    hists = defaultdict(list)
     counter_delta = defaultdict(float)
     counter_last = {}
     gauges = {}
@@ -129,6 +135,8 @@ def summarize_log(recs, malformed=0):
         v, attrs = r.get("value"), r.get("attrs") or {}
         if kind == "timer" and isinstance(v, (int, float)):
             timers[name].append(float(v))
+        elif kind == "hist" and isinstance(v, (int, float)):
+            hists[name].append(float(v))
         elif kind == "span":
             if isinstance(v, (int, float)):
                 spans[name].append(float(v))
@@ -186,9 +194,18 @@ def summarize_log(recs, malformed=0):
             "p90": round(_pct(s, 0.90), 3), "p99": round(_pct(s, 0.99), 3),
             "max": round(s[-1], 3),
             "mean": round(sum(s) / len(s), 3)}
+    hist_summary = {}
+    for name, vals in hists.items():
+        s = sorted(vals)
+        hist_summary[name] = {
+            "count": len(s), "p50": round(_pct(s, 0.50), 4),
+            "mean": round(sum(s) / len(s), 4)}
+    span_s = round(max(ts) - min(ts), 3) if ts else 0.0
     fused = _fused_summary(counter_delta, counter_last, timer_summary)
     serving = _serving_summary(counter_delta, counter_last, timer_summary,
                                gauges)
+    decode = _decode_summary(counter_delta, counter_last, timer_summary,
+                             gauges, hist_summary, span_s)
     router = _router_summary(counter_delta, counter_last, timer_summary)
     ckpt = _ckpt_summary(counter_delta, counter_last, timer_summary)
     sharding = _sharding_summary(counter_delta, counter_last, gauges)
@@ -213,6 +230,7 @@ def summarize_log(recs, malformed=0):
     return {
         "fused": fused,
         "serving": serving,
+        "decode": decode,
         "router": router,
         "checkpoint": ckpt,
         "sharding": sharding,
@@ -222,7 +240,7 @@ def summarize_log(recs, malformed=0):
         "tracing": tracing,
         "malformed_lines": int(malformed),
         "records": len(recs),
-        "span_s": round(max(ts) - min(ts), 3) if ts else 0.0,
+        "span_s": span_s,
         "timers": timer_summary,
         "compiles": compiles,
         "counters": {n: {"delta": counter_delta.get(n, 0.0),
@@ -306,6 +324,61 @@ def _serving_summary(counter_delta, counter_last, timer_summary, gauges):
     qd = gauges.get("serving.queue_depth")
     if qd is not None:
         out["last_queue_depth"] = qd
+    return out
+
+
+def _decode_summary(counter_delta, counter_last, timer_summary, gauges,
+                    hists, span_s):
+    """Generative decode engine accounting (paddle_tpu/serving/decode.py
+    + kv_cache.py): tokens/s, prefill-vs-decode step latency, slot-array
+    occupancy, and the KV page pool's high-water mark."""
+
+    def cval(name):
+        v = counter_delta.get(name) or counter_last.get(name) or 0
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            return 0.0
+
+    tokens = cval("decode.tokens")
+    steps = cval("decode.steps")
+    prefills = cval("decode.prefills")
+    if not tokens and not prefills:
+        return None
+    out = {"requests": int(cval("decode.requests")),
+           "prefills": int(prefills),
+           "prefill_tokens": int(cval("decode.prefill_tokens")),
+           "steps": int(steps), "tokens": int(tokens),
+           "retired": int(cval("decode.retired")),
+           "rejects": int(cval("decode.rejects")),
+           "kv_refusals": int(cval("decode.kv_refusals")),
+           "deadline_expired": int(cval("decode.deadline_expired")),
+           "errors": int(cval("decode.errors")),
+           "compiles": int(cval("decode.compiles"))}
+    if span_s and tokens:
+        out["tokens_per_s"] = round(tokens / span_s, 2)
+    if steps:
+        out["tokens_per_step"] = round(tokens / steps, 2)
+    occ = hists.get("decode.batch_occupancy")
+    if occ:
+        out["batch_occupancy"] = occ
+    for timer, key in (("decode.prefill_ms", "prefill_ms"),
+                       ("decode.step_ms", "step_ms"),
+                       ("decode.request_ms", "request_ms")):
+        t = timer_summary.get(timer)
+        if t:
+            out[key] = {"p50": t["p50"], "p99": t["p99"], "max": t["max"]}
+    kv_pool = gauges.get("mem.serving.kv_pool_bytes")
+    if kv_pool is not None:
+        out["kv_pool_bytes"] = int(kv_pool)
+        out["kv_high_water_bytes"] = int(
+            gauges.get("mem.serving.kv_high_water_bytes") or 0)
+        out["kv_used_bytes"] = int(
+            gauges.get("mem.serving.kv_used_bytes") or 0)
+    pages = cval("decode.kv_pages_allocated")
+    if pages:
+        out["kv_pages_allocated"] = int(pages)
+        out["kv_pages_freed"] = int(cval("decode.kv_pages_freed"))
     return out
 
 
@@ -613,6 +686,45 @@ def render(s, out=sys.stdout):
                   f"  max {t['max']}\n")
         if "last_queue_depth" in sv:
             w(f"last queue depth: {_fmt_num(sv['last_queue_depth'])}\n")
+
+    if s.get("decode"):
+        dc = s["decode"]
+        w("\n-- decode (continuous-batching generative engine) --\n")
+        line = (f"requests: {dc['requests']}  prefills: {dc['prefills']} "
+                f"({dc['prefill_tokens']} tokens)  steps: {dc['steps']}  "
+                f"tokens: {dc['tokens']}")
+        if "tokens_per_s" in dc:
+            line += f"  ({dc['tokens_per_s']}/s over the log)"
+        w(line + "\n")
+        occ_line = []
+        if "tokens_per_step" in dc:
+            occ_line.append(f"tokens/step: {dc['tokens_per_step']}")
+        if "batch_occupancy" in dc:
+            occ_line.append(
+                f"batch occupancy: {dc['batch_occupancy']['mean']:.1%} "
+                f"mean (p50 {dc['batch_occupancy']['p50']:.1%})")
+        if occ_line:
+            w("  ".join(occ_line) + "\n")
+        w(f"retired: {dc['retired']}  rejected: {dc['rejects']}  "
+          f"kv refusals: {dc['kv_refusals']}  deadline-expired: "
+          f"{dc['deadline_expired']}  errors: {dc['errors']}  "
+          f"compiles: {dc['compiles']}\n")
+        for key, label in (("prefill_ms", "prefill"),
+                           ("step_ms", "decode step"),
+                           ("request_ms", "request e2e")):
+            if key in dc:
+                t = dc[key]
+                w(f"{label} ms: p50 {t['p50']}  p99 {t['p99']}"
+                  f"  max {t['max']}\n")
+        if "kv_pool_bytes" in dc:
+            w(f"kv page pool: {_fmt_num(dc['kv_pool_bytes'])} B "
+              f"(high water {_fmt_num(dc['kv_high_water_bytes'])} B, "
+              f"in use {_fmt_num(dc['kv_used_bytes'])} B)\n")
+        if "kv_pages_allocated" in dc:
+            leak = dc["kv_pages_allocated"] - dc["kv_pages_freed"]
+            w(f"kv pages: {dc['kv_pages_allocated']} allocated / "
+              f"{dc['kv_pages_freed']} freed"
+              + (f"  (LEAKED {leak})\n" if leak else "\n"))
 
     if s.get("router"):
         rt = s["router"]
