@@ -233,7 +233,15 @@ def convert_to_int8_program(program: Program, scope, act_scales=None,
 
       * matmul-family ops whose activation has a calibrated scale
         (PostTrainingQuantization.calibrated_scales) replaced by the
-        native `int8_matmul` op (int8 MXU dot, int32 accumulation), and
+        native `int8_matmul` op (static-quant mode: int8 MXU dot, int32
+        accumulation),
+      * matmul-family ops WITHOUT a calibrated activation scale replaced
+        by `int8_matmul` in weight-only mode (no act_scale attr; fc Bias
+        rides the op's Bias input) — the lowering the Pallas int8 MXU
+        GEMM kernel (ops/pallas/int8_gemm.py) sits behind, so
+        slim-quantized models hit the kernel with zero model changes
+        (the old `dequantize_weight` + stock matmul lowering never
+        fired it), and
       * every other quantizable op reading through `dequantize_weight`
         (weight-only int8 storage; XLA fuses the dequant into the op).
 
@@ -320,7 +328,22 @@ def convert_to_int8_program(program: Program, scope, act_scales=None,
                     {"Out": [out_name]},
                     {"act_scale": float(act_scales[aname])}))
             continue
-        # weight-only: dequantize once per consumer chain
+        if mat_family and plain:
+            # weight-only int8 through the SAME op contract (no
+            # act_scale attr): the activation stays fp and the Pallas
+            # int8 GEMM kernel fuses the per-channel dequant into the
+            # MXU matmul epilogue — the old lowering (dequantize_weight
+            # + stock matmul) kept the kernel dark for slim models
+            out_name = op.outputs["Out"][0]
+            inputs = {"X": [aname], "Y": [base], "YScale": [scale_name]}
+            bias_names = op.inputs.get("Bias") if op.type == "fc" else None
+            if bias_names:
+                inputs["Bias"] = [bias_names[0]]
+            new_ops.append(OpDesc("int8_matmul", inputs,
+                                  {"Out": [out_name]}, {}))
+            continue
+        # weight-only non-matmul (conv family): dequantize once per
+        # consumer chain
         if base not in dequantized:
             deq = base + "@dequantized"
             block.create_var(name=deq, stop_gradient=True)

@@ -210,7 +210,8 @@ def _collect_collective_ops(ops, _seen=None) -> List[OpDesc]:
 # key order — the recompile-cause diagnostic names these in events
 _KEY_COMPONENTS = ("program", "program_version", "scope", "feed_names",
                    "fetch_names", "mesh", "dp_divisibility",
-                   "steps_per_dispatch", "axis_rules", "zero_stage")
+                   "steps_per_dispatch", "axis_rules", "zero_stage",
+                   "pallas_kernels")
 
 
 def _assert_all_finite(named_vals, where: str):
@@ -829,9 +830,18 @@ class Executor:
         # recompile-cause diagnostics
         rules_fp = axis_rules.fingerprint() if mesh is not None else None
         zero_stage = getattr(program, "_zero_stage", None)
+        # the Pallas kernel fingerprint (PT_PALLAS mode + tile/chunk
+        # geometry, ops/pallas.kernels_fingerprint) is read at TRACE
+        # time by the kernel dispatchers — a mid-process mode flip or
+        # chunk-flag change must recompile, not reuse an entry lowered
+        # for the other kernel variant (and the PR 10 cost capture then
+        # attributes flops/bytes per variant)
+        from ..ops import pallas as _pallas
+
+        pallas_fp = _pallas.kernels_fingerprint()
         key = (program.uid, program.version, scope.uid, feed_names,
                tuple(fetch_names), mesh_key, tuple(sorted(dp_ok.items())),
-               scan_k, rules_fp, zero_stage)
+               scan_k, rules_fp, zero_stage, pallas_fp)
         entry = self._cache.get(key)
         compile_cause = None
         t_compile = None
@@ -975,7 +985,8 @@ class Executor:
                  "mesh": None if mesh_key is None else list(mesh_key[0]),
                  "dp_divisibility": sorted(dp_ok.items()),
                  "steps_per_dispatch": scan_k or 1,
-                 "axis_rules": rules_fp, "zero_stage": zero_stage})
+                 "axis_rules": rules_fp, "zero_stage": zero_stage,
+                 "pallas_kernels": pallas_fp})
         else:
             # host-side dispatch wall time (device dispatch is async —
             # these are the step-time percentiles in the run log).

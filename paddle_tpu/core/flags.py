@@ -283,6 +283,15 @@ define_flag("decode_max_new_tokens", 64,
             "default generation budget when a request does not set "
             "max_new_tokens (always additionally capped by the model's "
             "max_seq_len)")
+define_flag("pallas_kv_chunk_tokens", 1024,
+            "KV tokens one chunk of the Pallas paged-attention decode "
+            "kernel (ops/pallas/paged_attention.py) streams through "
+            "VMEM: a row whose whole context fits one chunk takes the "
+            "exact single-pass softmax (bitwise-identical to the "
+            "PT_PALLAS=off stock lowering); longer contexts stream "
+            "chunks through online-softmax accumulation. Part of "
+            "kernels_fingerprint(), so changing it recompiles every "
+            "cached program instead of reusing a stale kernel")
 define_flag("decode_weight_quant", "none",
             "weight format of the decode engine: 'none' serves fp32 "
             "weights, 'int8' serves per-output-channel weight-only int8 "

@@ -82,18 +82,23 @@ def _param(name, shape, dtype="float32"):
 
 def _dense(x, name, d_in, d_out, quant: bool):
     """x @ W + b with the weight either an fp32 feed or an (int8, scale)
-    pair dequantized through ops/quant_ops.py dequantize_weight (fused
-    into the matmul read by XLA — the weight-only int8 serving path)."""
+    pair lowered through the weight-only ``int8_matmul`` op contract
+    (ops/quant_ops.py): the weight stays int8 in HBM and the
+    per-channel dequant + bias fuse into the matmul epilogue — the
+    Pallas MXU kernel (ops/pallas/int8_gemm.py) under PT_PALLAS, the
+    counted stock lowering otherwise."""
+    b = _param(f"{name}_b", (d_out,))
     if quant:
         w8 = _param(f"{name}_w_i8", (d_in, d_out), "int8")
         ws = _param(f"{name}_w_scale", (d_out,))
-        helper = LayerHelper("dequantize_weight")
-        w = helper.create_variable_for_type_inference("float32")
-        helper.append_op("dequantize_weight", {"X": [w8], "Scale": [ws]},
-                         {"Out": [w]}, {"axis": 1})
-    else:
-        w = _param(f"{name}_w", (d_in, d_out))
-    b = _param(f"{name}_b", (d_out,))
+        helper = LayerHelper("int8_matmul")
+        out = helper.create_variable_for_type_inference("float32")
+        helper.append_op("int8_matmul",
+                         {"X": [x], "Y": [w8], "YScale": [ws],
+                          "Bias": [b]},
+                         {"Out": [out]}, {})
+        return out
+    w = _param(f"{name}_w", (d_in, d_out))
     return layers.linear(x, w, b)
 
 

@@ -215,19 +215,27 @@ def cached_kv_attention_op(ins, attrs):
     [N, P, kvdim]; PageTable [B, MP]; Positions [B] int32 — the new
     token's 0-based position (context length = pos + 1). The op first
     writes the new K/V at (PageTable[b, pos//P], pos%P), then attends
-    the query over the row's gathered pages with positions > pos masked
-    to -1e9 BEFORE the softmax, so stale page contents (the pool
-    recycles pages across requests) contribute exactly zero — per-row
-    outputs are a pure function of the row's own tokens, which is what
-    keeps continuous-batched decode bitwise-identical to sequential
-    decode. Empty slots carry an all-zero page table and write to the
-    pool's reserved scratch page 0.
+    the query over the row's pages with positions > pos masked out
+    BEFORE the softmax, so stale page contents (the pool recycles pages
+    across requests) contribute exactly zero — per-row outputs are a
+    pure function of the row's own tokens, which is what keeps
+    continuous-batched decode bitwise-identical to sequential decode.
+    Empty slots carry an all-zero page table and write to the pool's
+    reserved scratch page 0.
+
+    The attend phase routes through the Pallas paged-attention kernel
+    (ops/pallas/paged_attention.py: per-page HBM→VMEM block-gather, no
+    dense gathered context in HBM) under the PT_PALLAS dispatch; the
+    'off' mode and untileable shapes take the counted stock
+    gather+einsum lowering (``pallas.paged_attn_fallbacks``). The write
+    phase is shared by every route.
 
     Outputs: Out [B, nh*hd], PoolKOut, PoolVOut (the engine threads the
     pools through the step program and donates them to the jit so XLA
     can update in place)."""
-    import jax
     import jax.numpy as jnp
+
+    from .pallas.paged_attention import paged_decode_attention
 
     q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
     # .at[] updates need jax arrays (a direct OpTest call feeds numpy)
@@ -238,25 +246,13 @@ def cached_kv_attention_op(ins, attrs):
     n = int(attrs["num_heads"])
     hd = int(attrs["head_dim"])
     scale = float(attrs.get("scale") or hd ** -0.5)
-    b = q.shape[0]
     page = int(pool_k.shape[1])
-    mp = int(table.shape[1])
     # write the step's K/V into each row's current page
     phys = jnp.take_along_axis(table, (pos // page)[:, None], axis=1)[:, 0]
     pool_k = pool_k.at[phys, pos % page].set(k)
     pool_v = pool_v.at[phys, pos % page].set(v)
-    # gather each row's pages into a dense [B, MP*P, kvdim] context
-    ctx_k = pool_k[table].reshape(b, mp * page, -1)
-    ctx_v = pool_v[table].reshape(b, mp * page, -1)
-    qh = q.reshape(b, n, hd)
-    kh = ctx_k.reshape(b, mp * page, n, hd)
-    vh = ctx_v.reshape(b, mp * page, n, hd)
-    scores = jnp.einsum("bnh,bsnh->bns", qh, kh) * scale
-    mask = jnp.arange(mp * page, dtype=jnp.int32)[None, None, :] \
-        <= pos[:, None, None]
-    scores = jnp.where(mask, scores, -1e9)
-    probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bns,bsnh->bnh", probs, vh).reshape(b, n * hd)
+    out = paged_decode_attention(q, pool_k, pool_v, table, pos,
+                                 num_heads=n, head_dim=hd, scale=scale)
     return {"Out": out, "PoolKOut": pool_k, "PoolVOut": pool_v}
 
 

@@ -7,7 +7,11 @@ math/bert_encoder_functor.cu) and fused optimizer passes
 
 * flash_attention — blockwise online-softmax attention (fwd + bwd kernels),
 * layer_norm      — fused row-normalisation,
-* fused_adamw     — single-kernel parameter/moment update.
+* fused_adamw     — single-kernel parameter/moment update,
+* int8_gemm       — weight-only int8 MXU GEMM, dequant+bias+act fused
+                    into the matmul epilogue (serving hot path),
+* paged_attention — decode-step attention that walks the KV page table
+                    directly (serving/kv_cache.py layout).
 
 Mode selection (``kernel_mode()``):
   'tpu'       compiled Pallas on a real TPU backend,
@@ -45,6 +49,21 @@ def interpret_mode() -> bool:
     return kernel_mode() == "interpret"
 
 
+def kernels_fingerprint() -> str:
+    """Mode + kernel-geometry fingerprint for compile-cache keys: a
+    PT_PALLAS flip or a tile/chunk-constant change mid-process must
+    RECOMPILE (the lowering changed), not reuse a stale entry. Named
+    'pallas_kernels' in the executor's recompile-cause diagnostics and
+    the decode engine's cost-capture keys."""
+    from .int8_gemm import int8_gemm_fingerprint
+    from .paged_attention import paged_attn_fingerprint
+
+    return (f"{kernel_mode()}|{int8_gemm_fingerprint()}"
+            f"|{paged_attn_fingerprint()}")
+
+
 from .flash_attention import flash_attention  # noqa: E402,F401
 from .layer_norm import fused_layer_norm  # noqa: E402,F401
 from .fused_adam import fused_adamw  # noqa: E402,F401
+from .int8_gemm import int8_weight_only_gemm  # noqa: E402,F401
+from .paged_attention import paged_decode_attention  # noqa: E402,F401

@@ -122,18 +122,42 @@ def dequantize_weight(ins, attrs):
     return {"Out": x.astype(jnp.float32) * scale.reshape(shape)}
 
 
-@register_op("int8_matmul", non_diff_inputs=("Y", "YScale"))
+@register_op("int8_matmul", non_diff_inputs=("Y", "YScale", "Bias"))
 def int8_matmul(ins, attrs):
-    """Native int8 GEMM: activation statically quantized by the
-    calibrated abs-max (attr act_scale, PTQ), weight already int8
-    per-output-channel; int32 accumulation on the MXU, dequantized
-    epilogue. Out = (clip(round(x/sx))_i8 @ w_i8) * sx * sy[col]."""
+    """Native int8 GEMM — TWO serving modes behind one op contract:
+
+    * **static-quant** (attr ``act_scale`` present, the PTQ path):
+      activation statically quantized by the calibrated abs-max, weight
+      already int8 per-output-channel; int8×int8 dot with int32
+      accumulation on the MXU, dequantized epilogue.
+      Out = (clip(round(x/sx))_i8 @ w_i8) * sx * sy[col].
+    * **weight-only** (no ``act_scale``): the activation stays fp32 and
+      only the weight is int8 — Out = act((x @ w_i8) * sy[col] + Bias)
+      through the Pallas MXU kernel (ops/pallas/int8_gemm.py), which
+      keeps the weight int8 in HBM and fuses the per-channel dequant
+      plus the optional Bias input / ``act`` attr ('relu') into the
+      matmul epilogue. PT_PALLAS=off (and untileable shapes) take the
+      counted stock lowering (``pallas.int8_gemm_fallbacks``).
+
+    models/decoder_lm.py's int8 programs and contrib/slim.py's
+    weight-only converts both lower through the weight-only mode, so
+    the kernel fires for every int8-served model with zero model
+    changes."""
     import jax
     import jax.numpy as jnp
 
     x, w = ins["X"][0], ins["Y"][0]
     sy = ins["YScale"][0].reshape(-1)          # per output column
-    sx = float(attrs["act_scale"]) / 127.0
+    act_scale = attrs.get("act_scale")
+    if not act_scale:
+        from .pallas.int8_gemm import int8_weight_only_gemm
+
+        bias = ins["Bias"][0] if ins.get("Bias") and \
+            ins["Bias"][0] is not None else None
+        out = int8_weight_only_gemm(x, w, sy, bias=bias,
+                                    act=attrs.get("act") or None)
+        return {"Out": out}
+    sx = float(act_scale) / 127.0
     xq = jnp.clip(jnp.round(x.astype(jnp.float32) / sx), -127,
                   127).astype(jnp.int8)
     acc = jax.lax.dot_general(

@@ -295,6 +295,15 @@ class DecodeEngine:
         out["model_version"] = self.version
         out["status"] = self.health.state
         out["kv_cache"] = self.pool.stats()
+        from ..ops import pallas as _pallas
+
+        # per-kernel dispatch/fallback counters (counted at lowering
+        # time) + the live kernel fingerprint — which code path this
+        # engine's programs actually compiled
+        out["pallas"] = dict(
+            {k.split(".", 1)[1]: int(v) for k, v in c.items()
+             if k.startswith("pallas.") and isinstance(v, (int, float))},
+            kernels=_pallas.kernels_fingerprint())
         hists = telemetry.snapshot()["hists"]
         for key in ("decode.step_ms", "decode.prefill_ms",
                     "decode.request_ms"):
@@ -383,15 +392,23 @@ class DecodeEngine:
             run_block(block, env)
             return env["logits"], {n: env[n + "_out"] for n in pool_names}
 
+        from ..ops import pallas as _pallas
+
         entry = jax.jit(fn, donate_argnums=(1,))
         self._entries[key] = entry
         t0 = time.perf_counter()
         feed = self._zero_feed(phase, bucket)
+        # the Pallas kernel fingerprint (PT_PALLAS mode + tile/chunk
+        # geometry) keys the cost capture so flops/bytes attribute to
+        # the kernel VARIANT actually compiled — the roofline verdict of
+        # the stock gather+einsum lowering and the paged kernel are
+        # different programs, not one blurred row
+        pallas_fp = _pallas.kernels_fingerprint()
         if costmodel.capture_mode() != "off":
             costmodel.capture(
                 lambda: entry.lower(self._params, dict(self._pools), feed),
                 key_id=costmodel.key_id_for((phase, bucket,
-                                             cc.weight_quant)),
+                                             cc.weight_quant, pallas_fp)),
                 kind="decode", program=f"{phase}_b{bucket}")
         # compile through a throwaway execution on zero feeds (the
         # predictor's measure-through-first-run discipline); FRESH pool
@@ -402,6 +419,7 @@ class DecodeEngine:
         telemetry.event("compile", "decode", ms,
                         {"cause": "decode_bucket", "phase": phase,
                          "bucket": bucket,
+                         "pallas_kernels": pallas_fp,
                          "cache_size": len(self._entries)})
         return entry
 

@@ -76,6 +76,7 @@ _VERIFY_FLAG_MODULES = {
     "test_op_registry_sweep", "test_gate_smoke_execution",
     "test_ops_batch2", "test_ops_batch3", "test_ops_extended",
     "test_ops_round4", "test_ops_round5", "test_crf_ops",
+    "test_pallas_serving_kernels",
 }
 
 

@@ -464,10 +464,10 @@ _EMIT_RE = re.compile(
 
 
 def _emitted_metric_names():
-    """Every cost.*/mem.*/costmodel.*/sharding.*state_bytes* metric name
-    the framework emits, scraped from the source (f-string placeholders
-    truncate the name at '{' — the renderer must reference the static
-    prefix)."""
+    """Every cost.*/mem.*/costmodel.*/pallas.*/sharding.*state_bytes*
+    metric name the framework emits, scraped from the source (f-string
+    placeholders truncate the name at '{' — the renderer must reference
+    the static prefix)."""
     names = set()
     roots = [os.path.join(REPO_ROOT, "paddle_tpu"),
              os.path.join(REPO_ROOT, "tools")]
@@ -482,7 +482,8 @@ def _emitted_metric_names():
                     src = f.read()
                 for m in _EMIT_RE.finditer(src):
                     name = m.group(1).split("{", 1)[0]
-                    if name.startswith(("cost.", "mem.", "costmodel.")) or \
+                    if name.startswith(("cost.", "mem.", "costmodel.",
+                                        "pallas.")) or \
                             (name.startswith("sharding.")
                              and "state_bytes" in name):
                         names.add(name)
@@ -501,6 +502,11 @@ class TestMetricDriftGuard:
         assert "costmodel.unavailable" in names
         assert any(n.startswith("mem.serving.bucket") for n in names)
         assert "sharding.optimizer_state_bytes" in names
+        # the Pallas serving kernels count every dispatch and fallback
+        assert "pallas.int8_gemm_dispatches" in names
+        assert "pallas.paged_attn_dispatches" in names
+        assert "pallas.int8_gemm_fallbacks" in names
+        assert "pallas.paged_attn_fallbacks" in names
         renderers = ""
         for tool in ("perf_report.py", "mem_report.py"):
             with open(os.path.join(REPO_ROOT, "tools", tool)) as f:

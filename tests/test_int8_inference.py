@@ -97,8 +97,11 @@ def test_int8_predictor_top1_parity(scope):
 
 
 def test_weight_only_path(scope):
-    """Without activation scales every op takes the weight-only
-    dequantize_weight route and still matches closely."""
+    """Without activation scales every matmul-family op takes the
+    weight-only ``int8_matmul`` route (NO act_scale attr — the lowering
+    the Pallas int8 MXU GEMM kernel sits behind; before this the
+    weight-only convert emitted dequantize_weight + stock matmul and
+    the kernel never fired) and still matches closely."""
     infer, logits, batch = _build_and_train(scope, steps=30)
     fp = AnalysisPredictor(AnalysisConfig(), program=infer,
                            feed_names=["x"], fetch_names=[logits.name],
@@ -110,14 +113,36 @@ def test_weight_only_path(scope):
     int8_scope._vars = {k: np.copy(v) for k, v in scope.items()}
     prog = slim.convert_to_int8_program(infer.clone(for_test=True),
                                         int8_scope, act_scales=None)
-    types = [op.type for op in prog.global_block().ops]
-    assert "dequantize_weight" in types and "int8_matmul" not in types
+    mm_ops = [op for op in prog.global_block().ops
+              if op.type == "int8_matmul"]
+    assert len(mm_ops) == 2, \
+        [op.type for op in prog.global_block().ops]
+    assert all(not op.attrs.get("act_scale") for op in mm_ops)
     q = AnalysisPredictor(AnalysisConfig(), program=prog,
                           feed_names=["x"], fetch_names=[logits.name],
                           scope=int8_scope)
     q_logits, = q.run({"x": test["x"]})
     agree = np.mean(np.argmax(q_logits, 1) == np.argmax(fp_logits, 1))
     assert agree >= 0.98, agree
+
+    # regression: numeric parity with the OLD weight-only lowering
+    # (dequantize_weight + stock matmul — dequant-then-dot instead of
+    # the kernel's dot-then-scale; same math, different rounding order,
+    # pinned within float tolerance)
+    def old_lowering(x):
+        h = x
+        for i, op in enumerate(mm_ops):
+            w8 = np.asarray(int8_scope.find_var(op.inputs["Y"][0]))
+            sc = np.asarray(int8_scope.find_var(op.inputs["YScale"][0]))
+            b = np.asarray(int8_scope.find_var(f"fc_{i}.b_0"))
+            h = h @ (w8.astype(np.float32) * sc[None, :]) + b
+            if i == 0:
+                h = np.maximum(h, 0.0)
+        return h
+
+    want = old_lowering(test["x"].astype(np.float32))
+    np.testing.assert_allclose(np.asarray(q_logits), want,
+                               rtol=2e-4, atol=2e-4)
 
 
 def test_weight_tied_param_stays_fp(scope):

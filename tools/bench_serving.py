@@ -442,17 +442,83 @@ def _run_gen_load(engine, workload, concurrency):
     return wall, results, sorted(ttft), sorted(itl)
 
 
+class _forced_pallas:
+    """Pin PT_PALLAS for one bench arm (the dispatchers read it at trace
+    time, so it must cover engine build + warmup + load)."""
+
+    def __init__(self, mode):
+        self.mode = mode
+
+    def __enter__(self):
+        self._old = os.environ.get("PT_PALLAS")
+        os.environ["PT_PALLAS"] = self.mode
+        return self
+
+    def __exit__(self, *exc):
+        if self._old is None:
+            os.environ.pop("PT_PALLAS", None)
+        else:
+            os.environ["PT_PALLAS"] = self._old
+
+
+def _kernel_arm_mode(args):
+    """The Pallas mode of the --generate kernel arm: forced via
+    --kernel-mode, else 'tpu' on a TPU backend, 'interpret' for the
+    --smoke CI row (proves the kernel path end-to-end on CPU, bitwise-
+    gated), 'off' otherwise (CPU perf rows: the interpreter is not a
+    performance arm)."""
+    if args.kernel_mode != "auto":
+        return args.kernel_mode
+    import jax
+
+    try:
+        if jax.default_backend() == "tpu":
+            return "tpu"
+    except Exception:
+        pass
+    return "interpret" if args.smoke else "off"
+
+
+def _decode_rooflines(before_keys):
+    """Roofline verdicts of decode programs captured since
+    ``before_keys`` (per-arm: the pallas fingerprint is part of each
+    capture key, so the two arms never collide on one record)."""
+    from paddle_tpu.core import costmodel
+
+    out = {}
+    for rec in costmodel.programs():
+        if rec.kind == "decode" and rec.key_id not in before_keys:
+            out[str(rec.program)] = {
+                "intensity": round(rec.intensity(), 4),
+                "verdict": rec.roofline(),
+                "flops": rec.flops,
+                "bytes_accessed": rec.bytes_accessed}
+    return out
+
+
+def _captured_keys():
+    from paddle_tpu.core import costmodel
+
+    return {rec.key_id for rec in costmodel.programs()}
+
+
 def bench_generate(args):
     """--generate: continuous batching vs the drain-and-refill baseline,
-    gated on bitwise identity with sequential decode."""
+    gated on bitwise identity with sequential decode — plus a Pallas
+    kernel on/off A/B arm (extra.pallas_kernels) with per-arm roofline
+    verdicts, so a TPU relay round can show the memory-bound →
+    compute-bound flip of the paged-attention/int8-GEMM kernels."""
     import numpy as np
 
     from paddle_tpu.core import telemetry
+    from paddle_tpu.core.flags import set_flags
     from paddle_tpu.models.decoder_lm import (DecoderLMConfig,
                                               decoder_lm_params)
     from paddle_tpu.serving import (DecodeConfig, DecodeEngine,
                                     ServingHTTPServer)
 
+    # roofline verdicts need the per-compile cost capture on
+    set_flags({"cost_capture": "cost"})
     concurrency = args.gen_concurrency or 2 * args.gen_slots
     cfg = DecoderLMConfig(vocab_size=512, d_model=args.gen_d_model,
                           n_head=4, n_layers=args.gen_layers,
@@ -474,53 +540,66 @@ def bench_generate(args):
             prefill_buckets=[args.gen_prompt_len],
             continuous=continuous)).start(warmup=True)
 
-    # -- sequential reference (also warms nothing shared) ------------------
-    seq_eng = make_engine(True)
-    reference = {}
-    t0 = time.perf_counter()
-    for i, (prompt, max_new) in enumerate(workload):
-        reference[i] = np.asarray(
-            seq_eng.generate(prompt, max_new_tokens=max_new, timeout=300))
-    seq_wall = time.perf_counter() - t0
-    seq_eng.close(drain=True, timeout=10)
-    total_tokens = sum(len(v) for v in reference.values())
+    kernel_mode = _kernel_arm_mode(args)
 
-    # -- drain-and-refill baseline (static batching) -----------------------
-    # each arm runs --gen-rounds times on its warmed engine and scores
-    # its best wall (the standard best-of-N discipline: scheduler noise
-    # only ever slows a run down)
-    drain_eng = make_engine(False)
-    drain_wall = None
-    for _ in range(args.gen_rounds):
-        wall, drain_res, _t, _i = _run_gen_load(
-            drain_eng, workload, concurrency)
-        drain_wall = wall if drain_wall is None else min(drain_wall, wall)
-    drain_eng.close(drain=True, timeout=10)
+    # ===== stock arm: PT_PALLAS=off pinned (counted stock lowerings) ======
+    with _forced_pallas("off"):
+        stock_keys = _captured_keys()
+        # -- sequential reference (also warms nothing shared) --------------
+        seq_eng = make_engine(True)
+        reference = {}
+        t0 = time.perf_counter()
+        for i, (prompt, max_new) in enumerate(workload):
+            reference[i] = np.asarray(
+                seq_eng.generate(prompt, max_new_tokens=max_new,
+                                 timeout=300))
+        seq_wall = time.perf_counter() - t0
+        seq_eng.close(drain=True, timeout=10)
+        total_tokens = sum(len(v) for v in reference.values())
 
-    # -- continuous batching, with the live /metrics scrape mid-load -------
-    cont_eng = make_engine(True)
-    http_srv = ServingHTTPServer(None, decode_engine=cont_eng).start()
-    scraped = {}
-    stop_scrape = threading.Event()
-    scraper = threading.Thread(
-        target=_scrape_gen_metrics,
-        args=(http_srv.url, stop_scrape, scraped),
-        name="pt-bench-gen-scrape", daemon=True)
-    scraper.start()
-    steps_before = telemetry_counter("decode.steps")
-    tokens_before = telemetry_counter("decode.tokens")
-    try:
-        cont_wall = None
+        # -- drain-and-refill baseline (static batching) -------------------
+        # each arm runs --gen-rounds times on its warmed engine and scores
+        # its best wall (the standard best-of-N discipline: scheduler noise
+        # only ever slows a run down)
+        drain_eng = make_engine(False)
+        drain_wall = None
         for _ in range(args.gen_rounds):
-            wall, cont_res, ttft, itl = _run_gen_load(
-                cont_eng, workload, concurrency)
-            cont_wall = wall if cont_wall is None else min(cont_wall, wall)
-    finally:
-        stop_scrape.set()
-        scraper.join(timeout=10)
-        http_srv.shutdown()
-        pool_stats = cont_eng.pool.stats()
-        cont_eng.close(drain=True, timeout=10)
+            wall, drain_res, _t, _i = _run_gen_load(
+                drain_eng, workload, concurrency)
+            drain_wall = wall if drain_wall is None else min(drain_wall,
+                                                            wall)
+        drain_eng.close(drain=True, timeout=10)
+
+        # -- continuous batching, with the live /metrics scrape mid-load ---
+        cont_eng = make_engine(True)
+        http_srv = ServingHTTPServer(None, decode_engine=cont_eng).start()
+        scraped = {}
+        stop_scrape = threading.Event()
+        scraper = threading.Thread(
+            target=_scrape_gen_metrics,
+            args=(http_srv.url, stop_scrape, scraped),
+            name="pt-bench-gen-scrape", daemon=True)
+        scraper.start()
+        steps_before = telemetry_counter("decode.steps")
+        tokens_before = telemetry_counter("decode.tokens")
+        try:
+            cont_wall = None
+            for _ in range(args.gen_rounds):
+                wall, cont_res, ttft, itl = _run_gen_load(
+                    cont_eng, workload, concurrency)
+                cont_wall = wall if cont_wall is None else min(cont_wall,
+                                                               wall)
+        finally:
+            stop_scrape.set()
+            scraper.join(timeout=10)
+            http_srv.shutdown()
+            pool_stats = cont_eng.pool.stats()
+            cont_eng.close(drain=True, timeout=10)
+        stock_rooflines = _decode_rooflines(stock_keys)
+        # snapshot the CONTINUOUS arm's step/token deltas before the
+        # kernel arm moves the same global counters
+        cont_steps = telemetry_counter("decode.steps") - steps_before
+        cont_tokens = telemetry_counter("decode.tokens") - tokens_before
 
     # -- bitwise gate: every arm must reproduce sequential decode ----------
     for name, res in (("drain", drain_res), ("continuous", cont_res)):
@@ -532,14 +611,66 @@ def bench_generate(args):
                     f"differs from sequential decode — continuous "
                     f"batching must not change generations")
 
-    c = telemetry.counters()
-    # occupancy of the CONTINUOUS arm only (counters are global across
-    # the three arms): generated tokens / (steps * slot count)
-    cont_steps = int(c.get("decode.steps", 0)) - steps_before
-    cont_tokens = int(c.get("decode.tokens", 0)) - tokens_before
+    # ===== kernel arm: the Pallas int8-GEMM + paged-attention path ========
+    toks_s = total_tokens / cont_wall
+    pallas_ab = {"stock": {"mode": "off",
+                           "tokens_per_s": round(toks_s, 2),
+                           "rooflines": stock_rooflines}}
+    if kernel_mode != "off":
+        disp_before = (telemetry_counter("pallas.int8_gemm_dispatches"),
+                       telemetry_counter("pallas.paged_attn_dispatches"))
+        with _forced_pallas(kernel_mode):
+            kern_keys = _captured_keys()
+            kern_eng = make_engine(True)
+            kern_wall = None
+            for _ in range(args.gen_rounds):
+                wall, kern_res, _kt, _ki = _run_gen_load(
+                    kern_eng, workload, concurrency)
+                kern_wall = wall if kern_wall is None else min(kern_wall,
+                                                               wall)
+            kern_eng.close(drain=True, timeout=10)
+            kern_rooflines = _decode_rooflines(kern_keys)
+        attn_disp = (telemetry_counter("pallas.paged_attn_dispatches")
+                     - disp_before[1])
+        gemm_disp = (telemetry_counter("pallas.int8_gemm_dispatches")
+                     - disp_before[0])
+        if not attn_disp:
+            raise SystemExit(
+                f"KERNEL ARM DARK: PT_PALLAS={kernel_mode} never "
+                f"dispatched the paged-attention kernel — the A/B row "
+                f"would compare stock against stock")
+        if kernel_mode == "interpret":
+            # the interpreter proves CORRECTNESS: kernel-arm generations
+            # must be bitwise-identical to the stock arm's sequential
+            # reference (the tier-1 decode identity gate, end to end)
+            for i, want in reference.items():
+                got = kern_res.get(i)
+                if got is None or not np.array_equal(got, want):
+                    raise SystemExit(
+                        f"BITWISE MISMATCH: PT_PALLAS=interpret decode "
+                        f"of request {i} differs from PT_PALLAS=off — "
+                        f"the kernel changed generations")
+        kern_toks_s = total_tokens / kern_wall
+        pallas_ab["kernel"] = {
+            "mode": kernel_mode,
+            "tokens_per_s": round(kern_toks_s, 2),
+            "int8_gemm_dispatches": gemm_disp,
+            "paged_attn_dispatches": attn_disp,
+            "rooflines": kern_rooflines,
+            "bitwise_vs_stock": kernel_mode == "interpret"}
+        pallas_ab["kernel_vs_stock"] = round(kern_toks_s / toks_s, 3)
+        if kernel_mode == "tpu" and kern_toks_s < toks_s:
+            # the acceptance gate is PERF only where the compiled kernel
+            # actually runs; the interpreter arm is a correctness probe
+            raise SystemExit(
+                f"KERNEL ARM SLOWER: PT_PALLAS=tpu "
+                f"{kern_toks_s:.1f} tokens/s < stock {toks_s:.1f} — "
+                f"the kernels must not regress the decode hot path")
+
+    # occupancy of the CONTINUOUS stock arm only (counters are global
+    # across the arms): generated tokens / (steps * slot count)
     occupancy = cont_tokens / (cont_steps * args.gen_slots) \
         if cont_steps else 0.0
-    toks_s = total_tokens / cont_wall
     toks_s_drain = total_tokens / drain_wall
     return {
         "metric": "decode_tokens_per_s" + ("_int8" if args.int8 else ""),
@@ -572,6 +703,11 @@ def bench_generate(args):
             "bitwise_vs_sequential": True,
             "metrics_scrapes": int(scraped.get("scrapes", 0)),
             "scraped_tokens_per_s": scraped.get("tokens_per_s"),
+            # the Pallas kernel on/off A/B: per-arm tokens/s + per-
+            # program roofline verdicts (pt_cost_* intensity vs the
+            # device ridge) — the memory-bound → compute-bound evidence
+            # for the next TPU relay round
+            "pallas_kernels": pallas_ab,
         },
     }
 
@@ -630,6 +766,13 @@ def main():
                          "against sequential decode)")
     ap.add_argument("--int8", action="store_true",
                     help="with --generate: int8 weight-only serving")
+    ap.add_argument("--kernel-mode", default="auto",
+                    choices=("auto", "off", "interpret", "tpu"),
+                    help="--generate: PT_PALLAS mode of the kernel A/B "
+                         "arm (extra.pallas_kernels). auto = tpu on a "
+                         "TPU backend, interpret for --smoke (CPU CI "
+                         "proves the kernel path bitwise), off "
+                         "otherwise (skips the second arm)")
     ap.add_argument("--gen-requests", type=int, default=64,
                     help="--generate: request count")
     ap.add_argument("--gen-rounds", type=int, default=3,

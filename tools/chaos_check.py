@@ -299,7 +299,40 @@ def run_serving(args) -> int:
 def run_decode(args) -> int:
     """--decode mode: injected decode.step / decode.kv_alloc faults must
     surface as per-request errors, the KV page pool must account back to
-    baseline (zero leaked pages), and the queue must never wedge."""
+    baseline (zero leaked pages), and the queue must never wedge.
+
+    Runs TWO legs: the default kernel mode, then one with
+    ``PT_PALLAS=interpret`` forced — fault injection at decode.step must
+    compose with the Pallas paged-attention/int8-GEMM kernel path
+    exactly as with the stock lowerings (per-request errors, zero
+    leaked pages, live queue)."""
+    if args.telemetry_log:
+        from paddle_tpu.core import telemetry
+
+        telemetry.configure(args.telemetry_log)
+    if args.trace_sample:
+        from paddle_tpu.core import flags as _flags
+
+        _flags.set_flags({"trace_sample_rate": args.trace_sample})
+    for leg, mode in (("default", None), ("pallas-interpret", "interpret")):
+        print(f"== decode chaos leg: {leg} ==")
+        old = os.environ.get("PT_PALLAS")
+        if mode is not None:
+            os.environ["PT_PALLAS"] = mode
+        try:
+            rc = _run_decode_leg(args, kernel_leg=mode is not None)
+        finally:
+            if mode is not None:
+                if old is None:
+                    os.environ.pop("PT_PALLAS", None)
+                else:
+                    os.environ["PT_PALLAS"] = old
+        if rc:
+            return rc
+    return 0
+
+
+def _run_decode_leg(args, kernel_leg=False) -> int:
     import threading
 
     import numpy as np
@@ -309,16 +342,12 @@ def run_decode(args) -> int:
                                               decoder_lm_params)
     from paddle_tpu.serving import DecodeConfig, DecodeEngine
 
-    if args.telemetry_log:
-        telemetry.configure(args.telemetry_log)
-    if args.trace_sample:
-        from paddle_tpu.core import flags as _flags
-
-        _flags.set_flags({"trace_sample_rate": args.trace_sample})
     # a decode.step fault fails the WHOLE in-flight slot array (every
     # affected generation gets a per-request error), so the default uses
     # one-shot triggers — a %N step spec would leave no survivors
     spec = args.fault_spec or "decode.step:@4,decode.kv_alloc:@3"
+    counters0 = dict(telemetry.counters())
+    attn_disp0 = int(counters0.get("pallas.paged_attn_dispatches", 0))
 
     cfg = DecoderLMConfig(vocab_size=128, d_model=32, n_head=2, n_layers=2,
                           d_inner=64, max_seq_len=48)
@@ -374,14 +403,18 @@ def run_decode(args) -> int:
         pool_stats = engine.pool.stats()
         engine.close(drain=True, timeout=10)
 
-    counters = telemetry.counters()
+    # per-LEG deltas: the interpret leg must not inherit the default
+    # leg's injection/error tallies through the process-global counters
+    raw = telemetry.counters()
+    counters = {k: int(v) - int(counters0.get(k, 0))
+                for k, v in raw.items() if isinstance(v, (int, float))}
     injected = int(counters.get("faults.injected", 0))
-    print("-- decode chaos tally " + "-" * 27)
+    print("-- decode chaos tally (this leg) " + "-" * 16)
     for key in ("faults.injected", "decode.requests", "decode.prefills",
                 "decode.steps", "decode.tokens", "decode.retired",
                 "decode.errors", "decode.kv_pages_allocated",
                 "decode.kv_pages_freed", "decode.kv_refusals",
-                "trace.spans"):
+                "pallas.paged_attn_dispatches", "trace.spans"):
         print(f"{key:28s} {int(counters.get(key, 0))}")
     inj = faults.counts()["injected"]
     for site, n in sorted(inj.items()):
@@ -417,6 +450,12 @@ def run_decode(args) -> int:
               "the trigger?)")
     if not ok or not np.asarray(final).size:
         print("CHAOS FAIL: no clean generations")
+        return 2
+    if kernel_leg and int(raw.get("pallas.paged_attn_dispatches", 0)) \
+            <= attn_disp0:
+        print("CHAOS FAIL: PT_PALLAS=interpret leg never dispatched the "
+              "paged-attention kernel — the fault/kernel composition "
+              "went untested")
         return 2
     print(f"CHAOS OK: {args.requests} generations, {len(failed)} "
           f"per-request error responses from {injected} injected faults, "

@@ -118,6 +118,17 @@ def summarize_mem(recs, malformed=0):
     }
     if gauges.get("cost.live_mfu") is not None:
         capture["last_live_mfu"] = _num(gauges["cost.live_mfu"])
+    # which kernel variant the serving programs lowered to — pairs with
+    # the per-program roofline verdicts above (the pallas fingerprint is
+    # part of each program's capture key)
+    pallas = {name.split(".", 1)[1]: int(_num(counters.get(name)))
+              for name in ("pallas.int8_gemm_dispatches",
+                           "pallas.int8_gemm_fallbacks",
+                           "pallas.paged_attn_dispatches",
+                           "pallas.paged_attn_fallbacks")
+              if counters.get(name) is not None}
+    if pallas:
+        capture["pallas_kernels"] = pallas
     return {"ledger": ledger, "programs": rows, "ooms": ooms,
             "capture": capture, "malformed_lines": int(malformed),
             "records": len(recs)}
@@ -212,6 +223,14 @@ def render(s, out=sys.stdout):
       f"{_fmt_bytes(c['dispatch_bytes'])} accessed\n")
     if "last_live_mfu" in c:
         w(f"last live MFU gauge: {c['last_live_mfu']:.3g}\n")
+    if "pallas_kernels" in c:
+        pk = c["pallas_kernels"]
+        w("pallas serving kernels: int8 gemm "
+          f"{pk.get('int8_gemm_dispatches', 0)}/"
+          f"{pk.get('int8_gemm_fallbacks', 0)} "
+          "dispatched/stock, paged attn "
+          f"{pk.get('paged_attn_dispatches', 0)}/"
+          f"{pk.get('paged_attn_fallbacks', 0)} dispatched/stock\n")
 
 
 REQUIRED_SECTIONS = ("-- HBM ledger --", "-- per-program cost table",

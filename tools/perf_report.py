@@ -379,6 +379,16 @@ def _decode_summary(counter_delta, counter_last, timer_summary, gauges,
     if pages:
         out["kv_pages_allocated"] = int(pages)
         out["kv_pages_freed"] = int(cval("decode.kv_pages_freed"))
+    # Pallas serving-kernel dispatch accounting (ops/pallas/int8_gemm.py
+    # + paged_attention.py): counted once per LOWERING — which code path
+    # each compiled program variant actually took, not per-step volume
+    pallas = {key.split(".", 1)[1]: int(cval(key)) for key in
+              ("pallas.int8_gemm_dispatches",
+               "pallas.int8_gemm_fallbacks",
+               "pallas.paged_attn_dispatches",
+               "pallas.paged_attn_fallbacks") if cval(key)}
+    if pallas:
+        out["pallas_kernels"] = pallas
     return out
 
 
@@ -725,6 +735,14 @@ def render(s, out=sys.stdout):
             w(f"kv pages: {dc['kv_pages_allocated']} allocated / "
               f"{dc['kv_pages_freed']} freed"
               + (f"  (LEAKED {leak})\n" if leak else "\n"))
+        if "pallas_kernels" in dc:
+            pk = dc["pallas_kernels"]
+            w("pallas kernels (per lowering): "
+              f"int8 gemm {pk.get('int8_gemm_dispatches', 0)} dispatched"
+              f" / {pk.get('int8_gemm_fallbacks', 0)} stock-fallback, "
+              f"paged attn {pk.get('paged_attn_dispatches', 0)} "
+              f"dispatched / {pk.get('paged_attn_fallbacks', 0)} "
+              f"stock-fallback\n")
 
     if s.get("router"):
         rt = s["router"]
