@@ -26,6 +26,12 @@ rate / peak device flops), so BENCH rows are self-attributing; with
 FLAGS_cost_capture=full the row also carries the composed HBM ledger
 total (extra.mem_hbm_total_bytes).
 
+SLO gate: every row embeds ``extra.slo`` — the tools/slo_check.py
+verdict of this run against the committed BENCH_r*.json history
+(pass / regress / no_baseline + the failed metric list), so a
+throughput or MFU regression is visible in the row itself and
+``python tools/slo_check.py <row>`` is the CI-able exit-code twin.
+
 Sharded mode: when a mesh is active the row also records
 extra.mesh_shape, extra.axis_rules_hash (the logical-axis-rule table
 fingerprint, parallel/axis_rules.py) and extra.zero_stage (the fleet

@@ -37,6 +37,11 @@ from .core.analysis import LockOrderError, install_thread_excepthook  # noqa: F4
 # thread books threads.uncaught_exceptions + a thread_error run-log
 # record before the default stderr print (core/analysis/lockdep.py)
 install_thread_excepthook()
+# flight recorder (core/incidents.py): importing it installs the
+# always-on black-box tap on telemetry.emit, so every process keeps the
+# last FLAGS_blackbox_seconds of telemetry/span history in memory for
+# anomaly-triggered incident dumps
+from .core import incidents as _incidents  # noqa: F401,E402
 from .param_attr import ParamAttr  # noqa: F401
 from . import dataset  # noqa: F401  (native-backed Dataset API)
 from .dataset import DatasetFactory, InMemoryDataset, QueueDataset  # noqa: F401

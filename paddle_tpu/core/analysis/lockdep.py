@@ -187,13 +187,20 @@ def _dump_stall(ident: int, waiter: Dict[str, Any], waited_s: float):
     tel = _telemetry()
     _book(tel.counter_add, "lock.stalls", 1, lock=waiter["lock"],
           thread=names.get(ident, f"tid-{ident}"))
-    _book(tel.event, "stall", "lockdep.stall", round(waited_s, 3), {
-        "lock": waiter["lock"],
-        "thread": names.get(ident, f"tid-{ident}"),
-        "waited_s": round(waited_s, 3),
-        "stall_s": float(_flags.flag("lock_stall_s")),
-        "threads": threads,
-    })
+    # unified incident pipeline (core/incidents.py): the legacy
+    # kind:"stall" record keeps its exact shape (perf_report/tests read
+    # it), plus one rate-limited kind:"incident" dump with the
+    # flight-recorder ring bundled — captured while still wedged
+    from .. import incidents as _incidents
+
+    _book(_incidents.report_incident, "stall", "lockdep.stall",
+          round(waited_s, 3), context={
+              "lock": waiter["lock"],
+              "thread": names.get(ident, f"tid-{ident}"),
+              "waited_s": round(waited_s, 3),
+              "stall_s": float(_flags.flag("lock_stall_s")),
+              "threads": threads,
+          }, legacy_kind="stall")
 
 
 class SanitizedLock:
@@ -434,10 +441,17 @@ def install_thread_excepthook():
                 tel = _telemetry()
                 tel.counter_add("threads.uncaught_exceptions", 1,
                                 thread=name, exc=args.exc_type.__name__)
-                tel.event("thread_error", name, None, {
-                    "exc": args.exc_type.__name__,
-                    "message": str(args.exc_value)[:500],
-                    "traceback": tb[-4000:]})
+                # unified incident pipeline: legacy kind:"thread_error"
+                # record (exact old shape) + one rate-limited
+                # kind:"incident" dump with the flight-recorder ring
+                from .. import incidents as _incidents
+
+                _incidents.report_incident(
+                    "thread_error", name, None, context={
+                        "exc": args.exc_type.__name__,
+                        "message": str(args.exc_value)[:500],
+                        "traceback": tb[-4000:]},
+                    legacy_kind="thread_error")
             except Exception:
                 pass
         prev(args)

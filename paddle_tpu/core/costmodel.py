@@ -492,7 +492,11 @@ def oom_forensics(program: Any, err: BaseException,
     """Dump the forensics record for an allocation failure and return
     the typed error to raise: ledger snapshot + the top-N cached
     programs by peak bytes + the offending program id, as one
-    ``kind:"oom"`` JSONL record (and a counted ``mem.oom_events``)."""
+    ``kind:"oom"`` JSONL record (and a counted ``mem.oom_events``).
+    The dump rides the unified incident pipeline (core/incidents.py):
+    the legacy record keeps its exact shape for mem_report, and a
+    ``kind:"incident"`` record bundles it with the flight-recorder ring
+    + active traces."""
     with _lock:
         recs = sorted(_programs.values(),
                       key=lambda r: -(r.peak_bytes or r.temp_bytes))[:top_n]
@@ -501,10 +505,14 @@ def oom_forensics(program: Any, err: BaseException,
             "arg_bytes": r.arg_bytes, "flops": r.flops} for r in recs]
     led = ledger()
     telemetry.counter_add("mem.oom_events", 1, where=where)
-    telemetry.event("oom", "costmodel.oom", None,
-                    {"where": where, "program": program,
-                     "error": f"{type(err).__name__}: {err}"[:500],
-                     "ledger": led, "top_programs": top})
+    from . import incidents
+
+    incidents.report_incident(
+        "oom", "costmodel.oom", None,
+        context={"where": where, "program": program,
+                 "error": f"{type(err).__name__}: {err}"[:500],
+                 "ledger": led, "top_programs": top},
+        legacy_kind="oom")
     telemetry.flush_sink()   # the process may be about to die — land it
     return OutOfMemoryError(
         f"device allocation failure in {where} of program {program!r} "

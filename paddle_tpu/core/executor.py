@@ -24,7 +24,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
-from . import costmodel, registry, telemetry, trace
+from . import costmodel, incidents, registry, telemetry, trace
 from .ir import Block, OpDesc, Program, Variable, default_main_program
 from .registry import EMPTY_VAR
 from .scope import Scope, global_scope
@@ -995,6 +995,9 @@ class Executor:
             telemetry.observe(
                 "executor.run_steps_ms" if scan_k else "executor.run_ms",
                 (time.perf_counter() - t_run) * 1e3, kind="timer")
+        # SLO watchdog hook: evaluates the rule set at most every
+        # FLAGS_slo_eval_s while armed, one boolean read otherwise
+        incidents.tick()
         from .flags import flag as _flag
 
         if _flag("check_nan_inf"):

@@ -347,6 +347,54 @@ define_flag("ckpt_verify", True,
             "ckpt.fallbacks telemetry). Disabling skips only the digest "
             "work — the commit manifest itself is always required")
 
+# -- flight recorder + SLO watchdog plane (core/incidents.py: always-on
+#    black-box diagnostics with anomaly-triggered incident dumps; reference
+#    analogs: heartbeat monitors + barrier health checks that stop at raw
+#    counters) -----------------------------------------------------------------
+
+define_flag("blackbox_max_records", 2048,
+            "bound on the always-on flight-recorder ring "
+            "(core/incidents.py): the last this-many telemetry records / "
+            "trace spans / decode-router events are kept in memory — "
+            "independent of any JSONL sink — and bundled into every "
+            "kind:'incident' dump; 0 disables the recorder entirely "
+            "(incident dumps then carry an empty ring)")
+define_flag("blackbox_seconds", 120.0,
+            "time horizon of the flight-recorder ring: a snapshot taken "
+            "for an incident dump drops records older than this many "
+            "seconds even when the ring's record bound has not evicted "
+            "them yet")
+define_flag("slo_watchdog", "auto",
+            "SLO/watchdog rule engine arming (core/incidents.py): 'on' "
+            "arms rule evaluation at import, 'off' disarms it "
+            "everywhere, 'auto' (default) arms when a serving/metrics "
+            "HTTP surface starts or incidents.arm() is called "
+            "explicitly. Armed: incidents.tick() calls sprinkled on the "
+            "executor/decode/router hot paths evaluate the rule set at "
+            "most every slo_eval_s; disarmed they cost one boolean read")
+define_flag("slo_eval_s", 5.0,
+            "min seconds between two SLO rule evaluations (inline "
+            "tick() or the pt-incidents-watchdog thread): each "
+            "evaluation reads the rolling metrics window once per "
+            "distinct rule window")
+define_flag("slo_rules", "",
+            "declarative SLO rule overrides: a JSON array of rule "
+            "objects ({name, metric, kind: counter|hist|gauge, stat, "
+            "window_s, threshold | ratio (relative to the warmup-learned "
+            "baseline), direction, min_samples, cooldown_s}), or "
+            "@/path/to/rules.json; empty uses the built-in rule set "
+            "(step-time p99 regression, live-MFU drop, serving/decode "
+            "queue saturation, pallas fallback spike, router failover "
+            "burst, ckpt verify failures)")
+define_flag("incident_rate_limit_s", 30.0,
+            "global min spacing between two kind:'incident' run-log "
+            "dumps (per-rule cooldowns apply on top): a storm of trips "
+            "books incidents.rate_limited instead of flooding the log; "
+            "legacy oom/stall/thread_error records are never suppressed")
+define_flag("incident_ring_records", 256,
+            "max flight-recorder records embedded in one incident dump "
+            "(newest kept) — bounds the dump's JSONL line size")
+
 define_flag("sanitize_locks", False,
             "runtime concurrency sanitizer (core/analysis/lockdep.py, "
             "the lockdep/TSan discipline): the lock factories the "

@@ -29,8 +29,12 @@ count, attrs.exc = raised type — pairs with the ``faults.injected``
 counter so chaos runs are auditable), ``span`` (one finished distributed-
 tracing span from core/trace.py: value = duration ms, attrs = trace/
 span/parent ids + start + pid — merged across processes by
-tools/trace_view.py), ``snapshot`` (full registry dump at flush/exit),
-``profiler_summary`` (one line per profiler.summarize row).
+tools/trace_view.py), ``incident`` (one anomaly dump from the unified
+incident pipeline in core/incidents.py: a tripped SLO watchdog rule or
+an OOM/stall/thread-death, bundling the flight-recorder ring + HBM
+ledger + active traces — rendered by tools/incident_report.py),
+``snapshot`` (full registry dump at flush/exit), ``profiler_summary``
+(one line per profiler.summarize row).
 
 In-memory aggregation (counters/gauges/histograms) is ALWAYS on — it is
 a few dict updates per executor run, invisible next to a device step.
@@ -68,6 +72,17 @@ from . import flags as _flags
 from .analysis import lockdep as _lockdep
 
 SCHEMA_FIELDS = ("ts", "kind", "name", "value", "attrs")
+
+# flight-recorder tap (core/incidents.py installs it): every emit()
+# record is fed to the hook — an always-on bounded in-memory ring —
+# whether or not the JSONL sink is configured. The hook must be cheap
+# and must never raise; it is called under the registry lock and uses
+# only a plain internal lock, so it cannot create an order cycle.
+_blackbox = [None]
+
+
+def set_blackbox(fn):
+    _blackbox[0] = fn
 
 _HIST_SAMPLE_CAP = 8192  # per-histogram retained samples (sliding ring)
 _WIN_BUCKET_CAP = 600    # rolling-window 1 s counter buckets (10 min cap)
@@ -242,15 +257,26 @@ class TelemetryRegistry:
 
     def emit(self, kind: str, name: str, value=None,
              attrs: Optional[Dict[str, Any]] = None):
-        """Append one schema record to the sink (no-op when disabled).
-        Lines are buffered and batch-written (see module docstring); any
-        serialisation/write failure is counted, never raised."""
+        """Append one schema record to the sink (no-op when disabled)
+        and to the always-on flight-recorder ring (core/incidents.py)
+        when one is installed — the ring sees every record even when no
+        JSONL sink is configured. Lines are buffered and batch-written
+        (see module docstring); any serialisation/write failure is
+        counted, never raised."""
+        bb = _blackbox[0]
         with self._lock:
             f = self._sink()
-            if f is None:
+            if f is None and bb is None:
                 return
             rec = {"ts": time.time(), "kind": kind, "name": name,
                    "value": value, "attrs": attrs or {}}
+            if bb is not None:
+                try:
+                    bb(rec)
+                except Exception:
+                    pass
+            if f is None:
+                return
             try:
                 self._buf.append(json.dumps(rec, default=str))
             except (ValueError, TypeError):
@@ -548,6 +574,14 @@ class MetricsServer:
         global _metrics_servers
         with _metrics_servers_lock:
             _metrics_servers += 1
+        # a scrape surface marks the run as instrumented: arm the SLO
+        # watchdog plane (core/incidents.py, FLAGS_slo_watchdog 'auto')
+        try:
+            from . import incidents
+
+            incidents.arm()
+        except Exception:
+            pass
 
     @property
     def host(self) -> str:
@@ -568,6 +602,12 @@ class MetricsServer:
         global _metrics_servers
         with _metrics_servers_lock:
             _metrics_servers = max(0, _metrics_servers - 1)
+        try:
+            from . import incidents
+
+            incidents.disarm()
+        except Exception:
+            pass
 
 
 # -- module-level convenience API (the surface everything instruments

@@ -86,6 +86,42 @@ _ctx: "contextvars.ContextVar[Optional[SpanContext]]" = \
     contextvars.ContextVar("pt_trace_ctx", default=None)
 _rng = random.Random()   # urandom-seeded; ids need uniqueness, not secrecy
 
+# recently-active traces — (finish ts, trace_id) per finished span, so
+# the incident pipeline (core/incidents.py) can name the trace ids that
+# were live around a trip point and tools/incident_report.py can pull
+# their spans out of the flight-recorder ring. Plain lock + bounded
+# deque: a few ns per finished SAMPLED span, nothing when tracing is off.
+_recent_lock = threading.Lock()
+_recent_traces: "deque" = None  # type: ignore[assignment]
+
+
+def _note_trace(trace_id: str):
+    global _recent_traces
+    with _recent_lock:
+        if _recent_traces is None:
+            from collections import deque
+
+            _recent_traces = deque(maxlen=256)
+        _recent_traces.append((time.time(), trace_id))
+
+
+def recent_trace_ids(window_s: float = 120.0,
+                     now: Optional[float] = None) -> list:
+    """Unique trace ids whose spans finished within the last
+    ``window_s`` seconds, newest first — the "active traces" an
+    incident dump correlates its ring spans against."""
+    if now is None:
+        now = time.time()
+    cut = now - max(window_s, 0.0)
+    with _recent_lock:
+        items = list(_recent_traces) if _recent_traces is not None else []
+    out, seen = [], set()
+    for ts, tid in reversed(items):
+        if ts >= cut and tid not in seen:
+            seen.add(tid)
+            out.append(tid)
+    return out
+
 
 def _new_id() -> str:
     return f"{_rng.getrandbits(64):016x}"
@@ -150,6 +186,7 @@ class _Span:
         if et is not None:
             attrs["error"] = et.__name__
         telemetry.counter_quiet("trace.spans")
+        _note_trace(self.ctx.trace_id)
         telemetry.event("span", self.name, round(dur_ms, 4), attrs)
         return False
 
@@ -245,6 +282,7 @@ def record(name: str, parent: Optional[SpanContext],
     if attrs:
         rec_attrs.update(attrs)
     telemetry.counter_quiet("trace.spans")
+    _note_trace(ctx.trace_id)
     telemetry.event("span", name, round((end_s - start_s) * 1e3, 4),
                     rec_attrs)
     return ctx

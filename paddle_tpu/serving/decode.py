@@ -66,7 +66,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from ..core import costmodel, faults, telemetry
+from ..core import costmodel, faults, incidents, telemetry
 from ..core.flags import flag as _flag
 from ..models.decoder_lm import (DecoderLMConfig, build_prefill_program,
                                  build_step_program, decoder_lm_params,
@@ -460,6 +460,9 @@ class DecodeEngine:
                     self._retire(req, error=err)
                 self._active = []
             telemetry.gauge_set("decode.active_slots", len(self._active))
+            # SLO watchdog hook (core/incidents.py): queue saturation /
+            # step-time regression rules evaluate on the step cadence
+            incidents.tick()
 
     def _admit(self):
         """Seat queued requests into free slots at the step boundary.

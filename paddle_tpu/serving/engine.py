@@ -33,7 +33,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from ..core import costmodel, faults, telemetry, trace
+from ..core import costmodel, faults, incidents, telemetry, trace
 from ..core.analysis import lockdep
 from ..core.flags import flag as _flag
 from .admission import (AdmissionQueue, EngineClosedError, InferenceRequest,
@@ -347,6 +347,9 @@ class ServingEngine:
                                           self.config.batch_timeout_ms)
             if taken is None:
                 return
+            # SLO watchdog hook (core/incidents.py): armed replicas
+            # evaluate the rule set on the batch cadence
+            incidents.tick()
             _sig, batch = taken
             if not batch:
                 continue

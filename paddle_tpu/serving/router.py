@@ -46,7 +46,7 @@ import time
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
-from ..core import faults, retry, telemetry, trace
+from ..core import faults, incidents, retry, telemetry, trace
 from ..core.analysis import lockdep
 from ..core.flags import flag as _flag
 from .admission import ServingError
@@ -220,9 +220,16 @@ class Router:
                 if self._stop.is_set():
                     return
                 self.probe(handle)
+            # SLO watchdog hook (core/incidents.py): failover-burst /
+            # queue-saturation rules evaluate on the probe cadence
+            incidents.tick()
 
     def start(self) -> "Router":
         if self._probe_thread is None:
+            # the router is the cluster's always-on vantage point: arm
+            # the SLO watchdog (failover bursts, saturation) — the probe
+            # loop drives evaluation via incidents.tick()
+            incidents.arm()
             self._probe_thread = threading.Thread(
                 target=self._probe_loop, name="pt-router-probe", daemon=True)
             self._probe_thread.start()
@@ -233,6 +240,7 @@ class Router:
         if self._probe_thread is not None:
             self._probe_thread.join(timeout=5)
             self._probe_thread = None
+        incidents.disarm()
 
     # -- balancing -----------------------------------------------------------
     def pick(self, exclude=()) -> Optional[ReplicaHandle]:

@@ -71,7 +71,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
-from ..core import telemetry, trace
+from ..core import incidents, telemetry, trace
 from .admission import (DeadlineExceededError, EngineClosedError,
                         KVCacheExhaustedError, ServerOverloadedError)
 from .engine import ServingConfig, ServingEngine
@@ -138,6 +138,9 @@ class _Handler(BaseHTTPRequestHandler):
             if self.server.decode_engine is not None:
                 # the generative plane's counters + KV-cache/pool ledger
                 stats["decode"] = self.server.decode_engine.stats()
+            # SLO watchdog firing states + incident totals — the plane's
+            # "health" verdict next to the raw counters (core/incidents)
+            stats["health"] = incidents.health()
             self._reply(200, stats)
         elif self.path == "/metrics":
             body = telemetry.prometheus_text().encode()
@@ -325,6 +328,10 @@ class ServingHTTPServer:
 
     def start(self) -> "ServingHTTPServer":
         if self._thread is None:
+            # a serving surface is the canonical always-on process: arm
+            # the SLO watchdog (FLAGS_slo_watchdog 'auto'); the engine
+            # loops drive evaluation via incidents.tick()
+            incidents.arm()
             self._thread = threading.Thread(
                 target=self._httpd.serve_forever,
                 name="pt-serving-http", daemon=True)
@@ -337,6 +344,7 @@ class ServingHTTPServer:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        incidents.disarm()
 
 
 def serve(model_dir: str, host: str = "127.0.0.1", port: int = 0,
@@ -348,6 +356,9 @@ def serve(model_dir: str, host: str = "127.0.0.1", port: int = 0,
     predictor = create_predictor(AnalysisConfig(model_dir))
     engine = ServingEngine(predictor, config=config)
     engine.start(warmup=warmup)
+    # production entry: the pt-incidents-watchdog thread keeps the SLO
+    # rules evaluating even while the replica is idle
+    incidents.start_watchdog()
     return ServingHTTPServer(engine, host=host, port=port).start()
 
 
@@ -359,5 +370,6 @@ def serve_decode(model_dir: str, host: str = "127.0.0.1", port: int = 0,
 
     de = decode_engine_from_dir(model_dir, config=config)
     de.start(warmup=warmup)
+    incidents.start_watchdog()
     return ServingHTTPServer(None, host=host, port=port,
                              decode_engine=de).start()

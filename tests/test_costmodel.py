@@ -483,7 +483,8 @@ def _emitted_metric_names():
                 for m in _EMIT_RE.finditer(src):
                     name = m.group(1).split("{", 1)[0]
                     if name.startswith(("cost.", "mem.", "costmodel.",
-                                        "pallas.")) or \
+                                        "pallas.", "incidents.",
+                                        "slo.")) or \
                             (name.startswith("sharding.")
                              and "state_bytes" in name):
                         names.add(name)
@@ -493,8 +494,9 @@ def _emitted_metric_names():
 class TestMetricDriftGuard:
     def test_every_cost_mem_metric_is_rendered(self):
         """No silently-orphaned telemetry: every cost.*/mem.*/
-        costmodel.*/sharding.*state_bytes* metric the code emits must be
-        referenced by perf_report.py or mem_report.py."""
+        costmodel.*/pallas.*/incidents.*/slo.*/sharding.*state_bytes*
+        metric the code emits must be referenced by perf_report.py or
+        mem_report.py."""
         names = _emitted_metric_names()
         # the plane exists: the guard must be looking at real names
         assert "cost.captures" in names
@@ -507,6 +509,11 @@ class TestMetricDriftGuard:
         assert "pallas.paged_attn_dispatches" in names
         assert "pallas.int8_gemm_fallbacks" in names
         assert "pallas.paged_attn_fallbacks" in names
+        # the incident pipeline + SLO watchdog (core/incidents.py)
+        assert "incidents.reported" in names
+        assert "incidents.rate_limited" in names
+        assert "slo.trips" in names
+        assert "slo.evaluations" in names
         renderers = ""
         for tool in ("perf_report.py", "mem_report.py"):
             with open(os.path.join(REPO_ROOT, "tools", tool)) as f:

@@ -323,6 +323,16 @@ def finalize_bench_result(out):
                     "sharding.optimizer_state_bytes_per_device"):
             if g.get(key) is not None:
                 ex[key.replace(".", "_")] = int(g[key])
+    # offline SLO gate (tools/slo_check.py): judge this row against the
+    # committed BENCH_r*/MULTICHIP_r* history so every fresh row is
+    # self-judging — a regression shows up in the row itself, not only
+    # when someone reruns the gate (never fatal to the bench run)
+    try:
+        from tools.slo_check import embed_verdict
+
+        ex["slo"] = embed_verdict(out)
+    except Exception:
+        pass
     attrs = {k: ex[k] for k in ("ms_per_step", "mfu", "batch", "seq_len",
                                 "steps_per_dispatch")
              if k in ex}

@@ -44,6 +44,11 @@ high-water mark and a mid-load /metrics scrape of the live token rate
 sequential one-request-at-a-time decode — the row aborts on any
 divergence.
 
+Every row goes through ``finalize_bench_result`` and so embeds
+``extra.slo`` — the tools/slo_check.py verdict of this run against the
+committed BENCH history (pass / regress / no_baseline), making serving
+rows self-judging the same way the training rows are.
+
 Examples:
     python tools/bench_serving.py                     # full closed-loop
     python tools/bench_serving.py --smoke             # seconds, CI row

@@ -36,6 +36,20 @@ accounting returns to baseline — zero pages leaked across fault-killed
 generations — and that the engine still generates cleanly once the
 spec is cleared.
 
+With ``--slo`` it gates the flight-recorder + SLO watchdog plane
+(paddle_tpu/core/incidents.py) in both directions: one leg per fault
+class drives that subsystem's failure signature through the real
+telemetry registry into the real rule engine (step-time p99 regression,
+live-MFU drop, serving/decode queue saturation, pallas fallback spike,
+router failover burst, ckpt verify failure) and asserts the MATCHING
+watchdog rule trips EXACTLY once under a sustained breach (the firing
+latch + cooldown pin the rate limit) with exactly one kind:"incident"
+dump that tools/incident_report.py renders with timeline + counter
+deltas; and the clean leg runs a real fault-free training loop with
+every clean signature and asserts ZERO rules trip — the false-positive
+gate. (The emit side of each subsystem is chaos-gated by the other
+legs; --slo gates the consume side.)
+
 With ``--cluster`` it chaos-tests the whole serving control plane
 (paddle_tpu/serving/cluster.py): N real replica processes behind the
 router, concurrent closed-loop clients with unique request ids, the
@@ -557,6 +571,211 @@ def run_checkpoint(args) -> int:
     return 0
 
 
+def _slo_fault_classes():
+    """fault class -> (expected rule, clean driver, fault driver). Each
+    driver pushes that subsystem's signature through the REAL telemetry
+    registry — the same counters/gauges/timers the subsystems emit — so
+    the run exercises the real windowing, baseline learning, rule and
+    incident machinery end to end."""
+    from paddle_tpu.core import telemetry
+    from paddle_tpu.core.flags import flag as _flag
+
+    def steps_clean():
+        for _ in range(25):
+            telemetry.observe("executor.run_ms", 5.0, kind="timer")
+
+    def steps_fault():
+        for _ in range(25):
+            telemetry.observe("executor.run_ms", 60.0, kind="timer")
+
+    def mfu_clean():
+        telemetry.gauge_set("cost.live_mfu", 0.5)
+
+    def mfu_fault():
+        telemetry.gauge_set("cost.live_mfu", 0.05)
+
+    def q_serving():
+        telemetry.gauge_set(
+            "serving.queue_depth",
+            int(0.95 * _flag("serving_max_queue_depth")))
+
+    def q_decode():
+        telemetry.gauge_set(
+            "decode.queue_depth",
+            int(0.95 * _flag("decode_max_queue_depth")))
+
+    def counters(name, n):
+        def drive():
+            telemetry.counter_add(name, n)
+        return drive
+
+    return {
+        "step_time": ("step_time_p99", steps_clean, steps_fault),
+        "mfu_drop": ("live_mfu_drop", mfu_clean, mfu_fault),
+        "serving_queue": ("serving_queue_saturation", None, q_serving),
+        "decode_queue": ("decode_queue_saturation", None, q_decode),
+        "pallas_gemm": ("pallas_gemm_fallback_spike", None,
+                        counters("pallas.int8_gemm_fallbacks", 5)),
+        "pallas_attn": ("pallas_attn_fallback_spike", None,
+                        counters("pallas.paged_attn_fallbacks", 5)),
+        "router_failover": ("router_failover_burst", None,
+                            counters("router.failovers", 5)),
+        "ckpt_verify": ("ckpt_verify_failures", None,
+                        counters("ckpt.verify_failures", 1)),
+    }
+
+
+def _slo_warmup(wd, classes, t0):
+    """Drive every clean signature and run enough evaluations for all
+    ratio rules to learn their baselines; returns trips seen (must be
+    none)."""
+    trips = []
+    for _name, (_rule, clean, _fault) in classes.items():
+        if clean is not None:
+            clean()
+    for i in range(7):
+        trips += wd.evaluate(now=t0 + i * 0.01)
+    return trips
+
+
+def run_slo(args) -> int:
+    """--slo mode: per-fault-class true-positive legs (matching rule
+    trips exactly once, one incident dump, postmortem renders) + the
+    clean false-positive leg (a real fault-free training loop + all
+    clean signatures, zero trips)."""
+    import glob as _glob
+    import io
+    import json as _json
+    import tempfile
+    import time
+
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.core import incidents, telemetry
+    from tools.incident_report import (load_incidents, render_incident,
+                                       summarize_incident)
+    from tools.perf_report import load_counted
+
+    classes = _slo_fault_classes()
+    only = [c for c in (args.slo_class or "").split(",") if c]
+    for c in only:
+        if c not in classes and c != "clean":
+            print(f"SLO FAIL: unknown fault class {c!r} "
+                  f"(have {sorted(classes)} + 'clean')")
+            return 2
+    run_classes = only or (list(classes) + ["clean"])
+    tmpdir = tempfile.mkdtemp(prefix="pt_chaos_slo_")
+    failures = []
+
+    for cls in run_classes:
+        log = os.path.join(tmpdir, f"slo_{cls}.jsonl")
+        telemetry.configure(None)
+        telemetry.reset()
+        incidents.reset()
+        telemetry.configure(log)
+        wd = incidents.arm()
+        t0 = time.time()
+        if cls != "clean":
+            warm_trips = _slo_warmup(wd, classes, t0)
+            if warm_trips:
+                failures.append(f"{cls}: warmup tripped {warm_trips}")
+                continue
+
+        if cls == "clean":
+            # the false-positive gate: a REAL fault-free training loop
+            # (the same net the PS chaos leg trains) with the live
+            # signals it actually produces — run_ms timers, the real
+            # (tiny, CPU) live-MFU gauge — evaluated many times; zero
+            # rules may trip. No synthetic signatures here: mixing them
+            # with real signals would poison the learned baselines
+            main, startup, loss = build_net(0.1)
+            exe = pt.Executor(pt.CPUPlace())
+            scope = pt.Scope()
+            exe.run(startup, scope=scope, use_compiled=False)
+            feed = {"x": np.random.RandomState(3000).randn(16, 16)
+                    .astype(np.float32)}
+            trips = []
+            for step in range(args.steps):
+                exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+                trips += wd.evaluate()
+            for i in range(20):
+                trips += wd.evaluate(now=t0 + 1 + i * 0.01)
+            telemetry.flush_sink()
+            recs, _m = load_counted(log)
+            incident_recs = load_incidents(recs)
+            if trips or incident_recs:
+                failures.append(f"clean: FALSE POSITIVE — trips {trips}, "
+                                f"{len(incident_recs)} incident dumps")
+                continue
+            print(f"SLO leg clean: {args.steps} real fault-free steps, "
+                  f"0 trips, 0 incidents (ok)")
+            continue
+
+        rule_name, _clean, fault = classes[cls]
+        fault()
+        trips = []
+        # sustained breach across many evaluations: the firing latch +
+        # cooldown must pin the trip (and the incident dump) to ONE
+        for i in range(10):
+            trips += wd.evaluate(now=t0 + 1 + i * 0.01)
+        telemetry.flush_sink()
+        recs, _m = load_counted(log)
+        incident_recs = load_incidents(recs)
+        if trips != [rule_name]:
+            failures.append(f"{cls}: expected exactly one "
+                            f"{rule_name!r} trip, got {trips}")
+            continue
+        if len(incident_recs) != 1:
+            failures.append(f"{cls}: {len(incident_recs)} incident "
+                            f"dumps (want exactly 1)")
+            continue
+        s = summarize_incident(incident_recs[0])
+        if s["source"] != "slo" or (s["rule"] or {}).get("name") \
+                != rule_name:
+            failures.append(f"{cls}: incident names rule "
+                            f"{(s['rule'] or {}).get('name')!r}, "
+                            f"want {rule_name!r}")
+            continue
+        buf = io.StringIO()
+        render_incident(s, out=buf)
+        text = buf.getvalue()
+        missing = [sec for sec in ("-- tripped rule --",
+                                   "-- counter deltas",
+                                   "-- timeline around the trip")
+                   if sec not in text]
+        if missing:
+            failures.append(f"{cls}: postmortem missing {missing}")
+            continue
+        print(f"SLO leg {cls}: rule {rule_name} tripped exactly once "
+              f"over 10 breached evaluations, 1 incident dump "
+              f"({s['ring_records']} ring records), postmortem ok")
+
+    telemetry.configure(None)
+    c = telemetry.counters()
+    print("-- slo chaos tally " + "-" * 30)
+    for key in ("slo.trips", "slo.evaluations", "incidents.reported",
+                "incidents.rate_limited"):
+        print(f"{key:28s} {int(c.get(key, 0))}")
+    for f in _glob.glob(os.path.join(tmpdir, "*.jsonl")):
+        try:
+            os.remove(f)
+        except OSError:
+            pass
+    try:
+        os.rmdir(tmpdir)
+    except OSError:
+        pass
+    if failures:
+        for f in failures:
+            print(f"SLO FAIL: {f}")
+        return 2
+    print(f"CHAOS OK: {len(run_classes)} SLO legs — every fault class "
+          f"tripped its matching watchdog rule exactly once, the clean "
+          f"leg tripped zero")
+    return 0
+
+
 def run_cluster(args) -> int:
     """--cluster mode: the full control-plane gate. Replica processes
     behind the router, faults armed on both sides of the hop, one
@@ -781,6 +1000,18 @@ def main():
                          "protocol (ckpt.save.write/commit + "
                          "ckpt.restore.read sites) with an elastic "
                          "kill/restart instead of the PS loop")
+    ap.add_argument("--slo", action="store_true",
+                    help="gate the flight-recorder + SLO watchdog plane "
+                         "(core/incidents.py): per-fault-class legs "
+                         "must trip the matching rule exactly once with "
+                         "one incident dump; the clean leg must trip "
+                         "zero (false-positive gate)")
+    ap.add_argument("--slo-class", default="",
+                    help="--slo mode: comma-separated fault classes to "
+                         "run (default: all + clean); classes: "
+                         "step_time, mfu_drop, serving_queue, "
+                         "decode_queue, pallas_gemm, pallas_attn, "
+                         "router_failover, ckpt_verify, clean")
     ap.add_argument("--cluster", action="store_true",
                     help="chaos-test the cluster serving control plane "
                          "(replica processes + router): SIGKILL a "
@@ -815,6 +1046,8 @@ def main():
     if args.cluster and args.requests == 24:
         args.requests = 400   # the serving default is too short to span
         # a kill + a rolling swap; --requests still overrides
+    if args.slo:
+        sys.exit(run_slo(args))
     if args.serving:
         sys.exit(run_serving(args))
     if args.decode:

@@ -1,0 +1,268 @@
+#!/usr/bin/env python
+"""incident_report — render kind:"incident" dumps into postmortems.
+
+The consume-side twin of tools/perf_report.py and tools/mem_report.py:
+reads the unified incident records the flight-recorder + SLO watchdog
+plane (paddle_tpu/core/incidents.py) writes into the JSONL run log —
+one per tripped watchdog rule / OOM / lock stall / thread death — and
+renders each into the report an operator wants at 3 a.m.:
+
+* **what tripped**: the rule context (metric, window, learned baseline,
+  threshold, measured value) or the legacy forensic context (OOM
+  where/program/error, stall lock/thread, thread-death traceback head);
+* **timeline around the trip point**: the bundled flight-recorder ring
+  — the last seconds of telemetry records, spans and events leading up
+  to the trip, printed with offsets relative to the trip;
+* **counter deltas**: per-counter movement across the ring window (the
+  first vs last cumulative value inside the ring), largest movers
+  first — what was accelerating when it tripped;
+* **correlated spans**: ring spans whose trace id is in the incident's
+  recently-active trace set — the requests that were in flight;
+* **ledger snapshot**: the HBM ledger at the trip.
+
+Stdlib-only on purpose, like perf_report: a run log from a TPU worker
+renders on any machine, no jax/framework import.
+
+Usage:
+    python tools/incident_report.py run.jsonl              # all incidents
+    python tools/incident_report.py run.jsonl --list       # index table
+    python tools/incident_report.py run.jsonl --index 0    # one incident
+    python tools/incident_report.py run.jsonl --json       # machine-readable
+
+Exit status: 0 on success, 2 when the log carries no incident records
+(or --index is out of range).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+try:
+    from tools.perf_report import load_counted
+except ImportError:       # run as `python tools/incident_report.py`
+    from perf_report import load_counted
+
+
+def load_incidents(recs):
+    """The kind:"incident" records of a run log, in log order."""
+    return [r for r in recs if r.get("kind") == "incident"]
+
+
+def counter_deltas(ring):
+    """Per-counter movement across the ring window: {name: (first_val,
+    last_val, delta)} from the cumulative values counter records carry."""
+    first, last = {}, {}
+    for r in ring:
+        if r.get("kind") != "counter":
+            continue
+        name, v = r.get("name"), r.get("value")
+        if not isinstance(v, (int, float)):
+            continue
+        first.setdefault(name, v)
+        last[name] = v
+    out = {}
+    for name, v0 in first.items():
+        v1 = last[name]
+        out[name] = (v0, v1, v1 - v0)
+    return out
+
+
+def correlated_spans(ring, traces):
+    """Ring spans whose trace id is in the incident's recently-active
+    trace set — the requests/steps that were in flight at the trip."""
+    traces = set(traces or ())
+    out = []
+    for r in ring:
+        if r.get("kind") != "span":
+            continue
+        attrs = r.get("attrs") or {}
+        if attrs.get("trace") in traces:
+            out.append({"name": r.get("name"), "dur_ms": r.get("value"),
+                        "trace": attrs.get("trace"),
+                        "span": attrs.get("span"),
+                        "ts": r.get("ts")})
+    return out
+
+
+def summarize_incident(rec):
+    """One incident record -> the postmortem summary dict."""
+    attrs = rec.get("attrs") or {}
+    ring = attrs.get("ring") or []
+    trip_ts = attrs.get("trip_ts") or rec.get("ts") or 0.0
+    deltas = counter_deltas(ring)
+    movers = sorted(deltas.items(), key=lambda kv: -abs(kv[1][2]))
+    return {
+        "id": attrs.get("id"),
+        "name": rec.get("name"),
+        "source": attrs.get("source"),
+        "value": rec.get("value"),
+        "trip_ts": trip_ts,
+        "rule": attrs.get("rule"),
+        "context": attrs.get("context") or {},
+        "ledger": attrs.get("ledger"),
+        "traces": attrs.get("traces") or [],
+        "ring_records": len(ring),
+        "ring_dropped": attrs.get("ring_dropped", 0),
+        "counter_deltas": {n: {"first": v0, "last": v1, "delta": d}
+                           for n, (v0, v1, d) in movers},
+        "spans": correlated_spans(ring, attrs.get("traces")),
+        "ring": ring,
+    }
+
+
+def _fmt_bytes(n):
+    try:
+        n = float(n)
+    except (TypeError, ValueError):
+        return str(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:,.1f} {unit}" if unit != "B" else f"{int(n):,} B"
+        n /= 1024
+    return f"{n:,.1f} TiB"
+
+
+def render_incident(s, out=sys.stdout, timeline=40):
+    w = out.write
+    w(f"\n== incident {s['id'] or '?'}: {s['name']} "
+      f"(source: {s['source']}) ==\n")
+
+    rule = s.get("rule")
+    if rule:
+        w("-- tripped rule --\n")
+        w(f"{rule.get('name')}: {rule.get('metric')} "
+          f"[{rule.get('kind')}/{rule.get('stat')}] over "
+          f"{rule.get('window_s')}s window\n")
+        thr = rule.get("threshold")
+        if rule.get("ratio") is not None:
+            w(f"baseline {rule.get('baseline')} x ratio "
+              f"{rule.get('ratio')} ({rule.get('direction')}), measured "
+              f"{rule.get('value')}\n")
+        else:
+            w(f"threshold {thr} ({rule.get('direction')}), measured "
+              f"{rule.get('value')}\n")
+        w(f"trips so far: {rule.get('trips')}  cooldown: "
+          f"{rule.get('cooldown_s')}s\n")
+
+    ctx = s.get("context") or {}
+    if ctx:
+        w("-- context --\n")
+        if s["source"] == "oom":
+            w(f"where: {ctx.get('where')}  program: {ctx.get('program')}\n")
+            w(f"error: {str(ctx.get('error'))[:160]}\n")
+            for t in (ctx.get("top_programs") or [])[:5]:
+                w(f"  top program {t.get('program')}: peak "
+                  f"{_fmt_bytes(t.get('peak_bytes'))}\n")
+        elif s["source"] == "stall":
+            w(f"lock: {ctx.get('lock')}  thread: {ctx.get('thread')}  "
+              f"waited {ctx.get('waited_s')}s "
+              f"(threshold {ctx.get('stall_s')}s)\n")
+            w(f"thread stacks captured: {len(ctx.get('threads') or [])}\n")
+        elif s["source"] == "thread_error":
+            w(f"thread died: {ctx.get('exc')}: "
+              f"{str(ctx.get('message'))[:160]}\n")
+        else:
+            for k, v in sorted(ctx.items()):
+                w(f"{k}: {str(v)[:160]}\n")
+
+    led = s.get("ledger")
+    if led:
+        w("-- HBM ledger at trip --\n")
+        w(f"params {_fmt_bytes(led.get('param_bytes', 0))}  opt state "
+          f"{_fmt_bytes(led.get('opt_state_bytes', 0))}  scratch "
+          f"{_fmt_bytes(led.get('peak_temp_bytes', 0))}  total "
+          f"{_fmt_bytes(led.get('total_bytes', 0))}\n")
+        if led.get("serving_kv_pool_bytes"):
+            w(f"KV page pool {_fmt_bytes(led['serving_kv_pool_bytes'])} "
+              f"(in use "
+              f"{_fmt_bytes(led.get('serving_kv_used_bytes', 0))})\n")
+
+    deltas = s.get("counter_deltas") or {}
+    if deltas:
+        w(f"-- counter deltas over the ring window "
+          f"({s['ring_records']} records) --\n")
+        shown = 0
+        for name, d in deltas.items():
+            if not d["delta"] and shown >= 5:
+                continue
+            w(f"{name[:40]:<42}{d['first']:>12} -> {d['last']:>12}  "
+              f"(+{d['delta']})\n")
+            shown += 1
+            if shown >= 20:
+                break
+
+    spans = s.get("spans") or []
+    if spans:
+        w(f"-- correlated spans ({len(s['traces'])} active trace(s)) --\n")
+        for sp in spans[-15:]:
+            off = (sp.get("ts") or 0) - s["trip_ts"]
+            w(f"  {str(sp['name'])[:36]:<38}{sp.get('dur_ms') or 0:>10} ms"
+              f"  t{off:+8.2f}s  trace {sp.get('trace')}\n")
+
+    ring = s.get("ring") or []
+    if ring:
+        w(f"-- timeline around the trip (last {min(timeline, len(ring))} "
+          f"of {s['ring_records']} ring records"
+          + (f", {s['ring_dropped']} older dropped" if s["ring_dropped"]
+             else "") + ") --\n")
+        for r in ring[-timeline:]:
+            off = (r.get("ts") or 0) - s["trip_ts"]
+            v = r.get("value")
+            w(f"  t{off:+8.2f}s  {str(r.get('kind'))[:9]:<10}"
+              f"{str(r.get('name'))[:38]:<40}"
+              f"{v if isinstance(v, (int, float)) else '':>12}\n")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="render kind:'incident' dumps from a paddle_tpu "
+                    "JSONL run log into postmortems")
+    ap.add_argument("log", help="path to the JSONL run log")
+    ap.add_argument("--index", type=int, default=None,
+                    help="render only the Nth incident (0-based)")
+    ap.add_argument("--list", action="store_true",
+                    help="print the incident index table and exit")
+    ap.add_argument("--timeline", type=int, default=40,
+                    help="ring records shown in the timeline section")
+    ap.add_argument("--json", action="store_true",
+                    help="print the summaries as JSON")
+    args = ap.parse_args(argv)
+
+    recs, malformed = load_counted(args.log)
+    incidents = load_incidents(recs)
+    if not incidents:
+        print(f"incident_report: no incident records in {args.log} "
+              f"({len(recs)} records) — nothing tripped, or the run was "
+              f"not instrumented", file=sys.stderr)
+        return 2
+    if args.index is not None:
+        if not 0 <= args.index < len(incidents):
+            print(f"incident_report: --index {args.index} out of range "
+                  f"(0..{len(incidents) - 1})", file=sys.stderr)
+            return 2
+        incidents = [incidents[args.index]]
+
+    summaries = [summarize_incident(r) for r in incidents]
+    if args.list:
+        for i, s in enumerate(summaries):
+            print(f"{i:>3}  {s['id'] or '?':<20} {s['source']:<13} "
+                  f"{s['name']:<30} ring {s['ring_records']:>4}")
+        return 0
+    if args.json:
+        slim = [{k: v for k, v in s.items() if k != "ring"}
+                for s in summaries]
+        print(json.dumps(slim, indent=2, default=str))
+        return 0
+    print(f"== incident report: {len(summaries)} incident(s) in "
+          f"{len(recs)} records =="
+          + (f" ({malformed} malformed line(s) skipped)" if malformed
+             else ""))
+    for s in summaries:
+        render_incident(s, timeline=args.timeline)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,6 +48,11 @@ Renders the structured run log written by ``paddle_tpu.core.telemetry``
   counts, lock-order violations, stall dumps (kind:"stall" all-thread
   stack records from the deadlock watchdog), uncaught worker-thread
   exceptions, and per-lock held/wait-ms percentiles;
+* an "Incidents & SLO" section when the run armed the flight-recorder /
+  SLO watchdog plane (core/incidents.py): rule trip counts and firing
+  states (``slo.<rule>_firing``), incident dumps landed vs rate-limited,
+  and a per-incident index — the full postmortems (timeline, counter
+  deltas, correlated spans) render with tools/incident_report.py;
 * a "Tracing" section when the run emitted distributed-tracing spans
   (core/trace.py, FLAGS_trace_sample_rate): trace/span counts and
   per-span-name duration percentiles — merge multi-process logs with
@@ -126,6 +131,7 @@ def summarize_log(recs, malformed=0):
     oom_events = 0
     stall_events = []
     thread_errors = []
+    incident_events = []
     spans = defaultdict(list)
     span_traces = set()
     snapshot = None
@@ -178,6 +184,12 @@ def summarize_log(recs, malformed=0):
         elif kind == "thread_error":
             thread_errors.append({"thread": name,
                                   "exc": attrs.get("exc")})
+        elif kind == "incident":
+            incident_events.append({
+                "name": name, "ts": r.get("ts"),
+                "id": attrs.get("id"), "source": attrs.get("source"),
+                "rule": (attrs.get("rule") or {}).get("name"),
+                "ring_records": len(attrs.get("ring") or [])})
         elif kind == "snapshot":
             snapshot = attrs
     # a final snapshot is authoritative for cumulative counter values
@@ -215,6 +227,8 @@ def summarize_log(recs, malformed=0):
     concurrency = _concurrency_summary(counter_delta, counter_last,
                                        timer_summary, stall_events,
                                        thread_errors)
+    incidents = _incidents_summary(counter_delta, counter_last, gauges,
+                                   incident_events)
     tracing = None
     if spans:
         by_name = {}
@@ -237,6 +251,7 @@ def summarize_log(recs, malformed=0):
         "verifier": verifier,
         "memcost": memcost,
         "concurrency": concurrency,
+        "incidents": incidents,
         "tracing": tracing,
         "malformed_lines": int(malformed),
         "records": len(recs),
@@ -626,6 +641,45 @@ def _concurrency_summary(counter_delta, counter_last, timer_summary,
     return out
 
 
+def _incidents_summary(counter_delta, counter_last, gauges,
+                       incident_events):
+    """Flight recorder + SLO watchdog accounting (core/incidents.py):
+    how many watchdog rules tripped, how many incident dumps landed vs
+    were rate-limited, which rules are still firing (slo.<rule>_firing
+    gauges), and the per-incident index — render the full postmortems
+    with tools/incident_report.py."""
+
+    def cval(name):
+        v = counter_delta.get(name) or counter_last.get(name) or 0
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            return 0.0
+
+    reported = cval("incidents.reported")
+    rate_limited = cval("incidents.rate_limited")
+    trips = cval("slo.trips")
+    evaluations = cval("slo.evaluations")
+    firing = {n[len("slo."):-len("_firing")]: v
+              for n, v in gauges.items()
+              if n.startswith("slo.") and n.endswith("_firing")}
+    if not (reported or rate_limited or trips or evaluations
+            or incident_events or firing):
+        return None
+    out = {"reported": int(reported),
+           "rate_limited": int(rate_limited),
+           "slo_trips": int(trips),
+           "slo_evaluations": int(evaluations),
+           "eval_errors": int(cval("slo.eval_errors")),
+           "incidents": incident_events[:20]}
+    if firing:
+        out["rules_firing"] = {n: int(v or 0) for n, v in
+                               sorted(firing.items())}
+    if incident_events:
+        out["last"] = incident_events[-1]
+    return out
+
+
 def _fmt_num(v):
     if isinstance(v, float):
         return f"{v:,.3f}".rstrip("0").rstrip(".")
@@ -869,6 +923,30 @@ def render(s, out=sys.stdout):
         for ev in cc.get("thread_errors", []):
             w(f"THREAD DIED: '{ev['thread']}' uncaught "
               f"{ev['exc']}\n")
+
+    if s.get("incidents"):
+        ic = s["incidents"]
+        w("\n-- incidents & SLO (flight recorder + watchdog) --\n")
+        w(f"incident dumps: {ic['reported']}  rate-limited: "
+          f"{ic['rate_limited']}  slo rule trips: {ic['slo_trips']}  "
+          f"evaluations: {ic['slo_evaluations']}")
+        if ic.get("eval_errors"):
+            w(f"  eval errors: {ic['eval_errors']}")
+        w("\n")
+        if ic.get("rules_firing"):
+            still = [n for n, v in ic["rules_firing"].items() if v]
+            w(f"rule firing states: "
+              + "  ".join(f"{n}={'FIRING' if v else 'ok'}"
+                          for n, v in ic["rules_firing"].items())
+              + "\n")
+            if still:
+                w(f"STILL FIRING at end of log: {', '.join(still)}\n")
+        for ev in ic.get("incidents", []):
+            w(f"INCIDENT {ev.get('id') or '?'}: {ev['name']} "
+              f"(source {ev['source']}"
+              + (f", rule {ev['rule']}" if ev.get("rule") else "")
+              + f", {ev['ring_records']} ring records — "
+                f"tools/incident_report.py)\n")
 
     if s.get("tracing"):
         tr = s["tracing"]

@@ -85,10 +85,51 @@ def load_spans(paths):
     return spans, malformed, total
 
 
-def chrome_trace(spans, paths):
+def load_incidents(paths):
+    """kind:"incident" records (core/incidents.py flight-recorder dumps)
+    from each log, tagged with their source file index — rendered as
+    instant-event markers so a trip point is visible inside the trace
+    timeline."""
+    incidents = []
+    for idx, path in enumerate(paths):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if not isinstance(rec, dict) or \
+                        rec.get("kind") != "incident":
+                    continue
+                attrs = rec.get("attrs") or {}
+                try:
+                    ts = float(attrs.get("trip_ts") or rec.get("ts"))
+                except (TypeError, ValueError):
+                    continue
+                incidents.append({
+                    "name": str(rec.get("name")),
+                    "ts": ts,
+                    "source": attrs.get("source"),
+                    "id": attrs.get("id"),
+                    "rule": (attrs.get("rule") or {}).get("name"),
+                    "traces": [str(t) for t in (attrs.get("traces")
+                                                or [])],
+                    "file": idx,
+                })
+    return incidents
+
+
+def chrome_trace(spans, paths, incidents=None):
     """chrome://tracing JSON: one chrome process per source log (so a
     trainer and a pserver render as separate swimlanes even when a
-    synthetic pair shares an OS pid), threads mapped per (file, tid)."""
+    synthetic pair shares an OS pid), threads mapped per (file, tid).
+    Incident records render as instant ("i") events on the swimlane of
+    a span sharing one of their active trace ids — the trip point sits
+    visually inside the request timeline it interrupted — falling back
+    to their source log's process row."""
     events = []
     pid_of = {}          # file idx -> chrome pid
     tid_of = {}          # (file idx, tid name) -> chrome tid
@@ -110,6 +151,21 @@ def chrome_trace(spans, paths):
             "pid": pid, "tid": tid_of[key],
             "args": {"trace": s["trace"], "span": s["span"],
                      "parent": s["parent"], **s["attrs"]},
+        })
+    for inc in incidents or []:
+        # matching swimlane: the latest-starting span of any of the
+        # incident's active traces; else the source log's process row
+        pid, tid = inc["file"], 0
+        match = [s for s in spans if s["trace"] in set(inc["traces"])]
+        if match:
+            s = max(match, key=lambda s: s["start"])
+            pid, tid = pid_of[s["file"]], tid_of[(s["file"], s["tid"])]
+        events.append({
+            "name": f"INCIDENT {inc['name']}", "ph": "i", "s": "t",
+            "cat": "incident", "ts": inc["ts"] * 1e6,
+            "pid": pid, "tid": tid,
+            "args": {"source": inc["source"], "id": inc["id"],
+                     "rule": inc["rule"], "traces": inc["traces"]},
         })
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
@@ -212,16 +268,26 @@ def main(argv=None):
               file=sys.stderr)
         return 2
 
+    incidents = load_incidents(args.logs)
+    if args.trace:
+        incidents = [i for i in incidents if args.trace in i["traces"]]
     print(f"{len(spans)} spans, "
           f"{len({s['trace'] for s in spans})} trace(s), "
-          f"{len(args.logs)} log(s)")
+          f"{len(args.logs)} log(s)"
+          + (f", {len(incidents)} incident marker(s)" if incidents
+             else ""))
     if args.out and not args.summary_only:
-        doc = chrome_trace(spans, args.logs)
+        doc = chrome_trace(spans, args.logs, incidents=incidents)
         with open(args.out, "w") as f:
             json.dump(doc, f)
         print(f"wrote {args.out}: {len(doc['traceEvents'])} events "
               f"(load in chrome://tracing or ui.perfetto.dev)")
     render_summary(build_trees(spans), args.logs)
+    for inc in incidents:
+        print(f"INCIDENT {inc['name']} (source {inc['source']}"
+              + (f", rule {inc['rule']}" if inc["rule"] else "")
+              + f") @ ts {inc['ts']:.3f} touching "
+              f"{len(inc['traces'])} trace(s)")
     return 0
 
 
