@@ -50,6 +50,21 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
 def main():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--profile", default="",
+                    help="tuned profile (tools/autotune.py offline) to "
+                         "apply before the run — the next relay round "
+                         "starts from the tuned point, and the row's "
+                         "extra.tuned_profile records the provenance")
+    args = ap.parse_args()
+    if args.profile:
+        from paddle_tpu.core import tuner
+
+        tuner.apply_profile(tuner.load_profile(args.profile),
+                            origin_path=args.profile)
+
     from tools.bench_models import bench_ernie_large, finalize_bench_result
 
     # finalize_bench_result merges telemetry.bench_extra() — compiles /

@@ -10,7 +10,24 @@ environment (FLAGS_<name>=...) at import, and via set_flags() at runtime
 from __future__ import annotations
 
 import os
+from contextlib import contextmanager
 from typing import Any, Dict, List, Optional, Union
+
+
+class ConfigError(ValueError):
+    """Typed configuration-surface error (bad flag name, uncoercible
+    value, malformed bucket spec). Subclasses ValueError so pre-existing
+    ``except ValueError`` callers keep working."""
+
+
+class UnknownFlagError(ConfigError):
+    """A flag name that is not in the registry — a typo'd override is an
+    error, never a silently-ignored setting."""
+
+
+class BucketConfigError(ConfigError):
+    """A bucket-boundary list that is not a strictly increasing sequence
+    of positive integers (or fails its coverage requirement)."""
 
 
 class _Flag:
@@ -45,26 +62,23 @@ def define_flag(name: str, default, doc: str = ""):
     return flag
 
 
+def _resolve_key(name: str) -> str:
+    key = name[6:] if name.startswith("FLAGS_") else name
+    if key not in _REGISTRY:
+        raise UnknownFlagError(f"unknown flag '{name}' (no FLAGS_{key} "
+                               f"registered)")
+    return key
+
+
 def get_flags(flags: Union[str, List[str]]) -> Dict[str, Any]:
     """paddle.get_flags."""
     names = [flags] if isinstance(flags, str) else list(flags)
-    out = {}
-    for n in names:
-        key = n[6:] if n.startswith("FLAGS_") else n
-        if key not in _REGISTRY:
-            raise ValueError(f"unknown flag '{n}'")
-        out[n] = _REGISTRY[key].value
-    return out
+    return {n: _REGISTRY[_resolve_key(n)].value for n in names}
 
 
 def set_flags(flags: Dict[str, Any]):
     """paddle.set_flags."""
-    for n, v in flags.items():
-        key = n[6:] if n.startswith("FLAGS_") else n
-        if key not in _REGISTRY:
-            raise ValueError(f"unknown flag '{n}'")
-        f = _REGISTRY[key]
-        f.value = _coerce(f, v)
+    apply(flags)
 
 
 def flag(name: str):
@@ -74,6 +88,101 @@ def flag(name: str):
 
 def all_flags() -> Dict[str, Any]:
     return {n: f.value for n, f in _REGISTRY.items()}
+
+
+# -- typed snapshot / apply / scoped-override API ----------------------------
+# (the config surface the autotuner searches over: candidate application
+# and rollback must be validated and exactly reversible — no ad-hoc
+# monkeypatching of flag values)
+
+def snapshot() -> Dict[str, Any]:
+    """Copy of every flag's CURRENT value, keyed by bare name — the
+    incumbent config an autotune trial (core/tuner.py) or a test rolls
+    back to. ``apply(snapshot())`` is an exact restore."""
+    return {n: f.value for n, f in _REGISTRY.items()}
+
+
+def apply(overrides: Dict[str, Any]) -> Dict[str, Any]:
+    """Validated bulk override: every name is resolved (typed
+    UnknownFlagError on a typo) and every value coerced BEFORE any flag
+    changes, so a half-applied candidate config is impossible. Returns
+    {bare_name: prior_value} of the touched flags — feed it back to
+    ``apply`` to roll back."""
+    resolved: Dict[str, Any] = {}
+    for n, v in overrides.items():
+        key = _resolve_key(n)
+        f = _REGISTRY[key]
+        try:
+            resolved[key] = _coerce(f, v)
+        except (TypeError, ValueError) as e:
+            raise ConfigError(
+                f"flag '{key}' cannot take value {v!r} "
+                f"({f.type.__name__} expected): {e}") from e
+    prior = {k: _REGISTRY[k].value for k in resolved}
+    for k, v in resolved.items():
+        _REGISTRY[k].value = v
+    return prior
+
+
+@contextmanager
+def overrides(mapping: Optional[Dict[str, Any]] = None, **kw):
+    """Scoped flag override: ``with flags.overrides(exec_steps_per_dispatch=4):``
+    applies the (validated) overrides and restores the exact prior values
+    on exit — even when the body raises."""
+    ov: Dict[str, Any] = dict(mapping or {})
+    ov.update(kw)
+    prior = apply(ov)
+    try:
+        yield prior
+    finally:
+        apply(prior)
+
+
+def parse_buckets(spec, name: str = "buckets",
+                  cover: Optional[int] = None,
+                  cover_exact: bool = False) -> Optional[List[int]]:
+    """Parse + validate a bucket-boundary list (a comma-separated flag
+    string or a sequence of ints). Boundaries must be POSITIVE integers
+    in STRICTLY increasing order — a zero-valued or non-monotonic list
+    raises a typed BucketConfigError instead of being silently
+    reordered/deduped (a config surface the autotuner searches must
+    reject malformed points loudly). ``cover`` demands the last boundary
+    reach it (``cover_exact`` demands equality — the decode engine's
+    fixed-step-shape contract). Returns None for an empty spec (caller
+    default applies)."""
+    if spec is None:
+        vals: List[int] = []
+    elif isinstance(spec, str):
+        s = spec.strip()
+        try:
+            vals = [int(b) for b in s.split(",") if b.strip()] if s else []
+        except ValueError as e:
+            raise BucketConfigError(
+                f"{name}: non-integer bucket boundary in {spec!r}") from e
+    else:
+        try:
+            vals = [int(b) for b in spec]
+        except (TypeError, ValueError) as e:
+            raise BucketConfigError(
+                f"{name}: non-integer bucket boundary in {spec!r}") from e
+    if not vals:
+        return None
+    if vals[0] < 1:
+        raise BucketConfigError(
+            f"{name}: bucket boundaries must be >= 1, got {vals}")
+    for a, b in zip(vals, vals[1:]):
+        if b <= a:
+            raise BucketConfigError(
+                f"{name}: bucket boundaries must be strictly increasing, "
+                f"got {vals}")
+    if cover is not None:
+        if cover_exact and vals[-1] != cover:
+            raise BucketConfigError(
+                f"{name}: bucket set {vals} must end exactly at {cover}")
+        if vals[-1] < cover:
+            raise BucketConfigError(
+                f"{name}: bucket set {vals} does not cover {cover}")
+    return vals
 
 
 # -- the flag set (reference: platform/flags.cc; TPU-meaningful subset,
@@ -417,6 +526,43 @@ define_flag("lock_stall_s", 30.0,
             "stack, held locks and waited lock into the run log as one "
             "kind:'stall' record (lock.stalls counts them) — wedged-"
             "process forensics captured while it is still wedged")
+# -- cost-model-guided autotuner (core/tuner.py + tools/autotune.py:
+#    offline replay search + online A/B promotion over this very flag
+#    surface; reference analogs: the hand-tuned ExecutionStrategy/
+#    BuildStrategy heuristics + DistributedStrategy auto mode) ----------------
+
+define_flag("tuner_traffic_fraction", 0.25,
+            "bounded traffic slice the router steers onto the trial "
+            "replica during an online A/B trial (core/tuner.py "
+            "OnlineTrial): every ~1/fraction-th routed request goes to "
+            "the trial arm, the rest stay on the control fleet; clamped "
+            "to (0, 0.5] so the control arm always carries the majority")
+define_flag("tuner_eval_interval_s", 1.0,
+            "seconds between two online-trial evaluation ticks (arm "
+            "stats scrape + SLO check + promote/abort decision)")
+define_flag("tuner_min_requests", 8,
+            "min requests the TRIAL arm must have served before a "
+            "promote/abort verdict is reached on latency deltas (an SLO "
+            "trip aborts immediately regardless)")
+define_flag("tuner_promote_ratio", 0.95,
+            "promotion gate: the trial arm's windowed p99 must be <= "
+            "control p99 * this ratio (i.e. at least a 5% win by "
+            "default) for the candidate to be promoted fleet-wide")
+define_flag("tuner_abort_ratio", 1.25,
+            "abort gate: a trial arm whose windowed p99 exceeds control "
+            "p99 * this ratio is rolled back without waiting for the "
+            "full trial budget")
+define_flag("tuner_max_evals", 10,
+            "evaluation ticks an online trial runs before it gives a "
+            "final verdict (undecided trials roll back — the incumbent "
+            "keeps the fleet)")
+define_flag("tuner_hbm_capacity_bytes", 0,
+            "per-device HBM capacity the offline tuner's headroom "
+            "constraint gates batch-size candidates against (candidate "
+            "rejected when its projected ledger total exceeds capacity * "
+            "0.92); 0 disables the gate when no measured ledger capacity "
+            "is available (CPU container)")
+
 define_flag("ps_degrade_to_survivors", False,
             "when the HeartBeatMonitor declares a trainer dead, shrink "
             "the sync barrier to the live set (mean over survivors) "

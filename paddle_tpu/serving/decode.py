@@ -67,6 +67,7 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from ..core import costmodel, faults, incidents, telemetry
+from ..core import flags as _flags
 from ..core.flags import flag as _flag
 from ..models.decoder_lm import (DecoderLMConfig, build_prefill_program,
                                  build_step_program, decoder_lm_params,
@@ -106,18 +107,21 @@ class DecodeConfig:
                  continuous: bool = True):
         self.max_slots = int(_flag("decode_max_slots") if max_slots is None
                              else max_slots)
-        if buckets is None:
-            spec = str(_flag("decode_buckets")).strip()
-            buckets = [int(b) for b in spec.split(",") if b.strip()] \
-                if spec else None
+        # strict typed parse (core/flags.py): zero-valued or
+        # non-monotonic lists raise BucketConfigError; the set must end
+        # exactly at max_slots (the fixed-step-shape contract).
         # default: ONE fixed bucket — constant step shapes keep
         # continuous batching bitwise-identical to sequential decode
-        self.buckets = sorted(set(int(b) for b in buckets)) if buckets \
-            else [self.max_slots]
-        if self.buckets[0] < 1 or self.buckets[-1] != self.max_slots:
-            raise ValueError(
-                f"decode buckets {self.buckets} must be >= 1 and end at "
-                f"max_slots ({self.max_slots})")
+        if buckets is None:
+            buckets = _flags.parse_buckets(_flag("decode_buckets"),
+                                           "FLAGS_decode_buckets",
+                                           cover=self.max_slots,
+                                           cover_exact=True)
+        else:
+            buckets = _flags.parse_buckets(buckets, "buckets",
+                                           cover=self.max_slots,
+                                           cover_exact=True)
+        self.buckets = buckets or [self.max_slots]
         self.page_size = int(_flag("decode_page_size") if page_size is None
                              else page_size)
         self.kv_pages = int(_flag("decode_kv_pages") if kv_pages is None

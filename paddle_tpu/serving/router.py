@@ -43,7 +43,7 @@ import http.client
 import json
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..core import faults, incidents, retry, telemetry, trace
@@ -71,6 +71,11 @@ class ReplicaHandle:
         self.model_version: Optional[int] = None
         self.last_probe_t = 0.0
         self.consecutive_failures = 0
+        # bounded ring of (epoch_ts, ms) of successful dispatches: the
+        # per-ARM latency evidence an online autotune trial compares
+        # (router-side so it works for the in-process cluster backend,
+        # whose replicas share one telemetry registry)
+        self.dispatch_samples: "deque" = deque(maxlen=512)
 
     # -- state updates (probe thread + dispatch path) ------------------------
     def mark_probe(self, ready: bool, stats: Optional[Dict[str, Any]] = None):
@@ -114,6 +119,15 @@ class ReplicaHandle:
             self.queue_depth = 0
             self.inflight = 0
             self.consecutive_failures = 0
+
+    def record_dispatch(self, ms: float):
+        with self._lock:
+            self.dispatch_samples.append((time.time(), float(ms)))
+
+    def dispatch_latencies(self, since_ts: float = 0.0) -> List[float]:
+        with self._lock:
+            return [ms for ts, ms in self.dispatch_samples
+                    if ts >= since_ts]
 
     # -- balancing -----------------------------------------------------------
     def score(self) -> int:
@@ -186,6 +200,11 @@ class Router:
         self._dedup_cap = int(_flag("router_dedup_capacity"))
         self._ids = 0
         self._rr = 0   # rotating tie-break offset for equal load scores
+        # online A/B traffic split (core/tuner.py OnlineTrial): when set,
+        # every period-th pick steers to the trial replica and every
+        # other pick EXCLUDES it, so each arm's latency evidence is pure
+        self._trial: Optional[Tuple[str, float]] = None
+        self._trial_count = 0
 
     # -- membership ----------------------------------------------------------
     def add_replica(self, name: str, url: str) -> ReplicaHandle:
@@ -242,18 +261,73 @@ class Router:
             self._probe_thread = None
         incidents.disarm()
 
+    # -- A/B traffic split (online autotune trials) --------------------------
+    def set_trial(self, replica_name: str, fraction: Optional[float] = None):
+        """Steer a bounded slice of traffic onto `replica_name`: every
+        ~1/fraction-th routed request dispatches there, the rest stay on
+        the control fleet (and skip the trial replica, keeping both
+        arms' latency samples pure). Fraction clamps to (0, 0.5] — the
+        control arm always carries the majority."""
+        if fraction is None:
+            fraction = float(_flag("tuner_traffic_fraction"))
+        fraction = min(max(float(fraction), 0.01), 0.5)
+        with self._lock:
+            self._trial = (replica_name, fraction)
+            self._trial_count = 0
+        telemetry.counter_add("router.trial_split_set", 1,
+                              replica=replica_name, fraction=fraction)
+
+    def clear_trial(self):
+        with self._lock:
+            self._trial = None
+
+    def trial(self) -> Optional[Tuple[str, float]]:
+        with self._lock:
+            return self._trial
+
     # -- balancing -----------------------------------------------------------
     def pick(self, exclude=()) -> Optional[ReplicaHandle]:
         """READY replica with the lowest load score, skipping `exclude`;
         None when nothing is routable. Equal scores round-robin (a
         rotating start offset), so an idle fleet shares work instead of
-        hammering the first replica."""
+        hammering the first replica.
+
+        With a trial traffic split active (set_trial), the steering
+        schedule decides the arm first: a steered pick returns the trial
+        replica (when ready), any other pick excludes it — unless the
+        trial replica is the ONLY routable one, where availability beats
+        arm purity."""
         handles = self.handles()
         if not handles:
             return None
         with self._lock:
             self._rr += 1
             offset = self._rr
+            trial = self._trial
+            steer = False
+            if trial is not None:
+                self._trial_count += 1
+                period = max(2, int(round(1.0 / trial[1])))
+                steer = (self._trial_count % period) == 0
+        if trial is not None:
+            trial_handle = next((h for h in handles
+                                 if h.name == trial[0]), None)
+            if trial_handle is not None and trial_handle not in exclude:
+                if steer and trial_handle.ready:
+                    telemetry.counter_quiet("router.trial_dispatches")
+                    return trial_handle
+                if not steer:
+                    control = self._pick_from(handles, offset,
+                                              set(exclude) | {trial_handle})
+                    if control is not None:
+                        telemetry.counter_quiet(
+                            "router.trial_control_dispatches")
+                        return control
+                    # no control replica routable: fall through and let
+                    # the trial replica carry the request
+        return self._pick_from(handles, offset, exclude)
+
+    def _pick_from(self, handles, offset, exclude) -> Optional[ReplicaHandle]:
         best = None
         best_score = None
         for j in range(len(handles)):
@@ -433,11 +507,17 @@ class Router:
                     with handle._lock:
                         handle.inflight += 1
                     try:
+                        t_disp = time.perf_counter()
                         with telemetry.timer("router.dispatch_ms"):
                             code, payload = _http_json(
                                 "POST", handle.url, "/v1/infer",
                                 body=json.dumps(body_doc).encode(),
                                 headers=headers, timeout=attempt_timeout)
+                        if code == 200:
+                            # per-arm latency evidence for online
+                            # autotune trials (core/tuner.py)
+                            handle.record_dispatch(
+                                (time.perf_counter() - t_disp) * 1e3)
                     finally:
                         with handle._lock:
                             handle.inflight -= 1
@@ -496,6 +576,9 @@ class Router:
                if k.startswith("router.") and isinstance(v, (int, float))}
         out["replicas"] = [h.snapshot() for h in self.handles()]
         out["ready"] = self.ready()
+        t = self.trial()
+        if t is not None:
+            out["trial"] = {"replica": t[0], "fraction": t[1]}
         hists = telemetry.snapshot()["hists"]
         for key in ("router.request_ms", "router.dispatch_ms"):
             h = hists.get(key)

@@ -88,12 +88,10 @@ def _verify_program_on_op_sweeps(request):
         return
     from paddle_tpu.core import flags as _flags
 
-    old = _flags.flag("verify_program")
-    _flags.set_flags({"verify_program": True})
-    try:
+    # the typed scoped-override API (PR 15): exact prior restored even
+    # when the test body raises — no ad-hoc save/restore
+    with _flags.overrides(verify_program=True):
         yield
-    finally:
-        _flags.set_flags({"verify_program": old})
 
 
 # Concurrency-sanitizer opt-in (PT_SANITIZE_TESTS=1): the serving/
@@ -118,12 +116,8 @@ def _sanitize_locks_opt_in(request):
         return
     from paddle_tpu.core import flags as _flags
 
-    old = _flags.flag("sanitize_locks")
-    _flags.set_flags({"sanitize_locks": True})
-    try:
+    with _flags.overrides(sanitize_locks=True):
         yield
-    finally:
-        _flags.set_flags({"sanitize_locks": old})
 
 
 def rand(*shape, dtype=np.float32, seed=None):

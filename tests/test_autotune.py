@@ -1,0 +1,660 @@
+"""Cost-model-guided autotuner (PR 15, core/tuner.py +
+tools/autotune.py).
+
+Pins the ISSUE acceptance criteria:
+
+* typed flags.snapshot()/apply()/overrides() API: validated before any
+  value changes (UnknownFlagError on a typo — no half-applied
+  candidate), exact restore;
+* FLAGS_serving_buckets / FLAGS_decode_buckets parse strictly: a
+  zero-valued or non-monotonic bucket list raises a typed
+  BucketConfigError instead of being silently reordered;
+* search-space enumeration + constraint rejection (HBM headroom gates
+  batch scaling, bucket sets must cover the batch bound, sharding
+  candidates need mesh evidence), counted in
+  tuner.constraint_rejections;
+* offline replay ranking on a synthetic run log with a known-best
+  config: measured per-k medians beat the incumbent, the amortization
+  fit extrapolates only when physically valid, knobs without evidence
+  cannot claim a win;
+* profile round-trip: emit -> load -> apply -> finalize_bench_result
+  embeds extra.tuned_profile provenance, and tools/slo_check.py only
+  compares rows of matching provenance;
+* online A/B trial against the in-process cluster backend: the
+  candidate config lands on ONE replica via the swap machinery, the
+  router steers/excludes the trial arm, promotion on per-arm p99, and
+  an SLO rule trip rolls back within ONE evaluation tick with exactly
+  one tuner.rollbacks increment and zero residual flag overrides;
+* perf_report renders the "Autotune" section; tools/autotune.py CLI
+  smoke (offline emits a profile, exit codes).
+"""
+
+import io
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import io as pt_io
+from paddle_tpu import layers
+from paddle_tpu.core import flags as _flags
+from paddle_tpu.core import incidents, telemetry, tuner
+from paddle_tpu.core.flags import (BucketConfigError, ConfigError,
+                                   UnknownFlagError)
+
+pytestmark = pytest.mark.serving
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tuner():
+    snap = _flags.snapshot()
+    tuner.clear_active_profile()
+    yield
+    _flags.apply(snap)
+    tuner.clear_active_profile()
+    incidents.reset()
+
+
+def _counter(name):
+    return int(telemetry.counters().get(name, 0))
+
+
+# ---------------------------------------------------------------------------
+# satellite: typed snapshot/apply/overrides flag API
+# ---------------------------------------------------------------------------
+
+
+class TestFlagsAPI:
+    def test_snapshot_apply_roundtrip(self):
+        snap = _flags.snapshot()
+        prior = _flags.apply({"FLAGS_exec_steps_per_dispatch": 4,
+                              "serving_max_batch_size": 16})
+        assert _flags.flag("exec_steps_per_dispatch") == 4
+        assert _flags.flag("serving_max_batch_size") == 16
+        assert prior == {"exec_steps_per_dispatch":
+                         snap["exec_steps_per_dispatch"],
+                         "serving_max_batch_size":
+                         snap["serving_max_batch_size"]}
+        _flags.apply(prior)
+        assert _flags.snapshot() == snap
+
+    def test_unknown_flag_is_typed_and_atomic(self):
+        before = _flags.flag("exec_steps_per_dispatch")
+        with pytest.raises(UnknownFlagError, match="unknown flag"):
+            _flags.apply({"exec_steps_per_dispatch": 8,
+                          "definitely_not_a_flag": 1})
+        # validation happens BEFORE any value changes: no half-applied
+        # candidate config
+        assert _flags.flag("exec_steps_per_dispatch") == before
+        assert issubclass(UnknownFlagError, ValueError)
+
+    def test_uncoercible_value_is_typed(self):
+        with pytest.raises(ConfigError):
+            _flags.apply({"exec_steps_per_dispatch": "not-an-int"})
+
+    def test_overrides_context_restores_on_exception(self):
+        before = _flags.flag("exec_steps_per_dispatch")
+        with pytest.raises(RuntimeError, match="boom"):
+            with _flags.overrides(exec_steps_per_dispatch=8):
+                assert _flags.flag("exec_steps_per_dispatch") == 8
+                raise RuntimeError("boom")
+        assert _flags.flag("exec_steps_per_dispatch") == before
+
+    def test_set_flags_stays_compatible(self):
+        # the public paddle.set_flags surface keeps its ValueError
+        # contract (UnknownFlagError subclasses it)
+        with pytest.raises(ValueError, match="unknown flag"):
+            _flags.set_flags({"FLAGS_nope": 1})
+
+
+# ---------------------------------------------------------------------------
+# satellite: strict bucket-list validation
+# ---------------------------------------------------------------------------
+
+
+class TestBucketValidation:
+    def test_parse_good(self):
+        assert _flags.parse_buckets("2,4,8", "t") == [2, 4, 8]
+        assert _flags.parse_buckets([1, 3], "t") == [1, 3]
+        assert _flags.parse_buckets("", "t") is None
+        assert _flags.parse_buckets(None, "t") is None
+
+    @pytest.mark.parametrize("bad", ["0,4", "4,2", "4,4", "-1,2", "2,x"])
+    def test_parse_bad_is_typed(self, bad):
+        with pytest.raises(BucketConfigError):
+            _flags.parse_buckets(bad, "t")
+
+    def test_cover(self):
+        assert _flags.parse_buckets("2,8", "t", cover=8) == [2, 8]
+        with pytest.raises(BucketConfigError, match="does not cover"):
+            _flags.parse_buckets("2,4", "t", cover=8)
+        with pytest.raises(BucketConfigError, match="end exactly"):
+            _flags.parse_buckets("2,16", "t", cover=8, cover_exact=True)
+
+    def test_serving_config_rejects_bad_flag(self):
+        from paddle_tpu.serving.engine import ServingConfig
+
+        _flags.apply({"serving_buckets": "8,4"})
+        with pytest.raises(BucketConfigError):
+            ServingConfig()
+        _flags.apply({"serving_buckets": "0,4"})
+        with pytest.raises(BucketConfigError):
+            ServingConfig()
+        _flags.apply({"serving_buckets": "4,8"})
+        assert ServingConfig().buckets == [4, 8]
+        _flags.apply({"serving_buckets": ""})
+        assert ServingConfig(max_batch_size=8).buckets == [1, 2, 4, 8]
+
+    def test_decode_config_rejects_bad_flag(self):
+        from paddle_tpu.serving.decode import DecodeConfig
+
+        _flags.apply({"decode_buckets": "4,2", "decode_max_slots": 4})
+        with pytest.raises(BucketConfigError):
+            DecodeConfig()
+        # the set must end exactly at max_slots (fixed-step-shape
+        # contract) — a ValueError subclass, like the old behavior
+        with pytest.raises(ValueError):
+            DecodeConfig(max_slots=4, buckets=[2, 8])
+        _flags.apply({"decode_buckets": "2,4"})
+        assert DecodeConfig(max_slots=4).buckets == [2, 4]
+        _flags.apply({"decode_buckets": ""})
+        assert DecodeConfig(max_slots=4).buckets == [4]
+
+
+# ---------------------------------------------------------------------------
+# search space + constraints
+# ---------------------------------------------------------------------------
+
+
+class TestSearchSpace:
+    def test_enumerate_default_first_and_counted(self):
+        before = _counter("tuner.candidates")
+        space = tuner.SearchSpace()
+        cands = space.enumerate()
+        assert cands[0].label == "default" and cands[0].changes == 0
+        assert all(c.changes == 1 for c in cands[1:])
+        expected = 1 + sum(len(k.values) - 1 for k in space.knobs)
+        assert len(cands) == expected
+        assert _counter("tuner.candidates") - before == expected
+
+    def test_bucket_constraints(self):
+        space = tuner.SearchSpace()
+        before = _counter("tuner.constraint_rejections")
+        bad = tuner.Candidate(flags={"serving_buckets": "8,4"})
+        assert space.check(bad) == "bucket_set_invalid"
+        # a monotonic set that stops short of max_batch_size is rejected
+        short = tuner.Candidate(flags={"serving_buckets": "2,4",
+                                       "serving_max_batch_size": 16})
+        assert space.check(short) == "bucket_set_invalid"
+        good = tuner.Candidate(flags={"serving_buckets": "4,8",
+                                      "serving_max_batch_size": 8})
+        assert space.check(good) is None
+        decode_bad = tuner.Candidate(flags={"decode_buckets": "2,4",
+                                            "decode_max_slots": 8})
+        assert space.check(decode_bad) == "bucket_set_invalid"
+        assert _counter("tuner.constraint_rejections") - before == 3
+
+    def test_hbm_headroom_gates_batch(self):
+        space = tuner.SearchSpace()
+        cand = tuner.Candidate(batch_multiplier=2.0)
+        # no capacity configured: a scaled batch is unprovable
+        assert space.check(cand, None) == "hbm_capacity_unknown"
+        obs = tuner.RunLogObservations()
+        obs.gauges["mem.hbm_total_bytes"] = 10e9
+        obs.gauges["mem.param_bytes"] = 2e9
+        obs.gauges["mem.opt_state_bytes"] = 2e9
+        # fixed 4 GB + 6 GB activations * 2 = 16 GB > 12 GB * 0.92
+        with _flags.overrides(tuner_hbm_capacity_bytes=int(12e9)):
+            assert space.check(cand, obs) == "hbm_headroom"
+        # 32 GB device: 16 GB projected fits
+        with _flags.overrides(tuner_hbm_capacity_bytes=int(32e9)):
+            assert space.check(cand, obs) is None
+        # capacity known but the log has no ledger gauges
+        with _flags.overrides(tuner_hbm_capacity_bytes=int(32e9)):
+            assert space.check(cand, tuner.RunLogObservations()) == \
+                "hbm_no_ledger_evidence"
+
+    def test_sharding_needs_mesh_evidence(self):
+        space = tuner.SearchSpace()
+        cand = tuner.Candidate(zero_stage=2)
+        assert space.check(cand, None) == "no_mesh_evidence"
+        obs = tuner.RunLogObservations()
+        obs.mesh_shape = {"dp": 8}
+        assert space.check(cand, obs) is None
+        rules = tuner.Candidate(
+            axis_rules=tuner.AXIS_RULE_VARIANTS["mp_first"])
+        assert space.check(rules, None) == "no_mesh_evidence"
+
+
+# ---------------------------------------------------------------------------
+# offline replay
+# ---------------------------------------------------------------------------
+
+
+def _metric_record(ms_per_step, k, batch=64, metric="mnist",
+                   unit="samples/s", value=1.0):
+    return {"ts": 1.0, "kind": "metric", "name": metric, "value": value,
+            "attrs": {"ms_per_step": ms_per_step,
+                      "steps_per_dispatch": k, "batch": batch,
+                      "unit": unit}}
+
+
+def _write_log(tmp_path, records, name="run.jsonl"):
+    path = str(tmp_path / name)
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    return path
+
+
+class TestOfflineReplay:
+    def test_known_best_amortization(self, tmp_path):
+        # ms(k) = 6 + 4/k measured at k=1 and k=4 -> k=8 extrapolates
+        # to 6.5, beating every observed point
+        path = _write_log(tmp_path, [_metric_record(10.0, 1),
+                                     _metric_record(7.0, 4)])
+        obs = tuner.RunLogObservations.load(path)
+        res = tuner.offline_search(obs)
+        assert res.default_score == 10.0
+        assert res.improved()
+        assert res.best.flags == {"exec_steps_per_dispatch": 8}
+        top = res.ranked[0]
+        assert top["basis"] == "modeled"
+        assert abs(top["score"] - 6.5) < 1e-9
+
+    def test_measured_beats_bad_incumbent(self, tmp_path):
+        # the CPU-container reality: the fused scan LOSES — k=4 is 5x
+        # slower. The fit is unphysical (host < 0) so NO extrapolation;
+        # the measured table still dethrones the hand-picked incumbent.
+        path = _write_log(tmp_path, [_metric_record(57.0, 1),
+                                     _metric_record(379.0, 4)])
+        obs = tuner.RunLogObservations.load(path)
+        with _flags.overrides(exec_steps_per_dispatch=4):
+            res = tuner.offline_search(obs)
+        assert res.default_score == 379.0
+        assert res.best.flags == {"exec_steps_per_dispatch": 1}
+        assert res.ranked[0]["basis"] == "measured"
+        assert res.ranked[0]["score"] == 57.0
+        # unobserved k must NOT have been extrapolated from the
+        # unphysical fit
+        labels = {r["candidate"].label: r for r in res.ranked}
+        assert labels["exec_steps_per_dispatch=8"]["basis"] == "default"
+
+    def test_single_k_cannot_invent_a_win(self, tmp_path):
+        path = _write_log(tmp_path, [_metric_record(10.0, 1)])
+        obs = tuner.RunLogObservations.load(path)
+        before = _counter("tuner.insufficient_evidence")
+        res = tuner.offline_search(obs)
+        assert not res.improved()
+        # the incumbent (fewest changes) wins the all-tie ranking
+        assert res.ranked[0]["candidate"].changes == 0
+        assert _counter("tuner.insufficient_evidence") > before
+
+    def test_raw_jsonl_timer_observations(self, tmp_path):
+        recs = [{"ts": 1.0, "kind": "timer", "name": "executor.run_ms",
+                 "value": v} for v in (9.0, 10.0, 11.0)]
+        recs += [{"ts": 1.0, "kind": "counter",
+                  "name": "executor.fused_dispatches", "value": 5,
+                  "attrs": {"delta": 5}},
+                 {"ts": 1.0, "kind": "counter",
+                  "name": "executor.fused_steps", "value": 20,
+                  "attrs": {"delta": 20}},
+                 {"ts": 1.0, "kind": "timer",
+                  "name": "executor.run_steps_ms", "value": 28.0}]
+        obs = tuner.RunLogObservations.load(_write_log(tmp_path, recs))
+        model = tuner.ReplayModel(obs)
+        assert model.measured[1] == 10.0         # run_ms median
+        assert model.measured[4] == 7.0          # 28 ms / k=4
+        assert model.fit_valid()
+
+    def test_empty_log_is_typed_error(self, tmp_path):
+        path = _write_log(tmp_path, [{"ts": 1.0, "kind": "gauge",
+                                      "name": "x", "value": 1}])
+        with pytest.raises(tuner.TunerError, match="no step-time"):
+            tuner.offline_search(tuner.RunLogObservations.load(path))
+
+    def test_roofline_and_bench_wrapper_ingest(self, tmp_path):
+        recs = [_metric_record(10.0, 1),
+                {"ts": 1.0, "kind": "cost", "name": "costmodel.jit",
+                 "value": 1e9, "attrs": {"roofline": "memory_bound",
+                                         "intensity": 0.7}},
+                {"parsed": _bench_row(8.0, 2)}]
+        obs = tuner.RunLogObservations.load(_write_log(tmp_path, recs))
+        assert obs.roofline_summary() == {"memory_bound": 1}
+        assert {r["k"] for r in obs.step_rows} == {1, 2}
+
+
+def _bench_row(ms_per_step, k, value=100.0, metric="mnist",
+               extra=None):
+    ex = {"ms_per_step": ms_per_step, "steps_per_dispatch": k,
+          "batch": 64}
+    ex.update(extra or {})
+    return {"metric": metric, "value": value, "unit": "samples/s",
+            "extra": ex}
+
+
+# ---------------------------------------------------------------------------
+# profiles + bench/slo_check provenance
+# ---------------------------------------------------------------------------
+
+
+class TestProfiles:
+    def _profile(self):
+        cand = tuner.Candidate(flags={"exec_steps_per_dispatch": 2},
+                               changes=1, label="k2")
+        return tuner.make_profile(cand, objective="step_ms",
+                                  replayed=5.0, default_objective=10.0,
+                                  origin={"run_id": "r42"},
+                                  workload="mnist")
+
+    def test_roundtrip_and_apply(self, tmp_path):
+        doc = self._profile()
+        path = str(tmp_path / "p.json")
+        tuner.save_profile(doc, path)
+        loaded = tuner.load_profile(path)
+        assert loaded["profile_hash"] == doc["profile_hash"]
+        before = _counter("tuner.profiles_loaded")
+        prior = tuner.apply_profile(loaded, origin_path=path)
+        assert _flags.flag("exec_steps_per_dispatch") == 2
+        assert _counter("tuner.profiles_loaded") - before == 1
+        prov = tuner.profile_provenance()
+        assert prov == {"profile_hash": doc["profile_hash"],
+                        "origin": "r42"}
+        _flags.apply(prior)
+
+    def test_load_rejects_junk(self, tmp_path):
+        bad = str(tmp_path / "bad.json")
+        with open(bad, "w") as f:
+            f.write('{"format": "something-else"}')
+        with pytest.raises(tuner.ProfileError):
+            tuner.load_profile(bad)
+        with pytest.raises(tuner.ProfileError):
+            tuner.load_profile(str(tmp_path / "missing.json"))
+
+    def test_finalize_bench_result_embeds_provenance(self):
+        from tools.bench_models import finalize_bench_result
+
+        out = finalize_bench_result({"metric": "t", "value": 1.0,
+                                     "unit": "x", "extra": {}})
+        assert out["extra"]["tuned_profile"] == "hand-picked"
+        doc = self._profile()
+        tuner.apply_profile(doc)
+        try:
+            out = finalize_bench_result({"metric": "t", "value": 1.0,
+                                         "unit": "x", "extra": {}})
+            assert out["extra"]["tuned_profile"]["profile_hash"] == \
+                doc["profile_hash"]
+        finally:
+            tuner.clear_active_profile()
+
+    def test_slo_check_matches_provenance(self):
+        from tools.slo_check import slo_verdict
+
+        hand = _bench_row(10.0, 1)
+        tuned = _bench_row(5.0, 1, value=200.0, extra={
+            "tuned_profile": {"profile_hash": "abc", "origin": "r1"}})
+        tuned_other = _bench_row(5.0, 1, value=220.0, extra={
+            "tuned_profile": {"profile_hash": "def", "origin": "r2"}})
+        # a hand-picked row is never judged against tuned history
+        v = slo_verdict(_bench_row(9.0, 1, value=95.0),
+                        [tuned, tuned_other])
+        assert v["verdict"] == "no_baseline"
+        # ... and judges fine against hand-picked peers
+        v = slo_verdict(_bench_row(9.0, 1, value=95.0), [hand, tuned])
+        assert v["verdict"] == "pass" and v["peers"] == 1
+        # tuned rows only compare within the SAME profile hash
+        v = slo_verdict(dict(tuned, value=100.0), [tuned, tuned_other])
+        assert v["peers"] == 1
+        assert v["verdict"] == "regress"   # 100 < 200 * 0.95
+
+
+# ---------------------------------------------------------------------------
+# online A/B trial (in-process cluster backend)
+# ---------------------------------------------------------------------------
+
+IN_DIM, OUT_DIM = 6, 4
+
+
+def _publish_mlp(tmp_path):
+    from paddle_tpu import checkpoint as _ckpt
+
+    model_dir = str(tmp_path / "mlp")
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [IN_DIM])
+        h = layers.fc(x, 8, act="relu")
+        y = layers.fc(h, OUT_DIM)
+    scope = pt.Scope()
+    pt.Executor().run(startup, scope=scope, use_compiled=False)
+    pt_io.save_inference_model(model_dir, ["x"], [y],
+                               main_program=main, scope=scope)
+    root = str(tmp_path / "models")
+    _ckpt.publish_model(root, model_dir)
+    return root
+
+
+@pytest.fixture()
+def mlp_cluster(tmp_path):
+    from paddle_tpu.serving.cluster import ClusterController
+
+    root = _publish_mlp(tmp_path)
+    cluster = ClusterController(root, replicas=2, inprocess=True).start()
+    try:
+        yield cluster
+    finally:
+        cluster.close()
+
+
+def _feed_arms(trial, trial_ms, control_ms, n=12):
+    """Deterministic per-arm latency evidence, recorded exactly where
+    real dispatches record it (ReplicaHandle.dispatch_samples)."""
+    for h in trial.router.handles():
+        ms = trial_ms if h.name == trial.trial_replica else control_ms
+        for _ in range(n):
+            h.record_dispatch(ms)
+
+
+CANDIDATE = {"serving_buckets": "4,8", "serving_batch_timeout_ms": 1.0}
+
+
+class TestOnlineTrial:
+    def test_candidate_lands_on_one_replica_then_promotes(
+            self, mlp_cluster):
+        snap = _flags.snapshot()
+        t0 = _counter("tuner.trials")
+        trial = tuner.OnlineTrial(mlp_cluster, CANDIDATE, fraction=0.25,
+                                  min_requests=8, max_evals=5,
+                                  label="t-promote")
+        trial.start()
+        assert _counter("tuner.trials") - t0 == 1
+        # the candidate config took on the TRIAL replica only — the
+        # swap machinery flipped config + predictor on one engine
+        for r in mlp_cluster.replicas:
+            if r.name == trial.trial_replica:
+                assert r.engine.config.buckets == [4, 8]
+            else:
+                assert r.engine.config.buckets == [1, 2, 4, 8]
+        # the router steers the bounded slice / excludes the trial arm
+        assert mlp_cluster.router.trial() == (trial.trial_replica, 0.25)
+        p0 = _counter("tuner.promotions")
+        _feed_arms(trial, trial_ms=5.0, control_ms=10.0)
+        res = trial.evaluate_once()
+        assert res is not None and res.status == "promoted"
+        assert _counter("tuner.promotions") - p0 == 1
+        assert mlp_cluster.router.trial() is None
+        # promoted flags are the new incumbent; fleet version untouched
+        assert _flags.flag("serving_buckets") == "4,8"
+        assert mlp_cluster.current_version == 1
+        for r in mlp_cluster.replicas:
+            assert r.engine.config.buckets == [4, 8]
+        _flags.apply(snap)
+
+    def test_latency_regression_rolls_back_clean(self, mlp_cluster):
+        snap = _flags.snapshot()
+        rb0 = _counter("tuner.rollbacks")
+        trial = tuner.OnlineTrial(mlp_cluster, CANDIDATE, fraction=0.25,
+                                  min_requests=8, max_evals=5,
+                                  label="t-regress")
+        trial.start()
+        _feed_arms(trial, trial_ms=50.0, control_ms=10.0)
+        res = trial.evaluate_once()
+        assert res is not None and res.status == "rolled_back"
+        assert res.reason == "latency_regression"
+        assert _counter("tuner.rollbacks") - rb0 == 1
+        # zero residual overrides + every replica back on the incumbent
+        assert _flags.snapshot() == snap
+        assert mlp_cluster.current_version == 1
+        for r in mlp_cluster.replicas:
+            assert r.engine.config.buckets == [1, 2, 4, 8]
+        # a second evaluate cannot double-book the rollback
+        assert trial.evaluate_once() is res
+        assert _counter("tuner.rollbacks") - rb0 == 1
+
+    def test_slo_trip_aborts_within_one_tick(self, mlp_cluster):
+        snap = _flags.snapshot()
+        incidents.reset()
+        wd = incidents.arm([incidents.Rule(
+            "t_gauge", "tuner_test.g", kind="gauge", threshold=5,
+            direction="above", cooldown_s=0.0)])
+        rb0 = _counter("tuner.rollbacks")
+        sa0 = _counter("tuner.slo_aborts")
+        trial = tuner.OnlineTrial(mlp_cluster, CANDIDATE, fraction=0.25,
+                                  min_requests=10_000, max_evals=50,
+                                  label="t-slo")
+        trial.start()
+        telemetry.gauge_set("tuner_test.g", 99)
+        wd.evaluate()                      # the rule trips mid-trial
+        res = trial.evaluate_once()        # ... and ONE tick aborts
+        assert res is not None and res.status == "rolled_back"
+        assert res.reason == "slo_trip" and res.evals == 1
+        assert _counter("tuner.rollbacks") - rb0 == 1
+        assert _counter("tuner.slo_aborts") - sa0 == 1
+        assert _flags.snapshot() == snap
+        assert mlp_cluster.current_version == 1
+
+    def test_undecided_trial_keeps_incumbent(self, mlp_cluster):
+        snap = _flags.snapshot()
+        trial = tuner.OnlineTrial(mlp_cluster, CANDIDATE, fraction=0.25,
+                                  min_requests=10_000, max_evals=2,
+                                  label="t-undecided")
+        trial.start()
+        assert trial.evaluate_once() is None
+        res = trial.evaluate_once()
+        assert res is not None and res.status == "rolled_back"
+        assert res.reason == "undecided"
+        assert _flags.snapshot() == snap
+
+
+class TestRouterTrialSteering:
+    def test_split_and_exclusion(self):
+        from paddle_tpu.serving.router import ReplicaHandle, Router
+
+        router = Router()
+        a = ReplicaHandle("a", "http://127.0.0.1:1")
+        b = ReplicaHandle("b", "http://127.0.0.1:2")
+        for h in (a, b):
+            h.ready = True
+            with router._lock:
+                router._handles.append(h)
+        router.set_trial("b", 0.25)
+        picks = [router.pick().name for _ in range(40)]
+        # every 4th pick steers to the trial arm, the rest exclude it
+        assert picks.count("b") == 10
+        assert all(n == "a" for i, n in enumerate(picks)
+                   if (i + 1) % 4 != 0)
+        # availability beats arm purity: control down -> trial serves
+        a.ready = False
+        assert router.pick().name == "b"
+        router.clear_trial()
+        assert router.trial() is None
+
+    def test_dispatch_latency_ring(self):
+        from paddle_tpu.serving.router import ReplicaHandle
+
+        h = ReplicaHandle("a", "http://127.0.0.1:1")
+        t0 = time.time()
+        h.record_dispatch(5.0)
+        h.record_dispatch(7.0)
+        assert h.dispatch_latencies(0.0) == [5.0, 7.0]
+        assert h.dispatch_latencies(t0 + 3600) == []
+
+
+# ---------------------------------------------------------------------------
+# perf_report section + CLI smoke
+# ---------------------------------------------------------------------------
+
+
+class TestReporting:
+    def test_perf_report_autotune_section(self):
+        sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+        from perf_report import render, summarize_log
+
+        recs = [
+            {"ts": 1.0, "kind": "counter", "name": "tuner.trials",
+             "value": 2, "attrs": {"delta": 2}},
+            {"ts": 1.0, "kind": "counter", "name": "tuner.rollbacks",
+             "value": 1, "attrs": {"delta": 1}},
+            {"ts": 1.0, "kind": "counter",
+             "name": "tuner.constraint_rejections", "value": 3,
+             "attrs": {"delta": 3}},
+            {"ts": 1.5, "kind": "tuner", "name": "trial_rolled_back",
+             "value": 12.5, "attrs": {"reason": "slo_trip",
+                                      "candidate": "k8"}},
+            {"ts": 1.6, "kind": "tuner", "name": "profile_applied",
+             "value": None, "attrs": {"profile_hash": "abc123"}},
+        ]
+        s = summarize_log(recs)
+        assert s["autotune"]["trials"] == 2
+        assert s["autotune"]["rollbacks"] == 1
+        assert s["autotune"]["constraint_rejections"] == 3
+        assert len(s["autotune"]["events"]) == 2
+        buf = io.StringIO()
+        render(s, out=buf)
+        text = buf.getvalue()
+        assert "-- autotune" in text
+        assert "rollbacks: 1" in text
+        assert "profile_applied: abc123" in text
+
+    def test_quiet_log_renders_no_section(self):
+        sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+        from perf_report import summarize_log
+
+        assert summarize_log([])["autotune"] is None
+
+    def test_autotune_cli_offline_smoke(self, tmp_path):
+        log = _write_log(tmp_path, [_metric_record(10.0, 1),
+                                    _metric_record(7.0, 4)])
+        out = str(tmp_path / "profile.json")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                          "autotune.py"),
+             "offline", "--log", log, "--out", out,
+             "--require-improvement", "--json"],
+            capture_output=True, text=True, timeout=120,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert proc.returncode == 0, proc.stderr
+        doc = json.loads(proc.stdout)
+        assert doc["improved"] is True
+        assert doc["profile"]["flags"] == {"exec_steps_per_dispatch": 8}
+        saved = tuner.load_profile(out)
+        assert saved["profile_hash"] == doc["profile"]["profile_hash"]
+
+    def test_autotune_cli_rejects_junk_log(self, tmp_path):
+        log = str(tmp_path / "empty.jsonl")
+        open(log, "w").close()
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                          "autotune.py"),
+             "offline", "--log", log],
+            capture_output=True, text=True, timeout=120,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert proc.returncode == 2
+        assert "no step-time" in proc.stderr

@@ -484,7 +484,7 @@ def _emitted_metric_names():
                     name = m.group(1).split("{", 1)[0]
                     if name.startswith(("cost.", "mem.", "costmodel.",
                                         "pallas.", "incidents.",
-                                        "slo.")) or \
+                                        "slo.", "tuner.")) or \
                             (name.startswith("sharding.")
                              and "state_bytes" in name):
                         names.add(name)
@@ -514,6 +514,11 @@ class TestMetricDriftGuard:
         assert "incidents.rate_limited" in names
         assert "slo.trips" in names
         assert "slo.evaluations" in names
+        # the cost-model-guided autotuner (core/tuner.py)
+        assert "tuner.trials" in names
+        assert "tuner.promotions" in names
+        assert "tuner.rollbacks" in names
+        assert "tuner.constraint_rejections" in names
         renderers = ""
         for tool in ("perf_report.py", "mem_report.py"):
             with open(os.path.join(REPO_ROOT, "tools", tool)) as f:

@@ -87,11 +87,11 @@ class TestPredictorCacheAndHandles:
         import paddle_tpu as pt
         from paddle_tpu.core import telemetry
 
+        from paddle_tpu.core import flags as _flags
+
         pred = self._mlp_predictor(tmp_path, scope)
-        old = pt.get_flags("FLAGS_predictor_cache_capacity")
-        pt.set_flags({"FLAGS_predictor_cache_capacity": 2})
         before = telemetry.counter_get("predictor.cache_evictions")
-        try:
+        with _flags.overrides(predictor_cache_capacity=2):
             for rows in (1, 2, 3):      # 3 signatures > capacity 2
                 pred.run({"x": np.zeros((rows, 6), np.float32)})
             assert len(pred._cache) == 2
@@ -101,8 +101,6 @@ class TestPredictorCacheAndHandles:
             x = np.random.RandomState(0).randn(1, 6).astype(np.float32)
             out, = pred.run({"x": x})
             assert out.shape == (1, 4)
-        finally:
-            pt.set_flags(old)
 
     def test_cache_hits_counted(self, tmp_path, scope):
         from paddle_tpu.core import telemetry

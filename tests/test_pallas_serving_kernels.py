@@ -281,13 +281,12 @@ class TestPagedAttnChunkedOnlineSoftmax(_PagedAttnCase):
         self.outputs = {"Out": out, "PoolKOut": pk, "PoolVOut": pv}
 
     def test_interpret_oracle(self):
-        old = _flag("pallas_kv_chunk_tokens")
-        set_flags({"pallas_kv_chunk_tokens": 16})   # 2 pages/chunk
-        try:
+        from paddle_tpu.core import flags as _flags
+
+        # 2 pages/chunk (typed scoped override, exact restore)
+        with _flags.overrides(pallas_kv_chunk_tokens=16):
             with _pallas("interpret"):
                 self.check_output(atol=2e-5, rtol=2e-5)
-        finally:
-            set_flags({"pallas_kv_chunk_tokens": old})
 
 
 # ---------------------------------------------------------------------------

@@ -471,10 +471,10 @@ class TestPassSweepBookModels:
 
 @pytest.fixture
 def verify_flag():
-    old = pt.get_flags("FLAGS_verify_program")["FLAGS_verify_program"]
-    pt.set_flags({"FLAGS_verify_program": True})
-    yield
-    pt.set_flags({"FLAGS_verify_program": old})
+    from paddle_tpu.core import flags as _flags
+
+    with _flags.overrides(verify_program=True):
+        yield
 
 
 class TestExecutorGate:
