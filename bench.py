@@ -26,6 +26,12 @@ rate / peak device flops), so BENCH rows are self-attributing; with
 FLAGS_cost_capture=full the row also carries the composed HBM ledger
 total (extra.mem_hbm_total_bytes).
 
+Goodput: every row embeds ``extra.goodput`` — the core/goodput.py
+wall-clock attribution (goodput ratio + per-phase badput ms: data
+wait, host dispatch, compile, checkpoint, collective, recovery), so a
+throughput regression in the row is attributable to the phase that ate
+the wall time; tools/slo_check.py gates on the ratio vs history.
+
 SLO gate: every row embeds ``extra.slo`` — the tools/slo_check.py
 verdict of this run against the committed BENCH_r*.json history
 (pass / regress / no_baseline + the failed metric list), so a

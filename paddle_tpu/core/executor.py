@@ -24,7 +24,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
-from . import costmodel, incidents, registry, telemetry, trace
+from . import costmodel, goodput, incidents, registry, telemetry, trace
 from .ir import Block, OpDesc, Program, Variable, default_main_program
 from .registry import EMPTY_VAR
 from .scope import Scope, global_scope
@@ -943,6 +943,9 @@ class Executor:
                     f"{program.uid}v{program.version}", e,
                     where="executor.dispatch") from e
             raise
+        # device-compute wall of the jitted call (goodput ledger's
+        # "productive" phase; the post-call booking below is host time)
+        t_dev_end = time.perf_counter()
         costmodel.book_dispatch(entry.cost, steps=scan_k or 1)
         # sharded-training collective accounting: the ShardingOptimizer
         # (fleet/meta_optimizers.py) precomputes the per-step dp-collective
@@ -992,12 +995,23 @@ class Executor:
             # these are the step-time percentiles in the run log).
             # Fused dispatches land in their own histogram: one sample
             # covers scan_k device steps
+            run_ms = (time.perf_counter() - t_run) * 1e3
             telemetry.observe(
                 "executor.run_steps_ms" if scan_k else "executor.run_ms",
-                (time.perf_counter() - t_run) * 1e3, kind="timer")
+                run_ms, kind="timer")
+            # goodput-ledger split of the same wall: the jitted call is
+            # the productive device-compute phase, everything after it
+            # (cost booking, collective accounting) is host dispatch
+            dev_ms = (t_dev_end - t_run) * 1e3
+            telemetry.observe("executor.device_ms", dev_ms, kind="timer")
+            telemetry.observe("executor.host_dispatch_ms",
+                              max(0.0, run_ms - dev_ms), kind="timer")
         # SLO watchdog hook: evaluates the rule set at most every
         # FLAGS_slo_eval_s while armed, one boolean read otherwise
         incidents.tick()
+        # goodput-ledger refresh (goodput.ratio live on /metrics) —
+        # throttled to FLAGS_goodput_publish_s, inert without a window
+        goodput.tick()
         from .flags import flag as _flag
 
         if _flag("check_nan_inf"):
@@ -1286,7 +1300,26 @@ class Executor:
             batches = _it.islice(batches, start_step, None)
             telemetry.counter_add("executor.reader_skipped_batches",
                                   start_step)
-        for feed in batches:
+
+        # goodput ledger (core/goodput.py): open an attribution window
+        # unless the caller already did, and time every batch fetch —
+        # the loop blocked on the data path is the data_wait phase
+        goodput.ensure_run()
+
+        def _timed_batches(it):
+            it = iter(it)
+            while True:
+                t_wait = time.perf_counter()
+                try:
+                    feed = next(it)
+                except StopIteration:
+                    return
+                telemetry.observe("reader.data_wait_ms",
+                                  (time.perf_counter() - t_wait) * 1e3,
+                                  kind="timer")
+                yield feed
+
+        for feed in _timed_batches(batches):
             bad = [kk for kk, v in feed.items() if isinstance(v, tuple)]
             if bad:
                 raise ExecutionError(
@@ -1309,6 +1342,9 @@ class Executor:
                 "dataset produced no batches — for InMemoryDataset call "
                 "load_into_memory() before training (resuming past the "
                 "end of the stream also lands here)")
+        # land the run's goodput counters + ratio gauge (the window stays
+        # open: a caller-owned window keeps accumulating across calls)
+        goodput.publish()
         if fetch_handler is not None and last is not None:
             fetch_handler(dict(zip(fetch_names, last)))
         return last

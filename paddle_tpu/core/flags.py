@@ -563,6 +563,51 @@ define_flag("tuner_hbm_capacity_bytes", 0,
             "0.92); 0 disables the gate when no measured ledger capacity "
             "is available (CPU container)")
 
+# -- fleet observatory + goodput ledger (core/fleetobs.py, core/goodput.py;
+#    reference analogs: heart_beat_monitor.h fleet liveness, monitor.h stat
+#    aggregation, profiler timeline attribution) ------------------------------
+
+define_flag("fleet_enable", False,
+            "start a FleetAggregator inside ClusterController.start() "
+            "(scrape every replica + the router into merged fleet "
+            "windows, serve /fleet/status + /fleet/metrics on the "
+            "router front end). Opt-in: per-process observability stays "
+            "the default")
+define_flag("fleet_scrape_interval_s", 1.0,
+            "seconds between two fleet scrape passes (every member's "
+            "/metrics + /v1/stats)")
+define_flag("fleet_stale_after_s", 5.0,
+            "seconds without a successful scrape before a member is "
+            "marked STALE. A stale member keeps its last-known load "
+            "(never zeroed) and stops contributing to fleet windows; "
+            "the scrape loop never wedges on it")
+define_flag("fleet_straggler_zscore", 3.0,
+            "per-member latency z-score vs the fleet median above which "
+            "a member is flagged a straggler (router pick() deprioritises "
+            "flagged replicas; the fleet_straggler_replica rule trips)")
+define_flag("fleet_min_members", 3,
+            "minimum members with fresh latency evidence before "
+            "straggler z-scores are computed — outlier math on 2 "
+            "members is a coin flip")
+define_flag("fleet_straggler_metric",
+            "serving.request_ms,router.dispatch_ms,executor.run_ms,"
+            "executor.run_steps_ms",
+            "comma list of latency histograms tried in order as the "
+            "per-member straggler/step-time evidence (first one a "
+            "member exposes wins)")
+define_flag("fleet_qps_floor", 0.0,
+            "fleet-level SLO: aggregate request throughput (fleet.qps) "
+            "below this floor trips the fleet_qps_floor rule; 0 "
+            "disables the rule")
+define_flag("fleet_queue_saturation", 0.9,
+            "fleet-level SLO: fraction of the per-replica admission "
+            "bound (FLAGS_serving_max_queue_depth) the fleet-AVERAGE "
+            "queue depth may reach before fleet_queue_saturation trips")
+define_flag("goodput_publish_s", 2.0,
+            "seconds between goodput-ledger publishes on the executor "
+            "hot path (goodput.* counters + the goodput.ratio gauge "
+            "refreshed on /metrics while the run is live)")
+
 define_flag("ps_degrade_to_survivors", False,
             "when the HeartBeatMonitor declares a trainer dead, shrink "
             "the sync barrier to the live set (mean over survivors) "

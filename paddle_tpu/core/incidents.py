@@ -554,6 +554,16 @@ def report_incident(source: str, name: str, value=None,
         traces = trace.recent_trace_ids()
     except Exception:
         pass
+    # where the wall-clock went at the moment of the trip (PR 16
+    # goodput ledger) — a step-time regression dump that already says
+    # "80% data_wait" saves the whole postmortem
+    goodput_view = None
+    try:
+        from . import goodput as _goodput
+
+        goodput_view = _goodput.breakdown()
+    except Exception:
+        pass
     try:
         ring_cap = int(_flags.flag("incident_ring_records"))
     except Exception:
@@ -567,6 +577,7 @@ def report_incident(source: str, name: str, value=None,
         "ring_dropped": _recorder.dropped,
         "ledger": ledger,
         "traces": traces,
+        "goodput": goodput_view,
         "counters": telemetry.counters(),
     }
     if rule is not None:

@@ -53,20 +53,31 @@ renders them in Prometheus exposition format, and
 ``start_metrics_server(port)`` serves ``GET /metrics`` from any process
 (trainer, pserver, serving worker) — the pull-based scrape surface the
 cluster control plane (ROADMAP item 2) load-balances on.
+
+Mergeable histograms: every histogram additionally counts observations
+into FIXED log-spaced buckets (``HIST_BUCKET_BOUNDS`` — identical in
+every process by construction), exported as cumulative
+``pt_<name>_bucket{le="..."}`` series alongside the window summaries.
+Bucket counts merge EXACTLY across processes by addition — the fleet
+aggregator (core/fleetobs.py) computes fleet-level percentiles from
+pooled bucket counts (``merge_bucket_counts`` + ``bucket_quantile``)
+instead of the unsound average-of-quantiles.
 """
 
 from __future__ import annotations
 
 import atexit
+import bisect
 import contextlib
 import json
+import math
 import os
 import re
 import sys
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Sequence
 
 from . import flags as _flags
 from .analysis import lockdep as _lockdep
@@ -88,12 +99,58 @@ _HIST_SAMPLE_CAP = 8192  # per-histogram retained samples (sliding ring)
 _WIN_BUCKET_CAP = 600    # rolling-window 1 s counter buckets (10 min cap)
 _WIN_SAMPLE_CAP = 8192   # rolling-window retained histogram samples
 
+#: Fixed log-spaced histogram bucket upper bounds, 4 per decade from
+#: 1e-3 to 1e7 (ms-scale timers land mid-range; byte-ish values still
+#: fit). The SAME tuple in every process is what makes cross-process
+#: bucket counts addable — never derive bounds from runtime state.
+HIST_BUCKET_BOUNDS: tuple = tuple(
+    round(10.0 ** (i / 4.0) * 1e-3, 9) for i in range(41))
+
+
+def bucket_index(v: float) -> int:
+    """Index of the bucket counting ``v`` (le semantics: first bound
+    >= v); len(HIST_BUCKET_BOUNDS) means the +Inf overflow bucket."""
+    return bisect.bisect_left(HIST_BUCKET_BOUNDS, float(v))
+
+
+def merge_bucket_counts(counts_seq: Sequence[Sequence[int]]) -> List[int]:
+    """Element-wise sum of per-bucket (NON-cumulative) count vectors —
+    the exact cross-registry histogram merge. Short vectors are treated
+    as zero-padded (forward compatibility)."""
+    out = [0] * (len(HIST_BUCKET_BOUNDS) + 1)
+    for counts in counts_seq:
+        for i, c in enumerate(counts):
+            if i < len(out):
+                out[i] += int(c)
+    return out
+
+
+def bucket_quantile(counts: Sequence[int], q: float) -> float:
+    """Quantile estimate from per-bucket counts: the UPPER bound of the
+    bucket holding the q-th sample (so the true value is within one
+    bucket boundary below). Overflow samples clamp to the last finite
+    bound — the estimate stays JSON-safe. 0.0 when empty."""
+    total = sum(int(c) for c in counts)
+    if total <= 0:
+        return 0.0
+    # same rank rule as the sample-ring percentile: 0-based index
+    rank = min(total - 1, int(q * (total - 1) + 0.5))
+    cum = 0
+    for i, c in enumerate(counts):
+        cum += int(c)
+        if cum > rank:
+            return HIST_BUCKET_BOUNDS[min(i, len(HIST_BUCKET_BOUNDS) - 1)]
+    return HIST_BUCKET_BOUNDS[-1]
+
 
 class _Hist:
     """Running histogram: exact count/sum/min/max + a bounded sample ring
-    for percentile estimates (recent-window semantics once full)."""
+    for percentile estimates (recent-window semantics once full) + fixed
+    log-spaced bucket counts (HIST_BUCKET_BOUNDS, exact cross-process
+    merge — the pt_*_bucket exposition)."""
 
-    __slots__ = ("count", "total", "vmin", "vmax", "samples", "_next")
+    __slots__ = ("count", "total", "vmin", "vmax", "samples", "_next",
+                 "buckets")
 
     def __init__(self):
         self.count = 0
@@ -102,6 +159,7 @@ class _Hist:
         self.vmax = float("-inf")
         self.samples = []
         self._next = 0
+        self.buckets = [0] * (len(HIST_BUCKET_BOUNDS) + 1)
 
     def observe(self, v: float):
         v = float(v)
@@ -109,6 +167,10 @@ class _Hist:
         self.total += v
         self.vmin = min(self.vmin, v)
         self.vmax = max(self.vmax, v)
+        if math.isfinite(v):
+            self.buckets[bisect.bisect_left(HIST_BUCKET_BOUNDS, v)] += 1
+        else:
+            self.buckets[-1] += 1
         if len(self.samples) < _HIST_SAMPLE_CAP:
             self.samples.append(v)
         else:
@@ -374,6 +436,13 @@ class TelemetryRegistry:
                     "hists": {n: h.summary()
                               for n, h in self._hists.items()}}
 
+    def hist_buckets(self) -> Dict[str, List[int]]:
+        """Per-histogram NON-cumulative bucket counts over
+        HIST_BUCKET_BOUNDS (+ overflow slot) — the mergeable view the
+        fleet aggregator pools across registries."""
+        with self._lock:
+            return {n: list(h.buckets) for n, h in self._hists.items()}
+
     def reset(self):
         """Clear all in-memory aggregates (tests). Leaves the sink alone."""
         with self._lock:
@@ -435,14 +504,17 @@ class TelemetryRegistry:
     def prometheus_text(self, window_s: Optional[float] = None) -> str:
         """Prometheus text exposition (0.0.4): cumulative counters as
         ``pt_<name>_total``, rolling-window rates as ``pt_<name>_rate``,
-        gauges, and histograms as summaries whose quantiles are computed
-        over the rolling window (cumulative _sum/_count)."""
+        gauges, histograms as summaries whose quantiles are computed
+        over the rolling window (cumulative _sum/_count), plus the
+        cumulative fixed-bucket ``pt_<name>_bucket{le="..."}`` series
+        (le-ordered, ending with +Inf) the fleet aggregator merges
+        exactly."""
         win = self.windowed(window_s)
         W = int(win["window_s"])
         with self._lock:
             cum = {n: v for n, v in self._counters.items()
                    if isinstance(v, (int, float))}
-            hist_cum = {n: (h.count, h.total)
+            hist_cum = {n: (h.count, h.total, list(h.buckets))
                         for n, h in self._hists.items()}
         lines = []
         for name in sorted(cum):
@@ -461,7 +533,7 @@ class TelemetryRegistry:
             lines.append(f"# TYPE {_prom_name(name)} gauge")
             lines.append(f"{_prom_name(name)} {_prom_num(v)}")
         for name in sorted(hist_cum):
-            cnt, tot = hist_cum[name]
+            cnt, tot, buckets = hist_cum[name]
             m = _prom_name(name)
             wh = win["hists"].get(name)
             lines.append(f"# TYPE {m} summary")
@@ -472,6 +544,17 @@ class TelemetryRegistry:
                                  f'{_prom_num(wh[key])}')
             lines.append(f"{m}_sum {_prom_num(round(tot, 4))}")
             lines.append(f"{m}_count {cnt}")
+            # cumulative fixed-bucket series: identical le labels in
+            # every process (HIST_BUCKET_BOUNDS), so fleet-side merging
+            # is pure addition of counts under matching labels. le must
+            # be emitted EXACTLY (repr, not _prom_num's 6-decimal
+            # rounding): a rounded-up label maps into the next bucket
+            # on the scrape side and misaligns the merge
+            running = 0
+            for bound, c in zip(HIST_BUCKET_BOUNDS, buckets):
+                running += c
+                lines.append(f'{m}_bucket{{le="{bound!r}"}} {running}')
+            lines.append(f'{m}_bucket{{le="+Inf"}} {cnt}')
             if wh:
                 lines.append(f"# TYPE {m}_window_rate gauge")
                 lines.append(f'{m}_window_rate{{window="{W}s"}} '
@@ -659,6 +742,10 @@ def gauges() -> Dict[str, Any]:
 
 def snapshot() -> Dict[str, Any]:
     return _reg().snapshot()
+
+
+def hist_buckets() -> Dict[str, List[int]]:
+    return _reg().hist_buckets()
 
 
 def enabled() -> bool:

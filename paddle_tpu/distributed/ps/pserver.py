@@ -274,6 +274,15 @@ class PServer:
                                                    "heartbeat"):
             self.monitor.ping(aux)
         if method == "heartbeat":
+            if name:
+                # the beat's name field carries the trainer's metrics
+                # URL (rpc.start_heartbeat metrics_url): hand it to the
+                # fleet observatory when one is running here
+                try:
+                    from ...core import fleetobs
+                    fleetobs.announce(f"trainer-{aux}", name)
+                except Exception:
+                    pass
             return None, 0
         if method.startswith("kv_"):
             # under the apply lock: checkpoint snapshots take the same

@@ -493,13 +493,19 @@ class RPCClient:
             pass
 
 
-def start_heartbeat(endpoints, trainer_id: int, interval: float = 10.0):
+def start_heartbeat(endpoints, trainer_id: int, interval: float = 10.0,
+                    metrics_url: str = ""):
     """Trainer-side liveness pings (reference: the trainer's periodic
     beat consumed by heart_beat_monitor.h). A daemon thread pings every
     pserver on its own connection so a trainer blocked in a sync recv
     still reads as alive. Returns a stop() callable; stop also closes
     the private sockets (under the same lock the beat thread holds while
-    using them, so a close can't race a call in flight)."""
+    using them, so a close can't race a call in flight).
+
+    ``metrics_url`` (the trainer's telemetry.start_metrics_server URL,
+    when it runs one) rides the beat's spare ``name`` field: the pserver
+    lands it in core/fleetobs.announce, so a fleet aggregator colocated
+    with the PS tier scrapes trainers with zero extra RPCs."""
     if isinstance(endpoints, str):
         endpoints = [e.strip() for e in endpoints.split(",") if e.strip()]
     stop = threading.Event()
@@ -520,7 +526,8 @@ def start_heartbeat(endpoints, trainer_id: int, interval: float = 10.0):
                     try:
                         if clients[ep] is None:
                             clients[ep] = RPCClient(ep, timeout=interval)
-                        clients[ep].call("heartbeat", aux=int(trainer_id),
+                        clients[ep].call("heartbeat", name=metrics_url,
+                                         aux=int(trainer_id),
                                          deadline=interval, max_retries=0)
                     except (RpcError, ConnectionError, OSError):
                         cli, clients[ep] = clients[ep], None

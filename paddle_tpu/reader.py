@@ -503,8 +503,17 @@ class _GeneratorLoader:
                          daemon=True).start()
         skip, self._skip_next = self._skip_next, 0
         self._position = 0
+        import time as _time
+
+        from .core import telemetry as _telemetry
         while True:
+            # consumer-side queue wait: the training loop blocked on the
+            # prefetch thread — the goodput ledger's data_wait phase
+            t_wait = _time.perf_counter()
             item = q.get()
+            _telemetry.observe("reader.data_wait_ms",
+                               (_time.perf_counter() - t_wait) * 1e3,
+                               kind="timer")
             if item is _END:
                 break
             self._position += 1

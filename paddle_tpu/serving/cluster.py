@@ -44,7 +44,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional
 
 from .. import checkpoint as _ckpt
-from ..core import retry, telemetry
+from ..core import fleetobs, retry, telemetry
 from ..core.analysis import lockdep
 from ..core.flags import flag as _flag
 from .router import Router, RouterHTTPServer, _http_json
@@ -237,7 +237,8 @@ class ClusterController:
                  model_poll_s: Optional[float] = None,
                  max_restarts: Optional[int] = None,
                  replica_telemetry_dir: str = "",
-                 auto_swap: bool = True):
+                 auto_swap: bool = True,
+                 fleet: Optional[bool] = None):
         self.model_root = os.path.abspath(model_root)
         self.n_replicas = int(replicas)
         self.inprocess = bool(inprocess)
@@ -265,6 +266,12 @@ class ClusterController:
         self._swap_lock = lockdep.lock("cluster.swap")
         self._counted_dead: set = set()
         self.current_version: Optional[int] = None
+        # fleet observatory (core/fleetobs.py): opt-in per cluster or
+        # fleet-wide via FLAGS_fleet_enable — scrapes every member's
+        # /metrics into merged fleet windows + /fleet/* on the router
+        self.fleet_enabled = bool(_flag("fleet_enable")) if fleet is None \
+            else bool(fleet)
+        self.fleet_aggregator: Optional[fleetobs.FleetAggregator] = None
 
     # -- lifecycle -----------------------------------------------------------
     @property
@@ -303,6 +310,14 @@ class ClusterController:
         self.router.start()
         self.router_server.start()
         self._wait_ready(ready_timeout_s)
+        if self.fleet_enabled:
+            self.fleet_aggregator = fleetobs.FleetAggregator()
+            self.fleet_aggregator.register("router", self.url,
+                                           kind="router")
+            for replica in self.replicas:
+                self.fleet_aggregator.register(replica.name, replica.url)
+            self.router.attach_fleet(self.fleet_aggregator)
+            self.fleet_aggregator.start()
         mon = threading.Thread(target=self._monitor_loop,
                                name="pt-cluster-monitor", daemon=True)
         mon.start()
@@ -331,6 +346,8 @@ class ClusterController:
         for t in self._threads:
             t.join(timeout=10)
         self._threads = []
+        if self.fleet_aggregator is not None:
+            self.fleet_aggregator.stop()
         self.router_server.shutdown()
         self.router.close()
         for replica in self.replicas:
@@ -380,6 +397,11 @@ class ClusterController:
                     if handle is not None:
                         handle.rebind(fresh.url)
                         self.router.probe(handle)
+                    if self.fleet_aggregator is not None:
+                        # a respawn keeps its fleet slot — re-point the
+                        # scrape at the fresh endpoint
+                        self.fleet_aggregator.register(replica.name,
+                                                       fresh.url)
                     # a respawn comes up on the NEWEST published version;
                     # converge it if the fleet is ahead/behind
                     if self.current_version is not None and \
@@ -543,4 +565,8 @@ class ClusterController:
         out["restarts"] = dict(self._restarts)
         out["replica_backend"] = "inprocess" if self.inprocess \
             else "process"
+        if self.fleet_aggregator is not None:
+            out["fleet"] = {
+                "members": self.fleet_aggregator.members(),
+                "stragglers": self.fleet_aggregator.straggler_names()}
         return out

@@ -130,19 +130,38 @@ class ReplicaHandle:
                     if ts >= since_ts]
 
     # -- balancing -----------------------------------------------------------
+    def probe_age_s(self) -> Optional[float]:
+        """Seconds since the last SUCCESSFUL probe; None before the
+        first. The staleness evidence behind score()'s failure penalty
+        and the /v1/stats `last_probe_age_s` field."""
+        with self._lock:
+            t = self.last_probe_t
+        if not t:
+            return None
+        return round(time.monotonic() - t, 3)
+
     def score(self) -> int:
         """Load estimate: last scraped queue depth + our own in-flight
-        dispatches (covers requests sent since the last probe)."""
+        dispatches (covers requests sent since the last probe), plus a
+        penalty per consecutive probe failure — a handle whose probe
+        just failed keeps its STALE queue depth (mark_down never zeroes
+        it), and the penalty stops that stale depth from reading as
+        "least loaded" next to replicas with fresh evidence."""
         with self._lock:
-            return self.queue_depth + self.inflight
+            return (self.queue_depth + self.inflight
+                    + self.consecutive_failures)
 
     def snapshot(self) -> Dict[str, Any]:
+        age = self.probe_age_s()
         with self._lock:
             return {"name": self.name, "url": self.url, "ready": self.ready,
                     "queue_depth": self.queue_depth,
                     "inflight": self.inflight,
                     "model_version": self.model_version,
-                    "consecutive_failures": self.consecutive_failures}
+                    "consecutive_failures": self.consecutive_failures,
+                    "probe_failures": self.consecutive_failures,
+                    "last_probe_age_s": age,
+                    "stale": self.consecutive_failures > 0}
 
 
 def _http_json(method: str, url: str, path: str,
@@ -205,6 +224,10 @@ class Router:
         # other pick EXCLUDES it, so each arm's latency evidence is pure
         self._trial: Optional[Tuple[str, float]] = None
         self._trial_count = 0
+        # fleet observatory tap (core/fleetobs.FleetAggregator): when
+        # attached, pick() deprioritises flagged stragglers and the
+        # front end serves /fleet/status + /fleet/metrics
+        self._fleet = None
 
     # -- membership ----------------------------------------------------------
     def add_replica(self, name: str, url: str) -> ReplicaHandle:
@@ -285,6 +308,25 @@ class Router:
         with self._lock:
             return self._trial
 
+    # -- fleet observatory ----------------------------------------------------
+    def attach_fleet(self, aggregator):
+        """Wire a core/fleetobs.FleetAggregator into the router: pick()
+        prefers non-straggler replicas and the HTTP front end gains the
+        /fleet/status + /fleet/metrics surfaces."""
+        self._fleet = aggregator
+
+    def fleet(self):
+        return self._fleet
+
+    def _straggler_names(self):
+        agg = self._fleet
+        if agg is None:
+            return ()
+        try:
+            return agg.straggler_names()
+        except Exception:
+            return ()
+
     # -- balancing -----------------------------------------------------------
     def pick(self, exclude=()) -> Optional[ReplicaHandle]:
         """READY replica with the lowest load score, skipping `exclude`;
@@ -330,15 +372,24 @@ class Router:
     def _pick_from(self, handles, offset, exclude) -> Optional[ReplicaHandle]:
         best = None
         best_score = None
-        for j in range(len(handles)):
-            handle = handles[(offset + j) % len(handles)]
-            if handle in exclude or not handle.ready:
-                continue
-            s = handle.score()
-            if best_score is None or s < best_score:
-                best, best_score = handle, s
-        if best is not None:
-            return best
+        # fleet-flagged stragglers lose the first pass: with an attached
+        # aggregator a latency outlier only carries traffic when it is
+        # the last routable replica (availability beats avoidance)
+        stragglers = self._straggler_names()
+        for skip_stragglers in ((True, False) if stragglers else (False,)):
+            for j in range(len(handles)):
+                handle = handles[(offset + j) % len(handles)]
+                if handle in exclude or not handle.ready:
+                    continue
+                if skip_stragglers and handle.name in stragglers:
+                    continue
+                s = handle.score()
+                if best_score is None or s < best_score:
+                    best, best_score = handle, s
+            if best is not None:
+                if stragglers and not skip_stragglers:
+                    telemetry.counter_quiet("router.straggler_fallback")
+                return best
         # nothing READY: fall back to a SWAPPING replica — it is alive
         # and still serving its old model version while the new one
         # warms. Without this, a kill overlapping a rolling swap leaves
@@ -627,6 +678,24 @@ class _RouterHandler(BaseHTTPRequestHandler):
             self._reply(200, router.stats())
         elif self.path == "/metrics":
             body = telemetry.prometheus_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif self.path == "/fleet/status":
+            agg = router.fleet()
+            if agg is None:
+                self._reply(404, {"error": "no fleet aggregator attached"})
+            else:
+                self._reply(200, agg.status())
+        elif self.path == "/fleet/metrics":
+            agg = router.fleet()
+            if agg is None:
+                self._reply(404, {"error": "no fleet aggregator attached"})
+                return
+            body = agg.metrics_text().encode()
             self.send_response(200)
             self.send_header("Content-Type",
                              "text/plain; version=0.0.4; charset=utf-8")

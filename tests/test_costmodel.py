@@ -484,7 +484,8 @@ def _emitted_metric_names():
                     name = m.group(1).split("{", 1)[0]
                     if name.startswith(("cost.", "mem.", "costmodel.",
                                         "pallas.", "incidents.",
-                                        "slo.", "tuner.")) or \
+                                        "slo.", "tuner.",
+                                        "goodput.", "fleet.")) or \
                             (name.startswith("sharding.")
                              and "state_bytes" in name):
                         names.add(name)
@@ -519,6 +520,18 @@ class TestMetricDriftGuard:
         assert "tuner.promotions" in names
         assert "tuner.rollbacks" in names
         assert "tuner.constraint_rejections" in names
+        # the goodput ledger (core/goodput.py) — badput_<phase> emits
+        # via an f-string, so the scraped name is the static prefix
+        assert "goodput.productive_ms" in names
+        assert "goodput.wall_ms" in names
+        assert "goodput.ratio" in names
+        assert "goodput.badput_" in names
+        # the fleet observatory (core/fleetobs.py)
+        assert "fleet.scrapes" in names
+        assert "fleet.scrape_failures" in names
+        assert "fleet.members_went_stale" in names
+        assert "fleet.stragglers" in names
+        assert "fleet.qps" in names
         renderers = ""
         for tool in ("perf_report.py", "mem_report.py"):
             with open(os.path.join(REPO_ROOT, "tools", tool)) as f:

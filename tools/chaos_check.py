@@ -62,6 +62,16 @@ response (dedup-verified by request id), p99 stays under --p99-bound,
 the swap completed (responses carry the new version), and the fault /
 failover / swap telemetry tally is printed.
 
+With ``--fleet`` it chaos-tests the fleet observatory
+(paddle_tpu/core/fleetobs.py): a live cluster of replica processes with
+the fleet aggregator scraping every member's /metrics. A clean phase
+must show every member OK with zero fleet SLO rule trips; then one
+replica is SIGKILLed mid-scrape and the gate asserts the aggregator
+marks exactly that member STALE without wedging the scrape loop (the
+survivors' scrape ages stay fresh), the ``fleet_member_stale`` rule
+trips EXACTLY once for the whole episode, and tools/fleet_report.py
+still renders the plane.
+
 Examples:
     python tools/chaos_check.py --fault-spec "ps.rpc.send:0.1" --seed 7
     python tools/chaos_check.py --fault-spec "ps.rpc.recv:%9" --steps 8 \
@@ -74,6 +84,7 @@ Examples:
         --fault-spec "ckpt.save.commit:%3,ckpt.restore.read:@1" --steps 8
     python tools/chaos_check.py --cluster --replicas 2 --requests 400 \
         --fault-spec "router.dispatch:0.02,serving.handler:%7"
+    python tools/chaos_check.py --fleet --replicas 2
 
 Exit status: 0 on success, 2 when the run failed or did not converge.
 Stdlib-only CLI surface (argparse); everything heavier lives in
@@ -981,6 +992,183 @@ def run_cluster(args) -> int:
     return 0
 
 
+def run_fleet(args) -> int:
+    """--fleet mode: the fleet-observatory gate (core/fleetobs.py), in
+    two phases over one live cluster of replica PROCESSES:
+
+    1. clean — the aggregator scrapes every member for a few passes;
+       every member must be OK with fresh scrape ages and ZERO fleet
+       SLO rule trips (false-positive gate);
+    2. kill — one replica is SIGKILLed mid-scrape; the aggregator must
+       mark exactly that member STALE without wedging (the surviving
+       members' scrape ages stay fresh, passes keep advancing), the
+       fleet_member_stale rule must trip EXACTLY once for the whole
+       episode, and tools/fleet_report.py must still render the plane
+       (live members > 0 -> exit 0).
+    """
+    import tempfile
+    import time
+
+    import paddle_tpu as pt
+    from paddle_tpu import checkpoint as ckpt
+    from paddle_tpu import io, layers
+    from paddle_tpu.core import telemetry
+    from paddle_tpu.serving import ClusterController, ServingConfig
+
+    if args.telemetry_log:
+        telemetry.configure(args.telemetry_log)
+    # fast scrape/staleness clocks so the gate runs in seconds; respawn
+    # disabled (max_restarts=0) so the SIGKILLed replica STAYS dead and
+    # the staleness episode persists
+    pt.set_flags({"FLAGS_fleet_scrape_interval_s": 0.2,
+                  "FLAGS_fleet_stale_after_s": 1.0})
+
+    def save_mlp(d, seed):
+        main_p, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main_p, startup):
+            x = layers.data("x", [16])
+            y = layers.fc(x, 4, param_attr=pt.ParamAttr(
+                name="fl_w0", initializer=pt.initializer.Xavier(seed=seed)))
+        scope = pt.Scope()
+        exe = pt.Executor()
+        exe.run(startup, scope=scope, use_compiled=False)
+        io.save_inference_model(d, ["x"], [y], main_program=main_p,
+                                scope=scope)
+
+    with tempfile.TemporaryDirectory(prefix="pt_chaos_fleet_") as tmp:
+        save_mlp(tmp + "/m1", 29)
+        root = tmp + "/models"
+        ckpt.publish_model(root, tmp + "/m1", version=1)
+        cluster = ClusterController(
+            root, replicas=args.replicas, inprocess=False,
+            serving_config=ServingConfig(max_batch_size=4,
+                                         batch_timeout_ms=1.0),
+            max_restarts=0, auto_swap=False,
+            fleet=True).start(ready_timeout_s=180)
+        agg = cluster.fleet_aggregator
+        print(f"cluster up: {args.replicas} replica processes + router "
+              f"behind {cluster.url}, fleet scrape every "
+              f"{agg.interval_s}s", flush=True)
+
+        # -- phase 1: clean ------------------------------------------------
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            st = agg.status()
+            if st["passes"] >= 5 and all(
+                    m["state"] == "OK" for m in st["members"]):
+                break
+            time.sleep(0.2)
+        st = agg.status()
+        members = {m["name"]: m for m in st["members"]}
+        clean_trips = st["rules"]["trips"]
+        print(f"clean phase: {st['passes']} scrape passes, "
+              f"{len(members)} members "
+              f"{sorted(members)}, rule trips {clean_trips}", flush=True)
+        if len(members) != args.replicas + 1:     # replicas + router
+            print(f"CHAOS FAIL: fleet sees {len(members)} members, "
+                  f"expected {args.replicas + 1}")
+            cluster.close()
+            return 2
+        not_ok = [n for n, m in members.items() if m["state"] != "OK"]
+        if not_ok:
+            print(f"CHAOS FAIL: members not OK in the clean phase: "
+                  f"{not_ok}")
+            cluster.close()
+            return 2
+        if clean_trips:
+            print(f"CHAOS FAIL: clean fleet tripped {clean_trips} "
+                  f"rule(s): {st['rules']['firing']} (false positive)")
+            cluster.close()
+            return 2
+
+        # -- phase 2: SIGKILL one replica mid-scrape -----------------------
+        victim = cluster.replicas[0]
+        victim.kill()
+        print(f"SIGKILLed {victim.name} (pid {victim.proc.pid}) "
+              f"mid-scrape", flush=True)
+        passes_at_kill = agg.status()["passes"]
+        stale_seen = False
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            st = agg.status()
+            m = {x["name"]: x for x in st["members"]}.get(victim.name)
+            if m is not None and m["state"] == "STALE":
+                stale_seen = True
+                break
+            time.sleep(0.2)
+        if not stale_seen:
+            print(f"CHAOS FAIL: {victim.name} never went STALE after "
+                  f"the SIGKILL")
+            cluster.close()
+            return 2
+        # let several more passes run: the loop must stay live and the
+        # stale rule must hold at exactly one trip for the episode
+        settle = agg.status()["passes"] + 5
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and \
+                agg.status()["passes"] < settle:
+            time.sleep(0.2)
+        st = agg.status()
+        members = {m["name"]: m for m in st["members"]}
+        survivors = [m for n, m in members.items() if n != victim.name]
+        stale_rule = st["rules"]["rules"].get("fleet_member_stale") or {}
+        trips = int(stale_rule.get("trips") or 0)
+        fresh = [m for m in survivors
+                 if m["state"] == "OK"
+                 and (m["scrape_age_s"] or 99) < 5 * agg.interval_s
+                 + agg.stale_after_s]
+        print(f"kill phase: passes {passes_at_kill} -> {st['passes']}, "
+              f"{victim.name} {members[victim.name]['state']} "
+              f"(consecutive failures "
+              f"{members[victim.name]['consecutive_failures']}), "
+              f"{len(fresh)}/{len(survivors)} survivors fresh, "
+              f"fleet_member_stale trips {trips}", flush=True)
+
+        # the router still renders the plane for the CLI
+        sys.path.insert(0, REPO_ROOT)
+        from tools import fleet_report
+        report_rc = fleet_report.main(["--url", cluster.url])
+
+        stats = cluster.stats()
+        cluster.close()
+
+    counters = telemetry.counters()
+    print("-- fleet chaos tally " + "-" * 28)
+    for key in ("fleet.scrapes", "fleet.scrape_failures",
+                "fleet.members_registered", "fleet.members_went_stale",
+                "slo.trips", "incidents.reported"):
+        print(f"{key:28s} {int(counters.get(key, 0))}")
+    print(f"fleet stats section: {json.dumps(stats.get('fleet'))[:200]}")
+
+    if st["passes"] <= passes_at_kill:
+        print("CHAOS FAIL: the scrape loop wedged after the SIGKILL")
+        return 2
+    if len(fresh) != len(survivors):
+        print(f"CHAOS FAIL: surviving members went stale with the loop "
+              f"up: {[m['name'] for m in survivors if m not in fresh]}")
+        return 2
+    if trips != 1:
+        print(f"CHAOS FAIL: fleet_member_stale tripped {trips} times, "
+              f"expected exactly 1 for one persistent STALE episode")
+        return 2
+    if "fleet_member_stale" not in st["rules"]["firing"]:
+        print("CHAOS FAIL: the stale episode is not held firing while "
+              "the member stays dead")
+        return 2
+    if not counters.get("fleet.members_went_stale", 0):
+        print("CHAOS FAIL: fleet.members_went_stale never counted")
+        return 2
+    if report_rc != 0:
+        print(f"CHAOS FAIL: fleet_report exited {report_rc} on a live "
+              f"plane")
+        return 2
+    print(f"CHAOS OK: SIGKILL mid-scrape -> {victim.name} STALE without "
+          f"wedging the loop, fleet_member_stale tripped exactly once, "
+          f"{int(counters.get('fleet.scrapes', 0))} member scrapes, "
+          f"fleet_report renders the plane")
+    return 0
+
+
 def run_autotune(args) -> int:
     """--autotune mode: the online-tuner safety gate. Two legs over one
     in-process cluster (published MLP model, synthetic closed-loop
@@ -1231,8 +1419,14 @@ def main():
                          "replica and hot-swap the model mid-load under "
                          "router.dispatch/serving.handler faults, assert "
                          "exactly-once responses and bounded p99")
+    ap.add_argument("--fleet", action="store_true",
+                    help="chaos-test the fleet observatory (core/"
+                         "fleetobs.py): SIGKILL a replica mid-scrape — "
+                         "the aggregator must mark it STALE without "
+                         "wedging, the fleet_member_stale rule must "
+                         "trip exactly once, the clean phase zero")
     ap.add_argument("--replicas", type=int, default=2,
-                    help="--cluster mode: replica process count")
+                    help="--cluster/--fleet mode: replica process count")
     ap.add_argument("--p99-bound", type=float, default=5000.0,
                     help="--cluster mode: fail if client-observed p99 "
                          "latency exceeds this many ms")
@@ -1271,6 +1465,8 @@ def main():
         sys.exit(run_autotune(args))
     if args.cluster:
         sys.exit(run_cluster(args))
+    if args.fleet:
+        sys.exit(run_fleet(args))
     sys.exit(run(args))
 
 

@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""Fleet observatory report — render a /fleet/status view for humans.
+
+The CLI face of core/fleetobs.py (PR 16): fetch (or load) one
+``/fleet/status`` document and print the per-member table (state,
+scrape age, queue depth, latency), the flagged stragglers, the fleet
+gauges, the fleet SLO rule states, and the local goodput breakdown.
+Stdlib-only, like every tool here.
+
+    python tools/fleet_report.py --url http://127.0.0.1:8801
+    python tools/fleet_report.py status.json        # saved document
+    python tools/fleet_report.py --smoke            # self-check
+
+Exit codes: 0 healthy render; 2 when the plane is DARK — the endpoint
+is unreachable, the document is not a fleet status, or every member is
+stale (a dashboard that renders an all-stale fleet as "fine" is worse
+than none).
+"""
+
+import argparse
+import io
+import json
+import sys
+import urllib.error
+import urllib.request
+
+REQUIRED_SECTIONS = ("-- members --", "-- fleet --", "-- goodput --")
+
+
+def load_status(source: str, timeout: float = 5.0):
+    """Fetch /fleet/status from a URL or read a saved JSON document."""
+    if source.startswith(("http://", "https://")):
+        url = source.rstrip("/")
+        if not url.endswith("/fleet/status"):
+            url += "/fleet/status"
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return json.loads(resp.read())
+    with open(source) as f:
+        return json.load(f)
+
+
+def _fmt(v, width=10):
+    if v is None:
+        return f"{'-':>{width}}"
+    if isinstance(v, float):
+        return f"{v:>{width}.3f}"
+    return f"{v!s:>{width}}"
+
+
+def render(doc, out=sys.stdout) -> int:
+    """Render one fleet status document; returns the member count that
+    is NOT stale (the caller's liveness evidence)."""
+    w = out.write
+    members = doc.get("members") or []
+    stale = [m for m in members if m.get("state") == "STALE"]
+    w(f"== fleet status: {len(members)} member(s), "
+      f"{len(stale)} stale, scrape interval "
+      f"{doc.get('interval_s', '?')}s, {doc.get('passes', 0)} pass(es) "
+      f"==\n")
+
+    w("\n-- members --\n")
+    w(f"{'member':<16}{'kind':<9}{'state':<8}{'age s':>8}{'scrapes':>9}"
+      f"{'fails':>7}{'queue':>7}{'lat ms':>10}  notes\n")
+    for m in members:
+        notes = []
+        if m.get("straggler"):
+            notes.append("STRAGGLER")
+        if m.get("last_error"):
+            notes.append(str(m["last_error"]))
+        w(f"{str(m.get('name', '?'))[:15]:<16}"
+          f"{str(m.get('kind', '?'))[:8]:<9}"
+          f"{str(m.get('state', '?')):<8}"
+          f"{_fmt(m.get('scrape_age_s'), 8)}"
+          f"{_fmt(m.get('scrapes', 0), 9)}"
+          f"{_fmt(m.get('consecutive_failures', 0), 7)}"
+          f"{_fmt(m.get('queue_depth'), 7)}"
+          f"{_fmt(m.get('latency_ms'), 10)}"
+          f"  {' '.join(notes)}\n")
+
+    w("\n-- fleet --\n")
+    fleet = doc.get("fleet") or {}
+    if fleet:
+        w(f"qps: {fleet.get('qps', 0)}  queue depth: "
+          f"{fleet.get('queue_depth', 0)} (saturation "
+          f"{float(fleet.get('queue_frac', 0.0)):.1%})")
+        if fleet.get("p99_ms") is not None:
+            w(f"  merged p99: {fleet['p99_ms']} ms")
+        w("\n")
+    stragglers = doc.get("stragglers") or []
+    w(f"stragglers: {', '.join(stragglers) if stragglers else 'none'}\n")
+    rules = (doc.get("rules") or {})
+    firing = rules.get("firing") or []
+    w(f"slo rules: {len((rules.get('rules') or {}))} "
+      f"({rules.get('trips', 0)} trip(s))"
+      + (f"  FIRING: {', '.join(firing)}" if firing else "") + "\n")
+
+    w("\n-- goodput --\n")
+    gp = doc.get("goodput") or {}
+    if gp:
+        w(f"wall: {gp.get('wall_ms', 0)} ms  productive: "
+          f"{gp.get('productive_ms', 0)} ms  ratio: "
+          f"{float(gp.get('ratio', 0.0)):.1%} "
+          f"({gp.get('window', '?')} window)\n")
+        wall = float(gp.get("wall_ms") or 0.0)
+        for phase, ms in sorted((gp.get("phases") or {}).items(),
+                                key=lambda kv: -float(kv[1])):
+            frac = f" ({float(ms) / wall:.1%})" if wall > 0 else ""
+            w(f"  badput {phase:<14} {ms:>12} ms{frac}\n")
+    else:
+        w("(no goodput breakdown in this document)\n")
+    return len(members) - len(stale)
+
+
+def smoke() -> int:
+    """Self-check: render a synthetic status document and fail (exit 2)
+    if any required section went missing from the renderer."""
+    doc = {
+        "interval_s": 1.0, "stale_after_s": 5.0, "passes": 7,
+        "members": [
+            {"name": "replica-0", "kind": "replica", "state": "OK",
+             "scrape_age_s": 0.4, "scrapes": 7,
+             "consecutive_failures": 0, "queue_depth": 3,
+             "latency_ms": 12.5, "straggler": False},
+            {"name": "replica-1", "kind": "replica", "state": "OK",
+             "scrape_age_s": 0.4, "scrapes": 7,
+             "consecutive_failures": 0, "queue_depth": 5,
+             "latency_ms": 94.0, "straggler": True},
+            {"name": "trainer-0", "kind": "trainer", "state": "STALE",
+             "scrape_age_s": 9.1, "scrapes": 2,
+             "consecutive_failures": 4, "last_error": "ConnectionError",
+             "straggler": False},
+        ],
+        "stragglers": ["replica-1"],
+        "fleet": {"members": 3, "members_ok": 2, "members_stale": 1,
+                  "stragglers": 1, "qps": 42.0, "queue_depth": 8,
+                  "queue_frac": 0.02, "p99_ms": 177.8},
+        "rules": {"rules": {"fleet_member_stale": {}}, "trips": 1,
+                  "firing": ["fleet_member_stale"]},
+        "goodput": {"wall_ms": 10000.0, "productive_ms": 7200.0,
+                    "ratio": 0.72, "window": "run",
+                    "phases": {"data_wait": 1400.0, "compile": 900.0,
+                               "other": 500.0}},
+    }
+    buf = io.StringIO()
+    live = render(doc, out=buf)
+    text = buf.getvalue()
+    missing = [sec for sec in REQUIRED_SECTIONS if sec not in text]
+    if missing or live != 2 or "STRAGGLER" not in text:
+        print(text)
+        print(f"fleet_report --smoke FAILED: missing sections {missing}, "
+              f"live members {live}", file=sys.stderr)
+        return 2
+    print("fleet_report --smoke ok")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Render a fleet observatory /fleet/status view "
+                    "(core/fleetobs.py).")
+    ap.add_argument("source", nargs="?", default="",
+                    help="saved /fleet/status JSON document")
+    ap.add_argument("--url", default="",
+                    help="fleet endpoint base URL (router front end or "
+                         "standalone fleet server); /fleet/status is "
+                         "appended")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    ap.add_argument("--smoke", action="store_true",
+                    help="self-check: render a synthetic document")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        return smoke()
+    source = args.url or args.source
+    if not source:
+        ap.error("a status URL (--url) or JSON path required (or --smoke)")
+    try:
+        doc = load_status(source, timeout=args.timeout)
+    except (OSError, ValueError, urllib.error.URLError) as e:
+        print(f"fleet plane DARK: cannot load {source}: {e}",
+              file=sys.stderr)
+        return 2
+    if not isinstance(doc, dict) or "members" not in doc:
+        print(f"fleet plane DARK: {source} is not a /fleet/status "
+              f"document", file=sys.stderr)
+        return 2
+    live = render(doc)
+    if not doc["members"] or live == 0:
+        print("fleet plane DARK: no live (non-stale) members",
+              file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

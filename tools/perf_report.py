@@ -235,6 +235,8 @@ def summarize_log(recs, malformed=0):
                                    incident_events)
     autotune = _autotune_summary(counter_delta, counter_last,
                                  tuner_events)
+    goodput = _goodput_summary(counter_delta, counter_last, gauges)
+    fleet = _fleet_summary(counter_delta, counter_last, gauges)
     tracing = None
     if spans:
         by_name = {}
@@ -259,6 +261,8 @@ def summarize_log(recs, malformed=0):
         "concurrency": concurrency,
         "incidents": incidents,
         "autotune": autotune,
+        "goodput": goodput,
+        "fleet": fleet,
         "tracing": tracing,
         "malformed_lines": int(malformed),
         "records": len(recs),
@@ -726,6 +730,80 @@ def _autotune_summary(counter_delta, counter_last, tuner_events):
     }
 
 
+def _goodput_summary(counter_delta, counter_last, gauges):
+    """Goodput ledger accounting (core/goodput.py): wall-clock
+    attribution of the run into productive device compute vs the badput
+    phases (goodput.productive_ms / goodput.wall_ms and the
+    goodput.badput_<phase>_ms family — data_wait, host_dispatch,
+    compile, checkpoint, collective, recovery, other — published via
+    counter_set, so the LAST value wins), plus the live goodput.ratio
+    gauge."""
+
+    def cval(name):
+        v = counter_last.get(name)
+        if v is None:
+            v = counter_delta.get(name)
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            return 0.0
+
+    wall = cval("goodput.wall_ms")
+    productive = cval("goodput.productive_ms")
+    ratio = gauges.get("goodput.ratio")
+    badput_prefix = "goodput.badput_"   # truncated f-string emit name
+    phases = {}
+    for name in sorted(set(counter_delta) | set(counter_last)):
+        if name.startswith(badput_prefix) and name.endswith("_ms"):
+            phases[name[len(badput_prefix):-len("_ms")]] = cval(name)
+    if not (wall or productive or phases or ratio is not None):
+        return None
+    out = {"wall_ms": round(wall, 3),
+           "productive_ms": round(productive, 3),
+           "badput_ms": round(sum(phases.values()), 3),
+           "phases": {p: round(v, 3) for p, v in phases.items()}}
+    if ratio is not None:
+        out["ratio"] = ratio
+    elif wall > 0:
+        out["ratio"] = round(min(1.0, productive / wall), 4)
+    return out
+
+
+def _fleet_summary(counter_delta, counter_last, gauges):
+    """Fleet observatory accounting (core/fleetobs.py): membership +
+    scrape health (fleet.scrapes / fleet.scrape_failures /
+    fleet.members_went_stale / fleet.members_registered /
+    fleet.rule_eval_errors / fleet.scrape_pass_errors counters) and the
+    last published fleet view (fleet.members, fleet.members_ok,
+    fleet.members_stale, fleet.stragglers, fleet.qps,
+    fleet.queue_depth, fleet.queue_frac, fleet.p99_ms gauges)."""
+
+    def cval(name):
+        v = counter_delta.get(name) or counter_last.get(name) or 0
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            return 0.0
+
+    scrapes = cval("fleet.scrapes")
+    failures = cval("fleet.scrape_failures")
+    registered = cval("fleet.members_registered")
+    went_stale = cval("fleet.members_went_stale")
+    view = {k.split(".", 1)[1]: v for k, v in gauges.items()
+            if k.startswith("fleet.") and isinstance(v, (int, float))}
+    if not (scrapes or failures or registered or went_stale or view):
+        return None
+    return {
+        "scrapes": int(scrapes),
+        "scrape_failures": int(failures),
+        "members_registered": int(registered),
+        "members_went_stale": int(went_stale),
+        "rule_eval_errors": int(cval("fleet.rule_eval_errors")),
+        "scrape_pass_errors": int(cval("fleet.scrape_pass_errors")),
+        "view": view,
+    }
+
+
 def _fmt_num(v):
     if isinstance(v, float):
         return f"{v:,.3f}".rstrip("0").rstrip(".")
@@ -1013,6 +1091,46 @@ def render(s, out=sys.stdout):
             w(f"  {ev['name']}: {detail}"
               + (f" (reason {ev['reason']})" if ev.get("reason") else "")
               + "\n")
+
+    if s.get("goodput"):
+        gp = s["goodput"]
+        w("\n-- goodput (wall-clock attribution, core/goodput.py) --\n")
+        line = (f"wall: {_fmt_num(gp['wall_ms'])} ms  productive: "
+                f"{_fmt_num(gp['productive_ms'])} ms  badput: "
+                f"{_fmt_num(gp['badput_ms'])} ms")
+        if gp.get("ratio") is not None:
+            line += f"  goodput ratio: {gp['ratio']:.1%}"
+        w(line + "\n")
+        if gp.get("phases"):
+            wall = gp["wall_ms"] or 0.0
+            for phase, ms in sorted(gp["phases"].items(),
+                                    key=lambda kv: -kv[1]):
+                frac = f" ({ms / wall:.1%} of wall)" if wall > 0 else ""
+                w(f"  badput {phase:<14} {_fmt_num(ms):>12} ms{frac}\n")
+
+    if s.get("fleet"):
+        fl = s["fleet"]
+        w("\n-- fleet (cross-process observatory, core/fleetobs.py) --\n")
+        w(f"scrapes: {fl['scrapes']}  failures: {fl['scrape_failures']}  "
+          f"registered: {fl['members_registered']}  went stale: "
+          f"{fl['members_went_stale']}"
+          + (f"  RULE EVAL ERRORS: {fl['rule_eval_errors']}"
+             if fl.get("rule_eval_errors") else "")
+          + (f"  SCRAPE PASS ERRORS: {fl['scrape_pass_errors']}"
+             if fl.get("scrape_pass_errors") else "")
+          + "\n")
+        view = fl.get("view") or {}
+        if view:
+            w(f"members: {_fmt_num(view.get('members', 0))} "
+              f"({_fmt_num(view.get('members_ok', 0))} ok / "
+              f"{_fmt_num(view.get('members_stale', 0))} stale)  "
+              f"stragglers: {_fmt_num(view.get('stragglers', 0))}\n")
+            line = (f"fleet qps: {_fmt_num(view.get('qps', 0))}  "
+                    f"queue depth: {_fmt_num(view.get('queue_depth', 0))} "
+                    f"(saturation {view.get('queue_frac', 0.0):.1%})")
+            if "p99_ms" in view:
+                line += f"  merged p99: {_fmt_num(view['p99_ms'])} ms"
+            w(line + "\n")
 
     if s.get("tracing"):
         tr = s["tracing"]

@@ -12,7 +12,9 @@ when it publishes numbers) with per-metric thresholds:
   better unless the unit spells ms ("ms", "ms/step", ...);
 * ``extra.mfu``      — higher is better;
 * ``extra.ms_per_step`` / ``extra.p99_ms`` / ``extra.ttft_ms`` /
-  ``extra.itl_p99_ms`` — lower is better.
+  ``extra.itl_p99_ms`` — lower is better;
+* ``extra.goodput.ratio`` — higher is better (the core/goodput.py
+  productive-wall-clock fraction finalize_bench_result embeds).
 
 A metric regresses when it is worse than the reference by more than its
 tolerance (default 5% for throughput/MFU, 15% for tail latency).
@@ -49,6 +51,11 @@ _METRICS = (
     ("p99_ms", "extra", "lower", 0.15),
     ("ttft_ms", "extra", "lower", 0.15),
     ("itl_p99_ms", "extra", "lower", 0.15),
+    # goodput ratio (core/goodput.py, embedded as extra.goodput.ratio):
+    # a run whose productive fraction collapsed is a regression even
+    # when headline throughput survived (e.g. shorter timed windows
+    # hiding data stalls) — dotted keys traverse nested extra dicts
+    ("goodput.ratio", "extra", "higher", 0.10),
 )
 
 
@@ -102,8 +109,13 @@ def _provenance_key(row):
 
 def _get(row, key, where):
     src = row.get("extra") or {} if where == "extra" else row
-    v = src.get(key)
-    return float(v) if isinstance(v, (int, float)) else None
+    # dotted keys traverse nested dicts ("goodput.ratio" ->
+    # extra["goodput"]["ratio"])
+    for part in key.split("."):
+        if not isinstance(src, dict):
+            return None
+        src = src.get(part)
+    return float(src) if isinstance(src, (int, float)) else None
 
 
 def slo_verdict(row, prior_rows, tolerances=None):
@@ -179,6 +191,9 @@ def main(argv=None):
     ap.add_argument("--tol-latency", type=float, default=0.15,
                     help="relative tolerance on ms metrics "
                          "(default 0.15)")
+    ap.add_argument("--tol-goodput", type=float, default=0.10,
+                    help="relative tolerance on extra.goodput.ratio "
+                         "(default 0.10)")
     ap.add_argument("--json", action="store_true",
                     help="print the verdict as JSON")
     args = ap.parse_args(argv)
@@ -193,7 +208,8 @@ def main(argv=None):
     prior = load_prior_rows(patterns, skip_paths=[args.row])
     tols = {"value": args.tol_throughput, "mfu": args.tol_throughput,
             "ms_per_step": args.tol_latency, "p99_ms": args.tol_latency,
-            "ttft_ms": args.tol_latency, "itl_p99_ms": args.tol_latency}
+            "ttft_ms": args.tol_latency, "itl_p99_ms": args.tol_latency,
+            "goodput.ratio": args.tol_goodput}
     v = slo_verdict(row, prior, tolerances=tols)
     if args.json:
         print(json.dumps(dict(v, metric=row.get("metric")), indent=2))
