@@ -144,7 +144,13 @@ def _sharding_manifest_extras(program) -> Optional[Dict[str, Any]]:
     zs = getattr(program, "_zero_stage", None) if program is not None else None
     if fp is None and zs is None:
         return None
-    return {"axis_rules": fp, "zero_stage": zs}
+    out = {"axis_rules": fp, "zero_stage": zs}
+    zd = getattr(program, "_zero_degree", None) if program is not None else None
+    if zd is not None:
+        # the dp degree the ZeRO shards were padded for — a restore into
+        # a different degree regroups the state (parallel/zero_regroup)
+        out["zero_degree"] = int(zd)
+    return out
 
 
 def _note_resharding(extras: Optional[Dict[str, Any]]):
@@ -475,11 +481,24 @@ def load_checkpoint(path: str, program: Optional[Program] = None,
         telemetry.counter_add("ckpt.verify_failures", 1,
                               ckpt=os.path.basename(str(path)))
         raise
+    _regroup_zero(arrays, program, scope)
     for name, val in arrays.items():
         scope.set(name, val)
     _restore_rng(manifest.get("extras"))
     _note_resharding(manifest.get("extras"))
     return int(manifest.get("step", 0))
+
+
+def _regroup_zero(arrays, program, scope):
+    """World-size-changing resume: re-pad saved ZeRO optimizer-shard
+    state to the restoring program's shard geometry (a checkpoint's
+    padded length is a function of the dp degree it was saved under —
+    parallel/zero_regroup.py)."""
+    if program is None or not getattr(program, "_zero_state_numel", None):
+        return
+    from .parallel import zero_regroup
+
+    zero_regroup.regroup_state(arrays, program, scope)
 
 
 # ---------------------------------------------------------------------------
@@ -821,6 +840,7 @@ class CheckpointManager:
         same script and training resumes."""
         scope = scope or global_scope()
         step, arrays, _ = self.restore_latest_arrays()
+        _regroup_zero(arrays, program, scope)
         for name, val in arrays.items():
             scope.set(name, val)
         return int(step)

@@ -614,3 +614,44 @@ define_flag("ps_degrade_to_survivors", False,
             "instead of stalling to the barrier timeout; a revived "
             "trainer rejoins at the next version. Changes the effective "
             "batch while degraded — opt-in")
+define_flag("ps_elastic_admission", True,
+            "admit trainer ids the PServer was not constructed with: a "
+            "send_grad/heartbeat from an unseen id grows num_trainers "
+            "(and the heartbeat monitor's expected set) so the sync "
+            "barrier REGROWS at scale-up instead of permanently "
+            "excluding new workers (ps.barrier_regrown counter)")
+
+# -- elastic resize + signal-driven autoscaling (distributed/scaler.py,
+#    distributed/elastic.py, serving/cluster.py scale_to) -------------------
+define_flag("elastic_restart_window_s", 0.0,
+            "sliding window (seconds) for the ElasticRunner restart "
+            "budget: only restarts inside the window count against "
+            "max_restarts, so sustained progress refunds the crash "
+            "budget. 0 keeps the legacy lifetime counter")
+define_flag("scaler_min_world", 1,
+            "lower bound on the world size a ScalerPolicy may target — "
+            "ScaleDown decisions clamp here (scaler.clamped counter)")
+define_flag("scaler_max_world", 8,
+            "upper bound on the world size a ScalerPolicy may target — "
+            "ScaleUp decisions clamp here (scaler.clamped counter)")
+define_flag("scaler_cooldown_s", 30.0,
+            "minimum seconds between two ScalerPolicy decisions: a "
+            "decision inside the cooldown is suppressed "
+            "(scaler.suppressed_cooldown) so one saturated window "
+            "cannot thrash the world size")
+define_flag("scaler_window_s", 30.0,
+            "metrics window (seconds) a ScalerPolicy reads when "
+            "gathering live signals (queue saturation, step-time p99, "
+            "heartbeat verdicts) from the telemetry registry")
+define_flag("scaler_queue_high_frac", 0.85,
+            "queue-saturation fraction (queue depth / admission bound) "
+            "at or above which the policy emits ScaleUp "
+            "(reason queue_saturation)")
+define_flag("scaler_queue_low_frac", 0.10,
+            "queue-saturation fraction at or below which the policy "
+            "emits ScaleDown (reason underutilized) — only when the "
+            "window actually carried traffic evidence")
+define_flag("scaler_step_p99_high_ms", 0.0,
+            "step-time p99 (ms) over the scaler window above which the "
+            "policy emits ScaleUp (reason step_time_p99); 0 disables "
+            "the rule")

@@ -595,6 +595,34 @@ def report_incident(source: str, name: str, value=None,
     return incident_id
 
 
+def report_scale_event(source: str, event: str, old_world: int,
+                       new_world: int, reason: str = "",
+                       attrs: Optional[Dict[str, Any]] = None) -> None:
+    """Land one ``kind:"scale"`` record for a world-size transition or an
+    elastic restart (distributed/scaler.py decisions executed by
+    ElasticRunner / ClusterController, plus every crash-restart).
+
+    Never rate-limited — a scale transition is rare and each one must be
+    reconstructable from the black box, so the record goes through
+    ``telemetry.event`` (the FlightRecorder's ``set_blackbox`` tap pulls
+    every emitted record into the incident ring) and is counted as
+    ``incidents.scale_events``."""
+    payload: Dict[str, Any] = {
+        "source": source,
+        "event": event,
+        "old_world": int(old_world),
+        "new_world": int(new_world),
+        "reason": reason,
+    }
+    if attrs:
+        payload.update(attrs)
+    telemetry.counter_add("incidents.scale_events", 1, source=source,
+                          event=event)
+    telemetry.event("scale", f"{source}.{event}",
+                    int(new_world) - int(old_world), payload)
+    telemetry.flush_sink()
+
+
 def last_incident() -> Optional[Dict[str, Any]]:
     with _incident_lock:
         return dict(_last_incident[0]) if _last_incident[0] else None

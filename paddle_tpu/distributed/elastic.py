@@ -1,4 +1,4 @@
-"""Elastic training: checkpoint-restart failure recovery.
+"""Elastic training: checkpoint-restart failure recovery + elastic resize.
 
 Capability mirror of the reference's failure-detection story (SURVEY.md
 §5): the reference has a pserver-side HeartBeatMonitor
@@ -22,6 +22,24 @@ flight when the step failed. The step loop runs under try/finally
 save; checkpoint-save failures (e.g. injected ``ckpt.save.*`` faults)
 are themselves recoverable, not fatal.
 
+Restart budget: with ``FLAGS_elastic_restart_window_s`` > 0 only the
+restarts inside that sliding window count against ``max_restarts`` —
+sustained progress refunds the crash budget instead of a lifetime
+counter bleeding it dry (``elastic.restart_budget_refunds``). Every
+restart lands a ``kind:"scale"`` record in the incident ring
+(core/incidents.report_scale_event).
+
+Elastic resize: attach a ``scaler`` (distributed/scaler.ScalerPolicy)
+and an ``on_scale`` callback and the runner executes ScaleUp/ScaleDown
+decisions between steps as checkpoint → barrier-drain → relaunch-at-
+new-world: the current step is force-checkpointed, the async writer is
+drained, and ``on_scale(decision)`` rebuilds the world (program, scope,
+step_fn, reader) at the target size — the runner then restores the
+checkpoint INTO the new world (world-size-changing resume: dense arrays
+re-lay out at the next compile, ZeRO state regroups via
+parallel/zero_regroup, the reader cursor re-splits across the new
+trainer set) and continues the step loop.
+
 On a multi-host job the same script re-launched by the cluster manager
 lands in restore_latest() and continues — the reference's
 checkpoint_notify flow without the pserver middleman.
@@ -30,8 +48,12 @@ checkpoint_notify flow without the pserver middleman.
 from __future__ import annotations
 
 import logging
+import time
+from collections import deque
 from typing import Callable, Optional, Tuple
 
+from ..core import flags as _flags
+from ..core import telemetry
 from .errors import RpcError
 
 _LOG = logging.getLogger("paddle_tpu.elastic")
@@ -53,7 +75,10 @@ class ElasticRunner:
                  save_interval_steps: int = 10, max_to_keep: int = 3,
                  max_restarts: int = 3,
                  recoverable: Tuple[type, ...] = RECOVERABLE,
-                 reader=None, async_save: bool = True):
+                 reader=None, async_save: bool = True,
+                 restart_window_s: Optional[float] = None,
+                 world_size: int = 1, scaler=None,
+                 on_scale: Optional[Callable] = None):
         from ..checkpoint import CheckpointManager
 
         self.program = program
@@ -65,7 +90,15 @@ class ElasticRunner:
         self.mgr = CheckpointManager(ckpt_dir, max_to_keep=max_to_keep,
                                      save_interval_steps=save_interval_steps,
                                      async_save=async_save)
-        self.restarts = 0
+        self.restarts = 0              # lifetime total (observability)
+        self.restart_window_s = float(
+            _flags.flag("elastic_restart_window_s")
+            if restart_window_s is None else restart_window_s)
+        self._restart_times: deque = deque()
+        self.world_size = int(world_size)
+        self.scaler = scaler
+        self.on_scale = on_scale
+        self.scale_events = 0
 
     def _recoverable_exc(self, e: BaseException) -> bool:
         """True if e — or anything on its explicit cause chain — is a
@@ -81,11 +114,49 @@ class ElasticRunner:
             e = e.__cause__
         return False
 
+    # -- windowed restart budget ---------------------------------------------
+    def budget_used(self, now: Optional[float] = None) -> int:
+        """Restarts currently charged against max_restarts: all of them
+        (legacy) or only those inside FLAGS_elastic_restart_window_s —
+        pruning expired entries IS the refund for sustained progress."""
+        if self.restart_window_s <= 0:
+            return self.restarts
+        if now is None:
+            now = time.monotonic()
+        cut = now - self.restart_window_s
+        refunded = 0
+        while self._restart_times and self._restart_times[0] < cut:
+            self._restart_times.popleft()
+            refunded += 1
+        if refunded:
+            telemetry.counter_add("elastic.restart_budget_refunds",
+                                  refunded)
+        return len(self._restart_times)
+
+    def _note_restart(self, step: int, exc: BaseException) -> int:
+        """Count one restart against the budget; returns the charged
+        count. Each restart is a scale-plane event: a kind:"scale"
+        record lands in the incident ring."""
+        from ..core import incidents
+
+        now = time.monotonic()
+        self.restarts += 1
+        self._restart_times.append(now)
+        telemetry.counter_add("elastic.restarts", 1, step=step,
+                              exc=type(exc).__name__)
+        incidents.report_scale_event(
+            "elastic", "restart", self.world_size, self.world_size,
+            reason=type(exc).__name__,
+            attrs={"step": int(step), "restarts": self.restarts})
+        return self.budget_used(now)
+
     # -- exact-resume extras -------------------------------------------------
     def _extras(self) -> dict:
         ex = {}
         if self.reader is not None and hasattr(self.reader, "state_dict"):
             ex["reader"] = self.reader.state_dict()
+        if self.world_size > 1:
+            ex["world"] = {"size": int(self.world_size)}
         return ex
 
     def _apply_restored_extras(self):
@@ -112,6 +183,64 @@ class ElasticRunner:
                 _LOG.warning("elastic: baseline checkpoint attempt %d "
                              "failed: %r", attempt, e)
 
+    # -- scale-decision execution --------------------------------------------
+    def _maybe_scale(self, step: int, step_fn):
+        """Poll the policy; on a decision, execute checkpoint →
+        barrier-drain → relaunch-at-new-world. Returns the (possibly
+        replaced) step_fn."""
+        if self.scaler is None or self.on_scale is None:
+            return step_fn
+        decision = self.scaler.decide(self.world_size)
+        if decision is None:
+            return step_fn
+        return self.execute_scale(decision, step, step_fn)
+
+    def execute_scale(self, decision, step: int, step_fn):
+        """The scale-event protocol, in order:
+
+        1. force-checkpoint the current step (the relaunch resumes here);
+        2. barrier-drain: join the async writer so the checkpoint is
+           durable before any part of the old world is torn down;
+        3. ``on_scale(decision)`` rebuilds the world at decision.target —
+           it returns None to veto, or a dict with any of
+           ``step_fn`` / ``program`` / ``scope`` / ``reader`` /
+           ``world_size`` replaced;
+        4. restore the checkpoint INTO the new world (the world-size-
+           changing resume) and emit the ``kind:"scale"`` ring record.
+        """
+        from ..core import incidents
+
+        self.mgr.save(step, self.program, self.scope,
+                      extras=self._extras(), force=True)
+        self.mgr.wait_until_finished()          # the barrier-drain
+        swapped = self.on_scale(decision)
+        if swapped is None:
+            _LOG.warning("elastic: on_scale vetoed %s -> %d",
+                         decision.direction, decision.target)
+            return step_fn
+        old_world = self.world_size
+        self.program = swapped.get("program", self.program)
+        self.scope = swapped.get("scope", self.scope)
+        self.reader = swapped.get("reader", self.reader)
+        self.world_size = int(swapped.get("world_size", decision.target))
+        step_fn = swapped.get("step_fn", step_fn)
+        restored = self.mgr.restore_latest(self.program, self.scope)
+        self._apply_restored_extras()
+        self.scale_events += 1
+        telemetry.counter_add("elastic.scale_events", 1,
+                              direction=decision.direction,
+                              old_world=old_world,
+                              new_world=self.world_size)
+        incidents.report_scale_event(
+            "elastic", "resize", old_world, self.world_size,
+            reason=decision.reason,
+            attrs={"step": int(restored),
+                   "direction": decision.direction,
+                   "signals": decision.signals})
+        _LOG.info("elastic: resized world %d -> %d at step %d (%s)",
+                  old_world, self.world_size, restored, decision.reason)
+        return step_fn
+
     def run(self, step_fn: Callable[[int], object], num_steps: int,
             on_restart: Optional[Callable[[int, BaseException], None]] = None):
         """Run step_fn(step) for num_steps with failure recovery.
@@ -134,20 +263,23 @@ class ElasticRunner:
                     step += 1
                     self.mgr.save(step, self.program, self.scope,
                                   extras=self._extras())
+                    step_fn = self._maybe_scale(step, step_fn)
                 except Exception as e:
                     if not self._recoverable_exc(e):
                         raise
-                    self.restarts += 1
-                    if self.restarts > self.max_restarts:
+                    used = self._note_restart(step, e)
+                    if used > self.max_restarts:
                         _LOG.error("elastic: step %d failed after %d "
-                                   "restarts", step, self.max_restarts)
+                                   "restarts%s", step, used,
+                                   f" inside {self.restart_window_s:.0f}s"
+                                   if self.restart_window_s > 0 else "")
                         raise
                     restored = self.mgr.restore_latest(self.program,
                                                        self.scope)
                     self._apply_restored_extras()
                     _LOG.warning(
                         "elastic: step %d raised %r — restart %d/%d from "
-                        "checkpoint step %d", step, e, self.restarts,
+                        "checkpoint step %d", step, e, used,
                         self.max_restarts, restored)
                     if on_restart is not None:
                         on_restart(step, e)

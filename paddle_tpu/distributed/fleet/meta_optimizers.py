@@ -564,9 +564,31 @@ class ShardingOptimizer:
             for per_param in getattr(self.inner, "_accumulators", {}).values()
             for pname, var in per_param.items() if pname in proxy_names)
 
+        # elastic-resize metadata: every padded-geometry state var's
+        # LOGICAL numel. The padded length is a function of the dp
+        # degree (-(-numel // n) * n), so a checkpoint saved at one
+        # degree restores into another by unpad-to-numel / repad-to-new
+        # (parallel/zero_regroup.py) — this map is what tells the
+        # restore which leading slice is real data
+        geom_by_proxy = {proxies[p.name].name: (numel, padded)
+                         for p, _, numel, padded in meta}
+        zero_meta = {}
+        for per_param in getattr(self.inner, "_accumulators", {}).values():
+            for pname, var in per_param.items():
+                geom = geom_by_proxy.get(pname)
+                if geom is None:
+                    continue
+                numel, padded = geom
+                # only the PADDED-geometry accumulators regroup; scalar
+                # state (beta-pow etc., shape [1]) is degree-independent
+                if tuple(var.shape) == (padded,) and padded != 1:
+                    zero_meta[var.name] = int(numel)
+
         # static per-step collective payloads: the executor books these
         # per dispatch (sharding.*_bytes counters + the trace span)
         program._zero_stage = self.stage
+        program._zero_degree = n
+        program._zero_state_numel = zero_meta
         program._sharding_bytes = {"reduce_scatter": rs_bytes,
                                    "allreduce": ar_bytes,
                                    "allgather": ag_bytes}

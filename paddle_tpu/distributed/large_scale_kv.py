@@ -133,10 +133,26 @@ class LargeScaleKV:
                      rows=np.stack(rows) if rows else
                      np.zeros((0, self.dim), np.float32))
 
-    def load(self, path: str):
+    def load(self, path: str, keep=None) -> int:
+        """Ingest a snapshot, re-sharding every row by id AT LOAD time —
+        the on-disk order/shard layout is never trusted, so a snapshot
+        written under ANY ``num_shards`` restores correctly into this
+        table's count (restore into a different count used to silently
+        mis-shard when layouts were trusted).
+
+        ``keep(ids) -> bool mask`` filters rows before ingest — the
+        cross-server rebalance hook (kv_service.KVTables.load_all):
+        when the pserver count changes, every server reads EVERY saved
+        snapshot and keeps only the rows ``id % new_count`` routes to
+        it. Returns the number of rows ingested."""
         data = np.load(path if path.endswith(".npz") else path + ".npz")
+        ids = np.asarray(data["ids"], np.int64)
+        rows = data["rows"]
+        if keep is not None and len(ids):
+            mask = np.asarray(keep(ids), bool)
+            ids, rows = ids[mask], rows[mask]
         by_shard: Dict[int, list] = {}
-        for k, v in zip(data["ids"], data["rows"]):
+        for k, v in zip(ids, rows):
             by_shard.setdefault(int(k) % len(self.shards), []).append(
                 (int(k), v))
         for s, items in by_shard.items():
@@ -144,6 +160,17 @@ class LargeScaleKV:
             with shard.lock:       # a concurrent pull iterates the table
                 for k, v in items:
                     shard.table[k] = v
+        return int(len(ids))
+
+    def ids(self) -> np.ndarray:
+        """All resident row ids (sorted) — the leak/rebalance audit
+        surface: after a resize, the union across servers must equal the
+        pre-resize union exactly (nothing leaked, nothing duplicated)."""
+        out = []
+        for s in self.shards:
+            with s.lock:
+                out.extend(s.table.keys())
+        return np.sort(np.asarray(out, np.int64))
 
 
 class SparseEmbedding:

@@ -128,21 +128,65 @@ class KVTables:
         atomic_write_json(os.path.join(dirname, f"kv_{tag}_specs.json"),
                           {n: list(s) for n, s in specs.items()})
 
-    def load_all(self, dirname: str, tag: str):
+    def load_all(self, dirname: str, tag: str, num_servers: int = 0,
+                 server_index: int = 0) -> int:
+        """Restore this server's tables. Plain restore (num_servers=0):
+        read only THIS tag's snapshot files — same server set in, same
+        server set out.
+
+        Rebalance restore (num_servers>0): the server count changed
+        between save and load, so the `id % old_count` routing baked
+        into the per-tag files no longer matches the client's
+        `id % new_count` split. Every server reads EVERY saved tag's
+        files for each table and keeps only the rows that route to it
+        under the NEW count — the union across the new set is exactly
+        the saved row set (nothing leaked, nothing duplicated; counted
+        as ps.kv_rebalanced_rows). Returns rows ingested here."""
+        import glob
         import json
         import os
 
-        spec_path = os.path.join(dirname, f"kv_{tag}_specs.json")
-        if not os.path.exists(spec_path):
-            return
-        with open(spec_path) as f:
-            specs = json.load(f)
+        from ...core import telemetry
+
+        if num_servers and num_servers > 0:
+            spec_paths = sorted(glob.glob(
+                os.path.join(dirname, "kv_*_specs.json")))
+        else:
+            p = os.path.join(dirname, f"kv_{tag}_specs.json")
+            spec_paths = [p] if os.path.exists(p) else []
+        specs: Dict[str, tuple] = {}
+        tags: List[str] = []
+        for sp in spec_paths:
+            base = os.path.basename(sp)
+            tags.append(base[len("kv_"):-len("_specs.json")])
+            with open(sp) as f:
+                for name, s in json.load(f).items():
+                    prev = specs.get(name)
+                    if prev is not None and tuple(prev) != tuple(s):
+                        raise ValueError(
+                            f"KV table '{name}' saved with conflicting "
+                            f"(dim, seed) specs across servers: {prev} "
+                            f"vs {tuple(s)}")
+                    specs[name] = tuple(s)
+        keep = None
+        if num_servers and num_servers > 0:
+            keep = (lambda ids:
+                    np.mod(ids, int(num_servers)) == int(server_index))
+        total = 0
         for name, (dim, seed) in specs.items():
             kv = self.ensure(name, int(dim), int(seed))
             for shard in kv.shards:
                 with shard.lock:
                     shard.table.clear()
-            kv.load(os.path.join(dirname, f"kv_{tag}_{name}.npz"))
+            for t in tags:
+                path = os.path.join(dirname, f"kv_{t}_{name}.npz")
+                if os.path.exists(path):
+                    total += kv.load(path, keep=keep)
+        if keep is not None:
+            telemetry.counter_add("ps.kv_rebalanced_rows", total,
+                                  servers=int(num_servers),
+                                  index=int(server_index))
+        return total
 
 
 class KVServer:
@@ -166,8 +210,17 @@ class KVServer:
             self.kv.save_all(dirname, tag or "kvserver")
             return None, 0
         if method == "checkpoint_load":
-            dirname, _, tag = name.partition("|")
-            self.kv.load_all(dirname, tag or "kvserver")
+            # "dirname|tag" or "dirname|tag|index/count" (rebalance —
+            # same wire as PServer checkpoint_load)
+            dirname, _, rest = name.partition("|")
+            tag, _, shard = rest.partition("|")
+            if shard:
+                idx, _, cnt = shard.partition("/")
+                self.kv.load_all(dirname, tag or "kvserver",
+                                 num_servers=int(cnt),
+                                 server_index=int(idx))
+            else:
+                self.kv.load_all(dirname, tag or "kvserver")
             return None, 0
         raise ValueError(f"KVServer: unknown method '{method}'")
 

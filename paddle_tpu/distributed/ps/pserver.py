@@ -95,6 +95,8 @@ class HeartBeatMonitor:
             # re-admission: the next barrier requires this trainer again
             self.dead.discard(tid)
             telemetry.counter_add("ps.trainer_revived", 1, trainer=tid)
+            telemetry.counter_add("ps.barrier_regrown", 1, trainer=tid,
+                                  cause="revived")
 
     def _watch(self):
         import logging
@@ -266,13 +268,38 @@ class PServer:
                 if self.sync_mode:
                     self._maybe_apply_sync(grad_name, st)
 
+    def _admit_trainer(self, tid: int):
+        """Elastic admission (scale-UP half of the barrier contract): a
+        trainer id the server has never seen announces itself via its
+        first send_grad/heartbeat, and the barrier REGROWS to include it
+        — the complement of the degrade-to-survivors shrink path. Gated
+        by FLAGS_ps_elastic_admission so fixed-world deployments keep
+        treating unknown ids as a config error."""
+        with self._apply_lock:
+            if tid < self.num_trainers:
+                return
+            old = self.num_trainers
+            self.num_trainers = tid + 1
+            if self.monitor is not None:
+                import time
+
+                now = time.monotonic()
+                for t in range(old, self.num_trainers):
+                    self.monitor.last_seen.setdefault(t, now)
+                self.monitor.num_trainers = self.num_trainers
+        telemetry.counter_add("ps.barrier_regrown", 1, trainer=tid,
+                              cause="joined")
+
     def _handle(self, method, name, arr, aux):
         # every contact is a liveness signal; recv_param's aux is a
         # version (not a trainer id), so sync-blocked trainers ping via
         # their preceding sends + explicit heartbeats
-        if self.monitor is not None and method in ("send_grad",
-                                                   "heartbeat"):
-            self.monitor.ping(aux)
+        if method in ("send_grad", "heartbeat"):
+            if int(aux) >= self.num_trainers and \
+                    _flags.flag("ps_elastic_admission"):
+                self._admit_trainer(int(aux))
+            if self.monitor is not None:
+                self.monitor.ping(aux)
         if method == "heartbeat":
             if name:
                 # the beat's name field carries the trainer's metrics
@@ -351,8 +378,16 @@ class PServer:
             self.save_checkpoint(dirname, tag or None)
             return None, 0
         if method == "checkpoint_load":
-            dirname, _, tag = name.partition("|")
-            self.load_checkpoint(dirname, tag or None)
+            # wire: "dirname|tag" or "dirname|tag|index/count" — the
+            # third field asks for a KV rebalance into a server set of
+            # `count` endpoints of which this server is `index`
+            dirname, _, rest = name.partition("|")
+            tag, _, shard = rest.partition("|")
+            rebalance = None
+            if shard:
+                idx, _, cnt = shard.partition("/")
+                rebalance = (int(idx), int(cnt))
+            self.load_checkpoint(dirname, tag or None, rebalance=rebalance)
             return None, 0
         raise ValueError(f"unknown PS method '{method}'")
 
@@ -387,28 +422,44 @@ class PServer:
             extras={"ps": meta}, step=self._global_step)
         telemetry.counter_add("ps.checkpoints", 1, tag=tag)
 
-    def load_checkpoint(self, dirname: str, tag: str = None):
+    def load_checkpoint(self, dirname: str, tag: str = None,
+                        rebalance=None):
         """Verified restore: the snapshot's manifest (file sha256 +
         per-array CRC32) must check out before any byte enters the
         server scope — a torn snapshot raises CheckpointCorruptError
         (relayed to the notifier as an RPC error) instead of silently
-        serving wrong parameters."""
+        serving wrong parameters.
+
+        rebalance=(server_index, num_servers): restore into a CHANGED
+        server count. KV rows re-shard by id across the new set
+        (KVTables.load_all reads every saved server's snapshot, keeps
+        the rows `id % num_servers == server_index` routes here); the
+        dense part stays per-tag — a brand-new server whose tag has no
+        snapshot keeps its startup-initialised params."""
         from ... import checkpoint as ckpt
 
         tag = tag or self._ckpt_tag()
-        arrays, manifest = ckpt.read_checkpoint_dir(
-            os.path.join(dirname, f"pserver_{tag}"))
-        meta = (manifest.get("extras") or {}).get("ps") or {}
+        dense_dir = os.path.join(dirname, f"pserver_{tag}")
+        arrays, meta = {}, {}
+        if rebalance is None or os.path.isdir(dense_dir):
+            arrays, manifest = ckpt.read_checkpoint_dir(dense_dir)
+            meta = (manifest.get("extras") or {}).get("ps") or {}
         with self._apply_lock:
             for k, v in arrays.items():
                 self.scope.set(k, v)
-            self._global_step = int(meta.get("global_step", 0))
-            self._apply_count = {
-                k: int(v) for k, v in (meta.get("apply_count")
-                                       or {}).items()}
+            if meta:
+                self._global_step = int(meta.get("global_step", 0))
+                self._apply_count = {
+                    k: int(v) for k, v in (meta.get("apply_count")
+                                           or {}).items()}
             # inside the lock, like save: a kv RPC between the dense
             # restore and the table restore would see a torn state
-            self.kv.load_all(dirname, tag)
+            if rebalance is None:
+                self.kv.load_all(dirname, tag)
+            else:
+                self.kv.load_all(dirname, tag,
+                                 num_servers=int(rebalance[1]),
+                                 server_index=int(rebalance[0]))
 
     def _grad_of(self, param_name):
         for g, p in self.grad_to_param.items():

@@ -1,0 +1,254 @@
+"""Scale-event protocol: signal-driven world-size policy.
+
+Capability mirror of the reference's elastic story
+(`DistributedStrategy.elastic`, the Fleet heartbeat/elastic surfaces):
+the reference reserves a flag and leaves the control loop to an external
+operator; here the control loop is in-tree. A ``ScalerPolicy`` reads the
+LIVE evidence the rest of the stack already publishes — heartbeat
+verdicts (``ps.trainer_dead`` / ``ps.trainer_revived`` /
+``ps.barrier_regrown``), queue saturation (serving admission depth or
+the PR 16 fleet view's ``fleet.queue_frac``), step-time p99 over the
+rolling window, router load — and emits typed ScaleUp/ScaleDown
+decisions with cooldowns and min/max bounds.
+
+The policy only DECIDES. Execution belongs to the callers:
+
+* ``ElasticRunner`` (distributed/elastic.py) executes a training-world
+  decision as checkpoint → barrier-drain → relaunch-at-new-world;
+* ``ClusterController.scale_to`` (serving/cluster.py) grows/shrinks the
+  serving replica set through the drain/ready state machine;
+* ``tools/chaos_check.py --resize`` drives both through injected chaos.
+
+Every decision is counted (``scaler.evaluations``, ``scaler.decisions``,
+``scaler.scale_up``, ``scaler.scale_down``, ``scaler.suppressed_cooldown``,
+``scaler.clamped``) and every EXECUTED transition lands in the incident
+ring as a ``kind:"scale"`` record (core/incidents.report_scale_event).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from ..core import flags as _flags
+from ..core import telemetry
+
+SCALE_UP = "up"
+SCALE_DOWN = "down"
+
+
+@dataclass(frozen=True)
+class ScaleDecision:
+    """One typed verdict: move the world from ``current`` to ``target``.
+
+    ``reason`` names the rule that fired (heartbeat_dead,
+    worker_rejoined, queue_saturation, step_time_p99, underutilized);
+    ``signals`` carries the evidence snapshot the rule judged."""
+
+    direction: str                 # SCALE_UP | SCALE_DOWN
+    current: int
+    target: int
+    reason: str
+    signals: Dict[str, Any] = field(default_factory=dict)
+    ts: float = 0.0
+
+    @property
+    def delta(self) -> int:
+        return self.target - self.current
+
+
+@dataclass
+class ScaleSignals:
+    """The evidence vector a policy judges — normalised from whatever
+    plane produced it (training PS world, serving fleet, local
+    telemetry window) so one policy serves both planes."""
+
+    dead_workers: int = 0          # heartbeat verdicts in the window
+    joined_workers: int = 0        # revived/announced workers in window
+    queue_frac: float = 0.0        # queue depth / admission bound, 0..1
+    queue_evidence: bool = False   # the window actually saw traffic
+    step_p99_ms: float = 0.0       # step-time p99 over the window
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def as_dict(self) -> Dict[str, Any]:
+        d = {"dead_workers": self.dead_workers,
+             "joined_workers": self.joined_workers,
+             "queue_frac": round(float(self.queue_frac), 4),
+             "queue_evidence": bool(self.queue_evidence),
+             "step_p99_ms": round(float(self.step_p99_ms), 3)}
+        d.update(self.extra)
+        return d
+
+
+def gather_signals(window: Optional[Dict[str, Any]] = None,
+                   fleet=None,
+                   window_s: Optional[float] = None,
+                   now: Optional[float] = None) -> ScaleSignals:
+    """Build a ScaleSignals from the live telemetry window (and the
+    fleet observatory when one is attached). ``window`` is injectable
+    for deterministic tests; by default the rolling
+    ``telemetry.windowed(FLAGS_scaler_window_s)`` view is read."""
+    if window is None:
+        W = float(window_s if window_s is not None
+                  else _flags.flag("scaler_window_s"))
+        window = telemetry.windowed(W, now=now)
+    counters = window.get("counters") or {}
+    hists = window.get("hists") or {}
+    gauges = window.get("gauges") or {}
+
+    def cdelta(name: str) -> float:
+        rec = counters.get(name) or {}
+        try:
+            return float(rec.get("delta") or 0)
+        except (TypeError, ValueError):
+            return 0.0
+
+    dead = cdelta("ps.trainer_dead")
+    revived = cdelta("ps.trainer_revived")
+    joined = cdelta("ps.barrier_regrown")
+    sig = ScaleSignals(
+        dead_workers=max(0, int(dead - revived)),
+        joined_workers=int(max(revived, joined)))
+    # queue saturation: prefer the fleet-merged view, fall back to the
+    # local serving gauge against the admission bound
+    qf = None
+    if fleet is not None:
+        try:
+            qf = ((fleet.status() or {}).get("fleet")
+                  or {}).get("queue_frac")
+        except Exception:
+            qf = None
+    if qf is None:
+        qf = gauges.get("fleet.queue_frac")
+    if qf is None:
+        depth = gauges.get("serving.queue_depth")
+        bound = float(_flags.flag("serving_max_queue_depth") or 0)
+        if depth is not None and bound > 0:
+            qf = float(depth) / bound
+    if qf is not None:
+        sig.queue_frac = max(0.0, float(qf))
+        sig.queue_evidence = True
+    # step-time p99 over the window: first step-latency histogram wins
+    for hname in ("executor.run_steps_ms", "executor.run_ms",
+                  "serving.request_ms"):
+        h = hists.get(hname)
+        if h and h.get("count"):
+            sig.step_p99_ms = float(h.get("p99") or 0.0)
+            sig.extra["step_metric"] = hname
+            break
+    return sig
+
+
+class ScalerPolicy:
+    """Cooldown-gated, bound-clamped scale policy over ScaleSignals.
+
+    Rule order (first hit wins):
+      1. dead_workers > 0           → ScaleDown to the survivor count
+      2. joined_workers > 0         → ScaleUp (re-absorb the announced
+                                      worker — the barrier-regrow path)
+      3. queue_frac ≥ high          → ScaleUp   (queue_saturation)
+      4. step_p99 ≥ bound (if set)  → ScaleUp   (step_time_p99)
+      5. queue_frac ≤ low w/traffic → ScaleDown (underutilized)
+
+    A decision outside [min_world, max_world] clamps; a clamp that
+    lands back on the current world is suppressed (scaler.clamped).
+    A decision inside the cooldown since the last one is suppressed
+    (scaler.suppressed_cooldown) — the thrash guard.
+    """
+
+    def __init__(self, min_world: Optional[int] = None,
+                 max_world: Optional[int] = None,
+                 cooldown_s: Optional[float] = None,
+                 queue_high_frac: Optional[float] = None,
+                 queue_low_frac: Optional[float] = None,
+                 step_p99_high_ms: Optional[float] = None,
+                 step: int = 1, source: str = "scaler"):
+        f = _flags.flag
+        self.min_world = int(f("scaler_min_world") if min_world is None
+                             else min_world)
+        self.max_world = int(f("scaler_max_world") if max_world is None
+                             else max_world)
+        if self.min_world < 1 or self.max_world < self.min_world:
+            raise ValueError(
+                f"ScalerPolicy: need 1 <= min_world <= max_world, got "
+                f"[{self.min_world}, {self.max_world}]")
+        self.cooldown_s = float(f("scaler_cooldown_s") if cooldown_s is None
+                                else cooldown_s)
+        self.queue_high = float(f("scaler_queue_high_frac")
+                                if queue_high_frac is None
+                                else queue_high_frac)
+        self.queue_low = float(f("scaler_queue_low_frac")
+                               if queue_low_frac is None
+                               else queue_low_frac)
+        self.step_p99_high = float(f("scaler_step_p99_high_ms")
+                                   if step_p99_high_ms is None
+                                   else step_p99_high_ms)
+        self.step = max(1, int(step))
+        self.source = source
+        self._last_decision_ts: Optional[float] = None
+
+    # -- the rules -----------------------------------------------------------
+    def _judge(self, world: int, sig: ScaleSignals):
+        """(direction, raw_target, reason) or None — bounds/cooldown are
+        applied by decide(), not here."""
+        if sig.dead_workers > 0:
+            return (SCALE_DOWN, world - sig.dead_workers, "heartbeat_dead")
+        if sig.joined_workers > 0:
+            return (SCALE_UP, world + sig.joined_workers, "worker_rejoined")
+        if sig.queue_evidence and sig.queue_frac >= self.queue_high:
+            return (SCALE_UP, world + self.step, "queue_saturation")
+        if self.step_p99_high > 0 and sig.step_p99_ms >= self.step_p99_high:
+            return (SCALE_UP, world + self.step, "step_time_p99")
+        if sig.queue_evidence and sig.queue_frac <= self.queue_low:
+            return (SCALE_DOWN, world - self.step, "underutilized")
+        return None
+
+    def decide(self, world: int, signals: Optional[ScaleSignals] = None,
+               now: Optional[float] = None,
+               fleet=None) -> Optional[ScaleDecision]:
+        """Judge the current evidence; returns a ScaleDecision or None.
+
+        The returned decision is already clamped to [min_world,
+        max_world] and has passed the cooldown gate — a non-None return
+        is safe to execute."""
+        if now is None:
+            now = time.time()
+        if signals is None:
+            signals = gather_signals(fleet=fleet, now=now)
+        telemetry.counter_add("scaler.evaluations", 1, source=self.source)
+        verdict = self._judge(int(world), signals)
+        if verdict is None:
+            return None
+        direction, target, reason = verdict
+        clamped = min(self.max_world, max(self.min_world, int(target)))
+        if clamped != target:
+            telemetry.counter_add("scaler.clamped", 1, source=self.source,
+                                  reason=reason, target=int(target),
+                                  clamped=clamped)
+            target = clamped
+        if target == int(world):
+            return None                 # fully clamped away
+        if self._last_decision_ts is not None and \
+                now - self._last_decision_ts < self.cooldown_s:
+            telemetry.counter_add("scaler.suppressed_cooldown", 1,
+                                  source=self.source, reason=reason)
+            return None
+        self._last_decision_ts = now
+        decision = ScaleDecision(direction=direction, current=int(world),
+                                 target=int(target), reason=reason,
+                                 signals=signals.as_dict(), ts=now)
+        telemetry.counter_add("scaler.decisions", 1, source=self.source,
+                              reason=reason, direction=direction,
+                              current=decision.current,
+                              target=decision.target)
+        if direction == SCALE_UP:
+            telemetry.counter_add("scaler.scale_up", 1,
+                                  source=self.source, reason=reason)
+        else:
+            telemetry.counter_add("scaler.scale_down", 1,
+                                  source=self.source, reason=reason)
+        return decision
+
+    def reset_cooldown(self):
+        self._last_decision_ts = None

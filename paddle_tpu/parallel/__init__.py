@@ -20,5 +20,6 @@ from .axis_rules import AxisRules, DEFAULT_RULES  # noqa: F401
 from .axis_rules import axis_rules as rule_scope  # noqa: F401
 from .axis_rules import get_rules, set_rules  # noqa: F401
 from . import axis_rules as _axis_rules_module  # noqa: F401
+from .zero_regroup import regroup_state  # noqa: F401
 
 axis_rules = _axis_rules_module

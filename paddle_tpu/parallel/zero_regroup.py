@@ -1,0 +1,82 @@
+"""ZeRO optimizer-shard regrouping for world-size-changing resume.
+
+Dense arrays are saved at GLOBAL shape, so restoring them into a
+different dp degree is "just the next compile" — the axis-rule table
+lays them out lazily (checkpoint.py `_note_resharding`). ZeRO stage-1/2
+optimizer state is the exception: the ShardingOptimizer pads every
+flattened param to ``-(-numel // n) * n`` before scattering, so the
+PERSISTED accumulator arrays have a length that depends on the dp
+degree they were saved under. Restoring a degree-8 checkpoint into a
+degree-4 program would feed [padded(8)]-shaped state into
+[padded(4)]-shaped vars — a shape error at best, silent corruption at
+worst.
+
+``regroup_state`` closes that: for every state var the NEW program
+declares in ``program._zero_state_numel`` (written by ShardingOptimizer
+at build time: var name → logical numel), a saved array whose length
+differs from the new padded geometry is unpadded to its logical numel
+and re-padded to the new length. The pad tail is taken from the
+startup-initialised array already in the scope — the tail's fill value
+is whatever the accumulator's initialiser chose (0 for moments, ε for
+adagrad-style state), and the invariant "the padded tail never moves"
+(zero param, zero grad, zero update) means the startup tail IS the
+correct steady-state tail at any degree.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..core import telemetry
+
+
+def regroup_state(arrays: Dict[str, np.ndarray], program=None,
+                  scope=None) -> int:
+    """Re-pad saved ZeRO state arrays to the program's CURRENT shard
+    geometry, in place in ``arrays``. Returns the number of arrays
+    regrouped (0 when the degree is unchanged or the program carries no
+    ZeRO metadata). Counts ``sharding.zero_regroup_events`` per
+    regrouped var."""
+    meta: Optional[Dict[str, int]] = getattr(
+        program, "_zero_state_numel", None) if program is not None else None
+    if not meta:
+        return 0
+    degree = getattr(program, "_zero_degree", None)
+    regrouped = 0
+    block_vars = program.global_block().vars
+    for name, numel in meta.items():
+        saved = arrays.get(name)
+        var = block_vars.get(name)
+        if saved is None or var is None:
+            continue
+        target = tuple(int(s) for s in var.shape)
+        saved = np.asarray(saved)
+        if saved.shape == target:
+            continue
+        if saved.ndim != 1 or len(target) != 1 or saved.shape[0] < numel \
+                or target[0] < numel:
+            # not a recognisable pad-geometry mismatch — leave it for
+            # the executor to surface rather than guessing
+            continue
+        base = None
+        if scope is not None:
+            cur = scope.find_var(name)
+            if cur is not None:
+                cur = np.asarray(cur)
+                if cur.shape == target:
+                    base = cur.astype(saved.dtype, copy=True)
+        if base is None:
+            out = np.zeros(target, dtype=saved.dtype)
+            if target[0] > numel and saved.shape[0] > numel:
+                # replicate the saved tail fill (constant by invariant)
+                out[numel:] = saved[numel]
+            base = out
+        base[:numel] = saved[:numel]
+        arrays[name] = base
+        regrouped += 1
+        telemetry.counter_add("sharding.zero_regroup_events", 1,
+                              var=name, saved_len=int(saved.shape[0]),
+                              new_len=int(target[0]), degree=degree)
+    return regrouped

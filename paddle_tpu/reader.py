@@ -432,7 +432,17 @@ class _GeneratorLoader:
     ``set_state()`` arms the NEXT iteration to fast-forward past that
     many batches — the exact-resume hook the crash-consistent checkpoint
     stack (paddle_tpu/checkpoint.py, ElasticRunner) stores and restores.
-    Exactness requires the underlying generator to be deterministic."""
+    Exactness requires the underlying generator to be deterministic.
+
+    Elastic worlds: ``set_world(world_size, trainer_id)`` turns the
+    loader into one member of a round-robin partition of the SAME
+    deterministic global stream — trainer t of W delivers exactly the
+    batches whose global index ≡ t (mod W). The cursor is the GLOBAL
+    stream position, so a checkpoint saved at one world size restores
+    into any other: every new trainer arms the same global cursor and
+    takes its own residue class — the reader re-split of a world-size-
+    changing resume needs no data munging (reader.cursor_resplits
+    counts the world-changing restores)."""
 
     def __init__(self, feed_list=None, capacity: int = 16,
                  return_list: bool = False, use_device_put: bool = True,
@@ -444,19 +454,49 @@ class _GeneratorLoader:
         self.mesh = mesh
         self._gen: Optional[Callable] = None
         self._places = None
-        self._position = 0        # cursor of the live/most recent iteration
+        self._position = 0        # GLOBAL cursor of the live iteration
         self._skip_next = 0       # armed by set_state for the next iteration
+        self._world_size = 1
+        self._trainer_id = 0
 
     # -- resumable cursor --------------------------------------------------
+    def set_world(self, world_size: int, trainer_id: int):
+        """Partition the global stream round-robin: this loader delivers
+        batches whose global index ≡ trainer_id (mod world_size)."""
+        world_size = int(world_size)
+        trainer_id = int(trainer_id)
+        if world_size < 1 or not 0 <= trainer_id < world_size:
+            raise ValueError(
+                f"set_world: need 0 <= trainer_id < world_size, got "
+                f"trainer {trainer_id} of {world_size}")
+        self._world_size = world_size
+        self._trainer_id = trainer_id
+        return self
+
     def state_dict(self) -> Dict[str, int]:
-        """{'batches': N} — position in the (deterministic) batch stream."""
-        return {"batches": int(self._position)}
+        """{'batches': N} — GLOBAL position in the (deterministic) batch
+        stream (plus the world shape when one is configured)."""
+        state = {"batches": int(self._position)}
+        if self._world_size > 1:
+            state["world_size"] = self._world_size
+            state["trainer_id"] = self._trainer_id
+        return state
 
     def set_state(self, state: Dict[str, int]):
-        """Arm the next iteration to discard the first N batches, so the
-        first delivered batch is the one a restored run expects."""
+        """Arm the next iteration to discard the first N GLOBAL batches,
+        so the first delivered batch is the one a restored run expects.
+        The cursor is global: a state saved by any member of any world
+        size restores into this loader's (possibly different) world —
+        the re-split is just this loader's own residue class applied
+        past the same cursor."""
         self._skip_next = max(0, int(state.get("batches", 0)))
         self._position = self._skip_next
+        saved_world = int(state.get("world_size", 1))
+        if saved_world != self._world_size:
+            from .core import telemetry as _telemetry
+            _telemetry.counter_add(
+                "reader.cursor_resplits", 1, saved_world=saved_world,
+                world=self._world_size, trainer=self._trainer_id)
 
     # -- configuration ----------------------------------------------------
     def set_sample_generator(self, generator, batch_size: int,
@@ -516,11 +556,16 @@ class _GeneratorLoader:
                                kind="timer")
             if item is _END:
                 break
+            index = self._position           # global index of this batch
             self._position += 1
             if skip > 0:
                 # fast-forward to the restored cursor: the batch was
                 # produced (deterministic stream) but never delivered
                 skip -= 1
+                continue
+            if index % self._world_size != self._trainer_id:
+                # another trainer's residue class — consumed from the
+                # global stream (the cursor advances) but not delivered
                 continue
             if self.return_list or not names:
                 yield list(item) if isinstance(item, tuple) else [item]

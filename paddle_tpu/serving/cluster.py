@@ -258,6 +258,11 @@ class ClusterController:
         self.replicas: List[Any] = []
         self._handles: Dict[str, Any] = {}
         self._restarts: Dict[str, int] = {}
+        # monotonic name source: a slot retired by scale_to is never
+        # renamed onto a later replica (router/fleet slots key by name)
+        self._next_index = 0
+        self._retired: set = set()
+        self._scaler = None
         self._watcher: Optional[_ckpt.ModelWatcher] = None
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -300,8 +305,9 @@ class ClusterController:
         # threads (spawned below) read and roll it under the same lock
         with self._swap_lock:
             self.current_version = newest[0]
-        for i in range(self.n_replicas):
-            replica = self._make_replica(i)
+        for _ in range(self.n_replicas):
+            replica = self._make_replica(self._next_index)
+            self._next_index += 1
             replica.spawn()
             self.replicas.append(replica)
             self._restarts[replica.name] = 0
@@ -356,9 +362,11 @@ class ClusterController:
     # -- supervision ---------------------------------------------------------
     def _monitor_loop(self):
         while not self._stop.wait(0.25):
-            for i, replica in enumerate(list(self.replicas)):
+            for replica in list(self.replicas):
                 if self._stop.is_set():
                     return
+                if id(replica) in self._retired:
+                    continue   # scale_to drained it on purpose
                 if replica.alive():
                     self._counted_dead.discard(id(replica))
                     continue
@@ -382,7 +390,8 @@ class ClusterController:
                     max_retries=3, backoff=0.2, deadline=60.0).start()
                 while not self._stop.is_set():
                     try:
-                        fresh = self._make_replica(i)
+                        fresh = self._make_replica(
+                            int(replica.name.rsplit("-", 1)[-1]))
                         fresh.spawn()
                     except ClusterError:
                         outcome, delay = sched.note_failure()
@@ -393,7 +402,14 @@ class ClusterController:
                             break
                         time.sleep(delay)
                         continue
-                    self.replicas[i] = fresh
+                    # locate by identity: a concurrent scale_to may have
+                    # shifted list positions (or retired this slot)
+                    slot = next((j for j, r in enumerate(self.replicas)
+                                 if r is replica), None)
+                    if slot is None:
+                        fresh.stop()
+                        break
+                    self.replicas[slot] = fresh
                     if handle is not None:
                         handle.rebind(fresh.url)
                         self.router.probe(handle)
@@ -557,6 +573,117 @@ class ClusterController:
                     break
                 time.sleep(0.05)
         return ok
+
+    # -- elastic replica scaling --------------------------------------------
+    def scale_to(self, n: int, reason: str = "manual",
+                 ready_timeout_s: float = 60.0) -> int:
+        """Grow or shrink the replica fleet to exactly ``n``, with zero
+        dropped in-flight requests.
+
+        Grow: spawn fresh replicas (on the newest published model),
+        router-register them, and wait for readiness. Shrink: pick the
+        most recently added replicas, wait for a READY peer (never take
+        the last ready replica offline), remove each from the router so
+        no NEW dispatch lands on it, then stop it gracefully — the
+        engine drains its queue before the socket closes. Each call is
+        ONE scale transition: exactly one incidents.report_scale_event.
+        Returns the new replica count."""
+        from ..core import incidents as _incidents
+
+        n = int(n)
+        if n < 1:
+            raise ClusterError("scale_to: need at least 1 replica")
+        with self._swap_lock:
+            old = len(self.replicas)
+            if n == old:
+                return old
+            if n > old:
+                for _ in range(n - old):
+                    replica = self._make_replica(self._next_index)
+                    self._next_index += 1
+                    replica.spawn()
+                    self.replicas.append(replica)
+                    self._restarts[replica.name] = 0
+                    self._handles[replica.name] = self.router.add_replica(
+                        replica.name, replica.url)
+                    if self.fleet_aggregator is not None:
+                        self.fleet_aggregator.register(replica.name,
+                                                       replica.url)
+                    # converge the newcomer onto the fleet's version if
+                    # a roll moved it past the newest-published default
+                    if self.current_version is not None and \
+                            replica.version != self.current_version:
+                        newest = _ckpt.ModelWatcher(
+                            self.model_root).latest()
+                        if newest is not None and \
+                                newest[0] == self.current_version:
+                            self._swap_one(replica, newest[0], newest[1])  # pt-lint: disable=blocking-call-under-lock(scale transitions serialise with rolls on purpose; bounded by the swap timeout)
+                deadline = time.monotonic() + ready_timeout_s
+                while time.monotonic() < deadline:
+                    for handle in self.router.handles():
+                        if not handle.ready:
+                            self.router.probe(handle)
+                    if all(h.ready for h in self.router.handles()):
+                        break
+                    time.sleep(0.05)  # pt-lint: disable=blocking-call-under-lock(scale transitions serialise with rolls on purpose; bounded by ready_timeout_s)
+            else:
+                for _ in range(old - n):
+                    victim = self.replicas[-1]
+                    # pt-lint: disable=blocking-call-under-lock(the zero-downtime invariant: a peer must be ready before this replica leaves the fleet)
+                    self._await_peer_ready(victim.name, timeout_s=30.0)
+                    self._retired.add(id(victim))
+                    self.replicas.remove(victim)
+                    self._handles.pop(victim.name, None)
+                    # router first: no NEW dispatch can land while the
+                    # engine drains its in-flight queue below
+                    self.router.remove_replica(victim.name)
+                    victim.stop()
+                    if self.fleet_aggregator is not None:
+                        self.fleet_aggregator.deregister(victim.name)
+            self.n_replicas = len(self.replicas)
+        telemetry.counter_add(
+            "router.scale_events", 1,
+            direction="up" if n > old else "down", replicas=n)
+        _incidents.report_scale_event(
+            "cluster", "resize", old, n, reason=reason)
+        return n
+
+    def attach_scaler(self, policy) -> "ClusterController":
+        """Drive replica count from a distributed.scaler.ScalerPolicy —
+        the SAME policy engine the training-side ElasticRunner uses,
+        pointed at serving signals (router load / queue saturation via
+        the fleet observatory)."""
+        self._scaler = policy
+        return self
+
+    def autoscale_tick(self, now: Optional[float] = None):
+        """One policy evaluation + (maybe) one scale transition.
+        Deterministic entry point — tests and external control loops
+        call this instead of racing a background thread. Returns the
+        executed ScaleDecision or None."""
+        if self._scaler is None:
+            return None
+        decision = self._scaler.decide(len(self.replicas), now=now,
+                                       fleet=self.fleet_aggregator)
+        if decision is None:
+            return None
+        self.scale_to(decision.target, reason=decision.reason)
+        return decision
+
+    def start_autoscaler(self, interval_s: float = 5.0):
+        """Background autoscale loop (production path; tests prefer
+        autoscale_tick)."""
+        def loop():
+            while not self._stop.wait(interval_s):
+                try:
+                    self.autoscale_tick()
+                except ClusterError:
+                    telemetry.counter_add("router.scale_errors", 1)
+        t = threading.Thread(target=loop, name="pt-cluster-autoscale",
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
 
     # -- introspection -------------------------------------------------------
     def stats(self) -> Dict[str, Any]:

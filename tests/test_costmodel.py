@@ -485,9 +485,11 @@ def _emitted_metric_names():
                     if name.startswith(("cost.", "mem.", "costmodel.",
                                         "pallas.", "incidents.",
                                         "slo.", "tuner.",
-                                        "goodput.", "fleet.")) or \
+                                        "goodput.", "fleet.",
+                                        "scaler.", "elastic.")) or \
                             (name.startswith("sharding.")
-                             and "state_bytes" in name):
+                             and ("state_bytes" in name
+                                  or "zero_regroup" in name)):
                         names.add(name)
     return names
 
@@ -526,6 +528,19 @@ class TestMetricDriftGuard:
         assert "goodput.wall_ms" in names
         assert "goodput.ratio" in names
         assert "goodput.badput_" in names
+        # the elastic resize / autoscaling plane (distributed/scaler.py
+        # policy engine + distributed/elastic.py runner)
+        assert "scaler.evaluations" in names
+        assert "scaler.decisions" in names
+        assert "scaler.scale_up" in names
+        assert "scaler.scale_down" in names
+        assert "scaler.suppressed_cooldown" in names
+        assert "scaler.clamped" in names
+        assert "elastic.restarts" in names
+        assert "elastic.scale_events" in names
+        assert "elastic.restart_budget_refunds" in names
+        assert "incidents.scale_events" in names
+        assert "sharding.zero_regroup_events" in names
         # the fleet observatory (core/fleetobs.py)
         assert "fleet.scrapes" in names
         assert "fleet.scrape_failures" in names

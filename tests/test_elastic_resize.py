@@ -1,0 +1,1014 @@
+"""Elastic resize: world-size-changing resume + signal-driven autoscaling
+(distributed/scaler.py, distributed/elastic.py, parallel/zero_regroup.py,
+the reader's global-cursor re-split, large_scale_kv re-sharding and the
+pserver barrier-regrow path).
+
+Contracts under test:
+* the reader cursor is GLOBAL: a checkpoint saved at one world size
+  restores into any other — each trainer takes its `index % W` residue
+  class past the same cursor (reader.cursor_resplits counted);
+* large_scale_kv restores into a different shard count (layout is never
+  trusted at load) and KVTables rebalances across a changed SERVER
+  count with zero leaked / zero duplicated rows;
+* ZeRO stage-1/2 optimizer shards regroup across a dp-degree change
+  (padded length is a function of the degree) — resume at a different
+  degree continues the loss trajectory of the uninterrupted run;
+* a degraded-to-survivors sync barrier REGROWS: a revived trainer is
+  required again and a brand-new trainer id is admitted (elastic
+  admission), with ps.barrier_degraded / ps.barrier_regrown pinned;
+* ScalerPolicy: rule order, cooldown suppression, min/max clamping,
+  exactly-once decision counters;
+* ElasticRunner: windowed restart budget with progress refunds, a
+  kind:"scale" ring record per restart, and execute_scale's checkpoint
+  -> drain -> relaunch-at-new-world protocol (loss-transparent);
+* ClusterController autoscaling: ScaleUp and ScaleDown each fire
+  exactly once off the live signals with zero dropped in-flight
+  requests through the drain.
+
+tools/chaos_check.py --resize is the CLI twin of the end-to-end story.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core import telemetry
+
+_FLAG_DEFAULTS = {
+    "FLAGS_ps_degrade_to_survivors": False,
+    "FLAGS_ps_elastic_admission": True,
+    "FLAGS_elastic_restart_window_s": 0.0,
+    "FLAGS_scaler_min_world": 1,
+    "FLAGS_scaler_max_world": 8,
+    "FLAGS_scaler_cooldown_s": 30.0,
+    "FLAGS_scaler_window_s": 30.0,
+    "FLAGS_scaler_queue_high_frac": 0.85,
+    "FLAGS_scaler_queue_low_frac": 0.10,
+    "FLAGS_scaler_step_p99_high_ms": 0.0,
+}
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    from paddle_tpu.distributed.ps.rpc import RPCClient
+
+    def scrub():
+        pt.set_flags(_FLAG_DEFAULTS)
+        telemetry.configure(None)
+        RPCClient.reset_pool()
+
+    scrub()
+    yield
+    scrub()
+
+
+def _delta(before, name):
+    return int(telemetry.counters().get(name, 0)) - int(before.get(name, 0))
+
+
+# ---------------------------------------------------------------------------
+# reader: the global cursor re-splits across world changes
+# ---------------------------------------------------------------------------
+
+def _stream(n):
+    def gen():
+        for i in range(n):
+            yield np.full((2, 3), i, np.float32)
+    return gen
+
+
+def _loader(n):
+    from paddle_tpu.reader import DataLoader
+
+    loader = DataLoader.from_generator(capacity=2, return_list=True,
+                                       use_double_buffer=False)
+    loader.set_batch_generator(_stream(n))
+    return loader
+
+
+def _values(batches):
+    return [int(np.asarray(b[0])[0, 0]) for b in batches]
+
+
+class TestReaderCursorResplit:
+    def test_residue_class_partition_covers_stream(self):
+        """Trainer t of W delivers exactly the global indices ≡ t (mod W);
+        the union over trainers is the whole stream, disjoint."""
+        per_trainer = {}
+        for tid in range(3):
+            loader = _loader(12).set_world(3, tid)
+            per_trainer[tid] = _values(loader)
+            # the cursor is the GLOBAL stream position, not the count of
+            # delivered batches
+            assert loader.state_dict()["batches"] == 12
+        for tid, vals in per_trainer.items():
+            assert vals == [i for i in range(12) if i % 3 == tid]
+
+    def test_world1_state_dict_stays_legacy(self):
+        loader = _loader(4)
+        list(loader)
+        assert loader.state_dict() == {"batches": 4}
+
+    def test_world_keys_recorded_beyond_world1(self):
+        loader = _loader(6).set_world(2, 1)
+        list(loader)
+        assert loader.state_dict() == {"batches": 6, "world_size": 2,
+                                       "trainer_id": 1}
+
+    def test_cursor_restores_into_different_world(self):
+        """A cursor saved by a world-2 member restores into a world-4
+        member: the new trainer fast-forwards the same global stream and
+        takes its own residue class (reader.cursor_resplits counted)."""
+        saver = _loader(12).set_world(2, 0)
+        it = iter(saver)
+        assert _values([next(it), next(it)]) == [0, 2]
+        state = saver.state_dict()      # global cursor: items 0..2 drawn
+        assert state["batches"] == 3
+
+        before = dict(telemetry.counters())
+        resumed = _loader(12).set_world(4, 1)
+        resumed.set_state(state)
+        assert _delta(before, "reader.cursor_resplits") == 1
+        assert _values(resumed) == [i for i in range(3, 12) if i % 4 == 1]
+
+    def test_same_world_restore_counts_no_resplit(self):
+        saver = _loader(6).set_world(2, 0)
+        list(saver)
+        before = dict(telemetry.counters())
+        resumed = _loader(6).set_world(2, 1)
+        resumed.set_state(saver.state_dict())
+        assert _delta(before, "reader.cursor_resplits") == 0
+
+    def test_set_world_validates(self):
+        loader = _loader(2)
+        with pytest.raises(ValueError, match="trainer_id"):
+            loader.set_world(2, 2)
+        with pytest.raises(ValueError, match="trainer_id"):
+            loader.set_world(0, 0)
+
+
+# ---------------------------------------------------------------------------
+# large_scale_kv: shard-count-independent restore + cross-server rebalance
+# ---------------------------------------------------------------------------
+
+class TestKVReshard:
+    def _train_rows(self, kv, ids, dim, seed=3):
+        kv.pull(ids)
+        kv.push(ids, np.random.RandomState(seed).randn(len(ids), dim)
+                .astype(np.float32), lr=0.5)
+        return kv.pull(ids)
+
+    def test_restore_into_different_num_shards(self, tmp_path):
+        """The in-process shard layout is never trusted at load: a table
+        saved at 8 shards restores into 3 with identical rows."""
+        from paddle_tpu.distributed.large_scale_kv import LargeScaleKV
+
+        ids = np.arange(40, dtype=np.int64) * 7 + 2
+        kv8 = LargeScaleKV(dim=4, num_shards=8, seed=9)
+        want = self._train_rows(kv8, ids, 4)
+        path = str(tmp_path / "kv8.npz")
+        kv8.save(path)
+
+        kv3 = LargeScaleKV(dim=4, num_shards=3, seed=9)
+        assert kv3.load(path) == len(ids)
+        assert kv3.size() == len(ids)
+        np.testing.assert_array_equal(np.sort(ids), kv3.ids())
+        np.testing.assert_array_equal(want, kv3.pull(ids))
+
+    def test_load_keep_filter(self, tmp_path):
+        from paddle_tpu.distributed.large_scale_kv import LargeScaleKV
+
+        ids = np.arange(10, dtype=np.int64)
+        kv = LargeScaleKV(dim=2, num_shards=4, seed=1)
+        self._train_rows(kv, ids, 2)
+        path = str(tmp_path / "kv.npz")
+        kv.save(path)
+        half = LargeScaleKV(dim=2, num_shards=4, seed=1)
+        assert half.load(path, keep=lambda i: i % 2 == 0) == 5
+        np.testing.assert_array_equal(half.ids(),
+                                      np.arange(0, 10, 2, dtype=np.int64))
+
+    def test_cross_server_rebalance_conserves_rows(self, tmp_path):
+        """2-server snapshots restore into 3 servers: every server reads
+        EVERY tag's files and keeps its `id % 3` class — the union is
+        exactly the saved set (zero leaked, zero duplicated) and pulls
+        match the pre-resize values."""
+        from paddle_tpu.distributed.ps.kv_service import KVTables
+
+        dim, ids = 4, np.arange(60, dtype=np.int64) * 5 + 1
+        grads = np.random.RandomState(2).randn(len(ids), dim) \
+            .astype(np.float32)
+        old = [KVTables() for _ in range(2)]
+        want = {}
+        for j, tab in enumerate(old):
+            kv = tab.ensure("emb", dim, seed=7)
+            mine = ids[ids % 2 == j]
+            kv.pull(mine)
+            kv.push(mine, grads[ids % 2 == j], lr=0.5)
+            for i in mine:
+                want[int(i)] = kv.pull([i])[0].copy()
+            tab.save_all(str(tmp_path), str(j))
+
+        before = dict(telemetry.counters())
+        new = [KVTables() for _ in range(3)]
+        ingested = sum(tab.load_all(str(tmp_path), f"n{j}", num_servers=3,
+                                    server_index=j)
+                       for j, tab in enumerate(new))
+        assert ingested == len(ids)
+        assert _delta(before, "ps.kv_rebalanced_rows") == len(ids)
+        got = np.concatenate([tab.tables["emb"].ids() for tab in new])
+        assert got.size == len(ids), "leaked or duplicated rows"
+        np.testing.assert_array_equal(np.sort(got), np.sort(ids))
+        for j, tab in enumerate(new):
+            mine = tab.tables["emb"].ids()
+            assert np.all(mine % 3 == j), "row outside its residue class"
+            for i in mine:
+                np.testing.assert_array_equal(
+                    want[int(i)], tab.tables["emb"].pull([i])[0])
+
+    def test_conflicting_specs_across_servers_raise(self, tmp_path):
+        from paddle_tpu.distributed.ps.kv_service import KVTables
+
+        a, b = KVTables(), KVTables()
+        a.ensure("emb", 4, seed=1).pull([0])
+        b.ensure("emb", 8, seed=1).pull([1])
+        a.save_all(str(tmp_path), "0")
+        b.save_all(str(tmp_path), "1")
+        with pytest.raises(ValueError, match="conflicting"):
+            KVTables().load_all(str(tmp_path), "n0", num_servers=2,
+                                server_index=0)
+
+
+# ---------------------------------------------------------------------------
+# ZeRO optimizer-shard regrouping across a dp-degree change
+# ---------------------------------------------------------------------------
+
+class TestZeroRegroupUnit:
+    def test_repad_preserves_logical_prefix_and_tail(self):
+        """Saved [numel..padded(old)] state re-pads to the new geometry:
+        the logical prefix is copied, the tail comes from the startup
+        array in the scope (or replicates the saved pad fill)."""
+        from paddle_tpu.parallel import regroup_state
+
+        prog, startup = pt.Program(), pt.Program()
+        with pt.program_guard(prog, startup):
+            v = layers.create_global_var([8], 0.0, "float32",
+                                         persistable=True, name="zr_acc")
+        prog._zero_state_numel = {"zr_acc": 6}
+        prog._zero_degree = 4
+
+        before = dict(telemetry.counters())
+        arrays = {"zr_acc": np.arange(10, dtype=np.float32)}  # degree-5 pad
+        assert regroup_state(arrays, prog, scope=None) == 1
+        np.testing.assert_array_equal(
+            arrays["zr_acc"],
+            np.array([0, 1, 2, 3, 4, 5, 6, 6], np.float32))
+        assert _delta(before, "sharding.zero_regroup_events") == 1
+
+        scope = pt.Scope()
+        scope.set("zr_acc", np.full(8, 0.5, np.float32))
+        arrays = {"zr_acc": np.arange(10, dtype=np.float32)}
+        assert regroup_state(arrays, prog, scope=scope) == 1
+        np.testing.assert_array_equal(
+            arrays["zr_acc"],
+            np.array([0, 1, 2, 3, 4, 5, 0.5, 0.5], np.float32))
+        assert v.name == "zr_acc"
+
+    def test_matching_geometry_is_untouched(self):
+        from paddle_tpu.parallel import regroup_state
+
+        prog, startup = pt.Program(), pt.Program()
+        with pt.program_guard(prog, startup):
+            layers.create_global_var([8], 0.0, "float32",
+                                     persistable=True, name="zr_same")
+        prog._zero_state_numel = {"zr_same": 6}
+        saved = np.arange(8, dtype=np.float32)
+        arrays = {"zr_same": saved}
+        assert regroup_state(arrays, prog, scope=None) == 0
+        assert arrays["zr_same"] is saved
+
+
+DP = 8
+
+
+@pytest.fixture
+def _dp_mesh():
+    import jax
+
+    from paddle_tpu.parallel import create_mesh
+    from paddle_tpu.parallel import mesh as meshmod
+
+    if len(jax.devices()) < DP:
+        pytest.skip(f"needs {DP} virtual devices")
+    mesh = create_mesh({"dp": DP})
+    yield mesh
+    meshmod.set_mesh(None)
+
+
+def _fresh_names():
+    from paddle_tpu.core import unique_name
+
+    unique_name.switch()
+
+
+def _zero_build(stage, lr=0.1):
+    """Momentum net with dims chosen so padded shard lengths DIFFER
+    between dp=8 and dp=4 (33 → pad 40 vs 36, 330 → 336 vs 332,
+    10 → 16 vs 12) — the regroup path must actually fire."""
+    from paddle_tpu.distributed import fleet
+
+    _fresh_names()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [16])
+        h = layers.fc(x, 33, act="relu", param_attr=pt.ParamAttr(
+            name="zr_w0", initializer=pt.initializer.Xavier(seed=31)),
+            bias_attr=pt.ParamAttr(name="zr_b0"))
+        y = layers.fc(h, 10, param_attr=pt.ParamAttr(
+            name="zr_w1", initializer=pt.initializer.Xavier(seed=32)),
+            bias_attr=pt.ParamAttr(name="zr_b1"))
+        loss = layers.mean(y * y)
+        strategy = fleet.DistributedStrategy()
+        strategy.sharding = True
+        strategy.sharding_configs = {"stage": stage}
+        dopt = fleet.distributed_optimizer(
+            pt.optimizer.MomentumOptimizer(lr, 0.9), strategy)
+        dopt.minimize(loss)
+    return main, startup, loss
+
+
+def _zero_feed(seed):
+    return {"x": np.random.RandomState(seed).randn(16, 16)
+            .astype(np.float32)}
+
+
+class TestZeroWorldChangeResume:
+    @pytest.mark.parametrize("stage", [1, 2])
+    def test_dp8_checkpoint_resumes_at_dp4(self, _dp_mesh, tmp_path, stage):
+        """The tentpole gate: train at dp=8, checkpoint, restore into a
+        dp=4 program (different shard padding) and continue — the loss
+        trajectory and final params match the uninterrupted dp=8 run at
+        the preserved global batch, with the regroup events counted and
+        the saved degree recorded in the manifest."""
+        from paddle_tpu import checkpoint as ckpt
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.parallel import create_mesh
+        from paddle_tpu.parallel import mesh as meshmod
+
+        fleet.init(is_collective=True)
+        exe = pt.Executor(pt.CPUPlace())
+
+        def train(main, startup, loss, mesh, steps, scope=None,
+                  start_seed=0):
+            sc = scope or pt.Scope()
+            if scope is None:
+                exe.run(startup, scope=sc, use_compiled=False)
+            out = []
+            for s in range(steps):
+                r = exe.run(main, feed=_zero_feed(start_seed + s),
+                            fetch_list=[loss], scope=sc, mesh=mesh)
+                out.append(float(np.asarray(r[0]).reshape(-1)[0]))
+            return sc, out
+
+        # uninterrupted dp=8 reference
+        main8, start8, loss8 = _zero_build(stage)
+        assert main8._zero_degree == DP
+        sc_full, full_losses = train(main8, start8, loss8, _dp_mesh, 4)
+        want = {p.name: np.asarray(sc_full.find_var(p.name))
+                for p in main8.all_parameters()}
+
+        # interrupted: 2 steps at dp=8, checkpoint
+        sc_a, _ = train(main8, start8, loss8, _dp_mesh, 2)
+        path = str(tmp_path / f"zero-resize-{stage}")
+        ckpt.save_checkpoint(path, program=main8, scope=sc_a)
+        manifest = json.load(open(f"{path}/MANIFEST.json"))
+        assert manifest["extras"]["sharding"]["zero_degree"] == DP
+
+        # resume into dp=4: same net, rebuilt at the new degree
+        meshmod.set_mesh(None)
+        mesh4 = create_mesh({"dp": 4})
+        try:
+            main4, start4, loss4 = _zero_build(stage)
+            assert main4._zero_degree == 4
+            sc_b = pt.Scope()
+            exe.run(start4, scope=sc_b, use_compiled=False)
+            before = dict(telemetry.counters())
+            ckpt.load_checkpoint(path, program=main4, scope=sc_b)
+            # zr_b0 (33), zr_w1 (330) and zr_b1 (10) velocity shards all
+            # change padded length between degree 8 and 4; zr_w0 (528)
+            # pads identically at both
+            assert _delta(before, "sharding.zero_regroup_events") == 3
+            _, resumed_losses = train(main4, start4, loss4, mesh4, 2,
+                                      scope=sc_b, start_seed=2)
+        finally:
+            meshmod.set_mesh(None)
+            create_mesh({"dp": DP})
+
+        np.testing.assert_allclose(resumed_losses, full_losses[2:],
+                                   rtol=2e-5, atol=1e-6)
+        for p in main4.all_parameters():
+            np.testing.assert_allclose(
+                np.asarray(sc_b.find_var(p.name)), want[p.name],
+                rtol=2e-5, atol=1e-6,
+                err_msg=f"{p.name} diverged across the dp 8 -> 4 resume")
+
+
+# ---------------------------------------------------------------------------
+# pserver barrier regrow (revival + elastic admission)
+# ---------------------------------------------------------------------------
+
+def _ps_net():
+    from paddle_tpu.core import ir
+
+    ir._main_program, ir._startup_program = ir.Program(), ir.Program()
+    _fresh_names()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [8], stop_gradient=True)
+        h = layers.fc(x, 8, act="relu", param_attr=pt.ParamAttr(
+            name="er_w0", initializer=pt.initializer.Xavier(seed=41)),
+            bias_attr=pt.ParamAttr(name="er_b0"))
+        y = layers.fc(h, 2, param_attr=pt.ParamAttr(
+            name="er_w1", initializer=pt.initializer.Xavier(seed=42)),
+            bias_attr=pt.ParamAttr(name="er_b1"))
+        loss = layers.mean(y * y)
+        pt.optimizer.SGDOptimizer(0.1).minimize(loss)
+    return main, startup
+
+
+def _ps_server(trainers, **kw):
+    from paddle_tpu.distributed.ps import DistributeTranspiler, PServer
+
+    main, startup = _ps_net()
+    t = DistributeTranspiler()
+    t.transpile(0, program=main, startup_program=startup,
+                pservers="127.0.0.1:0", trainers=trainers, sync_mode=True)
+    prog, ps_startup = t.get_pserver_programs("127.0.0.1:0")
+    return PServer("127.0.0.1:0", prog, ps_startup, num_trainers=trainers,
+                   sync_mode=True, grad_to_param=prog._ps_grad_to_param,
+                   grad_to_ops=prog._ps_grad_to_ops,
+                   common_ops=prog._ps_common_ops, **kw)
+
+
+class TestBarrierRegrow:
+    def test_degrade_then_regrow_revived_and_new_trainer(self):
+        """Satellite gate: a degraded-to-survivors barrier re-admits the
+        revived trainer AND accepts a brand-new trainer id — the next
+        barrier needs all three. Counter deltas pinned."""
+        from paddle_tpu.distributed.ps.rpc import RPCClient
+
+        pt.set_flags({"FLAGS_ps_degrade_to_survivors": True})
+        server = _ps_server(2, heartbeat_timeout=0.8)
+        before = dict(telemetry.counters())
+        try:
+            (g,) = [g for g, p in server.grad_to_param.items()
+                    if p == "er_w0"]
+            st = server.states[g]
+            shape = np.asarray(server.scope.find_var("er_w0")).shape
+            ones = np.ones(shape, np.float32)
+            clis = [RPCClient(server.endpoint) for _ in range(3)]
+
+            # full barrier at world 2
+            clis[0].call("send_grad", g, ones, aux=0)
+            clis[1].call("send_grad", g, ones, aux=1)
+            assert st.version == 1
+
+            # trainer 1 goes silent -> the survivors complete the step
+            # (trainer 0 keeps heartbeating so only 1 draws the verdict)
+            clis[0].call("send_grad", g, ones, aux=0)
+            deadline = time.monotonic() + 10.0
+            while st.version < 2 and time.monotonic() < deadline:
+                clis[0].call("heartbeat", "", None, aux=0)
+                time.sleep(0.05)
+            assert st.version == 2, "barrier never degraded to survivors"
+            assert _delta(before, "ps.barrier_degraded") == 1
+            assert _delta(before, "ps.trainer_dead") == 1
+
+            # revival: trainer 1 is required again...
+            clis[1].call("heartbeat", "", None, aux=1)
+            assert 1 not in server.monitor.dead
+            assert _delta(before, "ps.trainer_revived") == 1
+            assert _delta(before, "ps.barrier_regrown") == 1
+            # ...and a brand-new trainer id GROWS the barrier (elastic
+            # admission): world 2 -> 3
+            clis[2].call("heartbeat", "", None, aux=2)
+            assert server.num_trainers == 3
+            assert 2 in server.monitor.last_seen
+            assert _delta(before, "ps.barrier_regrown") == 2
+
+            # the next step's barrier needs all three members
+            clis[0].call("send_grad", g, ones, aux=0)
+            clis[1].call("send_grad", g, ones, aux=1)
+            assert st.version == 2, "barrier completed without the admitted"
+            clis[2].call("send_grad", g, ones, aux=2)
+            assert st.version == 3
+            assert _delta(before, "ps.trainer_dead") == 1
+            assert _delta(before, "ps.trainer_revived") == 1
+        finally:
+            server.shutdown()
+
+    def test_admission_gated_by_flag(self):
+        from paddle_tpu.distributed.ps.rpc import RPCClient
+
+        pt.set_flags({"FLAGS_ps_elastic_admission": False})
+        server = _ps_server(2, heartbeat_timeout=30.0)
+        before = dict(telemetry.counters())
+        try:
+            cli = RPCClient(server.endpoint)
+            cli.call("heartbeat", "", None, aux=5)
+            assert server.num_trainers == 2
+            assert _delta(before, "ps.barrier_regrown") == 0
+        finally:
+            server.shutdown()
+
+    def test_admission_is_idempotent(self):
+        from paddle_tpu.distributed.ps.rpc import RPCClient
+
+        server = _ps_server(2, heartbeat_timeout=30.0)
+        before = dict(telemetry.counters())
+        try:
+            cli = RPCClient(server.endpoint)
+            cli.call("heartbeat", "", None, aux=3)
+            cli.call("heartbeat", "", None, aux=3)
+            assert server.num_trainers == 4
+            assert _delta(before, "ps.barrier_regrown") == 1
+        finally:
+            server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# ScalerPolicy: rules, cooldown, clamping
+# ---------------------------------------------------------------------------
+
+class TestScalerPolicy:
+    def _policy(self, **kw):
+        from paddle_tpu.distributed.scaler import ScalerPolicy
+
+        kw.setdefault("min_world", 1)
+        kw.setdefault("max_world", 8)
+        kw.setdefault("cooldown_s", 0.0)
+        return ScalerPolicy(**kw)
+
+    def test_rule_order_and_directions(self):
+        from paddle_tpu.distributed.scaler import (SCALE_DOWN, SCALE_UP,
+                                                   ScaleSignals)
+
+        p = self._policy()
+        cases = [
+            (ScaleSignals(dead_workers=2), SCALE_DOWN, 2, "heartbeat_dead"),
+            (ScaleSignals(joined_workers=1), SCALE_UP, 5, "worker_rejoined"),
+            (ScaleSignals(queue_frac=0.9, queue_evidence=True),
+             SCALE_UP, 5, "queue_saturation"),
+            (ScaleSignals(queue_frac=0.05, queue_evidence=True),
+             SCALE_DOWN, 3, "underutilized"),
+        ]
+        for sig, direction, target, reason in cases:
+            d = p.decide(4, signals=sig, now=100.0)
+            p.reset_cooldown()
+            assert (d.direction, d.target, d.reason) == \
+                (direction, target, reason)
+        # dead beats joined beats queue (first hit wins)
+        d = p.decide(4, signals=ScaleSignals(dead_workers=1,
+                                             joined_workers=1,
+                                             queue_frac=0.99,
+                                             queue_evidence=True),
+                     now=200.0)
+        assert d.reason == "heartbeat_dead" and d.target == 3
+
+    def test_no_queue_evidence_means_no_queue_rules(self):
+        from paddle_tpu.distributed.scaler import ScaleSignals
+
+        p = self._policy()
+        assert p.decide(4, signals=ScaleSignals(queue_frac=0.0),
+                        now=1.0) is None
+
+    def test_step_p99_rule_when_bound_set(self):
+        from paddle_tpu.distributed.scaler import ScaleSignals
+
+        p = self._policy(step_p99_high_ms=50.0)
+        d = p.decide(2, signals=ScaleSignals(step_p99_ms=80.0), now=1.0)
+        assert d.reason == "step_time_p99" and d.target == 3
+        p2 = self._policy()          # bound 0 -> rule disabled
+        assert p2.decide(2, signals=ScaleSignals(step_p99_ms=1e9),
+                         now=1.0) is None
+
+    def test_cooldown_suppresses_thrash(self):
+        from paddle_tpu.distributed.scaler import ScaleSignals
+
+        p = self._policy(cooldown_s=10.0)
+        sig = ScaleSignals(queue_frac=0.95, queue_evidence=True)
+        before = dict(telemetry.counters())
+        assert p.decide(2, signals=sig, now=100.0) is not None
+        assert p.decide(3, signals=sig, now=105.0) is None
+        assert _delta(before, "scaler.suppressed_cooldown") == 1
+        assert p.decide(3, signals=sig, now=111.0) is not None
+
+    def test_clamp_to_bounds_and_to_current(self):
+        from paddle_tpu.distributed.scaler import ScaleSignals
+
+        p = self._policy(min_world=2, max_world=4)
+        before = dict(telemetry.counters())
+        d = p.decide(4, signals=ScaleSignals(joined_workers=3), now=1.0)
+        assert d is None              # clamped back onto the current world
+        assert _delta(before, "scaler.clamped") == 1
+        d = p.decide(3, signals=ScaleSignals(joined_workers=3), now=2.0)
+        assert d.target == 4          # clamped to max, still a move
+        p.reset_cooldown()
+        d = p.decide(3, signals=ScaleSignals(dead_workers=2), now=3.0)
+        assert d.target == 2          # clamped to min
+
+    def test_decision_counters_exactly_once(self):
+        from paddle_tpu.distributed.scaler import ScaleSignals
+
+        p = self._policy()
+        before = dict(telemetry.counters())
+        p.decide(2, signals=ScaleSignals(joined_workers=1), now=1.0)
+        p.decide(3, signals=ScaleSignals(dead_workers=1), now=2.0)
+        p.decide(2, signals=ScaleSignals(), now=3.0)     # no rule fires
+        assert _delta(before, "scaler.evaluations") == 3
+        assert _delta(before, "scaler.decisions") == 2
+        assert _delta(before, "scaler.scale_up") == 1
+        assert _delta(before, "scaler.scale_down") == 1
+
+    def test_bounds_validated(self):
+        with pytest.raises(ValueError, match="min_world"):
+            self._policy(min_world=0)
+        with pytest.raises(ValueError, match="min_world"):
+            self._policy(min_world=4, max_world=2)
+
+    def test_gather_signals_from_window(self):
+        from paddle_tpu.distributed.scaler import gather_signals
+
+        window = {
+            "counters": {"ps.trainer_dead": {"delta": 2},
+                         "ps.trainer_revived": {"delta": 1},
+                         "ps.barrier_regrown": {"delta": 3}},
+            "gauges": {"fleet.queue_frac": 0.5},
+            "hists": {"executor.run_ms": {"count": 10, "p99": 42.0}},
+        }
+        sig = gather_signals(window=window)
+        assert sig.dead_workers == 1          # dead net of revived
+        assert sig.joined_workers == 3        # max(revived, regrown)
+        assert sig.queue_frac == 0.5 and sig.queue_evidence
+        assert sig.step_p99_ms == 42.0
+        assert sig.extra["step_metric"] == "executor.run_ms"
+
+
+# ---------------------------------------------------------------------------
+# ElasticRunner: windowed restart budget + the scale-event protocol
+# ---------------------------------------------------------------------------
+
+def _local_net(lr=0.1):
+    _fresh_names()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [6], stop_gradient=True)
+        y = layers.fc(x, 3, param_attr=pt.ParamAttr(
+            name="el_w0", initializer=pt.initializer.Xavier(seed=51)),
+            bias_attr=pt.ParamAttr(name="el_b0"))
+        loss = layers.mean(y * y)
+        pt.optimizer.SGDOptimizer(lr).minimize(loss)
+    return main, startup, loss
+
+
+class TestElasticRestartBudget:
+    def test_window_refunds_expired_restarts(self, tmp_path):
+        from paddle_tpu.distributed.elastic import ElasticRunner
+
+        runner = ElasticRunner(str(tmp_path), restart_window_s=10.0)
+        runner.restarts = 3
+        runner._restart_times.extend([100.0, 101.0, 108.0])
+        before = dict(telemetry.counters())
+        # at t=111.5 the first two restarts are older than the window
+        assert runner.budget_used(now=111.5) == 1
+        assert _delta(before, "elastic.restart_budget_refunds") == 2
+        # lifetime total is untouched (observability)
+        assert runner.restarts == 3
+
+    def test_legacy_lifetime_budget_without_window(self, tmp_path):
+        from paddle_tpu.distributed.elastic import ElasticRunner
+
+        runner = ElasticRunner(str(tmp_path), restart_window_s=0.0)
+        runner.restarts = 2
+        assert runner.budget_used(now=1e9) == 2
+
+    def test_restart_lands_scale_ring_record(self, tmp_path):
+        """Every restart is a scale-plane event: one kind:"scale" record
+        (source elastic, event restart) + incidents.scale_events."""
+        from paddle_tpu.distributed.elastic import ElasticRunner
+
+        log = tmp_path / "run.jsonl"
+        telemetry.configure(str(log))
+        main, startup, loss = _local_net()
+        exe = pt.Executor(pt.CPUPlace())
+        scope = pt.Scope()
+        exe.run(startup, scope=scope, use_compiled=False)
+        feed = {"x": np.random.RandomState(0).randn(4, 6)
+                .astype(np.float32)}
+        runner = ElasticRunner(str(tmp_path / "ckpt"), main, scope,
+                               save_interval_steps=1, max_restarts=3,
+                               async_save=False)
+        state = {"raised": False}
+
+        def step_fn(step):
+            if step == 1 and not state["raised"]:
+                state["raised"] = True
+                raise ConnectionError("injected")
+            out = exe.run(main, feed=feed, fetch_list=[loss], scope=scope,
+                          use_compiled=False)
+            return float(np.asarray(out[0]).reshape(-1)[0])
+
+        before = dict(telemetry.counters())
+        runner.run(step_fn, 3)
+        runner.close()
+        assert _delta(before, "elastic.restarts") == 1
+        assert _delta(before, "incidents.scale_events") == 1
+        telemetry.flush_sink()
+        recs = [json.loads(line) for line in open(log) if line.strip()]
+        scale = [r for r in recs if r.get("kind") == "scale"]
+        assert len(scale) == 1
+        assert scale[0]["name"] == "elastic.restart"
+        assert scale[0]["attrs"]["reason"] == "ConnectionError"
+        assert scale[0]["attrs"]["old_world"] == \
+            scale[0]["attrs"]["new_world"] == 1
+
+
+class _ScriptedScaler:
+    """decide() plays back a fixed decision list — the policy is pinned
+    by TestScalerPolicy; here the EXECUTION protocol is under test."""
+
+    def __init__(self, decisions):
+        self.decisions = list(decisions)
+
+    def decide(self, world, now=None, fleet=None, signals=None):
+        return self.decisions.pop(0) if self.decisions else None
+
+
+class TestElasticExecuteScale:
+    def test_resize_is_loss_transparent(self, tmp_path):
+        """execute_scale: checkpoint -> drain -> on_scale swap -> restore
+        into the new world. With every trainer carrying the full global
+        batch the resized run's losses are BITWISE the uninterrupted
+        run's."""
+        from paddle_tpu.distributed.elastic import ElasticRunner
+        from paddle_tpu.distributed.scaler import SCALE_DOWN, ScaleDecision
+
+        exe = pt.Executor(pt.CPUPlace())
+        feed = {"x": np.random.RandomState(5).randn(4, 6)
+                .astype(np.float32)}
+
+        def leg(scaler, on_scale, steps=4):
+            main, startup, loss = _local_net()
+            scope = pt.Scope()
+            exe.run(startup, scope=scope, use_compiled=False)
+            runner = ElasticRunner(
+                str(tmp_path / f"ckpt-{id(scaler)}"), main, scope,
+                save_interval_steps=1, async_save=False, world_size=2,
+                scaler=scaler, on_scale=on_scale)
+            losses = []
+
+            def step_fn(step):
+                out = exe.run(main, feed=feed, fetch_list=[loss],
+                              scope=scope, use_compiled=False)
+                losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+                return losses[-1]
+
+            runner.run(step_fn, steps)
+            runner.close()
+            return runner, losses
+
+        _, base = leg(None, None)
+
+        decision = ScaleDecision(direction=SCALE_DOWN, current=2, target=1,
+                                 reason="heartbeat_dead", ts=1.0)
+        before = dict(telemetry.counters())
+        runner, got = leg(_ScriptedScaler([decision]),
+                          lambda d: {"world_size": d.target})
+        assert runner.world_size == 1
+        assert runner.scale_events == 1
+        assert got == base, "resize must be loss-transparent"
+        assert _delta(before, "elastic.scale_events") == 1
+        assert _delta(before, "incidents.scale_events") == 1
+
+    def test_on_scale_veto_keeps_world(self, tmp_path):
+        from paddle_tpu.distributed.elastic import ElasticRunner
+        from paddle_tpu.distributed.scaler import SCALE_UP, ScaleDecision
+
+        main, startup, loss = _local_net()
+        exe = pt.Executor(pt.CPUPlace())
+        scope = pt.Scope()
+        exe.run(startup, scope=scope, use_compiled=False)
+        feed = {"x": np.random.RandomState(5).randn(4, 6)
+                .astype(np.float32)}
+        decision = ScaleDecision(direction=SCALE_UP, current=2, target=4,
+                                 reason="worker_rejoined", ts=1.0)
+        runner = ElasticRunner(str(tmp_path / "ckpt"), main, scope,
+                               save_interval_steps=1, async_save=False,
+                               world_size=2,
+                               scaler=_ScriptedScaler([decision]),
+                               on_scale=lambda d: None)
+        before = dict(telemetry.counters())
+
+        def step_fn(step):
+            out = exe.run(main, feed=feed, fetch_list=[loss], scope=scope,
+                          use_compiled=False)
+            return float(np.asarray(out[0]).reshape(-1)[0])
+
+        runner.run(step_fn, 3)
+        runner.close()
+        assert runner.world_size == 2 and runner.scale_events == 0
+        assert _delta(before, "elastic.scale_events") == 0
+
+
+# ---------------------------------------------------------------------------
+# serving: signal-driven replica autoscaling through the drain machinery
+# ---------------------------------------------------------------------------
+
+IN_DIM = 6
+
+
+def _save_mlp(dirname, seed):
+    from paddle_tpu import io
+
+    _fresh_names()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [IN_DIM])
+        h = layers.fc(x, 8, act="relu", param_attr=pt.ParamAttr(
+            name="as_w0", initializer=pt.initializer.Xavier(seed=seed)))
+        y = layers.fc(h, 4, param_attr=pt.ParamAttr(
+            name="as_w1", initializer=pt.initializer.Xavier(seed=seed + 1)))
+    scope = pt.Scope()
+    exe = pt.Executor()
+    exe.run(startup, scope=scope, use_compiled=False)
+    io.save_inference_model(str(dirname), ["x"], [y],
+                            main_program=main, scope=scope)
+    return str(dirname)
+
+
+def _post_infer(url, x, rid=None, timeout=60):
+    import urllib.error
+
+    doc = {"inputs": {"x": x.tolist()}}
+    headers = {"Content-Type": "application/json"}
+    if rid is not None:
+        headers["X-Request-Id"] = rid
+    req = urllib.request.Request(url + "/v1/infer",
+                                 data=json.dumps(doc).encode(),
+                                 headers=headers)
+    try:
+        resp = urllib.request.urlopen(req, timeout=timeout)
+        return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.mark.serving
+class TestClusterAutoscale:
+    def test_scale_up_and_down_exactly_once_no_dropped_requests(
+            self, tmp_path):
+        """Acceptance gate: the REAL ScalerPolicy over live signals —
+        queue saturation scales the serving fleet 1 -> 2, the
+        underutilized rule scales it back 2 -> 1 through the drain while
+        closed-loop clients keep posting; ScaleUp and ScaleDown each
+        fire exactly once and no request is dropped."""
+        from paddle_tpu import checkpoint as ckpt
+        from paddle_tpu.distributed.scaler import ScalerPolicy
+        from paddle_tpu.serving import ClusterController, ServingConfig
+
+        # counter history from earlier tests must not leak into the
+        # policy's rolling window (dead-trainer verdicts would win)
+        telemetry.reset()
+        model = _save_mlp(tmp_path / "m1", seed=61)
+        root = str(tmp_path / "models")
+        ckpt.publish_model(root, model, version=1)
+        cluster = ClusterController(
+            root, replicas=1, inprocess=True,
+            serving_config=ServingConfig(max_batch_size=4,
+                                         batch_timeout_ms=1.0),
+            auto_swap=False).start(ready_timeout_s=120)
+        cluster.attach_scaler(ScalerPolicy(min_world=1, max_world=2,
+                                           cooldown_s=0.0,
+                                           source="serving"))
+        before = dict(telemetry.counters())
+        x = np.random.RandomState(1).randn(2, IN_DIM).astype(np.float32)
+        try:
+            # saturation signal -> ScaleUp 1 -> 2
+            telemetry.gauge_set("fleet.queue_frac", 0.95)
+            d = cluster.autoscale_tick()
+            assert d is not None and d.reason == "queue_saturation"
+            assert len(cluster.replicas) == 2
+            names = {h for h in
+                     (doc["replica"] for _, doc in
+                      (_post_infer(cluster.url, x) for _ in range(8)))}
+            assert len(names) == 2, "new replica never took traffic"
+
+            # drain 2 -> 1 WHILE closed-loop clients post — zero drops
+            results = {}
+            lock = threading.Lock()
+
+            def worker(wid):
+                for i in range(20):
+                    rid = f"as-{wid}-{i}"
+                    code, doc = _post_infer(cluster.url, x, rid=rid)
+                    with lock:
+                        results[rid] = (code, doc.get("request_id"))
+
+            threads = [threading.Thread(target=worker, args=(w,))
+                       for w in range(3)]
+            for t in threads:
+                t.start()
+            time.sleep(0.05)
+            telemetry.gauge_set("fleet.queue_frac", 0.02)
+            d = cluster.autoscale_tick()
+            assert d is not None and d.reason == "underutilized"
+            assert len(cluster.replicas) == 1
+            for t in threads:
+                t.join(60)
+            assert len(results) == 60
+            bad = {k: v for k, v in results.items() if v[0] != 200}
+            assert not bad, f"dropped requests across the drain: {bad}"
+            assert all(v[1] == k for k, v in results.items())
+
+            # steady state: further ticks clamp away, nothing fires
+            assert cluster.autoscale_tick() is None
+            assert cluster.autoscale_tick() is None
+        finally:
+            cluster.close()
+        assert _delta(before, "scaler.scale_up") == 1
+        assert _delta(before, "scaler.scale_down") == 1
+        assert _delta(before, "router.scale_events") == 2
+        assert _delta(before, "incidents.scale_events") == 2
+
+    def test_scale_to_bounds(self, tmp_path):
+        from paddle_tpu import checkpoint as ckpt
+        from paddle_tpu.serving import (ClusterController, ClusterError,
+                                        ServingConfig)
+
+        model = _save_mlp(tmp_path / "m1", seed=71)
+        root = str(tmp_path / "models")
+        ckpt.publish_model(root, model, version=1)
+        cluster = ClusterController(
+            root, replicas=1, inprocess=True,
+            serving_config=ServingConfig(max_batch_size=4,
+                                         batch_timeout_ms=1.0),
+            auto_swap=False).start(ready_timeout_s=120)
+        try:
+            with pytest.raises(ClusterError, match="at least 1"):
+                cluster.scale_to(0)
+            assert cluster.scale_to(1) == 1   # no-op resize is fine
+        finally:
+            cluster.close()
+
+
+# ---------------------------------------------------------------------------
+# perf_report: the elastic & autoscaling section renders from the log
+# ---------------------------------------------------------------------------
+
+def _perf_report():
+    import importlib.util as ilu
+    import os
+
+    tools = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools")
+    spec = ilu.spec_from_file_location(
+        "perf_report", os.path.join(tools, "perf_report.py"))
+    mod = ilu.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestPerfReportScalerSection:
+    def test_scale_events_render(self, tmp_path):
+        from paddle_tpu.core import incidents
+        from paddle_tpu.distributed.scaler import ScaleSignals, ScalerPolicy
+
+        log = tmp_path / "run.jsonl"
+        telemetry.configure(str(log))
+        p = ScalerPolicy(min_world=1, max_world=4, cooldown_s=0.0)
+        d = p.decide(2, signals=ScaleSignals(dead_workers=1), now=10.0)
+        incidents.report_scale_event("elastic", "resize", d.current,
+                                     d.target, reason=d.reason)
+        telemetry.flush_sink()
+
+        mod = _perf_report()
+        recs, malformed = mod.load_counted(str(log))
+        summary = mod.summarize_log(recs, malformed=malformed)
+        assert summary["scaler"] is not None
+        assert summary["scaler"]["decisions"] >= 1
+        assert summary["scaler"]["scale_down"] >= 1
+        assert any(e["name"] == "elastic.resize"
+                   for e in summary["scaler"]["events"])
+        import io
+
+        buf = io.StringIO()
+        mod.render(summary, out=buf)
+        text = buf.getvalue()
+        assert "elastic & autoscaling" in text
+        assert "elastic.resize: world 2 -> 1" in text

@@ -72,6 +72,22 @@ survivors' scrape ages stay fresh), the ``fleet_member_stale`` rule
 trips EXACTLY once for the whole episode, and tools/fleet_report.py
 still renders the plane.
 
+With ``--resize`` it gates the elastic-resize protocol
+(paddle_tpu/distributed/scaler.py + elastic.py + the PS barrier-regrow
+and KV-rebalance paths): a trainer is killed mid-run — its heartbeats
+stop and its in-flight step dies — and the REAL pserver heartbeat
+verdict drives the ScalerPolicy to a ScaleDown executed by the
+ElasticRunner as checkpoint → drain → relaunch at the smaller world;
+the trainer then rejoins (plus a brand-new trainer id announcing
+itself through elastic admission) and the policy scales back up from
+the checkpoint. The gate asserts the per-step loss trajectory is
+BITWISE identical to an uninterrupted fixed-world run (resize is
+loss-transparent at preserved global batch), ScaleUp and ScaleDown
+each fired EXACTLY once with exactly one kind:"scale" record per
+transition, and a KV-server-count resize (2 → 3 → 2) conserves the
+row set exactly — zero leaked, zero duplicated, pull parity across
+the resharded set.
+
 Examples:
     python tools/chaos_check.py --fault-spec "ps.rpc.send:0.1" --seed 7
     python tools/chaos_check.py --fault-spec "ps.rpc.recv:%9" --steps 8 \
@@ -85,6 +101,7 @@ Examples:
     python tools/chaos_check.py --cluster --replicas 2 --requests 400 \
         --fault-spec "router.dispatch:0.02,serving.handler:%7"
     python tools/chaos_check.py --fleet --replicas 2
+    python tools/chaos_check.py --resize --steps 8
 
 Exit status: 0 on success, 2 when the run failed or did not converge.
 Stdlib-only CLI surface (argparse); everything heavier lives in
@@ -582,6 +599,330 @@ def run_checkpoint(args) -> int:
           f"{int(counters.get('ckpt.verify_failures', 0))} checkpoints "
           f"rejected")
     return 0
+
+
+def run_resize(args) -> int:
+    """--resize mode: the elastic-resize gate. One process plays the
+    whole scale story end to end:
+
+      1. baseline leg — an uninterrupted fixed-world run on a fixed
+         batch records the reference loss trajectory;
+      2. chaos leg — the same net trains under an ElasticRunner at
+         world 2 against a REAL pserver liveness plane (heartbeat
+         monitor + elastic admission). A trainer is killed mid-run,
+         the heartbeat verdict drives the ScalerPolicy to a ScaleDown
+         (checkpoint → drain → relaunch at world 1), the trainer
+         rejoins alongside a brand-new trainer id and the policy
+         scales back up to 2 from the checkpoint. Because every
+         trainer carries the full global batch (the mean of identical
+         grads is bitwise exact), the per-step losses must be BITWISE
+         identical to the baseline — resize is loss-transparent;
+      3. KV leg — rows pushed to 2 KV servers are checkpointed and
+         restored into 3 servers, then back into 2: each resize must
+         conserve the row set exactly (zero leaked, zero duplicated,
+         every row in its `id % N` residue class) with pull parity.
+    """
+    import socket
+    import tempfile
+    import time as _time
+
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.core import telemetry
+    from paddle_tpu.distributed.elastic import ElasticRunner
+    from paddle_tpu.distributed.ps import DistributeTranspiler, PServer
+    from paddle_tpu.distributed.ps.kv_service import DistributedKV, KVServer
+    from paddle_tpu.distributed.ps.rpc import RPCClient, start_heartbeat
+    from paddle_tpu.distributed.scaler import ScalerPolicy
+
+    def wait_counter(name, floor, timeout=20.0):
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            if int(telemetry.counters().get(name, 0)) >= floor:
+                return True
+            _time.sleep(0.05)
+        return False
+
+    with tempfile.TemporaryDirectory(prefix="pt_chaos_resize_") as tmp:
+        log_path = args.telemetry_log or os.path.join(tmp, "resize.jsonl")
+        telemetry.configure(log_path)
+        steps = max(8, args.steps)
+        feed = {"x": np.random.RandomState(3000).randn(16, 16)
+                .astype(np.float32)}
+        exe = pt.Executor(pt.CPUPlace())
+
+        # -- leg 1: the uninterrupted reference trajectory ------------------
+        base_prog, base_startup, base_loss = build_net(args.lr)
+        base_scope = pt.Scope()
+        exe.run(base_startup, scope=base_scope, use_compiled=False)
+        baseline = []
+        for _ in range(steps):
+            out = exe.run(base_prog, feed=feed, fetch_list=[base_loss],
+                          scope=base_scope, use_compiled=False)
+            baseline.append(float(np.asarray(out[0]).reshape(-1)[0]))
+
+        c0 = dict(telemetry.counters())
+
+        # -- leg 2: kill -> scale-down -> rejoin -> scale-up ----------------
+        # the liveness plane: one real pserver with a heartbeat monitor;
+        # its verdicts (ps.trainer_dead / ps.barrier_regrown) are the ONLY
+        # signals the policy sees — no driver shortcuts
+        ps_main, ps_boot, _ = build_net(args.lr)
+        probe = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        probe.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        probe.bind(("127.0.0.1", 0))
+        ep = f"127.0.0.1:{probe.getsockname()[1]}"
+        probe.close()
+        t = DistributeTranspiler()
+        t.transpile(0, program=ps_main, startup_program=ps_boot,
+                    pservers=ep, trainers=2, sync_mode=True)
+        prog, ps_startup = t.get_pserver_programs(ep)
+        server = PServer(ep, prog, ps_startup, num_trainers=2,
+                         sync_mode=True, heartbeat_timeout=1.0,
+                         grad_to_param=prog._ps_grad_to_param,
+                         grad_to_ops=prog._ps_grad_to_ops,
+                         common_ops=prog._ps_common_ops)
+
+        chaos_prog, chaos_startup, chaos_loss = build_net(args.lr)
+        chaos_scope = pt.Scope()
+        exe.run(chaos_startup, scope=chaos_scope, use_compiled=False)
+        policy = ScalerPolicy(min_world=1, max_world=2, cooldown_s=0.0,
+                              source="chaos")
+        runner = ElasticRunner(os.path.join(tmp, "ckpt"), chaos_prog,
+                               chaos_scope, save_interval_steps=1,
+                               max_restarts=5, async_save=False,
+                               restart_window_s=120.0, world_size=2,
+                               scaler=policy,
+                               on_scale=lambda d: {"world_size": d.target})
+        stops = {0: start_heartbeat([ep], 0, interval=0.1),
+                 1: start_heartbeat([ep], 1, interval=0.1)}
+        state = {"killed": False, "revived": False}
+        losses = {}
+        k_kill = 2
+
+        def step_fn(step):
+            if step == k_kill and not state["killed"]:
+                state["killed"] = True
+                stops[1]()      # the "SIGKILL": trainer 1 goes silent...
+                raise ConnectionError("trainer 1 killed mid-step")
+            if state["killed"] and not state["revived"] \
+                    and runner.world_size == 2:
+                # hold the replayed step until the monitor's verdict
+                # lands — the ScaleDown must come from the real signal
+                if not wait_counter("ps.trainer_dead",
+                                    int(c0.get("ps.trainer_dead", 0)) + 1):
+                    raise AssertionError(
+                        "heartbeat monitor never marked the killed "
+                        "trainer dead")
+            if state["killed"] and not state["revived"] \
+                    and runner.world_size == 1:
+                state["revived"] = True
+                stops[1] = start_heartbeat([ep], 1, interval=0.1)  # rejoin
+                stops[2] = start_heartbeat([ep], 2, interval=0.1)  # new id
+                ok = wait_counter(
+                    "ps.trainer_revived",
+                    int(c0.get("ps.trainer_revived", 0)) + 1) and \
+                    wait_counter(
+                        "ps.barrier_regrown",
+                        int(c0.get("ps.barrier_regrown", 0)) + 2)
+                if not ok:
+                    raise AssertionError(
+                        "pserver barrier never regrew after the rejoin "
+                        "+ new-trainer announce")
+            out = exe.run(chaos_prog, feed=feed, fetch_list=[chaos_loss],
+                          scope=chaos_scope, use_compiled=False)
+            val = float(np.asarray(out[0]).reshape(-1)[0])
+            losses[step] = val
+            print(f"LOSS {step} {val:.6f} world={runner.world_size}",
+                  flush=True)
+            return val
+
+        try:
+            runner.run(step_fn, steps)
+        except AssertionError as e:
+            print(f"CHAOS FAIL: {e}")
+            return 2
+        finally:
+            runner.close()
+            for stop in stops.values():
+                try:
+                    stop()
+                except Exception:
+                    pass
+            server.shutdown()
+
+        # -- leg 3: KV server-count resize conserves the row set ------------
+        dim = 8
+        ids = np.arange(64, dtype=np.int64) * 3 + 1
+        grads = (np.random.RandomState(7).randn(len(ids), dim)
+                 .astype(np.float32))
+
+        def audit(kv_servers, want):
+            """None if the resident rows across kv_servers are exactly
+            `want` with correct `id % N` routing; else the failure."""
+            got = []
+            for j, srv in enumerate(kv_servers):
+                tab = srv.kv.tables.get("emb")
+                mine = (tab.ids() if tab is not None
+                        else np.empty(0, np.int64))
+                if mine.size and not np.all(mine % len(kv_servers) == j):
+                    return (f"server {j}/{len(kv_servers)} holds rows "
+                            f"outside its residue class")
+                got.append(mine)
+            got = np.concatenate(got) if got else np.empty(0, np.int64)
+            if got.size != len(want):
+                return (f"{got.size} resident rows != {len(want)} saved "
+                        f"(leaked or duplicated)")
+            if not np.array_equal(np.sort(got), np.sort(want)):
+                return "row ID set changed across the resize"
+            return None
+
+        kv_dir1 = os.path.join(tmp, "kv_snap_2")
+        kv_dir2 = os.path.join(tmp, "kv_snap_3")
+        servers2 = [KVServer("127.0.0.1:0") for _ in range(2)]
+        servers3 = [KVServer("127.0.0.1:0") for _ in range(3)]
+        servers2b = [KVServer("127.0.0.1:0") for _ in range(2)]
+        kv_errors = []
+        try:
+            eps2 = [s.endpoint for s in servers2]
+            cli = DistributedKV(eps2, "emb", dim, seed=5)
+            cli.pull(ids)                    # materialise, then train
+            cli.push(ids, grads, lr=0.5)
+            rows0 = cli.pull(ids)
+            for j, kep in enumerate(eps2):
+                RPCClient.get(kep).call("checkpoint", f"{kv_dir1}|{j}")
+            # scale up 2 -> 3 (audit BEFORE pull: a pull would quietly
+            # re-init any leaked row)
+            eps3 = [s.endpoint for s in servers3]
+            for j, kep in enumerate(eps3):
+                RPCClient.get(kep).call("checkpoint_load",
+                                        f"{kv_dir1}|n{j}|{j}/3")
+            err = audit(servers3, ids)
+            if err:
+                kv_errors.append(f"2->3: {err}")
+            if not np.array_equal(
+                    rows0, DistributedKV(eps3, "emb", dim, seed=5)
+                    .pull(ids)):
+                kv_errors.append("2->3: pull parity broken")
+            # scale back down 3 -> 2 from the NEW snapshot set
+            for j, kep in enumerate(eps3):
+                RPCClient.get(kep).call("checkpoint", f"{kv_dir2}|{j}")
+            eps2b = [s.endpoint for s in servers2b]
+            for j, kep in enumerate(eps2b):
+                RPCClient.get(kep).call("checkpoint_load",
+                                        f"{kv_dir2}|n{j}|{j}/2")
+            err = audit(servers2b, ids)
+            if err:
+                kv_errors.append(f"3->2: {err}")
+            if not np.array_equal(
+                    rows0, DistributedKV(eps2b, "emb", dim, seed=5)
+                    .pull(ids)):
+                kv_errors.append("3->2: pull parity broken")
+        finally:
+            for srv in servers2 + servers3 + servers2b:
+                srv.shutdown()
+
+        # -- the audit ------------------------------------------------------
+        telemetry.flush_sink()
+        counters = telemetry.counters()
+
+        def delta(name):
+            return int(counters.get(name, 0)) - int(c0.get(name, 0))
+
+        tally_keys = ("scaler.evaluations", "scaler.decisions",
+                      "scaler.scale_up", "scaler.scale_down",
+                      "scaler.clamped", "scaler.suppressed_cooldown",
+                      "elastic.restarts", "elastic.scale_events",
+                      "incidents.scale_events", "ps.trainer_dead",
+                      "ps.trainer_revived", "ps.barrier_regrown",
+                      "ps.kv_rebalanced_rows", "ckpt.saves",
+                      "ckpt.restores")
+        print("-- resize chaos tally " + "-" * 27)
+        for key in tally_keys:
+            print(f"{key:28s} {delta(key)}")
+
+        scale_recs = []
+        try:
+            with open(log_path) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if rec.get("kind") == "scale":
+                        scale_recs.append(rec)
+        except OSError:
+            pass
+        restart_recs = [r for r in scale_recs
+                        if r.get("name") == "elastic.restart"]
+        resize_recs = [r for r in scale_recs
+                       if r.get("name") == "elastic.resize"]
+        transitions = [(int((r.get("attrs") or {}).get("old_world", -1)),
+                        int((r.get("attrs") or {}).get("new_world", -1)))
+                       for r in resize_recs]
+
+        failures = []
+        chaos = [losses.get(i) for i in range(steps)]
+        if any(v is None for v in chaos):
+            failures.append(
+                f"chaos leg skipped steps "
+                f"{[i for i in range(steps) if losses.get(i) is None]}")
+        elif not all(np.isfinite(v) for v in chaos):
+            failures.append("non-finite loss in the chaos leg")
+        else:
+            diverged = [i for i in range(steps) if chaos[i] != baseline[i]]
+            if diverged:
+                i = diverged[0]
+                failures.append(
+                    f"loss trajectory diverged from the uninterrupted "
+                    f"run at step {i}: {chaos[i]!r} != {baseline[i]!r} "
+                    f"(resize must be loss-transparent)")
+            if chaos[-1] >= chaos[0]:
+                failures.append(f"loss did not converge "
+                                f"({chaos[0]:.6f} -> {chaos[-1]:.6f})")
+        if delta("scaler.scale_down") != 1 or delta("scaler.scale_up") != 1:
+            failures.append(
+                f"ScaleDown/ScaleUp must each fire exactly once, got "
+                f"{delta('scaler.scale_down')}/{delta('scaler.scale_up')}")
+        if delta("elastic.scale_events") != 2:
+            failures.append(f"expected 2 executed resizes, got "
+                            f"{delta('elastic.scale_events')}")
+        if delta("elastic.restarts") != 1:
+            failures.append(f"expected exactly 1 elastic restart, got "
+                            f"{delta('elastic.restarts')}")
+        if delta("incidents.scale_events") != 3:
+            failures.append(
+                f"expected exactly one scale incident per transition "
+                f"(1 restart + 2 resizes), got "
+                f"{delta('incidents.scale_events')}")
+        if len(restart_recs) != 1 or transitions != [(2, 1), (1, 2)]:
+            failures.append(
+                f"kind:\"scale\" ring records wrong: {len(restart_recs)} "
+                f"restart(s), resize transitions {transitions} "
+                f"(want 1 restart, [(2, 1), (1, 2)])")
+        if delta("ps.barrier_regrown") < 2:
+            failures.append(
+                f"barrier never regrew for both the rejoined and the "
+                f"new trainer (ps.barrier_regrown +"
+                f"{delta('ps.barrier_regrown')})")
+        if delta("ps.kv_rebalanced_rows") != 2 * len(ids):
+            failures.append(
+                f"kv rebalance ingested {delta('ps.kv_rebalanced_rows')} "
+                f"rows, want {2 * len(ids)} across the two resizes")
+        failures.extend(kv_errors)
+
+        if failures:
+            for msg in failures:
+                print(f"CHAOS FAIL: {msg}")
+            return 2
+        print(f"CHAOS OK: {steps} steps across kill -> scale-down -> "
+              f"scale-up, trajectory bitwise-identical to the "
+              f"uninterrupted run (loss {chaos[0]:.6f} -> "
+              f"{chaos[-1]:.6f}), {delta('incidents.scale_events')} "
+              f"scale incidents for 3 transitions, {len(ids)} KV rows "
+              f"conserved across 2 -> 3 -> 2 servers")
+        return 0
 
 
 def _slo_fault_classes():
@@ -1425,6 +1766,16 @@ def main():
                          "the aggregator must mark it STALE without "
                          "wedging, the fleet_member_stale rule must "
                          "trip exactly once, the clean phase zero")
+    ap.add_argument("--resize", action="store_true",
+                    help="gate the elastic-resize protocol (distributed/"
+                         "scaler.py + elastic.py): kill a trainer "
+                         "mid-run, scale down on the heartbeat verdict, "
+                         "scale back up from the checkpoint when it "
+                         "rejoins — the loss trajectory must be bitwise "
+                         "identical to an uninterrupted run, with "
+                         "exactly one scale incident per transition and "
+                         "zero leaked KV rows across a server-count "
+                         "resize")
     ap.add_argument("--replicas", type=int, default=2,
                     help="--cluster/--fleet mode: replica process count")
     ap.add_argument("--p99-bound", type=float, default=5000.0,
@@ -1467,6 +1818,8 @@ def main():
         sys.exit(run_cluster(args))
     if args.fleet:
         sys.exit(run_fleet(args))
+    if args.resize:
+        sys.exit(run_resize(args))
     sys.exit(run(args))
 
 

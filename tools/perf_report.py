@@ -133,6 +133,7 @@ def summarize_log(recs, malformed=0):
     thread_errors = []
     incident_events = []
     tuner_events = []
+    scale_events = []
     spans = defaultdict(list)
     span_traces = set()
     snapshot = None
@@ -194,6 +195,14 @@ def summarize_log(recs, malformed=0):
         elif kind == "tuner":
             tuner_events.append({"name": name, "ts": r.get("ts"),
                                  "value": v, **attrs})
+        elif kind == "scale":
+            scale_events.append({
+                "name": name, "ts": r.get("ts"),
+                "source": attrs.get("source"),
+                "event": attrs.get("event"),
+                "old_world": attrs.get("old_world"),
+                "new_world": attrs.get("new_world"),
+                "reason": attrs.get("reason")})
         elif kind == "snapshot":
             snapshot = attrs
     # a final snapshot is authoritative for cumulative counter values
@@ -237,6 +246,7 @@ def summarize_log(recs, malformed=0):
                                  tuner_events)
     goodput = _goodput_summary(counter_delta, counter_last, gauges)
     fleet = _fleet_summary(counter_delta, counter_last, gauges)
+    scaler = _scaler_summary(counter_delta, counter_last, scale_events)
     tracing = None
     if spans:
         by_name = {}
@@ -263,6 +273,7 @@ def summarize_log(recs, malformed=0):
         "autotune": autotune,
         "goodput": goodput,
         "fleet": fleet,
+        "scaler": scaler,
         "tracing": tracing,
         "malformed_lines": int(malformed),
         "records": len(recs),
@@ -804,6 +815,58 @@ def _fleet_summary(counter_delta, counter_last, gauges):
     }
 
 
+def _scaler_summary(counter_delta, counter_last, scale_events):
+    """Elastic resize & autoscaling accounting (distributed/scaler.py
+    policy engine + distributed/elastic.py runner + serving cluster
+    scale_to): policy evaluations vs decisions (scaler.evaluations /
+    scaler.decisions / scaler.scale_up / scaler.scale_down /
+    scaler.suppressed_cooldown / scaler.clamped), executed transitions
+    (elastic.scale_events, elastic.restarts,
+    elastic.restart_budget_refunds, router.scale_events,
+    router.scale_errors, incidents.scale_events), and the world-size-
+    changing-resume machinery those transitions exercised
+    (ps.barrier_regrown, ps.kv_rebalanced_rows, reader.cursor_resplits,
+    sharding.zero_regroup_events) — plus the kind:"scale" event
+    timeline the incident ring also captures."""
+
+    def cval(name):
+        v = counter_delta.get(name) or counter_last.get(name) or 0
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            return 0.0
+
+    evaluations = cval("scaler.evaluations")
+    decisions = cval("scaler.decisions")
+    restarts = cval("elastic.restarts")
+    transitions = cval("incidents.scale_events")
+    regrown = cval("ps.barrier_regrown")
+    if not (evaluations or decisions or restarts or transitions
+            or regrown or scale_events):
+        return None
+    return {
+        "evaluations": int(evaluations),
+        "decisions": int(decisions),
+        "scale_up": int(cval("scaler.scale_up")),
+        "scale_down": int(cval("scaler.scale_down")),
+        "suppressed_cooldown": int(cval("scaler.suppressed_cooldown")),
+        "clamped": int(cval("scaler.clamped")),
+        "restarts": int(restarts),
+        "restart_budget_refunds":
+            int(cval("elastic.restart_budget_refunds")),
+        "elastic_scale_events": int(cval("elastic.scale_events")),
+        "cluster_scale_events": int(cval("router.scale_events")),
+        "cluster_scale_errors": int(cval("router.scale_errors")),
+        "scale_incidents": int(transitions),
+        "barrier_regrown": int(regrown),
+        "kv_rebalanced_rows": int(cval("ps.kv_rebalanced_rows")),
+        "reader_cursor_resplits": int(cval("reader.cursor_resplits")),
+        "zero_regroup_events":
+            int(cval("sharding.zero_regroup_events")),
+        "events": scale_events[-20:],
+    }
+
+
 def _fmt_num(v):
     if isinstance(v, float):
         return f"{v:,.3f}".rstrip("0").rstrip(".")
@@ -1131,6 +1194,33 @@ def render(s, out=sys.stdout):
             if "p99_ms" in view:
                 line += f"  merged p99: {_fmt_num(view['p99_ms'])} ms"
             w(line + "\n")
+
+    if s.get("scaler"):
+        sc = s["scaler"]
+        w("\n-- elastic & autoscaling (distributed/scaler.py + "
+          "elastic.py) --\n")
+        w(f"policy evaluations: {sc['evaluations']}  decisions: "
+          f"{sc['decisions']} (up {sc['scale_up']} / down "
+          f"{sc['scale_down']})  cooldown-suppressed: "
+          f"{sc['suppressed_cooldown']}  clamped: {sc['clamped']}\n")
+        w(f"executed transitions: {sc['scale_incidents']} "
+          f"(training {sc['elastic_scale_events']}, serving "
+          f"{sc['cluster_scale_events']}"
+          + (f", SCALE ERRORS {sc['cluster_scale_errors']}"
+             if sc.get("cluster_scale_errors") else "")
+          + f")  restarts: {sc['restarts']}"
+          + (f" (budget refunds {sc['restart_budget_refunds']})"
+             if sc.get("restart_budget_refunds") else "")
+          + "\n")
+        w(f"resume machinery: barrier regrown {sc['barrier_regrown']}  "
+          f"kv rows rebalanced {_fmt_num(sc['kv_rebalanced_rows'])}  "
+          f"reader cursor re-splits {sc['reader_cursor_resplits']}  "
+          f"zero regroups {sc['zero_regroup_events']}\n")
+        for ev in sc.get("events", []):
+            w(f"  {ev.get('source') or '?'}.{ev.get('event') or '?'}: "
+              f"world {ev.get('old_world')} -> {ev.get('new_world')}"
+              + (f" ({ev['reason']})" if ev.get("reason") else "")
+              + "\n")
 
     if s.get("tracing"):
         tr = s["tracing"]
