@@ -438,6 +438,12 @@ def ledger() -> Dict[str, Any]:
             g.get("mem.serving.kv_used_bytes", 0) or 0)
         out["serving_kv_high_water_bytes"] = int(
             g.get("mem.serving.kv_high_water_bytes", 0) or 0)
+    # cumulative pool bytes requests did NOT privately allocate thanks
+    # to a prefix-cache hit (serving/prefix_store.py) — savings, not
+    # residency, so it never joins total_bytes
+    kv_saved = int(g.get("mem.serving.kv_prefix_saved_bytes", 0) or 0)
+    if kv_saved:
+        out["serving_kv_prefix_saved_bytes"] = kv_saved
     return out
 
 

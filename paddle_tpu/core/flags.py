@@ -406,6 +406,25 @@ define_flag("decode_weight_quant", "none",
             "weights, 'int8' serves per-output-channel weight-only int8 "
             "(ops/quant_ops.py dequantize_weight fused into the consuming "
             "matmul read — half the weight HBM traffic)")
+define_flag("decode_prefix_cache", False,
+            "content-addressed prefix sharing (serving/prefix_store.py): "
+            "admission looks up the longest cached prefix chain and "
+            "prefills only the suffix through the page-chunked prefill "
+            "program; shared pages are refcounted and read-only to the "
+            "step program, so prefix-hit decode stays bitwise-identical "
+            "to cold-prefill decode. Off by default: the classic "
+            "one-pass flash prefill path is untouched")
+define_flag("decode_role", "unified",
+            "disaggregated-serving role of a decode replica "
+            "(serving/disagg.py): 'prefill' replicas run chunked prefill "
+            "and ship serialized KV pages, 'decode' replicas install "
+            "shipped pages and run generation steps, 'unified' (default) "
+            "does both locally")
+define_flag("disagg_prefill_urls", "",
+            "comma-separated prefill-tier replica URLs a decode-role "
+            "replica fetches KV page shipments from (POST /v1/prefill); "
+            "empty = no tier, every prefill runs locally (the "
+            "unified-role fallback)")
 
 # -- cluster serving control plane (paddle_tpu/serving/router.py +
 #    cluster.py: replicated engines, health-checked routing, zero-downtime

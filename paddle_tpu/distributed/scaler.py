@@ -252,3 +252,66 @@ class ScalerPolicy:
 
     def reset_cooldown(self):
         self._last_decision_ts = None
+
+    @classmethod
+    def from_slo_rules(cls, up_rules=None, down_rules=None,
+                       **kw) -> "SLOScalerPolicy":
+        """A policy whose evidence is the incident plane's FIRING state
+        instead of raw metrics: the PR 18 watchdog already applies
+        windowing, min-samples and warmup baselines before latching a
+        ``slo.<rule>_firing`` gauge, so the scaler reuses that verdict
+        rather than re-deriving it from the same counters.
+
+        ``up_rules`` / ``down_rules`` name the SLO rules (incidents.Rule
+        names, e.g. the built-in ``decode_queue_saturation``) whose
+        firing argues ScaleUp / ScaleDown. Cooldown/bounds/step keyword
+        arguments pass through to :class:`ScalerPolicy` unchanged."""
+        return SLOScalerPolicy(
+            up_rules=_SLO_UP_DEFAULT if up_rules is None else up_rules,
+            down_rules=(_SLO_DOWN_DEFAULT if down_rules is None
+                        else down_rules), **kw)
+
+
+# SLO rules whose firing is capacity evidence. Saturated admission
+# queues, regressed step time and router failover bursts all argue MORE
+# replicas; a live-MFU collapse on an otherwise healthy world argues the
+# fleet is over-provisioned for the work it is getting.
+_SLO_UP_DEFAULT = ("decode_queue_saturation", "serving_queue_saturation",
+                   "step_time_p99", "router_failover_burst")
+_SLO_DOWN_DEFAULT = ("live_mfu_drop",)
+
+
+class SLOScalerPolicy(ScalerPolicy):
+    """ScalerPolicy driven by ``slo.<rule>_firing`` gauges (build via
+    :meth:`ScalerPolicy.from_slo_rules`). Rule order: first firing
+    up-rule wins, then first firing down-rule; the base class still owns
+    clamping and the cooldown gate, so one sustained queue-saturation
+    episode yields exactly ONE ScaleUp per cooldown window."""
+
+    def __init__(self, up_rules=(), down_rules=(), source: str = "slo",
+                 **kw):
+        super().__init__(source=source, **kw)
+        self.up_rules = tuple(str(r) for r in up_rules)
+        self.down_rules = tuple(str(r) for r in down_rules)
+
+    def firing_rules(self) -> list:
+        """Rule names currently latched firing (gauge value truthy)."""
+        gauges = telemetry.gauges()
+        out = []
+        for name in self.up_rules + self.down_rules:
+            if gauges.get(f"slo.{name}_firing"):
+                out.append(name)
+        return out
+
+    def _judge(self, world: int, sig: ScaleSignals):
+        firing = sig.extra.get("slo_firing")
+        if firing is None:
+            firing = self.firing_rules()
+            sig.extra["slo_firing"] = sorted(firing)
+        for name in self.up_rules:
+            if name in firing:
+                return (SCALE_UP, world + self.step, name)
+        for name in self.down_rules:
+            if name in firing:
+                return (SCALE_DOWN, world - self.step, name)
+        return None

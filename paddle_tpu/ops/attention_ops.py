@@ -256,6 +256,83 @@ def cached_kv_attention_op(ins, attrs):
     return {"Out": out, "PoolKOut": pool_k, "PoolVOut": pool_v}
 
 
+@register_op("chunk_cached_attention",
+             required_attrs=("num_heads", "head_dim"),
+             non_diff_inputs=("K", "V", "PoolK", "PoolV", "PageTable",
+                              "ChunkStart", "Lengths"))
+def chunk_cached_attention_op(ins, attrs):
+    """One page-aligned PROMPT CHUNK of prefill against the paged KV
+    pool — the building block of the prefix-sharing chunked prefill
+    (serving/prefix_store.py). Where ``kv_cache_write`` +
+    ``flash_attention`` prefill the whole prompt in one pass, this op
+    processes ``C`` tokens starting at global position ``ChunkStart``:
+    it writes the chunk's K/V into the row's pages and attends each
+    chunk query over (a) the POOL positions 0..ChunkStart-1 — the
+    already-prefilled (possibly SHARED, cache-hit) prefix — and (b) the
+    in-program chunk keys causally (s' <= s). Because a chunk's output
+    depends only on the chunk tokens and the prior positions' pool
+    BYTES (invalid positions are masked to -1e9 before the softmax, so
+    recycled-page garbage and physical page ids contribute exactly
+    zero), replaying only the uncached suffix chunks over bit-identical
+    cached prefix pages reproduces the cold prefill bit for bit — the
+    prefix-hit bitwise gate of tests/test_prefix_store.py.
+
+    Q, K, V [B, C, kvdim] — the chunk's projections; PoolK/PoolV
+    [N, P, kvdim]; PageTable [B, MP]; ChunkStart [B] int32 (page-aligned
+    global position of chunk token 0); Lengths [B] int32 (valid tokens
+    in this chunk, 1..C). Writes route invalid positions to the pool's
+    reserved scratch page 0; a SHARED page is protected by pointing the
+    chunk's own page-table entry at 0 (attention never reads the
+    current chunk through the pool, so absorbing its write into scratch
+    is free). Outputs: Out [B, C, kvdim], PoolKOut, PoolVOut."""
+    import jax
+    import jax.numpy as jnp
+
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    # .at[] updates need jax arrays (a direct OpTest call feeds numpy)
+    pool_k = jnp.asarray(ins["PoolK"][0])
+    pool_v = jnp.asarray(ins["PoolV"][0])
+    table = jnp.asarray(ins["PageTable"][0])
+    start = jnp.asarray(ins["ChunkStart"][0]).reshape(-1)
+    lengths = jnp.asarray(ins["Lengths"][0]).reshape(-1)
+    b, c, _ = k.shape
+    n = int(attrs["num_heads"])
+    hd = int(attrs["head_dim"])
+    scale = float(attrs.get("scale") or hd ** -0.5)
+    page = int(pool_k.shape[1])
+    mp = int(table.shape[1])
+    # prior context is gathered from the PRE-write pools: positions
+    # < ChunkStart are untouched by this chunk's writes by construction
+    s_ctx = mp * page
+    ctx_k = pool_k[table].reshape(b, s_ctx, n, hd)
+    ctx_v = pool_v[table].reshape(b, s_ctx, n, hd)
+    # -- write phase (kv_cache_write with a start offset) --------------------
+    pos = jnp.arange(c, dtype=jnp.int32)                       # [C]
+    g = start[:, None] + pos[None, :]                          # [B, C]
+    phys = jnp.take_along_axis(table, g // page, axis=1)
+    valid = pos[None, :] < lengths[:, None]                    # [B, C]
+    phys = jnp.where(valid, phys, 0).reshape(-1)
+    off = (g % page).reshape(-1)
+    pool_k_out = pool_k.at[phys, off].set(k.reshape(b * c, -1))
+    pool_v_out = pool_v.at[phys, off].set(v.reshape(b * c, -1))
+    # -- attend phase: prior pool context + causal in-chunk ------------------
+    qh = q.reshape(b, c, n, hd)
+    sc_ctx = jnp.einsum("bqnh,bsnh->bnqs", qh, ctx_k) * scale  # [B,n,C,S]
+    ctx_pos = jnp.arange(s_ctx, dtype=jnp.int32)
+    m_ctx = ctx_pos[None, None, None, :] < start[:, None, None, None]
+    sc_ctx = jnp.where(m_ctx, sc_ctx, -1e9)
+    kh = k.reshape(b, c, n, hd)
+    vh = v.reshape(b, c, n, hd)
+    sc_chk = jnp.einsum("bqnh,bsnh->bnqs", qh, kh) * scale     # [B,n,C,C]
+    causal = pos[None, :] <= pos[:, None]                      # [C_q, C_k]
+    sc_chk = jnp.where(causal[None, None, :, :], sc_chk, -1e9)
+    probs = jax.nn.softmax(jnp.concatenate([sc_ctx, sc_chk], -1), axis=-1)
+    out = jnp.einsum("bnqs,bsnh->bqnh", probs[..., :s_ctx], ctx_v) \
+        + jnp.einsum("bnqs,bsnh->bqnh", probs[..., s_ctx:], vh)
+    return {"Out": out.reshape(b, c, n * hd),
+            "PoolKOut": pool_k_out, "PoolVOut": pool_v_out}
+
+
 @register_op("ring_attention", non_diff_inputs=("Bias",), is_collective=True)
 def ring_attention_op(ins, attrs):
     """Sequence-parallel attention over the `sp` mesh axis

@@ -50,10 +50,14 @@ from .admission import (AdmissionQueue, DeadlineExceededError,
 from .cluster import ClusterController, ClusterError, InprocReplica, \
     ReplicaProcess
 from .decode import (DecodeConfig, DecodeEngine, GenerationRequest,
-                     decode_engine_from_dir, demo_engine)
+                     ShipPrefillRequest, decode_engine_from_dir,
+                     demo_engine)
+from .disagg import (ShipmentCRCError, ShipmentError, fetch_prefill,
+                     pack_shipment, unpack_shipment)
 from .engine import ServingConfig, ServingEngine
 from .health import HealthState
 from .kv_cache import KVPagePool
+from .prefix_store import PrefixStore, prefix_chain_hash
 from .router import (NoReplicaAvailableError, ReplicaHandle, Router,
                      RouterHTTPServer)
 from .server import LocalClient, ServingHTTPServer, serve, serve_decode
@@ -64,8 +68,11 @@ __all__ = [
     "EngineClosedError", "GenerationRequest", "HealthState",
     "InferenceRequest", "InprocReplica", "KVCacheExhaustedError",
     "KVPagePool", "LocalClient", "NoReplicaAvailableError",
-    "ReplicaHandle", "ReplicaProcess", "Router", "RouterHTTPServer",
-    "ServerOverloadedError", "ServingConfig", "ServingEngine",
-    "ServingError", "ServingHTTPServer", "decode_engine_from_dir",
-    "demo_engine", "serve", "serve_decode",
+    "PrefixStore", "ReplicaHandle", "ReplicaProcess", "Router",
+    "RouterHTTPServer", "ServerOverloadedError", "ServingConfig",
+    "ServingEngine", "ServingError", "ServingHTTPServer",
+    "ShipPrefillRequest", "ShipmentCRCError", "ShipmentError",
+    "decode_engine_from_dir", "demo_engine", "fetch_prefill",
+    "pack_shipment", "prefix_chain_hash", "serve", "serve_decode",
+    "unpack_shipment",
 ]

@@ -64,13 +64,17 @@ class ReplicaProcess:
     def __init__(self, name: str, model_root: str,
                  env: Optional[Dict[str, str]] = None,
                  serving_config=None, telemetry_log: str = "",
-                 ready_timeout_s: float = 120.0, **_ignored):
+                 ready_timeout_s: float = 120.0, role: str = "unified",
+                 **_ignored):
         self.name = name
         self.model_root = model_root
         self.env = env
         self.serving_config = serving_config
         self.telemetry_log = telemetry_log
         self.ready_timeout_s = ready_timeout_s
+        # disaggregated-serving tier (serving/disagg.py); forwarded to
+        # the replica process and the router's affinity pick
+        self.role = str(role or "unified")
         self.proc: Optional[subprocess.Popen] = None
         self.url: Optional[str] = None
         self.version: Optional[int] = None
@@ -93,6 +97,8 @@ class ReplicaProcess:
                     str(self.serving_config.batch_timeout_ms)]
         if self.telemetry_log:
             cmd += ["--telemetry-log", self.telemetry_log]
+        if self.role != "unified":
+            cmd += ["--role", self.role]
         self.proc = subprocess.Popen(
             cmd, env=env, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True, bufsize=1)
@@ -161,10 +167,11 @@ class InprocReplica:
     surface as ReplicaProcess at a fraction of the startup cost."""
 
     def __init__(self, name: str, model_root: str, serving_config=None,
-                 **_ignored):
+                 role: str = "unified", **_ignored):
         self.name = name
         self.model_root = model_root
         self.serving_config = serving_config
+        self.role = str(role or "unified")
         self.engine = None
         self.server = None
         self.url: Optional[str] = None
@@ -238,7 +245,8 @@ class ClusterController:
                  max_restarts: Optional[int] = None,
                  replica_telemetry_dir: str = "",
                  auto_swap: bool = True,
-                 fleet: Optional[bool] = None):
+                 fleet: Optional[bool] = None,
+                 roles: Optional[List[str]] = None):
         self.model_root = os.path.abspath(model_root)
         self.n_replicas = int(replicas)
         self.inprocess = bool(inprocess)
@@ -252,6 +260,11 @@ class ClusterController:
             else max_restarts)
         self.replica_telemetry_dir = replica_telemetry_dir
         self.auto_swap = bool(auto_swap)
+        # disaggregated-serving topology (serving/disagg.py): roles are
+        # cycled across replica slots (e.g. ["prefill", "decode"]) and
+        # drive the router's role-aware prefix-affinity pick; default is
+        # an all-unified fleet
+        self.roles = [str(r) for r in roles] if roles else []
         self.router = router or Router()
         self.router_server = RouterHTTPServer(self.router, host=host,
                                               port=router_port)
@@ -290,9 +303,11 @@ class ClusterController:
             log = os.path.join(self.replica_telemetry_dir,
                                f"{name}.jsonl")
         cls = InprocReplica if self.inprocess else ReplicaProcess
+        role = self.roles[index % len(self.roles)] if self.roles \
+            else "unified"
         return cls(name, self.model_root, env=self.replica_env,
                    serving_config=self.serving_config,
-                   telemetry_log=log)
+                   telemetry_log=log, role=role)
 
     def start(self, ready_timeout_s: float = 120.0) -> "ClusterController":
         self._watcher = _ckpt.ModelWatcher(self.model_root)
@@ -312,7 +327,8 @@ class ClusterController:
             self.replicas.append(replica)
             self._restarts[replica.name] = 0
             self._handles[replica.name] = self.router.add_replica(
-                replica.name, replica.url)
+                replica.name, replica.url,
+                role=getattr(replica, "role", "unified"))
         self.router.start()
         self.router_server.start()
         self._wait_ready(ready_timeout_s)
@@ -605,7 +621,8 @@ class ClusterController:
                     self.replicas.append(replica)
                     self._restarts[replica.name] = 0
                     self._handles[replica.name] = self.router.add_replica(
-                        replica.name, replica.url)
+                        replica.name, replica.url,
+                        role=getattr(replica, "role", "unified"))
                     if self.fleet_aggregator is not None:
                         self.fleet_aggregator.register(replica.name,
                                                        replica.url)

@@ -69,7 +69,9 @@ import numpy as np
 from ..core import costmodel, faults, incidents, telemetry
 from ..core import flags as _flags
 from ..core.flags import flag as _flag
-from ..models.decoder_lm import (DecoderLMConfig, build_prefill_program,
+from ..models.decoder_lm import (DecoderLMConfig,
+                                 build_chunk_prefill_program,
+                                 build_prefill_program,
                                  build_step_program, decoder_lm_params,
                                  quantize_decoder_lm_params)
 from .admission import (AdmissionQueue, DeadlineExceededError,
@@ -77,6 +79,7 @@ from .admission import (AdmissionQueue, DeadlineExceededError,
                         KVCacheExhaustedError, ServingError)
 from .health import DRAINING, READY, STOPPED, HealthState
 from .kv_cache import KVPagePool
+from .prefix_store import PrefixStore
 
 
 def _pow2_ladder(lo: int, hi: int) -> List[int]:
@@ -104,7 +107,10 @@ class DecodeConfig:
                  max_new_tokens: Optional[int] = None,
                  weight_quant: Optional[str] = None,
                  prefill_buckets: Optional[Sequence[int]] = None,
-                 continuous: bool = True):
+                 continuous: bool = True,
+                 prefix_cache: Optional[bool] = None,
+                 role: Optional[str] = None,
+                 prefill_urls: Optional[Any] = None):
         self.max_slots = int(_flag("decode_max_slots") if max_slots is None
                              else max_slots)
         # strict typed parse (core/flags.py): zero-valued or
@@ -144,6 +150,22 @@ class DecodeConfig:
         self.prefill_buckets = sorted(set(int(b) for b in prefill_buckets)) \
             if prefill_buckets else None   # None -> pow2 up to max_seq_len
         self.continuous = bool(continuous)
+        # prefix sharing + disaggregated-serving role (serving/
+        # prefix_store.py, serving/disagg.py)
+        self.prefix_cache = bool(
+            _flag("decode_prefix_cache") if prefix_cache is None
+            else prefix_cache)
+        self.role = str(_flag("decode_role") if role is None
+                        else role).lower()
+        if self.role not in ("unified", "prefill", "decode"):
+            raise ValueError(f"decode role must be 'unified', 'prefill' "
+                             f"or 'decode', got {self.role!r}")
+        if prefill_urls is None:
+            prefill_urls = _flag("disagg_prefill_urls")
+        if isinstance(prefill_urls, str):
+            prefill_urls = [u.strip() for u in prefill_urls.split(",")
+                            if u.strip()]
+        self.prefill_urls = [str(u) for u in prefill_urls]
 
     def bucket(self, active: int) -> int:
         for b in self.buckets:
@@ -162,7 +184,8 @@ class GenerationRequest(InferenceRequest):
 
     __slots__ = ("prompt", "max_new_tokens", "temperature", "seed",
                  "eos_id", "tokens", "token_walls", "t_submit", "t_first",
-                 "pages", "table_row", "pos_next", "last_token", "_rng")
+                 "pages", "table_row", "pos_next", "last_token",
+                 "shared_blocks", "_rng")
 
     def __init__(self, prompt: np.ndarray, max_new_tokens: int,
                  deadline: Optional[float], temperature: float = 0.0,
@@ -183,6 +206,9 @@ class GenerationRequest(InferenceRequest):
         self.table_row: Optional[np.ndarray] = None
         self.pos_next = 0
         self.last_token = 0
+        # prefix-store block hashes this request holds a reference on
+        # (serving/prefix_store.py) — released at retirement
+        self.shared_blocks: List[str] = []
         self._rng = np.random.RandomState(seed) if seed is not None \
             else None
 
@@ -217,6 +243,21 @@ class GenerationRequest(InferenceRequest):
             or (self.eos_id is not None and self.tokens[-1] == self.eos_id))
 
 
+class ShipPrefillRequest(InferenceRequest):
+    """Disaggregated-serving prefill work item (serving/disagg.py): a
+    prefill-tier replica runs the prompt's prefill, reads the finished
+    KV pages back to host, and resolves with the serialized shipment
+    bytes (versioned wire format, per-page CRC). Rides the same
+    AdmissionQueue as generations so every program run stays on the
+    worker thread that owns the donated pool arrays."""
+
+    __slots__ = ("prompt",)
+
+    def __init__(self, prompt: np.ndarray, deadline: Optional[float]):
+        super().__init__({"prompt": prompt}, 1, deadline)
+        self.prompt = prompt
+
+
 class DecodeEngine:
     """Thread-safe generative front end over a frozen decoder-LM param
     set. Lifecycle mirrors ServingEngine: ``start()`` → concurrent
@@ -245,6 +286,11 @@ class DecodeEngine:
         if self.config.prefill_buckets is None:
             self.config.prefill_buckets = _pow2_ladder(
                 min(8, model_cfg.max_seq_len), model_cfg.max_seq_len)
+        # content-addressed prefix sharing: admission consults the store
+        # for the longest cached prefix and prefills only the suffix
+        # through the page-chunked prefill program
+        self.prefix_store = PrefixStore(self.pool) \
+            if self.config.prefix_cache else None
         self._active: List[GenerationRequest] = []
         self._entries: Dict[Any, Any] = {}   # (phase, bucket) -> jitted fn
         self._thread: Optional[threading.Thread] = None
@@ -289,6 +335,25 @@ class DecodeEngine:
         """Blocking submit-and-wait; returns the generated int32 ids."""
         return self.submit(prompt, **kw).result(timeout)
 
+    def submit_prefill(self, prompt,
+                       deadline_ms: Optional[float] = None
+                       ) -> ShipPrefillRequest:
+        """Disaggregated serving (serving/disagg.py): enqueue a
+        prefill-and-ship work item. ``result()`` returns the serialized
+        KV page shipment bytes for the prompt."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt needs at least one token")
+        if int(prompt.size) > self.model_cfg.max_seq_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) exceeds the model's max_seq_len "
+                f"({self.model_cfg.max_seq_len})")
+        self.pool.check_fits(int(prompt.size))
+        req = ShipPrefillRequest(prompt,
+                                 self.queue.deadline_for(deadline_ms))
+        self.queue.submit_request(req)
+        return req
+
     def stats(self) -> Dict[str, Any]:
         """decode.* counters + KV pool accounting + latency percentiles
         + rolling-window token rate — the /v1/stats "decode" payload."""
@@ -298,7 +363,17 @@ class DecodeEngine:
         out["queue_depth"] = self.queue.depth()
         out["model_version"] = self.version
         out["status"] = self.health.state
+        out["role"] = self.config.role
         out["kv_cache"] = self.pool.stats()
+        if self.prefix_store is not None:
+            out["prefix_store"] = self.prefix_store.stats()
+            out["prefix_store"].update(
+                {k.split(".", 1)[1]: int(v) for k, v in c.items()
+                 if k.startswith("kv.") and isinstance(v, (int, float))})
+        dis = {k.split(".", 1)[1]: int(v) for k, v in c.items()
+               if k.startswith("disagg.") and isinstance(v, (int, float))}
+        if dis:
+            out["disagg"] = dis
         from ..ops import pallas as _pallas
 
         # per-kernel dispatch/fallback counters (counted at lowering
@@ -355,6 +430,9 @@ class DecodeEngine:
             self._entry("step", b)
         for b in self.config.prefill_buckets:
             self._entry("prefill", b)
+        if self.prefix_store is not None:
+            # the ONE chunked-prefill entry (chunk length == page size)
+            self._entry("chunk", self.config.page_size)
         return int(telemetry.counter_get("decode.compiles") - before)
 
     def close(self, drain: bool = True, timeout: Optional[float] = None):
@@ -383,6 +461,9 @@ class DecodeEngine:
         if phase == "step":
             program, _feeds, _fetches = build_step_program(
                 cfg, bucket, cc.kv_pages, cc.page_size, cc.weight_quant)
+        elif phase == "chunk":
+            program, _feeds, _fetches = build_chunk_prefill_program(
+                cfg, 1, bucket, cc.kv_pages, cc.page_size, cc.weight_quant)
         else:
             program, _feeds, _fetches = build_prefill_program(
                 cfg, 1, bucket, cc.kv_pages, cc.page_size, cc.weight_quant)
@@ -436,6 +517,13 @@ class DecodeEngine:
                     "page_table": jnp.zeros((bucket, self._mp), jnp.int32)}
         oh = np.zeros((1, bucket), np.float32)
         oh[0, 0] = 1.0
+        if phase == "chunk":
+            return {"tokens": jnp.zeros((1, bucket), jnp.int32),
+                    "positions": jnp.zeros((1, bucket), jnp.int32),
+                    "chunk_start": jnp.zeros((1,), jnp.int32),
+                    "lengths": jnp.ones((1,), jnp.int32),
+                    "last_onehot": jnp.asarray(oh),
+                    "page_table": jnp.zeros((1, self._mp), jnp.int32)}
         return {"tokens": jnp.zeros((1, bucket), jnp.int32),
                 "lengths": jnp.ones((1,), jnp.int32),
                 "last_onehot": jnp.asarray(oh),
@@ -479,32 +567,78 @@ class DecodeEngine:
             return
         unseated: List[GenerationRequest] = []
         for req in self.queue.poll(free):
+            if isinstance(req, ShipPrefillRequest):
+                self._ship_prefill(req)
+                continue
+            # disaggregated decode role: try to install a shipped
+            # prefill from the prefill tier; ANY failure (connection,
+            # CRC reject) falls back to a local prefill
+            if (self.config.role == "decode" and self.config.prefill_urls
+                    and self._admit_shipped(req)):
+                continue
+            # prefix sharing: acquire the longest cached prefix chain;
+            # a lookup fault is a per-request error, nothing acquired
+            hashes: List[str] = []
+            shared: List[int] = []
+            if self.prefix_store is not None:
+                try:
+                    hashes, shared = self.prefix_store.lookup(req.prompt)
+                except Exception as e:
+                    telemetry.counter_add("decode.errors", 1,
+                                          exc=type(e).__name__)
+                    req.fail(e if isinstance(e, ServingError)
+                             else ServingError(
+                                 f"prefix lookup failed: {e!r}"))
+                    continue
             need = self.pool.pages_for_tokens(
-                int(req.prompt.size) + req.max_new_tokens)
+                int(req.prompt.size) + req.max_new_tokens) - len(hashes)
             try:
                 pages = self.pool.try_alloc(need)
+                if not pages and self.prefix_store is not None:
+                    # ledger pressure: reclaim idle refcount-zero
+                    # chains LRU-first, then retry once
+                    short = need - self.pool.free_pages()
+                    if short > 0 and self.prefix_store.reclaim(short):
+                        pages = self.pool.try_alloc(need)
             except Exception as e:   # injected decode.kv_alloc fault
+                if hashes:
+                    self.prefix_store.release(hashes)
                 telemetry.counter_add("decode.errors", 1,
                                       exc=type(e).__name__)
                 req.fail(e if isinstance(e, ServingError) else ServingError(
                     f"KV page allocation failed: {e!r}"))
                 continue
             if not pages:
+                if hashes:
+                    self.prefix_store.release(hashes)
                 unseated.append(req)   # no headroom NOW — wait for frees
                 continue
             try:
-                self._prefill(req, pages)
+                self._prefill(req, pages, hashes, shared)
             except BaseException as e:
-                self.pool.free(pages)
+                self.pool.free(req.pages if req.pages else pages)
+                req.pages = []
+                if req.shared_blocks:
+                    self.prefix_store.release(req.shared_blocks)
+                    req.shared_blocks = []
                 telemetry.counter_add("decode.errors", 1,
                                       exc=type(e).__name__)
                 req.fail(e if isinstance(e, ServingError) else ServingError(
                     f"prefill failed: {e!r}"))
         self.queue.requeue(unseated)
 
-    def _prefill(self, req: GenerationRequest, pages: List[int]):
-        """PREFILL phase: one causal pass over the padded prompt writes
-        its K/V into the allocated pages and yields the first token."""
+    def _prefill(self, req: GenerationRequest, pages: List[int],
+                 hashes: Optional[List[str]] = None,
+                 shared: Optional[List[int]] = None):
+        """PREFILL phase. With the prefix store on, EVERY prefill runs
+        page-aligned chunks through the one chunked entry (a cache hit
+        just skips the cached leading chunks — bitwise identity with
+        the cold run holds by construction: same program, same fixed
+        shape, same order). Otherwise the classic one-pass causal
+        prefill over the padded prompt."""
+        if self.prefix_store is not None:
+            return self._prefill_chunked(req, pages, hashes or [],
+                                         shared or [])
         import jax.numpy as jnp
 
         L = int(req.prompt.size)
@@ -533,6 +667,175 @@ class DecodeEngine:
             self._retire(req)
         else:
             self._active.append(req)
+
+    def _prefill_chunked(self, req: GenerationRequest, pages: List[int],
+                         hashes: List[str], shared: List[int]):
+        """Chunked prefill (prefix store on): the page table splices
+        the ``len(hashes)`` shared prefix pages in front of the private
+        pages, then each UNCACHED page-sized chunk runs through the one
+        fixed-shape chunk entry. Writes land only in private pages (the
+        lookup's match cap keeps the final chunk — the one producing
+        first-token logits — always recomputed); afterwards the store
+        adopts this prompt's full pages so the next request shares
+        them."""
+        import jax.numpy as jnp
+
+        L = int(req.prompt.size)
+        P = self.config.page_size
+        k = len(hashes)
+        req.pages = pages
+        req.shared_blocks = list(hashes)
+        row = np.zeros(self._mp, np.int32)
+        row[:k] = shared
+        row[k:k + len(pages)] = pages
+        req.table_row = row
+        n_chunks = -(-L // P)
+        entry = self._entry("chunk", P)
+        logits = None
+        with telemetry.timer("decode.prefill_ms"):
+            for ci in range(k, n_chunks):
+                lo = ci * P
+                n = min(L, lo + P) - lo
+                tokens = np.zeros((1, P), np.int32)
+                tokens[0, :n] = req.prompt[lo:lo + n]
+                positions = np.clip(lo + np.arange(P, dtype=np.int32), 0,
+                                    self.model_cfg.max_seq_len - 1)
+                oh = np.zeros((1, P), np.float32)
+                if ci == n_chunks - 1:
+                    oh[0, L - 1 - lo] = 1.0
+                feed = {"tokens": jnp.asarray(tokens),
+                        "positions": jnp.asarray(positions[None, :]),
+                        "chunk_start": jnp.asarray([lo], jnp.int32),
+                        "lengths": jnp.asarray([n], jnp.int32),
+                        "last_onehot": jnp.asarray(oh),
+                        "page_table": jnp.asarray(row[None, :])}
+                logits, self._pools = entry(self._params, self._pools,
+                                            feed)
+            logits = np.asarray(logits)
+        telemetry.counter_add("decode.prefills", 1)
+        telemetry.counter_add("decode.prefill_tokens", L - k * P)
+        # the store adopts every FULL prompt page (strictly before the
+        # page receiving decode writes); repoint the table at the
+        # canonical pages and keep only the tail pages private
+        n_full = L // P
+        if n_full > k:
+            held, canon = self.prefix_store.insert(
+                req.prompt, [int(p) for p in row[:n_full]], start_block=k)
+            row[k:n_full] = canon
+            req.shared_blocks.extend(held)
+            req.pages = pages[n_full - k:]
+        self._append_token(req, logits[0])
+        req.pos_next = L
+        if req.finished():
+            self._retire(req)
+        else:
+            self._active.append(req)
+
+    def _ship_prefill(self, req: ShipPrefillRequest):
+        """Prefill-tier work (serving/disagg.py): run the prompt's
+        prefill, read the finished pages back to host, pack the
+        versioned per-page-CRC shipment, free the pages, resolve with
+        the bytes. ``disagg.ship`` faults inject here — a failure is a
+        per-request error; the pool stays clean."""
+        import jax.numpy as jnp
+
+        from . import disagg
+
+        pages: List[int] = []
+        try:
+            faults.maybe_fail("disagg.ship", tokens=int(req.prompt.size))
+            L = int(req.prompt.size)
+            n_pages = self.pool.pages_for_tokens(L)
+            pages = self.pool.try_alloc(n_pages)
+            if not pages:
+                raise KVCacheExhaustedError(
+                    f"prefill tier cannot seat {n_pages} pages right now")
+            bucket = next(b for b in self.config.prefill_buckets
+                          if b >= L)
+            row = np.zeros(self._mp, np.int32)
+            row[:n_pages] = pages
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :L] = req.prompt
+            oh = np.zeros((1, bucket), np.float32)
+            oh[0, L - 1] = 1.0
+            feed = {"tokens": jnp.asarray(tokens),
+                    "lengths": jnp.asarray([L], jnp.int32),
+                    "last_onehot": jnp.asarray(oh),
+                    "page_table": jnp.asarray(row[None, :])}
+            entry = self._entry("prefill", bucket)
+            with telemetry.timer("decode.prefill_ms"):
+                logits, self._pools = entry(self._params, self._pools,
+                                            feed)
+                logits = np.asarray(logits)
+            idx = np.asarray(pages, np.int64)
+            layer_pages = {name: np.asarray(self._pools[name])[idx]
+                           for name in sorted(self._pools)}
+            blob = disagg.pack_shipment(req.prompt, self.config.page_size,
+                                        layer_pages, logits[0])
+            self.pool.free(pages)
+            pages = []
+            telemetry.counter_add("disagg.ships", 1)
+            telemetry.counter_add("disagg.ship_bytes", len(blob))
+            req.resolve(blob)
+        except BaseException as e:
+            if pages:
+                self.pool.free(pages)
+            telemetry.counter_add("decode.errors", 1, exc=type(e).__name__)
+            req.fail(e if isinstance(e, ServingError) else ServingError(
+                f"prefill shipment failed: {e!r}"))
+
+    def _admit_shipped(self, req: GenerationRequest) -> bool:
+        """Decode-tier admission (serving/disagg.py): fetch the
+        prompt's KV page shipment from a prefill replica, CRC-verify,
+        install the pages into the pool arrays and seat the request
+        with its first token sampled from the SHIPPED logits. Returns
+        False on ANY failure — connection, CRC reject, no pool
+        headroom — so the caller falls back to a local prefill
+        (``disagg.fallback_prefills``); a corrupted shipment is
+        re-prefilled, never served."""
+        from . import disagg
+
+        import zlib
+
+        urls = self.config.prefill_urls
+        pages: List[int] = []
+        try:
+            url = urls[zlib.crc32(req.prompt.tobytes()) % len(urls)]
+            blob = disagg.fetch_prefill(url, req.prompt)
+            ship = disagg.unpack_shipment(blob)   # raises on CRC reject
+            L = int(req.prompt.size)
+            if (ship["page_size"] != self.config.page_size
+                    or ship["tokens"] != [int(t) for t in req.prompt]):
+                raise disagg.ShipmentError(
+                    "shipment does not match the request")
+            need = self.pool.pages_for_tokens(L + req.max_new_tokens)
+            pages = self.pool.try_alloc(need)
+            if not pages:
+                return False
+            n_ship = ship["n_pages"]
+            idx = np.asarray(pages[:n_ship], np.int64)
+            for name, arr in ship["layers"].items():
+                self._pools[name] = self._pools[name].at[idx].set(arr)
+            req.pages = pages
+            pages = []
+            row = np.zeros(self._mp, np.int32)
+            row[:len(req.pages)] = req.pages
+            req.table_row = row
+            telemetry.counter_add("disagg.installs", 1)
+            telemetry.counter_add("decode.prefills", 1)
+            self._append_token(req, np.asarray(ship["logits"]))
+            req.pos_next = L
+            if req.finished():
+                self._retire(req)
+            else:
+                self._active.append(req)
+            return True
+        except Exception as e:
+            if pages:
+                self.pool.free(pages)
+            telemetry.counter_add("disagg.fallback_prefills", 1,
+                                  exc=type(e).__name__)
+            return False
 
     def _run_step(self):
         """DECODE phase: one fixed-shape step over the padded slot
@@ -590,11 +893,16 @@ class DecodeEngine:
 
     def _retire(self, req: GenerationRequest, error: Optional[BaseException]
                 = None):
-        """Slot recycling: free the request's pages and resolve/fail it
-        — finished sequences leave WITHOUT draining the batch."""
+        """Slot recycling: free the request's PRIVATE pages, drop its
+        prefix-store references and resolve/fail it — finished
+        sequences leave WITHOUT draining the batch. Shared pages stay
+        resident in the store (that is the cache)."""
         if req.pages:
             self.pool.free(req.pages)
             req.pages = []
+        if req.shared_blocks:
+            self.prefix_store.release(req.shared_blocks)
+            req.shared_blocks = []
         telemetry.counter_add("decode.retired", 1)
         telemetry.observe("decode.request_ms",
                           (time.monotonic() - req.t_submit) * 1e3,

@@ -1,0 +1,268 @@
+"""Content-addressed prefix store — cross-request KV page sharing.
+
+The vLLM/SGLang prefix-caching idea rebuilt on the PR 12 paged pool:
+prompts are split into page-sized token blocks and each block is keyed
+by ``(parent_hash, token_block)`` — a hash CHAIN, so a block's identity
+pins the entire token prefix in front of it, not just its own tokens.
+Two requests that share a system prompt resolve to the same chain of
+blocks and therefore the same physical KV pages; the second request
+skips prefill for the shared chunks entirely and recomputes only its
+suffix through the page-chunked prefill program
+(models/decoder_lm.py build_chunk_prefill_program).
+
+Why sharing is bitwise-safe: shared pages are READ-ONLY to every
+program. A chunk's prefill writes land only in the request's private
+freshly-allocated pages (the lookup matches at most
+``floor((L-1)/P)`` blocks, so the final prompt chunk — the one that
+produces first-token logits — is always recomputed), and the decode
+step writes generated tokens past the prompt, again into private
+pages. The attention ops mask invalid positions to -1e9 before
+softmax, which underflows to exactly 0.0 — so neither physical page
+ids nor recycled-page garbage can perturb a single output bit
+(tier-1 gated in tests/test_prefix_store.py).
+
+Lifecycle:
+
+- ``lookup(tokens)`` walks the chain, bumps each matched block's
+  refcount, and returns the shared pages to splice into the page
+  table (``kv.prefix_hits`` / ``kv.prefix_misses``, ``kv.bytes_saved``).
+- ``insert(tokens, pages, ...)`` runs after a prefill: the store
+  ADOPTS the request's full prompt pages as shared blocks (refcount 1,
+  held by the inserting request). Registering a second child under a
+  parent that already has one is a copy-on-write fork of the chain at
+  the divergence point (``kv.cow_forks``) — the diverging request
+  recomputed its own pages, so no page is ever cloned in place.
+- ``release(blocks)`` at retirement drops refcounts; refcount-zero
+  chains STAY cached (that is the cache) until ``reclaim`` evicts
+  them LRU leaf-first under pool pressure (``kv.reclaims``).
+
+Booked in the HBM ledger as ``mem.serving.kv_prefix_saved_bytes``
+(costmodel.ledger "serving_kv_prefix_saved_bytes"): cumulative pool
+bytes requests did NOT privately allocate thanks to a hit.
+``kv.prefix_lookup`` is a fault-injection site (core/faults.py,
+tools/chaos_check.py --prefix).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..core import costmodel, faults, telemetry
+from ..core.analysis import lockdep
+from .kv_cache import KVPagePool
+
+ROOT_HASH = "root"
+
+
+def _chain_hash(parent_hash: str, tokens: Sequence[int]) -> str:
+    h = hashlib.blake2b(digest_size=16)
+    h.update(parent_hash.encode("utf-8"))
+    h.update(b"|")
+    h.update(",".join(str(int(t)) for t in tokens).encode("utf-8"))
+    return h.hexdigest()
+
+
+def prefix_chain_hash(tokens: Sequence[int], page_size: int) -> str:
+    """Hash of the FULL-page prefix chain of a prompt — the router's
+    affinity key (serving/router.py route_generate): equal shared
+    prefixes hash to the same decode replica, so a session's turns
+    land where its KV pages already live."""
+    h = ROOT_HASH
+    n = len(tokens) // int(page_size)
+    for b in range(n):
+        h = _chain_hash(h, tokens[b * page_size:(b + 1) * page_size])
+    return h
+
+
+class _Block:
+    __slots__ = ("hash", "parent", "tokens", "page", "refs", "children",
+                 "last_used")
+
+    def __init__(self, hash_: str, parent: str, tokens: Tuple[int, ...],
+                 page: int):
+        self.hash = hash_
+        self.parent = parent
+        self.tokens = tokens
+        self.page = page
+        self.refs = 0
+        self.children: set = set()
+        self.last_used = 0
+
+
+class PrefixStore:
+    """Hash-chained, refcounted block index over a KVPagePool.
+
+    Owns the physical pages of every resident block (they are lent
+    from the pool and returned only at eviction) — ``owned_pages()``
+    feeds ``pool.audit`` so chaos runs can prove nothing leaked."""
+
+    def __init__(self, pool: KVPagePool):
+        self.pool = pool
+        self.page_size = pool.page_size
+        self._lock = lockdep.lock("serving.kv_prefix")
+        self._blocks: Dict[str, _Block] = {}
+        self._clock = 0
+        self._bytes_saved = 0
+
+    # -- introspection -------------------------------------------------------
+    def owned_pages(self) -> List[int]:
+        with self._lock:
+            return [b.page for b in self._blocks.values()]
+
+    def num_blocks(self) -> int:
+        with self._lock:
+            return len(self._blocks)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            n = len(self._blocks)
+            shared = sum(1 for b in self._blocks.values() if b.refs > 1)
+            idle = sum(1 for b in self._blocks.values() if b.refs == 0)
+            saved = self._bytes_saved
+        return {"blocks": n, "blocks_shared": shared, "blocks_idle": idle,
+                "bytes_saved": saved,
+                "block_bytes": self.pool._page_bytes}
+
+    def _gauges(self):
+        telemetry.gauge_set("kv.prefix_blocks", len(self._blocks))
+        telemetry.gauge_set("mem.serving.kv_prefix_saved_bytes",
+                            self._bytes_saved)
+
+    # -- lookup --------------------------------------------------------------
+    def lookup(self, tokens: Sequence[int]) -> Tuple[List[str], List[int]]:
+        """Longest cached prefix of ``tokens``: returns (block hashes,
+        physical pages), refcounts bumped — caller MUST ``release`` the
+        hashes at retirement. Matches at most ``floor((L-1)/P)`` blocks
+        so the final prompt chunk is always recomputed (it yields the
+        first-token logits). ``kv.prefix_lookup`` faults inject here —
+        a failure is a per-request error, no refcount moves."""
+        faults.maybe_fail("kv.prefix_lookup", tokens=len(tokens))
+        P = self.page_size
+        max_blocks = max(0, (len(tokens) - 1) // P)
+        hashes: List[str] = []
+        pages: List[int] = []
+        with self._lock:
+            self._clock += 1
+            parent = ROOT_HASH
+            for b in range(max_blocks):
+                blk_tokens = tuple(int(t) for t in
+                                   tokens[b * P:(b + 1) * P])
+                h = _chain_hash(parent, blk_tokens)
+                blk = self._blocks.get(h)
+                if blk is None:
+                    break
+                blk.refs += 1
+                blk.last_used = self._clock
+                hashes.append(h)
+                pages.append(blk.page)
+                parent = h
+            if hashes:
+                self._bytes_saved += len(hashes) * self.pool._page_bytes
+            saved_now = len(hashes) * self.pool._page_bytes
+            self._gauges()
+        if hashes:
+            telemetry.counter_add("kv.prefix_hits", 1, blocks=len(hashes))
+            telemetry.counter_add("kv.bytes_saved", saved_now)
+        else:
+            telemetry.counter_add("kv.prefix_misses", 1)
+        return hashes, pages
+
+    # -- insert --------------------------------------------------------------
+    def insert(self, tokens: Sequence[int], pages: Sequence[int],
+               start_block: int = 0) -> Tuple[List[str], List[int]]:
+        """Adopt a freshly prefilled prompt's FULL pages as shared
+        blocks. ``pages`` are the request's prompt pages (page index i
+        holds global tokens [i*P, (i+1)*P)); blocks before
+        ``start_block`` were already acquired by lookup and are
+        skipped. Only pages strictly before the page receiving decode
+        writes are adoptable: ``floor(L/P)`` blocks total.
+
+        Returns (hashes newly held by this request, the CANONICAL page
+        per inserted block). The store adopts the candidate pages; the
+        caller must repoint its page table at the canonical pages and
+        drop them from its private list. On a duplicate insert (two
+        racing cold requests with the same prompt) the resident block
+        wins: its page is the canonical one and the redundant
+        candidate page goes straight back to the pool."""
+        P = self.page_size
+        n_full = len(tokens) // P
+        held: List[str] = []
+        canonical: List[int] = []
+        to_free: List[int] = []
+        cow = 0
+        with self._lock:
+            self._clock += 1
+            parent = ROOT_HASH
+            for b in range(n_full):
+                blk_tokens = tuple(int(t) for t in
+                                   tokens[b * P:(b + 1) * P])
+                h = _chain_hash(parent, blk_tokens)
+                if b >= start_block:
+                    blk = self._blocks.get(h)
+                    if blk is None:
+                        blk = _Block(h, parent, blk_tokens, int(pages[b]))
+                        self._blocks[h] = blk
+                        par = self._blocks.get(parent)
+                        if par is not None:
+                            if par.children:
+                                cow += 1
+                            par.children.add(h)
+                    else:
+                        # duplicate chain: the resident block wins, the
+                        # candidate page is redundant
+                        if blk.page != int(pages[b]):
+                            to_free.append(int(pages[b]))
+                    blk.refs += 1
+                    blk.last_used = self._clock
+                    held.append(h)
+                    canonical.append(blk.page)
+                parent = h
+            self._gauges()
+        if to_free:
+            self.pool.free(to_free)
+        if cow:
+            telemetry.counter_add("kv.cow_forks", cow)
+        return held, canonical
+
+    # -- release / reclaim ---------------------------------------------------
+    def release(self, hashes: Sequence[str]):
+        """Drop one reference per hash (request retirement). Blocks at
+        refcount zero remain resident — eviction is reclaim's job."""
+        with self._lock:
+            for h in hashes:
+                blk = self._blocks.get(h)
+                if blk is None:
+                    raise AssertionError(
+                        f"prefix store corruption: releasing unknown "
+                        f"block {h}")
+                if blk.refs <= 0:
+                    raise AssertionError(
+                        f"prefix store corruption: double release of "
+                        f"block {h}")
+                blk.refs -= 1
+
+    def reclaim(self, n_pages: int) -> int:
+        """Evict up to ``n_pages`` pages of refcount-zero LEAF blocks,
+        LRU first, returning their pages to the pool. Leaf-only keeps
+        every resident chain reachable from the root — an interior
+        block with a cached child must outlive it. Returns pages
+        actually freed (``kv.reclaims``)."""
+        freed: List[int] = []
+        with self._lock:
+            while len(freed) < n_pages:
+                victims = [b for b in self._blocks.values()
+                           if b.refs == 0 and not b.children]
+                if not victims:
+                    break
+                blk = min(victims, key=lambda b: b.last_used)
+                del self._blocks[blk.hash]
+                par = self._blocks.get(blk.parent)
+                if par is not None:
+                    par.children.discard(blk.hash)
+                freed.append(blk.page)
+            self._gauges()
+        if freed:
+            self.pool.free(freed)
+            telemetry.counter_add("kv.reclaims", 1, pages=len(freed))
+        return len(freed)

@@ -52,13 +52,20 @@ def run_decode_replica(args) -> int:
 
     if args.telemetry_log:
         telemetry.configure(args.telemetry_log)
-    engine = decode_engine_from_dir(args.decode_model_dir)
+    config = None
+    if args.role != "unified" or args.prefill_urls or args.prefix_cache:
+        from .decode import DecodeConfig
+
+        config = DecodeConfig(role=args.role,
+                              prefill_urls=args.prefill_urls,
+                              prefix_cache=args.prefix_cache or None)
+    engine = decode_engine_from_dir(args.decode_model_dir, config=config)
     server = ServingHTTPServer(None, host=args.host, port=args.port,
                                decode_engine=engine).start()
     print("PT_REPLICA_READY " + json.dumps(
         {"url": server.url, "port": server.port, "pid": os.getpid(),
          "version": engine.version, "model_dir": args.decode_model_dir,
-         "decode": True}), flush=True)
+         "decode": True, "role": engine.config.role}), flush=True)
 
     stop = threading.Event()
 
@@ -169,6 +176,18 @@ def main(argv=None) -> int:
     ap.add_argument("--batch-timeout-ms", type=float, default=-1.0,
                     help="< 0 = FLAGS_serving_batch_timeout_ms")
     ap.add_argument("--no-warmup", action="store_true")
+    ap.add_argument("--role", default="unified",
+                    choices=("unified", "prefill", "decode"),
+                    help="disaggregated-serving tier of a decode replica "
+                         "(serving/disagg.py): 'prefill' ships KV pages "
+                         "over POST /v1/prefill, 'decode' installs them, "
+                         "'unified' does both locally")
+    ap.add_argument("--prefill-urls", default="",
+                    help="comma-separated prefill-tier URLs a decode-role "
+                         "replica fetches KV shipments from")
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="enable the content-addressed prefix store "
+                         "(serving/prefix_store.py) on this replica")
     ap.add_argument("--poll-s", type=float, default=0.0,
                     help="> 0 arms SELF-watching of --model-root for new "
                          "versions (routerless mode); the cluster "

@@ -59,9 +59,13 @@ class NoReplicaAvailableError(ServingError):
 class ReplicaHandle:
     """The router's view of one replica: endpoint + last probed state."""
 
-    def __init__(self, name: str, url: str):
+    def __init__(self, name: str, url: str, role: str = "unified"):
         self.name = name
         self.url = url.rstrip("/")
+        # disaggregated-serving tier (serving/disagg.py): 'prefill'
+        # replicas only take /v1/prefill shipments, 'decode' and
+        # 'unified' carry /v1/generate traffic (route_generate)
+        self.role = str(role or "unified").lower()
         self._lock = lockdep.lock("router.replica")
         self.ready = False
         self.alive = True
@@ -154,7 +158,8 @@ class ReplicaHandle:
     def snapshot(self) -> Dict[str, Any]:
         age = self.probe_age_s()
         with self._lock:
-            return {"name": self.name, "url": self.url, "ready": self.ready,
+            return {"name": self.name, "url": self.url, "role": self.role,
+                    "ready": self.ready,
                     "queue_depth": self.queue_depth,
                     "inflight": self.inflight,
                     "model_version": self.model_version,
@@ -230,8 +235,9 @@ class Router:
         self._fleet = None
 
     # -- membership ----------------------------------------------------------
-    def add_replica(self, name: str, url: str) -> ReplicaHandle:
-        handle = ReplicaHandle(name, url)
+    def add_replica(self, name: str, url: str,
+                    role: str = "unified") -> ReplicaHandle:
+        handle = ReplicaHandle(name, url, role=role)
         with self._lock:
             self._handles.append(handle)
         self.probe(handle)
@@ -617,6 +623,128 @@ class Router:
                           code=code)
         return code, payload
 
+    # -- generative plane: prefix-affinity routing ---------------------------
+    def pick_generate(self, prompt_ids,
+                      exclude=()) -> Optional[ReplicaHandle]:
+        """Prefix-AFFINITY pick for /v1/generate (serving/disagg.py
+        topology): hash the prompt's full-page prefix chain
+        (serving/prefix_store.prefix_chain_hash) over the ready
+        decode-tier replicas, so a session's turns keep landing on the
+        replica whose prefix store already holds its KV pages. Falls
+        back to the unified tier when the decode tier is empty
+        (``router.affinity_fallbacks``), then to the generic
+        lowest-load pick. Prefill-tier replicas never carry generate
+        traffic."""
+        handles = [h for h in self.handles() if h not in exclude]
+        decode_tier = sorted((h for h in handles
+                              if h.ready and h.role == "decode"),
+                             key=lambda h: h.name)
+        unified_tier = sorted((h for h in handles
+                               if h.ready and h.role == "unified"),
+                              key=lambda h: h.name)
+        tier = decode_tier or unified_tier
+        if not tier:
+            return self.pick(exclude=set(exclude) | {
+                h for h in handles if h.role == "prefill"})
+        if not decode_tier and any(h.role == "decode"
+                                   for h in self.handles()):
+            # a decode tier EXISTS but none of it is ready right now
+            telemetry.counter_add("router.affinity_fallbacks", 1)
+        from .prefix_store import prefix_chain_hash
+
+        chain = prefix_chain_hash(
+            [int(t) for t in prompt_ids],
+            int(_flag("decode_page_size")))
+        handle = tier[int(chain, 16) % len(tier)]
+        telemetry.counter_quiet("router.affinity_routes")
+        return handle
+
+    def route_generate(self, prompt_ids,
+                       max_new_tokens: Optional[int] = None,
+                       temperature: float = 0.0,
+                       seed: Optional[int] = None,
+                       deadline_ms: Optional[float] = None,
+                       ) -> Tuple[int, Dict[str, Any]]:
+        """Route one generation to the decode plane with prefix
+        affinity; retries transport failures and retryable statuses on
+        the remaining tier. Never raises — always (code, payload)."""
+        telemetry.counter_add("router.requests", 1, plane="generate")
+        budget_s = float(_flag("router_timeout_s"))
+        if deadline_ms is not None and deadline_ms > 0:
+            budget_s = min(budget_s, deadline_ms / 1e3) \
+                if budget_s > 0 else deadline_ms / 1e3
+        policy = retry.RetryPolicy(
+            max_retries=self.policy.max_retries,
+            backoff=self.policy.backoff,
+            deadline=budget_s if budget_s > 0 else None,
+            max_delay=self.policy.max_delay, jitter=self.policy.jitter)
+        sched = policy.start()
+        per_try_cap = float(_flag("router_dispatch_timeout_s"))
+        body_doc: Dict[str, Any] = {
+            "prompt_ids": [int(t) for t in prompt_ids],
+            "temperature": float(temperature)}
+        if max_new_tokens is not None:
+            body_doc["max_new_tokens"] = int(max_new_tokens)
+        if seed is not None:
+            body_doc["seed"] = int(seed)
+        tried: set = set()
+        code, payload = 503, {"error": "no replica available"}
+        while True:
+            handle = self.pick_generate(body_doc["prompt_ids"],
+                                        exclude=tried)
+            if handle is None and tried:
+                tried = set()
+                handle = self.pick_generate(body_doc["prompt_ids"])
+            if handle is None:
+                telemetry.counter_add("router.rejects", 1)
+                code, payload = 503, {
+                    "error": "no generate-capable replica available"}
+                break
+            attempt_timeout = sched.remaining(default=per_try_cap)
+            attempt_timeout = per_try_cap if attempt_timeout is None \
+                else min(attempt_timeout, per_try_cap)
+            rem = sched.remaining(default=None)
+            if rem is not None:
+                body_doc["deadline_ms"] = max(rem * 1e3, 1.0)
+            retryable_exc: Optional[BaseException] = None
+            try:
+                faults.maybe_fail("router.dispatch", replica=handle.name)
+                with telemetry.timer("router.dispatch_ms"):
+                    code, payload = _http_json(
+                        "POST", handle.url, "/v1/generate",
+                        body=json.dumps(body_doc).encode(),
+                        timeout=attempt_timeout)
+            except (ConnectionError, OSError,
+                    http.client.HTTPException) as e:
+                retryable_exc = e
+                handle.mark_down(type(e).__name__)
+                telemetry.counter_add("router.dispatch_errors", 1,
+                                      replica=handle.name,
+                                      exc=type(e).__name__)
+            if retryable_exc is None:
+                if code == 200 or code not in self.RETRYABLE_STATUS:
+                    payload["replica"] = handle.name
+                    break
+                telemetry.counter_add("router.dispatch_errors", 1,
+                                      replica=handle.name, status=code)
+            tried.add(handle)
+            outcome, delay = sched.note_failure()
+            if outcome == retry.DEADLINE:
+                telemetry.counter_add("router.deadline_exceeded", 1)
+                code, payload = 504, {
+                    "error": f"generation exceeded its {budget_s:.3f}s "
+                             f"deadline after {sched.attempt} attempts"}
+                break
+            if outcome == retry.EXHAUSTED:
+                code, payload = 502, {
+                    "error": f"generation failed on every replica after "
+                             f"{sched.attempt} attempts "
+                             f"(last: {retryable_exc or code})"}
+                break
+            telemetry.counter_add("router.retries", 1)
+            time.sleep(delay)
+        return code, payload
+
     # -- introspection -------------------------------------------------------
     def ready(self) -> bool:
         return any(h.ready for h in self.handles())
@@ -707,6 +835,21 @@ class _RouterHandler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         router: Router = self.server.router
+        if self.path == "/v1/generate":
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+                doc = json.loads(self.rfile.read(length) or b"{}")
+                prompt = doc["prompt_ids"]
+            except (ValueError, TypeError, KeyError) as e:
+                self._reply(400, {"error": f"bad generate request: {e!r}"})
+                return
+            code, payload = router.route_generate(
+                prompt, max_new_tokens=doc.get("max_new_tokens"),
+                temperature=float(doc.get("temperature", 0.0)),
+                seed=doc.get("seed"),
+                deadline_ms=doc.get("deadline_ms"))
+            self._reply(code, payload)
+            return
         if self.path != "/v1/infer":
             self._reply(404, {"error": f"no route {self.path}"})
             return

@@ -242,10 +242,55 @@ class _Handler(BaseHTTPRequestHandler):
                 "model_version": de.version,
                 "latency_ms": round((time.perf_counter() - t0) * 1e3, 3)})
 
+    def _handle_prefill(self):
+        """POST /v1/prefill — the prefill tier of disaggregated serving
+        (serving/disagg.py): {"prompt": [ints]} -> the serialized KV
+        page shipment (application/octet-stream, versioned wire format
+        with per-page CRCs). Decode-role replicas fetch this and
+        install the pages instead of prefilling locally."""
+        de = self.server.decode_engine
+        if de is None:
+            self._reply(404, {"error": "no decode engine attached — "
+                                       "nothing to prefill here"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            doc = json.loads(self.rfile.read(length) or b"{}")
+            prompt = doc["prompt"]
+        except (ValueError, TypeError, KeyError) as e:
+            self._reply(400, {"error": f"bad prefill request: {e!r}"})
+            return
+        try:
+            blob = de.submit_prefill(
+                prompt, deadline_ms=doc.get("deadline_ms")).result()
+        except ValueError as e:
+            self._reply(400, {"error": str(e)})
+        except KVCacheExhaustedError as e:
+            self._reply(429, {"error": str(e),
+                              "error_type": "KVCacheExhaustedError"})
+        except ServerOverloadedError as e:
+            self._reply(429, {"error": str(e)}, {"Retry-After": "0.05"})
+        except EngineClosedError as e:
+            self._reply(503, {"error": str(e)})
+        except DeadlineExceededError as e:
+            self._reply(504, {"error": str(e)})
+        except Exception as e:
+            self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+        else:
+            body = bytes(blob)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/octet-stream")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
     def do_POST(self):
         engine: ServingEngine = self.server.engine
         if self.path == "/v1/generate":
             self._handle_generate()
+            return
+        if self.path == "/v1/prefill":
+            self._handle_prefill()
             return
         if self.path == "/v1/admin/swap":
             self._handle_swap(engine)

@@ -486,7 +486,8 @@ def _emitted_metric_names():
                                         "pallas.", "incidents.",
                                         "slo.", "tuner.",
                                         "goodput.", "fleet.",
-                                        "scaler.", "elastic.")) or \
+                                        "scaler.", "elastic.",
+                                        "kv.", "disagg.")) or \
                             (name.startswith("sharding.")
                              and ("state_bytes" in name
                                   or "zero_regroup" in name)):
@@ -541,6 +542,21 @@ class TestMetricDriftGuard:
         assert "elastic.restart_budget_refunds" in names
         assert "incidents.scale_events" in names
         assert "sharding.zero_regroup_events" in names
+        # the content-addressed prefix store + disaggregated prefill
+        # plane (serving/prefix_store.py + disagg.py)
+        assert "kv.prefix_hits" in names
+        assert "kv.prefix_misses" in names
+        assert "kv.bytes_saved" in names
+        assert "kv.cow_forks" in names
+        assert "kv.reclaims" in names
+        assert "kv.audit_failures" in names
+        assert "kv.prefix_blocks" in names
+        assert "mem.serving.kv_prefix_saved_bytes" in names
+        assert "disagg.ships" in names
+        assert "disagg.ship_bytes" in names
+        assert "disagg.installs" in names
+        assert "disagg.crc_rejects" in names
+        assert "disagg.fallback_prefills" in names
         # the fleet observatory (core/fleetobs.py)
         assert "fleet.scrapes" in names
         assert "fleet.scrape_failures" in names

@@ -661,6 +661,78 @@ class TestScalerPolicy:
 
 
 # ---------------------------------------------------------------------------
+# ScalerPolicy.from_slo_rules: firing SLO gauges as scale evidence
+# ---------------------------------------------------------------------------
+
+class TestSLOScalerPolicy:
+    def _policy(self, **kw):
+        from paddle_tpu.distributed.scaler import ScalerPolicy
+
+        kw.setdefault("min_world", 1)
+        kw.setdefault("max_world", 8)
+        kw.setdefault("cooldown_s", 0.0)
+        return ScalerPolicy.from_slo_rules(**kw)
+
+    def test_one_saturation_trip_one_cooldown_gated_scale_up(self):
+        """A decode queue-saturation episode tripped by the PR 18
+        watchdog (slo.decode_queue_saturation_firing=1) yields exactly
+        ONE ScaleUp while the cooldown runs, and none once the episode
+        clears — the scaler consumes the watchdog's latched verdict, not
+        the raw queue gauge."""
+        from paddle_tpu.core import incidents
+
+        rule = incidents.Rule(
+            "decode_queue_saturation", "decode.queue_depth",
+            kind="gauge", threshold=9.0, direction="above",
+            cooldown_s=0.0)
+        wd = incidents.Watchdog([rule])
+        p = self._policy(cooldown_s=60.0)
+        before = dict(telemetry.counters())
+        try:
+            telemetry.gauge_set("decode.queue_depth", 12)
+            assert wd.evaluate(now=100.0) == ["decode_queue_saturation"]
+
+            d = p.decide(2, now=100.0)
+            assert d is not None
+            assert (d.direction, d.target) == ("up", 3)
+            assert d.reason == "decode_queue_saturation"
+            assert "decode_queue_saturation" in \
+                d.signals.get("slo_firing", [])
+            # still firing inside the cooldown: suppressed, not repeated
+            assert p.decide(3, now=130.0) is None
+            assert _delta(before, "scaler.suppressed_cooldown") == 1
+            assert _delta(before, "scaler.scale_up") == 1
+            # episode clears -> gauge drops to 0 -> no decision even
+            # after the cooldown expires
+            telemetry.gauge_set("decode.queue_depth", 1)
+            wd.evaluate(now=200.0)
+            assert p.decide(3, now=300.0) is None
+            assert _delta(before, "scaler.scale_up") == 1
+        finally:
+            telemetry.gauge_set("decode.queue_depth", 0)
+            telemetry.gauge_set("slo.decode_queue_saturation_firing", 0)
+
+    def test_down_rule_and_injected_firing_set(self):
+        from paddle_tpu.distributed.scaler import ScaleSignals
+
+        p = self._policy()
+        sig = ScaleSignals(extra={"slo_firing": ["live_mfu_drop"]})
+        d = p.decide(4, signals=sig, now=1.0)
+        assert (d.direction, d.target, d.reason) == \
+            ("down", 3, "live_mfu_drop")
+        # up-rules outrank down-rules when both fire
+        p.reset_cooldown()
+        sig = ScaleSignals(extra={"slo_firing": [
+            "live_mfu_drop", "decode_queue_saturation"]})
+        d = p.decide(4, signals=sig, now=2.0)
+        assert (d.direction, d.reason) == ("up", "decode_queue_saturation")
+
+    def test_quiet_gauges_mean_no_decision(self):
+        p = self._policy()
+        assert p.decide(4, now=1.0) is None
+
+
+# ---------------------------------------------------------------------------
 # ElasticRunner: windowed restart budget + the scale-event protocol
 # ---------------------------------------------------------------------------
 

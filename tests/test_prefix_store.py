@@ -1,0 +1,562 @@
+"""Prefix-sharing content-addressed KV store + disaggregated serving
+(paddle_tpu/serving/prefix_store.py, serving/disagg.py, the chunked
+prefill path in serving/decode.py + models/decoder_lm.py, router
+prefix affinity).
+
+Contracts under test:
+* the store's hash CHAIN keys each page-sized block by (parent hash,
+  token block): full lookup hit, miss, and partial hit at the
+  divergence point — and lookup matches at most floor((L-1)/P) blocks
+  so the final prompt chunk is ALWAYS recomputed;
+* copy-on-write forks: a second child registered under a shared parent
+  is a fork (kv.cow_forks), and the diverging request's blocks are its
+  own — mutating one chain never perturbs the other's tokens;
+* refcounting + LRU reclaim: refcount-zero chains stay cached until
+  pool pressure evicts them leaf-first in last_used order; blocks
+  still referenced (or with cached children) are never evicted;
+* bytes_saved accounting lands in the store stats, the kv.bytes_saved
+  counter and the HBM ledger (serving_kv_prefix_saved_bytes);
+* BITWISE identity: prefix-hit continuous-batched decode equals
+  cold-prefill decode equals the classic one-pass prefill engine —
+  greedy and seeded sampling, fp32 and int8, PT_PALLAS off and
+  interpret;
+* pool.audit() proves the free list + lent pages partition the pool
+  (and, fed owned_pages(), that nothing leaked or was over-freed);
+* disaggregated shipments: pack/unpack round-trips every page
+  bit-exactly, a corrupted payload is rejected with ShipmentCRCError
+  (disagg.crc_rejects) — never installed;
+* router prefix affinity: equal full-page prefix chains pick the same
+  ready decode-tier replica, the unified tier absorbs traffic when the
+  decode tier is down (router.affinity_fallbacks), and prefill-tier
+  replicas never carry generate traffic.
+
+tools/chaos_check.py --prefix and tools/bench_serving.py
+--prefix-share are the CLI twins.
+"""
+
+import contextlib
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.core import telemetry
+
+pytestmark = pytest.mark.serving
+
+CFG_KW = dict(vocab_size=97, d_model=32, n_head=2, n_layers=2,
+              d_inner=64, max_seq_len=32)
+POOL_KW = dict(max_slots=4, page_size=4, kv_pages=28,
+               prefill_buckets=[8, 16])
+
+
+def _model_cfg(**over):
+    from paddle_tpu.models.decoder_lm import DecoderLMConfig
+
+    return DecoderLMConfig(**{**CFG_KW, **over})
+
+
+def _counter(name):
+    return int(telemetry.counter_get(name))
+
+
+def _pool(num_pages=16, page_size=4):
+    from paddle_tpu.serving.kv_cache import KVPagePool
+
+    return KVPagePool(n_layers=2, num_pages=num_pages,
+                      page_size=page_size, kv_dim=8)
+
+
+def _store(num_pages=16, page_size=4):
+    from paddle_tpu.serving.prefix_store import PrefixStore
+
+    return PrefixStore(_pool(num_pages, page_size))
+
+
+@contextlib.contextmanager
+def _pallas(mode):
+    old = os.environ.get("PT_PALLAS")
+    os.environ["PT_PALLAS"] = mode
+    try:
+        yield
+    finally:
+        if old is None:
+            os.environ.pop("PT_PALLAS", None)
+        else:
+            os.environ["PT_PALLAS"] = old
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    """Two prompts sharing a 9-token prefix (2 full pages at P=4) plus
+    divergent suffixes — the canonical shared-system-prompt workload."""
+    rng = np.random.RandomState(0)
+    prefix = rng.randint(3, 90, size=9)
+    p1 = np.concatenate([prefix, rng.randint(3, 90, size=3)]) \
+        .astype(np.int32)
+    p2 = np.concatenate([prefix, rng.randint(3, 90, size=2)]) \
+        .astype(np.int32)
+    return p1, p2
+
+
+# ---------------------------------------------------------------------------
+# chain hashing
+# ---------------------------------------------------------------------------
+
+class TestChainHash:
+    def test_full_page_blocks_only(self):
+        from paddle_tpu.serving.prefix_store import (ROOT_HASH,
+                                                     prefix_chain_hash)
+
+        t = list(range(20, 31))          # 11 tokens, P=4 -> 2 full pages
+        h8 = prefix_chain_hash(t[:8], 4)
+        # the partial final page never contributes to the chain
+        assert prefix_chain_hash(t[:9], 4) == h8
+        assert prefix_chain_hash(t, 4) == h8
+        # any full-page token flips the chain
+        t2 = list(t)
+        t2[7] += 1
+        assert prefix_chain_hash(t2, 4) != h8
+        # under one full page there is no chain at all
+        assert prefix_chain_hash(t[:3], 4) == ROOT_HASH
+
+    def test_chain_pins_whole_prefix_not_just_own_block(self):
+        from paddle_tpu.serving.prefix_store import _chain_hash
+
+        # same second block under different first blocks -> different
+        # identity: block identity = (parent hash, tokens)
+        a = _chain_hash(_chain_hash("root", [1, 2, 3, 4]), [9, 9, 9, 9])
+        b = _chain_hash(_chain_hash("root", [5, 6, 7, 8]), [9, 9, 9, 9])
+        assert a != b
+
+
+class TestChunkPrefillProgram:
+    def test_chunk_program_uses_chunk_cached_attention(self):
+        """The chunked-prefill program lowers attention through the
+        registered ``chunk_cached_attention`` op — one per layer. Its
+        numerics are pinned end-to-end by the bitwise-identity engine
+        tests below; this pins the lowering itself."""
+        from paddle_tpu.models.decoder_lm import build_chunk_prefill_program
+
+        cfg = _model_cfg()
+        program, feeds, fetches = build_chunk_prefill_program(
+            cfg, batch=1, chunk_len=4, num_pages=8, page_size=4)
+        ops = [op.type for op in program.global_block().ops]
+        assert ops.count("chunk_cached_attention") == cfg.n_layers
+        assert feeds and fetches
+
+
+# ---------------------------------------------------------------------------
+# store unit: lookup / insert / COW / reclaim over a real page pool
+# ---------------------------------------------------------------------------
+
+class TestStoreUnit:
+    def test_miss_insert_hit_and_final_chunk_cap(self):
+        store = _store()
+        toks = list(range(10, 20))       # 10 tokens -> 2 full pages
+        before = {n: _counter(f"kv.{n}")
+                  for n in ("prefix_hits", "prefix_misses", "bytes_saved")}
+        hashes, pages = store.lookup(toks)
+        assert (hashes, pages) == ([], [])
+        assert _counter("kv.prefix_misses") == before["prefix_misses"] + 1
+
+        alloc = store.pool.try_alloc(2)
+        held, canon = store.insert(toks, alloc)
+        assert len(held) == 2 and canon == alloc
+        assert store.num_blocks() == 2
+        store.release(held)
+
+        # full hit: both resident blocks, the SAME physical pages
+        hashes, pages = store.lookup(toks)
+        assert len(hashes) == 2 and pages == alloc
+        assert _counter("kv.prefix_hits") == before["prefix_hits"] + 1
+        saved = _counter("kv.bytes_saved") - before["bytes_saved"]
+        assert saved == 2 * store.pool._page_bytes
+        assert store.stats()["bytes_saved"] >= saved
+        store.release(hashes)
+
+        # the match cap: an exactly-two-page prompt matches only ONE
+        # block — the final chunk must be recomputed for its logits
+        hashes, _pages = store.lookup(toks[:8])
+        assert len(hashes) == 1
+        store.release(hashes)
+
+    def test_partial_hit_stops_at_divergence(self):
+        store = _store()
+        a = [1, 2, 3, 4, 5, 6, 7, 8, 9]
+        b = [1, 2, 3, 4, 50, 60, 70, 80, 90]   # diverges in block 1
+        pa = store.pool.try_alloc(2)
+        held, _ = store.insert(a, pa)
+        store.release(held)
+        hashes, pages = store.lookup(b)
+        assert len(hashes) == 1 and pages == [pa[0]]
+        store.release(hashes)
+
+    def test_cow_fork_counted_and_isolated(self):
+        store = _store()
+        before = _counter("kv.cow_forks")
+        a = [1, 2, 3, 4, 10, 11, 12, 13, 0]
+        b = [1, 2, 3, 4, 20, 21, 22, 23, 0]
+        pa = store.pool.try_alloc(2)
+        held_a, _ = store.insert(a, pa)
+        assert _counter("kv.cow_forks") == before   # first child: no fork
+
+        # request B: lookup matched the shared block 0, recomputed its
+        # own block 1 into a private page, then registers the fork
+        hashes, shared = store.lookup(b)
+        assert shared == [pa[0]]
+        pb1 = store.pool.try_alloc(1)
+        held_b, canon = store.insert(b, [shared[0], pb1[0]], start_block=1)
+        assert held_b != held_a[1:] and canon == pb1
+        assert _counter("kv.cow_forks") == before + 1
+        # both chains resolve independently to their own pages
+        assert store.lookup(a)[1] == pa
+        assert store.lookup(b)[1] == [pa[0], pb1[0]]
+
+    def test_duplicate_insert_resident_block_wins(self):
+        store = _store()
+        toks = [7, 7, 7, 7, 8, 8, 8, 8, 0]
+        pa = store.pool.try_alloc(2)
+        held_a, canon_a = store.insert(toks, pa)
+        free_before = store.pool.free_pages()
+        pb = store.pool.try_alloc(2)
+        held_b, canon_b = store.insert(toks, pb)
+        # the resident pages are canonical; the redundant candidates
+        # went straight back to the pool
+        assert canon_b == canon_a == pa
+        assert store.pool.free_pages() == free_before
+        assert store.num_blocks() == 2
+        for held in (held_a, held_b):
+            store.release(held)
+
+    def test_release_corruption_guards(self):
+        store = _store()
+        toks = [1, 2, 3, 4, 0]
+        held, _ = store.insert(toks, store.pool.try_alloc(1))
+        with pytest.raises(AssertionError, match="unknown"):
+            store.release(["deadbeef"])
+        store.release(held)
+        with pytest.raises(AssertionError, match="double release"):
+            store.release(held)
+
+    def test_reclaim_lru_leaf_first_and_refcount_protected(self):
+        store = _store()
+        before = _counter("kv.reclaims")
+        # chain A: two blocks (interior + leaf), touched FIRST (older)
+        a = [1, 2, 3, 4, 5, 6, 7, 8, 0]
+        held_a, _ = store.insert(a, store.pool.try_alloc(2))
+        # chain B: one block, touched later (newer)
+        b = [9, 9, 9, 9, 0]
+        held_b, _ = store.insert(b, store.pool.try_alloc(1))
+        store.release(held_a)
+
+        # B is still referenced: only A's blocks are evictable, and the
+        # interior block must outlive its leaf — so evicting 2 pages
+        # walks A's chain leaf-first
+        assert store.reclaim(2) == 2
+        assert store.num_blocks() == 1
+        assert store.lookup(a) == ([], [])
+        assert store.lookup(b)[0] == held_b          # B survived
+        store.release(held_b)
+
+        # refcount dropped: now B is evictable too
+        store.release(held_b)
+        assert store.reclaim(5) == 1
+        assert store.num_blocks() == 0
+        assert store.pool.free_pages() == store.pool.capacity_pages
+        assert _counter("kv.reclaims") == before + 2
+
+    def test_lru_order_evicts_oldest_leaf(self):
+        store = _store()
+        a = [1, 1, 1, 1, 0]
+        b = [2, 2, 2, 2, 0]
+        held_a, _ = store.insert(a, store.pool.try_alloc(1))
+        held_b, _ = store.insert(b, store.pool.try_alloc(1))
+        store.release(held_a)
+        store.release(held_b)
+        # touch A -> B becomes the LRU victim
+        store.release(store.lookup(a)[0])
+        assert store.reclaim(1) == 1
+        assert store.lookup(b) == ([], [])
+        assert store.lookup(a)[0]                    # A still resident
+
+    def test_bytes_saved_reaches_hbm_ledger(self):
+        from paddle_tpu.core import costmodel
+
+        store = _store()
+        toks = [3, 1, 4, 1, 5, 9, 2, 6, 0]
+        held, _ = store.insert(toks, store.pool.try_alloc(2))
+        store.release(held)
+        hashes, _ = store.lookup(toks)
+        store.release(hashes)
+        led = costmodel.ledger()
+        assert led.get("serving_kv_prefix_saved_bytes", 0) >= \
+            store.stats()["bytes_saved"] > 0
+
+
+# ---------------------------------------------------------------------------
+# pool.audit: the free list + lent pages partition the pool
+# ---------------------------------------------------------------------------
+
+class TestPoolAudit:
+    def test_clean_pool_and_owned_reconciliation(self):
+        pool = _pool()
+        assert pool.audit() == []
+        pages = pool.try_alloc(3)
+        assert pool.audit() == []
+        assert pool.audit(owned=pages) == []
+        # a page the ledger says is lent but nobody owns is a LEAK
+        viol = pool.audit(owned=pages[:2])
+        assert any("leak" in v for v in viol)
+        # a page owned twice is double-booked
+        viol = pool.audit(owned=pages + [pages[0]])
+        assert any("twice" in v for v in viol)
+        pool.free(pages)
+        assert pool.audit(owned=[]) == []
+
+    def test_tampered_ledger_detected_and_counted(self):
+        pool = _pool()
+        pages = pool.try_alloc(2)
+        before = _counter("kv.audit_failures")
+        pool._lent.discard(pages[0])     # simulate an over-free
+        viol = pool.audit(owned=pages)
+        assert viol
+        assert _counter("kv.audit_failures") == before + 1
+        pool._lent.add(pages[0])
+        pool.free(pages)
+        assert pool.audit() == []
+
+
+# ---------------------------------------------------------------------------
+# engine: bitwise identity — prefix-hit == cold == classic prefill
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def classic_engine():
+    from paddle_tpu.serving.decode import DecodeConfig, demo_engine
+
+    eng = demo_engine(DecodeConfig(**POOL_KW), _model_cfg()).start()
+    yield eng
+    eng.close(drain=True, timeout=30)
+
+
+@pytest.fixture(scope="module")
+def prefix_engine():
+    from paddle_tpu.serving.decode import DecodeConfig, demo_engine
+
+    eng = demo_engine(DecodeConfig(prefix_cache=True, **POOL_KW),
+                      _model_cfg()).start()
+    yield eng
+    eng.close(drain=True, timeout=30)
+
+
+class TestBitwiseIdentity:
+    def test_greedy_hit_equals_cold_equals_classic(self, classic_engine,
+                                                   prefix_engine, prompts):
+        p1, p2 = prompts
+        want1 = classic_engine.generate(p1, max_new_tokens=6, timeout=120)
+        want2 = classic_engine.generate(p2, max_new_tokens=6, timeout=120)
+        hits_before = _counter("kv.prefix_hits")
+        cold1 = prefix_engine.generate(p1, max_new_tokens=6, timeout=120)
+        hit2 = prefix_engine.generate(p2, max_new_tokens=6, timeout=120)
+        assert np.array_equal(want1, cold1), \
+            "chunked cold prefill diverged from classic prefill"
+        assert np.array_equal(want2, hit2), \
+            "prefix-hit decode diverged from classic prefill"
+        assert _counter("kv.prefix_hits") > hits_before
+        assert _counter("kv.bytes_saved") > 0
+        # shared pages + private pages reconcile exactly
+        assert prefix_engine.pool.audit(
+            owned=prefix_engine.prefix_store.owned_pages()) == []
+
+    def test_sampled_hit_equals_cold(self, prefix_engine, prompts):
+        from paddle_tpu.serving.decode import DecodeConfig, demo_engine
+
+        _p1, p2 = prompts
+        hit = prefix_engine.generate(p2, max_new_tokens=6,
+                                     temperature=0.8, seed=7, timeout=120)
+        cold_eng = demo_engine(DecodeConfig(prefix_cache=True, **POOL_KW),
+                               _model_cfg()).start()
+        try:
+            cold = cold_eng.generate(p2, max_new_tokens=6,
+                                     temperature=0.8, seed=7, timeout=120)
+        finally:
+            cold_eng.close(drain=True, timeout=30)
+        assert np.array_equal(hit, cold)
+
+    def test_int8_hit_equals_cold(self, prompts):
+        from paddle_tpu.serving.decode import DecodeConfig, demo_engine
+
+        p1, p2 = prompts
+        cold_eng = demo_engine(
+            DecodeConfig(weight_quant="int8", **POOL_KW),
+            _model_cfg()).start()
+        try:
+            want = cold_eng.generate(p2, max_new_tokens=5, timeout=120)
+        finally:
+            cold_eng.close(drain=True, timeout=30)
+        hit_eng = demo_engine(
+            DecodeConfig(weight_quant="int8", prefix_cache=True,
+                         **POOL_KW), _model_cfg()).start()
+        try:
+            hit_eng.generate(p1, max_new_tokens=5, timeout=120)
+            got = hit_eng.generate(p2, max_new_tokens=5, timeout=120)
+        finally:
+            hit_eng.close(drain=True, timeout=30)
+        assert np.array_equal(want, got), "int8 prefix-hit diverged"
+
+    def test_interpret_mode_hit_equals_off_mode(self, prefix_engine,
+                                                prompts):
+        """PT_PALLAS=interpret prefix-hit output equals the off-mode
+        prefix engine's (itself pinned to classic above) — the chunked
+        path composes with the kernel decode step."""
+        from paddle_tpu.serving.decode import DecodeConfig, demo_engine
+
+        p1, p2 = prompts
+        want1 = prefix_engine.generate(p1, max_new_tokens=6, timeout=120)
+        want2 = prefix_engine.generate(p2, max_new_tokens=6, timeout=120)
+        with _pallas("interpret"):
+            eng = demo_engine(DecodeConfig(prefix_cache=True, **POOL_KW),
+                              _model_cfg()).start()
+            try:
+                got1 = eng.generate(p1, max_new_tokens=6, timeout=120)
+                got2 = eng.generate(p2, max_new_tokens=6, timeout=120)
+            finally:
+                eng.close(drain=True, timeout=30)
+        assert np.array_equal(want1, got1)
+        assert np.array_equal(want2, got2)
+
+
+# ---------------------------------------------------------------------------
+# engine: reclaim under pool pressure keeps serving
+# ---------------------------------------------------------------------------
+
+class TestReclaimUnderPressure:
+    def test_idle_chains_evicted_to_seat_new_requests(self):
+        from paddle_tpu.serving.decode import DecodeConfig, demo_engine
+
+        rng = np.random.RandomState(3)
+        eng = demo_engine(
+            DecodeConfig(prefix_cache=True, max_slots=2, page_size=4,
+                         kv_pages=10, prefill_buckets=[8]),
+            _model_cfg()).start()
+        before = _counter("kv.reclaims")
+        try:
+            # distinct 12-token prompts: each leaves 3 idle blocks
+            # behind; the 9-page pool forces eviction by the third
+            for _ in range(4):
+                p = rng.randint(3, 90, size=12).astype(np.int32)
+                out = eng.generate(p, max_new_tokens=6, timeout=120)
+                assert out.size == 6
+            assert _counter("kv.reclaims") > before
+            assert eng.pool.audit(
+                owned=eng.prefix_store.owned_pages()) == []
+        finally:
+            eng.close(drain=True, timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# disaggregation: the KV shipment wire format
+# ---------------------------------------------------------------------------
+
+class TestShipment:
+    def test_pack_unpack_round_trips_bit_exactly(self):
+        from paddle_tpu.serving import disagg
+
+        rng = np.random.RandomState(11)
+        layer_pages = {
+            f"kv_{kv}_{i}": rng.randn(3, 4, 8).astype(np.float32)
+            for kv in ("k", "v") for i in range(2)}
+        logits = rng.randn(97).astype(np.float32)
+        toks = [5, 6, 7, 8, 9]
+        blob = disagg.pack_shipment(toks, 4, layer_pages, logits)
+        ship = disagg.unpack_shipment(blob)
+        assert ship["tokens"] == toks
+        assert ship["page_size"] == 4 and ship["n_pages"] == 3
+        for name, arr in layer_pages.items():
+            got = ship["layers"][name]
+            assert got.dtype == arr.dtype
+            assert np.array_equal(got, arr)
+        assert np.array_equal(ship["logits"], logits)
+
+    def test_corrupted_payload_rejected_with_crc_error(self):
+        from paddle_tpu.serving import disagg
+
+        layer_pages = {"kv_k_0": np.ones((2, 4, 8), np.float32),
+                       "kv_v_0": np.ones((2, 4, 8), np.float32)}
+        blob = disagg.pack_shipment([1, 2, 3], 4, layer_pages,
+                                    np.zeros(9, np.float32))
+        before = _counter("disagg.crc_rejects")
+        bad = bytearray(blob)
+        bad[-40] ^= 0xFF
+        with pytest.raises(disagg.ShipmentCRCError):
+            disagg.unpack_shipment(bytes(bad))
+        assert _counter("disagg.crc_rejects") == before + 1
+        with pytest.raises(disagg.ShipmentError):
+            disagg.unpack_shipment(b"NOPE" + bytes(blob)[4:])
+
+    def test_engine_ships_prefill_and_frees_pages(self, classic_engine,
+                                                  prompts):
+        from paddle_tpu.serving import disagg
+
+        _p1, p2 = prompts
+        baseline = classic_engine.pool.free_pages()
+        before = _counter("disagg.ships")
+        blob = classic_engine.submit_prefill(p2).result(timeout=120)
+        ship = disagg.unpack_shipment(bytes(blob))
+        assert ship["tokens"] == [int(t) for t in p2]
+        assert ship["n_pages"] == \
+            classic_engine.pool.pages_for_tokens(p2.size)
+        assert set(ship["layers"]) == set(classic_engine._pools)
+        assert _counter("disagg.ships") == before + 1
+        assert classic_engine.pool.free_pages() == baseline
+        assert classic_engine.stats()["role"] == "unified"
+
+
+# ---------------------------------------------------------------------------
+# router: prefix affinity + tier fallback
+# ---------------------------------------------------------------------------
+
+class TestRouterAffinity:
+    @pytest.fixture()
+    def router(self, monkeypatch):
+        from paddle_tpu.serving.router import Router
+
+        pt.set_flags({"FLAGS_decode_page_size": 4})
+        # no live replicas behind these handles: readiness is driven by
+        # the test through mark_probe, not the HTTP probe
+        monkeypatch.setattr(Router, "probe", lambda self, handle: None)
+        r = Router()
+        for name, role in (("d0", "decode"), ("d1", "decode"),
+                           ("u0", "unified"), ("pf0", "prefill")):
+            r.add_replica(name, f"http://127.0.0.1:1/{name}", role=role)
+        yield r
+        pt.set_flags({"FLAGS_decode_page_size": 16})
+
+    def _ready(self, router, *names):
+        for h in router.handles():
+            h.mark_probe(h.name in names)
+
+    def test_equal_prefix_chains_stick_to_one_decode_replica(self, router):
+        self._ready(router, "d0", "d1", "u0", "pf0")
+        rng = np.random.RandomState(2)
+        base = rng.randint(3, 90, size=9).tolist()
+        picks = {router.pick_generate(base + extra).name
+                 for extra in ([], [5], [5, 6], [7, 8])}
+        # same 2 full-page chain -> same replica, and it is decode-tier
+        assert len(picks) == 1 and picks <= {"d0", "d1"}
+        # a different chain may land elsewhere but stays in-tier
+        assert router.pick_generate(
+            rng.randint(3, 90, size=9).tolist()).name in ("d0", "d1")
+
+    def test_unified_fallback_when_decode_tier_down(self, router):
+        self._ready(router, "u0", "pf0")
+        before = _counter("router.affinity_fallbacks")
+        h = router.pick_generate([1, 2, 3, 4, 5])
+        assert h.name == "u0"
+        assert _counter("router.affinity_fallbacks") == before + 1
+
+    def test_prefill_tier_never_carries_generate(self, router):
+        self._ready(router, "pf0")
+        assert router.pick_generate([1, 2, 3, 4, 5]) is None

@@ -717,6 +717,115 @@ def bench_generate(args):
     }
 
 
+def bench_prefix_share(args):
+    """--prefix-share: the content-addressed prefix store A/B arm
+    (serving/prefix_store.py). A shared-system-prompt workload — every
+    request carries one long common prefix plus a short unique tail —
+    runs twice: the COLD arm on a classic one-pass-prefill engine
+    (prefix cache off), the HIT arm on a primed prefix-cache engine
+    whose chunked prefill recomputes only the tail. Bitwise-gated (a
+    prefix hit must not change one generated token) and scored on
+    time-to-first-token: the hit arm's TTFT p50 should beat the cold
+    arm's by the share of prefill it skipped (the >= 2x acceptance
+    line). Lands as BENCH ``extra.kv_prefix``."""
+    import numpy as np
+
+    from paddle_tpu.core import telemetry
+    from paddle_tpu.models.decoder_lm import (DecoderLMConfig,
+                                              decoder_lm_params)
+    from paddle_tpu.serving import DecodeConfig, DecodeEngine
+
+    page = args.gen_page_size
+    # the shared prefix must dominate the fixed per-prefill cost (the
+    # chunk entry still pays one dispatch + a full page-table attention
+    # gather) for the skipped compute to clear the 2x TTFT line: at
+    # least 96 pages of common context — a hit recomputes exactly one
+    # page-sized chunk of it
+    prefix_len = max(((args.gen_prompt_len - 4) // page), 96) * page
+    max_new = 8
+    rng = np.random.RandomState(23)
+    prefix_toks = rng.randint(3, 90, prefix_len).astype(np.int32)
+    workload = []
+    for _ in range(args.gen_requests):
+        tail = rng.randint(3, 90, int(rng.randint(1, 4))).astype(np.int32)
+        workload.append((np.concatenate([prefix_toks, tail]), max_new))
+    bucket = prefix_len + 4
+    cfg = DecoderLMConfig(vocab_size=512, d_model=args.gen_d_model,
+                          n_head=4, n_layers=args.gen_layers,
+                          d_inner=2 * args.gen_d_model,
+                          max_seq_len=bucket + max_new)
+    params = decoder_lm_params(cfg, seed=0)
+    total_pages = 2 + sum(-(-(len(p) + m) // page) for p, m in workload)
+    concurrency = args.gen_concurrency or 4
+
+    def run_arm(prefix_cache):
+        eng = DecodeEngine(cfg, params, DecodeConfig(
+            max_slots=args.gen_slots, page_size=page,
+            kv_pages=total_pages, prefill_buckets=[bucket],
+            prefix_cache=prefix_cache)).start(warmup=True)
+        try:
+            if prefix_cache:
+                # prime: the first observer inserts the shared chain so
+                # the measured load is the steady hit regime
+                eng.generate(workload[0][0], max_new_tokens=1, timeout=300)
+            ttft_all = []
+            for _ in range(args.gen_rounds):
+                _wall, res, ttft, _itl = _run_gen_load(
+                    eng, workload, concurrency)
+                ttft_all.extend(ttft)
+        finally:
+            eng.close(drain=True, timeout=10)
+        return res, sorted(ttft_all)
+
+    c0 = {n: telemetry_counter(n)
+          for n in ("kv.prefix_hits", "kv.prefix_misses", "kv.bytes_saved",
+                    "kv.cow_forks", "kv.reclaims")}
+    cold_res, cold_ttft = run_arm(False)
+    cold_mark = telemetry_counter("kv.prefix_hits")
+    if cold_mark != c0["kv.prefix_hits"]:
+        raise SystemExit("COLD ARM DIRTY: the prefix-cache-off arm "
+                         "counted prefix hits")
+    hit_res, hit_ttft = run_arm(True)
+    delta = {n: telemetry_counter(n) - v for n, v in c0.items()}
+
+    # bitwise gate: a prefix hit must reproduce the cold generation
+    for i, want in cold_res.items():
+        got = hit_res.get(i)
+        if got is None or not np.array_equal(got, want):
+            raise SystemExit(
+                f"BITWISE MISMATCH: prefix-hit decode of request {i} "
+                f"differs from cold-prefill decode — shared KV pages "
+                f"changed a generation")
+    looks = delta["kv.prefix_hits"] + delta["kv.prefix_misses"]
+    hit_rate = delta["kv.prefix_hits"] / looks if looks else 0.0
+    if not delta["kv.prefix_hits"] or delta["kv.bytes_saved"] <= 0:
+        raise SystemExit("PREFIX ARM DARK: the shared-prefix workload "
+                         "never hit the prefix store")
+    cold_p50, hit_p50 = _pct(cold_ttft, 0.50), _pct(hit_ttft, 0.50)
+    speedup = cold_p50 / hit_p50 if hit_p50 else 0.0
+    if speedup < 2.0:
+        print(f"PREFIX WARN: TTFT p50 speedup {speedup:.2f}x under the "
+              f"2x acceptance line (cold {cold_p50:.3f}ms vs hit "
+              f"{hit_p50:.3f}ms)", file=sys.stderr)
+    return {
+        "requests": len(workload),
+        "prefix_len": prefix_len,
+        "page_size": page,
+        "prefix_hit_rate": round(hit_rate, 4),
+        "prefix_hits": delta["kv.prefix_hits"],
+        "prefix_misses": delta["kv.prefix_misses"],
+        "bytes_saved": delta["kv.bytes_saved"],
+        "cow_forks": delta["kv.cow_forks"],
+        "reclaims": delta["kv.reclaims"],
+        "ttft_p50_ms_cold": round(cold_p50, 3),
+        "ttft_p99_ms_cold": round(_pct(cold_ttft, 0.99), 3),
+        "ttft_p50_ms_hit": round(hit_p50, 3),
+        "ttft_p99_ms_hit": round(_pct(hit_ttft, 0.99), 3),
+        "ttft_speedup_p50": round(speedup, 3),
+        "bitwise_vs_cold": True,
+    }
+
+
 def telemetry_counter(name):
     from paddle_tpu.core import telemetry
 
@@ -771,6 +880,12 @@ def main():
                          "against sequential decode)")
     ap.add_argument("--int8", action="store_true",
                     help="with --generate: int8 weight-only serving")
+    ap.add_argument("--prefix-share", action="store_true",
+                    help="with --generate: add the prefix-cache A/B arm "
+                         "(serving/prefix_store.py) — a shared-system-"
+                         "prompt workload cold vs prefix-hit, bitwise-"
+                         "gated, TTFT p50/p99 per arm as "
+                         "extra.kv_prefix")
     ap.add_argument("--kernel-mode", default="auto",
                     choices=("auto", "off", "interpret", "tpu"),
                     help="--generate: PT_PALLAS mode of the kernel A/B "
@@ -832,7 +947,10 @@ def main():
     if args.generate:
         from tools.bench_models import finalize_bench_result
 
-        print(json.dumps(finalize_bench_result(bench_generate(args))))
+        row = bench_generate(args)
+        if args.prefix_share:
+            row["extra"]["kv_prefix"] = bench_prefix_share(args)
+        print(json.dumps(finalize_bench_result(row)))
         return 0
 
     import tempfile

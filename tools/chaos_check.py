@@ -36,6 +36,18 @@ accounting returns to baseline — zero pages leaked across fault-killed
 generations — and that the engine still generates cleanly once the
 spec is cleared.
 
+With ``--prefix`` it chaos-tests the prefix-sharing KV store and the
+disaggregated prefill/decode plane (paddle_tpu/serving/prefix_store.py
++ disagg.py): concurrent shared-prefix generations and prefill-ship
+requests run under ``kv.prefix_lookup`` / ``disagg.ship`` fault specs
+— injected faults must surface as per-request errors, never a wedged
+queue — then the page/refcount plane is audited (zero leaked or
+double-freed pages once every idle prefix chain is reclaimed), and a
+decode-role engine is fed a corrupted-CRC shipment: the shipment must
+be REJECTED (disagg.crc_rejects) and the request re-prefilled locally
+(disagg.fallback_prefills) with output bitwise identical to a unified
+replica — a clean shipment must actually install.
+
 With ``--slo`` it gates the flight-recorder + SLO watchdog plane
 (paddle_tpu/core/incidents.py) in both directions: one leg per fault
 class drives that subsystem's failure signature through the real
@@ -504,6 +516,256 @@ def _run_decode_leg(args, kernel_leg=False) -> int:
     print(f"CHAOS OK: {args.requests} generations, {len(failed)} "
           f"per-request error responses from {injected} injected faults, "
           f"pool accounting back to baseline, queue never wedged")
+    return 0
+
+
+def run_prefix(args) -> int:
+    """--prefix mode: gate the prefix-sharing KV store + disaggregated
+    prefill plane (serving/prefix_store.py + disagg.py) in three legs:
+
+    1. concurrent shared-prefix generations and prefill-ship requests
+       under ``kv.prefix_lookup`` / ``disagg.ship`` faults — injected
+       faults must become per-request errors (never a wedged queue)
+       while prefix sharing still engages for the survivors;
+    2. page/refcount hygiene — ``pool.audit(owned=store.owned_pages())``
+       must reconcile with zero violations, and reclaiming every idle
+       prefix chain must return the pool exactly to its post-warmup
+       baseline (no page leaked into or out of the store);
+    3. shipment integrity — a decode-role engine fed a corrupted-CRC
+       shipment must REJECT it (disagg.crc_rejects), fall back to a
+       local prefill (disagg.fallback_prefills), and still produce
+       output bitwise identical to a unified replica; a clean shipment
+       must actually install (disagg.installs).
+    """
+    import threading
+
+    import numpy as np
+
+    from paddle_tpu.core import faults, telemetry
+    from paddle_tpu.models.decoder_lm import (DecoderLMConfig,
+                                              decoder_lm_params)
+    from paddle_tpu.serving import DecodeConfig, DecodeEngine, disagg
+
+    if args.telemetry_log:
+        telemetry.configure(args.telemetry_log)
+    if args.trace_sample:
+        from paddle_tpu.core import flags as _flags
+
+        _flags.set_flags({"trace_sample_rate": args.trace_sample})
+
+    # both default sites are one-shot: a lookup fault kills the whole
+    # admission and a ship fault the whole shipment, so %N specs would
+    # leave too few clean requests to exercise the sharing path
+    spec = args.fault_spec or "kv.prefix_lookup:@3,disagg.ship:@2"
+    counters0 = dict(telemetry.counters())
+
+    cfg = DecoderLMConfig(vocab_size=128, d_model=32, n_head=2, n_layers=2,
+                          d_inner=64, max_seq_len=48)
+    params = decoder_lm_params(cfg, seed=0)
+    engine = DecodeEngine(cfg, params,
+                          DecodeConfig(max_slots=4, page_size=4,
+                                       kv_pages=32, prefill_buckets=[16],
+                                       prefix_cache=True))
+    engine.start(warmup=True)
+    # drain whatever the warmup generation left resident in the store so
+    # the baseline is the true empty-store page count
+    engine.prefix_store.reclaim(1 << 20)
+    baseline_free = engine.pool.free_pages()
+    faults.configure(spec, seed=args.seed)
+
+    rng = np.random.RandomState(5)
+    shared = rng.randint(3, 120, 8).astype(np.int32)
+    prompts = [np.concatenate([shared,
+                               rng.randint(3, 120, int(rng.randint(2, 7)))
+                                  .astype(np.int32)])
+               for _ in range(args.requests)]
+    n_ships = max(3, args.requests // 4)
+    ok, failed, hung = [], [], []
+    ship_ok, ship_failed = [], []
+    lock = threading.Lock()
+
+    def gen_worker(indices):
+        for i in indices:
+            try:
+                toks = engine.generate(prompts[i], max_new_tokens=8,
+                                       timeout=60)
+            except TimeoutError as e:
+                with lock:
+                    hung.append(e)
+            except Exception as e:
+                with lock:
+                    failed.append(type(e).__name__)
+            else:
+                with lock:
+                    ok.append(toks)
+
+    def ship_worker():
+        for i in range(n_ships):
+            try:
+                blob = engine.submit_prefill(
+                    prompts[i % args.requests][:12]).result(60)
+            except TimeoutError as e:
+                with lock:
+                    hung.append(e)
+            except Exception as e:
+                with lock:
+                    ship_failed.append(type(e).__name__)
+            else:
+                with lock:
+                    ship_ok.append(blob)
+
+    gen_workers = 3
+    threads = [threading.Thread(
+        target=gen_worker, args=(list(range(w, args.requests, gen_workers)),),
+        name=f"pt-chaos-prefix-{w}", daemon=True) for w in range(gen_workers)]
+    threads.append(threading.Thread(target=ship_worker,
+                                    name="pt-chaos-prefix-ship", daemon=True))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    # queue must still move once the faults stop
+    faults.configure("")
+    try:
+        final = engine.generate(prompts[0], max_new_tokens=8, timeout=60)
+    except Exception as e:
+        print(f"CHAOS FAIL: post-fault generation failed ({e!r}) — "
+              f"engine wedged")
+        return 2
+
+    # leg 2: refcount/page hygiene while the store is still warm
+    violations = engine.pool.audit(owned=engine.prefix_store.owned_pages())
+    reclaimed = engine.prefix_store.reclaim(1 << 20)
+    free_after = engine.pool.free_pages()
+    blocks_after = engine.prefix_store.num_blocks()
+    engine.close(drain=True, timeout=10)
+
+    raw = telemetry.counters()
+    counters = {k: int(v) - int(counters0.get(k, 0))
+                for k, v in raw.items() if isinstance(v, (int, float))}
+    injected = int(counters.get("faults.injected", 0))
+    print("-- prefix chaos tally " + "-" * 27)
+    for key in ("faults.injected", "decode.requests", "decode.prefills",
+                "kv.prefix_hits", "kv.prefix_misses", "kv.bytes_saved",
+                "kv.cow_forks", "kv.reclaims", "kv.audit_failures",
+                "disagg.ships", "disagg.ship_bytes",
+                "disagg.fallback_prefills"):
+        print(f"{key:28s} {int(counters.get(key, 0))}")
+    for site, n in sorted(faults.counts()["injected"].items()):
+        print(f"  injected@{site:18s} {n}")
+    print(f"responses: {len(ok)} ok / {len(failed)} error; ships: "
+          f"{len(ship_ok)} ok / {len(ship_failed)} error; {len(hung)} "
+          f"hung; reclaimed {reclaimed} pages, pool free {free_after} "
+          f"(baseline {baseline_free})")
+
+    if hung:
+        print(f"CHAOS FAIL: {len(hung)} requests never got a response — "
+              f"wedged queue")
+        return 2
+    if len(ok) + len(failed) != args.requests or \
+            len(ship_ok) + len(ship_failed) != n_ships:
+        print("CHAOS FAIL: lost responses")
+        return 2
+    if injected and not (failed or ship_failed):
+        print("CHAOS FAIL: faults were injected but no request saw an "
+              "error response")
+        return 2
+    if not injected:
+        print("CHAOS WARN: fault spec never fired (run too short for "
+              "the trigger?)")
+    if not ok or not np.asarray(final).size:
+        print("CHAOS FAIL: no clean generations")
+        return 2
+    if int(counters.get("kv.prefix_hits", 0)) < 1:
+        print("CHAOS FAIL: shared-prefix workload never hit the prefix "
+              "cache — sharing path untested")
+        return 2
+    if not ship_ok:
+        print("CHAOS FAIL: no shipment survived the fault window")
+        return 2
+    if violations:
+        print(f"CHAOS FAIL: pool audit violations: {violations}")
+        return 2
+    if int(counters.get("kv.audit_failures", 0)):
+        print("CHAOS FAIL: kv.audit_failures counted during the run")
+        return 2
+    if free_after != baseline_free or blocks_after != 0:
+        print(f"CHAOS FAIL: prefix store leaked pages (free {free_after} "
+              f"vs baseline {baseline_free}, {blocks_after} blocks still "
+              f"resident after a full reclaim)")
+        return 2
+
+    # leg 3: corrupted-CRC shipment at a decode-role replica — rejected,
+    # locally re-prefilled, bitwise identical to the unified answer
+    probe = prompts[0][:10].copy()
+    ref = DecodeEngine(cfg, params,
+                       DecodeConfig(max_slots=2, page_size=4, kv_pages=24,
+                                    prefill_buckets=[16],
+                                    prefix_cache=False))
+    ref.start(warmup=True)
+    dec = DecodeEngine(cfg, params,
+                       DecodeConfig(max_slots=2, page_size=4, kv_pages=24,
+                                    prefill_buckets=[16],
+                                    prefix_cache=False, role="decode",
+                                    prefill_urls=["http://127.0.0.1:9"]))
+    dec.start(warmup=True)
+    orig_fetch = disagg.fetch_prefill
+    try:
+        blob = ref.submit_prefill(probe).result(60)
+        want = ref.generate(probe, max_new_tokens=8, timeout=60)
+        bad = bytearray(blob)
+        bad[-40] ^= 0xFF
+        bad = bytes(bad)
+
+        disagg.fetch_prefill = lambda url, prompt, timeout=30.0: bad
+        c0 = dict(telemetry.counters())
+        got_bad = dec.generate(probe, max_new_tokens=8, timeout=60)
+        c1 = dict(telemetry.counters())
+        crc = int(c1.get("disagg.crc_rejects", 0)) \
+            - int(c0.get("disagg.crc_rejects", 0))
+        fb = int(c1.get("disagg.fallback_prefills", 0)) \
+            - int(c0.get("disagg.fallback_prefills", 0))
+        inst_bad = int(c1.get("disagg.installs", 0)) \
+            - int(c0.get("disagg.installs", 0))
+
+        disagg.fetch_prefill = lambda url, prompt, timeout=30.0: blob
+        got_good = dec.generate(probe, max_new_tokens=8, timeout=60)
+        c2 = dict(telemetry.counters())
+        inst_good = int(c2.get("disagg.installs", 0)) \
+            - int(c1.get("disagg.installs", 0))
+    finally:
+        disagg.fetch_prefill = orig_fetch
+        dec.close(drain=True, timeout=10)
+        ref.close(drain=True, timeout=10)
+
+    print(f"shipment leg: crc_rejects +{crc}, fallback_prefills +{fb}, "
+          f"installs +{inst_bad} (corrupt) / +{inst_good} (clean)")
+    if crc < 1 or fb < 1:
+        print("CHAOS FAIL: corrupted shipment was not rejected / not "
+              "re-prefilled locally")
+        return 2
+    if inst_bad != 0:
+        print("CHAOS FAIL: a corrupted shipment was INSTALLED into the "
+              "KV pool")
+        return 2
+    if not np.array_equal(np.asarray(got_bad), np.asarray(want)):
+        print("CHAOS FAIL: fallback output diverged from the unified "
+              "replica's (corrupt-shipment leg)")
+        return 2
+    if inst_good != 1:
+        print(f"CHAOS FAIL: clean shipment installs +{inst_good} "
+              f"(expected exactly 1)")
+        return 2
+    if not np.array_equal(np.asarray(got_good), np.asarray(want)):
+        print("CHAOS FAIL: shipped-prefill output diverged from the "
+              "unified replica's")
+        return 2
+    print(f"CHAOS OK: {args.requests} generations + {n_ships} ships, "
+          f"{len(failed) + len(ship_failed)} per-request errors from "
+          f"{injected} injected faults, pool back to baseline after "
+          f"reclaim, corrupted shipment rejected and re-prefilled "
+          f"bitwise-identically")
     return 0
 
 
@@ -1730,6 +1992,13 @@ def main():
                          "mid-generation faults must become per-request "
                          "errors with the KV page pool accounting back "
                          "to baseline")
+    ap.add_argument("--prefix", action="store_true",
+                    help="chaos-test the prefix-sharing KV store + "
+                         "disaggregated prefill plane (kv.prefix_lookup "
+                         "/ disagg.ship sites): per-request errors only, "
+                         "zero leaked pages via pool.audit, and a "
+                         "corrupted-CRC shipment rejected and locally "
+                         "re-prefilled — never served")
     ap.add_argument("--checkpoint", action="store_true",
                     help="chaos-test the crash-consistent checkpoint "
                          "protocol (ckpt.save.write/commit + "
@@ -1810,6 +2079,8 @@ def main():
         sys.exit(run_serving(args))
     if args.decode:
         sys.exit(run_decode(args))
+    if args.prefix:
+        sys.exit(run_prefix(args))
     if args.checkpoint:
         sys.exit(run_checkpoint(args))
     if args.autotune:

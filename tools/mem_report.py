@@ -105,6 +105,12 @@ def summarize_mem(recs, malformed=0):
             _num(gauges.get("mem.serving.kv_used_bytes")))
         ledger["serving_kv_high_water_bytes"] = int(
             _num(gauges.get("mem.serving.kv_high_water_bytes")))
+    kv_saved = int(_num(gauges.get("mem.serving.kv_prefix_saved_bytes")))
+    if kv_saved:
+        # prefill bytes the content-addressed prefix store skipped
+        # (serving/prefix_store.py) — a savings figure, not residency,
+        # so it never joins total_bytes
+        ledger["serving_kv_prefix_saved_bytes"] = kv_saved
 
     rows = sorted(programs.values(),
                   key=lambda a: -_num(a.get("peak_bytes"),
@@ -180,6 +186,10 @@ def render(s, out=sys.stdout):
           f"   (in use {_fmt_bytes(led['serving_kv_used_bytes'])}, "
           f"high water "
           f"{_fmt_bytes(led['serving_kv_high_water_bytes'])})\n")
+    if led.get("serving_kv_prefix_saved_bytes"):
+        w(f"{'prefix cache savings':<26}"
+          f"{_fmt_bytes(led['serving_kv_prefix_saved_bytes']):>16}"
+          f"   (prefill skipped, not resident)\n")
 
     w(f"\n-- per-program cost table: {len(s['programs'])} captured --\n")
     if s["programs"]:
@@ -260,6 +270,9 @@ def smoke() -> int:
         {"ts": 1.2, "kind": "gauge",
          "name": "mem.serving.kv_high_water_bytes", "value": 1 << 19,
          "attrs": {}},
+        {"ts": 1.2, "kind": "gauge",
+         "name": "mem.serving.kv_prefix_saved_bytes", "value": 1 << 19,
+         "attrs": {}},
         {"ts": 1.2, "kind": "cost", "name": "costmodel.executor",
          "value": 2.0e9, "attrs": {
              "key": "deadbeef", "kind": "executor", "program": "1v0",
@@ -304,6 +317,9 @@ def smoke() -> int:
               ("kv pool", s["ledger"].get("serving_kv_pool_bytes")
                == 1 << 20),
               ("kv pool rendered", "KV page pool" in text),
+              ("prefix savings", s["ledger"].get(
+                  "serving_kv_prefix_saved_bytes") == 1 << 19),
+              ("prefix savings rendered", "prefix cache savings" in text),
               ("program rows", len(s["programs"]) == 1),
               ("oom rows", len(s["ooms"]) == 1),
               ("captures", s["capture"]["captures"] == 1),

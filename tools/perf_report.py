@@ -426,6 +426,30 @@ def _decode_summary(counter_delta, counter_last, timer_summary, gauges,
                "pallas.paged_attn_fallbacks") if cval(key)}
     if pallas:
         out["pallas_kernels"] = pallas
+    # content-addressed prefix store accounting (serving/prefix_store.py):
+    # sharing rate, prefill bytes the cache skipped, copy-on-write forks,
+    # LRU reclaims, and the refcount audit verdict
+    prefix = {key.split(".", 1)[1]: int(cval(key)) for key in
+              ("kv.prefix_hits", "kv.prefix_misses", "kv.bytes_saved",
+               "kv.cow_forks", "kv.reclaims", "kv.audit_failures")
+              if cval(key)}
+    blocks = gauges.get("kv.prefix_blocks")
+    if blocks is not None:
+        prefix["prefix_blocks"] = int(blocks)
+    saved = gauges.get("mem.serving.kv_prefix_saved_bytes")
+    if saved:
+        prefix["kv_prefix_saved_bytes"] = int(saved)
+    if prefix:
+        out["prefix_store"] = prefix
+    # disaggregated prefill/decode accounting (serving/disagg.py):
+    # shipments produced, installs at the decode tier, CRC rejects and
+    # the local re-prefill fallbacks they forced
+    disagg = {key.split(".", 1)[1]: int(cval(key)) for key in
+              ("disagg.ships", "disagg.ship_bytes", "disagg.installs",
+               "disagg.crc_rejects", "disagg.fallback_prefills")
+              if cval(key)}
+    if disagg:
+        out["disagg"] = disagg
     return out
 
 
@@ -984,6 +1008,26 @@ def render(s, out=sys.stdout):
               f"paged attn {pk.get('paged_attn_dispatches', 0)} "
               f"dispatched / {pk.get('paged_attn_fallbacks', 0)} "
               f"stock-fallback\n")
+        if "prefix_store" in dc:
+            ps = dc["prefix_store"]
+            looks = ps.get("prefix_hits", 0) + ps.get("prefix_misses", 0)
+            rate = ps.get("prefix_hits", 0) / looks if looks else 0.0
+            w(f"prefix store: {ps.get('prefix_hits', 0)} hits / "
+              f"{ps.get('prefix_misses', 0)} misses ({rate:.1%}), "
+              f"{_fmt_num(ps.get('bytes_saved', 0))} B prefill skipped, "
+              f"{ps.get('cow_forks', 0)} COW forks, "
+              f"{ps.get('reclaims', 0)} reclaims"
+              + (f", {ps['prefix_blocks']} blocks resident"
+                 if "prefix_blocks" in ps else "")
+              + (f"  (AUDIT FAILURES {ps['audit_failures']})"
+                 if ps.get("audit_failures") else "") + "\n")
+        if "disagg" in dc:
+            dg = dc["disagg"]
+            w(f"disagg prefill: {dg.get('ships', 0)} shipped "
+              f"({_fmt_num(dg.get('ship_bytes', 0))} B), "
+              f"{dg.get('installs', 0)} installed, "
+              f"{dg.get('crc_rejects', 0)} CRC-rejected, "
+              f"{dg.get('fallback_prefills', 0)} local fallbacks\n")
 
     if s.get("router"):
         rt = s["router"]
