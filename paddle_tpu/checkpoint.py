@@ -385,10 +385,25 @@ class AsyncCheckpointer:
         self._ensure_thread()
         self._q.put(fn)
 
-    def wait_until_finished(self):
+    def wait_until_finished(self, timeout: Optional[float] = None) -> bool:
+        """Join the queue; with ``timeout`` the join is BOUNDED — a
+        drain path (elastic shutdown, orchestrator SIGTERM) must never
+        hang forever behind a wedged writer. Returns True when the
+        queue fully drained, False on timeout (pending saves are left
+        in flight; the atexit join still gets a chance at them).
+        Re-raises a surfaced writer failure either way."""
         if self._thread is not None:
-            self._q.join()
+            if timeout is None:
+                self._q.join()
+            else:
+                deadline = time.monotonic() + max(0.0, float(timeout))
+                while self._q.unfinished_tasks:
+                    if time.monotonic() >= deadline:
+                        self._raise_failure()
+                        return False
+                    time.sleep(0.01)
         self._raise_failure()
+        return True
 
 
 _writer = AsyncCheckpointer()
@@ -845,9 +860,10 @@ class CheckpointManager:
             scope.set(name, val)
         return int(step)
 
-    def wait_until_finished(self):
+    def wait_until_finished(self, timeout: Optional[float] = None) -> bool:
         if self.async_save:
-            _writer.wait_until_finished()
+            return _writer.wait_until_finished(timeout=timeout)
+        return True
 
     def close(self):
         self.wait_until_finished()

@@ -424,7 +424,23 @@ define_flag("disagg_prefill_urls", "",
             "comma-separated prefill-tier replica URLs a decode-role "
             "replica fetches KV page shipments from (POST /v1/prefill); "
             "empty = no tier, every prefill runs locally (the "
-            "unified-role fallback)")
+            "unified-role fallback). On the live cluster path this is "
+            "usually the ROUTER url — the router forwards /v1/prefill "
+            "to a ready prefill-tier replica, so tier membership "
+            "changes never strand a decode replica")
+define_flag("decode_journal_stride", 1,
+            "decode steps between session-journal snapshots replicated "
+            "to the router (serving/session.py): 1 journals every "
+            "accepted token (a failover never replays more than the "
+            "in-flight step), larger strides trade replication traffic "
+            "for re-generated tokens on decode-replica death; <= 0 "
+            "disables journaling")
+define_flag("decode_step_delay_ms", 0.0,
+            "deliberate per-decode-step host-side delay — a chaos/bench "
+            "pacing knob (tools/chaos_check.py --orchestrator, "
+            "bench_serving --kill-decode) that keeps generations "
+            "in-flight long enough to SIGKILL a replica mid-generation; "
+            "0 (the default) adds nothing to the serving path")
 
 # -- cluster serving control plane (paddle_tpu/serving/router.py +
 #    cluster.py: replicated engines, health-checked routing, zero-downtime
@@ -456,7 +472,13 @@ define_flag("router_dedup_capacity", 1024,
             "bound on the router's request-id dedup cache: a client retry "
             "carrying an X-Request-Id already answered replays the cached "
             "response (router.dedup_hits) instead of re-dispatching — "
-            "exactly-once serving under client retries; <= 0 disables")
+            "exactly-once serving under client retries (/v1/infer AND "
+            "/v1/generate); <= 0 disables")
+define_flag("router_session_capacity", 4096,
+            "bound on the router's decode-session journal "
+            "(serving/session.py SessionJournal): completed sessions are "
+            "popped at response time, abandoned ones age out LRU at this "
+            "capacity (session.evicted); <= 0 disables the bound")
 define_flag("serving_model_poll_s", 0.5,
             "seconds between cluster-controller polls of the published-"
             "models root (checkpoint.ModelWatcher): a new verified COMMIT "
@@ -647,6 +669,34 @@ define_flag("elastic_restart_window_s", 0.0,
             "budget: only restarts inside the window count against "
             "max_restarts, so sustained progress refunds the crash "
             "budget. 0 keeps the legacy lifetime counter")
+define_flag("elastic_drain_timeout_s", 30.0,
+            "bound on joining the async checkpoint writer when an "
+            "ElasticRunner drains under SIGTERM (distributed/elastic.py "
+            "request_drain): the final force-save is awaited at most "
+            "this long so a wedged writer cannot stall process "
+            "termination past the supervisor's kill escalation; the "
+            "atomic rename commit still guarantees no torn checkpoint "
+            "is ever restored")
+define_flag("orch_max_restarts", 3,
+            "per-child respawn budget of the supervising launcher "
+            "(distributed/launch.py Orchestrator): a trainer/pserver "
+            "subprocess that dies is relaunched up to this many times "
+            "inside orch_restart_window_s; exhaustion raises the typed "
+            "RestartBudgetExhaustedError instead of respawn-looping")
+define_flag("orch_restart_window_s", 0.0,
+            "sliding window (seconds) for the orchestrator's per-child "
+            "restart budget — same refund semantics as "
+            "elastic_restart_window_s (orch.restart_budget_refunds); "
+            "0 = lifetime counter")
+define_flag("orch_ready_timeout_s", 30.0,
+            "seconds the orchestrator waits for a child's "
+            "PT_ORCH_READY announce line before treating the spawn as "
+            "failed; <= 0 skips the ready wait (children that never "
+            "announce are supervised from spawn)")
+define_flag("orch_drain_timeout_s", 15.0,
+            "seconds between the orchestrator's SIGTERM drain command "
+            "and SIGKILL escalation — the child's window to finish its "
+            "bounded final checkpoint and exit 0")
 define_flag("scaler_min_world", 1,
             "lower bound on the world size a ScalerPolicy may target — "
             "ScaleDown decisions clamp here (scaler.clamped counter)")

@@ -511,7 +511,8 @@ def report_incident(source: str, name: str, value=None,
                     context: Optional[Dict[str, Any]] = None,
                     rule: Optional[Dict[str, Any]] = None,
                     legacy_kind: Optional[str] = None,
-                    now: Optional[float] = None) -> Optional[str]:
+                    now: Optional[float] = None,
+                    rate_limit: bool = True) -> Optional[str]:
     """Route one anomaly through the unified pipeline.
 
     * ``legacy_kind`` set (oom / stall / thread_error): the original
@@ -523,6 +524,12 @@ def report_incident(source: str, name: str, value=None,
       snapshot, the HBM ledger, recently-active trace ids, and the
       rule/legacy context.
 
+    ``rate_limit=False`` exempts this report from the window entirely —
+    process-death events (orchestrator child deaths, cluster replica
+    deaths) must EACH land in the ledger even back-to-back — and leaves
+    the window's bookkeeping untouched, so an exempt report never
+    starves a rate-limited one.
+
     Returns the incident id, or None when the dump was rate-limited.
     """
     if now is None:
@@ -531,12 +538,16 @@ def report_incident(source: str, name: str, value=None,
         telemetry.event(legacy_kind, name, value, dict(context or {}))
     allowed = False
     with _incident_lock:
-        limit = float(_flags.flag("incident_rate_limit_s"))
-        if now - _last_incident_ts[0] >= limit:
-            _last_incident_ts[0] = now
+        if rate_limit:
+            limit = float(_flags.flag("incident_rate_limit_s"))
+            if now - _last_incident_ts[0] >= limit:
+                _last_incident_ts[0] = now
+                allowed = True
+        else:
+            allowed = True
+        if allowed:
             _incident_seq[0] += 1
             incident_id = f"inc-{int(now)}-{_incident_seq[0]:04d}"
-            allowed = True
     if not allowed:
         telemetry.counter_quiet("incidents.rate_limited")
         return None

@@ -48,6 +48,8 @@ checkpoint_notify flow without the pserver middleman.
 from __future__ import annotations
 
 import logging
+import signal as _signal
+import threading
 import time
 from collections import deque
 from typing import Callable, Optional, Tuple
@@ -57,6 +59,75 @@ from ..core import telemetry
 from .errors import RpcError
 
 _LOG = logging.getLogger("paddle_tpu.elastic")
+
+
+class RestartBudgetExhaustedError(RuntimeError):
+    """The windowed restart budget is spent: ``used`` restarts landed
+    inside ``window_s`` (or lifetime, with no window) against a budget
+    of ``max_restarts``. A supervisor that sees this must STOP
+    respawning — the failure is systematic, not transient."""
+
+    def __init__(self, used: int, max_restarts: int, window_s: float,
+                 last_error: str = ""):
+        self.used = int(used)
+        self.max_restarts = int(max_restarts)
+        self.window_s = float(window_s)
+        self.last_error = last_error
+        window = f" inside {window_s:.0f}s" if window_s > 0 else ""
+        detail = f" (last: {last_error})" if last_error else ""
+        super().__init__(
+            f"restart budget exhausted: {used} restarts{window} against "
+            f"max_restarts={max_restarts}{detail}")
+
+
+class RestartBudget:
+    """Sliding-window crash budget, shared by ElasticRunner (in-process
+    restore-restart) and the launch.py orchestrator (child respawn).
+    With ``window_s`` <= 0 the budget is a lifetime counter; otherwise
+    only restarts inside the window count — pruning expired entries IS
+    the refund for sustained progress (reported to ``on_refund`` so
+    each owner counts refunds on its own metric name)."""
+
+    def __init__(self, max_restarts: int, window_s: float = 0.0,
+                 on_refund: Optional[Callable[[int], None]] = None):
+        self.max_restarts = int(max_restarts)
+        self.window_s = float(window_s)
+        self.on_refund = on_refund
+        self.times: deque = deque()
+        self.lifetime = 0
+
+    def used(self, now: Optional[float] = None) -> int:
+        if self.window_s <= 0:
+            return self.lifetime
+        if now is None:
+            now = time.monotonic()
+        cut = now - self.window_s
+        refunded = 0
+        while self.times and self.times[0] < cut:
+            self.times.popleft()
+            refunded += 1
+        if refunded and self.on_refund is not None:
+            self.on_refund(refunded)
+        return len(self.times)
+
+    def note(self, now: Optional[float] = None) -> int:
+        """Charge one restart; returns the post-charge used count."""
+        if now is None:
+            now = time.monotonic()
+        self.lifetime += 1
+        self.times.append(now)
+        return self.used(now)
+
+    def exhausted(self, now: Optional[float] = None) -> bool:
+        return self.used(now) > self.max_restarts
+
+    def check(self, now: Optional[float] = None, last_error: str = ""):
+        """Raise RestartBudgetExhaustedError when over budget."""
+        used = self.used(now)
+        if used > self.max_restarts:
+            raise RestartBudgetExhaustedError(
+                used, self.max_restarts, self.window_s,
+                last_error=last_error)
 
 # error types worth a restart: transport failures (RpcError covers
 # RpcDeadlineError/RpcRemoteError — retries exhausted, deadlines blown,
@@ -94,11 +165,21 @@ class ElasticRunner:
         self.restart_window_s = float(
             _flags.flag("elastic_restart_window_s")
             if restart_window_s is None else restart_window_s)
-        self._restart_times: deque = deque()
+        self._budget = RestartBudget(
+            self.max_restarts, self.restart_window_s,
+            on_refund=lambda n: telemetry.counter_add(
+                "elastic.restart_budget_refunds", n))
+        # alias, not a copy: tests (and budget_used) poke the deque
+        self._restart_times = self._budget.times
         self.world_size = int(world_size)
         self.scaler = scaler
         self.on_scale = on_scale
         self.scale_events = 0
+        # cooperative drain (orchestrator SIGTERM path): the loop
+        # force-saves at the next step boundary, bound-joins the async
+        # writer, and returns instead of raising
+        self._drain = threading.Event()
+        self.drained_at: Optional[int] = None
 
     def _recoverable_exc(self, e: BaseException) -> bool:
         """True if e — or anything on its explicit cause chain — is a
@@ -121,17 +202,7 @@ class ElasticRunner:
         pruning expired entries IS the refund for sustained progress."""
         if self.restart_window_s <= 0:
             return self.restarts
-        if now is None:
-            now = time.monotonic()
-        cut = now - self.restart_window_s
-        refunded = 0
-        while self._restart_times and self._restart_times[0] < cut:
-            self._restart_times.popleft()
-            refunded += 1
-        if refunded:
-            telemetry.counter_add("elastic.restart_budget_refunds",
-                                  refunded)
-        return len(self._restart_times)
+        return self._budget.used(now)
 
     def _note_restart(self, step: int, exc: BaseException) -> int:
         """Count one restart against the budget; returns the charged
@@ -141,7 +212,7 @@ class ElasticRunner:
 
         now = time.monotonic()
         self.restarts += 1
-        self._restart_times.append(now)
+        self._budget.note(now)
         telemetry.counter_add("elastic.restarts", 1, step=step,
                               exc=type(exc).__name__)
         incidents.report_scale_event(
@@ -149,6 +220,48 @@ class ElasticRunner:
             reason=type(exc).__name__,
             attrs={"step": int(step), "restarts": self.restarts})
         return self.budget_used(now)
+
+    # -- cooperative drain ---------------------------------------------------
+    def request_drain(self):
+        """Ask the step loop to stop at the NEXT step boundary: force-
+        checkpoint, bound-join the async writer, return cleanly. Safe
+        from signal handlers and other threads (one Event.set)."""
+        self._drain.set()
+
+    @property
+    def draining(self) -> bool:
+        return self._drain.is_set()
+
+    def install_signal_handlers(self, signals=(_signal.SIGTERM,
+                                               _signal.SIGINT)):
+        """Wire SIGTERM/SIGINT to request_drain() — the orchestrator's
+        graceful-stop contract for trainer children. Main thread only
+        (signal.signal's own constraint). Returns self."""
+        for sig in signals:
+            _signal.signal(sig, lambda _s, _f: self.request_drain())
+        return self
+
+    def _execute_drain(self, step: int) -> bool:
+        """Force-save and BOUND-join the async writer (FLAGS_elastic_
+        drain_timeout_s): a SIGTERM'd trainer must make its checkpoint
+        durable before the supervisor's kill-escalation deadline, and a
+        wedged writer must not turn a drain into a hang. Returns True
+        when the writer fully drained."""
+        timeout = float(_flags.flag("elastic_drain_timeout_s"))
+        try:
+            self.mgr.save(step, self.program, self.scope,
+                          extras=self._extras(), force=True)
+        except self.recoverable as e:
+            _LOG.warning("elastic: drain checkpoint at step %d failed: "
+                         "%r", step, e)
+        ok = self.mgr.wait_until_finished(timeout=timeout)
+        if not ok:
+            telemetry.counter_add("elastic.drain_timeouts", 1, step=step)
+            _LOG.error("elastic: async writer still busy after %.1fs "
+                       "drain timeout at step %d", timeout, step)
+        telemetry.counter_add("elastic.drains", 1, step=step)
+        self.drained_at = int(step)
+        return ok
 
     # -- exact-resume extras -------------------------------------------------
     def _extras(self) -> dict:
@@ -258,6 +371,9 @@ class ElasticRunner:
         result = None
         try:
             while step < num_steps:
+                if self._drain.is_set():
+                    self._execute_drain(step)
+                    break
                 try:
                     result = step_fn(step)
                     step += 1
@@ -287,8 +403,14 @@ class ElasticRunner:
         finally:
             # teardown join: process exit must not truncate an in-flight
             # async save (the checkpoint module's atexit hook is the
-            # last-resort backstop; this is the orderly path)
-            self.mgr.wait_until_finished()
+            # last-resort backstop; this is the orderly path). A drain
+            # already bound-joined; don't let a wedged writer hang the
+            # drain exit unboundedly on top of that.
+            if self._drain.is_set():
+                self.mgr.wait_until_finished(
+                    timeout=float(_flags.flag("elastic_drain_timeout_s")))
+            else:
+                self.mgr.wait_until_finished()
         return result
 
     def close(self):

@@ -315,3 +315,51 @@ class SLOScalerPolicy(ScalerPolicy):
             if name in firing:
                 return (SCALE_DOWN, world - self.step, name)
         return None
+
+
+class ResizeSchedule:
+    """Deterministic step-triggered resize plan for the launch.py
+    orchestrator: ``"step:world,step:world"`` (e.g. ``"4:3,8:2"`` —
+    grow to 3 trainers once any trainer reports step 4, shrink back to
+    2 at step 8). Entries fire once each, in step order; the
+    orchestrator polls :meth:`next_target` with the max observed
+    trainer step between supervision passes. Malformed specs raise at
+    parse time — a silently-dropped resize plan is worse than a loud
+    one."""
+
+    def __init__(self, spec: str = "",
+                 entries: Optional[list] = None):
+        plan = []
+        if entries is not None:
+            plan = [(int(s), int(w)) for s, w in entries]
+        else:
+            for part in str(spec or "").split(","):
+                part = part.strip()
+                if not part:
+                    continue
+                step_s, sep, world_s = part.partition(":")
+                if not sep:
+                    raise ValueError(
+                        f"ResizeSchedule: entry {part!r} is not "
+                        f"'step:world'")
+                plan.append((int(step_s), int(world_s)))
+        for _, world in plan:
+            if world < 1:
+                raise ValueError("ResizeSchedule: world must be >= 1")
+        self._plan = sorted(plan)
+        self.executed: list = []
+
+    def pending(self) -> list:
+        return list(self._plan)
+
+    def next_target(self, step: int) -> Optional[int]:
+        """World size to resize to once ``step`` has been reached, or
+        None. Consumes every entry whose trigger step has passed and
+        returns the LAST one — a supervisor that stalled past two
+        triggers jumps straight to the final world."""
+        target = None
+        while self._plan and step >= self._plan[0][0]:
+            entry = self._plan.pop(0)
+            self.executed.append(entry)
+            target = entry[1]
+        return target

@@ -65,7 +65,9 @@ class ReplicaProcess:
                  env: Optional[Dict[str, str]] = None,
                  serving_config=None, telemetry_log: str = "",
                  ready_timeout_s: float = 120.0, role: str = "unified",
-                 **_ignored):
+                 decode_model_dir: Optional[str] = None,
+                 prefill_urls: str = "", prefix_cache: bool = False,
+                 journal_url: str = "", **_ignored):
         self.name = name
         self.model_root = model_root
         self.env = env
@@ -75,6 +77,12 @@ class ReplicaProcess:
         # disaggregated-serving tier (serving/disagg.py); forwarded to
         # the replica process and the router's affinity pick
         self.role = str(role or "unified")
+        # generative replica (serving/decode.py): serve --decode-model-dir
+        # over /v1/generate instead of a predictor over /v1/infer
+        self.decode_model_dir = decode_model_dir
+        self.prefill_urls = prefill_urls
+        self.prefix_cache = bool(prefix_cache)
+        self.journal_url = journal_url
         self.proc: Optional[subprocess.Popen] = None
         self.url: Optional[str] = None
         self.version: Optional[int] = None
@@ -88,13 +96,24 @@ class ReplicaProcess:
             os.path.abspath(__file__))))
         env["PYTHONPATH"] = repo_root + os.pathsep + \
             env.get("PYTHONPATH", "")
-        cmd = [sys.executable, "-m", "paddle_tpu.serving.replica",
-               "--model-root", self.model_root, "--port", "0"]
-        if self.serving_config is not None:
-            cmd += ["--max-batch-size",
-                    str(self.serving_config.max_batch_size),
-                    "--batch-timeout-ms",
-                    str(self.serving_config.batch_timeout_ms)]
+        if self.decode_model_dir:
+            cmd = [sys.executable, "-m", "paddle_tpu.serving.replica",
+                   "--decode-model-dir", self.decode_model_dir,
+                   "--port", "0"]
+            if self.prefill_urls:
+                cmd += ["--prefill-urls", self.prefill_urls]
+            if self.prefix_cache:
+                cmd += ["--prefix-cache"]
+            if self.journal_url and self.role != "prefill":
+                cmd += ["--journal-url", self.journal_url]
+        else:
+            cmd = [sys.executable, "-m", "paddle_tpu.serving.replica",
+                   "--model-root", self.model_root, "--port", "0"]
+            if self.serving_config is not None:
+                cmd += ["--max-batch-size",
+                        str(self.serving_config.max_batch_size),
+                        "--batch-timeout-ms",
+                        str(self.serving_config.batch_timeout_ms)]
         if self.telemetry_log:
             cmd += ["--telemetry-log", self.telemetry_log]
         if self.role != "unified":
@@ -167,11 +186,20 @@ class InprocReplica:
     surface as ReplicaProcess at a fraction of the startup cost."""
 
     def __init__(self, name: str, model_root: str, serving_config=None,
-                 role: str = "unified", **_ignored):
+                 role: str = "unified",
+                 decode_model_dir: Optional[str] = None,
+                 prefill_urls: str = "", prefix_cache: bool = False,
+                 journal_sink=None, **_ignored):
         self.name = name
         self.model_root = model_root
         self.serving_config = serving_config
         self.role = str(role or "unified")
+        self.decode_model_dir = decode_model_dir
+        self.prefill_urls = prefill_urls
+        self.prefix_cache = bool(prefix_cache)
+        # in-process replicas journal straight into the router's
+        # SessionJournal — same records, no HTTP hop
+        self.journal_sink = journal_sink
         self.engine = None
         self.server = None
         self.url: Optional[str] = None
@@ -179,9 +207,27 @@ class InprocReplica:
         self._stopped = False
 
     def spawn(self):
+        from .server import ServingHTTPServer
+
+        if self.decode_model_dir:
+            from .decode import DecodeConfig, decode_engine_from_dir
+
+            config = DecodeConfig(role=self.role,
+                                  prefill_urls=self.prefill_urls,
+                                  prefix_cache=self.prefix_cache or None)
+            self.engine = decode_engine_from_dir(self.decode_model_dir,
+                                                 config=config)
+            if self.journal_sink is not None and self.role != "prefill":
+                self.engine.journal_sink = self.journal_sink
+            self.server = ServingHTTPServer(
+                None, decode_engine=self.engine).start()
+            self.url = self.server.url
+            self.version = self.engine.version
+            self.engine.start(warmup=True)
+            self._stopped = False
+            return self
         from ..inference import AnalysisConfig, create_predictor
         from .engine import ServingEngine
-        from .server import ServingHTTPServer
 
         newest = _ckpt.ModelWatcher(self.model_root).latest()
         if newest is None:
@@ -246,8 +292,11 @@ class ClusterController:
                  replica_telemetry_dir: str = "",
                  auto_swap: bool = True,
                  fleet: Optional[bool] = None,
-                 roles: Optional[List[str]] = None):
-        self.model_root = os.path.abspath(model_root)
+                 roles: Optional[List[str]] = None,
+                 decode_model_dir: Optional[str] = None,
+                 role_counts: Optional[Dict[str, int]] = None,
+                 prefix_cache: bool = False):
+        self.model_root = os.path.abspath(model_root) if model_root else ""
         self.n_replicas = int(replicas)
         self.inprocess = bool(inprocess)
         self.serving_config = serving_config
@@ -265,6 +314,32 @@ class ClusterController:
         # drive the router's role-aware prefix-affinity pick; default is
         # an all-unified fleet
         self.roles = [str(r) for r in roles] if roles else []
+        # generative cluster (serving/decode.py): replicas serve
+        # /v1/generate from this servable dir instead of running
+        # predictors over model_root; decode-role replicas are wired to
+        # journal sessions to the router and pull prefill shipments
+        # through it (forward_prefill), so a respawned survivor can
+        # resume any journaled session
+        self.decode_model_dir = os.path.abspath(decode_model_dir) \
+            if decode_model_dir else None
+        self.prefix_cache = bool(prefix_cache)
+        # role_counts is the TIER view of the fleet ({"prefill": 1,
+        # "decode": 2}): it fixes the initial role plan AND gives
+        # scale_tier() a per-role target that survives respawns. A
+        # plain roles=[...] list keeps the legacy cycling behaviour.
+        self.role_counts: Optional[Dict[str, int]] = \
+            {str(k): int(v) for k, v in role_counts.items()} \
+            if role_counts else None
+        if self.role_counts is not None:
+            plan: List[str] = []
+            for r in sorted(self.role_counts):
+                plan.extend([r] * self.role_counts[r])
+            self.roles = plan
+            self.n_replicas = len(plan)
+        # slot → role registry: a respawn keeps the role its slot was
+        # provisioned with even after tier scaling reshapes the modulo
+        # cycling that assigned it
+        self._slot_roles: Dict[int, str] = {}
         self.router = router or Router()
         self.router_server = RouterHTTPServer(self.router, host=host,
                                               port=router_port)
@@ -296,30 +371,54 @@ class ClusterController:
     def url(self) -> str:
         return self.router_server.url
 
-    def _make_replica(self, index: int):
+    def _make_replica(self, index: int, role: Optional[str] = None):
         name = f"replica-{index}"
         log = ""
         if self.replica_telemetry_dir:
             log = os.path.join(self.replica_telemetry_dir,
                                f"{name}.jsonl")
         cls = InprocReplica if self.inprocess else ReplicaProcess
-        role = self.roles[index % len(self.roles)] if self.roles \
-            else "unified"
+        if role is None:
+            role = self._slot_roles.get(index)
+        if role is None:
+            role = self.roles[index % len(self.roles)] if self.roles \
+                else "unified"
+        self._slot_roles[index] = role
+        extra: Dict[str, Any] = {}
+        if self.decode_model_dir:
+            extra["decode_model_dir"] = self.decode_model_dir
+            extra["prefix_cache"] = self.prefix_cache
+            if role == "decode":
+                # pull shipments THROUGH the router (forward_prefill):
+                # the replica never needs to track prefill-tier
+                # membership — respawns and tier scaling stay invisible
+                extra["prefill_urls"] = self.url
+            if role != "prefill":
+                # RouterHTTPServer binds its port at construction, so
+                # the journal endpoint is known before any spawn
+                extra["journal_url"] = self.url + "/v1/session/journal"
+                extra["journal_sink"] = self.router.sessions.update
         return cls(name, self.model_root, env=self.replica_env,
                    serving_config=self.serving_config,
-                   telemetry_log=log, role=role)
+                   telemetry_log=log, role=role, **extra)
 
     def start(self, ready_timeout_s: float = 120.0) -> "ClusterController":
-        self._watcher = _ckpt.ModelWatcher(self.model_root)
-        newest = self._watcher.poll()
-        if newest is None:
-            raise ClusterError(f"no verified published model under "
-                               f"{self.model_root} — publish_model() one "
-                               f"before starting the cluster")
-        # current_version is owned by the swap lock: the monitor/watch
-        # threads (spawned below) read and roll it under the same lock
-        with self._swap_lock:
-            self.current_version = newest[0]
+        if self.decode_model_dir:
+            # generative fleet: the servable dir IS the model — no
+            # published-versions root, no rolling-swap watcher
+            self.auto_swap = False
+        else:
+            self._watcher = _ckpt.ModelWatcher(self.model_root)
+            newest = self._watcher.poll()
+            if newest is None:
+                raise ClusterError(f"no verified published model under "
+                                   f"{self.model_root} — publish_model() "
+                                   f"one before starting the cluster")
+            # current_version is owned by the swap lock: the monitor/
+            # watch threads (spawned below) read and roll it under the
+            # same lock
+            with self._swap_lock:
+                self.current_version = newest[0]
         for _ in range(self.n_replicas):
             replica = self._make_replica(self._next_index)
             self._next_index += 1
@@ -393,6 +492,22 @@ class ClusterController:
                     self._counted_dead.add(id(replica))
                     telemetry.counter_add("router.replica_deaths", 1,
                                           replica=replica.name)
+                    # exactly ONE incident record per death, exempt from
+                    # the rate-limit window like oom/stall — two replicas
+                    # dying back-to-back must both land in the ledger
+                    from ..core import incidents as _incidents
+
+                    rc = getattr(getattr(replica, "proc", None),
+                                 "returncode", None)
+                    _incidents.report_incident(
+                        "cluster", "replica_death", 1.0,
+                        context={"replica": replica.name,
+                                 "role": getattr(replica, "role",
+                                                 "unified"),
+                                 "exit_code": rc,
+                                 "signal": -rc if isinstance(rc, int)
+                                 and rc < 0 else None},
+                        rate_limit=False)
                 if self.inprocess:
                     continue   # tests kill in-proc replicas on purpose
                 if self._restarts[replica.name] >= self.max_restarts:
@@ -429,6 +544,13 @@ class ClusterController:
                     if handle is not None:
                         handle.rebind(fresh.url)
                         self.router.probe(handle)
+                    role = getattr(fresh, "role", "unified")
+                    if role in ("decode", "prefill"):
+                        # tier membership changed: the router's prefix-
+                        # affinity hash now maps some sessions elsewhere
+                        telemetry.counter_add("router.affinity_remaps",
+                                              1, role=role,
+                                              reason="respawn")
                     if self.fleet_aggregator is not None:
                         # a respawn keeps its fleet slot — re-point the
                         # scrape at the fresh endpoint
@@ -663,6 +785,76 @@ class ClusterController:
             direction="up" if n > old else "down", replicas=n)
         _incidents.report_scale_event(
             "cluster", "resize", old, n, reason=reason)
+        return n
+
+    def tier_members(self, role: str) -> List[Any]:
+        """Live replicas provisioned into ``role`` (slot registry order)."""
+        return [r for r in self.replicas
+                if getattr(r, "role", "unified") == str(role)]
+
+    def scale_tier(self, role: str, n: int, reason: str = "manual",
+                   ready_timeout_s: float = 60.0) -> int:
+        """Grow or shrink ONE role tier (prefill / decode / unified) to
+        exactly ``n`` replicas, leaving the other tiers untouched — the
+        serving-side analogue of a per-tier resize. New slots are
+        provisioned with the requested role and keep it across respawns
+        (the slot registry), so a prefill tier is supervised exactly
+        like decode replicas. Returns the tier's new size."""
+        from ..core import incidents as _incidents
+
+        role = str(role)
+        n = int(n)
+        if n < 0:
+            raise ClusterError("scale_tier: need n >= 0")
+        with self._swap_lock:
+            members = self.tier_members(role)
+            old = len(members)
+            if n == old:
+                return old
+            if n > old:
+                for _ in range(n - old):
+                    replica = self._make_replica(self._next_index,
+                                                 role=role)
+                    self._next_index += 1
+                    replica.spawn()
+                    self.replicas.append(replica)
+                    self._restarts[replica.name] = 0
+                    self._handles[replica.name] = self.router.add_replica(
+                        replica.name, replica.url, role=role)
+                    if self.fleet_aggregator is not None:
+                        self.fleet_aggregator.register(replica.name,
+                                                       replica.url)
+                deadline = time.monotonic() + ready_timeout_s
+                while time.monotonic() < deadline:
+                    for handle in self.router.handles():
+                        if not handle.ready:
+                            self.router.probe(handle)
+                    if all(h.ready for h in self.router.handles()):
+                        break
+                    time.sleep(0.05)  # pt-lint: disable=blocking-call-under-lock(tier transitions serialise with rolls on purpose; bounded by ready_timeout_s)
+            else:
+                for _ in range(old - n):
+                    victim = self.tier_members(role)[-1]
+                    # pt-lint: disable=blocking-call-under-lock(the zero-downtime invariant: a peer must be ready before this replica leaves the fleet)
+                    self._await_peer_ready(victim.name, timeout_s=30.0)
+                    self._retired.add(id(victim))
+                    self.replicas.remove(victim)
+                    self._handles.pop(victim.name, None)
+                    self.router.remove_replica(victim.name)
+                    victim.stop()
+                    if self.fleet_aggregator is not None:
+                        self.fleet_aggregator.deregister(victim.name)
+            self.n_replicas = len(self.replicas)
+            if self.role_counts is not None:
+                self.role_counts[role] = n
+        telemetry.counter_add(
+            "router.scale_events", 1,
+            direction="up" if n > old else "down", tier=role, replicas=n)
+        if role in ("decode", "prefill"):
+            telemetry.counter_add("router.affinity_remaps", 1, role=role,
+                                  reason="scale_tier")
+        _incidents.report_scale_event(
+            "cluster", f"resize_{role}", old, n, reason=reason)
         return n
 
     def attach_scaler(self, policy) -> "ClusterController":

@@ -185,22 +185,35 @@ class GenerationRequest(InferenceRequest):
     __slots__ = ("prompt", "max_new_tokens", "temperature", "seed",
                  "eos_id", "tokens", "token_walls", "t_submit", "t_first",
                  "pages", "table_row", "pos_next", "last_token",
-                 "shared_blocks", "_rng")
+                 "shared_blocks", "_rng", "session_id", "prior", "seq",
+                 "stop_at_eos")
 
     def __init__(self, prompt: np.ndarray, max_new_tokens: int,
                  deadline: Optional[float], temperature: float = 0.0,
                  seed: Optional[int] = None, eos_id: Optional[int] = None,
-                 trace: Optional[Any] = None):
+                 trace: Optional[Any] = None,
+                 session_id: Optional[str] = None,
+                 prior: Optional[np.ndarray] = None):
         super().__init__({"prompt": prompt}, 1, deadline, trace=trace)
         self.prompt = prompt
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.seed = seed
         self.eos_id = eos_id
+        self.stop_at_eos = eos_id is not None
         self.tokens: List[int] = []
         self.token_walls: List[float] = []
         self.t_submit = time.monotonic()
         self.t_first: Optional[float] = None
+        # session-failover identity (serving/session.py): ``prior`` is
+        # the accepted tokens from a previous replica life — the engine
+        # prefills ``seq`` (prompt + prior) and generates only the
+        # remainder; the router re-joins the full stream
+        self.session_id = session_id
+        self.prior = (np.zeros(0, np.int32) if prior is None
+                      else np.asarray(prior, np.int32).reshape(-1))
+        self.seq = (prompt if self.prior.size == 0
+                    else np.concatenate([prompt, self.prior]))
         # engine-side slot state (worker-thread-owned once admitted)
         self.pages: List[int] = []
         self.table_row: Optional[np.ndarray] = None
@@ -241,6 +254,36 @@ class GenerationRequest(InferenceRequest):
         return bool(self.tokens) and (
             len(self.tokens) >= self.max_new_tokens
             or (self.eos_id is not None and self.tokens[-1] == self.eos_id))
+
+    def journal_record(self, page_size: int,
+                       now: Optional[float] = None) -> Dict[str, Any]:
+        """Snapshot everything a survivor needs to continue this
+        generation bitwise-identically (serving/session.py): the prompt
+        (plus its page-chain hash for affinity), EVERY accepted token —
+        prior lives included — the sampler RNG state after those draws,
+        and the deadline remainder. Engine-thread-only (reads _rng)."""
+        from .prefix_store import prefix_chain_hash
+
+        rem = None
+        if self.deadline is not None:
+            rem = max(0.0, (self.deadline
+                            - (time.monotonic() if now is None else now))
+                      * 1e3)
+        from .session import pack_rng_state
+
+        return {
+            "request_id": self.session_id,
+            "prompt": [int(t) for t in self.prompt],
+            "prefix_hash": prefix_chain_hash(self.prompt, page_size),
+            "accepted": [int(t) for t in self.prior] + list(self.tokens),
+            "max_new_total": int(self.prior.size) + self.max_new_tokens,
+            "temperature": self.temperature,
+            "seed": self.seed,
+            "stop_at_eos": self.stop_at_eos,
+            "rng_state": pack_rng_state(self._rng)
+            if self.temperature > 0 else None,
+            "deadline_remaining_ms": rem,
+        }
 
 
 class ShipPrefillRequest(InferenceRequest):
@@ -296,29 +339,47 @@ class DecodeEngine:
         self._thread: Optional[threading.Thread] = None
         self.health = HealthState()
         self.version = int(version)
+        # session-failover journal (serving/session.py): a callable
+        # taking a list of journal records — in-process the router's
+        # SessionJournal.update, cross-process an HTTP POST. None (the
+        # default) disables journaling entirely.
+        self.journal_sink = None
+        self._journal_stride = int(_flag("decode_journal_stride"))
 
     # -- client surface ------------------------------------------------------
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                deadline_ms: Optional[float] = None,
                temperature: float = 0.0, seed: Optional[int] = None,
-               stop_at_eos: bool = True) -> GenerationRequest:
+               stop_at_eos: bool = True,
+               request_id: Optional[str] = None,
+               prior_tokens: Optional[Sequence[int]] = None,
+               rng_state: Optional[Any] = None) -> GenerationRequest:
         """Enqueue one generation (non-blocking). ``prompt`` is a 1-D
         int token-id array. Raises ValueError (malformed / over the
         model length), KVCacheExhaustedError (can never fit the KV
-        pool), ServerOverloadedError, EngineClosedError."""
+        pool), ServerOverloadedError, EngineClosedError.
+
+        ``request_id`` opts the request into session journaling
+        (serving/session.py). ``prior_tokens``/``rng_state`` re-admit a
+        journaled session after its replica died: the engine prefills
+        prompt+prior (prefix-hit or chunked cold re-prefill — bitwise
+        the same KV either way), restores the sampler RNG mid-stream
+        and generates only the remaining ``max_new_tokens``."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt needs at least one token")
+        prior = (np.zeros(0, np.int32) if prior_tokens is None
+                 else np.asarray(prior_tokens, np.int32).reshape(-1))
         if max_new_tokens is None:
             max_new_tokens = self.config.max_new_tokens
         max_new_tokens = int(max_new_tokens)
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, "
                              f"got {max_new_tokens}")
-        total = int(prompt.size) + max_new_tokens
+        total = int(prompt.size) + int(prior.size) + max_new_tokens
         if total > self.model_cfg.max_seq_len:
             raise ValueError(
-                f"prompt ({prompt.size}) + max_new_tokens "
+                f"prompt ({prompt.size + prior.size}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds the model's max_seq_len "
                 f"({self.model_cfg.max_seq_len})")
         # typed would-OOM refusal BEFORE the request enters the queue
@@ -326,7 +387,16 @@ class DecodeEngine:
         req = GenerationRequest(
             prompt, max_new_tokens, self.queue.deadline_for(deadline_ms),
             temperature=temperature, seed=seed,
-            eos_id=self.model_cfg.eos_id if stop_at_eos else None)
+            eos_id=self.model_cfg.eos_id if stop_at_eos else None,
+            session_id=request_id, prior=prior)
+        if rng_state is not None:
+            from .session import unpack_rng_state
+
+            req._rng = unpack_rng_state(rng_state)
+        if prior.size:
+            telemetry.counter_add("session.resumed", 1)
+            telemetry.counter_add("session.resumed_tokens",
+                                  int(prior.size))
         self.queue.submit_request(req)
         return req
 
@@ -542,6 +612,7 @@ class DecodeEngine:
                 self._admit()
                 if self._active:
                     self._run_step()
+                    self._journal_tick()
             except BaseException as e:   # the loop must outlive any step
                 telemetry.counter_add("decode.errors",
                                       max(1, len(self._active)),
@@ -582,7 +653,7 @@ class DecodeEngine:
             shared: List[int] = []
             if self.prefix_store is not None:
                 try:
-                    hashes, shared = self.prefix_store.lookup(req.prompt)
+                    hashes, shared = self.prefix_store.lookup(req.seq)
                 except Exception as e:
                     telemetry.counter_add("decode.errors", 1,
                                           exc=type(e).__name__)
@@ -591,7 +662,7 @@ class DecodeEngine:
                                  f"prefix lookup failed: {e!r}"))
                     continue
             need = self.pool.pages_for_tokens(
-                int(req.prompt.size) + req.max_new_tokens) - len(hashes)
+                int(req.seq.size) + req.max_new_tokens) - len(hashes)
             try:
                 pages = self.pool.try_alloc(need)
                 if not pages and self.prefix_store is not None:
@@ -641,14 +712,14 @@ class DecodeEngine:
                                          shared or [])
         import jax.numpy as jnp
 
-        L = int(req.prompt.size)
+        L = int(req.seq.size)
         bucket = next(b for b in self.config.prefill_buckets if b >= L)
         req.pages = pages
         row = np.zeros(self._mp, np.int32)
         row[:len(pages)] = pages
         req.table_row = row
         tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :L] = req.prompt
+        tokens[0, :L] = req.seq
         oh = np.zeros((1, bucket), np.float32)
         oh[0, L - 1] = 1.0
         feed = {"tokens": jnp.asarray(tokens),
@@ -680,7 +751,7 @@ class DecodeEngine:
         them."""
         import jax.numpy as jnp
 
-        L = int(req.prompt.size)
+        L = int(req.seq.size)
         P = self.config.page_size
         k = len(hashes)
         req.pages = pages
@@ -697,7 +768,7 @@ class DecodeEngine:
                 lo = ci * P
                 n = min(L, lo + P) - lo
                 tokens = np.zeros((1, P), np.int32)
-                tokens[0, :n] = req.prompt[lo:lo + n]
+                tokens[0, :n] = req.seq[lo:lo + n]
                 positions = np.clip(lo + np.arange(P, dtype=np.int32), 0,
                                     self.model_cfg.max_seq_len - 1)
                 oh = np.zeros((1, P), np.float32)
@@ -720,7 +791,7 @@ class DecodeEngine:
         n_full = L // P
         if n_full > k:
             held, canon = self.prefix_store.insert(
-                req.prompt, [int(p) for p in row[:n_full]], start_block=k)
+                req.seq, [int(p) for p in row[:n_full]], start_block=k)
             row[k:n_full] = canon
             req.shared_blocks.extend(held)
             req.pages = pages[n_full - k:]
@@ -800,12 +871,12 @@ class DecodeEngine:
         urls = self.config.prefill_urls
         pages: List[int] = []
         try:
-            url = urls[zlib.crc32(req.prompt.tobytes()) % len(urls)]
-            blob = disagg.fetch_prefill(url, req.prompt)
+            url = urls[zlib.crc32(req.seq.tobytes()) % len(urls)]
+            blob = disagg.fetch_prefill(url, req.seq)
             ship = disagg.unpack_shipment(blob)   # raises on CRC reject
-            L = int(req.prompt.size)
+            L = int(req.seq.size)
             if (ship["page_size"] != self.config.page_size
-                    or ship["tokens"] != [int(t) for t in req.prompt]):
+                    or ship["tokens"] != [int(t) for t in req.seq]):
                 raise disagg.ShipmentError(
                     "shipment does not match the request")
             need = self.pool.pages_for_tokens(L + req.max_new_tokens)
@@ -842,6 +913,9 @@ class DecodeEngine:
         array; per-request deadlines checked here, at step granularity."""
         import jax.numpy as jnp
 
+        delay_ms = float(_flag("decode_step_delay_ms"))
+        if delay_ms > 0:   # chaos/bench pacing knob — off by default
+            time.sleep(delay_ms / 1e3)
         now = time.monotonic()
         for req in [r for r in self._active if r.expired(now)]:
             self._active.remove(req)
@@ -881,6 +955,33 @@ class DecodeEngine:
             else:
                 still.append(req)
         self._active = still
+
+    def _journal_tick(self):
+        """Replicate session snapshots to the router at step-boundary
+        cadence (serving/session.py). Runs on the worker thread right
+        after a step — the snapshot is a consistent cut: every accepted
+        token is in it, the RNG state has consumed exactly those draws.
+        A sink failure (router briefly down) only costs replay depth,
+        never the generation (session.journal_errors)."""
+        sink = self.journal_sink
+        stride = self._journal_stride
+        if sink is None or stride <= 0:
+            return
+        now = time.monotonic()
+        records = []
+        for req in self._active:
+            if req.session_id is None or not req.tokens:
+                continue
+            if (int(req.prior.size) + len(req.tokens)) % stride == 0:
+                records.append(
+                    req.journal_record(self.config.page_size, now))
+        if not records:
+            return
+        try:
+            sink(records)
+        except Exception as e:
+            telemetry.counter_add("session.journal_errors", 1,
+                                  exc=type(e).__name__)
 
     def _append_token(self, req: GenerationRequest, logits_row: np.ndarray):
         tok = req.sample(logits_row)

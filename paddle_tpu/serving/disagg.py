@@ -144,18 +144,29 @@ def unpack_shipment(blob: bytes) -> Dict[str, Any]:
 
 def fetch_prefill(url: str, prompt: np.ndarray,
                   timeout: float = 30.0) -> bytes:
-    """POST the prompt to a prefill replica's ``/v1/prefill`` and
-    return the raw shipment bytes (HTTP errors raise ShipmentError)."""
+    """POST the prompt to ``/v1/prefill`` and return the raw shipment
+    bytes (HTTP errors raise ShipmentError).
+
+    ``url`` may point at a prefill replica directly OR at the cluster
+    router, which forwards to a live prefill-tier member
+    (``router.prefill_forwards``) — the indirection keeps prefill-tier
+    membership changes (respawn after a crash, ``scale_tier``)
+    invisible to decode replicas. A path component in ``url`` is
+    honoured as a prefix (e.g. ``http://router:8080/v1/prefill``);
+    a bare host:port URL gets ``/v1/prefill`` appended."""
     import http.client
     import urllib.parse
 
     u = urllib.parse.urlparse(url)
+    path = u.path.rstrip("/")
+    if not path.endswith("/v1/prefill"):
+        path = path + "/v1/prefill"
     conn = http.client.HTTPConnection(u.hostname, u.port, timeout=timeout)
     try:
         body = json.dumps(
             {"prompt": [int(t) for t in np.asarray(prompt).reshape(-1)]}
         ).encode("utf-8")
-        conn.request("POST", "/v1/prefill", body=body,
+        conn.request("POST", path, body=body,
                      headers={"Content-Type": "application/json"})
         resp = conn.getresponse()
         data = resp.read()

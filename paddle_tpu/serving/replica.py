@@ -60,6 +60,28 @@ def run_decode_replica(args) -> int:
                               prefill_urls=args.prefill_urls,
                               prefix_cache=args.prefix_cache or None)
     engine = decode_engine_from_dir(args.decode_model_dir, config=config)
+    if args.journal_url:
+        # session-failover journal (serving/session.py): replicate
+        # snapshots to the router at step-boundary cadence. Short
+        # timeout + swallowed errors — a slow router must never stall
+        # the decode step; the engine counts session.journal_errors.
+        import http.client as _hc
+        import urllib.parse as _up
+
+        u = _up.urlparse(args.journal_url)
+
+        def _journal_sink(records, _host=u.hostname, _port=u.port,
+                          _path=(u.path or "/v1/session/journal")):
+            conn = _hc.HTTPConnection(_host, _port, timeout=2.0)
+            try:
+                conn.request("POST", _path,
+                             body=json.dumps({"records": records}).encode(),
+                             headers={"Content-Type": "application/json"})
+                conn.getresponse().read()
+            finally:
+                conn.close()
+
+        engine.journal_sink = _journal_sink
     server = ServingHTTPServer(None, host=args.host, port=args.port,
                                decode_engine=engine).start()
     print("PT_REPLICA_READY " + json.dumps(
@@ -188,6 +210,11 @@ def main(argv=None) -> int:
     ap.add_argument("--prefix-cache", action="store_true",
                     help="enable the content-addressed prefix store "
                          "(serving/prefix_store.py) on this replica")
+    ap.add_argument("--journal-url", default="",
+                    help="router endpoint decode replicas replicate "
+                         "session-failover journals to (serving/"
+                         "session.py) — usually ROUTER_URL/v1/session/"
+                         "journal; empty disables journaling")
     ap.add_argument("--poll-s", type=float, default=0.0,
                     help="> 0 arms SELF-watching of --model-root for new "
                          "versions (routerless mode); the cluster "

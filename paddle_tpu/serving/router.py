@@ -43,6 +43,7 @@ import http.client
 import json
 import threading
 import time
+import zlib
 from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -233,6 +234,13 @@ class Router:
         # attached, pick() deprioritises flagged stragglers and the
         # front end serves /fleet/status + /fleet/metrics
         self._fleet = None
+        # decode-session journal (serving/session.py): replicas POST
+        # per-request snapshots to /v1/session/journal; on a
+        # decode-replica death route_generate re-admits the journaled
+        # session on a survivor instead of losing the generation
+        from .session import SessionJournal
+
+        self.sessions = SessionJournal()
 
     # -- membership ----------------------------------------------------------
     def add_replica(self, name: str, url: str,
@@ -650,12 +658,19 @@ class Router:
                                    for h in self.handles()):
             # a decode tier EXISTS but none of it is ready right now
             telemetry.counter_add("router.affinity_fallbacks", 1)
-        from .prefix_store import prefix_chain_hash
+        from .prefix_store import ROOT_HASH, prefix_chain_hash
 
-        chain = prefix_chain_hash(
-            [int(t) for t in prompt_ids],
-            int(_flag("decode_page_size")))
-        handle = tier[int(chain, 16) % len(tier)]
+        tokens = [int(t) for t in prompt_ids]
+        chain = prefix_chain_hash(tokens, int(_flag("decode_page_size")))
+        if chain == ROOT_HASH:
+            # prompt shorter than one full page: no KV pages to be
+            # affine to — spread by a stable hash of the raw prompt
+            # (must be process-independent: the failover re-pick and a
+            # respawned router have to agree)
+            key = zlib.crc32(",".join(map(str, tokens)).encode())
+        else:
+            key = int(chain, 16)
+        handle = tier[key % len(tier)]
         telemetry.counter_quiet("router.affinity_routes")
         return handle
 
@@ -664,11 +679,35 @@ class Router:
                        temperature: float = 0.0,
                        seed: Optional[int] = None,
                        deadline_ms: Optional[float] = None,
+                       request_id: Optional[str] = None,
+                       stop_at_eos: bool = True,
                        ) -> Tuple[int, Dict[str, Any]]:
         """Route one generation to the decode plane with prefix
         affinity; retries transport failures and retryable statuses on
-        the remaining tier. Never raises — always (code, payload)."""
+        the remaining tier. Never raises — always (code, payload).
+
+        Exactly-once under client retries: an X-Request-Id already
+        answered replays the cached response (same dedup cache as
+        /v1/infer — a client retry during a failover can't
+        double-generate). Crash survival: when a dispatch fails and the
+        session journal (serving/session.py) holds accepted tokens for
+        this id, the retry RESUMES the generation on a survivor —
+        prompt+accepted re-prefilled, RNG state restored — and the
+        journaled prefix is re-joined with the resumed tail, so the
+        client sees one uninterrupted, bitwise-identical token
+        stream."""
         telemetry.counter_add("router.requests", 1, plane="generate")
+        client_supplied = request_id is not None
+        rid = request_id if client_supplied else self.new_request_id()
+
+        cached = self._dedup_claim(rid)
+        if cached is not None:
+            telemetry.counter_add("router.dedup_hits", 1,
+                                  plane="generate")
+            payload = dict(cached[2])
+            payload["deduped"] = True
+            return cached[1], payload
+
         budget_s = float(_flag("router_timeout_s"))
         if deadline_ms is not None and deadline_ms > 0:
             budget_s = min(budget_s, deadline_ms / 1e3) \
@@ -682,23 +721,35 @@ class Router:
         per_try_cap = float(_flag("router_dispatch_timeout_s"))
         body_doc: Dict[str, Any] = {
             "prompt_ids": [int(t) for t in prompt_ids],
-            "temperature": float(temperature)}
+            "temperature": float(temperature),
+            "stop_at_eos": bool(stop_at_eos),
+            "request_id": rid}
         if max_new_tokens is not None:
             body_doc["max_new_tokens"] = int(max_new_tokens)
         if seed is not None:
             body_doc["seed"] = int(seed)
         tried: set = set()
+        resumed_prefix: List[int] = []
+        failed_over = False
         code, payload = 503, {"error": "no replica available"}
         while True:
+            # affinity stays keyed on the ORIGINAL prompt across
+            # failovers — prior_tokens ride separately in the body
             handle = self.pick_generate(body_doc["prompt_ids"],
                                         exclude=tried)
             if handle is None and tried:
                 tried = set()
                 handle = self.pick_generate(body_doc["prompt_ids"])
             if handle is None:
+                # respawn/failover window with no generate-capable
+                # replica: wait it out under the deadline, re-probing —
+                # the cluster controller is usually mid-respawn
+                if self._wait_for_replica(sched):
+                    continue
                 telemetry.counter_add("router.rejects", 1)
                 code, payload = 503, {
-                    "error": "no generate-capable replica available"}
+                    "error": "no generate-capable replica available",
+                    "request_id": rid}
                 break
             attempt_timeout = sched.remaining(default=per_try_cap)
             attempt_timeout = per_try_cap if attempt_timeout is None \
@@ -713,6 +764,7 @@ class Router:
                     code, payload = _http_json(
                         "POST", handle.url, "/v1/generate",
                         body=json.dumps(body_doc).encode(),
+                        headers={"X-Request-Id": rid},
                         timeout=attempt_timeout)
             except (ConnectionError, OSError,
                     http.client.HTTPException) as e:
@@ -728,22 +780,110 @@ class Router:
                 telemetry.counter_add("router.dispatch_errors", 1,
                                       replica=handle.name, status=code)
             tried.add(handle)
+            # session failover: if the dead replica journaled accepted
+            # tokens for this id, the next attempt resumes instead of
+            # regenerating — re-consulted every lap, so a survivor that
+            # ALSO dies mid-resume hands off its own progress too
+            record = self.sessions.get(rid)
+            if record and record.get("accepted"):
+                from .session import resume_args
+
+                kw = resume_args(record)
+                if kw["max_new_tokens"] >= 1:
+                    resumed_prefix = list(kw["prior_tokens"])
+                    body_doc["prior_tokens"] = kw["prior_tokens"]
+                    body_doc["max_new_tokens"] = kw["max_new_tokens"]
+                    if kw.get("rng_state") is not None:
+                        body_doc["rng_state"] = kw["rng_state"]
+                    failed_over = True
+                    telemetry.counter_add("session.failovers", 1,
+                                          replica=handle.name)
             outcome, delay = sched.note_failure()
             if outcome == retry.DEADLINE:
                 telemetry.counter_add("router.deadline_exceeded", 1)
                 code, payload = 504, {
                     "error": f"generation exceeded its {budget_s:.3f}s "
-                             f"deadline after {sched.attempt} attempts"}
+                             f"deadline after {sched.attempt} attempts",
+                    "request_id": rid}
                 break
             if outcome == retry.EXHAUSTED:
                 code, payload = 502, {
                     "error": f"generation failed on every replica after "
                              f"{sched.attempt} attempts "
-                             f"(last: {retryable_exc or code})"}
+                             f"(last: {retryable_exc or code})",
+                    "request_id": rid}
                 break
             telemetry.counter_add("router.retries", 1)
             time.sleep(delay)
+        if code == 200:
+            if resumed_prefix:
+                # re-join the journaled prefix with the resumed tail —
+                # ONE uninterrupted stream, bitwise-identical to the
+                # generation the dead replica would have produced
+                payload["tokens"] = resumed_prefix + list(
+                    payload.get("tokens", []))
+                payload["num_tokens"] = len(payload["tokens"])
+                payload["resumed"] = True
+            if failed_over:
+                payload["failed_over"] = True
+            payload.setdefault("request_id", rid)
+            self.sessions.pop(rid)
+        self._dedup_publish(rid, code, payload)
         return code, payload
+
+    def forward_prefill(self, raw_body: bytes,
+                        timeout: Optional[float] = None
+                        ) -> Tuple[int, bytes, str]:
+        """Forward a /v1/prefill shipment pull to a ready prefill-tier
+        replica (lowest load first) — the live-cluster path that lets
+        decode replicas point at the ROUTER instead of pinning peer
+        URLs, so prefill-tier membership changes (respawn, scale)
+        never strand them. Returns (status, body_bytes, content_type);
+        CRC verification stays end-to-end in the decode replica."""
+        cap = float(_flag("router_dispatch_timeout_s"))
+        timeout = cap if timeout is None else min(timeout, cap)
+        tier = sorted((h for h in self.handles()
+                       if h.ready and h.role == "prefill"),
+                      key=lambda h: h.score())
+        if not tier:
+            return 503, json.dumps(
+                {"error": "no prefill-tier replica available"}).encode(), \
+                "application/json"
+        last: Any = None
+        for handle in tier:
+            try:
+                host, _, port = \
+                    handle.url.rpartition("://")[2].partition(":")
+                conn = http.client.HTTPConnection(host, int(port),
+                                                  timeout=timeout)
+                try:
+                    conn.request("POST", "/v1/prefill", body=raw_body,
+                                 headers={"Content-Type":
+                                          "application/json"})
+                    resp = conn.getresponse()
+                    data = resp.read()
+                    ctype = resp.getheader("Content-Type",
+                                           "application/octet-stream")
+                finally:
+                    conn.close()
+                if resp.status == 200:
+                    telemetry.counter_add("router.prefill_forwards", 1,
+                                          replica=handle.name)
+                    return resp.status, data, ctype
+                last = resp.status
+                telemetry.counter_add("router.prefill_forward_errors", 1,
+                                      replica=handle.name,
+                                      status=resp.status)
+            except (ConnectionError, OSError,
+                    http.client.HTTPException) as e:
+                last = e
+                handle.mark_down(type(e).__name__)
+                telemetry.counter_add("router.prefill_forward_errors", 1,
+                                      replica=handle.name,
+                                      exc=type(e).__name__)
+        return 503, json.dumps(
+            {"error": f"every prefill replica failed (last: {last})"}
+        ).encode(), "application/json"
 
     # -- introspection -------------------------------------------------------
     def ready(self) -> bool:
@@ -843,12 +983,43 @@ class _RouterHandler(BaseHTTPRequestHandler):
             except (ValueError, TypeError, KeyError) as e:
                 self._reply(400, {"error": f"bad generate request: {e!r}"})
                 return
+            # client-supplied identity: exactly-once dedup + session
+            # journaling key — body request_id wins over the header
+            rid = (doc.get("request_id")
+                   or self.headers.get("X-Request-Id"))
             code, payload = router.route_generate(
                 prompt, max_new_tokens=doc.get("max_new_tokens"),
                 temperature=float(doc.get("temperature", 0.0)),
                 seed=doc.get("seed"),
-                deadline_ms=doc.get("deadline_ms"))
+                deadline_ms=doc.get("deadline_ms"),
+                request_id=rid,
+                stop_at_eos=bool(doc.get("stop_at_eos", True)))
             self._reply(code, payload)
+            return
+        if self.path == "/v1/session/journal":
+            # decode replicas replicate session snapshots here at
+            # step-boundary cadence (serving/session.py)
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+                doc = json.loads(self.rfile.read(length) or b"{}")
+                records = doc.get("records") or []
+            except (ValueError, TypeError) as e:
+                self._reply(400, {"error": f"bad journal batch: {e!r}"})
+                return
+            n = router.sessions.update(records)
+            self._reply(200, {"journaled": n})
+            return
+        if self.path == "/v1/prefill":
+            # live-cluster shipment pull: decode replicas configured
+            # with the ROUTER url fetch prefill shipments through here
+            length = int(self.headers.get("Content-Length") or 0)
+            raw = self.rfile.read(length)
+            code, data, ctype = router.forward_prefill(raw)
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
             return
         if self.path != "/v1/infer":
             self._reply(404, {"error": f"no route {self.path}"})

@@ -210,12 +210,22 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(400, {"error": f"bad generate request: {e!r}"})
             return
         t0 = time.perf_counter()
+        # session identity: body request_id wins, else the X-Request-Id
+        # header the router forwards — either opts the generation into
+        # journaling; prior_tokens/rng_state re-admit a journaled
+        # session after its replica died (serving/session.py)
+        request_id = (doc.get("request_id")
+                      or self.headers.get("X-Request-Id"))
         try:
             req = de.submit(prompt,
                             max_new_tokens=doc.get("max_new_tokens"),
                             deadline_ms=doc.get("deadline_ms"),
                             temperature=float(doc.get("temperature", 0.0)),
-                            seed=doc.get("seed"))
+                            seed=doc.get("seed"),
+                            stop_at_eos=bool(doc.get("stop_at_eos", True)),
+                            request_id=request_id,
+                            prior_tokens=doc.get("prior_tokens"),
+                            rng_state=doc.get("rng_state"))
             tokens = req.result()
         except ValueError as e:
             self._reply(400, {"error": str(e)})
@@ -234,13 +244,20 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:
             self._reply(500, {"error": f"{type(e).__name__}: {e}"})
         else:
-            self._reply(200, {
+            payload = {
                 "tokens": np.asarray(tokens).tolist(),
                 "num_tokens": int(np.asarray(tokens).size),
                 "ttft_ms": round(req.ttft_ms, 3)
                 if req.ttft_ms is not None else None,
                 "model_version": de.version,
-                "latency_ms": round((time.perf_counter() - t0) * 1e3, 3)})
+                "latency_ms": round((time.perf_counter() - t0) * 1e3, 3)}
+            if request_id is not None:
+                payload["request_id"] = request_id
+            if doc.get("prior_tokens"):
+                # resumed session: the tokens above are the TAIL only;
+                # the router re-joins them with the journaled prefix
+                payload["resumed"] = True
+            self._reply(200, payload)
 
     def _handle_prefill(self):
         """POST /v1/prefill — the prefill tier of disaggregated serving

@@ -487,7 +487,8 @@ def _emitted_metric_names():
                                         "slo.", "tuner.",
                                         "goodput.", "fleet.",
                                         "scaler.", "elastic.",
-                                        "kv.", "disagg.")) or \
+                                        "kv.", "disagg.",
+                                        "orch.", "session.")) or \
                             (name.startswith("sharding.")
                              and ("state_bytes" in name
                                   or "zero_regroup" in name)):
@@ -557,6 +558,25 @@ class TestMetricDriftGuard:
         assert "disagg.installs" in names
         assert "disagg.crc_rejects" in names
         assert "disagg.fallback_prefills" in names
+        # the process-level crash-survival plane: the launch.py
+        # orchestrator and the decode-session failover journal
+        # (serving/session.py)
+        assert "orch.spawns" in names
+        assert "orch.child_deaths" in names
+        assert "orch.respawns" in names
+        assert "orch.budget_exhausted" in names
+        assert "orch.drains" in names
+        assert "orch.drain_kills" in names
+        assert "orch.scale_events" in names
+        assert "orch.restart_budget_refunds" in names
+        assert "session.journaled" in names
+        assert "session.evicted" in names
+        assert "session.resumed" in names
+        assert "session.resumed_tokens" in names
+        assert "session.journal_errors" in names
+        assert "session.failovers" in names
+        assert "elastic.drains" in names
+        assert "elastic.drain_timeouts" in names
         # the fleet observatory (core/fleetobs.py)
         assert "fleet.scrapes" in names
         assert "fleet.scrape_failures" in names

@@ -1,0 +1,413 @@
+"""Process-level crash survival (distributed/launch.py orchestrator,
+distributed/demo_trainer.py child, serving decode-session failover).
+
+Contracts under test:
+* a SIGKILLed trainer subprocess is detected by the supervising
+  orchestrator and respawned within the windowed restart budget — the
+  respawned life restores the newest verified checkpoint and the
+  ``LOSS <step> <value>`` row stream completes with no step missing;
+* every child death lands EXACTLY one ``kind:"incident"`` record
+  (exit code, signal, heartbeat age) through
+  ``incidents.report_incident``, exempt from the rate-limit window —
+  back-to-back deaths all reach the ledger;
+* a deterministically crash-looping child (``--crash-at``) exhausts the
+  budget into a typed ``RestartBudgetExhaustedError`` — never a silent
+  respawn loop;
+* ``execute_scale`` is a REAL process resize: checkpoint → drain
+  (SIGTERM, the child's ElasticRunner force-saves and bound-joins its
+  async writer) → terminate → relaunch at the new world size; a
+  2→3→2 resize produces a loss trajectory bitwise-identical to an
+  uninterrupted single-process run;
+* the orchestrator shutdown path survives a kill DURING the drain
+  checkpoint (``PT_CKPT_CRASH_AT=ckpt.save.commit``): the torn save is
+  never visible to restore — atomic-commit discipline holds under
+  SIGTERM-then-die;
+* decode-session failover: a decode replica SIGKILLed mid-generation
+  loses nothing — the router re-admits the journaled session on a
+  survivor and the merged output is BITWISE-identical to the
+  uninterrupted run, greedy and sampled, fp32 and int8, PT_PALLAS off
+  and interpret;
+* /v1/generate exactly-once: a client retry of an answered request id
+  replays the cached response (``router.dedup_hits``) without
+  re-generating;
+* tier auto-provisioning: a prefill tier is provisioned like decode
+  replicas, shipment pull flows THROUGH the router
+  (``router.prefill_forwards``), and a killed tier member respawns
+  with its role sticky + affinity remapped.
+
+tools/chaos_check.py --orchestrator is the CLI twin.
+"""
+
+import contextlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core import flags, incidents, telemetry
+
+pytestmark = pytest.mark.chaos
+
+PY = sys.executable
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _trainer_argv(steps, ckpt_dir, out, delay_ms=0.0, save_interval=1,
+                  crash_at=-1):
+    return [PY, "-m", "paddle_tpu.distributed.demo_trainer",
+            "--steps", str(steps), "--ckpt-dir", str(ckpt_dir),
+            "--out", str(out), "--save-interval", str(save_interval),
+            "--step-delay-ms", str(delay_ms), "--crash-at", str(crash_at)]
+
+
+def _rows(path):
+    """LOSS rows keyed by step, LAST occurrence wins (a respawned life
+    legitimately re-emits the step it died inside)."""
+    rows = {}
+    with open(path) as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) == 3 and parts[0] == "LOSS":
+                rows[int(parts[1])] = parts[2]
+    return rows
+
+
+def _counter(name):
+    return int(telemetry.counter_get(name))
+
+
+def _incident_records(name):
+    return [r for r in incidents.flight_recorder().snapshot(window_s=1e9)
+            if r.get("kind") == "incident" and r.get("name") == name]
+
+
+def _generate(url, body, timeout=120):
+    req = urllib.request.Request(
+        url + "/v1/generate", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+# ---------------------------------------------------------------------------
+# training orchestrator
+# ---------------------------------------------------------------------------
+
+class TestOrchestratorSupervision:
+    def test_sigkill_trainer_respawns_within_budget(self, tmp_path):
+        """A SIGKILLed trainer is respawned once, the row stream
+        completes, and the death lands exactly one incident."""
+        from paddle_tpu.distributed.launch import Orchestrator
+
+        out = tmp_path / "rows.txt"
+        argv = _trainer_argv(8, tmp_path / "ck", out, delay_ms=60)
+        orch = Orchestrator(argv, world=2, ready_timeout_s=90,
+                            drain_timeout_s=20)
+        deaths0 = _counter("orch.child_deaths")
+        incidents0 = len(_incident_records("child_death"))
+        orch.start()
+
+        def killer():
+            while orch.max_step() < 2:
+                time.sleep(0.02)
+            orch.trainers[0].signal(signal.SIGKILL)
+
+        threading.Thread(target=killer, daemon=True).start()
+        rc = orch.run()
+        assert rc == 0
+        assert orch.respawns == 1
+        assert _counter("orch.child_deaths") - deaths0 == 1
+        recs = _incident_records("child_death")
+        assert len(recs) - incidents0 == 1
+        ctx = recs[-1]["attrs"]["context"]
+        assert ctx["role"] == "trainer"
+        assert ctx["signal"] == int(signal.SIGKILL)
+        # no step lost: every row present despite the mid-stream kill
+        assert sorted(_rows(out)) == list(range(8))
+
+    def test_budget_exhaustion_raises_typed_error(self, tmp_path):
+        """--crash-at turns the child into a deterministic crash loop:
+        the orchestrator respawns within budget, then raises the typed
+        error — and BOTH deaths reach the incident ledger (the reports
+        are rate-limit-exempt)."""
+        from paddle_tpu.distributed.elastic import \
+            RestartBudgetExhaustedError
+        from paddle_tpu.distributed.launch import Orchestrator
+
+        argv = _trainer_argv(5, tmp_path / "ck", tmp_path / "rows.txt",
+                             crash_at=1)
+        orch = Orchestrator(argv, world=1, max_restarts=1,
+                            restart_window_s=0.0, ready_timeout_s=90,
+                            drain_timeout_s=10)
+        exhausted0 = _counter("orch.budget_exhausted")
+        incidents0 = len(_incident_records("child_death"))
+        orch.start()
+        with pytest.raises(RestartBudgetExhaustedError) as ei:
+            orch.run()
+        assert ei.value.max_restarts == 1
+        assert ei.value.used == 2
+        assert orch.respawns == 1
+        assert _counter("orch.budget_exhausted") - exhausted0 == 1
+        assert len(_incident_records("child_death")) - incidents0 == 2
+
+    def test_real_process_2_3_2_resize_matches_uninterrupted(
+            self, tmp_path):
+        """The tentpole gate: a scheduled 2→3→2 resize executed as
+        checkpoint → drain → terminate → relaunch continues the loss
+        trajectory BITWISE — every row equal to an uninterrupted
+        single-process run."""
+        from paddle_tpu.distributed.launch import Orchestrator
+        from paddle_tpu.distributed.scaler import ResizeSchedule
+
+        base_out = tmp_path / "base.txt"
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        subprocess.run(
+            _trainer_argv(11, tmp_path / "ck_base", base_out),
+            env=env, check=True, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL, timeout=300)
+        base = _rows(base_out)
+        assert sorted(base) == list(range(11))
+
+        out = tmp_path / "rows.txt"
+        argv = _trainer_argv(11, tmp_path / "ck", out, delay_ms=50)
+        scales0 = _counter("orch.scale_events")
+        orch = Orchestrator(argv, world=2, ready_timeout_s=90,
+                            drain_timeout_s=30,
+                            schedule=ResizeSchedule("3:3,8:2"))
+        orch.start()
+        assert orch.run() == 0
+        assert orch.scale_events == 2
+        assert _counter("orch.scale_events") - scales0 == 2
+        got = _rows(out)
+        assert sorted(got) == list(range(11))
+        diff = [s for s in base if base[s] != got[s]]
+        assert not diff, (
+            f"trajectory diverged after resize at steps {diff}: "
+            f"{[(base[s], got[s]) for s in diff[:3]]}")
+
+    def test_shutdown_drain_kill_leaves_no_torn_checkpoint(
+            self, tmp_path):
+        """PT_CKPT_CRASH_AT kill test for the orchestrator shutdown
+        path: the child dies between durable data and manifest commit of
+        its drain checkpoint — restore must see NOTHING (atomic commit),
+        and stop() must return promptly rather than hang."""
+        from paddle_tpu.checkpoint import CheckpointManager
+        from paddle_tpu.distributed.launch import Orchestrator
+
+        ckpt_dir = tmp_path / "ck"
+        env = dict(os.environ)
+        # the child's only periodic save is the first (step 0) — the
+        # interval pushes every later one past the horizon — so the save
+        # at step 4 is the DRAIN's force-save, and the hook kills the
+        # child between durable data and manifest commit
+        env["PT_CKPT_CRASH_AT"] = "ckpt.save.commit@4"
+        argv = _trainer_argv(500, ckpt_dir, tmp_path / "rows.txt",
+                             delay_ms=200, save_interval=10000)
+        orch = Orchestrator(argv, world=1, ready_timeout_s=90,
+                            drain_timeout_s=10, env=env)
+        orch.start()
+        deadline = time.monotonic() + 60
+        while orch.max_step() < 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert orch.max_step() >= 2, "trainer never made progress"
+        # land the SIGTERM inside step 3's delay so the drain check
+        # fires at the top of step 4 — the crash spec's step
+        time.sleep(0.05)
+        t0 = time.monotonic()
+        orch.stop()
+        assert time.monotonic() - t0 < 30, "shutdown drain hung"
+        child = orch.trainers[0]
+        assert not child.alive()
+        assert child.returncode() == -int(signal.SIGKILL)
+        # the torn drain save (step 4) is invisible: restore falls back
+        # to the committed step-0 checkpoint without raising
+        step, arrays, _ = CheckpointManager(
+            str(ckpt_dir)).restore_latest_arrays()
+        assert step == 0 and arrays, (
+            f"expected the committed step-0 checkpoint, got step {step} "
+            f"with {len(arrays)} arrays")
+
+
+# ---------------------------------------------------------------------------
+# decode-session failover + tier provisioning
+# ---------------------------------------------------------------------------
+
+CFG_KW = dict(vocab_size=97, d_model=32, n_head=2, n_layers=2,
+              d_inner=64, max_seq_len=64)
+
+
+@pytest.fixture(scope="module")
+def lm_dir(tmp_path_factory):
+    from paddle_tpu.models.decoder_lm import (DecoderLMConfig,
+                                              decoder_lm_params,
+                                              save_decoder_lm)
+
+    d = tmp_path_factory.mktemp("orch_lm")
+    cfg = DecoderLMConfig(**CFG_KW)
+    save_decoder_lm(str(d), cfg, decoder_lm_params(cfg, seed=0))
+    return str(d)
+
+
+@contextlib.contextmanager
+def _decode_flags(monkeypatch, **over):
+    """Apply flag overrides BOTH in-process (the registry, for inproc
+    engines/routers) and as FLAGS_ env (inherited by replica
+    subprocesses)."""
+    for k, v in over.items():
+        monkeypatch.setenv(f"FLAGS_{k}", str(v))
+    prior = flags.apply(over)
+    try:
+        yield
+    finally:
+        flags.apply(prior)
+
+
+PROMPT = [int(t) for t in np.random.RandomState(3).randint(3, 96, 6)]
+
+# greedy/sampled x fp32/int8, with PT_PALLAS off/interpret spread
+# across the matrix — the four acceptance identity legs
+LEGS = [
+    ("greedy-fp32", 0.0, "none", "off"),
+    ("sampled-fp32", 0.8, "none", "interpret"),
+    ("greedy-int8", 0.0, "int8", "interpret"),
+    ("sampled-int8", 0.8, "int8", "off"),
+]
+
+
+class TestDecodeSessionFailover:
+    @pytest.mark.parametrize("leg,temperature,quant,pallas", LEGS,
+                             ids=[l[0] for l in LEGS])
+    def test_decode_sigkill_bitwise_identity(self, lm_dir, monkeypatch,
+                                             leg, temperature, quant,
+                                             pallas):
+        """SIGKILL the serving decode replica mid-generation: the
+        journaled session resumes on the survivor and the merged token
+        stream is bitwise-identical to the uninterrupted run."""
+        from paddle_tpu.serving.cluster import ClusterController
+
+        monkeypatch.setenv("PT_PALLAS", pallas)
+        body = {"prompt_ids": PROMPT, "max_new_tokens": 14,
+                "temperature": temperature, "seed": 11}
+        with _decode_flags(monkeypatch, decode_step_delay_ms=60.0,
+                           decode_weight_quant=quant):
+            # uninterrupted reference: in-process single decode replica
+            ref_cluster = ClusterController(
+                "", decode_model_dir=lm_dir, role_counts={"decode": 1},
+                inprocess=True).start(ready_timeout_s=120)
+            try:
+                ref = _generate(ref_cluster.url, body)
+            finally:
+                ref_cluster.close()
+            assert len(ref["tokens"]) >= 6
+
+            failovers0 = _counter("session.failovers")
+            cluster = ClusterController(
+                "", decode_model_dir=lm_dir,
+                role_counts={"decode": 2}).start(ready_timeout_s=180)
+            try:
+                result = {}
+
+                def client():
+                    result.update(_generate(
+                        cluster.url, dict(body, request_id=f"s-{leg}")))
+
+                t = threading.Thread(target=client)
+                t.start()
+                victim = None
+                deadline = time.monotonic() + 90
+                while time.monotonic() < deadline:
+                    rec = cluster.router.sessions.get(f"s-{leg}")
+                    if rec and len(rec["accepted"]) >= 3:
+                        handle = cluster.router.pick_generate(PROMPT)
+                        victim = next(r for r in cluster.replicas
+                                      if r.name == handle.name)
+                        victim.kill(signal.SIGKILL)
+                        break
+                    time.sleep(0.01)
+                assert victim is not None, \
+                    "session journal never showed progress"
+                t.join(timeout=180)
+                assert result, "client never completed"
+            finally:
+                cluster.close()
+        assert result["tokens"] == ref["tokens"], (
+            f"[{leg}] resumed output diverged: {result['tokens']} vs "
+            f"uninterrupted {ref['tokens']}")
+        assert result.get("failed_over") is True
+        assert _counter("session.failovers") - failovers0 >= 1
+
+    def test_generate_dedup_replays_exactly_once(self, lm_dir,
+                                                 monkeypatch):
+        """A client retry of an answered /v1/generate id replays the
+        cached response — the engine never generates twice."""
+        from paddle_tpu.serving.cluster import ClusterController
+
+        cluster = ClusterController(
+            "", decode_model_dir=lm_dir, role_counts={"decode": 1},
+            inprocess=True).start(ready_timeout_s=120)
+        try:
+            body = {"prompt_ids": PROMPT, "max_new_tokens": 6,
+                    "temperature": 0.0, "request_id": "retry-1"}
+            first = _generate(cluster.url, body)
+            hits0 = _counter("router.dedup_hits")
+            prefills0 = _counter("decode.prefills")
+            second = _generate(cluster.url, body)
+        finally:
+            cluster.close()
+        assert second["tokens"] == first["tokens"]
+        assert _counter("router.dedup_hits") - hits0 == 1
+        # the replay came from the dedup cache, not a fresh generation
+        assert _counter("decode.prefills") == prefills0
+
+    def test_prefill_tier_provisioned_and_role_sticky_respawn(
+            self, lm_dir, monkeypatch):
+        """Tier auto-provisioning: the prefill tier serves shipment
+        pulls THROUGH the router, and a SIGKILLed decode member
+        respawns with its role sticky, affinity remapped, exactly one
+        replica-death incident."""
+        from paddle_tpu.serving.cluster import ClusterController
+
+        forwards0 = _counter("router.prefill_forwards")
+        remaps0 = _counter("router.affinity_remaps")
+        incidents0 = len(_incident_records("replica_death"))
+        cluster = ClusterController(
+            "", decode_model_dir=lm_dir,
+            role_counts={"prefill": 1, "decode": 1},
+        ).start(ready_timeout_s=180)
+        try:
+            body = {"prompt_ids": [int(t) for t in
+                                   np.random.RandomState(5).randint(
+                                       3, 96, 24)],
+                    "max_new_tokens": 6, "temperature": 0.0}
+            out = _generate(cluster.url, body)
+            assert _counter("router.prefill_forwards") - forwards0 >= 1, \
+                "decode replica did not pull its prefill via the router"
+
+            victim = cluster.tier_members("decode")[0]
+            victim.kill(signal.SIGKILL)
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                members = cluster.tier_members("decode")
+                if members and members[0] is not victim \
+                        and members[0].alive():
+                    break
+                time.sleep(0.1)
+            members = cluster.tier_members("decode")
+            assert members and members[0] is not victim, \
+                "decode tier member never respawned"
+            assert members[0].role == "decode"
+            out2 = _generate(cluster.url,
+                             dict(body, request_id="after-respawn"))
+        finally:
+            cluster.close()
+        assert out2["tokens"] == out["tokens"]
+        assert _counter("router.affinity_remaps") - remaps0 >= 1
+        assert len(_incident_records("replica_death")) - incidents0 == 1

@@ -826,6 +826,152 @@ def bench_prefix_share(args):
     }
 
 
+def bench_kill_decode(args):
+    """--kill-decode: the decode-session failover arm (serving/
+    session.py + router re-admission). A 2-replica process decode tier
+    serves a batch of journaled sessions; mid-load the replica SERVING
+    a session — the router's affinity target — is SIGKILLed. Zero
+    requests may be lost: the journaled sessions resume on the
+    survivor. Lands as BENCH ``extra.failover`` with the failover count
+    and the resumed-session TTFT p99 (the re-admission re-prefills
+    prompt+accepted, so resumed TTFT is the crash-recovery cost the
+    operator actually pays) next to the clean-session p99."""
+    import signal as _signal
+    import tempfile
+    import threading
+    import time as _time
+    import urllib.request
+
+    import numpy as np
+
+    from paddle_tpu.core import flags as _flags
+    from paddle_tpu.models.decoder_lm import (DecoderLMConfig,
+                                              decoder_lm_params,
+                                              save_decoder_lm)
+    from paddle_tpu.serving.cluster import ClusterController
+
+    n = args.gen_requests
+    max_new = min(args.gen_max_new, 24)
+    rng = np.random.RandomState(29)
+    prompts = [[int(t) for t in rng.randint(3, 96, 6)] for _ in range(n)]
+    cfg = DecoderLMConfig(vocab_size=97, d_model=32, n_head=2,
+                          n_layers=2, d_inner=64,
+                          max_seq_len=8 + max_new)
+
+    # pace decode so the kill reliably lands mid-generation; the pacing
+    # is identical for clean and resumed sessions, so their TTFT ratio
+    # stays honest
+    over = {"decode_step_delay_ms": 20.0}
+    prior = _flags.apply(over)
+    prior_env = {k: os.environ.get(f"FLAGS_{k}") for k in over}
+    for k, v in over.items():
+        os.environ[f"FLAGS_{k}"] = str(v)
+    failovers0 = telemetry_counter("session.failovers")
+    results: dict = {}
+    lock = threading.Lock()
+    try:
+        with tempfile.TemporaryDirectory(prefix="pt_bench_kd_") as tmp:
+            lm_dir = os.path.join(tmp, "lm")
+            save_decoder_lm(lm_dir, cfg, decoder_lm_params(cfg, seed=0))
+            cluster = ClusterController(
+                "", decode_model_dir=lm_dir,
+                role_counts={"decode": 2}).start(ready_timeout_s=180)
+            try:
+                def worker(idx):
+                    body = json.dumps(
+                        {"prompt_ids": prompts[idx],
+                         "max_new_tokens": max_new,
+                         "temperature": 0.0,
+                         "request_id": f"bench-kd-{idx}"}).encode()
+                    req = urllib.request.Request(
+                        cluster.url + "/v1/generate", data=body,
+                        headers={"Content-Type": "application/json"})
+                    t0 = _time.perf_counter()
+                    try:
+                        doc = json.loads(urllib.request.urlopen(
+                            req, timeout=300).read())
+                        doc["client_ms"] = (_time.perf_counter()
+                                            - t0) * 1e3
+                        with lock:
+                            results[idx] = doc
+                    except Exception as e:      # lost request: counted
+                        with lock:
+                            results[idx] = {"error": repr(e)}
+
+                def killer():
+                    deadline = _time.monotonic() + 120
+                    while _time.monotonic() < deadline:
+                        for idx in range(n):
+                            rec = cluster.router.sessions.get(
+                                f"bench-kd-{idx}")
+                            if rec and len(rec["accepted"]) >= 3:
+                                handle = cluster.router.pick_generate(
+                                    prompts[idx])
+                                for rep in cluster.replicas:
+                                    if rep.name == handle.name:
+                                        rep.kill(_signal.SIGKILL)
+                                        return
+                        _time.sleep(0.01)
+
+                kt = threading.Thread(target=killer,
+                                      name="pt-bench-failover-killer")
+                kt.start()
+                threads = []
+                concurrency = args.gen_concurrency or 4
+                for idx in range(n):
+                    t = threading.Thread(target=worker, args=(idx,),
+                                         name=f"pt-bench-failover-w{idx}")
+                    t.start()
+                    threads.append(t)
+                    while sum(x.is_alive() for x in threads) \
+                            >= concurrency:
+                        _time.sleep(0.005)
+                for t in threads:
+                    t.join(timeout=300)
+                kt.join(timeout=130)
+            finally:
+                cluster.close()
+    finally:
+        _flags.apply(prior)
+        for k, v in prior_env.items():
+            if v is None:
+                os.environ.pop(f"FLAGS_{k}", None)
+            else:
+                os.environ[f"FLAGS_{k}"] = v
+
+    lost = [i for i in range(n)
+            if "tokens" not in results.get(i, {})]
+    if lost:
+        raise SystemExit(
+            f"FAILOVER ARM LOST WORK: {len(lost)}/{n} sessions got no "
+            f"answer across the decode kill: "
+            f"{[results.get(i) for i in lost[:3]]}")
+    failover_count = telemetry_counter("session.failovers") - failovers0
+    if failover_count < 1:
+        raise SystemExit("FAILOVER ARM DARK: the mid-load SIGKILL "
+                         "never produced a session failover")
+    resumed_ttft = sorted(
+        r["ttft_ms"] for r in results.values()
+        if r.get("failed_over") and r.get("ttft_ms") is not None)
+    clean_ttft = sorted(
+        r["ttft_ms"] for r in results.values()
+        if not r.get("failed_over") and r.get("ttft_ms") is not None)
+    return {
+        "requests": n,
+        "lost": 0,
+        "failover_count": failover_count,
+        "resumed_sessions": len(resumed_ttft),
+        "resumed_ttft_p50_ms": round(_pct(resumed_ttft, 0.50), 3)
+        if resumed_ttft else None,
+        "resumed_ttft_p99_ms": round(_pct(resumed_ttft, 0.99), 3)
+        if resumed_ttft else None,
+        "clean_ttft_p99_ms": round(_pct(clean_ttft, 0.99), 3)
+        if clean_ttft else None,
+        "client_p99_ms": round(_pct(sorted(
+            r["client_ms"] for r in results.values()), 0.99), 3),
+    }
+
+
 def telemetry_counter(name):
     from paddle_tpu.core import telemetry
 
@@ -886,6 +1032,13 @@ def main():
                          "prompt workload cold vs prefix-hit, bitwise-"
                          "gated, TTFT p50/p99 per arm as "
                          "extra.kv_prefix")
+    ap.add_argument("--kill-decode", action="store_true",
+                    help="with --generate: add the decode-session "
+                         "failover arm (serving/session.py) — SIGKILL "
+                         "the decode replica serving a journaled "
+                         "session mid-load, zero lost requests, "
+                         "failover_count + resumed-session TTFT p99 "
+                         "as extra.failover")
     ap.add_argument("--kernel-mode", default="auto",
                     choices=("auto", "off", "interpret", "tpu"),
                     help="--generate: PT_PALLAS mode of the kernel A/B "
@@ -950,6 +1103,8 @@ def main():
         row = bench_generate(args)
         if args.prefix_share:
             row["extra"]["kv_prefix"] = bench_prefix_share(args)
+        if args.kill_decode:
+            row["extra"]["failover"] = bench_kill_decode(args)
         print(json.dumps(finalize_bench_result(row)))
         return 0
 

@@ -100,6 +100,20 @@ transition, and a KV-server-count resize (2 → 3 → 2) conserves the
 row set exactly — zero leaked, zero duplicated, pull parity across
 the resharded set.
 
+With ``--orchestrator`` it gates process-level crash survival
+(paddle_tpu/distributed/launch.py + the serving session-failover
+plane): real trainer/pserver subprocesses under the supervising
+orchestrator and real replica processes under the ClusterController,
+with every role SIGKILLed once — a trainer and the pserver mid-run, a
+prefill-tier replica under load, and (four times, one per
+greedy/sampled × fp32/int8 identity leg) the decode replica serving a
+session, i.e. the router's affinity/probe target, mid-generation. The
+gate asserts zero lost work everywhere: the LOSS row stream completes
+with no step missing, every request answers 200, each death lands
+EXACTLY one kind:"incident" record, killed tier members respawn with
+their role sticky, and the resumed token stream is BITWISE-identical
+to an uninterrupted run in all four legs.
+
 Examples:
     python tools/chaos_check.py --fault-spec "ps.rpc.send:0.1" --seed 7
     python tools/chaos_check.py --fault-spec "ps.rpc.recv:%9" --steps 8 \
@@ -1187,6 +1201,275 @@ def run_resize(args) -> int:
         return 0
 
 
+def run_orchestrator(args) -> int:
+    """--orchestrator mode: the process-level crash-survival gate.
+    Every role in the stack is SIGKILLed once — trainer, pserver,
+    prefill replica, decode replica, and the router's probe/affinity
+    target mid-generation — and the run asserts zero lost work:
+
+      1. training leg — a supervising Orchestrator (distributed/
+         launch.py) runs 2 trainer + 1 pserver subprocesses; a trainer
+         and the pserver are each SIGKILLed mid-run, both respawn
+         within the restart budget, and the LOSS row stream completes
+         with no step missing; every death lands EXACTLY one
+         kind:"incident" record;
+      2. prefill-tier leg — a ClusterController provisions a prefill
+         tier next to the decode tier; the prefill replica is
+         SIGKILLed and every in-flight/subsequent request still
+         answers 200 (decode replicas fall back to local prefill while
+         the tier member respawns role-sticky);
+      3. identity legs — greedy/sampled x fp32/int8: the decode
+         replica SERVING a session (the router's affinity/probe
+         target) is SIGKILLed mid-generation; the journaled session
+         resumes on the survivor and the merged output must be
+         BITWISE-identical to an uninterrupted run.
+    """
+    import json as _json
+    import signal as _signal
+    import tempfile
+    import threading
+    import time as _time
+    import urllib.request
+
+    import numpy as np
+
+    from paddle_tpu.core import flags as _flags
+    from paddle_tpu.core import incidents, telemetry
+    from paddle_tpu.distributed.launch import Orchestrator
+    from paddle_tpu.models.decoder_lm import (DecoderLMConfig,
+                                              decoder_lm_params,
+                                              save_decoder_lm)
+    from paddle_tpu.serving.cluster import ClusterController
+
+    if args.telemetry_log:
+        telemetry.configure(args.telemetry_log)
+
+    def incident_count(name):
+        return len([r for r in
+                    incidents.flight_recorder().snapshot(window_s=1e9)
+                    if r.get("kind") == "incident"
+                    and r.get("name") == name])
+
+    def generate(url, body):
+        req = urllib.request.Request(
+            url + "/v1/generate", data=_json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return _json.loads(resp.read())
+
+    failures = []
+    with tempfile.TemporaryDirectory(prefix="pt_chaos_orch_") as tmp:
+        # -- leg 1: trainer + pserver SIGKILL under the orchestrator --------
+        out_path = os.path.join(tmp, "rows.txt")
+        steps = max(10, args.steps)
+        child_argv = [sys.executable, "-m",
+                      "paddle_tpu.distributed.demo_trainer",
+                      "--steps", str(steps),
+                      "--ckpt-dir", os.path.join(tmp, "ckpt"),
+                      "--out", out_path, "--step-delay-ms", "60"]
+        deaths0 = int(telemetry.counters().get("orch.child_deaths", 0))
+        inc0 = incident_count("child_death")
+        orch = Orchestrator(child_argv, world=2,
+                            pserver_argv=child_argv, n_pservers=1,
+                            ready_timeout_s=120, drain_timeout_s=20)
+        orch.start()
+
+        def killer():
+            while orch.max_step() < 2:
+                _time.sleep(0.02)
+            orch.trainers[1].signal(_signal.SIGKILL)
+            while orch.respawns < 1 or orch.max_step() < 5:
+                _time.sleep(0.02)
+            orch.pservers[0].signal(_signal.SIGKILL)
+
+        threading.Thread(target=killer, daemon=True,
+                         name="pt-chaos-orch-killer").start()
+        orch.run()
+        rows = {}
+        with open(out_path) as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) == 3 and parts[0] == "LOSS":
+                    rows[int(parts[1])] = parts[2]
+        deaths = int(telemetry.counters().get("orch.child_deaths",
+                                              0)) - deaths0
+        incs = incident_count("child_death") - inc0
+        if sorted(rows) != list(range(steps)):
+            failures.append(f"training leg lost rows: have "
+                            f"{sorted(rows)} want 0..{steps - 1}")
+        if deaths != 2 or orch.respawns != 2:
+            failures.append(f"training leg: {deaths} deaths / "
+                            f"{orch.respawns} respawns, want 2/2")
+        if incs != deaths:
+            failures.append(f"training leg: {incs} child_death "
+                            f"incidents for {deaths} deaths")
+        print(f"leg 1 (trainer+pserver kill): {steps} steps complete, "
+              f"{deaths} deaths -> {orch.respawns} respawns, "
+              f"{incs} incidents", flush=True)
+
+        # shared decode model + pacing for the serving legs
+        lm_dir = os.path.join(tmp, "lm")
+        cfg = DecoderLMConfig(vocab_size=97, d_model=32, n_head=2,
+                              n_layers=2, d_inner=64, max_seq_len=64)
+        save_decoder_lm(lm_dir, cfg, decoder_lm_params(cfg, seed=0))
+        prompt = [int(t) for t in
+                  np.random.RandomState(3).randint(3, 96, 6)]
+        prior_env = {}
+
+        def set_flags_everywhere(**over):
+            prior = _flags.apply(over)
+            for k, v in over.items():
+                key = f"FLAGS_{k}"
+                prior_env.setdefault(key, os.environ.get(key))
+                os.environ[key] = str(v)
+            return prior
+
+        prior_flags = set_flags_everywhere(decode_step_delay_ms=60.0)
+        try:
+            # -- leg 2: prefill replica SIGKILL, zero lost requests ---------
+            rdeaths0 = incident_count("replica_death")
+            cluster = ClusterController(
+                "", decode_model_dir=lm_dir,
+                role_counts={"prefill": 1, "decode": 1},
+            ).start(ready_timeout_s=180)
+            try:
+                body = {"prompt_ids": prompt, "max_new_tokens": 6,
+                        "temperature": 0.0}
+                before = generate(cluster.url, body)
+                victim = cluster.tier_members("prefill")[0]
+                victim.kill(_signal.SIGKILL)
+                answered = 0
+                for i in range(4):
+                    got = generate(cluster.url,
+                                   dict(body, request_id=f"pf-{i}"))
+                    if got["tokens"] == before["tokens"]:
+                        answered += 1
+                deadline = _time.monotonic() + 120
+                while _time.monotonic() < deadline:
+                    members = cluster.tier_members("prefill")
+                    if members and members[0] is not victim \
+                            and members[0].alive():
+                        break
+                    _time.sleep(0.1)
+                members = cluster.tier_members("prefill")
+                if not members or members[0] is victim \
+                        or members[0].role != "prefill":
+                    failures.append("prefill tier member never "
+                                    "respawned role-sticky")
+                if answered != 4:
+                    failures.append(f"prefill-kill leg: only {answered}"
+                                    f"/4 requests answered identically")
+            finally:
+                cluster.close()
+            rdeaths = incident_count("replica_death") - rdeaths0
+            if rdeaths != 1:
+                failures.append(f"prefill-kill leg: {rdeaths} "
+                                f"replica_death incidents, want 1")
+            print(f"leg 2 (prefill kill): 4/4 requests answered, "
+                  f"{rdeaths} incident, tier respawned", flush=True)
+
+            # -- leg 3: the four identity legs ------------------------------
+            for leg, temperature, quant in (
+                    ("greedy-fp32", 0.0, "none"),
+                    ("sampled-fp32", 0.8, "none"),
+                    ("greedy-int8", 0.0, "int8"),
+                    ("sampled-int8", 0.8, "int8")):
+                prior_leg = set_flags_everywhere(decode_weight_quant=quant)
+                try:
+                    body = {"prompt_ids": prompt, "max_new_tokens": 14,
+                            "temperature": temperature, "seed": 11}
+                    ref_cluster = ClusterController(
+                        "", decode_model_dir=lm_dir,
+                        role_counts={"decode": 1},
+                        inprocess=True).start(ready_timeout_s=120)
+                    try:
+                        ref = generate(ref_cluster.url, body)
+                    finally:
+                        ref_cluster.close()
+                    rdeaths0 = incident_count("replica_death")
+                    cluster = ClusterController(
+                        "", decode_model_dir=lm_dir,
+                        role_counts={"decode": 2},
+                    ).start(ready_timeout_s=180)
+                    try:
+                        result = {}
+
+                        def client():
+                            result.update(generate(
+                                cluster.url,
+                                dict(body, request_id=f"id-{leg}")))
+
+                        t = threading.Thread(
+                            target=client,
+                            name=f"pt-chaos-failover-client-{leg}")
+                        t.start()
+                        victim = None
+                        deadline = _time.monotonic() + 90
+                        while _time.monotonic() < deadline:
+                            rec = cluster.router.sessions.get(
+                                f"id-{leg}")
+                            if rec and len(rec["accepted"]) >= 3:
+                                handle = cluster.router.pick_generate(
+                                    prompt)
+                                victim = next(
+                                    r for r in cluster.replicas
+                                    if r.name == handle.name)
+                                victim.kill(_signal.SIGKILL)
+                                break
+                            _time.sleep(0.01)
+                        t.join(timeout=180)
+                    finally:
+                        cluster.close()
+                    if victim is None:
+                        failures.append(f"[{leg}] journal never showed "
+                                        f"progress — no kill landed")
+                    elif not result:
+                        failures.append(f"[{leg}] client never "
+                                        f"completed after the kill")
+                    elif result["tokens"] != ref["tokens"]:
+                        failures.append(
+                            f"[{leg}] resumed output diverged: "
+                            f"{result['tokens']} vs {ref['tokens']}")
+                    elif not result.get("failed_over"):
+                        failures.append(f"[{leg}] response not marked "
+                                        f"failed_over")
+                    rdeaths = incident_count("replica_death") - rdeaths0
+                    if rdeaths != 1:
+                        failures.append(f"[{leg}] {rdeaths} "
+                                        f"replica_death incidents, "
+                                        f"want 1")
+                    print(f"leg 3 [{leg}]: bitwise-identical across "
+                          f"the mid-generation kill "
+                          f"({len(ref['tokens'])} tokens)", flush=True)
+                finally:
+                    _flags.apply(prior_leg)
+        finally:
+            _flags.apply(prior_flags)
+            for key, val in prior_env.items():
+                if val is None:
+                    os.environ.pop(key, None)
+                else:
+                    os.environ[key] = val
+
+    counters = telemetry.counters()
+    tally = {k: counters.get(k, 0)
+             for k in ("orch.spawns", "orch.child_deaths",
+                       "orch.respawns", "session.failovers",
+                       "session.resumed", "router.prefill_forwards",
+                       "router.affinity_remaps", "incidents.reported")}
+    print("telemetry:", _json.dumps(tally, sort_keys=True), flush=True)
+    if failures:
+        for f in failures:
+            print(f"CHAOS FAIL: {f}", flush=True)
+        return 2
+    print("CHAOS OK: every role SIGKILLed once (trainer, pserver, "
+          "prefill, decode, router target) with zero lost rows/"
+          "requests, exactly one incident per death, and all four "
+          "identity legs bitwise-identical across the mid-generation "
+          "kill", flush=True)
+    return 0
+
+
 def _slo_fault_classes():
     """fault class -> (expected rule, clean driver, fault driver). Each
     driver pushes that subsystem's signature through the REAL telemetry
@@ -2045,6 +2328,16 @@ def main():
                          "exactly one scale incident per transition and "
                          "zero leaked KV rows across a server-count "
                          "resize")
+    ap.add_argument("--orchestrator", action="store_true",
+                    help="gate process-level crash survival "
+                         "(distributed/launch.py + decode-session "
+                         "failover): SIGKILL every role once — "
+                         "trainer, pserver, prefill replica, decode "
+                         "replica, the router's mid-generation "
+                         "affinity target — and assert zero lost "
+                         "rows/requests, exactly one incident per "
+                         "death, and bitwise-identical resumed output "
+                         "in all four identity legs")
     ap.add_argument("--replicas", type=int, default=2,
                     help="--cluster/--fleet mode: replica process count")
     ap.add_argument("--p99-bound", type=float, default=5000.0,
@@ -2091,6 +2384,8 @@ def main():
         sys.exit(run_fleet(args))
     if args.resize:
         sys.exit(run_resize(args))
+    if args.orchestrator:
+        sys.exit(run_orchestrator(args))
     sys.exit(run(args))
 
 

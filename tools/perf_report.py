@@ -247,6 +247,7 @@ def summarize_log(recs, malformed=0):
     goodput = _goodput_summary(counter_delta, counter_last, gauges)
     fleet = _fleet_summary(counter_delta, counter_last, gauges)
     scaler = _scaler_summary(counter_delta, counter_last, scale_events)
+    crash_survival = _crash_survival_summary(counter_delta, counter_last)
     tracing = None
     if spans:
         by_name = {}
@@ -274,6 +275,7 @@ def summarize_log(recs, malformed=0):
         "goodput": goodput,
         "fleet": fleet,
         "scaler": scaler,
+        "crash_survival": crash_survival,
         "tracing": tracing,
         "malformed_lines": int(malformed),
         "records": len(recs),
@@ -891,6 +893,50 @@ def _scaler_summary(counter_delta, counter_last, scale_events):
     }
 
 
+def _crash_survival_summary(counter_delta, counter_last):
+    """Process-level fault tolerance accounting: the launch.py
+    orchestrator's supervision plane (orch.spawns / orch.child_deaths /
+    orch.respawns / orch.budget_exhausted / orch.restart_budget_refunds
+    / orch.drains / orch.drain_kills / orch.scale_events), the training-
+    side drain (elastic.drains / elastic.drain_timeouts), and the
+    decode-session failover journal (session.journaled /
+    session.evicted / session.resumed / session.resumed_tokens /
+    session.journal_errors / session.failovers)."""
+
+    def cval(name):
+        v = counter_delta.get(name) or counter_last.get(name) or 0
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            return 0.0
+
+    spawns = cval("orch.spawns")
+    deaths = cval("orch.child_deaths")
+    journaled = cval("session.journaled")
+    failovers = cval("session.failovers")
+    drains = cval("elastic.drains") + cval("orch.drains")
+    if not (spawns or deaths or journaled or failovers or drains):
+        return None
+    return {
+        "spawns": int(spawns),
+        "child_deaths": int(deaths),
+        "respawns": int(cval("orch.respawns")),
+        "budget_exhausted": int(cval("orch.budget_exhausted")),
+        "budget_refunds": int(cval("orch.restart_budget_refunds")),
+        "orch_drains": int(cval("orch.drains")),
+        "drain_kills": int(cval("orch.drain_kills")),
+        "orch_scale_events": int(cval("orch.scale_events")),
+        "elastic_drains": int(cval("elastic.drains")),
+        "elastic_drain_timeouts": int(cval("elastic.drain_timeouts")),
+        "sessions_journaled": int(journaled),
+        "sessions_evicted": int(cval("session.evicted")),
+        "sessions_resumed": int(cval("session.resumed")),
+        "resumed_tokens": int(cval("session.resumed_tokens")),
+        "journal_errors": int(cval("session.journal_errors")),
+        "failovers": int(failovers),
+    }
+
+
 def _fmt_num(v):
     if isinstance(v, float):
         return f"{v:,.3f}".rstrip("0").rstrip(".")
@@ -1265,6 +1311,30 @@ def render(s, out=sys.stdout):
               f"world {ev.get('old_world')} -> {ev.get('new_world')}"
               + (f" ({ev['reason']})" if ev.get("reason") else "")
               + "\n")
+
+    if s.get("crash_survival"):
+        cs = s["crash_survival"]
+        w("\n-- crash survival (launch.py orchestrator + session "
+          "failover) --\n")
+        w(f"child spawns: {cs['spawns']}  deaths: {cs['child_deaths']}  "
+          f"respawns: {cs['respawns']}"
+          + (f"  BUDGET EXHAUSTED: {cs['budget_exhausted']}"
+             if cs.get("budget_exhausted") else "")
+          + (f"  (budget refunds {cs['budget_refunds']})"
+             if cs.get("budget_refunds") else "")
+          + "\n")
+        w(f"drains: orchestrator {cs['orch_drains']} (SIGKILL "
+          f"escalations {cs['drain_kills']})  trainer "
+          f"{cs['elastic_drains']} (writer-join timeouts "
+          f"{cs['elastic_drain_timeouts']})  orchestrated resizes: "
+          f"{cs['orch_scale_events']}\n")
+        w(f"decode sessions: journaled {cs['sessions_journaled']}  "
+          f"evicted {cs['sessions_evicted']}  failovers "
+          f"{cs['failovers']}  resumed {cs['sessions_resumed']} "
+          f"({_fmt_num(cs['resumed_tokens'])} tokens re-admitted)"
+          + (f"  JOURNAL ERRORS {cs['journal_errors']}"
+             if cs.get("journal_errors") else "")
+          + "\n")
 
     if s.get("tracing"):
         tr = s["tracing"]
